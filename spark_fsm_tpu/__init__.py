@@ -1,0 +1,25 @@
+"""spark_fsm_tpu — a TPU-native frequent-sequence-mining framework.
+
+Rebuilds the capabilities of ``databill86/spark-fsm`` (a Scala/Spark + Akka
+service wrapping the SPMF SPADE frequent-sequence miner and the TSR top-k
+sequential-rule miner) as an idiomatic JAX/Pallas framework:
+
+- the vertical sequence database is an HBM-resident ``item x seq x word``
+  bitmap tensor (SPAM-style id-lists, SURVEY.md sec 2.3 step 1);
+- the SPADE temporal joins (s-extension / i-extension) and support counts are
+  bitwise VPU kernels (``ops/``), batched over candidates;
+- the sequence axis shards over a ``jax.sharding.Mesh`` with partial supports
+  ``psum``-reduced over ICI before the global minsup prune (``parallel/``);
+- the service shell preserves the reference's contracts: SPMF dataset format,
+  ``algorithm={SPADE,SPADE_TPU,TSR,TSR_TPU}`` plugin selection, and the
+  train/status/get/track/register job lifecycle (``service/``).
+
+The reference mount was empty during the survey (see SURVEY.md provenance
+notice), so parity is defined behaviorally: byte-identical frequent-sequence
+sets versus the CPU oracle in ``models/oracle.py`` on the BASELINE.md configs.
+"""
+
+__version__ = "0.1.0"
+
+from spark_fsm_tpu.data.spmf import parse_spmf, format_spmf  # noqa: F401
+from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical  # noqa: F401

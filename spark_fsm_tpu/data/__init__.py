@@ -1,0 +1,1 @@
+"""Data layer: SPMF-format IO, vertical bitmap DB, sources, synthetic data."""

@@ -1,0 +1,91 @@
+"""Deterministic synthetic sequence databases.
+
+The sandbox has no network egress, so the public benchmark datasets named in
+BASELINE.md (BMS-WebView-1/2, MSNBC, Kosarak, Gazelle) cannot be downloaded.
+These generators produce seeded databases matched to the documented shape of
+each dataset (sequence count, alphabet size, length distribution, Zipfian
+item popularity) so benchmarks and parity tests are reproducible.  Swap in
+the real files via ``data.spmf.load_spmf`` when available — every consumer
+takes a plain SequenceDB.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+
+
+def synthetic_db(
+    seed: int,
+    n_sequences: int,
+    n_items: int,
+    mean_itemsets: float,
+    mean_itemset_size: float = 1.0,
+    zipf_s: float = 1.2,
+    max_itemsets: int = 96,
+    correlation: float = 0.35,
+) -> SequenceDB:
+    """Generate a clickstream-like sequence DB.
+
+    Item popularity is Zipfian (rank-``zipf_s``); ``correlation`` is the
+    probability that the next itemset is drawn from a small per-sequence
+    working set instead of globally, which creates genuine frequent patterns
+    (pure i.i.d. draws would leave little to mine).
+    """
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_items + 1, dtype=np.float64)
+    probs = ranks ** (-zipf_s)
+    probs /= probs.sum()
+
+    lengths = 1 + rng.poisson(max(mean_itemsets - 1.0, 0.0), size=n_sequences)
+    lengths = np.minimum(lengths, max_itemsets)
+    sizes_extra = rng.poisson(max(mean_itemset_size - 1.0, 0.0), size=int(lengths.sum()))
+
+    db: SequenceDB = []
+    k = 0
+    for n in lengths:
+        # Per-sequence working set of a few popular items → shared patterns.
+        wset = rng.choice(n_items, size=min(6, n_items), replace=False, p=probs) + 1
+        seq = []
+        for _ in range(int(n)):
+            sz = 1 + int(sizes_extra[k])
+            k += 1
+            itemset = set()
+            for _ in range(sz):
+                if rng.random() < correlation:
+                    itemset.add(int(wset[rng.integers(len(wset))]))
+                else:
+                    itemset.add(int(rng.choice(n_items, p=probs)) + 1)
+            seq.append(tuple(sorted(itemset)))
+        db.append(tuple(seq))
+    return db
+
+
+# Shapes follow BASELINE.md "public dataset characteristics" (scaled variants
+# for tests; full-size variants for bench.py).
+
+def bms_webview1_like(seed: int = 1, scale: float = 1.0) -> SequenceDB:
+    return synthetic_db(seed, int(59600 * scale), max(32, int(497 * scale)),
+                        mean_itemsets=2.5, zipf_s=1.1)
+
+
+def bms_webview2_like(seed: int = 2, scale: float = 1.0) -> SequenceDB:
+    return synthetic_db(seed, int(77500 * scale), max(64, int(3300 * scale)),
+                        mean_itemsets=4.6, zipf_s=1.15)
+
+
+def msnbc_like(seed: int = 3, scale: float = 1.0) -> SequenceDB:
+    # 17 page categories, long-tailed lengths.
+    return synthetic_db(seed, int(990000 * scale), 17,
+                        mean_itemsets=5.7, zipf_s=0.9, max_itemsets=96)
+
+
+def kosarak_like(seed: int = 4, scale: float = 1.0) -> SequenceDB:
+    return synthetic_db(seed, int(990000 * scale), max(128, int(41000 * scale)),
+                        mean_itemsets=8.1, zipf_s=1.3)
+
+
+def gazelle_like(seed: int = 5, scale: float = 1.0) -> SequenceDB:
+    return synthetic_db(seed, int(59000 * scale), max(64, int(498 * scale)),
+                        mean_itemsets=2.5, zipf_s=1.1)

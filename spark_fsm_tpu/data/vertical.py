@@ -1,0 +1,129 @@
+"""Vertical bitmap sequence database (SPAM-style id-lists).
+
+SURVEY.md sec 2.3 step 1: one pass over the horizontal DB builds, per item,
+an id-list of (sequence-id, itemset-position) pairs.  We use the bitmap
+representation (the variant the north star maps to TPU): for each item a
+``[n_seq, n_words]`` uint32 bitmap where bit ``p`` of sequence ``s`` (word
+``p // 32``, bit ``p % 32``, LSB-first) is set iff the item occurs in itemset
+``p`` of sequence ``s``.
+
+Positions are the *original* itemset indices of each sequence — the
+frequent-item projection drops bitmap rows but never renumbers positions, so
+maxgap/maxwindow constraints (which are defined on itemset positions,
+SURVEY.md sec 2.3 step 6) see the same gaps with or without projection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+
+WORD_BITS = 32
+
+
+@dataclasses.dataclass
+class VerticalDB:
+    """Dense vertical bitmap database over the frequent-item projection.
+
+    Attributes:
+      item_ids:   [n_items] int32, original SPMF item ids, strictly ascending.
+                  Bitmap row ``i`` belongs to item ``item_ids[i]``.
+      bitmaps:    [n_items, n_seq, n_words] uint32 occurrence bitmaps.
+      seq_lengths:[n_seq] int32, number of itemsets per sequence.
+      n_positions: padded position capacity = n_words * 32 (>= max seq length).
+      item_supports: [n_items] int32 sequence-support of each kept item.
+    """
+
+    item_ids: np.ndarray
+    bitmaps: np.ndarray
+    seq_lengths: np.ndarray
+    n_positions: int
+    item_supports: np.ndarray
+
+    @property
+    def n_items(self) -> int:
+        return int(self.bitmaps.shape[0])
+
+    @property
+    def n_sequences(self) -> int:
+        return int(self.bitmaps.shape[1])
+
+    @property
+    def n_words(self) -> int:
+        return int(self.bitmaps.shape[2])
+
+    def nbytes(self) -> int:
+        return int(self.bitmaps.nbytes)
+
+
+def build_vertical(
+    db: SequenceDB,
+    min_item_support: int = 1,
+    pad_sequences_to: Optional[int] = None,
+    word_multiple: int = 1,
+) -> VerticalDB:
+    """Build the vertical bitmap DB, keeping only items with sequence-support
+    >= ``min_item_support`` (the frequent-item projection: infrequent items
+    can never appear in a frequent pattern, so their rows are dropped;
+    positions are NOT renumbered).
+
+    ``pad_sequences_to`` pads the sequence axis (extra all-zero sequences)
+    e.g. to a device-mesh multiple; padded sequences contribute no support.
+    ``word_multiple`` pads n_words up (e.g. for kernel block shapes).
+    """
+    n_seq = len(db)
+    if n_seq == 0:
+        raise ValueError("empty sequence database")
+    seq_lengths = np.array([len(s) for s in db], dtype=np.int32)
+    max_len = int(seq_lengths.max())
+    n_words = max(1, -(-max_len // WORD_BITS))
+    if word_multiple > 1:
+        n_words = -(-n_words // word_multiple) * word_multiple
+
+    # Pass 1: sequence-support per item (count each item once per sequence).
+    supports: dict[int, int] = {}
+    for seq in db:
+        seen = set()
+        for itemset in seq:
+            seen.update(itemset)
+        for it in seen:
+            supports[it] = supports.get(it, 0) + 1
+    kept = sorted(it for it, sup in supports.items() if sup >= min_item_support)
+    item_index = {it: i for i, it in enumerate(kept)}
+    n_items = len(kept)
+
+    n_seq_padded = n_seq if pad_sequences_to is None else max(n_seq, pad_sequences_to)
+    bitmaps = np.zeros((n_items, n_seq_padded, n_words), dtype=np.uint32)
+
+    # Pass 2: set occurrence bits.
+    for s, seq in enumerate(db):
+        for p, itemset in enumerate(seq):
+            word = p // WORD_BITS
+            mask = np.uint32(1 << (p % WORD_BITS))
+            for it in itemset:
+                i = item_index.get(it)
+                if i is not None:
+                    bitmaps[i, s, word] |= mask
+
+    seq_lengths_padded = np.zeros(n_seq_padded, dtype=np.int32)
+    seq_lengths_padded[:n_seq] = seq_lengths
+    item_supports = np.array([supports[it] for it in kept], dtype=np.int32)
+    return VerticalDB(
+        item_ids=np.array(kept, dtype=np.int32),
+        bitmaps=bitmaps,
+        seq_lengths=seq_lengths_padded,
+        n_positions=n_words * WORD_BITS,
+        item_supports=item_supports,
+    )
+
+
+def abs_minsup(rel_minsup: float, n_sequences: int) -> int:
+    """Relative minsup (e.g. 0.001 = 0.1%) -> absolute sequence count.
+
+    SURVEY.md sec 2.3: ``ceil(minsup * |DB|)``, floored at 1.
+    """
+    return max(1, int(np.ceil(rel_minsup * n_sequences)))

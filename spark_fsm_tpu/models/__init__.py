@@ -1,0 +1,1 @@
+"""Miners: CPU oracles and TPU engines for SPADE and TSR."""

@@ -1,0 +1,166 @@
+"""CPU oracle miners.
+
+Two independent implementations guard correctness (SURVEY.md sec 4):
+
+- ``brute_force_mine``: direct containment checks over the horizontal DB with
+  unpruned candidate extension — slow, only for tiny fixtures, but shares no
+  bitmap/join code with anything else.  Ground truth for the oracle itself.
+- ``mine_spade``: the real CPU oracle — SPAM-style DFS over the vertical
+  bitmap DB (SURVEY.md sec 2.3 steps 2-5) built on ops/bitops_np.py.  This is
+  the "CPU SPADE" the north star's byte-identical parity is measured against,
+  and its enumeration (shared S/I candidate lists per equivalence class,
+  ascending item order) defines the canonical pattern universe the TPU engine
+  must reproduce.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_fsm_tpu.data.spmf import Sequence, SequenceDB
+from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
+from spark_fsm_tpu.ops import bitops_np as B
+from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
+
+
+# ---------------------------------------------------------------------------
+# Brute force (independent ground truth for tiny DBs)
+# ---------------------------------------------------------------------------
+
+def contains(seq: Sequence, pattern: Pattern) -> bool:
+    """True iff ``pattern`` occurs in ``seq`` (ordered itemset-subset match).
+
+    Greedy leftmost matching is correct for plain containment: taking the
+    earliest itemset that covers the next pattern element never removes later
+    options.
+    """
+    p = 0
+    for itemset in seq:
+        if p == len(pattern):
+            return True
+        if set(pattern[p]).issubset(itemset):
+            p += 1
+    return p == len(pattern)
+
+
+def brute_force_mine(
+    db: SequenceDB,
+    minsup_abs: int,
+    max_pattern_itemsets: int = 6,
+    max_itemset_size: int = 3,
+) -> List[PatternResult]:
+    """Level-wise mining with direct containment counting.
+
+    Extends every frequent pattern with every frequent item (both s- and
+    i-extension), relying only on the apriori property — no equivalence-class
+    pruning — so its completeness is independent of the SPAM S/I-list logic.
+    """
+    items = sorted({i for seq in db for itemset in seq for i in itemset})
+
+    def sup(pat: Pattern) -> int:
+        return sum(1 for seq in db if contains(seq, pat))
+
+    freq_items = [i for i in items if sup(((i,),)) >= minsup_abs]
+    results: List[PatternResult] = []
+    frontier: List[Pattern] = []
+    for i in freq_items:
+        pat: Pattern = ((i,),)
+        results.append((pat, sup(pat)))
+        frontier.append(pat)
+    while frontier:
+        nxt: List[Pattern] = []
+        for pat in frontier:
+            cands: List[Pattern] = []
+            if len(pat) < max_pattern_itemsets:
+                cands.extend(pat + ((i,),) for i in freq_items)
+            last = pat[-1]
+            if len(last) < max_itemset_size:
+                cands.extend(
+                    pat[:-1] + (tuple(sorted(last + (i,))),)
+                    for i in freq_items if i > last[-1]
+                )
+            for c in cands:
+                s = sup(c)
+                if s >= minsup_abs:
+                    results.append((c, s))
+                    nxt.append(c)
+        frontier = nxt
+    return sort_patterns(results)
+
+
+# ---------------------------------------------------------------------------
+# CPU SPADE oracle (SPAM bitmap DFS)
+# ---------------------------------------------------------------------------
+
+def mine_spade_vertical(
+    vdb: VerticalDB,
+    minsup_abs: int,
+    max_pattern_itemsets: Optional[int] = None,
+) -> List[PatternResult]:
+    """SPAM-style DFS over a prebuilt vertical DB.
+
+    Equivalence-class candidate pruning per Ayres et al. 2002 (SURVEY.md
+    sec 2.3 step 3): at each node with candidate lists (S, I), the frequent
+    s-extension items S' become every child's S list; an s-child by item i
+    gets I = {j in S' : j > i}; an i-child by item i gets I = {j in I' : j >
+    i} where I' are the frequent i-extension items.
+    """
+    bm = vdb.bitmaps  # [n_items, n_seq, n_words]
+    n_items = vdb.n_items
+    ids = vdb.item_ids
+    results: List[PatternResult] = []
+
+    root_items = [i for i in range(n_items) if int(vdb.item_supports[i]) >= minsup_abs]
+
+    # Stack-based DFS; node = (pattern, bitmap, s_list, i_list).
+    stack: List[Tuple[Pattern, np.ndarray, List[int], List[int]]] = []
+    for i in reversed(root_items):
+        pat: Pattern = ((int(ids[i]),),)
+        results.append((pat, int(vdb.item_supports[i])))
+        stack.append((pat, bm[i], root_items, [j for j in root_items if j > i]))
+
+    while stack:
+        pat, bmp, s_list, i_list = stack.pop()
+        if max_pattern_itemsets is not None and len(pat) >= max_pattern_itemsets and not i_list:
+            continue
+        s_ok: List[Tuple[int, np.ndarray, int]] = []
+        allow_s = max_pattern_itemsets is None or len(pat) < max_pattern_itemsets
+        if allow_s and s_list:
+            trans = B.sext_transform(bmp)
+            for i in s_list:
+                nb = trans & bm[i]
+                sup = int(B.support(nb))
+                if sup >= minsup_abs:
+                    s_ok.append((i, nb, sup))
+        s_items = [i for i, _, _ in s_ok]
+        i_ok: List[Tuple[int, np.ndarray, int]] = []
+        for i in i_list:
+            nb = bmp & bm[i]
+            sup = int(B.support(nb))
+            if sup >= minsup_abs:
+                i_ok.append((i, nb, sup))
+        i_items = [i for i, _, _ in i_ok]
+
+        # Push in reverse so DFS visits ascending item order, s before i.
+        for i, nb, sup in reversed(i_ok):
+            child = pat[:-1] + (pat[-1] + (int(ids[i]),),)
+            results.append((child, sup))
+            stack.append((child, nb, s_items, [j for j in i_items if j > i]))
+        for i, nb, sup in reversed(s_ok):
+            child = pat + ((int(ids[i]),),)
+            results.append((child, sup))
+            stack.append((child, nb, s_items, [j for j in s_items if j > i]))
+    return sort_patterns(results)
+
+
+def mine_spade(
+    db: SequenceDB,
+    minsup_abs: int,
+    max_pattern_itemsets: Optional[int] = None,
+) -> List[PatternResult]:
+    vdb = build_vertical(db, min_item_support=minsup_abs)
+    if vdb.n_items == 0:
+        return []
+    return mine_spade_vertical(vdb, minsup_abs, max_pattern_itemsets)

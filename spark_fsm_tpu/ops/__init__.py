@@ -1,0 +1,1 @@
+"""Bitmap join/support kernels: NumPy reference, jax.numpy, and Pallas TPU."""

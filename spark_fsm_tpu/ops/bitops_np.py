@@ -1,0 +1,84 @@
+"""NumPy reference implementations of the SPADE bitmap primitives.
+
+These define the exact semantics the TPU kernels (ops/bitops_jax.py,
+ops/pallas_kernels.py) must reproduce bit-for-bit; the oracle miner
+(models/oracle.py) is built on them.  SURVEY.md sec 2.3 step 4:
+
+- i-extension: bitmap AND at identical positions;
+- s-extension: transform the prefix bitmap so that, per sequence, all bits
+  strictly after the FIRST set bit are set ("first-occurrence postfix
+  mask"), then AND with the item bitmap;
+- support: number of sequences whose slice of the result is nonzero.
+
+Bit order: position p lives in word p // 32, bit p % 32, LSB-first, so
+"later position" = "more significant bit" and the postfix mask is a carry
+chain toward higher words.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+U32 = np.uint32
+FULL = np.uint32(0xFFFFFFFF)
+
+
+def prefix_or_word(w: np.ndarray) -> np.ndarray:
+    """Within-word inclusive prefix OR: out bit p = OR of bits 0..p of w."""
+    w = w.astype(U32, copy=True)
+    for shift in (1, 2, 4, 8, 16):
+        w |= w << U32(shift)
+    return w
+
+
+def sext_transform(b: np.ndarray) -> np.ndarray:
+    """First-occurrence postfix mask over the last (word) axis.
+
+    out bit p = 1 iff some bit q < p of the same sequence is set in ``b``
+    (equivalently: p is strictly after the first set bit).
+    """
+    b = np.asarray(b, dtype=U32)
+    out = np.empty_like(b)
+    carry = np.zeros(b.shape[:-1], dtype=bool)
+    for j in range(b.shape[-1]):
+        w = b[..., j]
+        out[..., j] = (prefix_or_word(w) << U32(1)) | np.where(carry, FULL, U32(0))
+        carry |= w != 0
+    return out
+
+
+def i_extend(prefix_bitmap: np.ndarray, item_bitmap: np.ndarray) -> np.ndarray:
+    """Itemset extension: both end at the same position."""
+    return prefix_bitmap & item_bitmap
+
+
+def s_extend(prefix_bitmap: np.ndarray, item_bitmap: np.ndarray) -> np.ndarray:
+    """Sequence extension: item strictly after the prefix's first end."""
+    return sext_transform(prefix_bitmap) & item_bitmap
+
+
+def support(bitmap: np.ndarray) -> np.ndarray:
+    """Sequence-count support: #sequences with any set bit.
+
+    bitmap: [..., n_seq, n_words] -> [...] int64.
+    """
+    return np.count_nonzero((np.asarray(bitmap) != 0).any(axis=-1), axis=-1)
+
+
+def first_set_positions(b: np.ndarray) -> np.ndarray:
+    """Per-sequence index of the first set bit, or n_words*32 if none.
+
+    b: [..., n_words] -> [...] int32.  Used by TSR occurrence logic.
+    """
+    b = np.asarray(b, dtype=U32)
+    n_words = b.shape[-1]
+    pos = np.full(b.shape[:-1], n_words * 32, dtype=np.int32)
+    for j in range(n_words - 1, -1, -1):
+        w = b[..., j]
+        nz = w != 0
+        # int64 to avoid uint32->float pitfalls in log2-style tricks
+        ww = w.astype(np.int64)
+        lsb = (ww & -ww).astype(np.uint64)
+        low = np.where(nz, (np.log2(np.maximum(lsb, 1).astype(np.float64))).astype(np.int32), 0)
+        pos = np.where(nz, j * 32 + low, pos)
+    return pos

@@ -1,0 +1,1 @@
+"""Utilities: canonical pattern serialization, profiling, logging."""

@@ -1,0 +1,14 @@
+"""Test env: force CPU backend with 8 virtual devices (SURVEY.md sec 4).
+
+Must run before any ``import jax`` — pytest imports conftest first, so this
+is the one place allowed to set the env.  The same sharded code runs
+unchanged on a real TPU mesh; the driver's dryrun_multichip uses the same
+mechanism.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

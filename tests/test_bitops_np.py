@@ -1,0 +1,85 @@
+import numpy as np
+
+from spark_fsm_tpu.ops import bitops_np as B
+
+
+def bits(*positions, n_words=1):
+    out = np.zeros(n_words, dtype=np.uint32)
+    for p in positions:
+        out[p // 32] |= np.uint32(1 << (p % 32))
+    return out
+
+
+def naive_sext(b):
+    """Bit-by-bit reference for the postfix mask."""
+    n = b.shape[-1] * 32
+    get = lambda p: (b[p // 32] >> (p % 32)) & 1
+    out = np.zeros_like(b)
+    for p in range(n):
+        if any(get(q) for q in range(p)):
+            out[p // 32] |= np.uint32(1 << (p % 32))
+    return out
+
+
+def test_sext_simple():
+    b = bits(2)
+    assert B.sext_transform(b).tolist() == [(0xFFFFFFFF << 3) & 0xFFFFFFFF]
+
+
+def test_sext_zero():
+    assert B.sext_transform(bits()).tolist() == [0]
+
+
+def test_sext_first_bit_only_counts():
+    # bits at 1 and 5 -> mask = everything strictly after 1
+    got = B.sext_transform(bits(1, 5))
+    assert got.tolist() == [(0xFFFFFFFF << 2) & 0xFFFFFFFF]
+
+
+def test_sext_multiword_carry():
+    b = bits(33, n_words=3)
+    got = B.sext_transform(b)
+    assert got[0] == 0
+    assert got[1] == (0xFFFFFFFF << 2) & 0xFFFFFFFF
+    assert got[2] == 0xFFFFFFFF
+    b2 = bits(0, n_words=2)
+    got2 = B.sext_transform(b2)
+    assert got2[0] == 0xFFFFFFFE and got2[1] == 0xFFFFFFFF
+
+
+def test_sext_random_vs_naive():
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        b = rng.integers(0, 2**32, size=3, dtype=np.uint32)
+        # sparsify so first-set-bit positions vary
+        b &= rng.integers(0, 2**32, size=3, dtype=np.uint32)
+        b &= rng.integers(0, 2**32, size=3, dtype=np.uint32)
+        np.testing.assert_array_equal(B.sext_transform(b), naive_sext(b))
+
+
+def test_sext_batched_shape():
+    rng = np.random.default_rng(1)
+    b = rng.integers(0, 2**32, size=(4, 5, 2), dtype=np.uint32)
+    got = B.sext_transform(b)
+    for i in range(4):
+        for j in range(5):
+            np.testing.assert_array_equal(got[i, j], B.sext_transform(b[i, j]))
+
+
+def test_extensions_and_support():
+    # seq0: prefix at pos 1, item at pos 3 -> s-ext hits, i-ext misses
+    prefix = np.stack([bits(1), bits(2)])
+    item = np.stack([bits(3), bits(2)])
+    s = B.s_extend(prefix, item)
+    assert s[0].tolist() == bits(3).tolist()
+    assert s[1].tolist() == [0]
+    i = B.i_extend(prefix, item)
+    assert i[0].tolist() == [0]
+    assert i[1].tolist() == bits(2).tolist()
+    assert B.support(s) == 1 and B.support(i) == 1
+    assert B.support(np.zeros((3, 2), np.uint32)) == 0
+
+
+def test_first_set_positions():
+    b = np.stack([bits(0, n_words=2), bits(37, 40, n_words=2), bits(n_words=2)])
+    assert B.first_set_positions(b).tolist() == [0, 37, 64]

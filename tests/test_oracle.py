@@ -1,0 +1,77 @@
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.models.oracle import brute_force_mine, contains, mine_spade
+from spark_fsm_tpu.utils.canonical import patterns_text, diff_patterns
+
+# Worked example in the style of Zaki's SPADE paper (SURVEY.md sec 4).
+ZAKI_DB = parse_spmf(
+    """
+    1 3 -1 2 -1 2 4 -2
+    1 -1 2 -2
+    3 -1 2 4 -2
+    1 3 -1 4 -2
+    """
+)
+
+
+def test_contains():
+    seq = ((1, 3), (2,), (2, 4))
+    assert contains(seq, ((1,), (2,)))
+    assert contains(seq, ((1, 3), (2, 4)))
+    assert contains(seq, ((2,), (2,)))
+    assert not contains(seq, ((2,), (1,)))
+    assert not contains(seq, ((1, 2),))
+    assert not contains(seq, ((2,), (2,), (2,)))
+
+
+def test_zaki_fixture_spot_values():
+    res = dict(mine_spade(ZAKI_DB, minsup_abs=2))
+    assert res[((1,),)] == 3
+    assert res[((2,),)] == 3
+    assert res[((1, 3),)] == 2
+    assert res[((1,), (2,))] == 2
+    assert res[((3,), (2, 4))] == 2
+    assert res[((3,), (4,))] == 3
+    assert ((2,), (1,)) not in res
+    assert ((1, 2),) not in res
+
+
+def test_oracle_matches_brute_force_on_fixture():
+    a = mine_spade(ZAKI_DB, minsup_abs=2)
+    b = brute_force_mine(ZAKI_DB, minsup_abs=2, max_pattern_itemsets=8, max_itemset_size=4)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+
+
+def random_db(rng, n_seq=12, n_items=5, max_itemsets=4, max_set=3):
+    db = []
+    for _ in range(n_seq):
+        seq = []
+        for _ in range(rng.integers(1, max_itemsets + 1)):
+            k = int(rng.integers(1, max_set + 1))
+            itemset = tuple(sorted(rng.choice(n_items, size=k, replace=False) + 1))
+            seq.append(tuple(int(x) for x in itemset))
+        db.append(tuple(seq))
+    return db
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("minsup", [2, 4])
+def test_oracle_matches_brute_force_randomized(seed, minsup):
+    rng = np.random.default_rng(seed)
+    db = random_db(rng)
+    a = mine_spade(db, minsup_abs=minsup)
+    b = brute_force_mine(db, minsup_abs=minsup, max_pattern_itemsets=8, max_itemset_size=5)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+
+
+def test_max_pattern_itemsets_cap():
+    res = mine_spade(ZAKI_DB, minsup_abs=2, max_pattern_itemsets=1)
+    assert all(len(p) == 1 for p, _ in res)
+    # i-extensions within the single itemset still allowed
+    assert ((1, 3),) in dict(res)
+
+
+def test_empty_result():
+    assert mine_spade(parse_spmf("1 -2\n2 -2\n"), minsup_abs=2) == []

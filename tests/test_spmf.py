@@ -1,0 +1,37 @@
+import pytest
+
+from spark_fsm_tpu.data.spmf import format_spmf, parse_spmf
+
+
+def test_parse_basic():
+    db = parse_spmf("1 3 -1 2 -1 2 4 -1 -2\n")
+    assert db == [((1, 3), (2,), (2, 4))]
+
+
+def test_parse_no_trailing_markers():
+    assert parse_spmf("5 -1 6") == [((5,), (6,))]
+    assert parse_spmf("5 -1 6 -2") == [((5,), (6,))]
+
+
+def test_parse_skips_comments_and_blanks():
+    text = "# header\n\n1 -1 2 -2\n% meta\n3 -2\n"
+    assert parse_spmf(text) == [((1,), (2,)), ((3,),)]
+
+
+def test_parse_normalizes_itemsets():
+    # duplicates removed, items sorted within an itemset
+    assert parse_spmf("3 1 3 -1 -2") == [((1, 3),)]
+
+
+def test_parse_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        parse_spmf("0 -1 -2")
+
+
+def test_roundtrip():
+    db = [((1, 3), (2,), (2, 4)), ((7,),)]
+    assert parse_spmf(format_spmf(db)) == db
+
+
+def test_format_exact_text():
+    assert format_spmf([((1, 3), (2,))]) == "1 3 -1 2 -1 -2\n"

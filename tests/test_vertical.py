@@ -1,0 +1,63 @@
+import numpy as np
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+
+
+def test_bit_layout():
+    db = parse_spmf("1 3 -1 2 -1 2 4 -2\n1 -1 2 -2\n")
+    vdb = build_vertical(db)
+    assert vdb.item_ids.tolist() == [1, 2, 3, 4]
+    assert vdb.n_words == 1
+    i = {it: k for k, it in enumerate(vdb.item_ids.tolist())}
+    # seq 0: item 1 at pos 0; item 2 at pos 1 and 2; item 3 at pos 0; item 4 at pos 2
+    assert vdb.bitmaps[i[1], 0, 0] == 0b001
+    assert vdb.bitmaps[i[2], 0, 0] == 0b110
+    assert vdb.bitmaps[i[3], 0, 0] == 0b001
+    assert vdb.bitmaps[i[4], 0, 0] == 0b100
+    # seq 1: item 1 at pos 0, item 2 at pos 1
+    assert vdb.bitmaps[i[1], 1, 0] == 0b01
+    assert vdb.bitmaps[i[2], 1, 0] == 0b10
+    assert vdb.item_supports.tolist() == [2, 2, 1, 1]
+
+
+def test_projection_keeps_positions():
+    # item 9 is infrequent; dropping it must not shift item 2's position
+    db = parse_spmf("9 -1 2 -2\n2 -1 2 -2\n")
+    vdb = build_vertical(db, min_item_support=2)
+    assert vdb.item_ids.tolist() == [2]
+    assert vdb.bitmaps[0, 0, 0] == 0b10  # still position 1
+    assert vdb.bitmaps[0, 1, 0] == 0b11
+
+
+def test_multiword_positions():
+    # a sequence with 40 itemsets puts bits into word 1
+    seq = " -1 ".join(["7"] * 40) + " -2"
+    vdb = build_vertical(parse_spmf(seq))
+    assert vdb.n_words == 2
+    assert vdb.bitmaps[0, 0, 0] == 0xFFFFFFFF
+    assert vdb.bitmaps[0, 0, 1] == 0xFF
+
+
+def test_sequence_padding():
+    db = parse_spmf("1 -2\n")
+    vdb = build_vertical(db, pad_sequences_to=8)
+    assert vdb.n_sequences == 8
+    assert vdb.bitmaps[:, 1:].sum() == 0
+    assert vdb.seq_lengths.tolist() == [1, 0, 0, 0, 0, 0, 0, 0]
+
+
+def test_word_multiple():
+    vdb = build_vertical(parse_spmf("1 -2\n"), word_multiple=4)
+    assert vdb.n_words == 4
+
+
+def test_abs_minsup():
+    assert abs_minsup(0.001, 77500) == 78
+    assert abs_minsup(0.5, 3) == 2
+    assert abs_minsup(0.0, 100) == 1
+
+
+def test_nbytes():
+    vdb = build_vertical(parse_spmf("1 -2\n"))
+    assert vdb.nbytes() == 4
