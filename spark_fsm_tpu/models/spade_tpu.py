@@ -1,0 +1,363 @@
+"""SPADE on TPU: batched SPAM DFS over a device-resident bitmap store.
+
+Architecture (the TPU-native replacement for the reference's JVM miner,
+SURVEY.md sec 3.1 hot loop):
+
+- The vertical DB and all live pattern bitmaps sit in one HBM-resident
+  ``store[slot, seq, word]`` uint32 tensor.  Slots ``0..n_items-1`` are the
+  item id-lists (never freed); the rest is a pool for pattern bitmaps plus a
+  final scratch slot that absorbs padded-lane writes.
+- Host-side DFS pops nodes in batches; every candidate (parent x item x
+  ext-type) in the batch goes through one fused device kernel chain:
+  gather -> s-ext transform / AND join -> per-sequence any -> support sum.
+  The host then applies the minsup prune (SURVEY.md sec 2.3 step 5) and
+  materializes only surviving children back into pool slots.
+- Memory safety is recompute-on-miss, not spill: a child that gets no free
+  slot (or whose slot was reclaimed) carries its extension path
+  ``steps = ((item, is_s), ...)``; when popped, its bitmap is rebuilt by a
+  ``lax.scan`` fold of joins from the item id-lists — bit-exact, because a
+  pattern's bitmap IS the fold of its extension joins.
+- With a mesh, the sequence axis shards over devices (``shard_map``); joins
+  are embarrassingly parallel and per-shard partial supports ``psum`` over
+  ICI before the global prune — the reference's Spark-partition aggregation
+  (SURVEY.md sec 2.2), natively.
+
+Enumeration (S/I equivalence-class pruning) is identical to the CPU oracle
+in models/oracle.py, so the output pattern set is byte-identical by
+construction; supports are exact integers from popcounts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
+from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
+from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
+
+Step = Tuple[int, bool]  # (item index, is_s_extension)
+
+
+@dataclasses.dataclass
+class _Node:
+    steps: Tuple[Step, ...]
+    slot: Optional[int]
+    s_list: List[int]
+    i_list: List[int]
+
+
+def _next_pow2(n: int) -> int:
+    k = 1
+    while k < n:
+        k *= 2
+    return k
+
+
+class SpadeTPU:
+    """Single- or multi-chip SPADE miner.
+
+    Args:
+      vdb: vertical DB (build with ``min_item_support=minsup_abs`` for the
+        frequent-item projection; extra items are filtered here anyway).
+      minsup_abs: absolute minimum sequence support.
+      mesh: optional 1-D ``Mesh`` over SEQ_AXIS; sequence axis is padded to
+        a device multiple and sharded.
+      chunk: candidates per support-kernel launch.
+      node_batch: DFS nodes popped per host iteration.
+      pool_bytes: HBM budget for the pattern-bitmap pool.
+      max_pattern_itemsets: optional cap on pattern length in itemsets.
+    """
+
+    def __init__(
+        self,
+        vdb: VerticalDB,
+        minsup_abs: int,
+        *,
+        mesh: Optional[Mesh] = None,
+        chunk: int = 512,
+        node_batch: int = 256,
+        recompute_chunk: int = 256,
+        pool_bytes: int = 2 << 30,
+        max_pattern_itemsets: Optional[int] = None,
+    ):
+        self.vdb = vdb
+        self.minsup = int(minsup_abs)
+        self.mesh = mesh
+        self.chunk = int(chunk)
+        self.recompute_chunk = int(recompute_chunk)
+        self.max_pattern_itemsets = max_pattern_itemsets
+
+        bitmaps = vdb.bitmaps
+        n_items, n_seq, n_words = bitmaps.shape
+        if mesh is not None:
+            n_dev = mesh.devices.size
+            padded = pad_to_multiple(n_seq, n_dev)
+            if padded != n_seq:
+                bitmaps = np.concatenate(
+                    [bitmaps, np.zeros((n_items, padded - n_seq, n_words), np.uint32)], axis=1
+                )
+                n_seq = padded
+        self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
+
+        slot_bytes = n_seq * n_words * 4
+        pool_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 16384))
+        self.pool_slots = pool_slots
+        self.node_batch = min(int(node_batch), pool_slots)
+        self.scratch = n_items + pool_slots
+        total = n_items + pool_slots + 1
+
+        store_np = np.zeros((total, n_seq, n_words), dtype=np.uint32)
+        store_np[:n_items] = bitmaps
+        if mesh is not None:
+            self.store = jax.device_put(store_np, store_sharding(mesh))
+        else:
+            self.store = jax.device_put(store_np)
+        del store_np
+        self._free: List[int] = list(range(n_items + pool_slots - 1, n_items - 1, -1))
+        self._build_fns()
+
+        # mining statistics (observability, SURVEY.md sec 5)
+        self.stats = {
+            "candidates": 0, "kernel_launches": 0, "recomputed_nodes": 0,
+            "reclaimed_slots": 0, "patterns": 0,
+        }
+
+    # ------------------------------------------------------------------ fns
+
+    def _build_fns(self) -> None:
+        mesh = self.mesh
+
+        def supports_body(store, parent_slot, item_slot, iss):
+            j = B.join(store[parent_slot], store[item_slot], iss)
+            part = B.support(j)
+            if mesh is not None:
+                part = jax.lax.psum(part, SEQ_AXIS)
+            return part
+
+        def materialize_body(store, parent_slot, item_slot, iss, out_slot):
+            j = B.join(store[parent_slot], store[item_slot], iss)
+            return store.at[out_slot].set(j)
+
+        def recompute_body(store, step_items, step_iss, step_valid, out_slot):
+            # step_* : [K, M]; fold the join chain along K.
+            bmp = store[step_items[0]]
+            def body(b, xs):
+                it, iss, valid = xs
+                nb = B.join(b, store[it], iss)
+                return jnp.where(valid[:, None, None], nb, b), None
+            bmp, _ = jax.lax.scan(body, bmp, (step_items[1:], step_iss[1:], step_valid[1:]))
+            return store.at[out_slot].set(bmp)
+
+        if mesh is None:
+            self._supports_fn = jax.jit(supports_body)
+            self._materialize_fn = jax.jit(materialize_body, donate_argnums=0)
+            self._recompute_fn = jax.jit(recompute_body, donate_argnums=0)
+        else:
+            st = P(None, SEQ_AXIS, None)
+            rep = P()
+            self._supports_fn = jax.jit(
+                jax.shard_map(supports_body, mesh=mesh,
+                              in_specs=(st, rep, rep, rep), out_specs=rep)
+            )
+            self._materialize_fn = jax.jit(
+                jax.shard_map(materialize_body, mesh=mesh,
+                              in_specs=(st, rep, rep, rep, rep), out_specs=st),
+                donate_argnums=0,
+            )
+            self._recompute_fn = jax.jit(
+                jax.shard_map(recompute_body, mesh=mesh,
+                              in_specs=(st, rep, rep, rep, rep), out_specs=st),
+                donate_argnums=0,
+            )
+
+    # ------------------------------------------------------------ slot mgmt
+
+    def _alloc(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def _free_slot(self, slot: Optional[int]) -> None:
+        if slot is not None and slot >= self.n_items:
+            self._free.append(slot)
+
+    def _reclaim(self, stack: List[_Node], need: int) -> None:
+        """Drop bitmap slots from the bottom of the DFS stack (processed
+        last, cheapest to recompute later) until ``need`` slots are free."""
+        for node in stack:
+            if len(self._free) >= need:
+                return
+            if node.slot is not None and node.slot >= self.n_items:
+                self._free.append(node.slot)
+                node.slot = None
+                self.stats["reclaimed_slots"] += 1
+
+    # ------------------------------------------------------------- kernels
+
+    def _supports(self, parent: np.ndarray, item: np.ndarray, iss: np.ndarray) -> np.ndarray:
+        """Chunked candidate support counts; inputs are 1-D int/bool arrays."""
+        n = len(parent)
+        out = np.empty(n, dtype=np.int32)
+        c = self.chunk
+        for lo in range(0, n, c):
+            hi = min(lo + c, n)
+            pad = c - (hi - lo)
+            p = np.pad(parent[lo:hi], (0, pad)).astype(np.int32)
+            it = np.pad(item[lo:hi], (0, pad)).astype(np.int32)
+            ss = np.pad(iss[lo:hi], (0, pad)).astype(bool)
+            sup = self._supports_fn(self.store, jnp.asarray(p), jnp.asarray(it), jnp.asarray(ss))
+            out[lo:hi] = np.asarray(sup)[: hi - lo]
+            self.stats["kernel_launches"] += 1
+        self.stats["candidates"] += n
+        return out
+
+    def _materialize(self, parent, item, iss, out_slot) -> None:
+        n = len(parent)
+        c = self.chunk
+        for lo in range(0, n, c):
+            hi = min(lo + c, n)
+            pad = c - (hi - lo)
+            p = np.pad(parent[lo:hi], (0, pad)).astype(np.int32)
+            it = np.pad(item[lo:hi], (0, pad)).astype(np.int32)
+            ss = np.pad(iss[lo:hi], (0, pad)).astype(bool)
+            os = np.pad(out_slot[lo:hi], (0, pad), constant_values=self.scratch).astype(np.int32)
+            self.store = self._materialize_fn(
+                self.store, jnp.asarray(p), jnp.asarray(it), jnp.asarray(ss), jnp.asarray(os)
+            )
+            self.stats["kernel_launches"] += 1
+
+    def _ensure_slots(self, batch: List[_Node], stack: List[_Node]) -> None:
+        """Recompute bitmaps for popped nodes that lost (or never had) a slot."""
+        missing = [n for n in batch if n.slot is None]
+        if not missing:
+            return
+        self.stats["recomputed_nodes"] += len(missing)
+        if len(self._free) < len(missing):
+            self._reclaim(stack, len(missing))
+        for lo in range(0, len(missing), self.recompute_chunk):
+            group = missing[lo: lo + self.recompute_chunk]
+            m = self.recompute_chunk
+            k = _next_pow2(max(len(n.steps) for n in group))
+            items = np.zeros((k, m), np.int32)
+            iss = np.zeros((k, m), bool)
+            valid = np.zeros((k, m), bool)
+            slots = np.full(m, self.scratch, np.int32)
+            for col, node in enumerate(group):
+                slot = self._alloc()
+                assert slot is not None, "slot pool exhausted beyond reclaim"
+                node.slot = slot
+                slots[col] = slot
+                for row, (it, s) in enumerate(node.steps):
+                    items[row, col], iss[row, col], valid[row, col] = it, s, True
+            self.store = self._recompute_fn(
+                self.store, jnp.asarray(items), jnp.asarray(iss),
+                jnp.asarray(valid), jnp.asarray(slots)
+            )
+            self.stats["kernel_launches"] += 1
+
+    # ---------------------------------------------------------------- mine
+
+    def _pattern_of(self, steps: Sequence[Step]) -> Pattern:
+        ids = self.vdb.item_ids
+        pat: List[List[int]] = []
+        for it, is_s in steps:
+            if is_s:
+                pat.append([int(ids[it])])
+            else:
+                pat[-1].append(int(ids[it]))
+        return tuple(tuple(s) for s in pat)
+
+    def mine(self) -> List[PatternResult]:
+        minsup = self.minsup
+        results: List[PatternResult] = []
+        root_items = [i for i in range(self.n_items)
+                      if int(self.vdb.item_supports[i]) >= minsup]
+        stack: List[_Node] = []
+        for i in reversed(root_items):
+            results.append((self._pattern_of(((i, True),)), int(self.vdb.item_supports[i])))
+            stack.append(_Node(((i, True),), i, root_items,
+                               [j for j in root_items if j > i]))
+
+        while stack:
+            batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
+            self._ensure_slots(batch, stack)
+
+            # Flat candidate list for the whole batch.
+            cand_parent: List[int] = []
+            cand_item: List[int] = []
+            cand_iss: List[bool] = []
+            spans: List[Tuple[int, int, int]] = []  # (s_lo, s_hi == i_lo, i_hi)
+            for node in batch:
+                n_itemsets = sum(1 for _, s in node.steps if s)
+                allow_s = (self.max_pattern_itemsets is None
+                           or n_itemsets < self.max_pattern_itemsets)
+                s_lo = len(cand_parent)
+                if allow_s:
+                    for i in node.s_list:
+                        cand_parent.append(node.slot); cand_item.append(i); cand_iss.append(True)
+                s_hi = len(cand_parent)
+                for i in node.i_list:
+                    cand_parent.append(node.slot); cand_item.append(i); cand_iss.append(False)
+                spans.append((s_lo, s_hi, len(cand_parent)))
+
+            sups = (self._supports(np.array(cand_parent, np.int32),
+                                   np.array(cand_item, np.int32),
+                                   np.array(cand_iss, bool))
+                    if cand_parent else np.empty(0, np.int32))
+
+            # Prune, create children, collect materialization work.
+            children: List[_Node] = []
+            mat_parent: List[int] = []; mat_item: List[int] = []
+            mat_iss: List[bool] = []; mat_child: List[int] = []
+            for node, (s_lo, s_hi, i_hi) in zip(batch, spans):
+                s_items = [cand_item[k] for k in range(s_lo, s_hi) if sups[k] >= minsup]
+                i_items = [cand_item[k] for k in range(s_hi, i_hi) if sups[k] >= minsup]
+                for k in range(s_lo, i_hi):
+                    if sups[k] < minsup:
+                        continue
+                    it, is_s = cand_item[k], cand_iss[k]
+                    steps = node.steps + ((it, is_s),)
+                    results.append((self._pattern_of(steps), int(sups[k])))
+                    src = s_items if is_s else i_items
+                    child_i = [j for j in src if j > it]
+                    if not s_items and not child_i:
+                        continue  # leaf: no possible extensions
+                    child = _Node(steps, None, s_items, child_i)
+                    slot = self._alloc()
+                    if slot is not None:
+                        child.slot = slot
+                        mat_parent.append(node.slot); mat_item.append(it)
+                        mat_iss.append(is_s); mat_child.append(slot)
+                    children.append(child)
+            if mat_child:
+                self._materialize(np.array(mat_parent, np.int32), np.array(mat_item, np.int32),
+                                  np.array(mat_iss, bool), np.array(mat_child, np.int32))
+            stack.extend(reversed(children))
+            for node in batch:
+                self._free_slot(node.slot)
+
+        self.stats["patterns"] = len(results)
+        return sort_patterns(results)
+
+
+def mine_spade_tpu(
+    db: SequenceDB,
+    minsup_abs: int,
+    *,
+    mesh: Optional[Mesh] = None,
+    max_pattern_itemsets: Optional[int] = None,
+    **kwargs,
+) -> List[PatternResult]:
+    """Convenience wrapper: DB -> vertical build -> TPU mine."""
+    vdb = build_vertical(db, min_item_support=minsup_abs)
+    if vdb.n_items == 0:
+        return []
+    eng = SpadeTPU(vdb, minsup_abs, mesh=mesh,
+                   max_pattern_itemsets=max_pattern_itemsets, **kwargs)
+    return eng.mine()

@@ -1,0 +1,69 @@
+"""JAX bitmap primitives — bit-exact mirrors of ops/bitops_np.py.
+
+All ops are word-wise VPU work (uint32 bitwise + popcount): the SPADE
+temporal join is memory-bandwidth-bound, so the goal is fusion (XLA fuses
+the transform/AND/any/sum chain into one pass over HBM) rather than MXU use.
+The word axis is the last (minor, lane) axis; the unrolled word loop in
+``sext_transform`` is static so everything stays inside one fused kernel.
+
+Semantics (SURVEY.md sec 2.3 step 4):
+- ``sext_transform``: per sequence, set all bits strictly after the first
+  set bit (first-occurrence postfix mask) — carry chain toward higher words;
+- ``i_extend``: AND at identical positions;
+- ``support``: #sequences with any surviving bit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+FULL = jnp.uint32(0xFFFFFFFF)
+
+
+def prefix_or_word(w: jax.Array) -> jax.Array:
+    """Within-word inclusive prefix OR (bit p = OR of bits 0..p)."""
+    for shift in (1, 2, 4, 8, 16):
+        w = w | (w << jnp.uint32(shift))
+    return w
+
+
+def sext_transform(b: jax.Array) -> jax.Array:
+    """First-occurrence postfix mask over the last (word) axis."""
+    n_words = b.shape[-1]
+    carry = jnp.zeros(b.shape[:-1], dtype=bool)
+    outs = []
+    for j in range(n_words):
+        w = b[..., j]
+        outs.append((prefix_or_word(w) << jnp.uint32(1)) | jnp.where(carry, FULL, jnp.uint32(0)))
+        carry = carry | (w != 0)
+    return jnp.stack(outs, axis=-1)
+
+
+def i_extend(prefix_bitmap: jax.Array, item_bitmap: jax.Array) -> jax.Array:
+    return prefix_bitmap & item_bitmap
+
+
+def s_extend(prefix_bitmap: jax.Array, item_bitmap: jax.Array) -> jax.Array:
+    return sext_transform(prefix_bitmap) & item_bitmap
+
+
+def join(prefix_bitmap: jax.Array, item_bitmap: jax.Array, is_s) -> jax.Array:
+    """Temporal join with per-candidate extension type.
+
+    ``is_s`` broadcasts against the leading (candidate) axes: True selects
+    s-extension, False i-extension.
+    """
+    is_s = jnp.asarray(is_s)
+    sel = is_s[(...,) + (None,) * (prefix_bitmap.ndim - is_s.ndim)]
+    return jnp.where(sel, sext_transform(prefix_bitmap), prefix_bitmap) & item_bitmap
+
+
+def contains_bits(bitmap: jax.Array) -> jax.Array:
+    """[..., n_seq, n_words] -> [..., n_seq] bool: any bit set per sequence."""
+    return jnp.any(bitmap != 0, axis=-1)
+
+
+def support(bitmap: jax.Array) -> jax.Array:
+    """[..., n_seq, n_words] -> [...] int32 sequence-count support."""
+    return jnp.sum(contains_bits(bitmap), axis=-1, dtype=jnp.int32)

@@ -1,0 +1,1 @@
+"""Device-mesh parallelism: sequence-axis sharding, psum support reduction."""

@@ -1,0 +1,39 @@
+"""Mesh construction and sharding helpers.
+
+The framework's parallel axis is the *sequence-id* axis of the vertical
+bitmap DB (SURVEY.md sec 2.2): joins are elementwise over sequences, so the
+only communication is the ``psum`` of per-shard partial supports over ICI
+before the global minsup prune.  This is the TPU-native replacement for the
+reference's Spark-partition data parallelism + driver-side aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the sequence axis.  Multi-host: pass jax.devices()."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SEQ_AXIS,))
+
+def store_spec() -> P:
+    """[slot, seq, word] bitmap store: shard the sequence axis."""
+    return P(None, SEQ_AXIS, None)
+
+
+def store_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, store_spec())
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return -(-n // k) * k

@@ -1,14 +1,25 @@
 """Test env: force CPU backend with 8 virtual devices (SURVEY.md sec 4).
 
-Must run before any ``import jax`` — pytest imports conftest first, so this
-is the one place allowed to set the env.  The same sharded code runs
-unchanged on a real TPU mesh; the driver's dryrun_multichip uses the same
-mechanism.
+This sandbox boots every interpreter with an `axon` TPU plugin registered
+via sitecustomize (PYTHONPATH=/root/.axon_site) and JAX_PLATFORMS=axon in
+the ambient env, so plain env-var defaults are NOT enough: the axon hooks
+re-route platform selection, and a second process touching the TPU tunnel
+while another holds it hangs at backend init.  The reliable override is
+``jax.config.update('jax_platforms', 'cpu')`` before the first backend
+init (XLA_FLAGS is read at backend-client creation, so setting it here is
+still early enough for the 8 virtual devices).
+
+The same sharded code runs unchanged on a real TPU mesh; the driver's
+dryrun_multichip uses the same mechanism.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

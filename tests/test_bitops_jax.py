@@ -1,0 +1,55 @@
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.ops import bitops_np as BN
+
+
+@pytest.fixture(scope="module")
+def jnp_mod():
+    import jax.numpy as jnp
+    return jnp
+
+
+def rand_bitmaps(rng, shape):
+    b = rng.integers(0, 2**32, size=shape, dtype=np.uint32)
+    b &= rng.integers(0, 2**32, size=shape, dtype=np.uint32)
+    return b
+
+
+@pytest.mark.parametrize("shape", [(1,), (3,), (5, 4, 2), (2, 7, 3)])
+def test_sext_matches_numpy(jnp_mod, shape):
+    from spark_fsm_tpu.ops import bitops_jax as BJ
+    rng = np.random.default_rng(0)
+    b = rand_bitmaps(rng, shape)
+    np.testing.assert_array_equal(np.asarray(BJ.sext_transform(jnp_mod.asarray(b))),
+                                  BN.sext_transform(b))
+
+
+def test_support_matches_numpy(jnp_mod):
+    from spark_fsm_tpu.ops import bitops_jax as BJ
+    rng = np.random.default_rng(1)
+    b = rand_bitmaps(rng, (6, 10, 3))
+    np.testing.assert_array_equal(np.asarray(BJ.support(jnp_mod.asarray(b))), BN.support(b))
+    assert np.asarray(BJ.support(jnp_mod.zeros((4, 2), jnp_mod.uint32))) == 0
+
+
+def test_join_select(jnp_mod):
+    from spark_fsm_tpu.ops import bitops_jax as BJ
+    rng = np.random.default_rng(2)
+    p = rand_bitmaps(rng, (4, 6, 2))
+    i = rand_bitmaps(rng, (4, 6, 2))
+    iss = np.array([True, False, True, False])
+    got = np.asarray(BJ.join(jnp_mod.asarray(p), jnp_mod.asarray(i), jnp_mod.asarray(iss)))
+    want = np.where(iss[:, None, None], BN.sext_transform(p), p) & i
+    np.testing.assert_array_equal(got, want)
+
+
+def test_extend_helpers(jnp_mod):
+    from spark_fsm_tpu.ops import bitops_jax as BJ
+    rng = np.random.default_rng(3)
+    p = rand_bitmaps(rng, (5, 2))
+    i = rand_bitmaps(rng, (5, 2))
+    np.testing.assert_array_equal(np.asarray(BJ.s_extend(jnp_mod.asarray(p), jnp_mod.asarray(i))),
+                                  BN.s_extend(p, i))
+    np.testing.assert_array_equal(np.asarray(BJ.i_extend(jnp_mod.asarray(p), jnp_mod.asarray(i))),
+                                  BN.i_extend(p, i))

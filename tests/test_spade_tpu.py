@@ -1,0 +1,97 @@
+"""Engine-vs-oracle parity: the north-star property (byte-identical sets)."""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.models.spade_tpu import SpadeTPU, mine_spade_tpu
+from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+from tests.test_oracle import ZAKI_DB, random_db
+
+
+def assert_parity(db, minsup, max_pattern_itemsets=None, **kw):
+    a = mine_spade(db, minsup, max_pattern_itemsets=max_pattern_itemsets)
+    b = mine_spade_tpu(db, minsup, max_pattern_itemsets=max_pattern_itemsets, **kw)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+    return b
+
+
+def test_parity_zaki():
+    assert_parity(ZAKI_DB, 2)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_parity_randomized(seed):
+    rng = np.random.default_rng(seed)
+    db = random_db(rng, n_seq=30, n_items=6, max_itemsets=5, max_set=3)
+    assert_parity(db, 3)
+
+
+def test_parity_synthetic():
+    db = synthetic_db(seed=7, n_sequences=400, n_items=40, mean_itemsets=4.0,
+                      mean_itemset_size=1.4)
+    assert_parity(db, abs_minsup(0.02, len(db)))
+
+
+def test_parity_multiword():
+    # sequences long enough to span multiple uint32 words; dense long
+    # sequences explode combinatorially, so cap pattern length and keep
+    # minsup high — the point is exercising the multi-word carry chain
+    db = synthetic_db(seed=8, n_sequences=120, n_items=12, mean_itemsets=40.0,
+                      max_itemsets=80)
+    assert_parity(db, abs_minsup(0.5, len(db)), max_pattern_itemsets=3)
+
+
+def test_parity_tiny_pool_exercises_recompute():
+    db = synthetic_db(seed=9, n_sequences=200, n_items=25, mean_itemsets=4.0,
+                      mean_itemset_size=1.3)
+    minsup = abs_minsup(0.03, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    a = mine_spade(db, minsup)
+    # 64-slot pool with small batches forces slot reclaim + recompute
+    eng = SpadeTPU(vdb, minsup, pool_bytes=1, node_batch=16, chunk=64,
+                   recompute_chunk=8)
+    assert eng.pool_slots == 64
+    b = eng.mine()
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+    assert eng.stats["recomputed_nodes"] > 0 or eng.stats["reclaimed_slots"] == 0
+
+
+def test_parity_max_itemsets_cap():
+    a = mine_spade(ZAKI_DB, 2, max_pattern_itemsets=2)
+    b = mine_spade_tpu(ZAKI_DB, 2, max_pattern_itemsets=2)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+
+
+def test_mesh_parity_8_devices():
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    mesh = make_mesh(8)
+    db = synthetic_db(seed=10, n_sequences=330, n_items=30, mean_itemsets=4.0,
+                      mean_itemset_size=1.3)  # 330 % 8 != 0 -> exercises padding
+    minsup = abs_minsup(0.03, len(db))
+    a = mine_spade(db, minsup)
+    b = assert_parity(db, minsup, mesh=mesh)
+    assert len(b) == len(a)
+
+
+def test_mesh_parity_with_recompute():
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(4)
+    db = synthetic_db(seed=11, n_sequences=160, n_items=20, mean_itemsets=4.0)
+    minsup = abs_minsup(0.05, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    eng = SpadeTPU(vdb, minsup, mesh=mesh, pool_bytes=1, node_batch=16, chunk=64)
+    got = eng.mine()
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_empty_and_trivial():
+    assert mine_spade_tpu(parse_spmf("1 -2\n2 -2\n"), 2) == []
+    res = mine_spade_tpu(parse_spmf("1 -2\n1 -2\n"), 2)
+    assert res == [(((1,),), 2)]
