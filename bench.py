@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Workload (BASELINE.md north star): SPADE on a BMS-WebView-2-shaped database
+at minsup=0.1%.  The real BMS-WebView-2 file is unreachable (zero-egress
+sandbox), so a seeded synthetic DB with the documented shape (77.5k
+sequences, 3.3k item alphabet, ~4.6 itemsets/sequence) stands in; point
+BENCH_DATASET at a real SPMF file to override.
+
+Metric: patterns/sec of the steady-state mine (second run, compiles warm).
+vs_baseline: 10s-target ratio = 10.0 / steady wall-clock (>1 beats the
+"<10s on v5e-8" north star; here a single chip).
+
+Env knobs: BENCH_SCALE (default 1.0), BENCH_MINSUP (default 0.001),
+BENCH_DATASET (SPMF file path), BENCH_PARITY=1 (also run the CPU oracle and
+check byte-identical output; adds oracle wall-clock).
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+
+
+def _tpu_reachable() -> bool:
+    """The axon TPU tunnel relay listens on 8082; if it's gone, importing
+    the axon backend hangs forever, so gate BEFORE the first backend init."""
+    try:
+        with socket.create_connection(("127.0.0.1", 8082), timeout=2.0):
+            return True
+    except OSError:
+        return False
+
+
+def main() -> None:
+    want_tpu = os.environ.get("JAX_PLATFORMS", "").lower() not in ("cpu",)
+    use_tpu = want_tpu and _tpu_reachable()
+    import jax
+    if not use_tpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    from spark_fsm_tpu.data.spmf import load_spmf
+    from spark_fsm_tpu.data.synth import bms_webview2_like
+    from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+    from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+    from spark_fsm_tpu.utils.canonical import patterns_text
+
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    rel_minsup = float(os.environ.get("BENCH_MINSUP", "0.001"))
+    dataset = os.environ.get("BENCH_DATASET")
+
+    t0 = time.time()
+    db = load_spmf(dataset) if dataset else bms_webview2_like(scale=scale)
+    minsup = abs_minsup(rel_minsup, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    build_s = time.time() - t0
+
+    platform = jax.devices()[0].platform
+    t0 = time.time()
+    eng = SpadeTPU(vdb, minsup)
+    res = eng.mine()
+    cold_s = time.time() - t0
+
+    eng.stats = {k: 0 for k in eng.stats}  # per-run stats for the steady pass
+    t0 = time.time()
+    res = eng.mine()
+    steady_s = time.time() - t0
+
+    patterns_per_sec = len(res) / steady_s if steady_s > 0 else 0.0
+    out = {
+        "metric": "patterns/sec (SPADE, BMS-WebView-2-shaped, minsup=0.1%)",
+        "value": round(patterns_per_sec, 2),
+        "unit": "patterns/sec",
+        "vs_baseline": round(10.0 / steady_s, 3) if steady_s > 0 else 0.0,
+        "patterns": len(res),
+        "wall_s": round(steady_s, 3),
+        "cold_wall_s": round(cold_s, 3),
+        "vertical_build_s": round(build_s, 3),
+        "sequences": vdb.n_sequences,
+        "frequent_items": vdb.n_items,
+        "platform": platform,
+        "candidates": eng.stats["candidates"],
+    }
+
+    if os.environ.get("BENCH_PARITY") == "1":
+        from spark_fsm_tpu.models.oracle import mine_spade
+        t0 = time.time()
+        oracle = mine_spade(db, minsup)
+        out["oracle_wall_s"] = round(time.time() - t0, 3)
+        out["parity"] = patterns_text(res) == patterns_text(oracle)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

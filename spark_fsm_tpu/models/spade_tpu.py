@@ -135,15 +135,26 @@ class SpadeTPU:
     def _build_fns(self) -> None:
         mesh = self.mesh
 
-        def supports_body(store, parent_slot, item_slot, iss):
-            j = B.join(store[parent_slot], store[item_slot], iss)
-            part = B.support(j)
+        # The s-ext transform (~6 word-ops) dominates the AND (1 op), and a
+        # node typically has tens of candidates, so gather + transform the
+        # popped batch's bitmaps ONCE per batch; candidate chunks then only
+        # gather [chunk, S, W] slices and AND them with the item id-lists.
+        def prep_body(store, node_slot):
+            parents = store[node_slot]            # [Bn, S, W]
+            return parents, B.sext_transform(parents)
+
+        def _joined(parents, trans, store, parent_ref, item_slot, iss):
+            base = jnp.where(iss[:, None, None], trans[parent_ref], parents[parent_ref])
+            return base & store[item_slot]
+
+        def supports_body(parents, trans, store, parent_ref, item_slot, iss):
+            part = B.support(_joined(parents, trans, store, parent_ref, item_slot, iss))
             if mesh is not None:
                 part = jax.lax.psum(part, SEQ_AXIS)
             return part
 
-        def materialize_body(store, parent_slot, item_slot, iss, out_slot):
-            j = B.join(store[parent_slot], store[item_slot], iss)
+        def materialize_body(parents, trans, store, parent_ref, item_slot, iss, out_slot):
+            j = _joined(parents, trans, store, parent_ref, item_slot, iss)
             return store.at[out_slot].set(j)
 
         def recompute_body(store, step_items, step_iss, step_valid, out_slot):
@@ -157,20 +168,25 @@ class SpadeTPU:
             return store.at[out_slot].set(bmp)
 
         if mesh is None:
+            self._prep_fn = jax.jit(prep_body)
             self._supports_fn = jax.jit(supports_body)
-            self._materialize_fn = jax.jit(materialize_body, donate_argnums=0)
+            self._materialize_fn = jax.jit(materialize_body, donate_argnums=2)
             self._recompute_fn = jax.jit(recompute_body, donate_argnums=0)
         else:
             st = P(None, SEQ_AXIS, None)
             rep = P()
+            self._prep_fn = jax.jit(
+                jax.shard_map(prep_body, mesh=mesh,
+                              in_specs=(st, rep), out_specs=(st, st))
+            )
             self._supports_fn = jax.jit(
                 jax.shard_map(supports_body, mesh=mesh,
-                              in_specs=(st, rep, rep, rep), out_specs=rep)
+                              in_specs=(st, st, st, rep, rep, rep), out_specs=rep)
             )
             self._materialize_fn = jax.jit(
                 jax.shard_map(materialize_body, mesh=mesh,
-                              in_specs=(st, rep, rep, rep, rep), out_specs=st),
-                donate_argnums=0,
+                              in_specs=(st, st, st, rep, rep, rep, rep), out_specs=st),
+                donate_argnums=2,
             )
             self._recompute_fn = jax.jit(
                 jax.shard_map(recompute_body, mesh=mesh,
@@ -200,36 +216,47 @@ class SpadeTPU:
 
     # ------------------------------------------------------------- kernels
 
-    def _supports(self, parent: np.ndarray, item: np.ndarray, iss: np.ndarray) -> np.ndarray:
-        """Chunked candidate support counts; inputs are 1-D int/bool arrays."""
-        n = len(parent)
-        out = np.empty(n, dtype=np.int32)
+    def _prep(self, batch: List[_Node]):
+        """Gather + s-ext-transform the popped batch's bitmaps, once."""
+        slots = np.zeros(self.node_batch, np.int32)
+        for i, n in enumerate(batch):
+            slots[i] = n.slot
+        parents, trans = self._prep_fn(self.store, jnp.asarray(slots))
+        self.stats["kernel_launches"] += 1
+        return parents, trans
+
+    def _chunks(self, *arrays: np.ndarray, pad_values=None):
+        """Yield chunk-padded jnp views of parallel 1-D arrays."""
+        n = len(arrays[0])
         c = self.chunk
+        pad_values = pad_values or [0] * len(arrays)
         for lo in range(0, n, c):
             hi = min(lo + c, n)
             pad = c - (hi - lo)
-            p = np.pad(parent[lo:hi], (0, pad)).astype(np.int32)
-            it = np.pad(item[lo:hi], (0, pad)).astype(np.int32)
-            ss = np.pad(iss[lo:hi], (0, pad)).astype(bool)
-            sup = self._supports_fn(self.store, jnp.asarray(p), jnp.asarray(it), jnp.asarray(ss))
+            yield lo, hi, tuple(
+                jnp.asarray(np.pad(a[lo:hi], (0, pad), constant_values=pv))
+                for a, pv in zip(arrays, pad_values)
+            )
+
+    def _supports(self, prep, ref: np.ndarray, item: np.ndarray, iss: np.ndarray) -> np.ndarray:
+        """Chunked candidate support counts (ref indexes into the batch)."""
+        parents, trans = prep
+        out = np.empty(len(ref), dtype=np.int32)
+        for lo, hi, (r, it, ss) in self._chunks(
+                ref.astype(np.int32), item.astype(np.int32), iss.astype(bool)):
+            sup = self._supports_fn(parents, trans, self.store, r, it, ss)
             out[lo:hi] = np.asarray(sup)[: hi - lo]
             self.stats["kernel_launches"] += 1
-        self.stats["candidates"] += n
+        self.stats["candidates"] += len(ref)
         return out
 
-    def _materialize(self, parent, item, iss, out_slot) -> None:
-        n = len(parent)
-        c = self.chunk
-        for lo in range(0, n, c):
-            hi = min(lo + c, n)
-            pad = c - (hi - lo)
-            p = np.pad(parent[lo:hi], (0, pad)).astype(np.int32)
-            it = np.pad(item[lo:hi], (0, pad)).astype(np.int32)
-            ss = np.pad(iss[lo:hi], (0, pad)).astype(bool)
-            os = np.pad(out_slot[lo:hi], (0, pad), constant_values=self.scratch).astype(np.int32)
-            self.store = self._materialize_fn(
-                self.store, jnp.asarray(p), jnp.asarray(it), jnp.asarray(ss), jnp.asarray(os)
-            )
+    def _materialize(self, prep, ref, item, iss, out_slot) -> None:
+        parents, trans = prep
+        for _, _, (r, it, ss, os) in self._chunks(
+                ref.astype(np.int32), item.astype(np.int32), iss.astype(bool),
+                out_slot.astype(np.int32),
+                pad_values=[0, 0, False, self.scratch]):
+            self.store = self._materialize_fn(parents, trans, self.store, r, it, ss, os)
             self.stats["kernel_launches"] += 1
 
     def _ensure_slots(self, batch: List[_Node], stack: List[_Node]) -> None:
@@ -287,35 +314,36 @@ class SpadeTPU:
         while stack:
             batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
             self._ensure_slots(batch, stack)
+            prep = self._prep(batch)
 
-            # Flat candidate list for the whole batch.
-            cand_parent: List[int] = []
+            # Flat candidate list for the whole batch (ref = index in batch).
+            cand_ref: List[int] = []
             cand_item: List[int] = []
             cand_iss: List[bool] = []
             spans: List[Tuple[int, int, int]] = []  # (s_lo, s_hi == i_lo, i_hi)
-            for node in batch:
+            for b_idx, node in enumerate(batch):
                 n_itemsets = sum(1 for _, s in node.steps if s)
                 allow_s = (self.max_pattern_itemsets is None
                            or n_itemsets < self.max_pattern_itemsets)
-                s_lo = len(cand_parent)
+                s_lo = len(cand_ref)
                 if allow_s:
                     for i in node.s_list:
-                        cand_parent.append(node.slot); cand_item.append(i); cand_iss.append(True)
-                s_hi = len(cand_parent)
+                        cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(True)
+                s_hi = len(cand_ref)
                 for i in node.i_list:
-                    cand_parent.append(node.slot); cand_item.append(i); cand_iss.append(False)
-                spans.append((s_lo, s_hi, len(cand_parent)))
+                    cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(False)
+                spans.append((s_lo, s_hi, len(cand_ref)))
 
-            sups = (self._supports(np.array(cand_parent, np.int32),
+            sups = (self._supports(prep, np.array(cand_ref, np.int32),
                                    np.array(cand_item, np.int32),
                                    np.array(cand_iss, bool))
-                    if cand_parent else np.empty(0, np.int32))
+                    if cand_ref else np.empty(0, np.int32))
 
             # Prune, create children, collect materialization work.
             children: List[_Node] = []
-            mat_parent: List[int] = []; mat_item: List[int] = []
+            mat_ref: List[int] = []; mat_item: List[int] = []
             mat_iss: List[bool] = []; mat_child: List[int] = []
-            for node, (s_lo, s_hi, i_hi) in zip(batch, spans):
+            for b_idx, (node, (s_lo, s_hi, i_hi)) in enumerate(zip(batch, spans)):
                 s_items = [cand_item[k] for k in range(s_lo, s_hi) if sups[k] >= minsup]
                 i_items = [cand_item[k] for k in range(s_hi, i_hi) if sups[k] >= minsup]
                 for k in range(s_lo, i_hi):
@@ -326,17 +354,21 @@ class SpadeTPU:
                     results.append((self._pattern_of(steps), int(sups[k])))
                     src = s_items if is_s else i_items
                     child_i = [j for j in src if j > it]
-                    if not s_items and not child_i:
+                    child_itemsets = n_itemsets + (1 if is_s else 0)
+                    child_allow_s = (self.max_pattern_itemsets is None
+                                     or child_itemsets < self.max_pattern_itemsets)
+                    if not ((s_items and child_allow_s) or child_i):
                         continue  # leaf: no possible extensions
                     child = _Node(steps, None, s_items, child_i)
                     slot = self._alloc()
                     if slot is not None:
                         child.slot = slot
-                        mat_parent.append(node.slot); mat_item.append(it)
+                        mat_ref.append(b_idx); mat_item.append(it)
                         mat_iss.append(is_s); mat_child.append(slot)
                     children.append(child)
             if mat_child:
-                self._materialize(np.array(mat_parent, np.int32), np.array(mat_item, np.int32),
+                self._materialize(prep, np.array(mat_ref, np.int32),
+                                  np.array(mat_item, np.int32),
                                   np.array(mat_iss, bool), np.array(mat_child, np.int32))
             stack.extend(reversed(children))
             for node in batch:
