@@ -1,0 +1,330 @@
+"""TSR — top-k sequential rules (TopSeqRules), CPU oracle + TPU engine.
+
+Semantics (SURVEY.md sec 2.4): a rule X ==> Y (X, Y disjoint unordered
+itemsets) occurs in a sequence iff every item of X occurs strictly before
+every item of Y, i.e. max_x first(x) < min_y last(y).  sup(X=>Y) counts such
+sequences; conf = sup(X=>Y) / sup(X).  The miner returns the top-k rules by
+support among those with conf >= minconf — tie-inclusive (see
+utils/canonical.py), with a dynamically rising internal minsup.
+
+Bitmap formulation (the north star's "TSR reuses the same join/support
+kernels"): with A = AND over x in X of prefix_or_incl(id-list(x)) ("all of X
+occurred by p") and C = AND over y in Y of suffix_or_incl(id-list(y)) ("all
+of Y occur at >= p"), the rule holds in a sequence iff
+(shift_up_one(A) & C) != 0, and sup(X) = #sequences with A != 0.  Both
+reduce to the engine's AND + per-sequence-any + popcount primitives, so the
+TPU path is the same fused VPU chain as SPADE's temporal join, batched over
+candidate rules and psum-reduced over the sharded sequence axis.
+
+Search: best-first branch-and-bound over expansions (left = grow X, right =
+grow Y, both adding item ids greater than the side's max, right-expanded
+rules may still left-expand but not vice versa — the standard duplicate-free
+expansion scheme), batch-evaluating candidates on device.  Large alphabets
+are handled by iterative deepening over the top-M items by support: a run
+restricted to M items is provably complete once sup(item_{M+1}) < s_k.
+"""
+
+from __future__ import annotations
+
+import bisect
+import heapq
+import itertools
+from fractions import Fraction
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
+from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
+from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
+
+
+def conf_ok(sup: int, supx: int, minconf: float) -> bool:
+    """Exact confidence test: sup/supx >= minconf (no float division)."""
+    return supx > 0 and Fraction(sup, supx) >= Fraction(str(minconf))
+
+
+# ---------------------------------------------------------------------------
+# Brute-force oracle (independent ground truth for tiny DBs)
+# ---------------------------------------------------------------------------
+
+def rule_counts_direct(db: SequenceDB, x_items: Tuple[int, ...],
+                       y_items: Tuple[int, ...]) -> Tuple[int, int]:
+    """(sup(X=>Y), sup(X)) by direct first/last-occurrence scanning."""
+    sup = supx = 0
+    for seq in db:
+        first: dict[int, int] = {}
+        last: dict[int, int] = {}
+        for p, itemset in enumerate(seq):
+            for it in itemset:
+                first.setdefault(it, p)
+                last[it] = p
+        if all(x in first for x in x_items):
+            supx += 1
+            if all(y in last for y in y_items):
+                if max(first[x] for x in x_items) < min(last[y] for y in y_items):
+                    sup += 1
+    return sup, supx
+
+
+def brute_force_rules(db: SequenceDB, k: int, minconf: float,
+                      max_side: int = 2) -> List[RuleResult]:
+    """Enumerate every X, Y (sizes <= max_side, disjoint) directly."""
+    items = sorted({i for seq in db for itemset in seq for i in itemset})
+    qualifying: List[RuleResult] = []
+    for nx in range(1, max_side + 1):
+        for x in itertools.combinations(items, nx):
+            rest = [i for i in items if i not in x]
+            for ny in range(1, max_side + 1):
+                for y in itertools.combinations(rest, ny):
+                    sup, supx = rule_counts_direct(db, x, y)
+                    if sup >= 1 and conf_ok(sup, supx, minconf):
+                        qualifying.append((x, y, sup, supx))
+    if not qualifying:
+        return []
+    sups = sorted((r[2] for r in qualifying), reverse=True)
+    s_k = sups[k - 1] if len(sups) >= k else sups[-1]
+    return sort_rules([r for r in qualifying if r[2] >= s_k])
+
+
+# ---------------------------------------------------------------------------
+# TPU engine
+# ---------------------------------------------------------------------------
+
+class TsrTPU:
+    """Batched best-first TopSeqRules over the vertical bitmap DB.
+
+    Args:
+      vdb: vertical DB (min_item_support=1 — TSR's internal minsup starts
+        at 1 and rises as the top-k heap fills).
+      k / minconf: the reference's request params (SURVEY.md sec 2.4).
+      item_cap: initial restriction to the top-M items by support for the
+        iterative-deepening outer loop.
+      max_side: optional cap on |X| and |Y|.
+    """
+
+    def __init__(
+        self,
+        vdb: VerticalDB,
+        k: int,
+        minconf: float,
+        *,
+        mesh: Optional[Mesh] = None,
+        chunk: int = 256,
+        item_cap: int = 256,
+        max_side: Optional[int] = None,
+    ):
+        self.vdb = vdb
+        self.k = int(k)
+        self.minconf = float(minconf)
+        self.mesh = mesh
+        self.chunk = int(chunk)
+        self.item_cap = int(item_cap)
+        self.max_side = max_side
+        self.stats = {"evaluated": 0, "kernel_launches": 0, "deepening_rounds": 0}
+        self._eval_fns: dict = {}
+
+        bitmaps = vdb.bitmaps
+        n_items, n_seq, n_words = bitmaps.shape
+        if mesh is not None:
+            n_dev = mesh.devices.size
+            padded = pad_to_multiple(n_seq, n_dev)
+            if padded != n_seq:
+                bitmaps = np.concatenate(
+                    [bitmaps, np.zeros((n_items, padded - n_seq, n_words), np.uint32)],
+                    axis=1,
+                )
+        self._bitmaps = bitmaps
+        # items sorted by support desc, stable by item id
+        order = np.lexsort((vdb.item_ids, -vdb.item_supports))
+        self._order = order
+        self._sup_sorted = vdb.item_supports[order]
+
+    # ------------------------------------------------------------- kernels
+
+    def _prep(self, m: int):
+        """prefix/suffix-OR id-lists for the top-m items (one jit call)."""
+        sel = self._order[:m]
+        raw = jnp.asarray(self._bitmaps[sel])
+        if self.mesh is not None:
+            raw = jax.device_put(raw, store_sharding(self.mesh))
+
+        def body(b):
+            return B.prefix_or_incl(b), B.suffix_or_incl(b)
+
+        if self.mesh is None:
+            fn = jax.jit(body)
+        else:
+            st = P(None, SEQ_AXIS, None)
+            fn = jax.jit(jax.shard_map(body, mesh=self.mesh,
+                                       in_specs=(st,), out_specs=(st, st)))
+        p1, s1 = fn(raw)
+        self.stats["kernel_launches"] += 1
+        return p1, s1
+
+    def _eval_fn(self, kmax: int):
+        """Jitted evaluator for side sizes <= kmax (bucketed compile)."""
+        if kmax in self._eval_fns:
+            return self._eval_fns[kmax]
+        mesh = self.mesh
+        FULL = jnp.uint32(0xFFFFFFFF)
+
+        def fold(t, idx, valid):
+            acc = None
+            for j in range(kmax):
+                g = jnp.where(valid[:, j, None, None], t[idx[:, j]], FULL)
+                acc = g if acc is None else acc & g
+            return acc
+
+        def body(p1, s1, xs, xv, ys, yv):
+            a = fold(p1, xs, xv)
+            c = fold(s1, ys, yv)
+            sup = B.support(B.shift_up_one(a) & c)
+            supx = B.support(a)
+            if mesh is not None:
+                sup = jax.lax.psum(sup, SEQ_AXIS)
+                supx = jax.lax.psum(supx, SEQ_AXIS)
+            return sup, supx
+
+        if mesh is None:
+            fn = jax.jit(body)
+        else:
+            st = P(None, SEQ_AXIS, None)
+            rep = P()
+            fn = jax.jit(jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(st, st, rep, rep, rep, rep), out_specs=(rep, rep)))
+        self._eval_fns[kmax] = fn
+        return fn
+
+    def _evaluate(self, p1, s1, cands: List[Tuple[Tuple[int, ...], Tuple[int, ...]]]):
+        """Batch-evaluate (sup, supx) for candidate rules (local item idx)."""
+        n = len(cands)
+        kmax = 1
+        for x, y in cands:
+            kmax = max(kmax, len(x), len(y))
+        km = 1
+        while km < kmax:
+            km *= 2
+        fn = self._eval_fn(km)
+        sup_out = np.empty(n, np.int64)
+        supx_out = np.empty(n, np.int64)
+        c = self.chunk
+        for lo in range(0, n, c):
+            hi = min(lo + c, n)
+            xs = np.zeros((c, km), np.int32); xv = np.zeros((c, km), bool)
+            ys = np.zeros((c, km), np.int32); yv = np.zeros((c, km), bool)
+            for r, (x, y) in enumerate(cands[lo:hi]):
+                xs[r, :len(x)] = x; xv[r, :len(x)] = True
+                ys[r, :len(y)] = y; yv[r, :len(y)] = True
+            sup, supx = fn(p1, s1, jnp.asarray(xs), jnp.asarray(xv),
+                           jnp.asarray(ys), jnp.asarray(yv))
+            sup_out[lo:hi] = np.asarray(sup)[: hi - lo]
+            supx_out[lo:hi] = np.asarray(supx)[: hi - lo]
+            self.stats["kernel_launches"] += 1
+        self.stats["evaluated"] += n
+        return sup_out, supx_out
+
+    # ---------------------------------------------------------------- mine
+
+    def _mine_restricted(self, m: int) -> Tuple[List[RuleResult], int]:
+        """Full search over the top-m items; returns (results, s_k)."""
+        sup_it = self._sup_sorted[:m].astype(np.int64)
+        p1, s1 = self._prep(m)
+        ids = self.vdb.item_ids[self._order[:m]]
+
+        results: List[Tuple[int, int, Tuple[int, ...], Tuple[int, ...]]] = []
+        minsup = 1
+        sup_sorted: List[int] = []  # ascending supports of accepted rules
+
+        def s_k_threshold() -> int:
+            if len(sup_sorted) < self.k:
+                return 1
+            return sup_sorted[-self.k]
+
+        # queue: (-bound, seq#, X, Y, can_right); X/Y are local index tuples
+        counter = itertools.count()
+        queue: List[Tuple[int, int, Tuple[int, ...], Tuple[int, ...], bool]] = []
+        for i in range(m):
+            for j in range(m):
+                if i != j:
+                    bound = int(min(sup_it[i], sup_it[j]))
+                    heapq.heappush(queue, (-bound, next(counter), (i,), (j,), True))
+
+        while queue:
+            batch = []
+            while queue and len(batch) < self.chunk:
+                nb, _, x, y, cr = queue[0]
+                if -nb < minsup:
+                    queue.clear()
+                    break
+                heapq.heappop(queue)
+                batch.append((x, y, cr))
+            if not batch:
+                break
+            sups, supxs = self._evaluate(p1, s1, [(x, y) for x, y, _ in batch])
+            for (x, y, can_right), sup, supx in zip(batch, sups, supxs):
+                sup, supx = int(sup), int(supx)
+                if sup < minsup:
+                    continue
+                if conf_ok(sup, supx, self.minconf):
+                    results.append((sup, supx, x, y))
+                    bisect.insort(sup_sorted, sup)
+                    new_t = s_k_threshold()
+                    if new_t > minsup:
+                        minsup = new_t
+                        results = [r for r in results if r[0] >= minsup]
+                        del sup_sorted[: bisect.bisect_left(sup_sorted, minsup)]
+                # expansions (bound = min(sup, sup of added item))
+                used = set(x) | set(y)
+                if self.max_side is None or len(x) < self.max_side:
+                    for c in range(max(x) + 1, m):
+                        if c in used or sup_it[c] < minsup:
+                            continue
+                        bound = int(min(sup, sup_it[c]))
+                        if bound >= minsup:
+                            heapq.heappush(queue, (-bound, next(counter),
+                                                   x + (c,), y, False))
+                if can_right and (self.max_side is None or len(y) < self.max_side):
+                    for c in range(max(y) + 1, m):
+                        if c in used or sup_it[c] < minsup:
+                            continue
+                        bound = int(min(sup, sup_it[c]))
+                        if bound >= minsup:
+                            heapq.heappush(queue, (-bound, next(counter),
+                                                   x, y + (c,), True))
+
+        s_k = s_k_threshold()
+        # local indices are support-ordered; canonical form sorts by item id
+        out = [
+            (tuple(sorted(int(ids[i]) for i in x)),
+             tuple(sorted(int(ids[i]) for i in y)), sup, supx)
+            for sup, supx, x, y in results
+        ]
+        return sort_rules(out), s_k
+
+    def mine(self) -> List[RuleResult]:
+        n_total = self.vdb.n_items
+        m = max(1, min(self.item_cap, n_total))
+        while True:
+            self.stats["deepening_rounds"] += 1
+            results, s_k = self._mine_restricted(m)
+            if m >= n_total:
+                return results
+            next_item_sup = int(self._sup_sorted[m])
+            if len(results) >= self.k and next_item_sup < s_k:
+                return results
+            m = min(m * 2, n_total)
+
+
+def mine_tsr_tpu(db: SequenceDB, k: int, minconf: float, *,
+                 mesh: Optional[Mesh] = None, **kwargs) -> List[RuleResult]:
+    vdb = build_vertical(db, min_item_support=1)
+    if vdb.n_items == 0:
+        return []
+    return TsrTPU(vdb, k, minconf, mesh=mesh, **kwargs).mine()

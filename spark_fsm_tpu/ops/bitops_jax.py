@@ -40,6 +40,48 @@ def sext_transform(b: jax.Array) -> jax.Array:
     return jnp.stack(outs, axis=-1)
 
 
+def prefix_or_incl(b: jax.Array) -> jax.Array:
+    """Inclusive prefix OR (bit p = any bit q <= p) — TSR 'X occurred by p'."""
+    n_words = b.shape[-1]
+    carry = jnp.zeros(b.shape[:-1], dtype=bool)
+    outs = []
+    for j in range(n_words):
+        w = b[..., j]
+        outs.append(prefix_or_word(w) | jnp.where(carry, FULL, jnp.uint32(0)))
+        carry = carry | (w != 0)
+    return jnp.stack(outs, axis=-1)
+
+
+def suffix_or_word(w: jax.Array) -> jax.Array:
+    for shift in (1, 2, 4, 8, 16):
+        w = w | (w >> jnp.uint32(shift))
+    return w
+
+
+def suffix_or_incl(b: jax.Array) -> jax.Array:
+    """Inclusive suffix OR (bit p = any bit q >= p) — TSR 'Y occurs at >= p'."""
+    n_words = b.shape[-1]
+    carry = jnp.zeros(b.shape[:-1], dtype=bool)
+    outs = []
+    for j in range(n_words - 1, -1, -1):
+        w = b[..., j]
+        outs.append(suffix_or_word(w) | jnp.where(carry, FULL, jnp.uint32(0)))
+        carry = carry | (w != 0)
+    return jnp.stack(outs[::-1], axis=-1)
+
+
+def shift_up_one(b: jax.Array) -> jax.Array:
+    """Multiword shift toward higher positions by 1 (cross-word carries)."""
+    n_words = b.shape[-1]
+    carry = jnp.zeros(b.shape[:-1], dtype=jnp.uint32)
+    outs = []
+    for j in range(n_words):
+        w = b[..., j]
+        outs.append((w << jnp.uint32(1)) | carry)
+        carry = w >> jnp.uint32(31)
+    return jnp.stack(outs, axis=-1)
+
+
 def i_extend(prefix_bitmap: jax.Array, item_bitmap: jax.Array) -> jax.Array:
     return prefix_bitmap & item_bitmap
 

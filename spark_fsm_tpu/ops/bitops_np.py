@@ -65,6 +65,61 @@ def support(bitmap: np.ndarray) -> np.ndarray:
     return np.count_nonzero((np.asarray(bitmap) != 0).any(axis=-1), axis=-1)
 
 
+def prefix_or_incl(b: np.ndarray) -> np.ndarray:
+    """Inclusive prefix OR: out bit p = 1 iff some bit q <= p set.
+
+    TSR building block: prefix_or_incl(id-list(x)) bit p says "x has
+    occurred by position p"; AND over x in X gives "all of X occurred by p"
+    (SURVEY.md sec 2.4 occurrence maps, bitmap formulation).
+    """
+    b = np.asarray(b, dtype=U32)
+    out = np.empty_like(b)
+    carry = np.zeros(b.shape[:-1], dtype=bool)
+    for j in range(b.shape[-1]):
+        w = b[..., j]
+        out[..., j] = prefix_or_word(w) | np.where(carry, FULL, U32(0))
+        carry |= w != 0
+    return out
+
+
+def suffix_or_word(w: np.ndarray) -> np.ndarray:
+    """Within-word inclusive suffix OR: out bit p = OR of bits p..31 of w."""
+    w = w.astype(U32, copy=True)
+    for shift in (1, 2, 4, 8, 16):
+        w |= w >> U32(shift)
+    return w
+
+
+def suffix_or_incl(b: np.ndarray) -> np.ndarray:
+    """Inclusive suffix OR: out bit p = 1 iff some bit q >= p set.
+
+    suffix_or_incl(id-list(y)) bit p says "y occurs at or after p"; AND over
+    y in Y gives "all of Y occur at or after p".
+    """
+    b = np.asarray(b, dtype=U32)
+    out = np.empty_like(b)
+    carry = np.zeros(b.shape[:-1], dtype=bool)
+    for j in range(b.shape[-1] - 1, -1, -1):
+        w = b[..., j]
+        out[..., j] = suffix_or_word(w) | np.where(carry, FULL, U32(0))
+        carry |= w != 0
+    return out
+
+
+def shift_up_one(b: np.ndarray) -> np.ndarray:
+    """Shift the whole per-sequence bitvector one position higher (bit p ->
+    bit p+1), with carries across words.  (A << 1) & C != 0 is the TSR rule
+    containment test: exists p with all-X-by-(p-1) and all-Y-at->=p."""
+    b = np.asarray(b, dtype=U32)
+    out = np.empty_like(b)
+    carry = np.zeros(b.shape[:-1], dtype=U32)
+    for j in range(b.shape[-1]):
+        w = b[..., j]
+        out[..., j] = ((w << U32(1)) & FULL) | carry
+        carry = w >> U32(31)
+    return out
+
+
 def first_set_positions(b: np.ndarray) -> np.ndarray:
     """Per-sequence index of the first set bit, or n_words*32 if none.
 

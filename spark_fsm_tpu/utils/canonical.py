@@ -41,6 +41,43 @@ def patterns_digest(results: Iterable[PatternResult]) -> str:
     return hashlib.sha256(patterns_text(results).encode()).hexdigest()
 
 
+# ---------------------------------------------------------------------------
+# Rules (TSR).  A rule is X ==> Y with X, Y disjoint unordered itemsets;
+# confidence is kept exact as the integer pair (sup, sup_x) so the canonical
+# text is float-free (byte-identical across platforms).  Top-k is defined
+# tie-inclusively: every rule with conf >= minconf and sup >= s_k (the k-th
+# highest qualifying support) is returned — deterministic, unlike SPMF's
+# insertion-order tie-breaking.
+# ---------------------------------------------------------------------------
+
+RuleResult = Tuple[Tuple[int, ...], Tuple[int, ...], int, int]  # X, Y, sup, sup_x
+
+
+def sort_rules(rules: Iterable[RuleResult]) -> List[RuleResult]:
+    # conf descending compared exactly: s1/x1 > s2/x2  <=>  s1*x2 > s2*x1
+    import functools
+
+    def cmp(a: RuleResult, b: RuleResult) -> int:
+        if a[2] != b[2]:
+            return -1 if a[2] > b[2] else 1
+        lhs, rhs = a[2] * b[3], b[2] * a[3]
+        if lhs != rhs:
+            return -1 if lhs > rhs else 1
+        return -1 if (a[0], a[1]) < (b[0], b[1]) else (1 if (a[0], a[1]) > (b[0], b[1]) else 0)
+
+    return sorted(rules, key=functools.cmp_to_key(cmp))
+
+
+def rule_line(rule: RuleResult) -> str:
+    x, y, sup, supx = rule
+    return (f"{' '.join(map(str, x))} ==> {' '.join(map(str, y))} "
+            f"#SUP: {sup} #CONF: {sup}/{supx}")
+
+
+def rules_text(rules: Iterable[RuleResult]) -> str:
+    return "\n".join(rule_line(r) for r in sort_rules(rules)) + "\n"
+
+
 def diff_patterns(a: Iterable[PatternResult], b: Iterable[PatternResult], limit: int = 10) -> str:
     """Human-readable diff for parity failures (missing / extra / support mismatches)."""
     da: Dict[Pattern, int] = dict(a)

@@ -53,3 +53,13 @@ def test_extend_helpers(jnp_mod):
                                   BN.s_extend(p, i))
     np.testing.assert_array_equal(np.asarray(BJ.i_extend(jnp_mod.asarray(p), jnp_mod.asarray(i))),
                                   BN.i_extend(p, i))
+
+
+def test_tsr_primitives_match_numpy(jnp_mod):
+    from spark_fsm_tpu.ops import bitops_jax as BJ
+    rng = np.random.default_rng(6)
+    b = rand_bitmaps(rng, (4, 5, 3))
+    for np_fn, jx_fn in [(BN.prefix_or_incl, BJ.prefix_or_incl),
+                         (BN.suffix_or_incl, BJ.suffix_or_incl),
+                         (BN.shift_up_one, BJ.shift_up_one)]:
+        np.testing.assert_array_equal(np.asarray(jx_fn(jnp_mod.asarray(b))), np_fn(b))

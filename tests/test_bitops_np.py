@@ -83,3 +83,42 @@ def test_extensions_and_support():
 def test_first_set_positions():
     b = np.stack([bits(0, n_words=2), bits(37, 40, n_words=2), bits(n_words=2)])
     assert B.first_set_positions(b).tolist() == [0, 37, 64]
+
+
+def naive_prefix_or(b):
+    n = b.shape[-1] * 32
+    get = lambda p: (b[p // 32] >> (p % 32)) & 1
+    out = np.zeros_like(b)
+    for p in range(n):
+        if any(get(q) for q in range(p + 1)):
+            out[p // 32] |= np.uint32(1 << (p % 32))
+    return out
+
+
+def naive_suffix_or(b):
+    n = b.shape[-1] * 32
+    get = lambda p: (b[p // 32] >> (p % 32)) & 1
+    out = np.zeros_like(b)
+    for p in range(n):
+        if any(get(q) for q in range(p, n)):
+            out[p // 32] |= np.uint32(1 << (p % 32))
+    return out
+
+
+def test_prefix_suffix_or_random_vs_naive():
+    rng = np.random.default_rng(5)
+    for _ in range(30):
+        b = rng.integers(0, 2**32, size=3, dtype=np.uint32)
+        b &= rng.integers(0, 2**32, size=3, dtype=np.uint32)
+        b &= rng.integers(0, 2**32, size=3, dtype=np.uint32)
+        np.testing.assert_array_equal(B.prefix_or_incl(b), naive_prefix_or(b))
+        np.testing.assert_array_equal(B.suffix_or_incl(b), naive_suffix_or(b))
+
+
+def test_shift_up_one():
+    b = bits(0, 31, 40, n_words=2)
+    got = B.shift_up_one(b)
+    assert got.tolist() == bits(1, 32, 41, n_words=2).tolist()
+    # top bit falls off the end
+    top = bits(63, n_words=2)
+    assert B.shift_up_one(top).tolist() == [0, 0]

@@ -1,0 +1,91 @@
+"""TSR: oracle-vs-engine parity and rule-semantics unit tests."""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.models.tsr import (
+    TsrTPU, brute_force_rules, conf_ok, mine_tsr_tpu, rule_counts_direct)
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.utils.canonical import rules_text
+from tests.test_oracle import ZAKI_DB, random_db
+
+
+def test_rule_counts_direct():
+    db = parse_spmf("1 -1 2 -1 3 -2\n2 -1 1 -1 3 -2\n1 3 -2\n")
+    # X={1}, Y={3}: seq0 first(1)=0 < last(3)=2 ok; seq1 first(1)=1 < 2 ok;
+    # seq2 first(1)=0 = last(3)=0 -> not strictly before
+    assert rule_counts_direct(db, (1,), (3,)) == (2, 3)
+    # X={1,2} -> Y={3}: seq0 max(first)=1 < 2 ok; seq1 max(first)=1 < 2 ok
+    assert rule_counts_direct(db, (1, 2), (3,)) == (2, 2)
+    # same-itemset co-occurrence is NOT before
+    assert rule_counts_direct(db, (1,), (1,))[0] == 0  # degenerate but defined
+
+
+def test_conf_ok_exact():
+    assert conf_ok(1, 2, 0.5)
+    assert not conf_ok(49, 100, 0.5)
+    assert conf_ok(2, 3, 0.5)
+    assert not conf_ok(0, 0, 0.5)
+
+
+def assert_rule_parity(db, k, minconf, max_side=2, **kw):
+    want = brute_force_rules(db, k, minconf, max_side=max_side)
+    got = mine_tsr_tpu(db, k, minconf, max_side=max_side, **kw)
+    assert rules_text(got) == rules_text(want), (
+        f"\n--- got ---\n{rules_text(got)}\n--- want ---\n{rules_text(want)}")
+    return got
+
+
+def test_parity_zaki():
+    assert_rule_parity(ZAKI_DB, k=5, minconf=0.5)
+
+
+def test_parity_zaki_high_conf():
+    assert_rule_parity(ZAKI_DB, k=3, minconf=0.9)
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("k,minconf", [(5, 0.5), (10, 0.3)])
+def test_parity_randomized(seed, k, minconf):
+    rng = np.random.default_rng(100 + seed)
+    db = random_db(rng, n_seq=25, n_items=6, max_itemsets=5, max_set=2)
+    assert_rule_parity(db, k, minconf)
+
+
+def test_parity_side3():
+    rng = np.random.default_rng(7)
+    db = random_db(rng, n_seq=20, n_items=5, max_itemsets=6, max_set=2)
+    assert_rule_parity(db, k=8, minconf=0.4, max_side=3)
+
+
+def test_iterative_deepening():
+    # force tiny item_cap so the deepening loop must widen
+    db = synthetic_db(seed=21, n_sequences=300, n_items=30, mean_itemsets=5.0)
+    want = mine_tsr_tpu(db, 10, 0.5, max_side=2, item_cap=64)
+    eng_db = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(eng_db, 10, 0.5, max_side=2, item_cap=2)
+    got = eng.mine()
+    assert eng.stats["deepening_rounds"] > 1
+    assert rules_text(got) == rules_text(want)
+
+
+def test_mesh_parity():
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(9)
+    db = random_db(rng, n_seq=27, n_items=6, max_itemsets=5, max_set=2)
+    assert_rule_parity(db, 6, 0.5, mesh=mesh)
+
+
+def test_tie_inclusive_topk():
+    # two rules with identical support at the k-th slot must BOTH appear
+    db = parse_spmf("1 -1 2 -2\n1 -1 3 -2\n1 -1 2 -2\n1 -1 3 -2\n")
+    got = mine_tsr_tpu(db, 1, 0.0)
+    sups = [r[2] for r in got]
+    assert sups.count(max(sups)) >= 2
+
+
+def test_empty():
+    assert mine_tsr_tpu(parse_spmf("1 -2\n"), 5, 0.5) == []
