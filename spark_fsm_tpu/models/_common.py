@@ -1,0 +1,48 @@
+"""Shared host-side machinery for the batched-DFS device engines.
+
+Both SPADE engines (bitmap and constrained max-start) drive the same
+pattern: a device-resident state pool addressed by slot, a host DFS stack,
+recompute-on-miss, and reclaim-from-stack-bottom when the pool runs dry.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+
+def next_pow2(n: int) -> int:
+    k = 1
+    while k < n:
+        k *= 2
+    return k
+
+
+class SlotPool:
+    """Free-list allocator over pool slot ids with stack reclaim.
+
+    ``reclaim`` walks nodes bottom-of-stack-first (processed last, cheapest
+    to recompute later), dropping their slots until ``need`` are free; the
+    caller supplies which nodes are reclaimable (e.g. non-root).
+    """
+
+    def __init__(self, slots: range):
+        self._free: List[int] = list(reversed(slots))
+        self.reclaimed = 0
+
+    def __len__(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def free(self, slot: int) -> None:
+        self._free.append(slot)
+
+    def reclaim(self, stack, need: int, reclaimable: Callable) -> None:
+        for node in stack:
+            if len(self._free) >= need:
+                return
+            if node.slot is not None and reclaimable(node):
+                self._free.append(node.slot)
+                node.slot = None
+                self.reclaimed += 1

@@ -1,0 +1,374 @@
+"""Constrained SPADE (maxgap / maxwindow) on TPU — max-start state engine.
+
+Same batched-DFS architecture as models/spade_tpu.py (slot pool in HBM,
+chunked fused kernels, recompute-on-miss, sequence-axis shard_map + psum),
+but the per-pattern device state is the max-start array of
+ops/maxstart_jax.py instead of an end-position bitmap, because gap/window
+checks need occurrence-start information (SURVEY.md sec 2.3 step 6).
+
+Enumeration differences vs the unconstrained engine (see models/oracle.py
+mine_cspade, the parity oracle):
+- under maxgap, s-extension candidates are ALL frequent root items —
+  sibling S-list pruning is unsound there (a valid occurrence of P.y.z
+  does not contain a gap-valid occurrence of P.z), the cSPADE F2-join
+  observation; with no gap bound the usual sibling prune applies;
+- i-extension sibling pruning stays valid (same positions);
+- pruning on the windowed support is exact: it is anti-monotone under
+  prefix growth (a valid child occurrence contains a valid same-start
+  prefix occurrence).
+
+State dtype is int8 when positions fit (<=127), else int16 — constrained
+state is positions-wide (not bit-packed), so this halves HBM traffic on
+typical clickstream data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
+from spark_fsm_tpu.models._common import SlotPool, next_pow2
+from spark_fsm_tpu.ops import maxstart_jax as MS
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
+from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
+
+Step = Tuple[int, bool]
+
+
+@dataclasses.dataclass
+class _Node:
+    steps: Tuple[Step, ...]
+    slot: Optional[int]
+    s_list: List[int]  # s-candidates: siblings when maxgap is None, else all roots
+    i_list: List[int]
+
+
+class ConstrainedSpadeTPU:
+    def __init__(
+        self,
+        vdb: VerticalDB,
+        minsup_abs: int,
+        *,
+        maxgap: Optional[int] = None,
+        maxwindow: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        chunk: int = 64,
+        node_batch: int = 32,
+        recompute_chunk: int = 32,
+        pool_bytes: int = 2 << 30,
+        max_pattern_itemsets: Optional[int] = None,
+    ):
+        self.vdb = vdb
+        self.minsup = int(minsup_abs)
+        self.maxgap = maxgap
+        self.maxwindow = maxwindow
+        self.mesh = mesh
+        self.chunk = int(chunk)
+        self.recompute_chunk = int(recompute_chunk)
+        self.max_pattern_itemsets = max_pattern_itemsets
+
+        bitmaps = vdb.bitmaps
+        n_items, n_seq, n_words = bitmaps.shape
+        if mesh is not None:
+            n_dev = mesh.devices.size
+            padded = pad_to_multiple(n_seq, n_dev)
+            if padded != n_seq:
+                bitmaps = np.concatenate(
+                    [bitmaps, np.zeros((n_items, padded - n_seq, n_words), np.uint32)],
+                    axis=1,
+                )
+                n_seq = padded
+        self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
+        self.n_pos = n_words * 32
+        self.dtype = jnp.int8 if self.n_pos <= 127 else jnp.int16
+
+        slot_bytes = n_seq * self.n_pos * np.dtype(self.dtype.dtype).itemsize
+        pool_slots = max(32, min(int(pool_bytes) // max(slot_bytes, 1), 8192))
+        self.pool_slots = pool_slots
+        self.node_batch = min(int(node_batch), pool_slots)
+        self.scratch = pool_slots
+        if mesh is not None:
+            self.items = jax.device_put(bitmaps, store_sharding(mesh))
+        else:
+            self.items = jax.device_put(bitmaps)
+        pool_np = np.zeros((pool_slots + 1, n_seq, self.n_pos), self.dtype.dtype)
+        if mesh is not None:
+            self.pool = jax.device_put(pool_np, store_sharding(mesh))
+        else:
+            self.pool = jax.device_put(pool_np)
+        del pool_np
+        self._pool_alloc = SlotPool(range(pool_slots))
+        self._build_fns()
+        self.stats = {"candidates": 0, "kernel_launches": 0,
+                      "recomputed_nodes": 0, "reclaimed_slots": 0, "patterns": 0}
+
+    # ------------------------------------------------------------------ fns
+
+    def _build_fns(self) -> None:
+        mesh = self.mesh
+        maxgap, maxwindow = self.maxgap, self.maxwindow
+        dt = self.dtype
+        NONE = jnp.asarray(-1, dt)
+
+        def root_states(items, item_idx):
+            occ = MS.expand_bits(items[item_idx])
+            pos = jnp.arange(occ.shape[-1], dtype=dt)
+            return jnp.where(occ, pos, NONE)
+
+        def prep_body(pool, items, node_slot, node_root, is_root):
+            # root nodes read their state straight from the item bitmaps
+            m = jnp.where(is_root[:, None, None],
+                          root_states(items, node_root),
+                          pool[node_slot].astype(dt))
+            return m, MS.prev_max(m, maxgap)
+
+        def _child(m, pm, items, ref, item_idx, iss):
+            occ = MS.expand_bits(items[item_idx])
+            base = jnp.where(iss[:, None, None], pm[ref], m[ref])
+            return jnp.where(occ & (base >= 0), base, NONE)
+
+        def supports_body(m, pm, items, ref, item_idx, iss):
+            part = MS.support(_child(m, pm, items, ref, item_idx, iss), maxwindow)
+            if mesh is not None:
+                part = jax.lax.psum(part, SEQ_AXIS)
+            return part
+
+        def materialize_body(m, pm, items, pool, ref, item_idx, iss, out_slot):
+            c = _child(m, pm, items, ref, item_idx, iss)
+            return pool.at[out_slot].set(c)
+
+        def recompute_body(pool, items, step_items, step_iss, step_valid, out_slot):
+            m = root_states(items, step_items[0])
+            def body(state, xs):
+                it, iss, valid = xs
+                pm = MS.prev_max(state, maxgap)
+                occ = MS.expand_bits(items[it])
+                base = jnp.where(iss[:, None, None], pm, state)
+                nm = jnp.where(occ & (base >= 0), base, NONE)
+                return jnp.where(valid[:, None, None], nm, state), None
+            m, _ = jax.lax.scan(body, m, (step_items[1:], step_iss[1:], step_valid[1:]))
+            return pool.at[out_slot].set(m)
+
+        if mesh is None:
+            self._prep_fn = jax.jit(prep_body)
+            self._supports_fn = jax.jit(supports_body)
+            self._materialize_fn = jax.jit(materialize_body, donate_argnums=3)
+            self._recompute_fn = jax.jit(recompute_body, donate_argnums=0)
+        else:
+            st = P(None, SEQ_AXIS, None)
+            rep = P()
+            self._prep_fn = jax.jit(jax.shard_map(
+                prep_body, mesh=mesh, in_specs=(st, st, rep, rep, rep),
+                out_specs=(st, st)))
+            self._supports_fn = jax.jit(jax.shard_map(
+                supports_body, mesh=mesh,
+                in_specs=(st, st, st, rep, rep, rep), out_specs=rep))
+            self._materialize_fn = jax.jit(jax.shard_map(
+                materialize_body, mesh=mesh,
+                in_specs=(st, st, st, st, rep, rep, rep, rep), out_specs=st),
+                donate_argnums=3)
+            self._recompute_fn = jax.jit(jax.shard_map(
+                recompute_body, mesh=mesh,
+                in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
+                donate_argnums=0)
+
+    # ------------------------------------------------------------ slot mgmt
+
+    def _alloc(self) -> Optional[int]:
+        return self._pool_alloc.alloc()
+
+    def _free_slot(self, slot: Optional[int]) -> None:
+        if slot is not None:
+            self._pool_alloc.free(slot)
+
+    def _ensure_slots(self, batch: List[_Node], stack: List[_Node]) -> None:
+        missing = [n for n in batch if n.slot is None and len(n.steps) > 1]
+        if not missing:
+            return
+        self.stats["recomputed_nodes"] += len(missing)
+        if len(self._pool_alloc) < len(missing):
+            self._pool_alloc.reclaim(stack, len(missing),
+                                     lambda n: len(n.steps) > 1)
+            self.stats["reclaimed_slots"] = self._pool_alloc.reclaimed
+        for lo in range(0, len(missing), self.recompute_chunk):
+            group = missing[lo: lo + self.recompute_chunk]
+            mcap = self.recompute_chunk
+            k = next_pow2(max(len(n.steps) for n in group))
+            items = np.zeros((k, mcap), np.int32)
+            iss = np.zeros((k, mcap), bool)
+            valid = np.zeros((k, mcap), bool)
+            slots = np.full(mcap, self.scratch, np.int32)
+            for col, node in enumerate(group):
+                slot = self._alloc()
+                assert slot is not None, "constrained pool exhausted beyond reclaim"
+                node.slot = slot
+                slots[col] = slot
+                for row, (it, s) in enumerate(node.steps):
+                    items[row, col], iss[row, col], valid[row, col] = it, s, True
+            self.pool = self._recompute_fn(
+                self.pool, self.items, jnp.asarray(items), jnp.asarray(iss),
+                jnp.asarray(valid), jnp.asarray(slots))
+            self.stats["kernel_launches"] += 1
+
+    # ------------------------------------------------------------- kernels
+
+    def _prep(self, batch: List[_Node]):
+        slots = np.zeros(self.node_batch, np.int32)
+        roots = np.zeros(self.node_batch, np.int32)
+        is_root = np.zeros(self.node_batch, bool)
+        for i, n in enumerate(batch):
+            if len(n.steps) == 1:
+                is_root[i] = True
+                roots[i] = n.steps[0][0]
+            else:
+                slots[i] = n.slot
+        m, pm = self._prep_fn(self.pool, self.items, jnp.asarray(slots),
+                              jnp.asarray(roots), jnp.asarray(is_root))
+        self.stats["kernel_launches"] += 1
+        return m, pm
+
+    def _run_chunks(self, fn_extra, ref, item, iss, out_slot=None):
+        n = len(ref)
+        c = self.chunk
+        outs = np.empty(n, np.int32) if out_slot is None else None
+        for lo in range(0, n, c):
+            hi = min(lo + c, n)
+            pad = c - (hi - lo)
+            r = jnp.asarray(np.pad(ref[lo:hi], (0, pad)).astype(np.int32))
+            it = jnp.asarray(np.pad(item[lo:hi], (0, pad)).astype(np.int32))
+            ss = jnp.asarray(np.pad(iss[lo:hi], (0, pad)).astype(bool))
+            if out_slot is None:
+                sup = fn_extra(r, it, ss)
+                outs[lo:hi] = np.asarray(sup)[: hi - lo]
+            else:
+                os = jnp.asarray(np.pad(out_slot[lo:hi], (0, pad),
+                                        constant_values=self.scratch).astype(np.int32))
+                fn_extra(r, it, ss, os)
+            self.stats["kernel_launches"] += 1
+        return outs
+
+    # ---------------------------------------------------------------- mine
+
+    def _pattern_of(self, steps) -> Pattern:
+        ids = self.vdb.item_ids
+        pat: List[List[int]] = []
+        for it, is_s in steps:
+            if is_s:
+                pat.append([int(ids[it])])
+            else:
+                pat[-1].append(int(ids[it]))
+        return tuple(tuple(s) for s in pat)
+
+    def mine(self) -> List[PatternResult]:
+        minsup = self.minsup
+        results: List[PatternResult] = []
+        root_items = [i for i in range(self.n_items)
+                      if int(self.vdb.item_supports[i]) >= minsup]
+        stack: List[_Node] = []
+        for i in reversed(root_items):
+            results.append((self._pattern_of(((i, True),)),
+                            int(self.vdb.item_supports[i])))
+            stack.append(_Node(((i, True),), None, root_items,
+                               [j for j in root_items if j > i]))
+
+        while stack:
+            batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
+            self._ensure_slots(batch, stack)
+            m, pm = self._prep(batch)
+
+            cand_ref: List[int] = []
+            cand_item: List[int] = []
+            cand_iss: List[bool] = []
+            spans: List[Tuple[int, int, int]] = []
+            for b_idx, node in enumerate(batch):
+                n_itemsets = sum(1 for _, s in node.steps if s)
+                allow_s = (self.max_pattern_itemsets is None
+                           or n_itemsets < self.max_pattern_itemsets)
+                s_lo = len(cand_ref)
+                if allow_s:
+                    # sibling s-prune is unsound under maxgap, so s_list is
+                    # root_items then; with no gap bound it is the (valid)
+                    # frequent-sibling list as in the unconstrained engine
+                    for i in node.s_list:
+                        cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(True)
+                s_hi = len(cand_ref)
+                for i in node.i_list:
+                    cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(False)
+                spans.append((s_lo, s_hi, len(cand_ref)))
+
+            self.stats["candidates"] += len(cand_ref)
+            sups = (self._run_chunks(
+                        lambda r, it, ss: self._supports_fn(m, pm, self.items, r, it, ss),
+                        np.array(cand_ref, np.int32), np.array(cand_item, np.int32),
+                        np.array(cand_iss, bool))
+                    if cand_ref else np.empty(0, np.int32))
+
+            children: List[_Node] = []
+            mat_ref: List[int] = []; mat_item: List[int] = []
+            mat_iss: List[bool] = []; mat_child: List[int] = []
+            for b_idx, (node, (s_lo, s_hi, i_hi)) in enumerate(zip(batch, spans)):
+                n_itemsets = sum(1 for _, s in node.steps if s)
+                s_items = [cand_item[k] for k in range(s_lo, s_hi) if sups[k] >= minsup]
+                i_items = [cand_item[k] for k in range(s_hi, i_hi) if sups[k] >= minsup]
+                for k in range(s_lo, i_hi):
+                    if sups[k] < minsup:
+                        continue
+                    it, is_s = cand_item[k], cand_iss[k]
+                    steps = node.steps + ((it, is_s),)
+                    results.append((self._pattern_of(steps), int(sups[k])))
+                    src = s_items if is_s else i_items
+                    child_i = [j for j in src if j > it]
+                    child_s = s_items if self.maxgap is None else root_items
+                    child_itemsets = n_itemsets + (1 if is_s else 0)
+                    child_allow_s = (self.max_pattern_itemsets is None
+                                     or child_itemsets < self.max_pattern_itemsets)
+                    if not ((child_s and child_allow_s) or child_i):
+                        continue
+                    child = _Node(steps, None, child_s, child_i)
+                    slot = self._alloc()
+                    if slot is not None:
+                        child.slot = slot
+                        mat_ref.append(b_idx); mat_item.append(it)
+                        mat_iss.append(is_s); mat_child.append(slot)
+                    children.append(child)
+            if mat_child:
+                def mat(r, it, ss, os):
+                    self.pool = self._materialize_fn(m, pm, self.items, self.pool,
+                                                     r, it, ss, os)
+                self._run_chunks(mat, np.array(mat_ref, np.int32),
+                                 np.array(mat_item, np.int32),
+                                 np.array(mat_iss, bool),
+                                 np.array(mat_child, np.int32))
+            stack.extend(reversed(children))
+            for node in batch:
+                if len(node.steps) > 1:
+                    self._free_slot(node.slot)
+
+        self.stats["patterns"] = len(results)
+        return sort_patterns(results)
+
+
+def mine_cspade_tpu(
+    db: SequenceDB,
+    minsup_abs: int,
+    *,
+    maxgap: Optional[int] = None,
+    maxwindow: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    max_pattern_itemsets: Optional[int] = None,
+    **kwargs,
+) -> List[PatternResult]:
+    vdb = build_vertical(db, min_item_support=minsup_abs)
+    if vdb.n_items == 0:
+        return []
+    eng = ConstrainedSpadeTPU(vdb, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
+                              mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
+                              **kwargs)
+    return eng.mine()

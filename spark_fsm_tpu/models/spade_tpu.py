@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
+from spark_fsm_tpu.models._common import SlotPool, next_pow2
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
@@ -52,13 +53,6 @@ class _Node:
     slot: Optional[int]
     s_list: List[int]
     i_list: List[int]
-
-
-def _next_pow2(n: int) -> int:
-    k = 1
-    while k < n:
-        k *= 2
-    return k
 
 
 class SpadeTPU:
@@ -121,7 +115,7 @@ class SpadeTPU:
         else:
             self.store = jax.device_put(store_np)
         del store_np
-        self._free: List[int] = list(range(n_items + pool_slots - 1, n_items - 1, -1))
+        self._pool = SlotPool(range(n_items, n_items + pool_slots))
         self._build_fns()
 
         # mining statistics (observability, SURVEY.md sec 5)
@@ -197,22 +191,11 @@ class SpadeTPU:
     # ------------------------------------------------------------ slot mgmt
 
     def _alloc(self) -> Optional[int]:
-        return self._free.pop() if self._free else None
+        return self._pool.alloc()
 
     def _free_slot(self, slot: Optional[int]) -> None:
-        if slot is not None and slot >= self.n_items:
-            self._free.append(slot)
-
-    def _reclaim(self, stack: List[_Node], need: int) -> None:
-        """Drop bitmap slots from the bottom of the DFS stack (processed
-        last, cheapest to recompute later) until ``need`` slots are free."""
-        for node in stack:
-            if len(self._free) >= need:
-                return
-            if node.slot is not None and node.slot >= self.n_items:
-                self._free.append(node.slot)
-                node.slot = None
-                self.stats["reclaimed_slots"] += 1
+        if slot is not None and slot >= self.n_items:  # item rows never free
+            self._pool.free(slot)
 
     # ------------------------------------------------------------- kernels
 
@@ -265,12 +248,14 @@ class SpadeTPU:
         if not missing:
             return
         self.stats["recomputed_nodes"] += len(missing)
-        if len(self._free) < len(missing):
-            self._reclaim(stack, len(missing))
+        if len(self._pool) < len(missing):
+            self._pool.reclaim(stack, len(missing),
+                               lambda n: n.slot >= self.n_items)
+            self.stats["reclaimed_slots"] = self._pool.reclaimed
         for lo in range(0, len(missing), self.recompute_chunk):
             group = missing[lo: lo + self.recompute_chunk]
             m = self.recompute_chunk
-            k = _next_pow2(max(len(n.steps) for n in group))
+            k = next_pow2(max(len(n.steps) for n in group))
             items = np.zeros((k, m), np.int32)
             iss = np.zeros((k, m), bool)
             valid = np.zeros((k, m), bool)

@@ -1,0 +1,61 @@
+"""JAX mirrors of ops/maxstart_np.py (constrained-SPADE max-start state).
+
+All ops are elementwise/scan work over the position axis — VPU-friendly,
+fusable, and shardable on the sequence axis exactly like the bitmap path
+(positions live in the minor axis; sequences shard across devices).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NONE16 = jnp.int16(-1)
+
+
+def expand_bits(words: jax.Array) -> jax.Array:
+    """[..., n_words] uint32 -> [..., n_words*32] bool (LSB-first)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(bool)
+
+
+def root_state(words: jax.Array) -> jax.Array:
+    occ = expand_bits(words)
+    pos = jnp.arange(occ.shape[-1], dtype=jnp.int16)
+    return jnp.where(occ, pos, NONE16)
+
+
+def prev_max(m: jax.Array, maxgap: Optional[int]) -> jax.Array:
+    p_axis = m.shape[-1]
+    if maxgap is None or maxgap >= p_axis:
+        run = jax.lax.cummax(m, axis=m.ndim - 1)
+        return jnp.concatenate(
+            [jnp.full(m.shape[:-1] + (1,), NONE16, m.dtype), run[..., :-1]], axis=-1)
+    out = jnp.full_like(m, NONE16)
+    for d in range(1, maxgap + 1):
+        shifted = jnp.concatenate(
+            [jnp.full(m.shape[:-1] + (d,), NONE16, m.dtype), m[..., :-d]], axis=-1)
+        out = jnp.maximum(out, shifted)
+    return out
+
+
+def s_extend(m: jax.Array, item_words: jax.Array, maxgap: Optional[int]) -> jax.Array:
+    occ = expand_bits(item_words)
+    pm = prev_max(m, maxgap)
+    return jnp.where(occ & (pm >= 0), pm, NONE16)
+
+
+def i_extend(m: jax.Array, item_words: jax.Array) -> jax.Array:
+    occ = expand_bits(item_words)
+    return jnp.where(occ & (m >= 0), m, NONE16)
+
+
+def support(m: jax.Array, maxwindow: Optional[int]) -> jax.Array:
+    ok = m >= 0
+    if maxwindow is not None:
+        pos = jnp.arange(m.shape[-1], dtype=m.dtype)
+        ok = ok & ((pos - m) <= maxwindow)
+    return jnp.sum(jnp.any(ok, axis=-1), axis=-1, dtype=jnp.int32)

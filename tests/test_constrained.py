@@ -1,0 +1,172 @@
+"""maxgap/maxwindow constrained mining: ops, oracle, engine parity."""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models.oracle import (
+    brute_force_mine_constrained, contains_constrained, mine_cspade, mine_spade)
+from spark_fsm_tpu.models.spade_constrained import (
+    ConstrainedSpadeTPU, mine_cspade_tpu)
+from spark_fsm_tpu.ops import maxstart_np as MS
+from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+from tests.test_oracle import ZAKI_DB, random_db
+
+
+# ------------------------------------------------------------------- ops
+
+def test_expand_bits():
+    w = np.array([0b101, 0b1], dtype=np.uint32)
+    got = MS.expand_bits(w)
+    assert got.shape == (64,)
+    assert got[0] and not got[1] and got[2] and got[32]
+    assert got.sum() == 3
+
+
+def test_root_state():
+    w = np.array([0b1010], dtype=np.uint32)
+    m = MS.root_state(w)
+    assert m[1] == 1 and m[3] == 3 and m[0] == -1
+
+
+def test_prev_max_unbounded():
+    m = np.array([-1, 2, -1, 5, -1], dtype=np.int16)
+    got = MS.prev_max(np.pad(m, (0, 27), constant_values=-1), None)
+    assert got[0] == -1 and got[1] == -1 and got[2] == 2
+    assert got[3] == 2 and got[4] == 5
+
+
+def test_prev_max_gap():
+    m = np.array([3, -1, -1, -1, 7], dtype=np.int16)
+    padded = np.pad(m, (0, 27), constant_values=-1)
+    g1 = MS.prev_max(padded, 1)
+    assert g1[1] == 3 and g1[2] == -1 and g1[5] == 7
+    g3 = MS.prev_max(padded, 3)
+    assert g3[3] == 3 and g3[4] == -1  # pos 4 - gap 3 reaches pos 1..3 only
+
+
+def test_support_window():
+    # ends at 5 with start 2: span 3
+    m = np.full((1, 32), -1, np.int16)
+    m[0, 5] = 2
+    assert MS.support(m, None) == 1
+    assert MS.support(m, 3) == 1
+    assert MS.support(m, 2) == 0
+
+
+def test_jax_ops_match_numpy():
+    import jax.numpy as jnp
+    from spark_fsm_tpu.ops import maxstart_jax as MJ
+    rng = np.random.default_rng(0)
+    words = rng.integers(0, 2**32, size=(4, 6, 2), dtype=np.uint32)
+    m = rng.integers(-1, 50, size=(4, 6, 64)).astype(np.int16)
+    np.testing.assert_array_equal(np.asarray(MJ.expand_bits(jnp.asarray(words))),
+                                  MS.expand_bits(words))
+    for g in (None, 1, 3, 100):
+        np.testing.assert_array_equal(np.asarray(MJ.prev_max(jnp.asarray(m), g)),
+                                      MS.prev_max(m, g))
+    for w in (None, 0, 5, 63):
+        np.testing.assert_array_equal(np.asarray(MJ.support(jnp.asarray(m), w)),
+                                      MS.support(m, w))
+    np.testing.assert_array_equal(
+        np.asarray(MJ.s_extend(jnp.asarray(m), jnp.asarray(words), 2)),
+        MS.s_extend(m, words, 2))
+    np.testing.assert_array_equal(
+        np.asarray(MJ.i_extend(jnp.asarray(m), jnp.asarray(words))),
+        MS.i_extend(m, words))
+
+
+# ----------------------------------------------------------- containment
+
+def test_contains_constrained():
+    seq = ((1,), (2,), (3,), (1, 4))
+    assert contains_constrained(seq, ((1,), (3,)))
+    assert not contains_constrained(seq, ((1,), (3,)), maxgap=1)
+    assert contains_constrained(seq, ((1,), (3,)), maxgap=2)
+    assert contains_constrained(seq, ((2,), (3,), (4,)), maxgap=1, maxwindow=2)
+    assert not contains_constrained(seq, ((1,), (4,)), maxwindow=2)
+    assert contains_constrained(seq, ((1,), (4,)), maxwindow=3)
+    # backtracking case: greedy first match of {1} at 0 fails the gap, the
+    # occurrence at 3 cannot work either, but (2)->(1,4) needs the later 1
+    assert contains_constrained(seq, ((2,), (1,)), maxgap=2)
+
+
+# ------------------------------------------------------- oracle parity
+
+CONFIGS = [(None, None), (1, None), (2, None), (None, 2), (2, 3), (1, 2)]
+
+
+@pytest.mark.parametrize("maxgap,maxwindow", CONFIGS)
+def test_cspade_oracle_vs_brute_force(maxgap, maxwindow):
+    rng = np.random.default_rng(42)
+    db = random_db(rng, n_seq=14, n_items=5, max_itemsets=5, max_set=2)
+    a = mine_cspade(db, 3, maxgap=maxgap, maxwindow=maxwindow)
+    b = brute_force_mine_constrained(db, 3, maxgap=maxgap, maxwindow=maxwindow,
+                                     max_pattern_itemsets=6, max_itemset_size=4)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+
+
+def test_cspade_unconstrained_equals_spade():
+    a = mine_cspade(ZAKI_DB, 2)
+    b = mine_spade(ZAKI_DB, 2)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+
+
+# -------------------------------------------------------- engine parity
+
+@pytest.mark.parametrize("maxgap,maxwindow", CONFIGS)
+def test_engine_vs_oracle(maxgap, maxwindow):
+    rng = np.random.default_rng(7)
+    db = random_db(rng, n_seq=25, n_items=6, max_itemsets=6, max_set=2)
+    a = mine_cspade(db, 3, maxgap=maxgap, maxwindow=maxwindow)
+    b = mine_cspade_tpu(db, 3, maxgap=maxgap, maxwindow=maxwindow)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+
+
+def test_engine_synthetic_gazelle_like():
+    db = synthetic_db(seed=30, n_sequences=300, n_items=40, mean_itemsets=5.0,
+                      mean_itemset_size=1.3)
+    minsup = abs_minsup(0.03, len(db))
+    a = mine_cspade(db, minsup, maxgap=2, maxwindow=5)
+    b = mine_cspade_tpu(db, minsup, maxgap=2, maxwindow=5)
+    assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
+
+
+def test_engine_tiny_pool_recompute():
+    db = synthetic_db(seed=31, n_sequences=150, n_items=20, mean_itemsets=5.0)
+    minsup = abs_minsup(0.05, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    eng = ConstrainedSpadeTPU(vdb, minsup, maxgap=3, maxwindow=6,
+                              pool_bytes=1, node_batch=8, chunk=32,
+                              recompute_chunk=4)
+    assert eng.pool_slots == 32
+    got = eng.mine()
+    want = mine_cspade(db, minsup, maxgap=3, maxwindow=6)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_engine_mesh_parity():
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(8)
+    db = synthetic_db(seed=32, n_sequences=210, n_items=15, mean_itemsets=4.5)
+    minsup = abs_minsup(0.05, len(db))
+    got = mine_cspade_tpu(db, minsup, maxgap=2, maxwindow=4, mesh=mesh)
+    want = mine_cspade(db, minsup, maxgap=2, maxwindow=4)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_engine_int16_path():
+    # sequences longer than 127 positions force the int16 state dtype
+    db = synthetic_db(seed=33, n_sequences=60, n_items=10, mean_itemsets=100.0,
+                      max_itemsets=150)
+    minsup = abs_minsup(0.5, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    import jax.numpy as jnp
+    eng = ConstrainedSpadeTPU(vdb, minsup, maxgap=1, maxwindow=3,
+                              max_pattern_itemsets=3)
+    assert eng.dtype == jnp.int16
+    got = eng.mine()
+    want = mine_cspade(db, minsup, maxgap=1, maxwindow=3, max_pattern_itemsets=3)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
