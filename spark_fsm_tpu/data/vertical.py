@@ -16,7 +16,7 @@ SURVEY.md sec 2.3 step 6) see the same gaps with or without projection.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -29,35 +29,61 @@ WORD_BITS = 32
 class VerticalDB:
     """Dense vertical bitmap database over the frequent-item projection.
 
+    The authoritative representation is the token table — one row per
+    (kept-item occurrence): ``tok_item`` (dense item index), ``tok_seq``,
+    ``tok_word``/``tok_mask`` (bit address of the itemset position).  It is
+    ~1000x smaller than the dense bitmaps, so device engines upload tokens
+    and scatter-build the bitmap store IN HBM instead of pushing hundreds of
+    MB over PCIe/tunnel; CPU consumers use the lazily-built dense ``bitmaps``.
+
     Attributes:
       item_ids:   [n_items] int32, original SPMF item ids, strictly ascending.
                   Bitmap row ``i`` belongs to item ``item_ids[i]``.
-      bitmaps:    [n_items, n_seq, n_words] uint32 occurrence bitmaps.
       seq_lengths:[n_seq] int32, number of itemsets per sequence.
       n_positions: padded position capacity = n_words * 32 (>= max seq length).
       item_supports: [n_items] int32 sequence-support of each kept item.
+      tok_*: [n_tokens] int32/uint32 token table (see above).
+      bitmaps: [n_items, n_seq, n_words] uint32 occurrence bitmaps (lazy).
     """
 
     item_ids: np.ndarray
-    bitmaps: np.ndarray
     seq_lengths: np.ndarray
     n_positions: int
     item_supports: np.ndarray
+    tok_item: np.ndarray
+    tok_seq: np.ndarray
+    tok_word: np.ndarray
+    tok_mask: np.ndarray
+    _n_seq: int
+    _n_words: int
+    _bitmaps: Optional[np.ndarray] = None
 
     @property
     def n_items(self) -> int:
-        return int(self.bitmaps.shape[0])
+        return int(self.item_ids.shape[0])
 
     @property
     def n_sequences(self) -> int:
-        return int(self.bitmaps.shape[1])
+        return self._n_seq
 
     @property
     def n_words(self) -> int:
-        return int(self.bitmaps.shape[2])
+        return self._n_words
+
+    @property
+    def bitmaps(self) -> np.ndarray:
+        """Dense [n_items, n_seq, n_words] bitmaps, built on first use."""
+        if self._bitmaps is None:
+            bm = np.zeros(self.n_items * self._n_seq * self._n_words, np.uint32)
+            flat = (self.tok_item.astype(np.int64) * self._n_seq
+                    + self.tok_seq) * self._n_words + self.tok_word
+            # distinct (seq,pos) per item occurrence => add == bitwise OR
+            np.add.at(bm, flat, self.tok_mask)
+            self._bitmaps = bm.reshape(self.n_items, self._n_seq, self._n_words)
+        return self._bitmaps
 
     def nbytes(self) -> int:
-        return int(self.bitmaps.nbytes)
+        return self.n_items * self._n_seq * self._n_words * 4
 
 
 def build_vertical(
@@ -78,46 +104,78 @@ def build_vertical(
     n_seq = len(db)
     if n_seq == 0:
         raise ValueError("empty sequence database")
-    seq_lengths = np.array([len(s) for s in db], dtype=np.int32)
+
+    # One cheap Python pass flattens the DB to token arrays; everything
+    # after is vectorized numpy (the reference's one-pass vertical-db
+    # construction, SURVEY.md sec 2.3 step 1).
+    seq_lengths = np.fromiter((len(s) for s in db), np.int32, count=n_seq)
+    raw_items = np.fromiter(
+        (it for seq in db for itemset in seq for it in itemset),
+        np.int64,
+    )
+    counts = np.fromiter(
+        (len(itemset) for seq in db for itemset in seq),
+        np.int64,
+    )
+    n_itemsets_total = len(counts)
+    # position (itemset index within its sequence) per itemset, then per token
+    seq_of_itemset = np.repeat(np.arange(n_seq, dtype=np.int64), seq_lengths)
+    starts = np.concatenate(([0], np.cumsum(seq_lengths)))[seq_of_itemset]
+    pos_of_itemset = np.arange(n_itemsets_total, dtype=np.int64) - starts
+    tok_seq = np.repeat(seq_of_itemset, counts)
+    tok_pos = np.repeat(pos_of_itemset, counts)
+
     max_len = int(seq_lengths.max())
     n_words = max(1, -(-max_len // WORD_BITS))
     if word_multiple > 1:
         n_words = -(-n_words // word_multiple) * word_multiple
 
-    # Pass 1: sequence-support per item (count each item once per sequence).
-    supports: dict[int, int] = {}
-    for seq in db:
-        seen = set()
-        for itemset in seq:
-            seen.update(itemset)
-        for it in seen:
-            supports[it] = supports.get(it, 0) + 1
-    kept = sorted(it for it, sup in supports.items() if sup >= min_item_support)
-    item_index = {it: i for i, it in enumerate(kept)}
+    # Sequence-support per item: count unique (item, seq) pairs.
+    pair = raw_items * n_seq + tok_seq
+    uniq_pair = np.unique(pair)
+    uniq_item = uniq_pair // n_seq
+    items_all, sup_all = np.unique(uniq_item, return_counts=True)
+    keep = sup_all >= min_item_support
+    kept = items_all[keep]
+    item_supports = sup_all[keep].astype(np.int32)
     n_items = len(kept)
 
+    # Remap raw item ids -> dense kept index; drop tokens of dropped items.
+    idx = np.searchsorted(kept, raw_items)
+    idx_clip = np.minimum(idx, max(n_items - 1, 0))
+    if n_items == 0:
+        tok_keep = np.zeros(len(raw_items), dtype=bool)
+    else:
+        tok_keep = kept[idx_clip] == raw_items
+    tok_item = idx_clip[tok_keep]
+    tok_seq_k = tok_seq[tok_keep]
+    tok_pos_k = tok_pos[tok_keep]
+    # Dedup (item, seq, pos) — a caller-built DB may repeat an item inside
+    # an itemset, and the scatter-ADD consumers (here and the device store
+    # build) rely on each token being a distinct bit.
+    key = (tok_item * n_seq + tok_seq_k) * (np.int64(n_words) * WORD_BITS) + tok_pos_k
+    uniq = np.unique(key)
+    tok_pos_k = uniq % (np.int64(n_words) * WORD_BITS)
+    rest = uniq // (np.int64(n_words) * WORD_BITS)
+    tok_seq_k = (rest % n_seq).astype(np.int32)
+    tok_item = (rest // n_seq).astype(np.int32)
+    tok_word = (tok_pos_k // WORD_BITS).astype(np.int32)
+    tok_mask = (np.uint32(1) << (tok_pos_k % WORD_BITS).astype(np.uint32))
+
     n_seq_padded = n_seq if pad_sequences_to is None else max(n_seq, pad_sequences_to)
-    bitmaps = np.zeros((n_items, n_seq_padded, n_words), dtype=np.uint32)
-
-    # Pass 2: set occurrence bits.
-    for s, seq in enumerate(db):
-        for p, itemset in enumerate(seq):
-            word = p // WORD_BITS
-            mask = np.uint32(1 << (p % WORD_BITS))
-            for it in itemset:
-                i = item_index.get(it)
-                if i is not None:
-                    bitmaps[i, s, word] |= mask
-
     seq_lengths_padded = np.zeros(n_seq_padded, dtype=np.int32)
     seq_lengths_padded[:n_seq] = seq_lengths
-    item_supports = np.array([supports[it] for it in kept], dtype=np.int32)
     return VerticalDB(
-        item_ids=np.array(kept, dtype=np.int32),
-        bitmaps=bitmaps,
+        item_ids=kept.astype(np.int32),
         seq_lengths=seq_lengths_padded,
         n_positions=n_words * WORD_BITS,
         item_supports=item_supports,
+        tok_item=tok_item,
+        tok_seq=tok_seq_k,
+        tok_word=tok_word,
+        tok_mask=tok_mask,
+        _n_seq=n_seq_padded,
+        _n_words=n_words,
     )
 
 

@@ -25,6 +25,7 @@ typical clickstream data.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from typing import List, Optional, Tuple
 
 import jax
@@ -59,8 +60,9 @@ class ConstrainedSpadeTPU:
         maxgap: Optional[int] = None,
         maxwindow: Optional[int] = None,
         mesh: Optional[Mesh] = None,
-        chunk: int = 64,
+        chunk: int = 256,
         node_batch: int = 32,
+        pipeline_depth: int = 4,
         recompute_chunk: int = 32,
         pool_bytes: int = 2 << 30,
         max_pattern_itemsets: Optional[int] = None,
@@ -71,6 +73,7 @@ class ConstrainedSpadeTPU:
         self.maxwindow = maxwindow
         self.mesh = mesh
         self.chunk = int(chunk)
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.recompute_chunk = int(recompute_chunk)
         self.max_pattern_itemsets = max_pattern_itemsets
 
@@ -89,10 +92,19 @@ class ConstrainedSpadeTPU:
         self.n_pos = n_words * 32
         self.dtype = jnp.int8 if self.n_pos <= 127 else jnp.int16
 
+        # Same budget/invariant accounting as the unconstrained engine: the
+        # pool shares HBM with pipeline_depth in-flight (m, pm) preps (2
+        # slot-equivalents per node each), and node_batch is bounded so
+        # in-flight batches can never starve a recompute.
         slot_bytes = n_seq * self.n_pos * np.dtype(self.dtype.dtype).itemsize
-        pool_slots = max(32, min(int(pool_bytes) // max(slot_bytes, 1), 8192))
+        budget_slots = max(32, min(int(pool_bytes) // max(slot_bytes, 1), 8192))
+        self.pipeline_depth = min(self.pipeline_depth,
+                                  max(1, budget_slots // 8))
+        d = self.pipeline_depth
+        nb = max(1, min(int(node_batch), budget_slots // (3 * (d + 2))))
+        pool_slots = max(8, budget_slots - 2 * d * nb)
         self.pool_slots = pool_slots
-        self.node_batch = min(int(node_batch), pool_slots)
+        self.node_batch = nb
         self.scratch = pool_slots
         if mesh is not None:
             self.items = jax.device_put(bitmaps, store_sharding(mesh))
@@ -235,9 +247,11 @@ class ConstrainedSpadeTPU:
         return m, pm
 
     def _run_chunks(self, fn_extra, ref, item, iss, out_slot=None):
+        """Chunk-dispatch kernels.  Support mode (out_slot None) returns ONE
+        device array for the whole list with its host copy in flight."""
         n = len(ref)
         c = self.chunk
-        outs = np.empty(n, np.int32) if out_slot is None else None
+        outs = [] if out_slot is None else None
         for lo in range(0, n, c):
             hi = min(lo + c, n)
             pad = c - (hi - lo)
@@ -245,14 +259,20 @@ class ConstrainedSpadeTPU:
             it = jnp.asarray(np.pad(item[lo:hi], (0, pad)).astype(np.int32))
             ss = jnp.asarray(np.pad(iss[lo:hi], (0, pad)).astype(bool))
             if out_slot is None:
-                sup = fn_extra(r, it, ss)
-                outs[lo:hi] = np.asarray(sup)[: hi - lo]
+                outs.append(fn_extra(r, it, ss))
             else:
                 os = jnp.asarray(np.pad(out_slot[lo:hi], (0, pad),
                                         constant_values=self.scratch).astype(np.int32))
                 fn_extra(r, it, ss, os)
             self.stats["kernel_launches"] += 1
-        return outs
+        if out_slot is not None:
+            return None
+        sup = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        try:
+            sup.copy_to_host_async()
+        except Exception:
+            pass
+        return sup
 
     # ---------------------------------------------------------------- mine
 
@@ -278,7 +298,12 @@ class ConstrainedSpadeTPU:
             stack.append(_Node(((i, True),), None, root_items,
                                [j for j in root_items if j > i]))
 
-        while stack:
+        # Same software-pipelined dispatch/resolve loop as the unconstrained
+        # engine (see models/spade_tpu.py): one async support readback per
+        # node batch, pipeline_depth batches in flight.
+        inflight: deque = deque()
+
+        def dispatch():
             batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
             self._ensure_slots(batch, stack)
             m, pm = self._prep(batch)
@@ -304,11 +329,18 @@ class ConstrainedSpadeTPU:
                 spans.append((s_lo, s_hi, len(cand_ref)))
 
             self.stats["candidates"] += len(cand_ref)
-            sups = (self._run_chunks(
-                        lambda r, it, ss: self._supports_fn(m, pm, self.items, r, it, ss),
-                        np.array(cand_ref, np.int32), np.array(cand_item, np.int32),
-                        np.array(cand_iss, bool))
-                    if cand_ref else np.empty(0, np.int32))
+            sup_dev = (self._run_chunks(
+                           lambda r, it, ss: self._supports_fn(m, pm, self.items, r, it, ss),
+                           np.array(cand_ref, np.int32), np.array(cand_item, np.int32),
+                           np.array(cand_iss, bool))
+                       if cand_ref else None)
+            return batch, (m, pm), cand_item, cand_iss, spans, sup_dev
+
+        def resolve(entry):
+            batch, (m, pm), cand_item, cand_iss, spans, sup_dev = entry
+            n_cand = spans[-1][2] if spans else 0
+            sups = (np.asarray(sup_dev)[:n_cand] if sup_dev is not None
+                    else np.empty(0, np.int32))
 
             children: List[_Node] = []
             mat_ref: List[int] = []; mat_item: List[int] = []
@@ -350,6 +382,11 @@ class ConstrainedSpadeTPU:
             for node in batch:
                 if len(node.steps) > 1:
                     self._free_slot(node.slot)
+
+        while stack or inflight:
+            while stack and len(inflight) < self.pipeline_depth:
+                inflight.append(dispatch())
+            resolve(inflight.popleft())
 
         self.stats["patterns"] = len(results)
         return sort_patterns(results)

@@ -12,6 +12,12 @@ SURVEY.md sec 3.1 hot loop):
   gather -> s-ext transform / AND join -> per-sequence any -> support sum.
   The host then applies the minsup prune (SURVEY.md sec 2.3 step 5) and
   materializes only surviving children back into pool slots.
+- The dispatch/resolve split pipelines the host loop: several node batches
+  are in flight at once, each with ONE asynchronously-copied support array,
+  so device->host latency (large on remote/tunneled TPUs, where a round
+  trip can cost tens of ms) overlaps with compute and with other batches'
+  transfers instead of serializing the DFS.  Device ops stay correctly
+  ordered because a single device executes dispatches in order.
 - Memory safety is recompute-on-miss, not spill: a child that gets no free
   slot (or whose slot was reclaimed) carries its extension path
   ``steps = ((item, is_s), ...)``; when popped, its bitmap is rebuilt by a
@@ -30,6 +36,7 @@ construction; supports are exact integers from popcounts.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -66,6 +73,8 @@ class SpadeTPU:
         a device multiple and sharded.
       chunk: candidates per support-kernel launch.
       node_batch: DFS nodes popped per host iteration.
+      pipeline_depth: node batches in flight (dispatched, support readback
+        pending) at once.
       pool_bytes: HBM budget for the pattern-bitmap pool.
       max_pattern_itemsets: optional cap on pattern length in itemsets.
     """
@@ -76,8 +85,9 @@ class SpadeTPU:
         minsup_abs: int,
         *,
         mesh: Optional[Mesh] = None,
-        chunk: int = 512,
+        chunk: int = 2048,
         node_batch: int = 256,
+        pipeline_depth: int = 4,
         recompute_chunk: int = 256,
         pool_bytes: int = 2 << 30,
         max_pattern_itemsets: Optional[int] = None,
@@ -86,35 +96,55 @@ class SpadeTPU:
         self.minsup = int(minsup_abs)
         self.mesh = mesh
         self.chunk = int(chunk)
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.recompute_chunk = int(recompute_chunk)
         self.max_pattern_itemsets = max_pattern_itemsets
 
-        bitmaps = vdb.bitmaps
-        n_items, n_seq, n_words = bitmaps.shape
+        n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
         if mesh is not None:
-            n_dev = mesh.devices.size
-            padded = pad_to_multiple(n_seq, n_dev)
-            if padded != n_seq:
-                bitmaps = np.concatenate(
-                    [bitmaps, np.zeros((n_items, padded - n_seq, n_words), np.uint32)], axis=1
-                )
-                n_seq = padded
+            n_seq = pad_to_multiple(n_seq, mesh.devices.size)
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
 
+        # HBM budget covers the slot pool PLUS the in-flight prep tensors
+        # (each pipelined batch holds a [2*node_batch, S, W] prep), and
+        # node_batch is bounded so pipeline_depth in-flight batches can
+        # never starve a recompute: slots held in flight <= depth*nb, so
+        # free+stack-reclaimable >= pool - (depth+1)*nb >= nb holds whenever
+        # nb <= pool // (depth+2).
         slot_bytes = n_seq * n_words * 4
-        pool_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 16384))
+        budget_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 16384))
+        self.pipeline_depth = min(self.pipeline_depth,
+                                  max(1, budget_slots // 8))
+        d = self.pipeline_depth
+        nb = max(1, min(int(node_batch), budget_slots // (3 * (d + 2))))
+        pool_slots = max(8, budget_slots - 2 * d * nb)
         self.pool_slots = pool_slots
-        self.node_batch = min(int(node_batch), pool_slots)
+        self.node_batch = nb
         self.scratch = n_items + pool_slots
         total = n_items + pool_slots + 1
 
-        store_np = np.zeros((total, n_seq, n_words), dtype=np.uint32)
-        store_np[:n_items] = bitmaps
-        if mesh is not None:
-            self.store = jax.device_put(store_np, store_sharding(mesh))
+        if mesh is None:
+            # Scatter-build the store IN HBM from the ~KB-scale token table
+            # (SURVEY.md sec 2.3 step 1 as a device kernel) — the dense
+            # store is never materialized on host or shipped over the link.
+            def init_store(ti, ts, tw, tm):
+                z = jnp.zeros((total, n_seq, n_words), jnp.uint32)
+                return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
+
+            self.store = jax.jit(init_store)(
+                jnp.asarray(vdb.tok_item), jnp.asarray(vdb.tok_seq),
+                jnp.asarray(vdb.tok_word), jnp.asarray(vdb.tok_mask))
         else:
-            self.store = jax.device_put(store_np)
-        del store_np
+            bitmaps = vdb.bitmaps
+            if n_seq != vdb.n_sequences:
+                bitmaps = np.concatenate(
+                    [bitmaps,
+                     np.zeros((n_items, n_seq - vdb.n_sequences, n_words), np.uint32)],
+                    axis=1)
+            store_np = np.zeros((total, n_seq, n_words), dtype=np.uint32)
+            store_np[:n_items] = bitmaps
+            self.store = jax.device_put(store_np, store_sharding(mesh))
+            del store_np
         self._pool = SlotPool(range(n_items, n_items + pool_slots))
         self._build_fns()
 
@@ -131,24 +161,27 @@ class SpadeTPU:
 
         # The s-ext transform (~6 word-ops) dominates the AND (1 op), and a
         # node typically has tens of candidates, so gather + transform the
-        # popped batch's bitmaps ONCE per batch; candidate chunks then only
-        # gather [chunk, S, W] slices and AND them with the item id-lists.
+        # popped batch's bitmaps ONCE per batch.  Plain and transformed rows
+        # interleave into ONE [2*Bn, S, W] tensor so each candidate costs a
+        # single gathered row (a where(iss, trans[ref], parents[ref]) would
+        # gather BOTH branches — 2x HBM traffic on the parent side).
         def prep_body(store, node_slot):
             parents = store[node_slot]            # [Bn, S, W]
-            return parents, B.sext_transform(parents)
+            pt = jnp.stack([parents, B.sext_transform(parents)], axis=1)
+            return pt.reshape((-1,) + parents.shape[1:])  # [2*Bn, S, W]
 
-        def _joined(parents, trans, store, parent_ref, item_slot, iss):
-            base = jnp.where(iss[:, None, None], trans[parent_ref], parents[parent_ref])
+        def _joined(pt, store, parent_ref, item_slot, iss):
+            base = pt[2 * parent_ref + iss.astype(jnp.int32)]
             return base & store[item_slot]
 
-        def supports_body(parents, trans, store, parent_ref, item_slot, iss):
-            part = B.support(_joined(parents, trans, store, parent_ref, item_slot, iss))
+        def supports_body(pt, store, parent_ref, item_slot, iss):
+            part = B.support(_joined(pt, store, parent_ref, item_slot, iss))
             if mesh is not None:
                 part = jax.lax.psum(part, SEQ_AXIS)
             return part
 
-        def materialize_body(parents, trans, store, parent_ref, item_slot, iss, out_slot):
-            j = _joined(parents, trans, store, parent_ref, item_slot, iss)
+        def materialize_body(pt, store, parent_ref, item_slot, iss, out_slot):
+            j = _joined(pt, store, parent_ref, item_slot, iss)
             return store.at[out_slot].set(j)
 
         def recompute_body(store, step_items, step_iss, step_valid, out_slot):
@@ -164,23 +197,23 @@ class SpadeTPU:
         if mesh is None:
             self._prep_fn = jax.jit(prep_body)
             self._supports_fn = jax.jit(supports_body)
-            self._materialize_fn = jax.jit(materialize_body, donate_argnums=2)
+            self._materialize_fn = jax.jit(materialize_body, donate_argnums=1)
             self._recompute_fn = jax.jit(recompute_body, donate_argnums=0)
         else:
             st = P(None, SEQ_AXIS, None)
             rep = P()
             self._prep_fn = jax.jit(
                 jax.shard_map(prep_body, mesh=mesh,
-                              in_specs=(st, rep), out_specs=(st, st))
+                              in_specs=(st, rep), out_specs=st)
             )
             self._supports_fn = jax.jit(
                 jax.shard_map(supports_body, mesh=mesh,
-                              in_specs=(st, st, st, rep, rep, rep), out_specs=rep)
+                              in_specs=(st, st, rep, rep, rep), out_specs=rep)
             )
             self._materialize_fn = jax.jit(
                 jax.shard_map(materialize_body, mesh=mesh,
-                              in_specs=(st, st, st, rep, rep, rep, rep), out_specs=st),
-                donate_argnums=2,
+                              in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
+                donate_argnums=1,
             )
             self._recompute_fn = jax.jit(
                 jax.shard_map(recompute_body, mesh=mesh,
@@ -200,13 +233,17 @@ class SpadeTPU:
     # ------------------------------------------------------------- kernels
 
     def _prep(self, batch: List[_Node]):
-        """Gather + s-ext-transform the popped batch's bitmaps, once."""
+        """Gather + s-ext-transform the popped batch's bitmaps, once.
+
+        Returns the interleaved [2*Bn, S, W] plain/transformed tensor; row
+        ``2*b`` is node b's bitmap, row ``2*b+1`` its s-ext transform.
+        """
         slots = np.zeros(self.node_batch, np.int32)
         for i, n in enumerate(batch):
             slots[i] = n.slot
-        parents, trans = self._prep_fn(self.store, jnp.asarray(slots))
+        pt = self._prep_fn(self.store, jnp.asarray(slots))
         self.stats["kernel_launches"] += 1
-        return parents, trans
+        return pt
 
     def _chunks(self, *arrays: np.ndarray, pad_values=None):
         """Yield chunk-padded jnp views of parallel 1-D arrays."""
@@ -221,25 +258,30 @@ class SpadeTPU:
                 for a, pv in zip(arrays, pad_values)
             )
 
-    def _supports(self, prep, ref: np.ndarray, item: np.ndarray, iss: np.ndarray) -> np.ndarray:
-        """Chunked candidate support counts (ref indexes into the batch)."""
-        parents, trans = prep
-        out = np.empty(len(ref), dtype=np.int32)
-        for lo, hi, (r, it, ss) in self._chunks(
+    def _supports_dispatch(self, prep, ref: np.ndarray, item: np.ndarray,
+                           iss: np.ndarray) -> jax.Array:
+        """Dispatch chunked support kernels; return ONE device array for the
+        whole batch with its host copy already in flight (the readback is
+        the expensive half on tunneled TPUs, so batches make exactly one)."""
+        outs = []
+        for _, _, (r, it, ss) in self._chunks(
                 ref.astype(np.int32), item.astype(np.int32), iss.astype(bool)):
-            sup = self._supports_fn(parents, trans, self.store, r, it, ss)
-            out[lo:hi] = np.asarray(sup)[: hi - lo]
+            outs.append(self._supports_fn(prep, self.store, r, it, ss))
             self.stats["kernel_launches"] += 1
         self.stats["candidates"] += len(ref)
-        return out
+        sup = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        try:
+            sup.copy_to_host_async()
+        except Exception:
+            pass
+        return sup
 
     def _materialize(self, prep, ref, item, iss, out_slot) -> None:
-        parents, trans = prep
         for _, _, (r, it, ss, os) in self._chunks(
                 ref.astype(np.int32), item.astype(np.int32), iss.astype(bool),
                 out_slot.astype(np.int32),
                 pad_values=[0, 0, False, self.scratch]):
-            self.store = self._materialize_fn(parents, trans, self.store, r, it, ss, os)
+            self.store = self._materialize_fn(prep, self.store, r, it, ss, os)
             self.stats["kernel_launches"] += 1
 
     def _ensure_slots(self, batch: List[_Node], stack: List[_Node]) -> None:
@@ -285,6 +327,82 @@ class SpadeTPU:
                 pat[-1].append(int(ids[it]))
         return tuple(tuple(s) for s in pat)
 
+    def _dispatch(self, stack: List[_Node]):
+        """Pop a node batch, dispatch its support kernels, start the async
+        host copy.  Returns everything the resolve step needs."""
+        batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
+        self._ensure_slots(batch, stack)
+        prep = self._prep(batch)
+
+        # Flat candidate list for the whole batch (ref = index in batch).
+        cand_item: List[int] = []
+        cand_iss: List[bool] = []
+        cand_ref: List[int] = []
+        spans: List[Tuple[int, int, int]] = []  # (s_lo, s_hi == i_lo, i_hi)
+        for b_idx, node in enumerate(batch):
+            n_itemsets = sum(1 for _, s in node.steps if s)
+            allow_s = (self.max_pattern_itemsets is None
+                       or n_itemsets < self.max_pattern_itemsets)
+            s_lo = len(cand_ref)
+            if allow_s:
+                for i in node.s_list:
+                    cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(True)
+            s_hi = len(cand_ref)
+            for i in node.i_list:
+                cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(False)
+            spans.append((s_lo, s_hi, len(cand_ref)))
+
+        sup_dev = (self._supports_dispatch(prep, np.array(cand_ref, np.int32),
+                                           np.array(cand_item, np.int32),
+                                           np.array(cand_iss, bool))
+                   if cand_ref else None)
+        return batch, prep, cand_item, cand_iss, spans, sup_dev
+
+    def _resolve(self, inflight, stack: List[_Node],
+                 results: List[PatternResult]) -> None:
+        """Wait for a dispatched batch's supports; prune, materialize
+        surviving children, push them on the DFS stack."""
+        batch, prep, cand_item, cand_iss, spans, sup_dev = inflight
+        minsup = self.minsup
+        n_cand = spans[-1][2] if spans else 0
+        sups = (np.asarray(sup_dev)[:n_cand] if sup_dev is not None
+                else np.empty(0, np.int32))
+
+        children: List[_Node] = []
+        mat_ref: List[int] = []; mat_item: List[int] = []
+        mat_iss: List[bool] = []; mat_child: List[int] = []
+        for b_idx, (node, (s_lo, s_hi, i_hi)) in enumerate(zip(batch, spans)):
+            n_itemsets = sum(1 for _, s in node.steps if s)
+            s_items = [cand_item[k] for k in range(s_lo, s_hi) if sups[k] >= minsup]
+            i_items = [cand_item[k] for k in range(s_hi, i_hi) if sups[k] >= minsup]
+            for k in range(s_lo, i_hi):
+                if sups[k] < minsup:
+                    continue
+                it, is_s = cand_item[k], cand_iss[k]
+                steps = node.steps + ((it, is_s),)
+                results.append((self._pattern_of(steps), int(sups[k])))
+                src = s_items if is_s else i_items
+                child_i = [j for j in src if j > it]
+                child_itemsets = n_itemsets + (1 if is_s else 0)
+                child_allow_s = (self.max_pattern_itemsets is None
+                                 or child_itemsets < self.max_pattern_itemsets)
+                if not ((s_items and child_allow_s) or child_i):
+                    continue  # leaf: no possible extensions
+                child = _Node(steps, None, s_items, child_i)
+                slot = self._alloc()
+                if slot is not None:
+                    child.slot = slot
+                    mat_ref.append(b_idx); mat_item.append(it)
+                    mat_iss.append(is_s); mat_child.append(slot)
+                children.append(child)
+        if mat_child:
+            self._materialize(prep, np.array(mat_ref, np.int32),
+                              np.array(mat_item, np.int32),
+                              np.array(mat_iss, bool), np.array(mat_child, np.int32))
+        stack.extend(reversed(children))
+        for node in batch:
+            self._free_slot(node.slot)
+
     def mine(self) -> List[PatternResult]:
         minsup = self.minsup
         results: List[PatternResult] = []
@@ -296,68 +414,15 @@ class SpadeTPU:
             stack.append(_Node(((i, True),), i, root_items,
                                [j for j in root_items if j > i]))
 
-        while stack:
-            batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
-            self._ensure_slots(batch, stack)
-            prep = self._prep(batch)
-
-            # Flat candidate list for the whole batch (ref = index in batch).
-            cand_ref: List[int] = []
-            cand_item: List[int] = []
-            cand_iss: List[bool] = []
-            spans: List[Tuple[int, int, int]] = []  # (s_lo, s_hi == i_lo, i_hi)
-            for b_idx, node in enumerate(batch):
-                n_itemsets = sum(1 for _, s in node.steps if s)
-                allow_s = (self.max_pattern_itemsets is None
-                           or n_itemsets < self.max_pattern_itemsets)
-                s_lo = len(cand_ref)
-                if allow_s:
-                    for i in node.s_list:
-                        cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(True)
-                s_hi = len(cand_ref)
-                for i in node.i_list:
-                    cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(False)
-                spans.append((s_lo, s_hi, len(cand_ref)))
-
-            sups = (self._supports(prep, np.array(cand_ref, np.int32),
-                                   np.array(cand_item, np.int32),
-                                   np.array(cand_iss, bool))
-                    if cand_ref else np.empty(0, np.int32))
-
-            # Prune, create children, collect materialization work.
-            children: List[_Node] = []
-            mat_ref: List[int] = []; mat_item: List[int] = []
-            mat_iss: List[bool] = []; mat_child: List[int] = []
-            for b_idx, (node, (s_lo, s_hi, i_hi)) in enumerate(zip(batch, spans)):
-                s_items = [cand_item[k] for k in range(s_lo, s_hi) if sups[k] >= minsup]
-                i_items = [cand_item[k] for k in range(s_hi, i_hi) if sups[k] >= minsup]
-                for k in range(s_lo, i_hi):
-                    if sups[k] < minsup:
-                        continue
-                    it, is_s = cand_item[k], cand_iss[k]
-                    steps = node.steps + ((it, is_s),)
-                    results.append((self._pattern_of(steps), int(sups[k])))
-                    src = s_items if is_s else i_items
-                    child_i = [j for j in src if j > it]
-                    child_itemsets = n_itemsets + (1 if is_s else 0)
-                    child_allow_s = (self.max_pattern_itemsets is None
-                                     or child_itemsets < self.max_pattern_itemsets)
-                    if not ((s_items and child_allow_s) or child_i):
-                        continue  # leaf: no possible extensions
-                    child = _Node(steps, None, s_items, child_i)
-                    slot = self._alloc()
-                    if slot is not None:
-                        child.slot = slot
-                        mat_ref.append(b_idx); mat_item.append(it)
-                        mat_iss.append(is_s); mat_child.append(slot)
-                    children.append(child)
-            if mat_child:
-                self._materialize(prep, np.array(mat_ref, np.int32),
-                                  np.array(mat_item, np.int32),
-                                  np.array(mat_iss, bool), np.array(mat_child, np.int32))
-            stack.extend(reversed(children))
-            for node in batch:
-                self._free_slot(node.slot)
+        # Software-pipelined DFS: keep up to pipeline_depth batches in
+        # flight so support readbacks overlap with compute and each other.
+        # Resolving out of strict DFS order only permutes enumeration order;
+        # the pattern SET is unchanged (canonicalized in sort_patterns).
+        inflight: deque = deque()
+        while stack or inflight:
+            while stack and len(inflight) < self.pipeline_depth:
+                inflight.append(self._dispatch(stack))
+            self._resolve(inflight.popleft(), stack, results)
 
         self.stats["patterns"] = len(results)
         return sort_patterns(results)

