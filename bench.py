@@ -13,7 +13,10 @@ vs_baseline: 10s-target ratio = 10.0 / steady wall-clock (>1 beats the
 
 Env knobs: BENCH_SCALE (default 1.0), BENCH_MINSUP (default 0.001),
 BENCH_DATASET (SPMF file path), BENCH_PARITY=1 (also run the CPU oracle and
-check byte-identical output; adds oracle wall-clock).
+check byte-identical output; adds oracle wall-clock), BENCH_PALLAS=1 to
+enable the Pallas pair-support kernel (default off until it is validated on
+the target chip generation; a kernel failure falls back to the jnp path,
+but a hang would stall the harness, so opt-in here).
 """
 
 import json
@@ -60,8 +63,9 @@ def main() -> None:
     build_s = time.time() - t0
 
     platform = jax.devices()[0].platform
+    use_pallas = "auto" if os.environ.get("BENCH_PALLAS") == "1" else False
     t0 = time.time()
-    eng = SpadeTPU(vdb, minsup)
+    eng = SpadeTPU(vdb, minsup, use_pallas=use_pallas)
     res = eng.mine()
     cold_s = time.time() - t0
 

@@ -48,6 +48,7 @@ from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import SlotPool, next_pow2
 from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
@@ -91,6 +92,7 @@ class SpadeTPU:
         recompute_chunk: int = 256,
         pool_bytes: int = 2 << 30,
         max_pattern_itemsets: Optional[int] = None,
+        use_pallas="auto",
     ):
         self.vdb = vdb
         self.minsup = int(minsup_abs)
@@ -101,8 +103,19 @@ class SpadeTPU:
         self.max_pattern_itemsets = max_pattern_itemsets
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
+        # Pallas pair-support kernel: single-chip, single-word layout (see
+        # ops/pallas_support.py).  "auto" enables it on a real TPU backend;
+        # explicit True runs interpret-mode off-TPU (tests).
+        eligible = mesh is None and n_words == 1 and n_items > 0
+        if use_pallas == "auto":
+            self.use_pallas = eligible and jax.default_backend() == "tpu"
+        else:
+            self.use_pallas = bool(use_pallas) and eligible
+        self._pallas_interpret = jax.default_backend() != "tpu"
         if mesh is not None:
             n_seq = pad_to_multiple(n_seq, mesh.devices.size)
+        if self.use_pallas:
+            n_seq = pad_to_multiple(n_seq, PS.S_BLOCK)
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
 
         # HBM budget covers the slot pool PLUS the in-flight prep tensors
@@ -122,6 +135,8 @@ class SpadeTPU:
         self.node_batch = nb
         self.scratch = n_items + pool_slots
         total = n_items + pool_slots + 1
+        if self.use_pallas:  # pair kernel reads item rows rounded to I_TILE
+            total = max(total, pad_to_multiple(n_items, PS.I_TILE))
 
         if mesh is None:
             # Scatter-build the store IN HBM from the ~KB-scale token table
@@ -260,15 +275,41 @@ class SpadeTPU:
 
     def _supports_dispatch(self, prep, ref: np.ndarray, item: np.ndarray,
                            iss: np.ndarray) -> jax.Array:
-        """Dispatch chunked support kernels; return ONE device array for the
-        whole batch with its host copy already in flight (the readback is
-        the expensive half on tunneled TPUs, so batches make exactly one)."""
+        """Dispatch the batch's support kernels; return ONE device array for
+        the whole batch with its host copy already in flight (the readback
+        is the expensive half on tunneled TPUs, so batches make exactly
+        one)."""
+        self.stats["candidates"] += len(ref)
+        if self.use_pallas:
+            # Pair matrix over (parent x ALL item rows) + on-device
+            # extraction; candidate count padded to pow2 buckets to bound
+            # recompilation.  A lowering/runtime failure downgrades to the
+            # jnp path for the rest of the mine (results are identical).
+            n = len(ref)
+            cap = max(1024, next_pow2(n))
+            pref = np.zeros(cap, np.int32)
+            itm = np.zeros(cap, np.int32)
+            pref[:n] = 2 * ref + iss
+            itm[:n] = item
+            try:
+                sup = PS.batch_supports(
+                    prep, self.store, self.n_items,
+                    jnp.asarray(pref), jnp.asarray(itm),
+                    interpret=self._pallas_interpret)
+                self.stats["kernel_launches"] += 1
+                try:
+                    sup.copy_to_host_async()
+                except Exception:
+                    pass
+                return sup
+            except Exception as exc:  # pragma: no cover - device-specific
+                self.use_pallas = False
+                self.stats["pallas_fallback"] = repr(exc)
         outs = []
         for _, _, (r, it, ss) in self._chunks(
                 ref.astype(np.int32), item.astype(np.int32), iss.astype(bool)):
             outs.append(self._supports_fn(prep, self.store, r, it, ss))
             self.stats["kernel_launches"] += 1
-        self.stats["candidates"] += len(ref)
         sup = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
         try:
             sup.copy_to_host_async()
@@ -365,8 +406,25 @@ class SpadeTPU:
         batch, prep, cand_item, cand_iss, spans, sup_dev = inflight
         minsup = self.minsup
         n_cand = spans[-1][2] if spans else 0
-        sups = (np.asarray(sup_dev)[:n_cand] if sup_dev is not None
-                else np.empty(0, np.int32))
+        if sup_dev is None:
+            sups = np.empty(0, np.int32)
+        else:
+            try:
+                sups = np.asarray(sup_dev)[:n_cand]
+            except Exception as exc:  # pragma: no cover - device-specific
+                # TPU kernel runtime faults surface at readback; downgrade
+                # to the jnp path and recount this batch.
+                if not self.use_pallas:
+                    raise
+                self.use_pallas = False
+                self.stats["pallas_fallback"] = repr(exc)
+                ref = np.empty(n_cand, np.int32)
+                for b_idx, (s_lo, _, i_hi) in enumerate(spans):
+                    ref[s_lo:i_hi] = b_idx
+                sup_dev = self._supports_dispatch(
+                    prep, ref, np.array(cand_item, np.int32),
+                    np.array(cand_iss, bool))
+                sups = np.asarray(sup_dev)[:n_cand]
 
         children: List[_Node] = []
         mat_ref: List[int] = []; mat_item: List[int] = []

@@ -1,0 +1,18 @@
+"""Service shell — the reference's orchestration layers, TPU-native host side.
+
+Recreates the observable contracts of the reference's L5/L6 stack
+(SURVEY.md sec 1: Spray REST API over an Akka actor system) without
+translating it: a stdlib HTTP front end over thread-based actor workers,
+an in-process Redis-compatible result/status store, pluggable sequence
+sources, and an algorithm plugin registry selected by the request's
+``algorithm`` parameter (the ``FSMActor``/``AlgorithmPlugin`` boundary
+named in BASELINE.json's north star).
+"""
+
+from spark_fsm_tpu.service.model import (  # noqa: F401
+    ServiceRequest,
+    ServiceResponse,
+    Status,
+)
+from spark_fsm_tpu.service.plugins import ALGORITHMS, AlgorithmPlugin  # noqa: F401
+from spark_fsm_tpu.service.store import ResultStore  # noqa: F401

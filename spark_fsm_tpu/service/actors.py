@@ -1,0 +1,193 @@
+"""Actor-style orchestration — the reference's L5 without Akka.
+
+``FSMMaster`` routes ``ServiceRequest``s to workers (SURVEY.md sec 1 L5,
+sec 3 call stacks): miner (train), questor (get), tracker (track),
+registrar (register/index), status.  Here the master is a plain router;
+the miner runs jobs on a worker thread (the mailbox is a queue — the
+actor model's useful property, serialized mutation, without a JVM), and
+supervision = per-job exception capture into the ``failure`` status, the
+reference's error contract.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import traceback
+from typing import Dict, Optional
+
+from spark_fsm_tpu.service import model, plugins, sources
+from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
+from spark_fsm_tpu.service.store import ResultStore
+
+
+class Miner:
+    """Train worker: source -> dataset -> plugin -> sink, with statuses.
+
+    Mirrors SURVEY.md sec 3.1: status 'started' -> build dataset ->
+    'dataset' -> mine -> sink patterns/rules -> 'trained' -> 'finished';
+    failures land in 'failure' with the error recorded (the supervision
+    contract of the reference's actor hierarchy).
+    """
+
+    def __init__(self, store: ResultStore, workers: int = 1) -> None:
+        self.store = store
+        self._q: "queue.Queue[Optional[ServiceRequest]]" = queue.Queue()
+        self._threads = [
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"fsm-miner-{i}")
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, req: ServiceRequest) -> None:
+        self.store.add_status(req.uid, Status.STARTED)
+        self._q.put(req)
+
+    def _loop(self) -> None:
+        while True:
+            req = self._q.get()
+            if req is None:
+                return
+            try:
+                self._run(req)
+            except Exception as exc:  # supervision: failure status + log
+                self.store.set(f"fsm:error:{req.uid}",
+                               f"{exc}\n{traceback.format_exc()}")
+                self.store.add_status(req.uid, Status.FAILURE)
+
+    def _run(self, req: ServiceRequest) -> None:
+        db = sources.get_db(req, self.store)
+        self.store.add_status(req.uid, Status.DATASET)
+        plugin = plugins.get_plugin(req)
+        results = plugin.extract(req, db)
+        if plugin.kind == "patterns":
+            self.store.add_patterns(req.uid, model.serialize_patterns(results))
+        else:
+            self.store.add_rules(req.uid, model.serialize_rules(results))
+        self.store.add_status(req.uid, Status.TRAINED)
+        self.store.add_status(req.uid, Status.FINISHED)
+
+    def shutdown(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
+
+
+class Questor:
+    """Query worker: serve mined patterns/rules from the store.
+
+    Supports the reference's rule-filtering queries for prediction
+    (SURVEY.md sec 3.2): 'antecedent'/'consequent' params restrict rules
+    to those whose side intersects the given items.
+    """
+
+    def __init__(self, store: ResultStore) -> None:
+        self.store = store
+
+    def handle(self, req: ServiceRequest, subject: str) -> ServiceResponse:
+        uid = req.uid
+        status = self.store.status(uid)
+        if status is None:
+            return model.response(req, Status.FAILURE, error="unknown uid")
+        if status != Status.FINISHED:
+            return model.response(req, status,
+                                  error="job not finished; results pending")
+        if subject == "patterns":
+            payload = self.store.patterns(uid)
+            if payload is None:
+                return model.response(req, Status.FAILURE, error="no patterns")
+            return model.response(req, Status.FINISHED, patterns=payload)
+        if subject == "rules":
+            payload = self.store.rules(uid)
+            if payload is None:
+                return model.response(req, Status.FAILURE, error="no rules")
+            rules = model.deserialize_rules(payload)
+            ante = req.param("antecedent")
+            cons = req.param("consequent")
+            if ante:
+                want = {int(i) for i in ante.split(",")}
+                rules = [r for r in rules if want & set(r[0])]
+            if cons:
+                want = {int(i) for i in cons.split(",")}
+                rules = [r for r in rules if want & set(r[1])]
+            return model.response(req, Status.FINISHED,
+                                  rules=model.serialize_rules(rules))
+        return model.response(req, Status.FAILURE,
+                              error=f"unknown subject {subject!r}")
+
+
+class Tracker:
+    """Ingest worker: /track events into the store (SURVEY.md sec 3.3)."""
+
+    REQUIRED = ("item",)
+
+    def __init__(self, store: ResultStore) -> None:
+        self.store = store
+
+    def handle(self, req: ServiceRequest, topic: str) -> ServiceResponse:
+        event = {k: v for k, v in req.data.items() if k != "uid"}
+        for field in self.REQUIRED:
+            if field not in event:
+                return model.response(req, Status.FAILURE,
+                                      error=f"missing field {field!r}")
+        self.store.track(topic, json.dumps(event))
+        return model.response(req, Status.FINISHED)
+
+
+class Registrar:
+    """Field-spec registration (SURVEY.md sec 3.4)."""
+
+    def __init__(self, store: ResultStore) -> None:
+        self.store = store
+
+    def handle(self, req: ServiceRequest, topic: str) -> ServiceResponse:
+        spec = {k: v for k, v in req.data.items() if k != "uid"}
+        self.store.add_fields(topic, json.dumps(spec))
+        return model.response(req, Status.FINISHED)
+
+
+class Master:
+    """Routes tasks to workers — the reference's FSMMaster."""
+
+    def __init__(self, store: Optional[ResultStore] = None,
+                 miner_workers: int = 1) -> None:
+        self.store = store if store is not None else ResultStore()
+        self.miner = Miner(self.store, workers=miner_workers)
+        self.questor = Questor(self.store)
+        self.tracker = Tracker(self.store)
+        self.registrar = Registrar(self.store)
+
+    def handle(self, req: ServiceRequest) -> ServiceResponse:
+        task, _, subject = req.task.partition(":")
+        if task == "train":
+            if not req.uid:
+                req.data["uid"] = ServiceRequest.fresh_uid()
+            try:  # validate algorithm/source names before going async
+                plugins.get_plugin(req)
+                src = (req.param("source") or "FILE").upper()
+                if src not in sources.SOURCES:
+                    raise ValueError(f"unknown source {src!r}")
+            except ValueError as exc:
+                return model.response(req, Status.FAILURE, error=str(exc))
+            self.miner.submit(req)
+            return model.response(req, Status.STARTED)
+        if task == "status":
+            status = self.store.status(req.uid)
+            if status is None:
+                return model.response(req, Status.FAILURE, error="unknown uid")
+            error = self.store.get(f"fsm:error:{req.uid}")
+            extra: Dict[str, str] = {"error": error} if error else {}
+            return model.response(req, status, **extra)
+        if task == "get":
+            return self.questor.handle(req, subject or "patterns")
+        if task == "track":
+            return self.tracker.handle(req, subject or "item")
+        if task in ("register", "index"):
+            return self.registrar.handle(req, subject or "item")
+        return model.response(req, Status.FAILURE,
+                              error=f"unknown task {req.task!r}")
+
+    def shutdown(self) -> None:
+        self.miner.shutdown()

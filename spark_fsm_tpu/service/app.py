@@ -1,0 +1,150 @@
+"""HTTP front end — the reference's REST surface (SURVEY.md sec 1 L6).
+
+Endpoints (POST, form- or JSON-encoded parameters):
+
+  /train              — start a mining job; returns uid + 'started'
+  /status/{uid}       — job lifecycle status (also /status?uid=...)
+  /get/patterns       — mined patterns for uid (when finished)
+  /get/rules          — mined rules, optional antecedent/consequent filter
+  /track/{topic}      — ingest one event for later TRACKED-source mining
+  /register/{topic}   — register a field spec
+  /index/{topic}      — alias of register (reference keeps both)
+  /admin/ping         — liveness; /admin/algorithms — plugin listing
+
+Runs on the stdlib ThreadingHTTPServer: the service layer is deliberately
+dependency-free; heavy lifting happens in the engines (device) behind the
+Miner worker thread.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qsl, urlsplit
+
+from spark_fsm_tpu.service import plugins
+from spark_fsm_tpu.service.actors import Master
+from spark_fsm_tpu.service.model import ServiceRequest
+
+
+def _parse_body(handler: BaseHTTPRequestHandler) -> dict:
+    length = int(handler.headers.get("Content-Length") or 0)
+    raw = handler.rfile.read(length) if length else b""
+    ctype = (handler.headers.get("Content-Type") or "").split(";")[0].strip()
+    if ctype == "application/json" and raw:
+        obj = json.loads(raw.decode("utf-8"))
+        if not isinstance(obj, dict):
+            raise ValueError("JSON body must be an object")
+        return {str(k): str(v) for k, v in obj.items()}
+    return {k: v for k, v in parse_qsl(raw.decode("utf-8"))}
+
+
+def _route(path: str) -> Tuple[str, str]:
+    parts = [p for p in path.split("/") if p]
+    head = parts[0] if parts else ""
+    tail = "/".join(parts[1:]) if len(parts) > 1 else ""
+    return head, tail
+
+
+class FsmHandler(BaseHTTPRequestHandler):
+    master: Master  # set by make_server
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet by default
+        pass
+
+    def _send(self, code: int, payload: str) -> None:
+        body = payload.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
+        try:
+            url = urlsplit(self.path)
+            head, tail = _route(url.path)
+            data = {k: v for k, v in parse_qsl(url.query)}
+            data.update(_parse_body(self))
+        except Exception as exc:
+            self._send(400, json.dumps({"status": "failure", "error": str(exc)}))
+            return
+
+        if head == "admin":
+            self._admin(tail)
+            return
+        if head not in ("train", "status", "get", "track", "register", "index"):
+            self._send(404, json.dumps({"status": "failure",
+                                        "error": f"unknown endpoint /{head}"}))
+            return
+        if head == "status" and tail and "uid" not in data:
+            data["uid"] = tail  # /status/{uid}
+        task = head if head in ("train", "status") else f"{head}:{tail}"
+        req = ServiceRequest(service="fsm", task=task, data=data)
+        try:
+            resp = self.master.handle(req)
+        except Exception as exc:  # worker bug -> failure envelope, not a
+            self._send(400, json.dumps({       # dropped connection
+                "service": "fsm", "task": task,
+                "data": {"uid": req.uid, "error": str(exc)},
+                "status": "failure"}))
+            return
+        self._send(200, resp.to_json())
+
+    def do_GET(self) -> None:  # noqa: N802
+        # GET convenience mirrors POST for read-only endpoints.
+        url = urlsplit(self.path)
+        head, _ = _route(url.path)
+        if head in ("status", "get", "admin"):
+            self.do_POST()
+        else:
+            self._send(405, json.dumps({"status": "failure",
+                                        "error": "use POST"}))
+
+    def _admin(self, task: str) -> None:
+        if task == "ping":
+            self._send(200, json.dumps({"status": "up"}))
+        elif task == "algorithms":
+            self._send(200, json.dumps(sorted(plugins.ALGORITHMS)))
+        else:
+            self._send(404, json.dumps({"status": "failure",
+                                        "error": f"unknown admin task {task!r}"}))
+
+
+def make_server(port: int = 0, host: str = "127.0.0.1",
+                master: Optional[Master] = None,
+                miner_workers: int = 1) -> ThreadingHTTPServer:
+    m = master if master is not None else Master(miner_workers=miner_workers)
+    handler = type("BoundFsmHandler", (FsmHandler,), {"master": m})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.master = m  # type: ignore[attr-defined]
+    return server
+
+
+def serve_background(port: int = 0) -> ThreadingHTTPServer:
+    """Start a server on a daemon thread; returns it (``server_port`` set)."""
+    server = make_server(port)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="fsm-http").start()
+    return server
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="spark_fsm_tpu service")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--miner-workers", type=int, default=1)
+    args = parser.parse_args()
+    server = make_server(args.port, args.host, miner_workers=args.miner_workers)
+    print(f"spark_fsm_tpu service on http://{args.host}:{server.server_port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.master.shutdown()  # type: ignore[attr-defined]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Algorithm plugin registry — the reference's L4 boundary.
+
+The reference selects the miner by the request's ``algorithm`` param
+through top-level plugin objects (``SPADE.extract``, ``TSR.extract`` —
+SURVEY.md sec 1 L4, sec 3.1).  The rebuild keeps exactly that seam (the
+``AlgorithmPlugin`` boundary named in BASELINE.json: ``algorithm=
+SPADE_TPU``) over the TPU engines and the CPU oracles:
+
+  SPADE      — CPU oracle miner (numpy bitmap DFS).
+  SPADE_TPU  — device engine (models/spade_tpu.py); honors maxgap /
+               maxwindow by switching to the constrained engine.
+  TSR        — CPU/bitmap top-k rule miner with device kernels off.
+  TSR_TPU    — device TSR engine (models/tsr.py).
+
+Each plugin returns (kind, results) where kind is "patterns" or "rules".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.data.vertical import abs_minsup
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.utils.canonical import PatternResult, RuleResult
+
+Results = Union[List[PatternResult], List[RuleResult]]
+
+
+@dataclasses.dataclass
+class AlgorithmPlugin:
+    name: str
+    kind: str  # "patterns" | "rules"
+    extract: Callable[[ServiceRequest, SequenceDB], Results]
+
+
+def _minsup(req: ServiceRequest, db: SequenceDB) -> int:
+    support = req.param("support")
+    if support is None:
+        raise ValueError("train request needs a 'support' parameter")
+    rel = float(support)
+    if rel >= 1.0:  # absolute count given directly
+        return int(rel)
+    return abs_minsup(rel, len(db))
+
+
+def _constraints(req: ServiceRequest) -> Tuple[Optional[int], Optional[int]]:
+    mg = req.param("maxgap")
+    mw = req.param("maxwindow")
+    return (int(mg) if mg is not None else None,
+            int(mw) if mw is not None else None)
+
+
+def _spade_cpu(req: ServiceRequest, db: SequenceDB) -> Results:
+    from spark_fsm_tpu.models.oracle import mine_cspade, mine_spade
+
+    minsup = _minsup(req, db)
+    maxgap, maxwindow = _constraints(req)
+    if maxgap is None and maxwindow is None:
+        return mine_spade(db, minsup)
+    return mine_cspade(db, minsup, maxgap=maxgap, maxwindow=maxwindow)
+
+
+def _spade_tpu(req: ServiceRequest, db: SequenceDB) -> Results:
+    from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+
+    minsup = _minsup(req, db)
+    maxgap, maxwindow = _constraints(req)
+    if maxgap is None and maxwindow is None:
+        return mine_spade_tpu(db, minsup)
+    return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow)
+
+
+def _tsr(req: ServiceRequest, db: SequenceDB) -> Results:
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    k = int(req.param("k", "100"))
+    minconf = float(req.param("minconf", "0.5"))
+    max_side = req.param("max_side")
+    return mine_tsr_tpu(db, k, minconf,
+                        max_side=int(max_side) if max_side else None)
+
+
+ALGORITHMS: Dict[str, AlgorithmPlugin] = {
+    "SPADE": AlgorithmPlugin("SPADE", "patterns", _spade_cpu),
+    "SPADE_TPU": AlgorithmPlugin("SPADE_TPU", "patterns", _spade_tpu),
+    "TSR": AlgorithmPlugin("TSR", "rules", _tsr),
+    "TSR_TPU": AlgorithmPlugin("TSR_TPU", "rules", _tsr),
+}
+
+
+def get_plugin(req: ServiceRequest) -> AlgorithmPlugin:
+    name = (req.param("algorithm") or "SPADE_TPU").upper()
+    if name not in ALGORITHMS:
+        raise ValueError(f"unknown algorithm {name!r} "
+                         f"(have {sorted(ALGORITHMS)})")
+    return ALGORITHMS[name]

@@ -1,0 +1,136 @@
+"""Sequence sources — the reference's pluggable L1/L2 data layer.
+
+The reference builds ``RDD[(Int, String)]`` sequence databases from
+Elasticsearch, JDBC, flat files, and Piwik (SURVEY.md sec 1 L1, sec 2
+"Sequence sources"); the rebuild keeps the same selection contract
+(``source`` request param) and SPMF line format but returns an in-memory
+``SequenceDB`` — device sharding happens downstream in the engines, which
+is this framework's analog of Spark partitioning (SURVEY.md sec 2.2).
+
+Registered sources:
+  FILE     — SPMF-format text file (``path`` param).
+  INLINE   — SPMF text embedded in the request (``data`` param's
+             ``sequences`` key); handy for tests and small jobs.
+  TRACKED  — events previously ingested via /track for a topic, grouped
+             into per-(site,user) sequences ordered by timestamp: the
+             reference's track->mine loop without an external store.
+  SYNTH    — seeded synthetic DB (no-egress stand-in for the public
+             benchmark datasets; see data/synth.py).
+  ELASTIC / JDBC / PIWIK — interface stubs: constructing them raises a
+             clear error in this sandbox (no network egress / no driver),
+             but the registry seam and parameter names match SURVEY.md.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Tuple
+
+from spark_fsm_tpu.data.spmf import SequenceDB, load_spmf, parse_spmf
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.store import ResultStore
+
+
+class SourceError(ValueError):
+    pass
+
+
+def file_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    path = req.param("path")
+    if not path:
+        raise SourceError("FILE source needs a 'path' parameter")
+    return load_spmf(path)
+
+
+def inline_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    text = req.param("sequences")
+    if text is None:
+        raise SourceError("INLINE source needs a 'sequences' parameter")
+    return parse_spmf(text)
+
+
+def tracked_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    """Group tracked events into sequences.
+
+    Events are JSON objects with the registered field roles: site, user,
+    timestamp, group (itemset id within a session), item.  Sequence key =
+    (site, user); itemsets group by 'group' (or timestamp when absent),
+    ordered by timestamp — the reference's field-spec semantics
+    (SURVEY.md sec 2 "Registrar / field spec").
+    """
+    topic = req.param("topic", "item")
+    events = store.tracked(topic)
+    if not events:
+        raise SourceError(f"no tracked events for topic {topic!r}")
+    sessions: Dict[Tuple[str, str], List[Tuple[int, int, int]]] = {}
+    for ev_json in events:
+        ev = json.loads(ev_json)
+        key = (str(ev.get("site", "")), str(ev.get("user", "")))
+        ts = int(ev.get("timestamp", 0))
+        group = int(ev.get("group", ts))
+        item = int(ev["item"])
+        sessions.setdefault(key, []).append((ts, group, item))
+    db: SequenceDB = []
+    for key in sorted(sessions):
+        rows = sorted(sessions[key])
+        itemsets: List[Tuple[int, ...]] = []
+        cur_group = None
+        cur: set = set()
+        for ts, group, item in rows:
+            if cur_group is None or group != cur_group:
+                if cur:
+                    itemsets.append(tuple(sorted(cur)))
+                cur = set()
+                cur_group = group
+            cur.add(item)
+        if cur:
+            itemsets.append(tuple(sorted(cur)))
+        if itemsets:
+            db.append(tuple(itemsets))
+    return db
+
+
+def synth_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    from spark_fsm_tpu.data import synth
+
+    name = req.param("dataset", "bms_webview1")
+    scale = float(req.param("scale", "0.01"))
+    gen = getattr(synth, f"{name}_like", None)
+    if gen is None:
+        raise SourceError(f"unknown synthetic dataset {name!r}")
+    return gen(scale=scale)
+
+
+def _stub(name: str, needs: str) -> Callable[[ServiceRequest, ResultStore], SequenceDB]:
+    def raise_stub(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+        raise SourceError(
+            f"{name} source is an interface stub in this build: {needs}. "
+            f"Use FILE/INLINE/TRACKED/SYNTH, or register a client via "
+            f"sources.register()."
+        )
+
+    return raise_stub
+
+
+SOURCES: Dict[str, Callable[[ServiceRequest, ResultStore], SequenceDB]] = {
+    "FILE": file_source,
+    "INLINE": inline_source,
+    "TRACKED": tracked_source,
+    "SYNTH": synth_source,
+    # reference parity: ElasticSource / JdbcSource / PiwikSource seams
+    "ELASTIC": _stub("ELASTIC", "requires an Elasticsearch endpoint"),
+    "JDBC": _stub("JDBC", "requires a JDBC-reachable database"),
+    "PIWIK": _stub("PIWIK", "requires a Piwik analytics database"),
+}
+
+
+def register(name: str,
+             fn: Callable[[ServiceRequest, ResultStore], SequenceDB]) -> None:
+    SOURCES[name.upper()] = fn
+
+
+def get_db(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    name = (req.param("source") or "FILE").upper()
+    if name not in SOURCES:
+        raise SourceError(f"unknown source {name!r}")
+    return SOURCES[name](req, store)

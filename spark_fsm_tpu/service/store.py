@@ -1,0 +1,133 @@
+"""Result/metadata store — the reference's RedisSink/RedisCache contract.
+
+The reference persists mined patterns/rules, job statuses, registered
+field specs, and tracked events in Redis (SURVEY.md sec 1 L1, sec 5
+checkpoint row: "the model IS the mined pattern/rule set persisted once at
+job end").  This module provides the same contract behind an interface
+with two implementations:
+
+- ``ResultStore``: in-process, thread-safe dict store (the default — no
+  external service needed, mirrors Redis key semantics).
+- ``RedisResultStore``: thin adapter over a real Redis client when the
+  ``redis`` package is importable (not bundled in this sandbox; the class
+  degrades to an ImportError at construction, keeping the seam visible).
+
+Key layout follows the reference's convention: ``fsm:status:<uid>``,
+``fsm:pattern:<uid>``, ``fsm:rule:<uid>``, ``fsm:fields:<topic>``,
+``fsm:track:<topic>``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class ResultStore:
+    """Thread-safe in-process store with Redis-like key semantics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._kv: Dict[str, str] = {}
+        self._lists: Dict[str, List[str]] = {}
+
+    # -- generic ops (Redis GET/SET/RPUSH/LRANGE equivalents) --------------
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def rpush(self, key: str, value: str) -> None:
+        with self._lock:
+            self._lists.setdefault(key, []).append(value)
+
+    def lrange(self, key: str) -> List[str]:
+        with self._lock:
+            return list(self._lists.get(key, []))
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+            self._lists.pop(key, None)
+
+    # -- job status registry (RedisCache.addStatus / status) ---------------
+
+    def add_status(self, uid: str, status: str) -> None:
+        ts = int(time.time() * 1000)
+        self.set(f"fsm:status:{uid}", status)
+        self.rpush(f"fsm:status:log:{uid}", f"{ts}:{status}")
+
+    def status(self, uid: str) -> Optional[str]:
+        return self.get(f"fsm:status:{uid}")
+
+    def status_log(self, uid: str) -> List[Tuple[int, str]]:
+        out = []
+        for entry in self.lrange(f"fsm:status:log:{uid}"):
+            ts, _, st = entry.partition(":")
+            out.append((int(ts), st))
+        return out
+
+    # -- mined results (RedisSink.addPatterns / addRules) ------------------
+
+    def add_patterns(self, uid: str, payload_json: str) -> None:
+        self.set(f"fsm:pattern:{uid}", payload_json)
+
+    def patterns(self, uid: str) -> Optional[str]:
+        return self.get(f"fsm:pattern:{uid}")
+
+    def add_rules(self, uid: str, payload_json: str) -> None:
+        self.set(f"fsm:rule:{uid}", payload_json)
+
+    def rules(self, uid: str) -> Optional[str]:
+        return self.get(f"fsm:rule:{uid}")
+
+    # -- field specs (FSMRegistrar / spec.Fields) --------------------------
+
+    def add_fields(self, topic: str, spec_json: str) -> None:
+        self.set(f"fsm:fields:{topic}", spec_json)
+
+    def fields(self, topic: str) -> Optional[str]:
+        return self.get(f"fsm:fields:{topic}")
+
+    # -- tracked events (FSMTracker ingest) --------------------------------
+
+    def track(self, topic: str, event_json: str) -> None:
+        self.rpush(f"fsm:track:{topic}", event_json)
+
+    def tracked(self, topic: str) -> List[str]:
+        return self.lrange(f"fsm:track:{topic}")
+
+
+class RedisResultStore(ResultStore):
+    """Adapter over a real Redis (optional dependency seam).
+
+    Cites the reference's RedisSink/RedisCache pair (SURVEY.md sec 2).
+    Raises ImportError at construction when the client library is absent;
+    every deployment in this sandbox uses the in-process store.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379) -> None:
+        super().__init__()
+        import redis  # not bundled: documented seam, exercised elsewhere
+
+        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+
+    def set(self, key: str, value: str) -> None:
+        self._r.set(key, value)
+
+    def get(self, key: str) -> Optional[str]:
+        return self._r.get(key)
+
+    def rpush(self, key: str, value: str) -> None:
+        self._r.rpush(key, value)
+
+    def lrange(self, key: str) -> List[str]:
+        return self._r.lrange(key, 0, -1)
+
+    def delete(self, key: str) -> None:
+        self._r.delete(key)

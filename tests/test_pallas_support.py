@@ -1,0 +1,67 @@
+"""Pallas pair-support kernel: interpret-mode parity with the numpy ops.
+
+The kernel itself is TPU-targeted; on the CPU test backend it runs through
+the Pallas interpreter, which exercises identical index/block logic
+(SURVEY.md sec 4: distributed/device tests without device hardware).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+from spark_fsm_tpu.ops import bitops_np as BN
+from spark_fsm_tpu.ops.pallas_support import (
+    I_TILE, P_TILE, S_BLOCK, batch_supports, pair_supports)
+from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+
+def _rand_words(rng, n, s):
+    # sparse-ish single-word bitmaps
+    return (rng.integers(0, 2**32, (n, s), dtype=np.uint32)
+            & rng.integers(0, 2**32, (n, s), dtype=np.uint32)
+            & rng.integers(0, 2**32, (n, s), dtype=np.uint32))
+
+
+def test_pair_supports_matches_numpy():
+    rng = np.random.default_rng(0)
+    P, NI, S = 2 * P_TILE, 21, S_BLOCK
+    pt = _rand_words(rng, P, S)
+    store = _rand_words(rng, I_TILE, S)
+    out = np.asarray(pair_supports(jnp.asarray(pt), jnp.asarray(store), NI,
+                                   interpret=True))
+    assert out.shape == (P, -(-NI // I_TILE) * I_TILE)
+    for p in range(P):
+        for i in range(NI):
+            want = int(np.count_nonzero(pt[p] & store[i]))
+            assert out[p, i] == want, (p, i, out[p, i], want)
+
+
+def test_batch_supports_extraction():
+    rng = np.random.default_rng(1)
+    P, S = P_TILE, 2 * S_BLOCK
+    pt = _rand_words(rng, P, S)[..., None]          # [P, S, 1] squeezed path
+    store = _rand_words(rng, I_TILE, S)[..., None]
+    pref = rng.integers(0, P, 50, dtype=np.int32)
+    item = rng.integers(0, 20, 50, dtype=np.int32)
+    sup = np.asarray(batch_supports(jnp.asarray(pt), jnp.asarray(store), 20,
+                                    jnp.asarray(pref), jnp.asarray(item),
+                                    interpret=True))
+    for k in range(50):
+        want = int(BN.support(pt[pref[k], :, :] & store[item[k], :, :]))
+        assert sup[k] == want
+
+
+def test_engine_pallas_parity_small():
+    db = synthetic_db(seed=7, n_sequences=260, n_items=14, mean_itemsets=4.0,
+                      mean_itemset_size=1.4)
+    minsup = abs_minsup(0.05, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    eng = SpadeTPU(vdb, minsup, use_pallas=True, node_batch=16,
+                   pool_bytes=64 << 20)
+    assert eng.use_pallas and eng.n_seq % S_BLOCK == 0
+    got = eng.mine()
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)

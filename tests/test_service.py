@@ -1,0 +1,173 @@
+"""Service lifecycle tests over the real HTTP surface.
+
+Mirrors SURVEY.md sec 3 call stacks: register -> track -> train -> status
+-> get, with the FILE and TRACKED sources, SPADE/TSR plugins, rule
+filtering, and failure supervision.  Runs on the CPU backend (conftest).
+"""
+
+import json
+import time
+import urllib.request
+import urllib.parse
+
+import pytest
+
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.service.app import serve_background
+from spark_fsm_tpu.service.model import deserialize_patterns, deserialize_rules
+from spark_fsm_tpu.utils.canonical import patterns_text, sort_patterns
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = serve_background()
+    yield srv
+    srv.master.shutdown()
+    srv.shutdown()
+
+
+def _post(server, endpoint, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{server.server_port}{endpoint}"
+    with urllib.request.urlopen(url, data=data, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _await_status(server, uid, want="finished", timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        resp = _post(server, f"/status/{uid}")
+        if resp["status"] == want:
+            return resp
+        if resp["status"] == "failure":
+            raise AssertionError(f"job failed: {resp}")
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {want}")
+
+
+def test_admin(server):
+    assert _post(server, "/admin/ping")["status"] == "up"
+    algos = _post(server, "/admin/algorithms")
+    assert {"SPADE", "SPADE_TPU", "TSR", "TSR_TPU"} <= set(algos)
+
+
+def test_train_get_file_source(server, tmp_path):
+    db = synthetic_db(seed=5, n_sequences=220, n_items=12, mean_itemsets=4.0)
+    path = tmp_path / "db.spmf"
+    path.write_text(format_spmf(db))
+
+    resp = _post(server, "/train", algorithm="SPADE_TPU", source="FILE",
+                 path=str(path), support="0.05")
+    assert resp["status"] == "started"
+    uid = resp["data"]["uid"]
+    _await_status(server, uid)
+
+    got = _post(server, "/get/patterns", uid=uid)
+    assert got["status"] == "finished"
+    patterns = deserialize_patterns(got["data"]["patterns"])
+    want = mine_spade(db, abs_minsup(0.05, len(db)))
+    assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+
+
+def test_train_inline_constrained(server):
+    db = synthetic_db(seed=6, n_sequences=150, n_items=10, mean_itemsets=5.0)
+    resp = _post(server, "/train", algorithm="SPADE_TPU", source="INLINE",
+                 sequences=format_spmf(db), support="0.05",
+                 maxgap="2", maxwindow="5")
+    uid = resp["data"]["uid"]
+    _await_status(server, uid)
+    got = _post(server, "/get/patterns", uid=uid)
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    want = mine_cspade(db, abs_minsup(0.05, len(db)), maxgap=2, maxwindow=5)
+    patterns = deserialize_patterns(got["data"]["patterns"])
+    assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+
+
+def test_track_register_mine_lifecycle(server):
+    # register a field spec, track a clickstream, mine the tracked topic
+    _post(server, "/register/clicks", site="s", user="u",
+          timestamp="t", item="i")
+    events = [
+        ("alice", 1, 3), ("alice", 2, 7), ("alice", 3, 3),
+        ("bob", 1, 3), ("bob", 2, 7), ("bob", 3, 9),
+        ("carol", 1, 3), ("carol", 2, 7),
+    ]
+    for user, ts, item in events:
+        r = _post(server, "/track/clicks", site="shop", user=user,
+                  timestamp=str(ts), item=str(item))
+        assert r["status"] == "finished"
+
+    resp = _post(server, "/train", algorithm="SPADE", source="TRACKED",
+                 topic="clicks", support="3")
+    uid = resp["data"]["uid"]
+    _await_status(server, uid)
+    got = _post(server, "/get/patterns", uid=uid)
+    patterns = deserialize_patterns(got["data"]["patterns"])
+    # <{3}>, <{7}>, <{3},{7}> occur in all 3 user sequences
+    as_set = {(pat, sup) for pat, sup in patterns}
+    assert (((3,),), 3) in as_set
+    assert (((7,),), 3) in as_set
+    assert (((3,), (7,)), 3) in as_set
+
+
+def test_tsr_rules_and_filtering(server):
+    db = synthetic_db(seed=8, n_sequences=120, n_items=8, mean_itemsets=4.0)
+    resp = _post(server, "/train", algorithm="TSR_TPU", source="INLINE",
+                 sequences=format_spmf(db), k="15", minconf="0.5",
+                 max_side="2")
+    uid = resp["data"]["uid"]
+    _await_status(server, uid)
+    got = _post(server, "/get/rules", uid=uid)
+    rules = deserialize_rules(got["data"]["rules"])
+    assert rules, "expected some rules"
+    some_item = rules[0][0][0]
+    filtered = _post(server, "/get/rules", uid=uid,
+                     antecedent=str(some_item))
+    frules = deserialize_rules(filtered["data"]["rules"])
+    assert frules and all(some_item in r[0] for r in frules)
+    assert len(frules) <= len(rules)
+
+
+def test_failure_supervision(server):
+    # unknown algorithm rejected synchronously
+    resp = _post(server, "/train", algorithm="NOPE", source="INLINE",
+                 sequences="1 -2", support="0.5")
+    assert resp["status"] == "failure" and "unknown algorithm" in resp["data"]["error"]
+
+    # bad source path fails asynchronously with status=failure + error
+    resp = _post(server, "/train", algorithm="SPADE", source="FILE",
+                 path="/nonexistent/file.spmf", support="0.5")
+    uid = resp["data"]["uid"]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = _post(server, f"/status/{uid}")
+        if st["status"] == "failure":
+            assert "error" in st["data"]
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("failure status never surfaced")
+
+    # stubbed source seams surface a clear error
+    resp = _post(server, "/train", algorithm="SPADE", source="ELASTIC",
+                 support="0.5")
+    uid = resp["data"]["uid"]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = _post(server, f"/status/{uid}")
+        if st["status"] == "failure":
+            assert "stub" in st["data"]["error"]
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("stub source failure never surfaced")
+
+
+def test_unknown_uid_and_pending(server):
+    resp = _post(server, "/status/deadbeef")
+    assert resp["status"] == "failure"
+    got = _post(server, "/get/patterns", uid="deadbeef")
+    assert got["status"] == "failure"
