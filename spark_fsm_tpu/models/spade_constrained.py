@@ -270,8 +270,8 @@ class ConstrainedSpadeTPU:
         sup = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
         try:
             sup.copy_to_host_async()
-        except Exception:
-            pass
+        except (AttributeError, NotImplementedError):
+            pass  # method unavailable on this backend
         return sup
 
     # ---------------------------------------------------------------- mine

@@ -274,12 +274,16 @@ class SpadeTPU:
             )
 
     def _supports_dispatch(self, prep, ref: np.ndarray, item: np.ndarray,
-                           iss: np.ndarray) -> jax.Array:
-        """Dispatch the batch's support kernels; return ONE device array for
-        the whole batch with its host copy already in flight (the readback
-        is the expensive half on tunneled TPUs, so batches make exactly
-        one)."""
-        self.stats["candidates"] += len(ref)
+                           iss: np.ndarray, *, count: bool = True):
+        """Dispatch the batch's support kernels; return ``(sup, was_pallas)``
+        — ONE device array for the whole batch with its host copy already in
+        flight (the readback is the expensive half on tunneled TPUs, so
+        batches make exactly one), plus which path produced it, so a
+        pipelined resolve can recount exactly the Pallas-produced batches
+        after a kernel fault downgrade.  ``count=False`` skips the candidate
+        counter on fallback recounts of the same candidates."""
+        if count:
+            self.stats["candidates"] += len(ref)
         if self.use_pallas:
             # Pair matrix over (parent x ALL item rows) + on-device
             # extraction; candidate count padded to pow2 buckets to bound
@@ -299,9 +303,9 @@ class SpadeTPU:
                 self.stats["kernel_launches"] += 1
                 try:
                     sup.copy_to_host_async()
-                except Exception:
-                    pass
-                return sup
+                except (AttributeError, NotImplementedError):
+                    pass  # method unavailable on this backend
+                return sup, True
             except Exception as exc:  # pragma: no cover - device-specific
                 self.use_pallas = False
                 self.stats["pallas_fallback"] = repr(exc)
@@ -313,9 +317,9 @@ class SpadeTPU:
         sup = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
         try:
             sup.copy_to_host_async()
-        except Exception:
-            pass
-        return sup
+        except (AttributeError, NotImplementedError):
+            pass  # method unavailable on this backend
+        return sup, False
 
     def _materialize(self, prep, ref, item, iss, out_slot) -> None:
         for _, _, (r, it, ss, os) in self._chunks(
@@ -393,17 +397,18 @@ class SpadeTPU:
                 cand_ref.append(b_idx); cand_item.append(i); cand_iss.append(False)
             spans.append((s_lo, s_hi, len(cand_ref)))
 
-        sup_dev = (self._supports_dispatch(prep, np.array(cand_ref, np.int32),
-                                           np.array(cand_item, np.int32),
-                                           np.array(cand_iss, bool))
-                   if cand_ref else None)
-        return batch, prep, cand_item, cand_iss, spans, sup_dev
+        sup_dev, was_pallas = (
+            self._supports_dispatch(prep, np.array(cand_ref, np.int32),
+                                    np.array(cand_item, np.int32),
+                                    np.array(cand_iss, bool))
+            if cand_ref else (None, False))
+        return batch, prep, cand_item, cand_iss, spans, sup_dev, was_pallas
 
     def _resolve(self, inflight, stack: List[_Node],
                  results: List[PatternResult]) -> None:
         """Wait for a dispatched batch's supports; prune, materialize
         surviving children, push them on the DFS stack."""
-        batch, prep, cand_item, cand_iss, spans, sup_dev = inflight
+        batch, prep, cand_item, cand_iss, spans, sup_dev, was_pallas = inflight
         minsup = self.minsup
         n_cand = spans[-1][2] if spans else 0
         if sup_dev is None:
@@ -413,17 +418,20 @@ class SpadeTPU:
                 sups = np.asarray(sup_dev)[:n_cand]
             except Exception as exc:  # pragma: no cover - device-specific
                 # TPU kernel runtime faults surface at readback; downgrade
-                # to the jnp path and recount this batch.
-                if not self.use_pallas:
+                # to the jnp path and recount this batch.  Gate on THIS
+                # batch's dispatch path, not the mutable self.use_pallas:
+                # with pipeline_depth>1 several Pallas batches are in flight
+                # when the first fault lands, and each must be recounted.
+                if not was_pallas:
                     raise
                 self.use_pallas = False
                 self.stats["pallas_fallback"] = repr(exc)
                 ref = np.empty(n_cand, np.int32)
                 for b_idx, (s_lo, _, i_hi) in enumerate(spans):
                     ref[s_lo:i_hi] = b_idx
-                sup_dev = self._supports_dispatch(
+                sup_dev, _ = self._supports_dispatch(
                     prep, ref, np.array(cand_item, np.int32),
-                    np.array(cand_iss, bool))
+                    np.array(cand_iss, bool), count=False)
                 sups = np.asarray(sup_dev)[:n_cand]
 
         children: List[_Node] = []

@@ -232,8 +232,8 @@ class TsrTPU:
         supx_all = supx_parts[0] if len(supx_parts) == 1 else jnp.concatenate(supx_parts)
         try:
             sup_all.copy_to_host_async(); supx_all.copy_to_host_async()
-        except Exception:
-            pass
+        except (AttributeError, NotImplementedError):
+            pass  # method unavailable on this backend
         return (np.asarray(sup_all)[:n].astype(np.int64),
                 np.asarray(supx_all)[:n].astype(np.int64))
 

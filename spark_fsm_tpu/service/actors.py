@@ -43,6 +43,10 @@ class Miner:
             t.start()
 
     def submit(self, req: ServiceRequest) -> None:
+        # A client-supplied uid may collide with a finished/failed job;
+        # clear its stale error and results so /status and /get reflect
+        # THIS job, not the previous one's leftovers.
+        self.store.clear_job(req.uid)
         self.store.add_status(req.uid, Status.STARTED)
         self._q.put(req)
 
@@ -51,6 +55,11 @@ class Miner:
             req = self._q.get()
             if req is None:
                 return
+            # Clear again at run start: with a reused uid, an EARLIER job
+            # with the same uid may have written its error/results after
+            # submit()'s clear (it was still queued/running then).  The
+            # last job to *start* owns the uid's keys from here on.
+            self.store.clear_job(req.uid, keep_status_log=True)
             try:
                 self._run(req)
             except Exception as exc:  # supervision: failure status + log
@@ -119,19 +128,22 @@ class Questor:
 
 
 class Tracker:
-    """Ingest worker: /track events into the store (SURVEY.md sec 3.3)."""
+    """Ingest worker: /track events into the store (SURVEY.md sec 3.3).
 
-    REQUIRED = ("item",)
+    Validation honors the topic's registered field spec: the required
+    'item' role may live under any event field name the spec maps it to.
+    """
 
     def __init__(self, store: ResultStore) -> None:
         self.store = store
 
     def handle(self, req: ServiceRequest, topic: str) -> ServiceResponse:
         event = {k: v for k, v in req.data.items() if k != "uid"}
-        for field in self.REQUIRED:
-            if field not in event:
-                return model.response(req, Status.FAILURE,
-                                      error=f"missing field {field!r}")
+        item_field = sources.field_map(self.store, topic)["item"]
+        if item_field not in event:
+            return model.response(req, Status.FAILURE,
+                                  error=f"missing field {item_field!r} "
+                                        f"(the registered 'item' role)")
         self.store.track(topic, json.dumps(event))
         return model.response(req, Status.FINISHED)
 

@@ -49,42 +49,69 @@ def inline_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     return parse_spmf(text)
 
 
+ROLES = ("site", "user", "timestamp", "group", "item")
+
+
+def field_map(store: ResultStore, topic: str) -> Dict[str, str]:
+    """role -> event-field-name mapping for a topic.
+
+    The reference's register step exists precisely to map *arbitrary*
+    source fields onto the site/user/timestamp/group/item roles (SURVEY.md
+    sec 2 "Registrar / field spec", sec 3.4).  A registered spec for the
+    topic (``/register``, stored as ``fsm:fields:<topic>``) supplies the
+    mapping; unregistered roles default to their own name.
+    """
+    mapping = {r: r for r in ROLES}
+    spec_json = store.fields(topic)
+    if spec_json:
+        try:
+            spec = json.loads(spec_json)
+        except ValueError:
+            spec = {}
+        for role in ROLES:
+            name = spec.get(role)
+            if isinstance(name, str) and name:
+                mapping[role] = name
+    return mapping
+
+
 def tracked_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     """Group tracked events into sequences.
 
-    Events are JSON objects with the registered field roles: site, user,
-    timestamp, group (itemset id within a session), item.  Sequence key =
-    (site, user); itemsets group by 'group' (or timestamp when absent),
-    ordered by timestamp — the reference's field-spec semantics
-    (SURVEY.md sec 2 "Registrar / field spec").
+    Events are JSON objects; the registered field spec for the topic maps
+    the site/user/timestamp/group/item roles onto the event's field names
+    (see ``field_map``).  Sequence key = (site, user); each distinct group
+    id forms ONE itemset (even if its rows interleave in time with other
+    groups), and itemsets are ordered by the group's first timestamp —
+    the reference's field-spec semantics (SURVEY.md sec 2 "Registrar /
+    field spec").
     """
     topic = req.param("topic", "item")
     events = store.tracked(topic)
     if not events:
         raise SourceError(f"no tracked events for topic {topic!r}")
-    sessions: Dict[Tuple[str, str], List[Tuple[int, int, int]]] = {}
+    fm = field_map(store, topic)
+    sessions: Dict[Tuple[str, str], Dict[int, List[Tuple[int, int]]]] = {}
     for ev_json in events:
         ev = json.loads(ev_json)
-        key = (str(ev.get("site", "")), str(ev.get("user", "")))
-        ts = int(ev.get("timestamp", 0))
-        group = int(ev.get("group", ts))
-        item = int(ev["item"])
-        sessions.setdefault(key, []).append((ts, group, item))
+        key = (str(ev.get(fm["site"], "")), str(ev.get(fm["user"], "")))
+        ts = int(ev.get(fm["timestamp"], 0))
+        group = int(ev.get(fm["group"], ts))
+        if fm["item"] not in ev:
+            # spec registered/changed after this event was tracked
+            raise SourceError(
+                f"tracked event for topic {topic!r} has no field "
+                f"{fm['item']!r} (the registered 'item' role); event keys: "
+                f"{sorted(ev)} — re-track or fix the /register spec")
+        item = int(ev[fm["item"]])
+        sessions.setdefault(key, {}).setdefault(group, []).append((ts, item))
     db: SequenceDB = []
     for key in sorted(sessions):
-        rows = sorted(sessions[key])
-        itemsets: List[Tuple[int, ...]] = []
-        cur_group = None
-        cur: set = set()
-        for ts, group, item in rows:
-            if cur_group is None or group != cur_group:
-                if cur:
-                    itemsets.append(tuple(sorted(cur)))
-                cur = set()
-                cur_group = group
-            cur.add(item)
-        if cur:
-            itemsets.append(tuple(sorted(cur)))
+        groups = sessions[key]
+        # itemset order = (first timestamp of the group, group id)
+        order = sorted(groups, key=lambda g: (min(ts for ts, _ in groups[g]), g))
+        itemsets = [tuple(sorted({item for _, item in groups[g]}))
+                    for g in order]
         if itemsets:
             db.append(tuple(itemsets))
     return db

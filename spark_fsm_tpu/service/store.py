@@ -55,6 +55,15 @@ class ResultStore:
             self._kv.pop(key, None)
             self._lists.pop(key, None)
 
+    def clear_job(self, uid: str, *, keep_status_log: bool = False) -> None:
+        """Remove a job's error/results (and optionally its status log) so a
+        reused uid reports THIS job, not a predecessor's leftovers."""
+        keys = [f"fsm:error:{uid}", f"fsm:pattern:{uid}", f"fsm:rule:{uid}"]
+        if not keep_status_log:
+            keys.append(f"fsm:status:log:{uid}")
+        for key in keys:
+            self.delete(key)
+
     # -- job status registry (RedisCache.addStatus / status) ---------------
 
     def add_status(self, uid: str, status: str) -> None:

@@ -87,9 +87,10 @@ def test_train_inline_constrained(server):
 
 
 def test_track_register_mine_lifecycle(server):
-    # register a field spec, track a clickstream, mine the tracked topic
-    _post(server, "/register/clicks", site="s", user="u",
-          timestamp="t", item="i")
+    # register a field spec (identity mapping), track a clickstream, mine
+    # the tracked topic
+    _post(server, "/register/clicks", site="site", user="user",
+          timestamp="timestamp", item="item")
     events = [
         ("alice", 1, 3), ("alice", 2, 7), ("alice", 3, 3),
         ("bob", 1, 3), ("bob", 2, 7), ("bob", 3, 9),
@@ -111,6 +112,79 @@ def test_track_register_mine_lifecycle(server):
     assert (((3,),), 3) in as_set
     assert (((7,),), 3) in as_set
     assert (((3,), (7,)), 3) in as_set
+
+
+def test_register_maps_arbitrary_field_names(server):
+    # the registered spec maps roles onto NON-default event field names;
+    # tracking and mining must consult it (SURVEY.md sec 2 Registrar row)
+    _post(server, "/register/weblog", site="domain", user="visitor",
+          timestamp="at", group="session", item="sku")
+
+    # item role lives under 'sku' — an event missing it is rejected
+    r = _post(server, "/track/weblog", domain="shop", visitor="x",
+              at="1", session="1", other="y")
+    assert r["status"] == "failure" and "sku" in r["data"]["error"]
+
+    events = [
+        ("ann", 1, 1, 3), ("ann", 2, 2, 7),
+        ("ben", 1, 1, 3), ("ben", 2, 2, 7),
+    ]
+    for visitor, at, session, sku in events:
+        r = _post(server, "/track/weblog", domain="shop", visitor=visitor,
+                  at=str(at), session=str(session), sku=str(sku))
+        assert r["status"] == "finished"
+
+    resp = _post(server, "/train", algorithm="SPADE", source="TRACKED",
+                 topic="weblog", support="2")
+    uid = resp["data"]["uid"]
+    _await_status(server, uid)
+    got = _post(server, "/get/patterns", uid=uid)
+    as_set = {(pat, sup) for pat, sup in
+              deserialize_patterns(got["data"]["patterns"])}
+    assert (((3,), (7,)), 2) in as_set
+
+
+def test_tracked_groups_not_time_contiguous(server):
+    # two groups interleaved in time still form exactly two itemsets,
+    # ordered by each group's first timestamp (ADVICE round-1 finding)
+    for at, session, sku in [(1, 10, 5), (2, 20, 6), (3, 10, 7), (4, 20, 8)]:
+        _post(server, "/track/interleave", site="s", user="u",
+              timestamp=str(at), group=str(session), item=str(sku))
+    resp = _post(server, "/train", algorithm="SPADE", source="TRACKED",
+                 topic="interleave", support="1")
+    uid = resp["data"]["uid"]
+    _await_status(server, uid)
+    got = _post(server, "/get/patterns", uid=uid)
+    as_set = {(pat, sup) for pat, sup in
+              deserialize_patterns(got["data"]["patterns"])}
+    # group 10 = {5,7} (first ts 1), group 20 = {6,8} (first ts 2)
+    assert (((5, 7), (6, 8)), 1) in as_set
+    assert (((5, 6),), 1) not in as_set  # no cross-group itemset
+
+
+def test_uid_reuse_clears_stale_error(server):
+    # a failed job leaves an error; re-training with the SAME uid must not
+    # report the stale error once the new job finishes (ADVICE finding)
+    uid = "reuse-me"
+    resp = _post(server, "/train", uid=uid, algorithm="SPADE", source="FILE",
+                 path="/nonexistent/file.spmf", support="0.5")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = _post(server, f"/status/{uid}")
+        if st["status"] == "failure":
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("failure status never surfaced")
+
+    resp = _post(server, "/train", uid=uid, algorithm="SPADE",
+                 source="INLINE", sequences="1 -1 2 -2\n1 -1 2 -2",
+                 support="2")
+    assert resp["data"]["uid"] == uid
+    st = _await_status(server, uid)
+    assert "error" not in st["data"], f"stale error leaked: {st}"
+    got = _post(server, "/get/patterns", uid=uid)
+    assert got["status"] == "finished"
 
 
 def test_tsr_rules_and_filtering(server):
