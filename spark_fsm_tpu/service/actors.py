@@ -160,6 +160,119 @@ class Registrar:
         return model.response(req, Status.FINISHED)
 
 
+class Streamer:
+    """Streaming micro-batch worker (SURVEY.md sec 2.5, eval config #5).
+
+    Each topic owns a sliding window of sequence micro-batches.  A push
+    (``/stream/{topic}`` with an SPMF micro-batch in ``sequences``)
+    appends the batch, evicts expired ones, and re-mines the window
+    through the SAME AlgorithmPlugin boundary as batch train jobs — so
+    SPADE/SPADE_TPU (with or without maxgap/maxwindow) and TSR all work
+    incrementally.  Results land in the store under uid
+    ``stream:{topic}`` with a ``finished`` status, so ``/get/patterns``
+    (or ``/get/rules``) serves the window's current result set exactly
+    like a batch job's.
+
+    Window config (``support``, ``algorithm``, ``max_batches``,
+    ``max_sequences``, constraints) is fixed by the first push to the
+    topic; later pushes may omit it.  Relative ``support`` is recomputed
+    against the *current* window size on every push.
+    """
+
+    def __init__(self, store: ResultStore) -> None:
+        self.store = store
+        self._lock = threading.Lock()
+        self._topics: Dict[str, dict] = {}
+
+    def _topic_state(self, req: ServiceRequest, topic: str) -> dict:
+        from spark_fsm_tpu.streaming.window import WindowMiner
+
+        with self._lock:
+            state = self._topics.get(topic)
+            if state is None:
+                mb = req.param("max_batches")
+                ms = req.param("max_sequences")
+                if mb is None and ms is None:
+                    mb = "4"
+                # the cached base request keeps only mining params — never
+                # the first micro-batch's payload
+                data = {k: v for k, v in req.data.items()
+                        if k not in ("sequences", "uid")}
+                data.setdefault("algorithm", "SPADE_TPU")
+                data.setdefault("support", "0.1")
+                base = ServiceRequest(req.service, req.task, data)
+                # Validate the WHOLE config before caching: a bad first
+                # push must not poison the topic forever.
+                plugin = plugins.get_plugin(base)
+                support = float(data["support"])
+                for p in ("maxgap", "maxwindow", "k", "max_side"):
+                    if base.param(p) is not None:
+                        int(base.param(p))
+                if base.param("minconf") is not None:
+                    float(base.param("minconf"))
+
+                def plugin_mine(db, minsup_abs, _plugin=plugin, _base=base):
+                    # WindowMiner computes the window-relative absolute
+                    # minsup; hand it to the plugin as an absolute count
+                    # (plugins._minsup treats support >= 1 as absolute).
+                    d = dict(_base.data)
+                    d["support"] = str(int(minsup_abs))
+                    return _plugin.extract(
+                        ServiceRequest(_base.service, _base.task, d), db)
+
+                state = {
+                    "miner": WindowMiner(
+                        support,
+                        max_batches=int(mb) if mb is not None else None,
+                        max_sequences=int(ms) if ms is not None else None,
+                        mine=plugin_mine),
+                    "kind": plugin.kind,
+                }
+                self._topics[topic] = state
+            return state
+
+    def handle(self, req: ServiceRequest, topic: str) -> ServiceResponse:
+        from spark_fsm_tpu.data.spmf import parse_spmf
+
+        if not topic:
+            return model.response(req, Status.FAILURE,
+                                  error="stream needs a topic: /stream/{topic}")
+        text = req.param("sequences")
+        if text is None:
+            return model.response(req, Status.FAILURE,
+                                  error="stream push needs a 'sequences' "
+                                        "parameter (SPMF micro-batch)")
+        try:
+            batch = parse_spmf(text)
+            if not batch:
+                raise ValueError("empty micro-batch: 'sequences' parsed to "
+                                 "zero sequences")
+            state = self._topic_state(req, topic)
+        except ValueError as exc:
+            return model.response(req, Status.FAILURE, error=str(exc))
+        uid = f"stream:{topic}"
+        miner = state["miner"]
+        try:
+            results = miner.push(batch)
+            if state["kind"] == "patterns":
+                self.store.add_patterns(uid, model.serialize_patterns(results))
+            else:
+                self.store.add_rules(uid, model.serialize_rules(results))
+            self.store.add_status(uid, Status.FINISHED)
+        except Exception as exc:
+            self.store.set(f"fsm:error:{uid}",
+                           f"{exc}\n{traceback.format_exc()}")
+            self.store.add_status(uid, Status.FAILURE)
+            return model.response(req, Status.FAILURE, error=str(exc))
+        window = miner.window
+        return model.response(
+            req, Status.FINISHED, uid=uid,
+            window_batches=str(window.n_batches),
+            window_sequences=str(window.n_sequences),
+            evicted_batches=str(miner.stats["evicted_batches"]),
+            results=str(len(results)))
+
+
 class Master:
     """Routes tasks to workers — the reference's FSMMaster."""
 
@@ -170,6 +283,7 @@ class Master:
         self.questor = Questor(self.store)
         self.tracker = Tracker(self.store)
         self.registrar = Registrar(self.store)
+        self.streamer = Streamer(self.store)
 
     def handle(self, req: ServiceRequest) -> ServiceResponse:
         task, _, subject = req.task.partition(":")
@@ -196,6 +310,8 @@ class Master:
             return self.questor.handle(req, subject or "patterns")
         if task == "track":
             return self.tracker.handle(req, subject or "item")
+        if task == "stream":
+            return self.streamer.handle(req, subject)
         if task in ("register", "index"):
             return self.registrar.handle(req, subject or "item")
         return model.response(req, Status.FAILURE,

@@ -7,6 +7,9 @@ Endpoints (POST, form- or JSON-encoded parameters):
   /get/patterns       — mined patterns for uid (when finished)
   /get/rules          — mined rules, optional antecedent/consequent filter
   /track/{topic}      — ingest one event for later TRACKED-source mining
+  /stream/{topic}     — push an SPMF micro-batch into the topic's sliding
+                        window; the window is re-mined and results served
+                        under uid "stream:{topic}" (eval config #5)
   /register/{topic}   — register a field spec
   /index/{topic}      — alias of register (reference keeps both)
   /admin/ping         — liveness; /admin/algorithms — plugin listing
@@ -76,7 +79,8 @@ class FsmHandler(BaseHTTPRequestHandler):
         if head == "admin":
             self._admin(tail)
             return
-        if head not in ("train", "status", "get", "track", "register", "index"):
+        if head not in ("train", "status", "get", "track", "register",
+                        "index", "stream"):
             self._send(404, json.dumps({"status": "failure",
                                         "error": f"unknown endpoint /{head}"}))
             return

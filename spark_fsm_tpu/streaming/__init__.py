@@ -1,0 +1,12 @@
+"""Streaming / incremental mining (SURVEY.md sec 2.5, eval config #5).
+
+The reference ecosystem feeds micro-batches (Kafka) into a sliding-window
+sequence database and keeps the mined pattern set current.  This package
+provides the TPU-native equivalent: a window of sequence micro-batches with
+count-based eviction, re-mined per push (re-mining the window is the
+survey-sanctioned baseline; windows are small relative to the batch path).
+"""
+
+from spark_fsm_tpu.streaming.window import SlidingWindow, WindowMiner
+
+__all__ = ["SlidingWindow", "WindowMiner"]

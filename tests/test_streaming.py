@@ -1,0 +1,173 @@
+"""Streaming incremental SPADE (SURVEY.md sec 2.5, eval config #5).
+
+The binding property: after EVERY micro-batch push, the window's mined
+pattern set is byte-identical to a fresh oracle mine of exactly the
+window's sequences — the stream changes when mining happens, never what
+is mined.
+"""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.streaming.window import SlidingWindow, WindowMiner
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+
+def _batches(seed, n, size, n_items=10):
+    db = synthetic_db(seed=seed, n_sequences=n * size, n_items=n_items,
+                      mean_itemsets=4.0)
+    return [db[i * size:(i + 1) * size] for i in range(n)]
+
+
+# ---------------------------------------------------------------- window
+
+
+def test_window_count_eviction():
+    w = SlidingWindow(max_batches=2)
+    b1, b2, b3 = _batches(seed=1, n=3, size=5)
+    assert w.push(b1) == 0 and w.n_sequences == 5
+    assert w.push(b2) == 0 and w.n_sequences == 10
+    assert w.push(b3) == 1  # b1 evicted
+    assert w.n_batches == 2 and w.n_sequences == 10
+    assert w.sequences() == list(b2) + list(b3)
+    assert w.evicted_batches == 1
+
+
+def test_window_sequence_cap_eviction():
+    w = SlidingWindow(max_sequences=12)
+    b1, b2, b3 = _batches(seed=2, n=3, size=5)
+    w.push(b1); w.push(b2)
+    assert w.n_sequences == 10  # under cap, nothing evicted
+    w.push(b3)
+    assert w.n_sequences == 10 and w.n_batches == 2  # b1 evicted
+    # a single oversized batch is kept (eviction never empties the window)
+    w2 = SlidingWindow(max_sequences=3)
+    w2.push(b1)
+    assert w2.n_batches == 1 and w2.n_sequences == 5
+
+
+def test_window_item_supports_match_rescan():
+    w = SlidingWindow(max_batches=2)
+    for b in _batches(seed=3, n=3, size=8):
+        w.push(b)
+        got = w.item_supports()
+        want = {}
+        for seq in w.sequences():
+            for it in {i for s in seq for i in s}:
+                want[it] = want.get(it, 0) + 1
+        assert dict(got) == want
+
+
+# ------------------------------------------------------- incremental mine
+
+
+@pytest.mark.parametrize("rel_support", [0.2, 3.0])
+def test_window_miner_parity_over_batches(rel_support):
+    """Each of 4 pushes (with eviction after the 2nd) mines a pattern set
+    byte-identical to a fresh oracle mine of the window's sequences."""
+    miner = WindowMiner(rel_support, max_batches=2)
+    for b in _batches(seed=4, n=4, size=20):
+        got = miner.push(b)
+        seqs = miner.window.sequences()
+        minsup = (int(rel_support) if rel_support >= 1
+                  else abs_minsup(rel_support, len(seqs)))
+        want = mine_spade(seqs, minsup)
+        assert patterns_text(got) == patterns_text(want)
+    assert miner.window.evicted_batches == 2
+    assert miner.stats["mines"] == 4
+
+
+def test_window_miner_minsup_tracks_window_size():
+    miner = WindowMiner(0.5, max_batches=3)
+    miner.push(_batches(seed=5, n=1, size=10)[0])
+    assert miner.minsup_abs() == 5
+    miner.push(_batches(seed=6, n=1, size=30)[0])
+    assert miner.minsup_abs() == 20  # 0.5 * 40
+
+
+# ---------------------------------------------------------------- service
+
+
+@pytest.fixture(scope="module")
+def server():
+    from spark_fsm_tpu.service.app import serve_background
+
+    srv = serve_background()
+    yield srv
+    srv.master.shutdown()
+    srv.shutdown()
+
+
+def _post(server, endpoint, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{server.server_port}{endpoint}"
+    with urllib.request.urlopen(url, data=data, timeout=60) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_stream_service_lifecycle(server):
+    from spark_fsm_tpu.service.model import deserialize_patterns
+    from spark_fsm_tpu.utils.canonical import sort_patterns
+
+    batches = _batches(seed=7, n=3, size=15)
+    window = []
+    for i, b in enumerate(batches):
+        resp = _post(server, "/stream/clickwin", sequences=format_spmf(b),
+                     support="0.2", max_batches="2", algorithm="SPADE_TPU")
+        assert resp["status"] == "finished", resp
+        window = (window + [b])[-2:]
+        seqs = [s for bb in window for s in bb]
+        assert resp["data"]["window_sequences"] == str(len(seqs))
+        got = _post(server, "/get/patterns", uid="stream:clickwin")
+        assert got["status"] == "finished"
+        patterns = deserialize_patterns(got["data"]["patterns"])
+        want = mine_spade(seqs, abs_minsup(0.2, len(seqs)))
+        assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+    # third push evicted the first batch
+    assert resp["data"]["evicted_batches"] == "1"
+
+
+def test_stream_constrained_and_rules(server):
+    # constrained SPADE over a sliding window
+    batches = _batches(seed=8, n=2, size=20)
+    for b in batches:
+        resp = _post(server, "/stream/cwin", sequences=format_spmf(b),
+                     support="0.2", maxgap="2", max_batches="2",
+                     algorithm="SPADE_TPU")
+        assert resp["status"] == "finished", resp
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    from spark_fsm_tpu.service.model import deserialize_patterns
+    from spark_fsm_tpu.utils.canonical import sort_patterns
+
+    seqs = [s for b in batches for s in b]
+    got = _post(server, "/get/patterns", uid="stream:cwin")
+    patterns = deserialize_patterns(got["data"]["patterns"])
+    want = mine_cspade(seqs, abs_minsup(0.2, len(seqs)), maxgap=2)
+    assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+
+    # TSR rules over a sliding window reuse the same seam
+    resp = _post(server, "/stream/rulewin", sequences=format_spmf(batches[0]),
+                 algorithm="TSR_TPU", k="10", minconf="0.5", max_side="2")
+    assert resp["status"] == "finished", resp
+    got = _post(server, "/get/rules", uid="stream:rulewin")
+    assert got["status"] == "finished"
+    assert json.loads(got["data"]["rules"])
+
+
+def test_stream_errors(server):
+    resp = _post(server, "/stream/", sequences="1 -2")
+    assert resp["status"] == "failure"
+    resp = _post(server, "/stream/nobatch")
+    assert resp["status"] == "failure"
+    assert "sequences" in resp["data"]["error"]
+    resp = _post(server, "/stream/badalgo", sequences="1 -2",
+                 algorithm="NOPE")
+    assert resp["status"] == "failure"
