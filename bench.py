@@ -3,20 +3,30 @@
 
 Workload (BASELINE.md north star): SPADE on a BMS-WebView-2-shaped database
 at minsup=0.1%.  The real BMS-WebView-2 file is unreachable (zero-egress
-sandbox), so a seeded synthetic DB with the documented shape (77.5k
+sandbox), so a seeded SYNTHETIC DB with the documented shape (77.5k
 sequences, 3.3k item alphabet, ~4.6 itemsets/sequence) stands in; point
-BENCH_DATASET at a real SPMF file to override.
+BENCH_DATASET at a real SPMF file to override.  The metric string names the
+dataset truthfully either way.
 
 Metric: patterns/sec of the steady-state mine (second run, compiles warm).
 vs_baseline: 10s-target ratio = 10.0 / steady wall-clock (>1 beats the
 "<10s on v5e-8" north star; here a single chip).
 
+Parity (the north star's other half) is checked by default against the CPU
+oracle — `"parity": true` in the output attests a byte-identical pattern
+set.  Set BENCH_PARITY=0 to skip (saves the oracle's ~30s wall-clock).
+
+The Pallas pair-support kernel is ON by default ("auto": enabled on a real
+TPU backend; validated on-chip v5e, exact parity, ~3x over the jnp gather
+path).  Set BENCH_PALLAS=0 to force the jnp path.
+
+If the TPU tunnel is down the harness retries for BENCH_TPU_WAIT seconds
+(default 60) and then falls back to CPU LOUDLY: `"platform": "cpu"` plus a
+`"tpu_fallback_reason"` field — a CPU number is not a TPU number.
+
 Env knobs: BENCH_SCALE (default 1.0), BENCH_MINSUP (default 0.001),
-BENCH_DATASET (SPMF file path), BENCH_PARITY=1 (also run the CPU oracle and
-check byte-identical output; adds oracle wall-clock), BENCH_PALLAS=1 to
-enable the Pallas pair-support kernel (default off until it is validated on
-the target chip generation; a kernel failure falls back to the jnp path,
-but a hang would stall the harness, so opt-in here).
+BENCH_DATASET (SPMF file path), BENCH_PARITY=0, BENCH_PALLAS=0,
+BENCH_TPU_WAIT (seconds).
 """
 
 import json
@@ -25,22 +35,42 @@ import socket
 import sys
 import time
 
+TUNNEL_PORT = 8082  # axon TPU tunnel relay; importing the backend with the
+                    # relay down hangs forever, so probe BEFORE backend init.
 
-def _tpu_reachable() -> bool:
-    """The axon TPU tunnel relay listens on 8082; if it's gone, importing
-    the axon backend hangs forever, so gate BEFORE the first backend init."""
-    try:
-        with socket.create_connection(("127.0.0.1", 8082), timeout=2.0):
-            return True
-    except OSError:
-        return False
+
+def _tpu_probe(wait_s: float) -> str:
+    """Empty string if the tunnel answers (retrying up to wait_s), else the
+    fallback reason.  Connection-refused means nothing listens at all (a
+    CPU-only box, not a flaky tunnel), so it gets a short retry budget
+    rather than stalling every run the full wait."""
+    start = time.time()
+    last = "unknown"
+    budget = wait_s
+    while True:
+        try:
+            with socket.create_connection(("127.0.0.1", TUNNEL_PORT), timeout=2.0):
+                return ""
+        except ConnectionRefusedError as e:
+            last = str(e)
+            budget = min(budget, 6.0)  # relay definitively absent
+        except OSError as e:
+            last = str(e)
+        if time.time() - start >= budget:
+            return (f"TPU tunnel port {TUNNEL_PORT} unreachable after "
+                    f"{budget:.0f}s of retries: {last}")
+        time.sleep(2.0)
 
 
 def main() -> None:
-    want_tpu = os.environ.get("JAX_PLATFORMS", "").lower() not in ("cpu",)
-    use_tpu = want_tpu and _tpu_reachable()
+    fallback_reason = ""
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        fallback_reason = "JAX_PLATFORMS=cpu requested by caller"
+    else:
+        fallback_reason = _tpu_probe(float(os.environ.get("BENCH_TPU_WAIT", "60")))
     import jax
-    if not use_tpu:
+    if fallback_reason:
+        print(f"bench: FALLING BACK TO CPU — {fallback_reason}", file=sys.stderr)
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
@@ -55,15 +85,19 @@ def main() -> None:
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     rel_minsup = float(os.environ.get("BENCH_MINSUP", "0.001"))
     dataset = os.environ.get("BENCH_DATASET")
+    dataset_name = (os.path.basename(dataset) if dataset
+                    else "synthetic BMS-WebView-2-shaped")
 
     t0 = time.time()
     db = load_spmf(dataset) if dataset else bms_webview2_like(scale=scale)
+    datagen_s = time.time() - t0
     minsup = abs_minsup(rel_minsup, len(db))
+    t0 = time.time()
     vdb = build_vertical(db, min_item_support=minsup)
     build_s = time.time() - t0
 
     platform = jax.devices()[0].platform
-    use_pallas = "auto" if os.environ.get("BENCH_PALLAS") == "1" else False
+    use_pallas = False if os.environ.get("BENCH_PALLAS") == "0" else "auto"
     t0 = time.time()
     eng = SpadeTPU(vdb, minsup, use_pallas=use_pallas)
     res = eng.mine()
@@ -76,28 +110,65 @@ def main() -> None:
 
     patterns_per_sec = len(res) / steady_s if steady_s > 0 else 0.0
     out = {
-        "metric": "patterns/sec (SPADE, BMS-WebView-2-shaped, minsup=0.1%)",
+        "metric": f"patterns/sec (SPADE, {dataset_name}, minsup={rel_minsup:g})",
         "value": round(patterns_per_sec, 2),
         "unit": "patterns/sec",
         "vs_baseline": round(10.0 / steady_s, 3) if steady_s > 0 else 0.0,
         "patterns": len(res),
         "wall_s": round(steady_s, 3),
         "cold_wall_s": round(cold_s, 3),
+        "datagen_s": round(datagen_s, 3),
         "vertical_build_s": round(build_s, 3),
         "sequences": vdb.n_sequences,
         "frequent_items": vdb.n_items,
         "platform": platform,
+        "pallas": bool(eng.use_pallas),
         "candidates": eng.stats["candidates"],
     }
+    if fallback_reason:
+        out["tpu_fallback_reason"] = fallback_reason
 
-    if os.environ.get("BENCH_PARITY") == "1":
+    if os.environ.get("BENCH_PARITY") != "0":
         from spark_fsm_tpu.models.oracle import mine_spade
         t0 = time.time()
         oracle = mine_spade(db, minsup)
         out["oracle_wall_s"] = round(time.time() - t0, 3)
         out["parity"] = patterns_text(res) == patterns_text(oracle)
 
+    # Only the canonical workload under default engine config, with the
+    # parity half of the north star checked and passing, may overwrite the
+    # headline entry — a BENCH_PALLAS=0 comparison run, a parity-skipped
+    # quick run, or a parity FAILURE must never masquerade as the baseline.
+    canonical = (scale == 1.0 and rel_minsup == 0.001 and not dataset
+                 and os.environ.get("BENCH_PALLAS") != "0"
+                 and out.get("parity") is True)
+    if canonical:
+        _publish(out)
+    else:
+        print("bench: non-canonical run (scale/minsup/dataset/pallas "
+              "override, or parity not attested) — not recorded in "
+              "BASELINE.json.published", file=sys.stderr)
     print(json.dumps(out))
+
+
+def _publish(out: dict) -> None:
+    """Record the canonical-workload result in BASELINE.json.published
+    (SURVEY.md sec 7 step 10).  Callers gate on the default config so a
+    scaled-down smoke run can never clobber the headline number."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            base = json.load(f)
+        pub = base.get("published") or {}
+        key = "tpu_single_chip" if out["platform"] == "tpu" else "cpu_fallback"
+        pub[key] = dict(out)
+        base["published"] = pub
+        with open(path, "w") as f:
+            json.dump(base, f, indent=2)
+            f.write("\n")
+    except Exception as e:  # never let bookkeeping kill the bench line
+        print(f"bench: could not update BASELINE.json: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,22 @@ from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 
 
+def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
+    """Persist a mine's output under ``uid`` — the single result sink used
+    by batch train jobs and stream pushes alike."""
+    if kind == "patterns":
+        store.add_patterns(uid, model.serialize_patterns(results))
+    else:
+        store.add_rules(uid, model.serialize_rules(results))
+
+
+def _record_failure(store: ResultStore, uid: str, exc: Exception) -> None:
+    """The supervision contract: error text + traceback under the error
+    key, status -> failure (SURVEY.md sec 5 failure-detection row)."""
+    store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
+    store.add_status(uid, Status.FAILURE)
+
+
 class Miner:
     """Train worker: source -> dataset -> plugin -> sink, with statuses.
 
@@ -63,19 +79,14 @@ class Miner:
             try:
                 self._run(req)
             except Exception as exc:  # supervision: failure status + log
-                self.store.set(f"fsm:error:{req.uid}",
-                               f"{exc}\n{traceback.format_exc()}")
-                self.store.add_status(req.uid, Status.FAILURE)
+                _record_failure(self.store, req.uid, exc)
 
     def _run(self, req: ServiceRequest) -> None:
         db = sources.get_db(req, self.store)
         self.store.add_status(req.uid, Status.DATASET)
         plugin = plugins.get_plugin(req)
         results = plugin.extract(req, db)
-        if plugin.kind == "patterns":
-            self.store.add_patterns(req.uid, model.serialize_patterns(results))
-        else:
-            self.store.add_rules(req.uid, model.serialize_rules(results))
+        _sink_results(self.store, req.uid, plugin.kind, results)
         self.store.add_status(req.uid, Status.TRAINED)
         self.store.add_status(req.uid, Status.FINISHED)
 
@@ -227,6 +238,11 @@ class Streamer:
                         max_sequences=int(ms) if ms is not None else None,
                         mine=plugin_mine),
                     "kind": plugin.kind,
+                    # held across push + result sink + response-field reads
+                    # so concurrent pushes cannot sink an older window's
+                    # results over a newer one's (push alone is serialized
+                    # inside WindowMiner, but the store write is not)
+                    "lock": threading.Lock(),
                 }
                 self._topics[topic] = state
             return state
@@ -252,25 +268,21 @@ class Streamer:
             return model.response(req, Status.FAILURE, error=str(exc))
         uid = f"stream:{topic}"
         miner = state["miner"]
-        try:
-            results = miner.push(batch)
-            if state["kind"] == "patterns":
-                self.store.add_patterns(uid, model.serialize_patterns(results))
-            else:
-                self.store.add_rules(uid, model.serialize_rules(results))
-            self.store.add_status(uid, Status.FINISHED)
-        except Exception as exc:
-            self.store.set(f"fsm:error:{uid}",
-                           f"{exc}\n{traceback.format_exc()}")
-            self.store.add_status(uid, Status.FAILURE)
-            return model.response(req, Status.FAILURE, error=str(exc))
-        window = miner.window
-        return model.response(
-            req, Status.FINISHED, uid=uid,
-            window_batches=str(window.n_batches),
-            window_sequences=str(window.n_sequences),
-            evicted_batches=str(miner.stats["evicted_batches"]),
-            results=str(len(results)))
+        with state["lock"]:
+            try:
+                results = miner.push(batch)
+                _sink_results(self.store, uid, state["kind"], results)
+                self.store.add_status(uid, Status.FINISHED)
+            except Exception as exc:
+                _record_failure(self.store, uid, exc)
+                return model.response(req, Status.FAILURE, error=str(exc))
+            window = miner.window
+            return model.response(
+                req, Status.FINISHED, uid=uid,
+                window_batches=str(window.n_batches),
+                window_sequences=str(window.n_sequences),
+                evicted_batches=str(miner.stats["evicted_batches"]),
+                results=str(len(results)))
 
 
 class Master:

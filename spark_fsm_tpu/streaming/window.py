@@ -45,6 +45,12 @@ class SlidingWindow:
                  max_sequences: Optional[int] = None) -> None:
         if max_batches is None and max_sequences is None:
             max_batches = 1  # degenerate default: mine each batch alone
+        if max_batches is not None and max_batches < 1:
+            raise ValueError(f"max_batches must be >= 1 (got {max_batches}); "
+                             "use None for an unbounded window")
+        if max_sequences is not None and max_sequences < 1:
+            raise ValueError(f"max_sequences must be >= 1 (got {max_sequences}); "
+                             "use None for an unbounded window")
         self.max_batches = max_batches
         self.max_sequences = max_sequences
         self._batches: Deque[SequenceDB] = deque()

@@ -171,3 +171,18 @@ def test_stream_errors(server):
     resp = _post(server, "/stream/badalgo", sequences="1 -2",
                  algorithm="NOPE")
     assert resp["status"] == "failure"
+    # a zero-capacity window would evict every pushed batch and serve an
+    # empty result set forever with status=finished — must be rejected
+    resp = _post(server, "/stream/zerowin", sequences="1 -2",
+                 max_batches="0")
+    assert resp["status"] == "failure"
+    assert "max_batches" in resp["data"]["error"]
+
+
+def test_window_rejects_nonpositive_caps():
+    import pytest
+
+    with pytest.raises(ValueError, match="max_batches"):
+        SlidingWindow(max_batches=0)
+    with pytest.raises(ValueError, match="max_sequences"):
+        SlidingWindow(max_sequences=-1)
