@@ -164,9 +164,11 @@ def _publish(out: dict) -> None:
         key = "tpu_single_chip" if out["platform"] == "tpu" else "cpu_fallback"
         pub[key] = dict(out)
         base["published"] = pub
-        with open(path, "w") as f:
+        tmp = path + ".tmp"  # atomic replace: a mid-write kill must not
+        with open(tmp, "w") as f:  # truncate the committed baseline
             json.dump(base, f, indent=2)
             f.write("\n")
+        os.replace(tmp, path)
     except Exception as e:  # never let bookkeeping kill the bench line
         print(f"bench: could not update BASELINE.json: {e}", file=sys.stderr)
 

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
 
@@ -129,17 +130,18 @@ class TsrTPU:
         self.stats = {"evaluated": 0, "kernel_launches": 0, "deepening_rounds": 0}
         self._eval_fns: dict = {}
 
-        bitmaps = vdb.bitmaps
-        n_items, n_seq, n_words = bitmaps.shape
+        # NEVER materialize vdb.bitmaps here: with a Kosarak-shaped alphabet
+        # (~41k items x ~990k sequences) the full dense store is ~160 GB.
+        # Each deepening round instead builds ONLY the top-m item rows from
+        # the token table (host memory/HBM proportional to m, not n_items).
+        self.n_seq = vdb.n_sequences
         if mesh is not None:
-            n_dev = mesh.devices.size
-            padded = pad_to_multiple(n_seq, n_dev)
-            if padded != n_seq:
-                bitmaps = np.concatenate(
-                    [bitmaps, np.zeros((n_items, padded - n_seq, n_words), np.uint32)],
-                    axis=1,
-                )
-        self._bitmaps = bitmaps
+            self.n_seq = pad_to_multiple(self.n_seq, mesh.devices.size)
+        self.n_words = vdb.n_words
+        # tok_item is nondecreasing (build_vertical emits tokens sorted by
+        # item), so per-item token ranges are a searchsorted away
+        self._tok_starts = np.searchsorted(
+            vdb.tok_item, np.arange(vdb.n_items + 1))
         # items sorted by support desc, stable by item id
         order = np.lexsort((vdb.item_ids, -vdb.item_supports))
         self._order = order
@@ -147,23 +149,55 @@ class TsrTPU:
 
     # ------------------------------------------------------------- kernels
 
+    def _sel_tokens(self, sel: np.ndarray):
+        """Token table restricted to the selected items, rows renumbered to
+        0..len(sel)-1 (selection order)."""
+        starts, vdb = self._tok_starts, self.vdb
+        lens = starts[sel + 1] - starts[sel]
+        idx = np.concatenate(
+            [np.arange(starts[i], starts[i + 1]) for i in sel]
+        ) if len(sel) else np.zeros(0, np.int64)
+        ti = np.repeat(np.arange(len(sel), dtype=np.int32), lens)
+        return ti, vdb.tok_seq[idx], vdb.tok_word[idx], vdb.tok_mask[idx]
+
+    def _host_bitmaps(self, m: int) -> np.ndarray:
+        """[m, n_seq, n_words] dense rows for the top-m items, host-built
+        from the token slice (memory proportional to m, never n_items)."""
+        ti, ts, tw, tm = self._sel_tokens(self._order[:m])
+        bm = np.zeros((m, self.n_seq, self.n_words), np.uint32)
+        np.add.at(bm, (ti, ts, tw), tm)  # distinct bits: add == OR
+        return bm
+
     def _prep(self, m: int):
-        """prefix/suffix-OR id-lists for the top-m items (one jit call)."""
-        sel = self._order[:m]
-        raw = jnp.asarray(self._bitmaps[sel])
-        if self.mesh is not None:
-            raw = jax.device_put(raw, store_sharding(self.mesh))
+        """prefix/suffix-OR id-lists for the top-m items (one jit call).
 
-        def body(b):
-            return B.prefix_or_incl(b), B.suffix_or_incl(b)
-
+        Single chip: the [m, n_seq, n_words] store is scatter-built in HBM
+        straight from the ~KB-scale token slice and transformed in the same
+        jit — the dense rows never exist on host.  Mesh: only the m selected
+        rows are host-built, then sharded over the sequence axis.
+        """
         if self.mesh is None:
-            fn = jax.jit(body)
+            ti, ts, tw, tm = self._sel_tokens(self._order[:m])
+
+            def build_and_prep(ti, ts, tw, tm):
+                z = jnp.zeros((m, self.n_seq, self.n_words), jnp.uint32)
+                b = z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
+                return B.prefix_or_incl(b), B.suffix_or_incl(b)
+
+            p1, s1 = jax.jit(build_and_prep)(
+                jnp.asarray(ti), jnp.asarray(ts), jnp.asarray(tw),
+                jnp.asarray(tm))
         else:
+            raw = jax.device_put(self._host_bitmaps(m),
+                                 store_sharding(self.mesh))
+
+            def body(b):
+                return B.prefix_or_incl(b), B.suffix_or_incl(b)
+
             st = P(None, SEQ_AXIS, None)
             fn = jax.jit(jax.shard_map(body, mesh=self.mesh,
                                        in_specs=(st,), out_specs=(st, st)))
-        p1, s1 = fn(raw)
+            p1, s1 = fn(raw)
         self.stats["kernel_launches"] += 1
         return p1, s1
 
@@ -329,9 +363,46 @@ class TsrTPU:
             m = min(m * 2, n_total)
 
 
+class TsrCPU(TsrTPU):
+    """CPU TopSeqRules: the same best-first search and iterative deepening,
+    with the bitmap evaluation in NumPy on host (the reference's JVM-local
+    miner analog; ``algorithm=TSR`` in the plugin registry, mirroring
+    SPADE vs SPADE_TPU).  Shares byte semantics with the device engine via
+    ops/bitops_np, so oracle comparisons are exact."""
+
+    def _prep(self, m: int):
+        assert self.mesh is None, "TsrCPU does not shard; use TsrTPU"
+        bm = self._host_bitmaps(m)
+        return Bnp.prefix_or_incl(bm), Bnp.suffix_or_incl(bm)
+
+    def _evaluate(self, p1, s1, cands):
+        n = len(cands)
+        sup = np.empty(n, np.int64)
+        supx = np.empty(n, np.int64)
+        for r, (x, y) in enumerate(cands):
+            a = p1[x[0]]
+            for i in x[1:]:
+                a = a & p1[i]
+            c = s1[y[0]]
+            for j in y[1:]:
+                c = c & s1[j]
+            sup[r] = int(Bnp.support(Bnp.shift_up_one(a) & c))
+            supx[r] = int(Bnp.support(a))
+        self.stats["evaluated"] += n
+        return sup, supx
+
+
 def mine_tsr_tpu(db: SequenceDB, k: int, minconf: float, *,
                  mesh: Optional[Mesh] = None, **kwargs) -> List[RuleResult]:
     vdb = build_vertical(db, min_item_support=1)
     if vdb.n_items == 0:
         return []
     return TsrTPU(vdb, k, minconf, mesh=mesh, **kwargs).mine()
+
+
+def mine_tsr_cpu(db: SequenceDB, k: int, minconf: float,
+                 **kwargs) -> List[RuleResult]:
+    vdb = build_vertical(db, min_item_support=1)
+    if vdb.n_items == 0:
+        return []
+    return TsrCPU(vdb, k, minconf, **kwargs).mine()

@@ -271,6 +271,9 @@ class Streamer:
         with state["lock"]:
             try:
                 results = miner.push(batch)
+                # a prior failed push's error must not shadow this success
+                # in /status (the batch path clears via clear_job)
+                self.store.delete(f"fsm:error:{uid}")
                 _sink_results(self.store, uid, state["kind"], results)
                 self.store.add_status(uid, Status.FINISHED)
             except Exception as exc:

@@ -9,8 +9,9 @@ SPADE_TPU``) over the TPU engines and the CPU oracles:
   SPADE      — CPU oracle miner (numpy bitmap DFS).
   SPADE_TPU  — device engine (models/spade_tpu.py); honors maxgap /
                maxwindow by switching to the constrained engine.
-  TSR        — CPU/bitmap top-k rule miner with device kernels off.
-  TSR_TPU    — device TSR engine (models/tsr.py).
+  TSR        — CPU top-k rule miner (models/tsr.py TsrCPU: same best-first
+               search, NumPy bitmap evaluation on host).
+  TSR_TPU    — device TSR engine (models/tsr.py TsrTPU).
 
 Each plugin returns (kind, results) where kind is "patterns" or "rules".
 """
@@ -73,21 +74,32 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB) -> Results:
     return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow)
 
 
-def _tsr(req: ServiceRequest, db: SequenceDB) -> Results:
-    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
-
+def _tsr_params(req: ServiceRequest):
     k = int(req.param("k", "100"))
     minconf = float(req.param("minconf", "0.5"))
     max_side = req.param("max_side")
-    return mine_tsr_tpu(db, k, minconf,
-                        max_side=int(max_side) if max_side else None)
+    return k, minconf, int(max_side) if max_side else None
+
+
+def _tsr_cpu(req: ServiceRequest, db: SequenceDB) -> Results:
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu
+
+    k, minconf, max_side = _tsr_params(req)
+    return mine_tsr_cpu(db, k, minconf, max_side=max_side)
+
+
+def _tsr_tpu(req: ServiceRequest, db: SequenceDB) -> Results:
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    k, minconf, max_side = _tsr_params(req)
+    return mine_tsr_tpu(db, k, minconf, max_side=max_side)
 
 
 ALGORITHMS: Dict[str, AlgorithmPlugin] = {
     "SPADE": AlgorithmPlugin("SPADE", "patterns", _spade_cpu),
     "SPADE_TPU": AlgorithmPlugin("SPADE_TPU", "patterns", _spade_tpu),
-    "TSR": AlgorithmPlugin("TSR", "rules", _tsr),
-    "TSR_TPU": AlgorithmPlugin("TSR_TPU", "rules", _tsr),
+    "TSR": AlgorithmPlugin("TSR", "rules", _tsr_cpu),
+    "TSR_TPU": AlgorithmPlugin("TSR_TPU", "rules", _tsr_tpu),
 }
 
 

@@ -89,3 +89,42 @@ def test_tie_inclusive_topk():
 
 def test_empty():
     assert mine_tsr_tpu(parse_spmf("1 -2\n"), 5, 0.5) == []
+
+
+def test_cpu_engine_parity():
+    # TSR (CPU, TsrCPU) and TSR_TPU must be byte-identical — they share the
+    # search; only the bitmap evaluation backend differs.
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu
+
+    rng = np.random.default_rng(17)
+    for _ in range(4):
+        db = random_db(rng, n_seq=24, n_items=7, max_itemsets=5, max_set=2)
+        got_cpu = mine_tsr_cpu(db, 8, 0.4)
+        got_tpu = mine_tsr_tpu(db, 8, 0.4)
+        assert rules_text(got_cpu) == rules_text(got_tpu)
+
+
+def test_no_dense_bitmap_materialization():
+    # The Kosarak eval config (~41k items x ~990k seqs) only fits if the
+    # engine builds bitmaps for the top-m items per deepening round; pulling
+    # vdb.bitmaps (ALL items, dense) would be ~160 GB at full scale.
+    db = synthetic_db(7, n_sequences=300, n_items=50, mean_itemsets=4.0)
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, k=10, minconf=0.5, item_cap=8)
+    eng.mine()
+    assert vdb._bitmaps is None, "TsrTPU must not materialize vdb.bitmaps"
+    assert eng.stats["deepening_rounds"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.skipif("not __import__('os').environ.get('RUN_SLOW')",
+                    reason="minutes-long full-scale run; set RUN_SLOW=1")
+def test_kosarak_scale_runnable():
+    # BASELINE.md eval config #3 at 10% scale (~99k seqs, ~4.1k items):
+    # proves the top-M memory plan mines a large-alphabet DB end to end.
+    from spark_fsm_tpu.data.synth import kosarak_like
+
+    db = kosarak_like(scale=0.1)
+    rules = mine_tsr_tpu(db, k=100, minconf=0.5)
+    assert len(rules) >= 100
+    assert all(conf_ok(sup, supx, 0.5) for _, _, sup, supx in rules)
