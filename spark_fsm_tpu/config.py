@@ -1,0 +1,187 @@
+"""Boot configuration — the reference's Configuration/application.conf analog.
+
+The reference loads service host/port, Spark properties, and Redis/ES
+endpoints from a Typesafe Config file at boot (SURVEY.md sec 1 L0, sec 5
+config row); per-request knobs stay in the request's string map.  The
+rebuild keeps that split: this module owns the boot-time knobs — service
+address, store backend, device-mesh size, engine memory/batching budgets,
+profiler output — loaded from a TOML or JSON file, while ``ServiceRequest``
+carries the per-job vocabulary (``algorithm``, ``support``, ...).
+
+File format (TOML shown; JSON with the same nesting also accepted):
+
+    profile_dir = "traces"          # jax.profiler output root ("" = off)
+
+    [service]
+    host = "0.0.0.0"
+    port = 9000
+    miner_workers = 2
+
+    [store]
+    backend = "inproc"              # or "redis"
+    host = "127.0.0.1"
+    port = 6379
+
+    [engine]
+    mesh_devices = 8                # 0 = single chip (no mesh)
+    pool_bytes = 2147483648         # HBM slot-pool budget
+    node_batch = 256                # DFS nodes per device dispatch
+    pipeline_depth = 4              # in-flight support readbacks
+    chunk = 256                     # SPADE support-count batch width
+    recompute_chunk = 256
+    tsr_chunk = 256                 # TSR candidate batch width
+    item_cap = 256                  # TSR iterative-deepening width
+
+Unknown keys are rejected (a typo'd knob must not silently no-op).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ServiceConfig:
+    host: str = "127.0.0.1"
+    port: int = 9000
+    miner_workers: int = 1
+
+
+@dataclasses.dataclass
+class StoreConfig:
+    backend: str = "inproc"  # "inproc" | "redis"
+    host: str = "127.0.0.1"
+    port: int = 6379
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Boot-time engine knobs; ``None`` means the engine's own default."""
+
+    mesh_devices: int = 0  # 0 = no mesh; N = shard seq axis over N devices
+    pool_bytes: Optional[int] = None
+    node_batch: Optional[int] = None
+    pipeline_depth: Optional[int] = None
+    chunk: Optional[int] = None  # SPADE engines (default 2048 there)
+    recompute_chunk: Optional[int] = None
+    tsr_chunk: Optional[int] = None  # TSR candidate batch (default 256)
+    item_cap: Optional[int] = None  # TSR iterative-deepening width
+
+
+@dataclasses.dataclass
+class Config:
+    service: ServiceConfig = dataclasses.field(default_factory=ServiceConfig)
+    store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _fill(cls, obj: Dict[str, Any], section: str):
+    if not isinstance(obj, dict):
+        raise ConfigError(f"[{section}] must be a table/object, "
+                          f"got {type(obj).__name__}")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(obj) - set(fields)
+    if unknown:
+        raise ConfigError(
+            f"unknown key(s) {sorted(unknown)} in [{section}] "
+            f"(valid: {sorted(fields)})")
+    kwargs = {}
+    for name, value in obj.items():
+        f = fields[name]
+        if f.type in ("int", "Optional[int]") and value is not None:
+            value = int(value)
+        elif f.type == "str":
+            value = str(value)
+        kwargs[name] = value
+    return cls(**kwargs)
+
+
+def parse_config(obj: Dict[str, Any]) -> Config:
+    top = dict(obj)
+    sections = {
+        "service": (ServiceConfig, top.pop("service", {})),
+        "store": (StoreConfig, top.pop("store", {})),
+        "engine": (EngineConfig, top.pop("engine", {})),
+    }
+    profile_dir = str(top.pop("profile_dir", ""))
+    if top:
+        raise ConfigError(
+            f"unknown top-level key(s) {sorted(top)} "
+            f"(valid: {sorted(sections) + ['profile_dir']})")
+    parsed = {name: _fill(cls, section_obj, name)
+              for name, (cls, section_obj) in sections.items()}
+    cfg = Config(profile_dir=profile_dir, **parsed)
+    if cfg.store.backend not in ("inproc", "redis"):
+        raise ConfigError(
+            f"store.backend must be 'inproc' or 'redis', "
+            f"got {cfg.store.backend!r}")
+    if cfg.engine.mesh_devices < 0:
+        raise ConfigError("engine.mesh_devices must be >= 0")
+    return cfg
+
+
+def load_config(path: str) -> Config:
+    """Load a TOML (``.toml``) or JSON boot config file."""
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    if path.endswith(".toml"):
+        import tomllib
+
+        obj = tomllib.loads(raw.decode("utf-8"))
+    else:
+        obj = json.loads(raw.decode("utf-8"))
+    if not isinstance(obj, dict):
+        raise ConfigError("config root must be a table/object")
+    return parse_config(obj)
+
+
+# --------------------------------------------------------------------------
+# Process-wide active config (set once at boot by app.main; tests may swap)
+# --------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_active = Config()
+_mesh_cache: Dict[int, Any] = {}
+
+
+def get_config() -> Config:
+    return _active
+
+
+def set_config(cfg: Config) -> None:
+    global _active
+    with _lock:
+        _active = cfg
+        _mesh_cache.clear()
+
+
+def engine_kwargs(*names: str) -> Dict[str, Any]:
+    """Configured engine knobs (subset ``names``, skipping unset ones)."""
+    eng = _active.engine
+    out = {}
+    for name in names:
+        value = getattr(eng, name)
+        if value is not None:
+            out[name] = value
+    return out
+
+
+def get_mesh():
+    """The boot-configured device mesh, or None for single-chip."""
+    n = _active.engine.mesh_devices
+    if n <= 0:
+        return None
+    with _lock:
+        if n not in _mesh_cache:
+            from spark_fsm_tpu.parallel.mesh import make_mesh
+
+            _mesh_cache[n] = make_mesh(n)
+        return _mesh_cache[n]

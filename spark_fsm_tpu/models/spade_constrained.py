@@ -400,6 +400,7 @@ def mine_cspade_tpu(
     maxwindow: Optional[int] = None,
     mesh: Optional[Mesh] = None,
     max_pattern_itemsets: Optional[int] = None,
+    stats_out: Optional[dict] = None,
     **kwargs,
 ) -> List[PatternResult]:
     vdb = build_vertical(db, min_item_support=minsup_abs)
@@ -408,4 +409,7 @@ def mine_cspade_tpu(
     eng = ConstrainedSpadeTPU(vdb, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
                               mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
                               **kwargs)
-    return eng.mine()
+    results = eng.mine()
+    if stats_out is not None:
+        stats_out.update(eng.stats)
+    return results

@@ -500,6 +500,7 @@ def mine_spade_tpu(
     *,
     mesh: Optional[Mesh] = None,
     max_pattern_itemsets: Optional[int] = None,
+    stats_out: Optional[dict] = None,
     **kwargs,
 ) -> List[PatternResult]:
     """Convenience wrapper: DB -> vertical build -> TPU mine."""
@@ -508,4 +509,7 @@ def mine_spade_tpu(
         return []
     eng = SpadeTPU(vdb, minsup_abs, mesh=mesh,
                    max_pattern_itemsets=max_pattern_itemsets, **kwargs)
-    return eng.mine()
+    results = eng.mine()
+    if stats_out is not None:
+        stats_out.update(eng.stats)
+    return results

@@ -393,16 +393,25 @@ class TsrCPU(TsrTPU):
 
 
 def mine_tsr_tpu(db: SequenceDB, k: int, minconf: float, *,
-                 mesh: Optional[Mesh] = None, **kwargs) -> List[RuleResult]:
+                 mesh: Optional[Mesh] = None,
+                 stats_out: Optional[dict] = None, **kwargs) -> List[RuleResult]:
     vdb = build_vertical(db, min_item_support=1)
     if vdb.n_items == 0:
         return []
-    return TsrTPU(vdb, k, minconf, mesh=mesh, **kwargs).mine()
+    eng = TsrTPU(vdb, k, minconf, mesh=mesh, **kwargs)
+    results = eng.mine()
+    if stats_out is not None:
+        stats_out.update(eng.stats)
+    return results
 
 
-def mine_tsr_cpu(db: SequenceDB, k: int, minconf: float,
-                 **kwargs) -> List[RuleResult]:
+def mine_tsr_cpu(db: SequenceDB, k: int, minconf: float, *,
+                 stats_out: Optional[dict] = None, **kwargs) -> List[RuleResult]:
     vdb = build_vertical(db, min_item_support=1)
     if vdb.n_items == 0:
         return []
-    return TsrCPU(vdb, k, minconf, **kwargs).mine()
+    eng = TsrCPU(vdb, k, minconf, **kwargs)
+    results = eng.mine()
+    if stats_out is not None:
+        stats_out.update(eng.stats)
+    return results

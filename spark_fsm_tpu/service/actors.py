@@ -12,14 +12,18 @@ reference's error contract.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
+import time
 import traceback
 from typing import Dict, Optional
 
+from spark_fsm_tpu import config
 from spark_fsm_tpu.service import model, plugins, sources
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils.obs import log_event, profile_trace
 
 
 def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
@@ -31,11 +35,35 @@ def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
         store.add_rules(uid, model.serialize_rules(results))
 
 
-def _record_failure(store: ResultStore, uid: str, exc: Exception) -> None:
+def _record_failure(store: ResultStore, uid: str, exc: Exception,
+                    metric: str = "jobs_failed") -> None:
     """The supervision contract: error text + traceback under the error
-    key, status -> failure (SURVEY.md sec 5 failure-detection row)."""
+    key, status -> failure (SURVEY.md sec 5 failure-detection row).
+    ``metric`` keeps batch-job and stream-push failure counters distinct
+    (jobs_failed must never exceed jobs_submitted)."""
     store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
     store.add_status(uid, Status.FAILURE)
+    store.incr(f"fsm:metric:{metric}")
+    log_event("job_failed", uid=uid, error=str(exc))
+
+
+def _profile_dir(req: ServiceRequest, uid: str) -> str:
+    """Trace dir for this job, or "" (no profiling).
+
+    ``profile`` request param: a path = trace there; any other truthy
+    value = trace under the boot config's ``profile_dir`` (required then).
+    """
+    value = req.param("profile")
+    if value is None or value.lower() in ("", "0", "false", "no", "off"):
+        return ""
+    if "/" in value or value.startswith("."):
+        return value
+    root = config.get_config().profile_dir
+    if not root:
+        raise ValueError(
+            "profile=1 requested but no profile_dir configured at boot "
+            "(set profile_dir in the config file, or pass profile=<path>)")
+    return os.path.join(root, uid)
 
 
 class Miner:
@@ -64,6 +92,10 @@ class Miner:
         # THIS job, not the previous one's leftovers.
         self.store.clear_job(req.uid)
         self.store.add_status(req.uid, Status.STARTED)
+        self.store.incr("fsm:metric:jobs_submitted")
+        log_event("job_submitted", uid=req.uid,
+                  algorithm=req.param("algorithm", "SPADE_TPU"),
+                  source=req.param("source", "FILE"))
         self._q.put(req)
 
     def _loop(self) -> None:
@@ -82,13 +114,31 @@ class Miner:
                 _record_failure(self.store, req.uid, exc)
 
     def _run(self, req: ServiceRequest) -> None:
+        t0 = time.perf_counter()
         db = sources.get_db(req, self.store)
         self.store.add_status(req.uid, Status.DATASET)
         plugin = plugins.get_plugin(req)
-        results = plugin.extract(req, db)
+        stats: Dict[str, object] = {
+            "algorithm": plugin.name,
+            "sequences": len(db),
+            "dataset_s": round(time.perf_counter() - t0, 4),
+        }
+        trace_dir = _profile_dir(req, req.uid)
+        t1 = time.perf_counter()
+        with profile_trace(trace_dir):
+            results = plugin.extract(req, db, stats)
+        mine_s = time.perf_counter() - t1
+        stats["mine_s"] = round(mine_s, 4)
+        stats["results"] = len(results)
+        stats["results_per_s"] = round(len(results) / mine_s, 2) if mine_s else 0.0
+        if trace_dir:
+            stats["profile_trace"] = trace_dir
+        self.store.set(f"fsm:stats:{req.uid}", json.dumps(stats))
         _sink_results(self.store, req.uid, plugin.kind, results)
         self.store.add_status(req.uid, Status.TRAINED)
         self.store.add_status(req.uid, Status.FINISHED)
+        self.store.incr("fsm:metric:jobs_finished")
+        log_event("job_finished", uid=req.uid, **stats)
 
     def shutdown(self) -> None:
         for _ in self._threads:
@@ -275,9 +325,12 @@ class Streamer:
                 # in /status (the batch path clears via clear_job)
                 self.store.delete(f"fsm:error:{uid}")
                 _sink_results(self.store, uid, state["kind"], results)
+                self.store.set(f"fsm:stats:{uid}", json.dumps(miner.stats))
                 self.store.add_status(uid, Status.FINISHED)
+                self.store.incr("fsm:metric:stream_pushes")
             except Exception as exc:
-                _record_failure(self.store, uid, exc)
+                _record_failure(self.store, uid, exc,
+                                metric="stream_failures")
                 return model.response(req, Status.FAILURE, error=str(exc))
             window = miner.window
             return model.response(
@@ -318,8 +371,13 @@ class Master:
             status = self.store.status(req.uid)
             if status is None:
                 return model.response(req, Status.FAILURE, error="unknown uid")
+            extra: Dict[str, str] = {}
             error = self.store.get(f"fsm:error:{req.uid}")
-            extra: Dict[str, str] = {"error": error} if error else {}
+            if error:
+                extra["error"] = error
+            stats = self.store.get(f"fsm:stats:{req.uid}")
+            if stats:  # engine + timing counters (SURVEY.md sec 5 metrics)
+                extra["stats"] = stats
             return model.response(req, status, **extra)
         if task == "get":
             return self.questor.handle(req, subject or "patterns")

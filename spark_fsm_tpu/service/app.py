@@ -12,7 +12,9 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         under uid "stream:{topic}" (eval config #5)
   /register/{topic}   — register a field spec
   /index/{topic}      — alias of register (reference keeps both)
-  /admin/ping         — liveness; /admin/algorithms — plugin listing
+  /admin/ping         — liveness; /admin/algorithms — plugin listing;
+  /admin/stats        — service metrics (job counters, backend, devices);
+  /admin/config       — the active boot config
 
 Runs on the stdlib ThreadingHTTPServer: the service layer is deliberately
 dependency-free; heavy lifting happens in the engines (device) behind the
@@ -22,15 +24,19 @@ Miner worker thread.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qsl, urlsplit
 
+from spark_fsm_tpu import config as cfgmod
 from spark_fsm_tpu.service import plugins
 from spark_fsm_tpu.service.actors import Master
 from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.store import RedisResultStore, ResultStore
 
 
 def _parse_body(handler: BaseHTTPRequestHandler) -> dict:
@@ -109,19 +115,60 @@ class FsmHandler(BaseHTTPRequestHandler):
                                         "error": "use POST"}))
 
     def _admin(self, task: str) -> None:
-        if task == "ping":
-            self._send(200, json.dumps({"status": "up"}))
-        elif task == "algorithms":
-            self._send(200, json.dumps(sorted(plugins.ALGORITHMS)))
-        else:
-            self._send(404, json.dumps({"status": "failure",
-                                        "error": f"unknown admin task {task!r}"}))
+        try:
+            if task == "ping":
+                self._send(200, json.dumps({"status": "up"}))
+            elif task == "algorithms":
+                self._send(200, json.dumps(sorted(plugins.ALGORITHMS)))
+            elif task == "stats":
+                self._send(200, json.dumps(service_stats(self.master)))
+            elif task == "config":
+                self._send(200, json.dumps(
+                    dataclasses.asdict(cfgmod.get_config())))
+            else:
+                self._send(404, json.dumps(
+                    {"status": "failure",
+                     "error": f"unknown admin task {task!r}"}))
+        except Exception as exc:  # e.g. store backend down: JSON envelope,
+            self._send(500, json.dumps({       # not a dropped connection
+                "status": "failure", "error": str(exc)}))
+
+
+def service_stats(master: Master) -> dict:
+    """Service-wide metrics for /admin/stats (SURVEY.md sec 5 metrics row):
+    job counters from the store plus the device/backend the engines see."""
+    import jax
+
+    store = master.store
+    counters = {
+        name: int(store.get(f"fsm:metric:{name}") or 0)
+        for name in ("jobs_submitted", "jobs_finished", "jobs_failed",
+                     "stream_pushes", "stream_failures")
+    }
+    mesh_devices = cfgmod.get_config().engine.mesh_devices
+    return {
+        "jobs": counters,
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "mesh_devices": mesh_devices,
+        "algorithms": sorted(plugins.ALGORITHMS),
+    }
+
+
+def make_store(cfg: Optional[cfgmod.Config] = None) -> ResultStore:
+    cfg = cfg if cfg is not None else cfgmod.get_config()
+    if cfg.store.backend == "redis":
+        return RedisResultStore(cfg.store.host, cfg.store.port)
+    return ResultStore()
 
 
 def make_server(port: int = 0, host: str = "127.0.0.1",
                 master: Optional[Master] = None,
                 miner_workers: int = 1) -> ThreadingHTTPServer:
-    m = master if master is not None else Master(miner_workers=miner_workers)
+    if master is not None:
+        m = master
+    else:
+        m = Master(store=make_store(), miner_workers=miner_workers)
     handler = type("BoundFsmHandler", (FsmHandler,), {"master": m})
     server = ThreadingHTTPServer((host, port), handler)
     server.master = m  # type: ignore[attr-defined]
@@ -138,12 +185,26 @@ def serve_background(port: int = 0) -> ThreadingHTTPServer:
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="spark_fsm_tpu service")
-    parser.add_argument("--port", type=int, default=9000)
-    parser.add_argument("--host", default="127.0.0.1")
-    parser.add_argument("--miner-workers", type=int, default=1)
+    parser.add_argument("--config", default=None,
+                        help="boot config file (.toml or .json); flags "
+                             "below override its [service] section")
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--miner-workers", type=int, default=None)
     args = parser.parse_args()
-    server = make_server(args.port, args.host, miner_workers=args.miner_workers)
-    print(f"spark_fsm_tpu service on http://{args.host}:{server.server_port}")
+    cfg = cfgmod.load_config(args.config) if args.config else cfgmod.Config()
+    if args.port is not None:
+        cfg.service.port = args.port
+    if args.host is not None:
+        cfg.service.host = args.host
+    if args.miner_workers is not None:
+        cfg.service.miner_workers = args.miner_workers
+    cfgmod.set_config(cfg)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    server = make_server(cfg.service.port, cfg.service.host,
+                         miner_workers=cfg.service.miner_workers)
+    print(f"spark_fsm_tpu service on http://{cfg.service.host}:"
+          f"{server.server_port}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
+from spark_fsm_tpu import config
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import abs_minsup
 from spark_fsm_tpu.service.model import ServiceRequest
@@ -31,9 +32,12 @@ Results = Union[List[PatternResult], List[RuleResult]]
 
 @dataclasses.dataclass
 class AlgorithmPlugin:
+    """``extract(req, db, stats=None)``; a provided ``stats`` dict receives
+    the engine's observability counters (SURVEY.md sec 5 metrics row)."""
+
     name: str
     kind: str  # "patterns" | "rules"
-    extract: Callable[[ServiceRequest, SequenceDB], Results]
+    extract: Callable[..., Results]
 
 
 def _minsup(req: ServiceRequest, db: SequenceDB) -> int:
@@ -53,25 +57,35 @@ def _constraints(req: ServiceRequest) -> Tuple[Optional[int], Optional[int]]:
             int(mw) if mw is not None else None)
 
 
-def _spade_cpu(req: ServiceRequest, db: SequenceDB) -> Results:
+def _spade_cpu(req: ServiceRequest, db: SequenceDB,
+               stats: Optional[dict] = None) -> Results:
     from spark_fsm_tpu.models.oracle import mine_cspade, mine_spade
 
     minsup = _minsup(req, db)
     maxgap, maxwindow = _constraints(req)
     if maxgap is None and maxwindow is None:
-        return mine_spade(db, minsup)
-    return mine_cspade(db, minsup, maxgap=maxgap, maxwindow=maxwindow)
+        results = mine_spade(db, minsup)
+    else:
+        results = mine_cspade(db, minsup, maxgap=maxgap, maxwindow=maxwindow)
+    if stats is not None:
+        stats["patterns"] = len(results)
+    return results
 
 
-def _spade_tpu(req: ServiceRequest, db: SequenceDB) -> Results:
+def _spade_tpu(req: ServiceRequest, db: SequenceDB,
+               stats: Optional[dict] = None) -> Results:
     from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
     from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
 
     minsup = _minsup(req, db)
     maxgap, maxwindow = _constraints(req)
+    kwargs = config.engine_kwargs("pool_bytes", "node_batch",
+                                  "pipeline_depth", "chunk", "recompute_chunk")
+    mesh = config.get_mesh()
     if maxgap is None and maxwindow is None:
-        return mine_spade_tpu(db, minsup)
-    return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow)
+        return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats, **kwargs)
+    return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow,
+                           mesh=mesh, stats_out=stats, **kwargs)
 
 
 def _tsr_params(req: ServiceRequest):
@@ -81,18 +95,32 @@ def _tsr_params(req: ServiceRequest):
     return k, minconf, int(max_side) if max_side else None
 
 
-def _tsr_cpu(req: ServiceRequest, db: SequenceDB) -> Results:
+def _tsr_kwargs() -> dict:
+    # TSR's batch width is a separate boot knob from SPADE's (tsr_chunk):
+    # the two engines' defaults differ 8x and must not be tuned together.
+    kwargs = config.engine_kwargs("item_cap")
+    tsr_chunk = config.engine_kwargs("tsr_chunk").get("tsr_chunk")
+    if tsr_chunk is not None:
+        kwargs["chunk"] = tsr_chunk
+    return kwargs
+
+
+def _tsr_cpu(req: ServiceRequest, db: SequenceDB,
+             stats: Optional[dict] = None) -> Results:
     from spark_fsm_tpu.models.tsr import mine_tsr_cpu
 
     k, minconf, max_side = _tsr_params(req)
-    return mine_tsr_cpu(db, k, minconf, max_side=max_side)
+    return mine_tsr_cpu(db, k, minconf, max_side=max_side, stats_out=stats,
+                        **_tsr_kwargs())
 
 
-def _tsr_tpu(req: ServiceRequest, db: SequenceDB) -> Results:
+def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
+             stats: Optional[dict] = None) -> Results:
     from spark_fsm_tpu.models.tsr import mine_tsr_tpu
 
     k, minconf, max_side = _tsr_params(req)
-    return mine_tsr_tpu(db, k, minconf, max_side=max_side)
+    return mine_tsr_tpu(db, k, minconf, max_side=max_side, mesh=config.get_mesh(),
+                        stats_out=stats, **_tsr_kwargs())
 
 
 ALGORITHMS: Dict[str, AlgorithmPlugin] = {

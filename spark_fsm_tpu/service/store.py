@@ -55,10 +55,18 @@ class ResultStore:
             self._kv.pop(key, None)
             self._lists.pop(key, None)
 
+    def incr(self, key: str) -> int:
+        """Redis INCR: atomic counter (service metrics live on these)."""
+        with self._lock:
+            value = int(self._kv.get(key, "0")) + 1
+            self._kv[key] = str(value)
+            return value
+
     def clear_job(self, uid: str, *, keep_status_log: bool = False) -> None:
         """Remove a job's error/results (and optionally its status log) so a
         reused uid reports THIS job, not a predecessor's leftovers."""
-        keys = [f"fsm:error:{uid}", f"fsm:pattern:{uid}", f"fsm:rule:{uid}"]
+        keys = [f"fsm:error:{uid}", f"fsm:pattern:{uid}", f"fsm:rule:{uid}",
+                f"fsm:stats:{uid}"]
         if not keep_status_log:
             keys.append(f"fsm:status:log:{uid}")
         for key in keys:
@@ -140,3 +148,6 @@ class RedisResultStore(ResultStore):
 
     def delete(self, key: str) -> None:
         self._r.delete(key)
+
+    def incr(self, key: str) -> int:
+        return int(self._r.incr(key))

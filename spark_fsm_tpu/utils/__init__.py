@@ -1,1 +1,3 @@
-"""Utilities: canonical pattern serialization, profiling, logging."""
+"""Utilities: canonical pattern/rule ordering (utils.canonical) and
+observability — structured JSON-line logs + jax.profiler capture
+(utils.obs)."""

@@ -1,0 +1,48 @@
+"""Observability: structured logging + profiler trace capture.
+
+The reference gets logging from log4j/slf4j and profiling from the Spark
+web UI (SURVEY.md sec 5 tracing + metrics rows).  The rebuild's analogs:
+structured JSON-line logs through stdlib ``logging`` (one object per line
+— grep/jq-able job lifecycle events), and ``jax.profiler`` trace capture
+(XProf/Perfetto-readable) scoped around a mine when a job asks for it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import threading
+import time
+
+logger = logging.getLogger("spark_fsm_tpu")
+
+
+def log_event(event: str, **fields) -> None:
+    """Emit one JSON object per line: {"event": ..., "ts": ..., **fields}.
+
+    Quiet unless the host app configures the ``spark_fsm_tpu`` logger (or
+    logging.basicConfig); the service CLI enables INFO by default.
+    """
+    payload = {"event": event, "ts": round(time.time(), 3)}
+    payload.update(fields)
+    logger.info(json.dumps(payload, default=str, sort_keys=True))
+
+
+_trace_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str):
+    """``jax.profiler.trace`` scope when ``trace_dir`` is set; no-op else.
+
+    jax.profiler allows ONE active trace per process, so concurrently
+    profiled jobs serialize on a lock rather than failing the second job.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with _trace_lock, jax.profiler.trace(trace_dir):
+        yield

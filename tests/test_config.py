@@ -1,0 +1,203 @@
+"""Boot config (SURVEY.md sec 5 config row) + observability tests.
+
+The reference boots from application.conf (Typesafe Config); the rebuild
+boots from TOML/JSON.  Also covers the metrics surface the reference lacks
+but SURVEY.md sec 5 requires: engine stats in /status, /admin/stats
+counters, and jax.profiler trace capture around a mine.
+"""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.config import Config, ConfigError, load_config, parse_config
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.service.app import serve_background, service_stats
+
+
+# ---------------------------------------------------------------- parsing
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.service.port == 9000
+    assert cfg.store.backend == "inproc"
+    assert cfg.engine.mesh_devices == 0
+    assert cfg.engine.pool_bytes is None
+    assert cfg.profile_dir == ""
+
+
+def test_load_toml(tmp_path):
+    p = tmp_path / "fsm.toml"
+    p.write_text(
+        'profile_dir = "traces"\n'
+        "[service]\nhost = \"0.0.0.0\"\nport = 9100\nminer_workers = 2\n"
+        "[store]\nbackend = \"redis\"\nport = 6380\n"
+        "[engine]\nmesh_devices = 8\npool_bytes = 1073741824\nnode_batch = 64\n"
+    )
+    cfg = load_config(str(p))
+    assert cfg.service.host == "0.0.0.0"
+    assert cfg.service.port == 9100
+    assert cfg.service.miner_workers == 2
+    assert cfg.store.backend == "redis"
+    assert cfg.store.port == 6380
+    assert cfg.engine.mesh_devices == 8
+    assert cfg.engine.pool_bytes == 1 << 30
+    assert cfg.engine.node_batch == 64
+    assert cfg.profile_dir == "traces"
+
+
+def test_load_json(tmp_path):
+    p = tmp_path / "fsm.json"
+    p.write_text(json.dumps({"service": {"port": 9200},
+                             "engine": {"chunk": 128}}))
+    cfg = load_config(str(p))
+    assert cfg.service.port == 9200
+    assert cfg.engine.chunk == 128
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(ConfigError, match="unknown key"):
+        parse_config({"service": {"prot": 9000}})
+    with pytest.raises(ConfigError, match="unknown top-level"):
+        parse_config({"sevice": {"port": 9000}})
+    with pytest.raises(ConfigError, match="backend"):
+        parse_config({"store": {"backend": "memcached"}})
+    # scalar where a table is required: clear error, not character soup
+    with pytest.raises(ConfigError, match="must be a table"):
+        parse_config({"service": "ab"})
+    with pytest.raises(ConfigError, match="must be a table"):
+        parse_config({"engine": 5})
+
+
+def test_engine_kwargs_and_mesh():
+    try:
+        cfgmod.set_config(parse_config(
+            {"engine": {"pool_bytes": 123, "mesh_devices": 8}}))
+        assert cfgmod.engine_kwargs("pool_bytes", "node_batch") == {
+            "pool_bytes": 123}
+        mesh = cfgmod.get_mesh()
+        assert mesh is not None and mesh.devices.size == 8
+        assert cfgmod.get_mesh() is mesh  # cached
+    finally:
+        cfgmod.set_config(Config())
+    assert cfgmod.get_mesh() is None
+
+
+# ---------------------------------------------------------- observability
+
+@pytest.fixture(scope="module")
+def server():
+    srv = serve_background()
+    yield srv
+    srv.master.shutdown()
+    srv.shutdown()
+
+
+def _post(server, endpoint, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{server.server_port}{endpoint}"
+    with urllib.request.urlopen(url, data=data, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _await(server, uid, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        resp = _post(server, f"/status/{uid}")
+        if resp["status"] in ("finished", "failure"):
+            return resp
+        time.sleep(0.05)
+    raise AssertionError("timeout")
+
+
+def _train(server, **extra):
+    db = synthetic_db(seed=11, n_sequences=120, n_items=10, mean_itemsets=4.0)
+    resp = _post(server, "/train", algorithm="SPADE_TPU", source="INLINE",
+                 sequences=format_spmf(db), support="0.05", **extra)
+    assert resp["status"] == "started"
+    return resp["data"]["uid"]
+
+
+def test_status_carries_engine_stats(server):
+    uid = _train(server)
+    resp = _await(server, uid)
+    assert resp["status"] == "finished"
+    stats = json.loads(resp["data"]["stats"])
+    assert stats["algorithm"] == "SPADE_TPU"
+    assert stats["sequences"] == 120
+    assert stats["results"] == stats["patterns"] > 0
+    assert stats["kernel_launches"] > 0
+    assert stats["mine_s"] >= 0
+    assert stats["results_per_s"] > 0
+
+
+def test_admin_stats_counters(server):
+    before = _post(server, "/admin/stats")
+    uid = _train(server)
+    assert _await(server, uid)["status"] == "finished"
+    after = _post(server, "/admin/stats")
+    assert after["jobs"]["jobs_submitted"] >= before["jobs"]["jobs_submitted"] + 1
+    assert after["jobs"]["jobs_finished"] >= before["jobs"]["jobs_finished"] + 1
+    assert after["backend"] == "cpu"  # conftest forces CPU in tests
+    assert after["devices"] == 8
+    assert "SPADE_TPU" in after["algorithms"]
+    # direct call mirrors the endpoint
+    assert service_stats(server.master)["jobs"] == after["jobs"]
+
+
+def test_admin_config_roundtrip(server):
+    cfg = _post(server, "/admin/config")
+    assert cfg["service"]["port"] == 9000  # default config active
+    assert cfg["store"]["backend"] == "inproc"
+
+
+def test_failed_job_counted(server):
+    resp = _post(server, "/train", algorithm="SPADE_TPU", source="FILE",
+                 path="/nonexistent/file.spmf", support="0.05")
+    uid = resp["data"]["uid"]
+    resp = _await(server, uid)
+    assert resp["status"] == "failure"
+    after = _post(server, "/admin/stats")
+    assert after["jobs"]["jobs_failed"] >= 1
+
+
+def test_profile_trace_captured(server, tmp_path):
+    trace_dir = tmp_path / "trace"
+    uid = _train(server, profile=str(trace_dir))
+    resp = _await(server, uid)
+    assert resp["status"] == "finished"
+    stats = json.loads(resp["data"]["stats"])
+    assert stats["profile_trace"] == str(trace_dir)
+    # jax.profiler writes a plugins/ or *.pb trace tree under the dir
+    assert trace_dir.exists() and any(trace_dir.rglob("*"))
+
+
+def test_profile_flag_without_config_dir_fails(server):
+    uid = _train(server, profile="1")
+    resp = _await(server, uid)
+    assert resp["status"] == "failure"
+    assert "profile_dir" in resp["data"]["error"]
+
+
+def test_profile_false_spellings_disable(server):
+    # JSON bodies coerce false -> "False"; none of these may trigger
+    # profiling (which would fail here: no profile_dir configured)
+    for value in ("False", "0", "off", "NO", ""):
+        uid = _train(server, profile=value)
+        assert _await(server, uid)["status"] == "finished", value
+
+
+def test_stream_failure_counter_separate(server):
+    # a bad first push fails config validation -> stream_failures, and
+    # jobs_failed (batch jobs) must not absorb it
+    before = _post(server, "/admin/stats")["jobs"]
+    resp = _post(server, "/stream/cfg_bad_topic",
+                 sequences="1 -1 -2", support="0.5", algorithm="NOPE")
+    assert resp["status"] == "failure"
+    after = _post(server, "/admin/stats")["jobs"]
+    assert after["jobs_failed"] == before["jobs_failed"]
