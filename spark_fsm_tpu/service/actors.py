@@ -315,6 +315,9 @@ class Streamer:
                                  "zero sequences")
             state = self._topic_state(req, topic)
         except ValueError as exc:
+            # config/parse rejections count as stream failures too, so
+            # /admin/stats reflects every failed push
+            self.store.incr("fsm:metric:stream_failures")
             return model.response(req, Status.FAILURE, error=str(exc))
         uid = f"stream:{topic}"
         miner = state["miner"]

@@ -1,0 +1,132 @@
+"""Minimal RESP2 (Redis Serialization Protocol) client over stdlib sockets.
+
+The reference persists results/metadata in Redis through a JVM client
+(SURVEY.md sec 2 "Redis sink/cache").  This rebuild talks the wire
+protocol directly — no third-party client package — which keeps the Redis
+seam real and testable in a sandbox with no Redis server: the test suite
+runs ``RedisResultStore`` against an in-process RESP server
+(tests/test_redis_store.py), and the same bytes reach a production Redis.
+
+Covers what the store needs: command pipelining-free request/response with
+simple strings, errors, integers, bulk strings, and arrays.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import List, Optional, Union
+
+Reply = Union[None, int, str, List["Reply"]]
+
+
+class RespError(RuntimeError):
+    """Server-side error reply (RESP '-ERR ...')."""
+
+
+def encode_command(*args: Union[str, bytes, int]) -> bytes:
+    """Encode one command as a RESP array of bulk strings."""
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        b = a if isinstance(a, bytes) else str(a).encode("utf-8")
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+class RespClient:
+    """Blocking request/response client; thread-safe via a send lock."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout: float = 10.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------------- io
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:  # payload + trailing \r\n
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        payload, self._buf = self._buf[:n], self._buf[n + 2:]
+        return payload
+
+    def _read_reply(self) -> Reply:
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":  # simple string
+            return rest.decode("utf-8")
+        if kind == b"-":  # error
+            raise RespError(rest.decode("utf-8"))
+        if kind == b":":  # integer
+            return int(rest)
+        if kind == b"$":  # bulk string
+            n = int(rest)
+            if n == -1:
+                return None
+            return self._read_exact(n).decode("utf-8")
+        if kind == b"*":  # array
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RespError(f"unknown RESP reply type {line!r}")
+
+    # ------------------------------------------------------------ command
+
+    def command(self, *args: Union[str, bytes, int]) -> Reply:
+        with self._lock:
+            self._sock.sendall(encode_command(*args))
+            return self._read_reply()
+
+    # convenience wrappers (the subset the store uses)
+
+    def set(self, key: str, value: str) -> None:
+        self.command("SET", key, value)
+
+    def get(self, key: str) -> Optional[str]:
+        reply = self.command("GET", key)
+        assert reply is None or isinstance(reply, str)
+        return reply
+
+    def rpush(self, key: str, value: str) -> int:
+        reply = self.command("RPUSH", key, value)
+        assert isinstance(reply, int)
+        return reply
+
+    def lrange(self, key: str, start: int = 0, stop: int = -1) -> List[str]:
+        reply = self.command("LRANGE", key, start, stop)
+        if reply is None:
+            return []
+        assert isinstance(reply, list)
+        return [r for r in reply if isinstance(r, str)]
+
+    def delete(self, key: str) -> int:
+        reply = self.command("DEL", key)
+        assert isinstance(reply, int)
+        return reply
+
+    def incr(self, key: str) -> int:
+        reply = self.command("INCR", key)
+        assert isinstance(reply, int)
+        return reply
+
+    def ping(self) -> bool:
+        return self.command("PING") == "PONG"

@@ -16,9 +16,11 @@ Registered sources:
              reference's track->mine loop without an external store.
   SYNTH    — seeded synthetic DB (no-egress stand-in for the public
              benchmark datasets; see data/synth.py).
-  ELASTIC / JDBC / PIWIK — interface stubs: constructing them raises a
-             clear error in this sandbox (no network egress / no driver),
-             but the registry seam and parameter names match SURVEY.md.
+  JDBC     — SQL database via stdlib sqlite3 (``db``/``url`` + ``query``
+             or ``table``), with the same field-role mapping as TRACKED.
+  ELASTIC / PIWIK — interface stubs: constructing them raises a clear
+             error in this sandbox (no network egress), but the registry
+             seam and parameter names match SURVEY.md.
 """
 
 from __future__ import annotations
@@ -75,34 +77,29 @@ def field_map(store: ResultStore, topic: str) -> Dict[str, str]:
     return mapping
 
 
-def tracked_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
-    """Group tracked events into sequences.
+def events_to_db(events: List[dict], fm: Dict[str, str],
+                 origin: str) -> SequenceDB:
+    """Group role-mapped events into an SPMF sequence database.
 
-    Events are JSON objects; the registered field spec for the topic maps
-    the site/user/timestamp/group/item roles onto the event's field names
-    (see ``field_map``).  Sequence key = (site, user); each distinct group
-    id forms ONE itemset (even if its rows interleave in time with other
-    groups), and itemsets are ordered by the group's first timestamp —
-    the reference's field-spec semantics (SURVEY.md sec 2 "Registrar /
-    field spec").
+    Shared by the TRACKED and JDBC sources: sequence key = (site, user);
+    each distinct group id forms ONE itemset (even if its rows interleave
+    in time with other groups), and itemsets are ordered by the group's
+    first timestamp — the reference's field-spec semantics (SURVEY.md
+    sec 2 "Registrar / field spec").
     """
-    topic = req.param("topic", "item")
-    events = store.tracked(topic)
-    if not events:
-        raise SourceError(f"no tracked events for topic {topic!r}")
-    fm = field_map(store, topic)
     sessions: Dict[Tuple[str, str], Dict[int, List[Tuple[int, int]]]] = {}
-    for ev_json in events:
-        ev = json.loads(ev_json)
+    for ev in events:
         key = (str(ev.get(fm["site"], "")), str(ev.get(fm["user"], "")))
-        ts = int(ev.get(fm["timestamp"], 0))
-        group = int(ev.get(fm["group"], ts))
-        if fm["item"] not in ev:
-            # spec registered/changed after this event was tracked
+        ts_raw = ev.get(fm["timestamp"])
+        ts = int(ts_raw) if ts_raw not in (None, "") else 0
+        g_raw = ev.get(fm["group"])
+        group = int(g_raw) if g_raw not in (None, "") else ts
+        if fm["item"] not in ev or ev[fm["item"]] is None:
+            # spec registered/changed after this event was recorded
             raise SourceError(
-                f"tracked event for topic {topic!r} has no field "
-                f"{fm['item']!r} (the registered 'item' role); event keys: "
-                f"{sorted(ev)} — re-track or fix the /register spec")
+                f"{origin} event has no field {fm['item']!r} (the "
+                f"registered 'item' role); event keys: {sorted(ev)} — "
+                f"fix the /register spec or the source data")
         item = int(ev[fm["item"]])
         sessions.setdefault(key, {}).setdefault(group, []).append((ts, item))
     db: SequenceDB = []
@@ -115,6 +112,70 @@ def tracked_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
         if itemsets:
             db.append(tuple(itemsets))
     return db
+
+
+def tracked_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    """Events ingested via /track, grouped per the topic's field spec."""
+    topic = req.param("topic", "item")
+    events = store.tracked(topic)
+    if not events:
+        raise SourceError(f"no tracked events for topic {topic!r}")
+    fm = field_map(store, topic)
+    return events_to_db([json.loads(e) for e in events], fm,
+                        origin=f"tracked topic {topic!r}")
+
+
+def jdbc_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    """SQL database source — the reference's JdbcSource seam, implemented
+    on stdlib sqlite3 (the sandbox's JDBC-reachable database).
+
+    Params: ``db`` = sqlite file path (or ``url`` = ``sqlite:///path``),
+    plus ``query`` (SQL whose result columns carry the role fields) or
+    ``table`` (SELECT * FROM table).  Column-name -> role mapping comes
+    from the topic's registered field spec, exactly like TRACKED.
+    """
+    url = req.param("url")
+    path = req.param("db")
+    if url:
+        if not url.startswith("sqlite:///"):
+            raise SourceError(
+                f"JDBC url {url!r} unsupported: this build speaks "
+                f"sqlite:///path (no network egress for remote databases)")
+        path = url[len("sqlite:///"):]
+    if not path:
+        raise SourceError("JDBC source needs a 'db' (sqlite file path) "
+                          "or 'url' (sqlite:///path) parameter")
+    query = req.param("query")
+    table = req.param("table")
+    if query is None:
+        if not table:
+            raise SourceError("JDBC source needs a 'query' or 'table' "
+                              "parameter")
+        if not table.replace("_", "").isalnum():
+            raise SourceError(f"invalid table name {table!r}")
+        query = f"SELECT * FROM {table}"
+
+    import sqlite3
+
+    try:
+        # open read-only so a typo'd path errors instead of creating a db
+        conn = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
+    except sqlite3.OperationalError as exc:
+        raise SourceError(f"cannot open sqlite db {path!r}: {exc}") from exc
+    try:
+        cur = conn.execute(query)
+        if cur.description is None:  # empty/comment-only/non-SELECT query
+            raise SourceError(f"JDBC query returned no result set: {query!r}")
+        cols = [d[0] for d in cur.description]
+        events = [dict(zip(cols, row)) for row in cur.fetchall()]
+    except sqlite3.Error as exc:
+        raise SourceError(f"JDBC query failed: {exc}") from exc
+    finally:
+        conn.close()
+    if not events:
+        raise SourceError(f"JDBC query returned no rows: {query!r}")
+    fm = field_map(store, req.param("topic", "item"))
+    return events_to_db(events, fm, origin="JDBC row")
 
 
 def synth_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
@@ -146,7 +207,7 @@ SOURCES: Dict[str, Callable[[ServiceRequest, ResultStore], SequenceDB]] = {
     "SYNTH": synth_source,
     # reference parity: ElasticSource / JdbcSource / PiwikSource seams
     "ELASTIC": _stub("ELASTIC", "requires an Elasticsearch endpoint"),
-    "JDBC": _stub("JDBC", "requires a JDBC-reachable database"),
+    "JDBC": jdbc_source,
     "PIWIK": _stub("PIWIK", "requires a Piwik analytics database"),
 }
 

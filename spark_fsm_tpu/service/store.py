@@ -8,9 +8,9 @@ with two implementations:
 
 - ``ResultStore``: in-process, thread-safe dict store (the default — no
   external service needed, mirrors Redis key semantics).
-- ``RedisResultStore``: thin adapter over a real Redis client when the
-  ``redis`` package is importable (not bundled in this sandbox; the class
-  degrades to an ImportError at construction, keeping the seam visible).
+- ``RedisResultStore``: the same contract over a real Redis server,
+  speaking RESP2 directly via service/resp.py (no client package);
+  selected with ``store.backend = "redis"`` in the boot config.
 
 Key layout follows the reference's convention: ``fsm:status:<uid>``,
 ``fsm:pattern:<uid>``, ``fsm:rule:<uid>``, ``fsm:fields:<topic>``,
@@ -121,18 +121,20 @@ class ResultStore:
 
 
 class RedisResultStore(ResultStore):
-    """Adapter over a real Redis (optional dependency seam).
-
-    Cites the reference's RedisSink/RedisCache pair (SURVEY.md sec 2).
-    Raises ImportError at construction when the client library is absent;
-    every deployment in this sandbox uses the in-process store.
+    """Store over a real Redis — the reference's RedisSink/RedisCache pair
+    (SURVEY.md sec 2), speaking RESP2 directly via service/resp.py (no
+    client package needed).  Same key layout as the in-process store, so
+    the two are interchangeable behind ``store.backend`` in the boot
+    config; protocol-tested against an in-process RESP server in
+    tests/test_redis_store.py.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379) -> None:
         super().__init__()
-        import redis  # not bundled: documented seam, exercised elsewhere
+        from spark_fsm_tpu.service.resp import RespClient
 
-        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+        self._r = RespClient(host=host, port=port)
+        self._r.ping()  # fail fast at boot, not on first job
 
     def set(self, key: str, value: str) -> None:
         self._r.set(key, value)
@@ -150,4 +152,4 @@ class RedisResultStore(ResultStore):
         self._r.delete(key)
 
     def incr(self, key: str) -> int:
-        return int(self._r.incr(key))
+        return self._r.incr(key)

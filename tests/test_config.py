@@ -201,3 +201,4 @@ def test_stream_failure_counter_separate(server):
     assert resp["status"] == "failure"
     after = _post(server, "/admin/stats")["jobs"]
     assert after["jobs_failed"] == before["jobs_failed"]
+    assert after["stream_failures"] == before["stream_failures"] + 1

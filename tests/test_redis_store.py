@@ -1,0 +1,212 @@
+"""Protocol-level RedisResultStore tests against an in-process RESP server.
+
+The reference's RedisSink/RedisCache talk to a real Redis (SURVEY.md
+sec 2); the rebuild's store speaks RESP2 on the wire (service/resp.py).
+These tests run a miniature Redis — a socket server implementing the six
+commands the store uses — so the exact bytes the store would send to
+production Redis are what's exercised here.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.resp import RespClient, RespError, encode_command
+from spark_fsm_tpu.service.store import RedisResultStore
+
+
+class MiniRedis:
+    """RESP2 server on a loopback socket: SET/GET/RPUSH/LRANGE/DEL/INCR/PING."""
+
+    def __init__(self):
+        self.kv = {}
+        self.lists = {}
+        self.lock = threading.Lock()
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        self.commands_seen = []
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n + 2:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            payload, buf = buf[:n], buf[n + 2:]
+            return payload
+
+        try:
+            while True:
+                line = read_line()
+                assert line[:1] == b"*", line
+                nargs = int(line[1:])
+                args = []
+                for _ in range(nargs):
+                    hdr = read_line()
+                    assert hdr[:1] == b"$", hdr
+                    args.append(read_exact(int(hdr[1:])).decode())
+                conn.sendall(self._dispatch(args))
+        except (ConnectionError, OSError):
+            conn.close()
+
+    def _dispatch(self, args):
+        cmd, rest = args[0].upper(), args[1:]
+        self.commands_seen.append(cmd)
+        with self.lock:
+            if cmd == "PING":
+                return b"+PONG\r\n"
+            if cmd == "SET":
+                self.kv[rest[0]] = rest[1]
+                return b"+OK\r\n"
+            if cmd == "GET":
+                v = self.kv.get(rest[0])
+                if v is None:
+                    return b"$-1\r\n"
+                vb = v.encode()
+                return b"$%d\r\n%s\r\n" % (len(vb), vb)
+            if cmd == "RPUSH":
+                lst = self.lists.setdefault(rest[0], [])
+                lst.extend(rest[1:])
+                return b":%d\r\n" % len(lst)
+            if cmd == "LRANGE":
+                lst = self.lists.get(rest[0], [])
+                start, stop = int(rest[1]), int(rest[2])
+                stop = len(lst) if stop == -1 else stop + 1
+                out = [b"*%d\r\n" % len(lst[start:stop])]
+                for v in lst[start:stop]:
+                    vb = v.encode()
+                    out.append(b"$%d\r\n%s\r\n" % (len(vb), vb))
+                return b"".join(out)
+            if cmd == "DEL":
+                n = 0
+                for k in rest:
+                    n += (self.kv.pop(k, None) is not None) + \
+                         (self.lists.pop(k, None) is not None)
+                return b":%d\r\n" % n
+            if cmd == "INCR":
+                v = int(self.kv.get(rest[0], "0")) + 1
+                self.kv[rest[0]] = str(v)
+                return b":%d\r\n" % v
+            return b"-ERR unknown command '%s'\r\n" % cmd.encode()
+
+    def close(self):
+        self.srv.close()
+
+
+@pytest.fixture()
+def mini_redis():
+    server = MiniRedis()
+    yield server
+    server.close()
+
+
+def test_encode_command_bytes():
+    assert encode_command("SET", "k", "v") == \
+        b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+
+
+def test_client_roundtrip(mini_redis):
+    c = RespClient(port=mini_redis.port)
+    assert c.ping()
+    c.set("a", "hello\r\nworld")  # CRLF inside a bulk string survives
+    assert c.get("a") == "hello\r\nworld"
+    assert c.get("missing") is None
+    assert c.rpush("l", "x") == 1
+    assert c.rpush("l", "y") == 2
+    assert c.lrange("l") == ["x", "y"]
+    assert c.incr("n") == 1
+    assert c.incr("n") == 2
+    assert c.delete("a") == 1
+    assert c.get("a") is None
+    with pytest.raises(RespError, match="unknown command"):
+        c.command("FLUSHALL")
+    c.close()
+
+
+def test_store_contract_over_wire(mini_redis):
+    store = RedisResultStore(port=mini_redis.port)
+    # status registry
+    store.add_status("u1", "started")
+    store.add_status("u1", "finished")
+    assert store.status("u1") == "finished"
+    assert [s for _, s in store.status_log("u1")] == ["started", "finished"]
+    # results
+    store.add_patterns("u1", '[{"support": 3}]')
+    assert store.patterns("u1") == '[{"support": 3}]'
+    store.add_rules("u1", "[]")
+    assert store.rules("u1") == "[]"
+    # field specs + tracked events
+    store.add_fields("t", '{"item": "sku"}')
+    assert store.fields("t") == '{"item": "sku"}'
+    store.track("t", '{"sku": 5}')
+    assert store.tracked("t") == ['{"sku": 5}']
+    # counters + job cleanup
+    assert store.incr("fsm:metric:jobs_submitted") == 1
+    store.clear_job("u1")
+    assert store.patterns("u1") is None
+    assert store.status("u1") == "finished"  # clear_job keeps nothing? no:
+    # clear_job without keep_status_log drops the log but not the status key
+    assert store.status_log("u1") == []
+    # every op above went over the socket, not the in-proc fallback
+    assert "SET" in mini_redis.commands_seen
+    assert "RPUSH" in mini_redis.commands_seen
+    assert "INCR" in mini_redis.commands_seen
+
+
+def test_store_end_to_end_mine(mini_redis):
+    """A full train job through the Master with Redis-backed persistence."""
+    from spark_fsm_tpu.service.actors import Master
+
+    store = RedisResultStore(port=mini_redis.port)
+    master = Master(store=store)
+    try:
+        req = ServiceRequest("fsm", "train", {
+            "algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n2 -1 1 -2\n",
+            "support": "0.5"})
+        resp = master.handle(req)
+        uid = resp.data["uid"]
+        deadline = __import__("time").time() + 30
+        while __import__("time").time() < deadline:
+            if store.status(uid) in ("finished", "failure"):
+                break
+            __import__("time").sleep(0.02)
+        assert store.status(uid) == "finished", store.get(f"fsm:error:{uid}")
+        assert store.patterns(uid) is not None
+        # the mined patterns live in the mini-redis dict, not process memory
+        assert mini_redis.kv[f"fsm:pattern:{uid}"] == store.patterns(uid)
+    finally:
+        master.shutdown()
+
+
+def test_store_fails_fast_when_down():
+    with pytest.raises(OSError):
+        RedisResultStore(port=1)  # nothing listens there

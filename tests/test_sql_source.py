@@ -1,0 +1,106 @@
+"""JDBC (SQL) source tests — the reference's JdbcSource seam on sqlite3.
+
+SURVEY.md sec 2 "Sequence sources": rows -> role-mapped events -> grouped
+sequences, sharing the field-spec semantics with the TRACKED source.
+"""
+
+import json
+import sqlite3
+
+import pytest
+
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.sources import SourceError, get_db, jdbc_source
+from spark_fsm_tpu.service.store import ResultStore
+
+
+def _mkdb(path, rows, cols=("site", "user", "timestamp", "grp", "item")):
+    conn = sqlite3.connect(path)
+    conn.execute(f"CREATE TABLE clicks ({', '.join(cols)})")
+    conn.executemany(
+        f"INSERT INTO clicks VALUES ({', '.join('?' * len(cols))})", rows)
+    conn.commit()
+    conn.close()
+
+
+def _req(**data):
+    return ServiceRequest("fsm", "train", {k: str(v) for k, v in data.items()})
+
+
+def test_table_with_registered_spec(tmp_path):
+    path = str(tmp_path / "clicks.db")
+    # two users; user A has groups 10 (items 1,3) then 20 (item 2)
+    _mkdb(path, [
+        ("s", "A", 100, 10, 1),
+        ("s", "A", 105, 10, 3),
+        ("s", "A", 200, 20, 2),
+        ("s", "B", 50, 7, 4),
+    ])
+    store = ResultStore()
+    # non-default column name 'grp' mapped onto the 'group' role
+    store.add_fields("item", json.dumps({"group": "grp"}))
+    db = jdbc_source(_req(db=path, table="clicks"), store)
+    assert db == [((1, 3), (2,)), ((4,),)]
+
+
+def test_query_and_url_form(tmp_path):
+    path = str(tmp_path / "q.db")
+    _mkdb(path, [("s", "A", 1, 1, 9), ("s", "A", 2, 2, 8)])
+    store = ResultStore()
+    store.add_fields("item", json.dumps({"group": "grp"}))
+    db = get_db(_req(source="JDBC", url=f"sqlite:///{path}",
+                     query="SELECT * FROM clicks WHERE item > 8"), store)
+    assert db == [((9,),)]
+
+
+def test_column_aliasing_in_query(tmp_path):
+    """SQL aliases can do the role mapping instead of a registered spec."""
+    path = str(tmp_path / "alias.db")
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE ev (host, visitor, at, batch, sku)")
+    conn.executemany("INSERT INTO ev VALUES (?,?,?,?,?)", [
+        ("h", "v1", 1, 1, 5), ("h", "v1", 2, 2, 6)])
+    conn.commit()
+    conn.close()
+    db = jdbc_source(_req(
+        db=path,
+        query="SELECT host AS site, visitor AS user, at AS timestamp, "
+              "batch AS 'group', sku AS item FROM ev"), ResultStore())
+    assert db == [((5,), (6,))]
+
+
+def test_errors(tmp_path):
+    store = ResultStore()
+    with pytest.raises(SourceError, match="'db'"):
+        jdbc_source(_req(table="clicks"), store)
+    with pytest.raises(SourceError, match="'query' or 'table'"):
+        jdbc_source(_req(db=str(tmp_path / "x.db")), store)
+    with pytest.raises(SourceError, match="invalid table name"):
+        jdbc_source(_req(db=str(tmp_path / "x.db"), table="a; DROP"), store)
+    with pytest.raises(SourceError, match="cannot open"):
+        jdbc_source(_req(db=str(tmp_path / "missing.db"), table="t"), store)
+    with pytest.raises(SourceError, match="unsupported"):
+        jdbc_source(_req(url="postgres://h/d", table="t"), store)
+
+    path = str(tmp_path / "empty.db")
+    _mkdb(path, [])
+    with pytest.raises(SourceError, match="no rows"):
+        jdbc_source(_req(db=path, table="clicks"), store)
+    with pytest.raises(SourceError, match="query failed"):
+        jdbc_source(_req(db=path, query="SELECT * FROM nope"), store)
+    with pytest.raises(SourceError, match="no result set"):
+        jdbc_source(_req(db=path, query="-- nothing"), store)
+
+    # a read-only open must not create the file
+    assert not (tmp_path / "missing.db").exists()
+
+
+def test_missing_item_column(tmp_path):
+    path = str(tmp_path / "noitem.db")
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE t (site, user, timestamp)")
+    conn.execute("INSERT INTO t VALUES ('s', 'u', 1)")
+    conn.commit()
+    conn.close()
+    with pytest.raises(SourceError, match="'item' role"):
+        jdbc_source(_req(db=path, table="t"), ResultStore())
