@@ -42,14 +42,14 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import SlotPool, next_pow2
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
-from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
 Step = Tuple[int, bool]  # (item index, is_s_extension)
@@ -103,19 +103,25 @@ class SpadeTPU:
         self.max_pattern_itemsets = max_pattern_itemsets
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
-        # Pallas pair-support kernel: single-chip, single-word layout (see
-        # ops/pallas_support.py).  "auto" enables it on a real TPU backend;
-        # explicit True runs interpret-mode off-TPU (tests).
-        eligible = mesh is None and n_words == 1 and n_items > 0
+        # Pallas pair-support kernel (ops/pallas_support.py): covers single-
+        # chip AND mesh (per-shard launch + psum), any word count.  "auto"
+        # enables it on a real TPU backend; explicit True runs interpret
+        # mode off-TPU (tests).
+        eligible = n_items > 0
         if use_pallas == "auto":
             self.use_pallas = eligible and jax.default_backend() == "tpu"
         else:
             self.use_pallas = bool(use_pallas) and eligible
         self._pallas_interpret = jax.default_backend() != "tpu"
-        if mesh is not None:
-            n_seq = pad_to_multiple(n_seq, mesh.devices.size)
-        if self.use_pallas:
-            n_seq = pad_to_multiple(n_seq, PS.S_BLOCK)
+        # seq-axis padding: a device multiple for the mesh shards, times the
+        # kernel's seq-block so every shard tiles evenly.  The block shrinks
+        # (floor 128 lanes) for small databases so padding stays bounded by
+        # the lane width, not by devices * 4096.
+        n_shards = 1 if mesh is None else mesh.devices.size
+        self._s_block = min(PS.seq_block(n_words),
+                            pad_to_multiple(-(-n_seq // n_shards), 128))
+        mult = n_shards * self._s_block if self.use_pallas else n_shards
+        n_seq = pad_to_multiple(n_seq, mult)
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
 
         # HBM budget covers the slot pool PLUS the in-flight prep tensors
@@ -138,28 +144,50 @@ class SpadeTPU:
         if self.use_pallas:  # pair kernel reads item rows rounded to I_TILE
             total = max(total, pad_to_multiple(n_items, PS.I_TILE))
 
+        # Scatter-build the store IN HBM from the ~KB-scale token table
+        # (SURVEY.md sec 2.3 step 1 as a device kernel) — the dense store is
+        # never materialized on host or shipped over the link, on either the
+        # single-chip or the mesh path.
         if mesh is None:
-            # Scatter-build the store IN HBM from the ~KB-scale token table
-            # (SURVEY.md sec 2.3 step 1 as a device kernel) — the dense
-            # store is never materialized on host or shipped over the link.
             def init_store(ti, ts, tw, tm):
                 z = jnp.zeros((total, n_seq, n_words), jnp.uint32)
                 return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
 
-            self.store = jax.jit(init_store)(
-                jnp.asarray(vdb.tok_item), jnp.asarray(vdb.tok_seq),
-                jnp.asarray(vdb.tok_word), jnp.asarray(vdb.tok_mask))
+            build = jax.jit(init_store)
         else:
-            bitmaps = vdb.bitmaps
-            if n_seq != vdb.n_sequences:
-                bitmaps = np.concatenate(
-                    [bitmaps,
-                     np.zeros((n_items, n_seq - vdb.n_sequences, n_words), np.uint32)],
-                    axis=1)
-            store_np = np.zeros((total, n_seq, n_words), dtype=np.uint32)
-            store_np[:n_items] = bitmaps
-            self.store = jax.device_put(store_np, store_sharding(mesh))
-            del store_np
+            # Each device scatters only the tokens whose sequence id lands in
+            # its seq-axis shard; out-of-shard tokens add a 0 mask (no-op).
+            shard = n_seq // mesh.devices.size
+
+            def init_store_shard(ti, ts, tw, tm):
+                ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
+                ok = (ls >= 0) & (ls < shard)
+                z = jnp.zeros((total, shard, n_words), jnp.uint32)
+                return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
+                    jnp.where(ok, tm, jnp.uint32(0)))
+
+            rep = P()
+            build = jax.jit(jax.shard_map(
+                init_store_shard, mesh=mesh,
+                in_specs=(rep, rep, rep, rep),
+                out_specs=P(None, SEQ_AXIS, None)))
+        self.store = build(
+            jnp.asarray(vdb.tok_item), jnp.asarray(vdb.tok_seq),
+            jnp.asarray(vdb.tok_word), jnp.asarray(vdb.tok_mask))
+
+        # Multiword Pallas: the kernel wants [row, word, seq] layout, and
+        # transposing the store per call would copy it — so transpose the
+        # (immutable) item rows once.  W == 1 feeds the store directly (the
+        # layouts are the same bytes there; see ops/pallas_support.py).
+        self._items_t = None
+        if self.use_pallas and n_words > 1:
+            ni = pad_to_multiple(n_items, PS.I_TILE)
+            tr = lambda s: jnp.transpose(s[:ni], (0, 2, 1))
+            if mesh is None:
+                self._items_t = jax.jit(tr)(self.store)
+            else:
+                self._items_t = jax.jit(tr, out_shardings=NamedSharding(
+                    mesh, P(None, None, SEQ_AXIS)))(self.store)
         self._pool = SlotPool(range(n_items, n_items + pool_slots))
         self._build_fns()
 
@@ -217,6 +245,23 @@ class SpadeTPU:
         else:
             st = P(None, SEQ_AXIS, None)
             rep = P()
+            # Per-shard pair-support kernel launch; psum the extracted
+            # candidate supports over ICI (same contract as supports_body).
+            n_items_s, sb = self.n_items, self._s_block
+            ikl, interp = self.n_words > 1, self._pallas_interpret
+
+            def pallas_supports_body(pt, items, pref, item):
+                sup = PS.batch_supports(
+                    pt, items, n_items_s, pref, item,
+                    items_kernel_layout=ikl, s_block=sb, interpret=interp)
+                return jax.lax.psum(sup, SEQ_AXIS)
+
+            items_spec = P(None, None, SEQ_AXIS) if ikl else st
+            self._pallas_supports_fn = jax.jit(
+                jax.shard_map(pallas_supports_body, mesh=mesh,
+                              in_specs=(st, items_spec, rep, rep),
+                              out_specs=rep)
+            )
             self._prep_fn = jax.jit(
                 jax.shard_map(prep_body, mesh=mesh,
                               in_specs=(st, rep), out_specs=st)
@@ -295,11 +340,18 @@ class SpadeTPU:
             itm = np.zeros(cap, np.int32)
             pref[:n] = 2 * ref + iss
             itm[:n] = item
+            items = self._items_t if self._items_t is not None else self.store
             try:
-                sup = PS.batch_supports(
-                    prep, self.store, self.n_items,
-                    jnp.asarray(pref), jnp.asarray(itm),
-                    interpret=self._pallas_interpret)
+                if self.mesh is None:
+                    sup = PS.batch_supports(
+                        prep, items, self.n_items,
+                        jnp.asarray(pref), jnp.asarray(itm),
+                        items_kernel_layout=self._items_t is not None,
+                        s_block=self._s_block,
+                        interpret=self._pallas_interpret)
+                else:
+                    sup = self._pallas_supports_fn(
+                        prep, items, jnp.asarray(pref), jnp.asarray(itm))
                 self.stats["kernel_launches"] += 1
                 try:
                     sup.copy_to_host_async()

@@ -17,10 +17,18 @@ support(pt[p] & items[i])`` with matmul-style 2-D tiling on the VPU:
 - item rows are slots 0..n_items-1 of the engine's bitmap store, which are
   CONTIGUOUS, so the kernel needs no gather at all.
 
-Single-word fast path: with n_words == 1 (sequences <= 32 itemsets — the
-common clickstream shape), a sequence's id-list slice is one uint32 lane,
-so "any bit set per sequence" is just ``word != 0`` and support is a lane
-count.  Multi-word databases use the jnp fallback path in the engine.
+Kernel operand layout is ``[row, word, seq]`` — the word axis is a STATIC
+inner loop (per word: AND + nonzero; OR across words; lane-count once), so
+a multiword database (> 32 itemsets/sequence) costs W passes over the same
+lanes with the count still exact per sequence.  The engine's store layout
+is ``[row, seq, word]``; for W == 1 the two layouts are the same bytes (a
+free reshape — the store feeds the kernel with no copy), for W > 1 the
+engine transposes the item rows ONCE per mine (items never change) and the
+per-batch parent rows per call (small).
+
+Sequence blocks shard naturally: under ``shard_map`` each device runs the
+kernel over its local seq-axis shard and the engine ``psum``s the partial
+supports over ICI (SURVEY.md sec 2.2), identical to the jnp path.
 """
 
 from __future__ import annotations
@@ -34,84 +42,106 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Tile sizes obey the TPU (sublane, lane) = (8, 128) layout: the out block
 # [P_TILE, I_TILE] puts item tiles on lanes, so I_TILE must be a multiple
-# of 128; S_BLOCK is the lane width of the streamed bitmap blocks.
+# of 128; the seq-block (lane width of the streamed bitmap blocks) shrinks
+# with the word count so VMEM residency stays ~constant.
 P_TILE = 16
 I_TILE = 128
 S_BLOCK = 4096
 
 
+def seq_block(n_words: int) -> int:
+    """Lane width per grid step for a given word count (multiple of 128)."""
+    return max(128, (S_BLOCK // max(1, n_words)) // 128 * 128)
+
+
 def _pair_support_kernel(pt_ref, items_ref, out_ref):
-    """out[p_tile, i_tile] += lane-count of (pt[p] & items[i]) != 0."""
+    """out[p_tile, i_tile] += #seqs with any word of (pt[p] & items[i]) != 0."""
 
     @pl.when(pl.program_id(2) == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    items = items_ref[:]                            # [I_T, S_B]
+    n_words = items_ref.shape[1]
     acc = []
     for p in range(P_TILE):                         # static unroll
-        row = pt_ref[p, :]                          # [S_B]
-        hit = ((row[None, :] & items) != 0).astype(jnp.int32)
-        acc.append(jnp.sum(hit, axis=-1))           # [I_T]
+        hit = None
+        for w in range(n_words):                    # static unroll
+            row = pt_ref[p, w, :]                   # [S_B]
+            h = (row[None, :] & items_ref[:, w, :]) != 0
+            hit = h if hit is None else (hit | h)   # any word -> seq contains
+        acc.append(jnp.sum(hit.astype(jnp.int32), axis=-1))  # [I_T]
     out_ref[:] += jnp.stack(acc)                    # [P_T, I_T]
 
 
-@functools.partial(jax.jit, static_argnames=("n_item_rows", "interpret"))
-def pair_supports(pt: jax.Array, store: jax.Array, n_item_rows: int,
-                  *, interpret: bool = False) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("n_item_rows", "s_block", "interpret"))
+def pair_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
+                  *, s_block: int = S_BLOCK, interpret: bool = False) -> jax.Array:
     """Pair-support matrix between parent rows and item rows.
 
     Args:
-      pt: [P, S] uint32 — gathered (plain, s-ext-transformed) parent rows;
-        P must be a multiple of P_TILE, S a multiple of S_BLOCK.
-      store: [T, S] uint32 bitmap store; rows 0..n_item_rows-1 are the item
-        id-lists (single-word layout, n_words == 1).
-      n_item_rows: number of leading store rows to pair against (rounded up
+      pt: [P, W, S] uint32 — (plain, s-ext-transformed) parent rows in
+        kernel layout; P must be a multiple of P_TILE, S of s_block.
+      items: [T, W, S] uint32 item id-lists in kernel layout; rows
+        0..n_item_rows-1 are paired against.
+      n_item_rows: number of leading item rows to pair against (rounded up
         to I_TILE internally; callers index out[:, :n_items]).
 
     Returns:
       [P, NI] int32 supports, NI = n_item_rows rounded up to I_TILE.
     """
-    P, S = pt.shape
-    assert P % P_TILE == 0 and S % S_BLOCK == 0, (P, S)
+    P, W, S = pt.shape
+    assert P % P_TILE == 0 and S % s_block == 0, (P, S, s_block)
+    assert items.shape[1] == W, (items.shape, W)
     ni = -(-n_item_rows // I_TILE) * I_TILE
-    assert ni <= store.shape[0], (ni, store.shape)
-    grid = (P // P_TILE, ni // I_TILE, S // S_BLOCK)
+    assert ni <= items.shape[0], (ni, items.shape)
+    grid = (P // P_TILE, ni // I_TILE, S // s_block)
     return pl.pallas_call(
         _pair_support_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((P_TILE, S_BLOCK), lambda p, i, sb: (p, sb),
+            pl.BlockSpec((P_TILE, W, s_block), lambda p, i, sb: (p, 0, sb),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((I_TILE, S_BLOCK), lambda p, i, sb: (i, sb),
+            pl.BlockSpec((I_TILE, W, s_block), lambda p, i, sb: (i, 0, sb),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((P_TILE, I_TILE), lambda p, i, sb: (p, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((P, ni), jnp.int32),
         interpret=interpret,
-    )(pt, store)
+    )(pt, items)
 
 
-@functools.partial(jax.jit, static_argnames=("n_item_rows", "interpret"))
-def batch_supports(pt: jax.Array, store: jax.Array, n_item_rows: int,
+@functools.partial(jax.jit, static_argnames=(
+    "n_item_rows", "items_kernel_layout", "s_block", "interpret"))
+def batch_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
                    pref: jax.Array, item: jax.Array,
-                   *, interpret: bool = False) -> jax.Array:
+                   *, items_kernel_layout: bool = False,
+                   s_block: int = S_BLOCK, interpret: bool = False) -> jax.Array:
     """Pair matrix + on-device candidate extraction in one dispatch.
 
     ``pref``/``item`` index (parent-or-transform row, item row) per
     candidate; returns [n_candidates] int32 supports.  Extracting on device
     keeps the host readback at 4 bytes/candidate instead of the full
-    matrix.  Accepts [*, S, 1] single-word inputs (squeezed here, inside
-    jit, so no eager copy happens on the dispatch path).
+    matrix.
+
+    ``pt`` arrives in the engine's native [P, S, W] layout (or [P, S]) and
+    is transposed here, inside jit — a free reshape when W == 1, a small
+    per-batch copy otherwise.  ``items`` is the engine store ([T, S, W] /
+    [T, S], W == 1: free reshape) or, with ``items_kernel_layout=True``,
+    a pre-transposed [T, W, S] item block (W > 1: transposing the full
+    store per call would copy it, so the engine does it once per mine).
     """
-    if pt.ndim == 3:
-        pt = pt[..., 0]
-    if store.ndim == 3:
-        store = store[..., 0]
+    if pt.ndim == 2:
+        pt = pt[:, :, None]
+    pt = jnp.transpose(pt, (0, 2, 1))               # [P, W, S]
+    if items.ndim == 2:
+        items = items[:, :, None]
+    if not items_kernel_layout:
+        items = jnp.transpose(items, (0, 2, 1))     # free iff W == 1
     p = pt.shape[0]
     p_pad = -(-p // P_TILE) * P_TILE  # any batch size: pad rows to the tile
     if p_pad != p:
-        pt = jnp.pad(pt, ((0, p_pad - p), (0, 0)))
-    out = pair_supports(pt, store, n_item_rows, interpret=interpret)
+        pt = jnp.pad(pt, ((0, p_pad - p), (0, 0), (0, 0)))
+    out = pair_supports(pt, items, n_item_rows,
+                        s_block=s_block, interpret=interpret)
     return out[pref, item]

@@ -93,8 +93,17 @@ class RespClient:
 
     def command(self, *args: Union[str, bytes, int]) -> Reply:
         with self._lock:
-            self._sock.sendall(encode_command(*args))
-            return self._read_reply()
+            try:
+                self._sock.sendall(encode_command(*args))
+                return self._read_reply()
+            except OSError:
+                # A timeout/transport error mid-reply leaves the stream
+                # desynced (a late remainder would be parsed as the NEXT
+                # command's reply) — poison the connection so every later
+                # use fails loudly instead of returning off-by-one replies.
+                self.close()
+                self._buf = b""
+                raise
 
     # convenience wrappers (the subset the store uses)
 

@@ -158,8 +158,11 @@ def jdbc_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     import sqlite3
 
     try:
-        # open read-only so a typo'd path errors instead of creating a db
-        conn = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
+        # open read-only so a typo'd path errors instead of creating a db;
+        # percent-encode the path so '?', '#', '%' in filenames survive the
+        # URI parse
+        from urllib.parse import quote
+        conn = sqlite3.connect(f"file:{quote(path)}?mode=ro", uri=True)
     except sqlite3.OperationalError as exc:
         raise SourceError(f"cannot open sqlite db {path!r}: {exc}") from exc
     try:

@@ -3,6 +3,7 @@
 The kernel itself is TPU-targeted; on the CPU test backend it runs through
 the Pallas interpreter, which exercises identical index/block logic
 (SURVEY.md sec 4: distributed/device tests without device hardware).
+Covers single-word, multiword (W > 1), and the shard_map mesh path.
 """
 
 import numpy as np
@@ -14,15 +15,16 @@ from spark_fsm_tpu.models.oracle import mine_spade
 from spark_fsm_tpu.models.spade_tpu import SpadeTPU
 from spark_fsm_tpu.ops import bitops_np as BN
 from spark_fsm_tpu.ops.pallas_support import (
-    I_TILE, P_TILE, S_BLOCK, batch_supports, pair_supports)
+    I_TILE, P_TILE, S_BLOCK, batch_supports, pair_supports, seq_block)
+from spark_fsm_tpu.parallel.mesh import make_mesh
 from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
 
 
-def _rand_words(rng, n, s):
-    # sparse-ish single-word bitmaps
-    return (rng.integers(0, 2**32, (n, s), dtype=np.uint32)
-            & rng.integers(0, 2**32, (n, s), dtype=np.uint32)
-            & rng.integers(0, 2**32, (n, s), dtype=np.uint32))
+def _rand_words(rng, *shape):
+    # sparse-ish bitmaps
+    return (rng.integers(0, 2**32, shape, dtype=np.uint32)
+            & rng.integers(0, 2**32, shape, dtype=np.uint32)
+            & rng.integers(0, 2**32, shape, dtype=np.uint32))
 
 
 def test_pair_supports_matches_numpy():
@@ -30,7 +32,8 @@ def test_pair_supports_matches_numpy():
     P, NI, S = 2 * P_TILE, 21, S_BLOCK
     pt = _rand_words(rng, P, S)
     store = _rand_words(rng, I_TILE, S)
-    out = np.asarray(pair_supports(jnp.asarray(pt), jnp.asarray(store), NI,
+    out = np.asarray(pair_supports(jnp.asarray(pt)[:, None, :],
+                                   jnp.asarray(store)[:, None, :], NI,
                                    interpret=True))
     assert out.shape == (P, -(-NI // I_TILE) * I_TILE)
     for p in range(P):
@@ -39,10 +42,26 @@ def test_pair_supports_matches_numpy():
             assert out[p, i] == want, (p, i, out[p, i], want)
 
 
+def test_pair_supports_multiword():
+    rng = np.random.default_rng(3)
+    W = 3
+    sb = seq_block(W)
+    P, NI, S = P_TILE, 17, 2 * sb
+    pt = _rand_words(rng, P, W, S)
+    items = _rand_words(rng, I_TILE, W, S)
+    out = np.asarray(pair_supports(jnp.asarray(pt), jnp.asarray(items), NI,
+                                   s_block=sb, interpret=True))
+    for p in range(P):
+        for i in range(NI):
+            # support = #seqs where ANY word of the AND is nonzero
+            want = int(np.count_nonzero((pt[p] & items[i]).any(axis=0)))
+            assert out[p, i] == want, (p, i, out[p, i], want)
+
+
 def test_batch_supports_extraction():
     rng = np.random.default_rng(1)
     P, S = P_TILE, 2 * S_BLOCK
-    pt = _rand_words(rng, P, S)[..., None]          # [P, S, 1] squeezed path
+    pt = _rand_words(rng, P, S)[..., None]          # [P, S, 1] native layout
     store = _rand_words(rng, I_TILE, S)[..., None]
     pref = rng.integers(0, P, 50, dtype=np.int32)
     item = rng.integers(0, 20, 50, dtype=np.int32)
@@ -54,6 +73,25 @@ def test_batch_supports_extraction():
         assert sup[k] == want
 
 
+def test_batch_supports_multiword_kernel_layout():
+    rng = np.random.default_rng(2)
+    W = 2
+    sb = seq_block(W)
+    P, S = P_TILE, sb
+    pt = _rand_words(rng, P, S, W)                  # native [P, S, W]
+    items_t = _rand_words(rng, I_TILE, W, S)        # kernel [T, W, S]
+    pref = rng.integers(0, P, 40, dtype=np.int32)
+    item = rng.integers(0, I_TILE, 40, dtype=np.int32)
+    sup = np.asarray(batch_supports(
+        jnp.asarray(pt), jnp.asarray(items_t), I_TILE,
+        jnp.asarray(pref), jnp.asarray(item),
+        items_kernel_layout=True, s_block=sb, interpret=True))
+    for k in range(40):
+        a = pt[pref[k]].T                           # [W, S]
+        want = int(np.count_nonzero((a & items_t[item[k]]).any(axis=0)))
+        assert sup[k] == want
+
+
 def test_engine_pallas_parity_small():
     db = synthetic_db(seed=7, n_sequences=260, n_items=14, mean_itemsets=4.0,
                       mean_itemset_size=1.4)
@@ -61,7 +99,52 @@ def test_engine_pallas_parity_small():
     vdb = build_vertical(db, min_item_support=minsup)
     eng = SpadeTPU(vdb, minsup, use_pallas=True, node_batch=16,
                    pool_bytes=64 << 20)
-    assert eng.use_pallas and eng.n_seq % S_BLOCK == 0
+    assert eng.use_pallas and eng.n_seq % eng._s_block == 0
     got = eng.mine()
     want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_engine_pallas_parity_multiword():
+    # mean_itemsets > 32 forces n_words >= 2 (multiword carry chains + the
+    # transposed item block both in play)
+    db = synthetic_db(seed=11, n_sequences=150, n_items=10, mean_itemsets=40.0,
+                      mean_itemset_size=1.2, max_itemsets=90)
+    minsup = abs_minsup(0.2, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    assert vdb.n_words > 1
+    eng = SpadeTPU(vdb, minsup, use_pallas=True, node_batch=8,
+                   pool_bytes=64 << 20, max_pattern_itemsets=4)
+    assert eng.use_pallas and eng._items_t is not None
+    got = eng.mine()
+    want = mine_spade(db, minsup, max_pattern_itemsets=4)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_engine_pallas_parity_mesh():
+    db = synthetic_db(seed=13, n_sequences=300, n_items=12, mean_itemsets=4.0,
+                      mean_itemset_size=1.3)
+    minsup = abs_minsup(0.06, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    mesh = make_mesh(8)
+    eng = SpadeTPU(vdb, minsup, mesh=mesh, use_pallas=True, node_batch=16,
+                   pool_bytes=256 << 20)
+    assert eng.use_pallas and eng.n_seq % (8 * eng._s_block) == 0
+    got = eng.mine()
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_engine_pallas_parity_mesh_multiword():
+    db = synthetic_db(seed=17, n_sequences=120, n_items=9, mean_itemsets=38.0,
+                      mean_itemset_size=1.2, max_itemsets=80)
+    minsup = abs_minsup(0.25, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    assert vdb.n_words > 1
+    mesh = make_mesh(8)
+    eng = SpadeTPU(vdb, minsup, mesh=mesh, use_pallas=True, node_batch=8,
+                   pool_bytes=256 << 20, max_pattern_itemsets=3)
+    assert eng.use_pallas and eng._items_t is not None
+    got = eng.mine()
+    want = mine_spade(db, minsup, max_pattern_itemsets=3)
     assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
