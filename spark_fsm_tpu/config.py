@@ -22,6 +22,11 @@ File format (TOML shown; JSON with the same nesting also accepted):
     host = "127.0.0.1"
     port = 6379
 
+    [distributed]
+    enabled = false                 # true: jax.distributed.initialize at boot
+    coordinator_address = ""        # "" = JAX env vars / cloud auto-detect
+    # num_processes / process_id: omit for env-var/cloud auto-detect
+
     [engine]
     mesh_devices = 8                # 0 = single chip (no mesh)
     pool_bytes = 2147483648         # HBM slot-pool budget
@@ -72,10 +77,26 @@ class EngineConfig:
 
 
 @dataclasses.dataclass
+class DistributedConfig:
+    """Multi-host (jax.distributed) wiring; all-defaults = single host.
+
+    ``enabled`` with empty coordinator/counts defers to JAX's own env vars
+    and cloud auto-detection (see parallel/multihost.py).
+    """
+
+    enabled: bool = False
+    coordinator_address: str = ""  # "" = JAX env var / auto-detect
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+
+@dataclasses.dataclass
 class Config:
     service: ServiceConfig = dataclasses.field(default_factory=ServiceConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    distributed: DistributedConfig = dataclasses.field(
+        default_factory=DistributedConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
 
 
@@ -110,6 +131,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "service": (ServiceConfig, top.pop("service", {})),
         "store": (StoreConfig, top.pop("store", {})),
         "engine": (EngineConfig, top.pop("engine", {})),
+        "distributed": (DistributedConfig, top.pop("distributed", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     if top:

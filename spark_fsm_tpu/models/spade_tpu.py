@@ -97,6 +97,9 @@ class SpadeTPU:
         self.vdb = vdb
         self.minsup = int(minsup_abs)
         self.mesh = mesh
+        # Multi-host mesh (jax.distributed): host-side inputs must become
+        # global replicated arrays; see parallel/multihost.py.
+        self._multiproc = mesh is not None and jax.process_count() > 1
         self.chunk = int(chunk)
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.recompute_chunk = int(recompute_chunk)
@@ -172,8 +175,8 @@ class SpadeTPU:
                 in_specs=(rep, rep, rep, rep),
                 out_specs=P(None, SEQ_AXIS, None)))
         self.store = build(
-            jnp.asarray(vdb.tok_item), jnp.asarray(vdb.tok_seq),
-            jnp.asarray(vdb.tok_word), jnp.asarray(vdb.tok_mask))
+            self._put(vdb.tok_item), self._put(vdb.tok_seq),
+            self._put(vdb.tok_word), self._put(vdb.tok_mask))
 
         # Multiword Pallas: the kernel wants [row, word, seq] layout, and
         # transposing the store per call would copy it — so transpose the
@@ -283,6 +286,16 @@ class SpadeTPU:
 
     # ------------------------------------------------------------ slot mgmt
 
+    def _put(self, x) -> jax.Array:
+        """Host array -> device input.  On a multi-host mesh every process
+        contributes its identical copy as a global replicated array (SPMD
+        host loops keep the copies identical by construction)."""
+        if self._multiproc:
+            from spark_fsm_tpu.parallel.multihost import replicate
+
+            return replicate(self.mesh, x)
+        return jnp.asarray(x)
+
     def _alloc(self) -> Optional[int]:
         return self._pool.alloc()
 
@@ -301,7 +314,7 @@ class SpadeTPU:
         slots = np.zeros(self.node_batch, np.int32)
         for i, n in enumerate(batch):
             slots[i] = n.slot
-        pt = self._prep_fn(self.store, jnp.asarray(slots))
+        pt = self._prep_fn(self.store, self._put(slots))
         self.stats["kernel_launches"] += 1
         return pt
 
@@ -314,7 +327,7 @@ class SpadeTPU:
             hi = min(lo + c, n)
             pad = c - (hi - lo)
             yield lo, hi, tuple(
-                jnp.asarray(np.pad(a[lo:hi], (0, pad), constant_values=pv))
+                self._put(np.pad(a[lo:hi], (0, pad), constant_values=pv))
                 for a, pv in zip(arrays, pad_values)
             )
 
@@ -351,7 +364,7 @@ class SpadeTPU:
                         interpret=self._pallas_interpret)
                 else:
                     sup = self._pallas_supports_fn(
-                        prep, items, jnp.asarray(pref), jnp.asarray(itm))
+                        prep, items, self._put(pref), self._put(itm))
                 self.stats["kernel_launches"] += 1
                 try:
                     sup.copy_to_host_async()
@@ -407,8 +420,8 @@ class SpadeTPU:
                 for row, (it, s) in enumerate(node.steps):
                     items[row, col], iss[row, col], valid[row, col] = it, s, True
             self.store = self._recompute_fn(
-                self.store, jnp.asarray(items), jnp.asarray(iss),
-                jnp.asarray(valid), jnp.asarray(slots)
+                self.store, self._put(items), self._put(iss),
+                self._put(valid), self._put(slots)
             )
             self.stats["kernel_launches"] += 1
 

@@ -1,0 +1,96 @@
+"""Multi-host (DCN) seam: ``jax.distributed`` wiring + global-array helpers.
+
+The reference scales past one machine with Spark driver->executor RPC and
+Akka remoting over TCP (SURVEY.md sec 2.2 rows 3-4, sec 5 comms row).  The
+TPU-native replacement is JAX's multi-controller model: every host runs the
+SAME program, ``jax.distributed.initialize`` wires them into one runtime
+over DCN, and a ``Mesh`` over ``jax.devices()`` (all hosts' chips) makes
+the seq-axis ``shard_map``/``psum`` pipeline span hosts with no further
+code change — the ICI collectives simply ride DCN at the host boundary.
+
+Host-side orchestration stays SPMD: each process runs the identical DFS
+control flow on identical (replicated) support readbacks, so no extra
+cross-host messaging is needed — the determinism the reference gets from a
+single Spark driver, the rebuild gets from replicated reductions.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Wire this process into the multi-host runtime (idempotent).
+
+    Args fall back to JAX's standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``) and cloud auto-detection when
+    omitted — on a real TPU pod slice ``jax.distributed.initialize()`` with
+    no arguments resolves everything from the metadata server.
+    """
+    global _initialized
+    if _initialized:
+        return
+    # NOTE: no jax.process_count()/jax.devices() probing here — touching the
+    # backend before jax.distributed.initialize() is itself the error.
+    kwargs = {}
+    if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        kwargs["coordinator_address"] = (
+            coordinator_address or os.environ["JAX_COORDINATOR_ADDRESS"])
+    if num_processes is not None or os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = (
+            num_processes if num_processes is not None
+            else int(os.environ["JAX_NUM_PROCESSES"]))
+    if process_id is not None or os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = (
+            process_id if process_id is not None
+            else int(os.environ["JAX_PROCESS_ID"]))
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as exc:
+        # Tolerate a runtime someone else already wired (e.g. a launcher
+        # that called initialize before importing this package).  JAX's
+        # message is "distributed.initialize should only be called once."
+        msg = str(exc)
+        if "only be called once" not in msg and "already initialized" not in msg:
+            raise
+    _initialized = True
+
+
+def shutdown_distributed() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def replicate(mesh: Mesh, x) -> jax.Array:
+    """Host array -> fully-replicated global array over ``mesh``.
+
+    In a single process this is a plain ``device_put`` (jit would have done
+    it implicitly); across processes a committed single-device array cannot
+    feed a multi-host computation, so every process contributes its (by
+    SPMD construction identical) local copy as the replica.
+    """
+    x = np.asarray(x)
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, x)

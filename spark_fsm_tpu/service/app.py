@@ -201,6 +201,15 @@ def main() -> None:
         cfg.service.miner_workers = args.miner_workers
     cfgmod.set_config(cfg)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
+    if cfg.distributed.enabled:
+        # Must run before anything touches the XLA backend: wires this
+        # process into the multi-host runtime (SURVEY.md sec 2.2 DCN row).
+        from spark_fsm_tpu.parallel.multihost import init_distributed
+
+        init_distributed(
+            coordinator_address=cfg.distributed.coordinator_address or None,
+            num_processes=cfg.distributed.num_processes or None,
+            process_id=cfg.distributed.process_id)
     server = make_server(cfg.service.port, cfg.service.host,
                          miner_workers=cfg.service.miner_workers)
     print(f"spark_fsm_tpu service on http://{cfg.service.host}:"

@@ -17,11 +17,19 @@ import socket
 import threading
 from typing import List, Optional, Union
 
-Reply = Union[None, int, str, List["Reply"]]
-
 
 class RespError(RuntimeError):
     """Server-side error reply (RESP '-ERR ...')."""
+
+
+class RespProtocolError(ConnectionError):
+    """Malformed/unknown bytes on the reply stream — the connection can no
+    longer be trusted to be in sync and must be discarded."""
+
+
+# Error ELEMENTS inside an array reply surface as RespError values (raising
+# mid-array would desync the stream); top-level errors raise.
+Reply = Union[None, int, str, RespError, List["Reply"]]
 
 
 def encode_command(*args: Union[str, bytes, int]) -> bytes:
@@ -38,15 +46,25 @@ class RespClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
                  timeout: float = 10.0) -> None:
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._host, self._port, self._timeout = host, port, timeout
+        self._sock: Optional[socket.socket] = None
         self._buf = b""
         self._lock = threading.Lock()
+        self._connect()  # fail fast if nothing listens
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout)
+        self._buf = b""
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._buf = b""
 
     # ---------------------------------------------------------------- io
 
@@ -68,41 +86,51 @@ class RespClient:
         payload, self._buf = self._buf[:n], self._buf[n + 2:]
         return payload
 
-    def _read_reply(self) -> Reply:
+    def _read_reply(self, depth: int = 0) -> Reply:
         line = self._read_line()
         kind, rest = line[:1], line[1:]
         if kind == b"+":  # simple string
             return rest.decode("utf-8")
         if kind == b"-":  # error
-            raise RespError(rest.decode("utf-8"))
-        if kind == b":":  # integer
-            return int(rest)
-        if kind == b"$":  # bulk string
-            n = int(rest)
-            if n == -1:
-                return None
-            return self._read_exact(n).decode("utf-8")
-        if kind == b"*":  # array
-            n = int(rest)
-            if n == -1:
-                return None
-            return [self._read_reply() for _ in range(n)]
-        raise RespError(f"unknown RESP reply type {line!r}")
+            err = RespError(rest.decode("utf-8"))
+            if depth:  # an error ELEMENT of an array: the remaining
+                return err  # elements must still be consumed — no raise
+            raise err
+        try:
+            if kind == b":":  # integer
+                return int(rest)
+            if kind == b"$":  # bulk string
+                n = int(rest)
+                if n == -1:
+                    return None
+                return self._read_exact(n).decode("utf-8")
+            if kind == b"*":  # array
+                n = int(rest)
+                if n == -1:
+                    return None
+                return [self._read_reply(depth + 1) for _ in range(n)]
+        except ValueError as exc:  # malformed length/integer
+            raise RespProtocolError(f"malformed RESP reply {line!r}") from exc
+        raise RespProtocolError(f"unknown RESP reply type {line!r}")
 
     # ------------------------------------------------------------ command
 
     def command(self, *args: Union[str, bytes, int]) -> Reply:
         with self._lock:
+            if self._sock is None:
+                self._connect()  # transparent reconnect after a poisoning
             try:
                 self._sock.sendall(encode_command(*args))
                 return self._read_reply()
+            except RespError:
+                raise  # server error reply — the stream is still in sync
             except OSError:
-                # A timeout/transport error mid-reply leaves the stream
-                # desynced (a late remainder would be parsed as the NEXT
-                # command's reply) — poison the connection so every later
-                # use fails loudly instead of returning off-by-one replies.
+                # A timeout/transport/protocol error mid-reply leaves the
+                # stream desynced (a late remainder would be parsed as the
+                # NEXT command's reply) — drop the connection so the next
+                # command starts on a fresh, in-sync socket instead of
+                # reading off-by-one replies from this one.
                 self.close()
-                self._buf = b""
                 raise
 
     # convenience wrappers (the subset the store uses)

@@ -210,3 +210,41 @@ def test_store_end_to_end_mine(mini_redis):
 def test_store_fails_fast_when_down():
     with pytest.raises(OSError):
         RedisResultStore(port=1)  # nothing listens there
+
+
+def test_client_resyncs_after_protocol_error():
+    """A malformed reply poisons the connection; the next command gets a
+    fresh socket instead of off-by-one replies from the stale stream."""
+    from spark_fsm_tpu.service.resp import RespProtocolError
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    replies = [b",3.14\r\n", b"+PONG\r\n"]  # RESP3 double (unknown), then ok
+
+    def serve_conn(conn):
+        try:
+            while True:
+                if not conn.recv(65536):
+                    return
+                conn.sendall(replies.pop(0))
+        except (OSError, IndexError):
+            conn.close()
+
+    def accept_loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    c = RespClient(port=srv.getsockname()[1])
+    with pytest.raises(RespProtocolError):
+        c.ping()
+    assert c._sock is None  # poisoned
+    assert c.ping()         # transparent reconnect on a fresh stream
+    c.close()
+    srv.close()
