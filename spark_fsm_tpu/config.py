@@ -16,6 +16,7 @@ File format (TOML shown; JSON with the same nesting also accepted):
     host = "0.0.0.0"
     port = 9000
     miner_workers = 2
+    remote_port = 0                 # actor-protocol TCP entry (0 = off)
 
     [store]
     backend = "inproc"              # or "redis"
@@ -53,6 +54,7 @@ class ServiceConfig:
     host: str = "127.0.0.1"
     port: int = 9000
     miner_workers: int = 1
+    remote_port: int = 0  # actor-protocol TCP entry (0 = disabled)
 
 
 @dataclasses.dataclass

@@ -191,6 +191,8 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=None)
     parser.add_argument("--host", default=None)
     parser.add_argument("--miner-workers", type=int, default=None)
+    parser.add_argument("--remote-port", type=int, default=None,
+                        help="actor-protocol TCP port (0 disables)")
     args = parser.parse_args()
     cfg = cfgmod.load_config(args.config) if args.config else cfgmod.Config()
     if args.port is not None:
@@ -199,6 +201,8 @@ def main() -> None:
         cfg.service.host = args.host
     if args.miner_workers is not None:
         cfg.service.miner_workers = args.miner_workers
+    if args.remote_port is not None:
+        cfg.service.remote_port = args.remote_port
     cfgmod.set_config(cfg)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     if cfg.distributed.enabled:
@@ -214,6 +218,16 @@ def main() -> None:
                          miner_workers=cfg.service.miner_workers)
     print(f"spark_fsm_tpu service on http://{cfg.service.host}:"
           f"{server.server_port}")
+    if cfg.service.remote_port:
+        # Second protocol entry (the reference's Akka-remote analog):
+        # actor-vocabulary JSON lines over TCP, same Master.
+        from spark_fsm_tpu.service.remote import serve_remote_background
+
+        remote = serve_remote_background(
+            server.master, cfg.service.host,  # type: ignore[attr-defined]
+            cfg.service.remote_port)
+        print(f"spark_fsm_tpu actor protocol on {cfg.service.host}:"
+              f"{remote.port}")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,116 @@
+"""Actor-protocol TCP entry (service/remote.py): the reference's second,
+Akka-remote-style API surface driven over a real socket — full train ->
+status -> get lifecycle, registrar + tracker tasks, and framing robustness
+(malformed requests must not kill the connection)."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from spark_fsm_tpu.service.actors import Master
+from spark_fsm_tpu.service.remote import (
+    RemoteClient, serve_remote_background)
+from spark_fsm_tpu.service.store import ResultStore
+
+
+@pytest.fixture()
+def remote():
+    master = Master(store=ResultStore())
+    server = serve_remote_background(master)
+    yield server
+    server.shutdown()
+    server.server_close()
+    master.shutdown()
+
+
+def _wait_finished(client, uid, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        resp = client.request("status", {"uid": uid})
+        if resp["status"] in ("finished", "failure"):
+            return resp
+        time.sleep(0.02)
+    raise TimeoutError("job did not finish")
+
+
+def test_train_status_get_over_socket(remote):
+    client = RemoteClient(port=remote.port)
+    resp = client.request("train", {
+        "algorithm": "SPADE", "source": "INLINE",
+        "sequences": "1 -1 2 -2\n1 -1 2 -2\n2 -1 1 -2\n",
+        "support": "0.5"})
+    assert resp["status"] == "started", resp
+    uid = resp["data"]["uid"]
+    final = _wait_finished(client, uid)
+    assert final["status"] == "finished", final
+    got = client.request("get:patterns", {"uid": uid})
+    patterns = json.loads(got["data"]["patterns"])
+    assert {"support": 3, "itemsets": [[1]]} in patterns
+    assert {"support": 2, "itemsets": [[1], [2]]} in patterns
+    client.close()
+
+
+def test_register_track_mine_over_socket(remote):
+    client = RemoteClient(port=remote.port)
+    # register a NON-default field mapping, then track events using it
+    assert client.request("register:clicks", {
+        "site": "shop", "user": "visitor", "timestamp": "ts",
+        "group": "session", "item": "sku"})["status"] == "finished"
+    rows = [
+        ("u1", 1, 1, 7), ("u1", 2, 2, 8),
+        ("u2", 1, 3, 7), ("u2", 2, 4, 8),
+    ]
+    for visitor, ts, session, sku in rows:
+        assert client.request("track:clicks", {
+            "shop": "main", "visitor": visitor, "ts": ts,
+            "session": session, "sku": sku})["status"] == "finished"
+    resp = client.request("train", {
+        "algorithm": "SPADE", "source": "TRACKED", "topic": "clicks",
+        "support": "0.9"})
+    uid = resp["data"]["uid"]
+    assert _wait_finished(client, uid)["status"] == "finished"
+    got = client.request("get:patterns", {"uid": uid})
+    patterns = json.loads(got["data"]["patterns"])
+    assert {"support": 2, "itemsets": [[7], [8]]} in patterns
+    client.close()
+
+
+def test_malformed_requests_keep_connection(remote):
+    raw = socket.create_connection(("127.0.0.1", remote.port), timeout=10)
+    f = raw.makefile("rwb")
+    # not JSON at all
+    f.write(b"this is not json\n")
+    f.flush()
+    resp = json.loads(f.readline())
+    assert resp["status"] == "failure" and "malformed" in resp["data"]["error"]
+    # valid JSON, wrong shape (array / null data) must not kill the socket
+    f.write(b"[1, 2, 3]\n")
+    f.flush()
+    assert json.loads(f.readline())["status"] == "failure"
+    f.write(b'{"service": "fsm", "task": "status", "data": null}\n')
+    f.flush()
+    assert json.loads(f.readline())["status"] == "failure"
+    # JSON but an unknown task -> failure envelope from the Master
+    f.write(b'{"service": "fsm", "task": "frobnicate", "data": {}}\n')
+    f.flush()
+    assert json.loads(f.readline())["status"] == "failure"
+    # connection still usable for a real request afterwards
+    f.write(b'{"service": "fsm", "task": "status", "data": {"uid": "x"}}\n')
+    f.flush()
+    resp = json.loads(f.readline())
+    assert resp["task"] == "status"
+    raw.close()
+
+
+def test_blank_lines_skipped_and_concurrent_clients(remote):
+    c1 = RemoteClient(port=remote.port)
+    c2 = RemoteClient(port=remote.port)
+    # blank lines are keepalive no-ops
+    c1._file.write(b"\n\n")
+    c1._file.flush()
+    assert c1.request("status", {"uid": "nope"})["task"] == "status"
+    assert c2.request("status", {"uid": "nope"})["task"] == "status"
+    c1.close()
+    c2.close()
