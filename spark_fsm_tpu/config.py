@@ -17,6 +17,7 @@ File format (TOML shown; JSON with the same nesting also accepted):
     port = 9000
     miner_workers = 2
     remote_port = 0                 # actor-protocol TCP entry (0 = off)
+    job_retries = 1                 # failed-job re-runs before 'failure'
 
     [store]
     backend = "inproc"              # or "redis"
@@ -55,6 +56,7 @@ class ServiceConfig:
     port: int = 9000
     miner_workers: int = 1
     remote_port: int = 0  # actor-protocol TCP entry (0 = disabled)
+    job_retries: int = 1  # re-runs of a failed train job before 'failure'
 
 
 @dataclasses.dataclass

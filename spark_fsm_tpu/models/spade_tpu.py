@@ -36,6 +36,7 @@ construction; supports are exact integers from popcounts.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
@@ -534,26 +535,111 @@ class SpadeTPU:
         for node in batch:
             self._free_slot(node.slot)
 
-    def mine(self) -> List[PatternResult]:
+    def frontier_fingerprint(self) -> dict:
+        """Identity of the (vdb, minsup) a frontier checkpoint binds to.
+
+        Node steps store DENSE item indices, which are only meaningful for
+        the exact same frequent-item projection — resuming against a
+        different dataset or minsup must be refused, not garbled.
+        """
+        ids = self.vdb.item_ids
+        return {
+            "minsup": self.minsup,
+            "n_items": self.n_items,
+            "n_sequences": self.vdb.n_sequences,
+            "max_itemsets": self.max_pattern_itemsets,  # changes enumeration
+            "item_ids_head": [int(i) for i in ids[:8]],
+            "item_ids_sum": int(ids.astype(np.int64).sum()),
+        }
+
+    def frontier_state(self, stack: List[_Node],
+                       results: List[PatternResult],
+                       results_from: int = 0) -> dict:
+        """JSON-able snapshot of a paused DFS: unexplored nodes (by their
+        extension paths — bitmaps are rebuilt by the recompute machinery on
+        resume) plus the results emitted since ``results_from``.
+
+        ``results`` entries are append-only during a mine, so periodic
+        checkpoints serialize only the DELTA (``results_from`` = count
+        already persisted) and the checkpoint sink appends — per-snapshot
+        cost stays O(frontier + new results), not O(all results), on the
+        long mines this feature exists for.  A ``resume`` dict passed back
+        to :meth:`mine` must carry the MERGED results list.
+        """
+        return {
+            "version": 1,
+            "fingerprint": self.frontier_fingerprint(),
+            "stack": [{"steps": [[int(i), int(s)] for i, s in n.steps],
+                       "s": [int(x) for x in n.s_list],
+                       "i": [int(x) for x in n.i_list]} for n in stack],
+            "results_done": int(results_from),
+            "results": [[[list(map(int, s)) for s in pat], int(sup)]
+                        for pat, sup in results[results_from:]],
+        }
+
+    def mine(self, *, resume: Optional[dict] = None,
+             checkpoint_cb=None,
+             checkpoint_every_s: float = 30.0) -> List[PatternResult]:
+        """Run the DFS; optionally resumable (SURVEY.md sec 5 checkpoint
+        row: per-level frontier checkpointing for long mines).
+
+        Args:
+          resume: a ``frontier_state`` snapshot to continue from; its
+            fingerprint must match this engine's (vdb, minsup).
+          checkpoint_cb: called with a ``frontier_state`` dict at most
+            every ``checkpoint_every_s`` seconds (the in-flight pipeline is
+            drained first so the snapshot is consistent).
+        """
         minsup = self.minsup
-        results: List[PatternResult] = []
-        root_items = [i for i in range(self.n_items)
-                      if int(self.vdb.item_supports[i]) >= minsup]
         stack: List[_Node] = []
-        for i in reversed(root_items):
-            results.append((self._pattern_of(((i, True),)), int(self.vdb.item_supports[i])))
-            stack.append(_Node(((i, True),), i, root_items,
-                               [j for j in root_items if j > i]))
+        results: List[PatternResult]
+        if resume is not None:
+            fp = resume.get("fingerprint")
+            if fp != self.frontier_fingerprint():
+                raise ValueError(
+                    "frontier checkpoint does not match this (vdb, minsup); "
+                    f"checkpointed {fp}, engine {self.frontier_fingerprint()}")
+            results = [
+                (tuple(tuple(int(i) for i in s) for s in pat), int(sup))
+                for pat, sup in resume["results"]]
+            for n in resume["stack"]:
+                stack.append(_Node(
+                    tuple((int(i), bool(s)) for i, s in n["steps"]),
+                    None,  # bitmaps rebuilt on demand (recompute-on-miss)
+                    [int(x) for x in n["s"]], [int(x) for x in n["i"]]))
+            self.stats["resumed_nodes"] = len(stack)
+        else:
+            results = []
+            root_items = [i for i in range(self.n_items)
+                          if int(self.vdb.item_supports[i]) >= minsup]
+            for i in reversed(root_items):
+                results.append((self._pattern_of(((i, True),)),
+                                int(self.vdb.item_supports[i])))
+                stack.append(_Node(((i, True),), i, root_items,
+                                   [j for j in root_items if j > i]))
 
         # Software-pipelined DFS: keep up to pipeline_depth batches in
         # flight so support readbacks overlap with compute and each other.
         # Resolving out of strict DFS order only permutes enumeration order;
         # the pattern SET is unchanged (canonicalized in sort_patterns).
+        # On resume the persisted results already cover everything in
+        # ``results`` — checkpoints only ever append the delta.
+        ckpt_done = len(results) if resume is not None else 0
+        last_ckpt = time.monotonic()
         inflight: deque = deque()
         while stack or inflight:
             while stack and len(inflight) < self.pipeline_depth:
                 inflight.append(self._dispatch(stack))
             self._resolve(inflight.popleft(), stack, results)
+            if (checkpoint_cb is not None
+                    and time.monotonic() - last_ckpt >= checkpoint_every_s):
+                while inflight:  # drain for a consistent frontier
+                    self._resolve(inflight.popleft(), stack, results)
+                checkpoint_cb(self.frontier_state(stack, results,
+                                                  results_from=ckpt_done))
+                ckpt_done = len(results)
+                self.stats["checkpoints"] = self.stats.get("checkpoints", 0) + 1
+                last_ckpt = time.monotonic()
 
         self.stats["patterns"] = len(results)
         return sort_patterns(results)
@@ -566,15 +652,29 @@ def mine_spade_tpu(
     mesh: Optional[Mesh] = None,
     max_pattern_itemsets: Optional[int] = None,
     stats_out: Optional[dict] = None,
+    checkpoint=None,
     **kwargs,
 ) -> List[PatternResult]:
-    """Convenience wrapper: DB -> vertical build -> TPU mine."""
+    """Convenience wrapper: DB -> vertical build -> TPU mine.
+
+    ``checkpoint`` (optional): an object with ``load() -> Optional[dict]``,
+    ``save(state)``, and ``every_s`` — a saved frontier is resumed when its
+    fingerprint still matches (a stale/mismatched one is ignored, the mine
+    restarts fresh).
+    """
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
         return []
     eng = SpadeTPU(vdb, minsup_abs, mesh=mesh,
                    max_pattern_itemsets=max_pattern_itemsets, **kwargs)
-    results = eng.mine()
+    resume = checkpoint.load() if checkpoint is not None else None
+    if (resume is not None
+            and resume.get("fingerprint") != eng.frontier_fingerprint()):
+        resume = None  # dataset/minsup changed since the snapshot
+    results = eng.mine(
+        resume=resume,
+        checkpoint_cb=checkpoint.save if checkpoint is not None else None,
+        checkpoint_every_s=getattr(checkpoint, "every_s", 30.0))
     if stats_out is not None:
         stats_out.update(eng.stats)
     return results

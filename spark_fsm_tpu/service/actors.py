@@ -44,6 +44,10 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
     store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
     store.add_status(uid, Status.FAILURE)
     store.incr(f"fsm:metric:{metric}")
+    # a permanently failed job's frontier is unreachable (a resubmit clears
+    # it before running) — drop it rather than leak it
+    store.delete(f"fsm:frontier:{uid}")
+    store.delete(f"fsm:frontier:results:{uid}")
     log_event("job_failed", uid=uid, error=str(exc))
 
 
@@ -66,6 +70,56 @@ def _profile_dir(req: ServiceRequest, uid: str) -> str:
     return os.path.join(root, uid)
 
 
+class StoreCheckpoint:
+    """Frontier checkpoint persisted in the result store — the optional
+    long-mine half of SURVEY.md sec 5's checkpoint row (results-at-job-end
+    remain the primary contract).  The engine fingerprints each snapshot,
+    so a retry against changed data safely restarts fresh instead of
+    resuming garbage.
+
+    Two keys: ``fsm:frontier:{uid}`` holds the (small) frontier snapshot,
+    ``fsm:frontier:results:{uid}`` is an APPEND-ONLY list of result-delta
+    chunks — each save writes only the patterns found since the previous
+    one, so checkpoint cost tracks the frontier, not the full output."""
+
+    def __init__(self, store: ResultStore, uid: str,
+                 every_s: float = 30.0) -> None:
+        self.store, self.uid, self.every_s = store, uid, every_s
+        self._meta_key = f"fsm:frontier:{uid}"
+        self._results_key = f"fsm:frontier:results:{uid}"
+
+    def load(self) -> Optional[dict]:
+        raw = self.store.get(self._meta_key)
+        if not raw:
+            return None
+        state = json.loads(raw)
+        results = []
+        for chunk in self.store.lrange(self._results_key):
+            results.extend(json.loads(chunk))
+        if len(results) != state.pop("results_total", -1):
+            return None  # torn snapshot (killed mid-save): refuse to resume
+        state["results"] = results
+        return state
+
+    def save(self, state: dict) -> None:
+        delta = state.pop("results")
+        done = state.pop("results_done")
+        if done == 0:
+            self.store.delete(self._results_key)  # fresh mine, fresh list
+        if delta:
+            self.store.rpush(self._results_key, json.dumps(delta))
+        state["results_total"] = done + len(delta)
+        # meta written LAST: results_total only matches the list once the
+        # delta is in, so a kill between the writes reads as torn, not valid
+        self.store.set(self._meta_key, json.dumps(state))
+        log_event("frontier_checkpoint", uid=self.uid,
+                  stack=len(state["stack"]), results=state["results_total"])
+
+    def clear(self) -> None:
+        self.store.delete(self._meta_key)
+        self.store.delete(self._results_key)
+
+
 class Miner:
     """Train worker: source -> dataset -> plugin -> sink, with statuses.
 
@@ -73,6 +127,12 @@ class Miner:
     'dataset' -> mine -> sink patterns/rules -> 'trained' -> 'finished';
     failures land in 'failure' with the error recorded (the supervision
     contract of the reference's actor hierarchy).
+
+    Supervision extends to retry: a failed job re-runs up to ``retries``
+    times (request param; default from the boot config) before the failure
+    status lands — the analog of Spark's task re-execution.  With
+    ``checkpoint=1`` a retry resumes the mine from the last persisted
+    frontier instead of starting over.
     """
 
     def __init__(self, store: ResultStore, workers: int = 1) -> None:
@@ -109,9 +169,29 @@ class Miner:
             # last job to *start* owns the uid's keys from here on.
             self.store.clear_job(req.uid, keep_status_log=True)
             try:
-                self._run(req)
-            except Exception as exc:  # supervision: failure status + log
-                _record_failure(self.store, req.uid, exc)
+                retries = int(req.param(
+                    "retries",
+                    str(config.get_config().service.job_retries)))
+            except ValueError:
+                retries = 0
+            attempt = 0
+            while True:
+                try:
+                    self._run(req)
+                    break
+                except ValueError as exc:  # bad params / bad source: the
+                    # failure is deterministic (SourceError included) — a
+                    # re-run would just repeat it, so fail immediately
+                    _record_failure(self.store, req.uid, exc)
+                    break
+                except Exception as exc:  # supervision: retry, then failure
+                    attempt += 1
+                    if attempt > max(0, retries):
+                        _record_failure(self.store, req.uid, exc)
+                        break
+                    self.store.incr("fsm:metric:jobs_retried")
+                    log_event("job_retry", uid=req.uid, attempt=attempt,
+                              error=str(exc))
 
     def _run(self, req: ServiceRequest) -> None:
         t0 = time.perf_counter()
@@ -123,11 +203,19 @@ class Miner:
             "sequences": len(db),
             "dataset_s": round(time.perf_counter() - t0, 4),
         }
+        ckpt: Optional[StoreCheckpoint] = None
+        if (req.param("checkpoint") or "").lower() not in ("", "0", "false",
+                                                           "no", "off"):
+            ckpt = StoreCheckpoint(
+                self.store, req.uid,
+                every_s=float(req.param("checkpoint_every_s", "30")))
         trace_dir = _profile_dir(req, req.uid)
         t1 = time.perf_counter()
         with profile_trace(trace_dir):
-            results = plugin.extract(req, db, stats)
+            results = plugin.extract(req, db, stats, checkpoint=ckpt)
         mine_s = time.perf_counter() - t1
+        if ckpt is not None:
+            ckpt.clear()  # results are the durable artifact from here on
         stats["mine_s"] = round(mine_s, 4)
         stats["results"] = len(results)
         stats["results_per_s"] = round(len(results) / mine_s, 2) if mine_s else 0.0

@@ -32,8 +32,10 @@ Results = Union[List[PatternResult], List[RuleResult]]
 
 @dataclasses.dataclass
 class AlgorithmPlugin:
-    """``extract(req, db, stats=None)``; a provided ``stats`` dict receives
-    the engine's observability counters (SURVEY.md sec 5 metrics row)."""
+    """``extract(req, db, stats=None, checkpoint=None)``; a provided
+    ``stats`` dict receives the engine's observability counters (SURVEY.md
+    sec 5 metrics row); ``checkpoint`` (load/save/every_s) enables frontier
+    resume where the engine supports it (SPADE_TPU unconstrained)."""
 
     name: str
     kind: str  # "patterns" | "rules"
@@ -57,9 +59,24 @@ def _constraints(req: ServiceRequest) -> Tuple[Optional[int], Optional[int]]:
             int(mw) if mw is not None else None)
 
 
+def _checkpoint_unsupported(checkpoint, name: str,
+                            stats: Optional[dict]) -> None:
+    """A requested checkpoint the selected engine cannot honor must be
+    visible (job stats + log), not silently dropped."""
+    if checkpoint is None:
+        return
+    from spark_fsm_tpu.utils.obs import log_event
+
+    log_event("checkpoint_unsupported", algorithm=name)
+    if stats is not None:
+        stats["checkpoint_unsupported"] = True
+
+
 def _spade_cpu(req: ServiceRequest, db: SequenceDB,
-               stats: Optional[dict] = None) -> Results:
+               stats: Optional[dict] = None, checkpoint=None) -> Results:
     from spark_fsm_tpu.models.oracle import mine_cspade, mine_spade
+
+    _checkpoint_unsupported(checkpoint, "SPADE", stats)
 
     minsup = _minsup(req, db)
     maxgap, maxwindow = _constraints(req)
@@ -73,7 +90,7 @@ def _spade_cpu(req: ServiceRequest, db: SequenceDB,
 
 
 def _spade_tpu(req: ServiceRequest, db: SequenceDB,
-               stats: Optional[dict] = None) -> Results:
+               stats: Optional[dict] = None, checkpoint=None) -> Results:
     from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
     from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
 
@@ -83,7 +100,9 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
                                   "pipeline_depth", "chunk", "recompute_chunk")
     mesh = config.get_mesh()
     if maxgap is None and maxwindow is None:
-        return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats, **kwargs)
+        return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
+                              checkpoint=checkpoint, **kwargs)
+    _checkpoint_unsupported(checkpoint, "SPADE_TPU[constrained]", stats)
     return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow,
                            mesh=mesh, stats_out=stats, **kwargs)
 
@@ -106,18 +125,20 @@ def _tsr_kwargs() -> dict:
 
 
 def _tsr_cpu(req: ServiceRequest, db: SequenceDB,
-             stats: Optional[dict] = None) -> Results:
+             stats: Optional[dict] = None, checkpoint=None) -> Results:
     from spark_fsm_tpu.models.tsr import mine_tsr_cpu
 
+    _checkpoint_unsupported(checkpoint, "TSR", stats)
     k, minconf, max_side = _tsr_params(req)
     return mine_tsr_cpu(db, k, minconf, max_side=max_side, stats_out=stats,
                         **_tsr_kwargs())
 
 
 def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
-             stats: Optional[dict] = None) -> Results:
+             stats: Optional[dict] = None, checkpoint=None) -> Results:
     from spark_fsm_tpu.models.tsr import mine_tsr_tpu
 
+    _checkpoint_unsupported(checkpoint, "TSR_TPU", stats)
     k, minconf, max_side = _tsr_params(req)
     return mine_tsr_tpu(db, k, minconf, max_side=max_side, mesh=config.get_mesh(),
                         stats_out=stats, **_tsr_kwargs())

@@ -66,7 +66,8 @@ class ResultStore:
         """Remove a job's error/results (and optionally its status log) so a
         reused uid reports THIS job, not a predecessor's leftovers."""
         keys = [f"fsm:error:{uid}", f"fsm:pattern:{uid}", f"fsm:rule:{uid}",
-                f"fsm:stats:{uid}"]
+                f"fsm:stats:{uid}", f"fsm:frontier:{uid}",
+                f"fsm:frontier:results:{uid}"]
         if not keep_status_log:
             keys.append(f"fsm:status:log:{uid}")
         for key in keys:

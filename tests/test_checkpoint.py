@@ -1,0 +1,241 @@
+"""Failure recovery: frontier checkpoint/resume + miner job retry.
+
+SURVEY.md sec 5 failure-detection and checkpoint rows: the primary
+contract stays results-persisted-at-job-end; these tests cover the
+optional extras — a crashed long mine resuming from its persisted DFS
+frontier, and the Miner re-running failed jobs like Spark re-executes
+tasks.
+"""
+
+import json
+import time
+
+import pytest
+
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.models.spade_tpu import SpadeTPU, mine_spade_tpu
+from spark_fsm_tpu.service import plugins
+from spark_fsm_tpu.service.actors import Master, StoreCheckpoint
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+
+def _db():
+    return synthetic_db(seed=31, n_sequences=240, n_items=13,
+                        mean_itemsets=4.0, mean_itemset_size=1.4)
+
+
+def test_crash_resume_parity():
+    """Kill a mine mid-DFS; a fresh engine resuming the last checkpoint
+    must produce the exact full pattern set."""
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+
+    class Crash(Exception):
+        pass
+
+    saved = []
+    merged = []  # checkpoints carry result DELTAS; a sink appends them
+
+    def cb(state):
+        assert state["results_done"] == len(merged)
+        merged.extend(state["results"])
+        saved.append(state)
+        if len(saved) == 2:
+            raise Crash  # simulated mid-mine death, after persisting
+
+    eng = SpadeTPU(vdb, minsup, node_batch=4, pipeline_depth=2,
+                   pool_bytes=32 << 20)
+    with pytest.raises(Crash):
+        eng.mine(checkpoint_cb=cb, checkpoint_every_s=0.0)
+    assert len(saved) == 2
+    # reconstruct the resume dict the way StoreCheckpoint.load does
+    state = json.loads(json.dumps(
+        {**saved[-1], "results": list(merged)}))
+    assert state["stack"], "crash happened after the frontier emptied"
+
+    eng2 = SpadeTPU(build_vertical(db, min_item_support=minsup), minsup,
+                    node_batch=16, pool_bytes=32 << 20)
+    got = eng2.mine(resume=state)
+    assert eng2.stats["resumed_nodes"] == len(state["stack"])
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_resume_rejects_mismatched_fingerprint():
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    eng = SpadeTPU(build_vertical(db, min_item_support=minsup), minsup)
+    state = eng.frontier_state([], [])
+    other = SpadeTPU(build_vertical(db, min_item_support=minsup + 3),
+                     minsup + 3)
+    with pytest.raises(ValueError, match="fingerprint|does not match"):
+        other.mine(resume=state)
+    # a changed length constraint changes the enumeration: also refused
+    constrained = SpadeTPU(build_vertical(db, min_item_support=minsup),
+                           minsup, max_pattern_itemsets=2)
+    with pytest.raises(ValueError, match="fingerprint|does not match"):
+        constrained.mine(resume=state)
+
+
+def test_wrapper_ignores_stale_checkpoint():
+    """mine_spade_tpu silently restarts fresh when the stored frontier was
+    written against different data (e.g. a TRACKED source that grew)."""
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+
+    class FakeCkpt:
+        every_s = 30.0
+
+        def __init__(self, state):
+            self.state = state
+
+        def load(self):
+            return self.state
+
+        def save(self, state):
+            self.state = state
+
+    stale = SpadeTPU(build_vertical(db, min_item_support=minsup + 5),
+                     minsup + 5).frontier_state([], [])
+    got = mine_spade_tpu(db, minsup, checkpoint=FakeCkpt(stale))
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want)
+
+
+def test_store_checkpoint_roundtrip_and_job_clear():
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "job1", every_s=5.0)
+    assert ckpt.load() is None
+    # two delta saves merge back into one results list on load
+    ckpt.save({"version": 1, "stack": [{"steps": [[0, 1]], "s": [], "i": []}],
+               "results_done": 0, "results": [[[[1]], 3]]})
+    ckpt.save({"version": 1, "stack": [],
+               "results_done": 1, "results": [[[[1], [2]], 2]]})
+    state = ckpt.load()
+    assert state["results"] == [[[[1]], 3], [[[1], [2]], 2]]
+    assert state["stack"] == []
+    # a torn snapshot (results list diverged from meta) refuses to resume
+    store.rpush("fsm:frontier:results:job1", json.dumps([[[[9]], 1]]))
+    assert ckpt.load() is None
+    ckpt.save({"version": 1, "stack": [], "results_done": 0, "results": []})
+    assert ckpt.load()["results"] == []
+    store.clear_job("job1")  # new job with the same uid drops the frontier
+    assert ckpt.load() is None
+
+
+@pytest.fixture()
+def flaky_plugin():
+    calls = {"n": 0}
+
+    def extract(req, db, stats=None, checkpoint=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient device wobble")
+        return plugins._spade_cpu(req, db, stats)
+
+    plugins.ALGORITHMS["FLAKY"] = plugins.AlgorithmPlugin(
+        "FLAKY", "patterns", extract)
+    yield calls
+    del plugins.ALGORITHMS["FLAKY"]
+
+
+def _wait(store, uid, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if store.status(uid) in ("finished", "failure"):
+            return store.status(uid)
+        time.sleep(0.02)
+    raise TimeoutError
+
+
+def test_miner_retries_transient_failure(flaky_plugin):
+    store = ResultStore()
+    master = Master(store=store)
+    try:
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "FLAKY", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n", "support": "0.5",
+            "retries": "1"}))
+        uid = resp.data["uid"]
+        assert _wait(store, uid) == "finished"
+        assert flaky_plugin["n"] == 2  # failed once, retried, succeeded
+        assert int(store.get("fsm:metric:jobs_retried") or 0) == 1
+    finally:
+        master.shutdown()
+
+
+def test_miner_no_retry_when_disabled(flaky_plugin):
+    store = ResultStore()
+    master = Master(store=store)
+    try:
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "FLAKY", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n", "support": "0.5", "retries": "0"}))
+        uid = resp.data["uid"]
+        assert _wait(store, uid) == "failure"
+        assert flaky_plugin["n"] == 1
+        assert "wobble" in (store.get(f"fsm:error:{uid}") or "")
+    finally:
+        master.shutdown()
+
+
+def test_validation_error_not_retried():
+    """Deterministic failures (bad params/source) skip the retry loop."""
+    calls = {"n": 0}
+
+    def extract(req, db, stats=None, checkpoint=None):
+        calls["n"] += 1
+        raise ValueError("support parameter is garbage")
+
+    plugins.ALGORITHMS["BROKEN"] = plugins.AlgorithmPlugin(
+        "BROKEN", "patterns", extract)
+    store = ResultStore()
+    master = Master(store=store)
+    try:
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "BROKEN", "source": "INLINE",
+            "sequences": "1 -2\n", "support": "0.5", "retries": "3"}))
+        uid = resp.data["uid"]
+        assert _wait(store, uid) == "failure"
+        assert calls["n"] == 1  # no re-runs despite retries=3
+        assert store.get("fsm:metric:jobs_retried") is None
+    finally:
+        del plugins.ALGORITHMS["BROKEN"]
+        master.shutdown()
+
+
+def test_service_checkpoint_plumbing():
+    """A SPADE_TPU train job with checkpoint=1 writes frontier snapshots
+    during the mine and clears them once results are durable."""
+    store = ResultStore()
+    master = Master(store=store)
+    seen = {"frontier": False}
+    orig_set = store.set
+
+    def spy_set(key, value):
+        if key.startswith("fsm:frontier:"):
+            seen["frontier"] = True
+        orig_set(key, value)
+
+    store.set = spy_set
+    try:
+        db_lines = "\n".join(
+            " -1 ".join(str(i) for i in seq_parts) + " -2"
+            for seq_parts in [(1, 2, 3), (1, 2), (2, 3), (1, 3), (3, 2)]
+            for _ in range(4))
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "SPADE_TPU", "source": "INLINE",
+            "sequences": db_lines, "support": "0.2",
+            "checkpoint": "1", "checkpoint_every_s": "0"}))
+        uid = resp.data["uid"]
+        assert _wait(store, uid) == "finished"
+        assert seen["frontier"], "no frontier snapshot was ever written"
+        assert store.get(f"fsm:frontier:{uid}") is None  # cleared at end
+        assert store.patterns(uid) is not None
+    finally:
+        master.shutdown()
