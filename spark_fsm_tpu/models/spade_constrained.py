@@ -77,17 +77,9 @@ class ConstrainedSpadeTPU:
         self.recompute_chunk = int(recompute_chunk)
         self.max_pattern_itemsets = max_pattern_itemsets
 
-        bitmaps = vdb.bitmaps
-        n_items, n_seq, n_words = bitmaps.shape
+        n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
         if mesh is not None:
-            n_dev = mesh.devices.size
-            padded = pad_to_multiple(n_seq, n_dev)
-            if padded != n_seq:
-                bitmaps = np.concatenate(
-                    [bitmaps, np.zeros((n_items, padded - n_seq, n_words), np.uint32)],
-                    axis=1,
-                )
-                n_seq = padded
+            n_seq = pad_to_multiple(n_seq, mesh.devices.size)
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
         self.n_pos = n_words * 32
         self.dtype = jnp.int8 if self.n_pos <= 127 else jnp.int16
@@ -106,20 +98,50 @@ class ConstrainedSpadeTPU:
         self.pool_slots = pool_slots
         self.node_batch = nb
         self.scratch = pool_slots
-        if mesh is not None:
-            self.items = jax.device_put(bitmaps, store_sharding(mesh))
+        # Scatter-build the item bitmaps IN HBM from the token table and
+        # allocate the state pool on device — neither the dense bitmaps nor
+        # the (large, all-zero) pool ever exists in host memory or crosses
+        # the link (same plan as the unconstrained engine's store build).
+        if mesh is None:
+            def init_items(ti, ts, tw, tm):
+                z = jnp.zeros((n_items, n_seq, n_words), jnp.uint32)
+                return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
+
+            build = jax.jit(init_items)
         else:
-            self.items = jax.device_put(bitmaps)
-        pool_np = np.zeros((pool_slots + 1, n_seq, self.n_pos), self.dtype.dtype)
-        if mesh is not None:
-            self.pool = jax.device_put(pool_np, store_sharding(mesh))
+            shard = n_seq // mesh.devices.size
+
+            def init_items_shard(ti, ts, tw, tm):
+                ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
+                ok = (ls >= 0) & (ls < shard)
+                z = jnp.zeros((n_items, shard, n_words), jnp.uint32)
+                return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
+                    jnp.where(ok, tm, jnp.uint32(0)))
+
+            rep = P()
+            build = jax.jit(jax.shard_map(
+                init_items_shard, mesh=mesh,
+                in_specs=(rep, rep, rep, rep),
+                out_specs=P(None, SEQ_AXIS, None)))
+        self.items = build(
+            jnp.asarray(vdb.tok_item), jnp.asarray(vdb.tok_seq),
+            jnp.asarray(vdb.tok_word), jnp.asarray(vdb.tok_mask))
+        pool_shape = (pool_slots + 1, n_seq, self.n_pos)
+        zeros = lambda: jnp.zeros(pool_shape, self.dtype)
+        if mesh is None:
+            self.pool = jax.jit(zeros)()
         else:
-            self.pool = jax.device_put(pool_np)
-        del pool_np
+            self.pool = jax.jit(
+                zeros, out_shardings=store_sharding(mesh))()
         self._pool_alloc = SlotPool(range(pool_slots))
         self._build_fns()
-        self.stats = {"candidates": 0, "kernel_launches": 0,
-                      "recomputed_nodes": 0, "reclaimed_slots": 0, "patterns": 0}
+        # s_candidates vs i_candidates: under maxgap the s-side is ALL root
+        # items per node (the unsound-sibling-prune rule), so its share of
+        # the candidate volume is the cost of that constraint — measured
+        # here, surfaced through job stats.
+        self.stats = {"candidates": 0, "s_candidates": 0, "i_candidates": 0,
+                      "kernel_launches": 0, "recomputed_nodes": 0,
+                      "reclaimed_slots": 0, "patterns": 0}
 
     # ------------------------------------------------------------------ fns
 
@@ -329,6 +351,9 @@ class ConstrainedSpadeTPU:
                 spans.append((s_lo, s_hi, len(cand_ref)))
 
             self.stats["candidates"] += len(cand_ref)
+            n_s = sum(1 for x in cand_iss if x)
+            self.stats["s_candidates"] += n_s
+            self.stats["i_candidates"] += len(cand_iss) - n_s
             sup_dev = (self._run_chunks(
                            lambda r, it, ss: self._supports_fn(m, pm, self.items, r, it, ss),
                            np.array(cand_ref, np.int32), np.array(cand_item, np.int32),

@@ -172,8 +172,9 @@ class Miner:
                 retries = int(req.param(
                     "retries",
                     str(config.get_config().service.job_retries)))
-            except ValueError:
-                retries = 0
+            except ValueError as exc:  # malformed param: fail like any
+                _record_failure(self.store, req.uid, exc)  # other bad param
+                continue
             attempt = 0
             while True:
                 try:
@@ -214,8 +215,6 @@ class Miner:
         with profile_trace(trace_dir):
             results = plugin.extract(req, db, stats, checkpoint=ckpt)
         mine_s = time.perf_counter() - t1
-        if ckpt is not None:
-            ckpt.clear()  # results are the durable artifact from here on
         stats["mine_s"] = round(mine_s, 4)
         stats["results"] = len(results)
         stats["results_per_s"] = round(len(results) / mine_s, 2) if mine_s else 0.0
@@ -225,6 +224,10 @@ class Miner:
         _sink_results(self.store, req.uid, plugin.kind, results)
         self.store.add_status(req.uid, Status.TRAINED)
         self.store.add_status(req.uid, Status.FINISHED)
+        if ckpt is not None:
+            # only AFTER the results are durable: a sink failure retried
+            # mid-way must resume from the final frontier, not re-mine
+            ckpt.clear()
         self.store.incr("fsm:metric:jobs_finished")
         log_event("job_finished", uid=req.uid, **stats)
 
