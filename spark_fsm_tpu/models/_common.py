@@ -10,6 +10,53 @@ from __future__ import annotations
 from typing import Callable, List, Optional
 
 
+def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
+                        mesh=None, put=None):
+    """Scatter-build a ``[n_rows, n_seq, n_words]`` uint32 bitmap store IN
+    HBM from the vertical DB's token table (SURVEY.md sec 2.3 step 1 as a
+    device kernel) — the dense store never exists on host or crosses the
+    link.  Item rows land in slots ``tok_item``; rows past the tokens'
+    reach (pattern pool, scratch) start zeroed.
+
+    With ``mesh``, each device scatters only the tokens whose sequence id
+    lands in its seq-axis shard (out-of-shard tokens add a 0 mask — a
+    no-op); ``n_seq`` must already be padded to a device multiple.
+    ``put`` maps host token arrays to device inputs (the multi-host engine
+    passes its global-replicate put; default jnp.asarray).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from spark_fsm_tpu.parallel.mesh import SEQ_AXIS
+
+    if mesh is None:
+        def init_store(ti, ts, tw, tm):
+            z = jnp.zeros((n_rows, n_seq, n_words), jnp.uint32)
+            return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
+
+        build = jax.jit(init_store)
+    else:
+        shard = n_seq // mesh.devices.size
+
+        def init_store_shard(ti, ts, tw, tm):
+            ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
+            ok = (ls >= 0) & (ls < shard)
+            z = jnp.zeros((n_rows, shard, n_words), jnp.uint32)
+            return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
+                jnp.where(ok, tm, jnp.uint32(0)))
+
+        rep = P()
+        build = jax.jit(jax.shard_map(
+            init_store_shard, mesh=mesh,
+            in_specs=(rep, rep, rep, rep),
+            out_specs=P(None, SEQ_AXIS, None)))
+    if put is None:
+        put = jnp.asarray
+    return build(put(vdb.tok_item), put(vdb.tok_seq),
+                 put(vdb.tok_word), put(vdb.tok_mask))
+
+
 def next_pow2(n: int) -> int:
     k = 1
     while k < n:

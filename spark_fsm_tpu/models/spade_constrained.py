@@ -35,7 +35,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
-from spark_fsm_tpu.models._common import SlotPool, next_pow2
+from spark_fsm_tpu.models._common import (
+    SlotPool, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
@@ -102,30 +103,8 @@ class ConstrainedSpadeTPU:
         # allocate the state pool on device — neither the dense bitmaps nor
         # the (large, all-zero) pool ever exists in host memory or crosses
         # the link (same plan as the unconstrained engine's store build).
-        if mesh is None:
-            def init_items(ti, ts, tw, tm):
-                z = jnp.zeros((n_items, n_seq, n_words), jnp.uint32)
-                return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
-
-            build = jax.jit(init_items)
-        else:
-            shard = n_seq // mesh.devices.size
-
-            def init_items_shard(ti, ts, tw, tm):
-                ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
-                ok = (ls >= 0) & (ls < shard)
-                z = jnp.zeros((n_items, shard, n_words), jnp.uint32)
-                return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
-                    jnp.where(ok, tm, jnp.uint32(0)))
-
-            rep = P()
-            build = jax.jit(jax.shard_map(
-                init_items_shard, mesh=mesh,
-                in_specs=(rep, rep, rep, rep),
-                out_specs=P(None, SEQ_AXIS, None)))
-        self.items = build(
-            jnp.asarray(vdb.tok_item), jnp.asarray(vdb.tok_seq),
-            jnp.asarray(vdb.tok_word), jnp.asarray(vdb.tok_mask))
+        self.items = scatter_build_store(vdb, n_items, n_seq, n_words,
+                                         mesh=mesh)
         pool_shape = (pool_slots + 1, n_seq, self.n_pos)
         zeros = lambda: jnp.zeros(pool_shape, self.dtype)
         if mesh is None:

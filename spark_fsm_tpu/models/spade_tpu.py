@@ -47,7 +47,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
-from spark_fsm_tpu.models._common import SlotPool, next_pow2
+from spark_fsm_tpu.models._common import (
+    SlotPool, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
@@ -148,36 +149,8 @@ class SpadeTPU:
         if self.use_pallas:  # pair kernel reads item rows rounded to I_TILE
             total = max(total, pad_to_multiple(n_items, PS.I_TILE))
 
-        # Scatter-build the store IN HBM from the ~KB-scale token table
-        # (SURVEY.md sec 2.3 step 1 as a device kernel) — the dense store is
-        # never materialized on host or shipped over the link, on either the
-        # single-chip or the mesh path.
-        if mesh is None:
-            def init_store(ti, ts, tw, tm):
-                z = jnp.zeros((total, n_seq, n_words), jnp.uint32)
-                return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
-
-            build = jax.jit(init_store)
-        else:
-            # Each device scatters only the tokens whose sequence id lands in
-            # its seq-axis shard; out-of-shard tokens add a 0 mask (no-op).
-            shard = n_seq // mesh.devices.size
-
-            def init_store_shard(ti, ts, tw, tm):
-                ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
-                ok = (ls >= 0) & (ls < shard)
-                z = jnp.zeros((total, shard, n_words), jnp.uint32)
-                return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
-                    jnp.where(ok, tm, jnp.uint32(0)))
-
-            rep = P()
-            build = jax.jit(jax.shard_map(
-                init_store_shard, mesh=mesh,
-                in_specs=(rep, rep, rep, rep),
-                out_specs=P(None, SEQ_AXIS, None)))
-        self.store = build(
-            self._put(vdb.tok_item), self._put(vdb.tok_seq),
-            self._put(vdb.tok_word), self._put(vdb.tok_mask))
+        self.store = scatter_build_store(vdb, total, n_seq, n_words,
+                                         mesh=mesh, put=self._put)
 
         # Multiword Pallas: the kernel wants [row, word, seq] layout, and
         # transposing the store per call would copy it — so transpose the

@@ -226,8 +226,14 @@ class Miner:
         self.store.add_status(req.uid, Status.FINISHED)
         if ckpt is not None:
             # only AFTER the results are durable: a sink failure retried
-            # mid-way must resume from the final frontier, not re-mine
-            ckpt.clear()
+            # mid-way must resume from the final frontier, not re-mine.
+            # Best-effort — the job has already succeeded, and a cleanup
+            # hiccup must not fail/re-run it (uid reuse reclaims the keys).
+            try:
+                ckpt.clear()
+            except Exception as exc:
+                log_event("frontier_clear_failed", uid=req.uid,
+                          error=str(exc))
         self.store.incr("fsm:metric:jobs_finished")
         log_event("job_finished", uid=req.uid, **stats)
 
