@@ -31,35 +31,12 @@ BENCH_TPU_WAIT (seconds).
 
 import json
 import os
-import socket
 import sys
 import time
 
-TUNNEL_PORT = 8082  # axon TPU tunnel relay; importing the backend with the
-                    # relay down hangs forever, so probe BEFORE backend init.
-
-
-def _tpu_probe(wait_s: float) -> str:
-    """Empty string if the tunnel answers (retrying up to wait_s), else the
-    fallback reason.  Connection-refused means nothing listens at all (a
-    CPU-only box, not a flaky tunnel), so it gets a short retry budget
-    rather than stalling every run the full wait."""
-    start = time.time()
-    last = "unknown"
-    budget = wait_s
-    while True:
-        try:
-            with socket.create_connection(("127.0.0.1", TUNNEL_PORT), timeout=2.0):
-                return ""
-        except ConnectionRefusedError as e:
-            last = str(e)
-            budget = min(budget, 6.0)  # relay definitively absent
-        except OSError as e:
-            last = str(e)
-        if time.time() - start >= budget:
-            return (f"TPU tunnel port {TUNNEL_PORT} unreachable after "
-                    f"{budget:.0f}s of retries: {last}")
-        time.sleep(2.0)
+# importing the backend with the relay down hangs forever, so probe BEFORE
+# backend init (utils/probe.py imports nothing heavy).
+from spark_fsm_tpu.utils.probe import tpu_probe as _tpu_probe
 
 
 def main() -> None:

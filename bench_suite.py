@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Eval-config benchmark suite — all five BASELINE.md configs on one chip.
+
+``bench.py`` remains the driver's single-line headline harness (config #0,
+the north-star workload); this suite exercises the OTHER eval configs the
+reference is judged on, each scaled so the whole suite fits interactive
+wall-clock, each with parity attested against the CPU oracle:
+
+  1. SPADE on BMS-WebView-1-shaped   (minsup 1%), single chip
+  2. SPADE on MSNBC-shaped           (minsup 0.5%), seq-axis mesh path
+  3. TSR top-k rules on Kosarak-shaped (k=100, minconf=0.5), device engine
+  4. cSPADE on Gazelle-shaped        (maxgap=2, maxwindow=5)
+  5. streaming incremental SPADE     (sliding window, per-window parity)
+
+Prints one JSON line per config and writes the collected results to
+``BENCH_SUITE.json`` (with platform + timestamp) unless BENCH_SUITE_OUT=0.
+Scale knobs: BENCH_SUITE_SCALE (default 0.2) multiplies every dataset's
+size so a full-size run is one env var away.
+
+The real public datasets are unreachable (zero-egress sandbox); the seeded
+synthetic generators in data/synth.py match each dataset's documented
+shape, and the metric strings say so.
+"""
+
+import json
+import os
+import sys
+import time
+
+from spark_fsm_tpu.utils.probe import tpu_probe
+
+
+def main() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        reason = "JAX_PLATFORMS=cpu requested"
+    else:
+        reason = tpu_probe(float(os.environ.get("BENCH_TPU_WAIT", "60")))
+    import jax
+    if reason:
+        print(f"bench_suite: CPU fallback — {reason}", file=sys.stderr)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    from spark_fsm_tpu.data.synth import (
+        bms_webview1_like, gazelle_like, kosarak_like, msnbc_like)
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.oracle import mine_cspade, mine_spade
+    from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu, mine_tsr_tpu
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    from spark_fsm_tpu.streaming.window import WindowMiner
+    from spark_fsm_tpu.utils.canonical import patterns_text, rules_text
+
+    scale = float(os.environ.get("BENCH_SUITE_SCALE", "0.2"))
+    platform = jax.devices()[0].platform
+    results = []
+
+    def record(config, name, fn, oracle_fn, text_fn, warm=True):
+        t0 = time.perf_counter()
+        got = fn()
+        cold = time.perf_counter() - t0
+        wall = cold
+        if warm:  # steady state: compiles cached from the cold run
+            t0 = time.perf_counter()
+            got = fn()
+            wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        want = oracle_fn()
+        oracle_wall = time.perf_counter() - t0
+        row = {
+            "config": config,
+            "metric": name,
+            "results": len(got),
+            "wall_s": round(wall, 3),
+            "cold_wall_s": round(cold, 3),
+            "oracle_wall_s": round(oracle_wall, 3),
+            "speedup_vs_oracle": round(oracle_wall / wall, 2) if wall else 0.0,
+            "parity": text_fn(got) == text_fn(want),
+            "platform": platform,
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # 1. SPADE, BMS-WebView-1-shaped, minsup 1%
+    db1 = bms_webview1_like(scale=scale)
+    ms1 = abs_minsup(0.01, len(db1))
+    record(1, f"SPADE synthetic BMS-WebView-1-shaped x{scale} minsup=1%",
+           lambda: mine_spade_tpu(db1, ms1),
+           lambda: mine_spade(db1, ms1), patterns_text)
+
+    # 2. SPADE, MSNBC-shaped, minsup 0.5%, through the mesh (shard_map+psum)
+    # path — on a 1-chip box this still exercises the sharded program.
+    db2 = msnbc_like(scale=scale * 0.5)  # msnbc is ~1M seqs; halve again
+    ms2 = abs_minsup(0.005, len(db2))
+    mesh = make_mesh(len(jax.devices()))
+    record(2, f"SPADE synthetic MSNBC-shaped mesh({mesh.devices.size}) minsup=0.5%",
+           lambda: mine_spade_tpu(db2, ms2, mesh=mesh),
+           lambda: mine_spade(db2, ms2), patterns_text)
+
+    # 3. TSR top-k rules, Kosarak-shaped
+    db3 = kosarak_like(scale=scale * 0.5)
+    record(3, "TSR_TPU synthetic Kosarak-shaped k=100 minconf=0.5",
+           lambda: mine_tsr_tpu(db3, 100, 0.5, max_side=2),
+           lambda: mine_tsr_cpu(db3, 100, 0.5, max_side=2), rules_text,
+           warm=False)  # minutes-long: one run, cold == wall
+
+    # 4. cSPADE, Gazelle-shaped, maxgap=2 maxwindow=5
+    db4 = gazelle_like(scale=scale)
+    ms4 = abs_minsup(0.005, len(db4))
+    record(4, f"cSPADE synthetic Gazelle-shaped maxgap=2 maxwindow=5 minsup=0.5%",
+           lambda: mine_cspade_tpu(db4, ms4, maxgap=2, maxwindow=5),
+           lambda: mine_cspade(db4, ms4, maxgap=2, maxwindow=5), patterns_text)
+
+    # 5. streaming incremental SPADE: sliding window over micro-batches,
+    # parity of EVERY window state vs a fresh oracle mine of that window
+    db5 = bms_webview1_like(scale=scale, seed=9)
+    n_batches = min(6, len(db5))  # tiny scales: one sequence per batch
+    per = len(db5) // n_batches
+    batches = [db5[i * per: (i + 1) * per if i < n_batches - 1 else len(db5)]
+               for i in range(n_batches)]  # remainder rides the last batch
+    wm = WindowMiner(0.02, max_batches=3)
+    t0 = time.perf_counter()
+    stream_parity = True
+    for batch in batches:
+        got = wm.push(batch)
+        window_db = wm.window.sequences()
+        want = mine_spade(window_db, wm.minsup_abs())
+        stream_parity &= patterns_text(got) == patterns_text(want)
+    wall = time.perf_counter() - t0
+    row = {
+        "config": 5,
+        "metric": (f"streaming SPADE sliding-window({n_batches} micro-batches,"
+                   f" keep 3) minsup=2%"),
+        "results": len(wm.patterns),
+        "wall_s": round(wall, 3),
+        "pushes": wm.stats["pushes"],
+        "parity": stream_parity,  # every window state vs fresh oracle
+        "platform": platform,
+    }
+    results.append(row)
+    print(json.dumps(row), flush=True)
+
+    if os.environ.get("BENCH_SUITE_OUT") != "0":
+        out = {
+            "scale": scale,
+            "ts": round(time.time(), 1),
+            "platform": platform,
+            "all_parity": all(r["parity"] for r in results),
+            "note": ("per-launch host<->device latency dominates at small "
+                     "scales; the device engine's win grows with DB size "
+                     "(headline full-size workload: see BASELINE.json "
+                     "published, ~33x over the oracle)"),
+            "configs": results,
+        }
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SUITE.json")
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=2)
+            fh.write("\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

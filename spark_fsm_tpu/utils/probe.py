@@ -1,0 +1,37 @@
+"""TPU-tunnel reachability probe shared by the bench harnesses.
+
+On this deployment the TPU backend is reached through a local relay; if
+the relay is down, *importing the backend hangs forever*, so harnesses
+must probe the socket BEFORE the first jax import and fall back to CPU
+loudly when it is unreachable.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+TUNNEL_PORT = 8082
+
+
+def tpu_probe(wait_s: float, port: int = TUNNEL_PORT) -> str:
+    """Empty string if the tunnel answers (retrying up to ``wait_s``), else
+    the fallback reason.  Connection-refused means nothing listens at all
+    (a CPU-only box, not a flaky tunnel), so it gets a short retry budget
+    rather than stalling every run the full wait."""
+    start = time.time()
+    last = "unknown"
+    budget = wait_s
+    while True:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2.0):
+                return ""
+        except ConnectionRefusedError as e:
+            last = str(e)
+            budget = min(budget, 6.0)  # relay definitively absent
+        except OSError as e:
+            last = str(e)
+        if time.time() - start >= budget:
+            return (f"TPU tunnel port {port} unreachable after "
+                    f"{budget:.0f}s of retries: {last}")
+        time.sleep(2.0)
