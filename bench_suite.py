@@ -58,7 +58,12 @@ def main() -> None:
     platform = jax.devices()[0].platform
     results = []
 
-    def record(config, name, fn, oracle_fn, text_fn, warm=True):
+    def record(config, name, fn, oracle_fn, text_fn, warm=True, db=None):
+        if db is not None and not db:
+            print(json.dumps({"config": config, "skipped":
+                              f"scale {scale} yields an empty database"}),
+                  flush=True)
+            return
         t0 = time.perf_counter()
         got = fn()
         cold = time.perf_counter() - t0
@@ -89,7 +94,7 @@ def main() -> None:
     ms1 = abs_minsup(0.01, len(db1))
     record(1, f"SPADE synthetic BMS-WebView-1-shaped x{scale} minsup=1%",
            lambda: mine_spade_tpu(db1, ms1),
-           lambda: mine_spade(db1, ms1), patterns_text)
+           lambda: mine_spade(db1, ms1), patterns_text, db=db1)
 
     # 2. SPADE, MSNBC-shaped, minsup 0.5%, through the mesh (shard_map+psum)
     # path — on a 1-chip box this still exercises the sharded program.
@@ -98,50 +103,57 @@ def main() -> None:
     mesh = make_mesh(len(jax.devices()))
     record(2, f"SPADE synthetic MSNBC-shaped mesh({mesh.devices.size}) minsup=0.5%",
            lambda: mine_spade_tpu(db2, ms2, mesh=mesh),
-           lambda: mine_spade(db2, ms2), patterns_text)
+           lambda: mine_spade(db2, ms2), patterns_text, db=db2)
 
     # 3. TSR top-k rules, Kosarak-shaped
     db3 = kosarak_like(scale=scale * 0.5)
     record(3, "TSR_TPU synthetic Kosarak-shaped k=100 minconf=0.5",
            lambda: mine_tsr_tpu(db3, 100, 0.5, max_side=2),
            lambda: mine_tsr_cpu(db3, 100, 0.5, max_side=2), rules_text,
-           warm=False)  # minutes-long: one run, cold == wall
+           warm=False, db=db3)  # minutes-long: one run, cold == wall
 
     # 4. cSPADE, Gazelle-shaped, maxgap=2 maxwindow=5
     db4 = gazelle_like(scale=scale)
     ms4 = abs_minsup(0.005, len(db4))
     record(4, f"cSPADE synthetic Gazelle-shaped maxgap=2 maxwindow=5 minsup=0.5%",
            lambda: mine_cspade_tpu(db4, ms4, maxgap=2, maxwindow=5),
-           lambda: mine_cspade(db4, ms4, maxgap=2, maxwindow=5), patterns_text)
+           lambda: mine_cspade(db4, ms4, maxgap=2, maxwindow=5), patterns_text,
+           db=db4)
 
     # 5. streaming incremental SPADE: sliding window over micro-batches,
     # parity of EVERY window state vs a fresh oracle mine of that window
     db5 = bms_webview1_like(scale=scale, seed=9)
-    n_batches = min(6, len(db5))  # tiny scales: one sequence per batch
-    per = len(db5) // n_batches
-    batches = [db5[i * per: (i + 1) * per if i < n_batches - 1 else len(db5)]
-               for i in range(n_batches)]  # remainder rides the last batch
-    wm = WindowMiner(0.02, max_batches=3)
-    t0 = time.perf_counter()
-    stream_parity = True
-    for batch in batches:
-        got = wm.push(batch)
-        window_db = wm.window.sequences()
-        want = mine_spade(window_db, wm.minsup_abs())
-        stream_parity &= patterns_text(got) == patterns_text(want)
-    wall = time.perf_counter() - t0
-    row = {
-        "config": 5,
-        "metric": (f"streaming SPADE sliding-window({n_batches} micro-batches,"
-                   f" keep 3) minsup=2%"),
-        "results": len(wm.patterns),
-        "wall_s": round(wall, 3),
-        "pushes": wm.stats["pushes"],
-        "parity": stream_parity,  # every window state vs fresh oracle
-        "platform": platform,
-    }
-    results.append(row)
-    print(json.dumps(row), flush=True)
+    if not db5:
+        print(json.dumps({"config": 5, "skipped":
+                          f"scale {scale} yields an empty database"}),
+              flush=True)
+    else:
+        n_batches = min(6, len(db5))  # tiny scales: one sequence per batch
+        per = len(db5) // n_batches
+        batches = [
+            db5[i * per: (i + 1) * per if i < n_batches - 1 else len(db5)]
+            for i in range(n_batches)]  # remainder rides the last batch
+        wm = WindowMiner(0.02, max_batches=3)
+        t0 = time.perf_counter()
+        stream_parity = True
+        for batch in batches:
+            got = wm.push(batch)
+            window_db = wm.window.sequences()
+            want = mine_spade(window_db, wm.minsup_abs())
+            stream_parity &= patterns_text(got) == patterns_text(want)
+        wall = time.perf_counter() - t0
+        row = {
+            "config": 5,
+            "metric": (f"streaming SPADE sliding-window({n_batches} "
+                       f"micro-batches, keep 3) minsup=2%"),
+            "results": len(wm.patterns),
+            "wall_s": round(wall, 3),
+            "pushes": wm.stats["pushes"],
+            "parity": stream_parity,  # every window state vs fresh oracle
+            "platform": platform,
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
 
     if os.environ.get("BENCH_SUITE_OUT") != "0":
         out = {

@@ -335,6 +335,13 @@ class Streamer:
     ``max_sequences``, constraints) is fixed by the first push to the
     topic; later pushes may omit it.  Relative ``support`` is recomputed
     against the *current* window size on every push.
+
+    Window state survives restarts (SURVEY.md sec 5 checkpoint row's
+    streaming half): the topic config and the window's raw micro-batch
+    texts persist in the store (``fsm:stream:cfg/window:{topic}``), and a
+    restarted service rebuilds the window on the topic's first touch — so
+    the push after a restart mines the true window, not a truncated one.
+    Mined results were already durable (``fsm:pattern:stream:{topic}``).
     """
 
     def __init__(self, store: ResultStore) -> None:
@@ -342,11 +349,69 @@ class Streamer:
         self._lock = threading.Lock()
         self._topics: Dict[str, dict] = {}
 
-    def _topic_state(self, req: ServiceRequest, topic: str) -> dict:
+    def _build_state(self, data: Dict[str, str],
+                     mb: Optional[int], ms: Optional[int]) -> dict:
+        """Topic state from a (validated-here) config; shared by first-push
+        creation and restart restore."""
         from spark_fsm_tpu.streaming.window import WindowMiner
 
+        base = ServiceRequest("fsm", "stream", data)
+        # Validate the WHOLE config before caching: a bad first push must
+        # not poison the topic forever.
+        plugin = plugins.get_plugin(base)
+        support = float(data["support"])
+        for p in ("maxgap", "maxwindow", "k", "max_side"):
+            if base.param(p) is not None:
+                int(base.param(p))
+        if base.param("minconf") is not None:
+            float(base.param("minconf"))
+
+        def plugin_mine(db, minsup_abs, _plugin=plugin, _base=base):
+            # WindowMiner computes the window-relative absolute minsup;
+            # hand it to the plugin as an absolute count (plugins._minsup
+            # treats support >= 1 as absolute).
+            d = dict(_base.data)
+            d["support"] = str(int(minsup_abs))
+            return _plugin.extract(
+                ServiceRequest(_base.service, _base.task, d), db)
+
+        return {
+            "miner": WindowMiner(support, max_batches=mb, max_sequences=ms,
+                                 mine=plugin_mine),
+            "kind": plugin.kind,
+            "cfg": {"data": data, "max_batches": mb, "max_sequences": ms},
+            # held across push + result sink + response-field reads
+            # so concurrent pushes cannot sink an older window's
+            # results over a newer one's (push alone is serialized
+            # inside WindowMiner, but the store write is not)
+            "lock": threading.Lock(),
+        }
+
+    def _restore(self, topic: str) -> Optional[dict]:
+        """Rebuild a topic from its persisted config + window batches."""
+        from spark_fsm_tpu.data.spmf import parse_spmf
+
+        raw = self.store.get(f"fsm:stream:cfg:{topic}")
+        if not raw:
+            return None
+        cfg = json.loads(raw)
+        state = self._build_state(cfg["data"], cfg["max_batches"],
+                                  cfg["max_sequences"])
+        wraw = self.store.get(f"fsm:stream:window:{topic}")
+        window = state["miner"].window
+        for text in (json.loads(wraw) if wraw else []):
+            # refill WITHOUT re-mining: results are already durable, and
+            # the next push re-mines the full window anyway
+            window.push(parse_spmf(text))
+        log_event("stream_topic_restored", topic=topic,
+                  batches=window.n_batches, sequences=window.n_sequences)
+        return state
+
+    def _topic_state(self, req: ServiceRequest, topic: str) -> dict:
         with self._lock:
             state = self._topics.get(topic)
+            if state is None:
+                state = self._restore(topic)
             if state is None:
                 mb = req.param("max_batches")
                 ms = req.param("max_sequences")
@@ -358,40 +423,13 @@ class Streamer:
                         if k not in ("sequences", "uid")}
                 data.setdefault("algorithm", "SPADE_TPU")
                 data.setdefault("support", "0.1")
-                base = ServiceRequest(req.service, req.task, data)
-                # Validate the WHOLE config before caching: a bad first
-                # push must not poison the topic forever.
-                plugin = plugins.get_plugin(base)
-                support = float(data["support"])
-                for p in ("maxgap", "maxwindow", "k", "max_side"):
-                    if base.param(p) is not None:
-                        int(base.param(p))
-                if base.param("minconf") is not None:
-                    float(base.param("minconf"))
-
-                def plugin_mine(db, minsup_abs, _plugin=plugin, _base=base):
-                    # WindowMiner computes the window-relative absolute
-                    # minsup; hand it to the plugin as an absolute count
-                    # (plugins._minsup treats support >= 1 as absolute).
-                    d = dict(_base.data)
-                    d["support"] = str(int(minsup_abs))
-                    return _plugin.extract(
-                        ServiceRequest(_base.service, _base.task, d), db)
-
-                state = {
-                    "miner": WindowMiner(
-                        support,
-                        max_batches=int(mb) if mb is not None else None,
-                        max_sequences=int(ms) if ms is not None else None,
-                        mine=plugin_mine),
-                    "kind": plugin.kind,
-                    # held across push + result sink + response-field reads
-                    # so concurrent pushes cannot sink an older window's
-                    # results over a newer one's (push alone is serialized
-                    # inside WindowMiner, but the store write is not)
-                    "lock": threading.Lock(),
-                }
-                self._topics[topic] = state
+                state = self._build_state(
+                    data,
+                    int(mb) if mb is not None else None,
+                    int(ms) if ms is not None else None)
+                self.store.set(f"fsm:stream:cfg:{topic}",
+                               json.dumps(state["cfg"]))
+            self._topics[topic] = state
             return state
 
     def handle(self, req: ServiceRequest, topic: str) -> ServiceResponse:
@@ -418,9 +456,21 @@ class Streamer:
             return model.response(req, Status.FAILURE, error=str(exc))
         uid = f"stream:{topic}"
         miner = state["miner"]
+        from spark_fsm_tpu.data.spmf import format_spmf
+
         with state["lock"]:
             try:
-                results = miner.push(batch)
+                try:
+                    results = miner.push(batch)
+                finally:
+                    # persist whatever the window NOW holds — the window
+                    # mutates before the mine runs, so a failed mine must
+                    # still persist the appended batch or a restart would
+                    # restore a window diverged from the live one
+                    self.store.set(
+                        f"fsm:stream:window:{topic}",
+                        json.dumps([format_spmf(b)
+                                    for b in miner.window.batches()]))
                 # a prior failed push's error must not shadow this success
                 # in /status (the batch path clears via clear_job)
                 self.store.delete(f"fsm:error:{uid}")

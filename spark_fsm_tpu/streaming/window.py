@@ -68,6 +68,11 @@ class SlidingWindow:
     def n_sequences(self) -> int:
         return self._n_sequences
 
+    def batches(self) -> List[SequenceDB]:
+        """The live micro-batches, oldest first (a fresh list) — the
+        authoritative window content for persistence mirrors."""
+        return list(self._batches)
+
     def sequences(self) -> SequenceDB:
         """The window's sequence DB, oldest batch first (a fresh list —
         the canonical input for both the engine mine and the parity

@@ -186,3 +186,91 @@ def test_window_rejects_nonpositive_caps():
         SlidingWindow(max_batches=0)
     with pytest.raises(ValueError, match="max_sequences"):
         SlidingWindow(max_sequences=-1)
+
+
+def test_stream_window_survives_restart():
+    """The window state is persisted: a new Master over the same store
+    (simulating a service restart) continues the stream exactly — the
+    post-restart push mines the true window, not a truncated one."""
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import (
+        ServiceRequest, deserialize_patterns)
+    from spark_fsm_tpu.service.store import ResultStore
+    from spark_fsm_tpu.utils.canonical import sort_patterns
+
+    store = ResultStore()
+    batches = _batches(seed=11, n=4, size=12)
+
+    def push(master, batch):
+        return master.handle(ServiceRequest("fsm", "stream:rwin", {
+            "sequences": format_spmf(batch), "support": "0.2",
+            "max_batches": "2", "algorithm": "SPADE"}))
+
+    m1 = Master(store=store)
+    try:
+        for b in batches[:3]:
+            assert push(m1, b).status == "finished"
+    finally:
+        m1.shutdown()
+
+    m2 = Master(store=store)  # restart: fresh process state, same store
+    try:
+        # served results are durable without any push
+        patterns = deserialize_patterns(store.patterns("stream:rwin"))
+        seqs = [s for bb in batches[1:3] for s in bb]
+        want = mine_spade(seqs, abs_minsup(0.2, len(seqs)))
+        assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+        # the post-restart push slides the RESTORED window (batches 2,3 ->
+        # 3,4), not an empty one
+        resp = push(m2, batches[3])
+        assert resp.status == "finished"
+        assert resp.data["window_batches"] == "2"
+        seqs = [s for bb in batches[2:4] for s in bb]
+        assert resp.data["window_sequences"] == str(len(seqs))
+        patterns = deserialize_patterns(store.patterns("stream:rwin"))
+        want = mine_spade(seqs, abs_minsup(0.2, len(seqs)))
+        assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+    finally:
+        m2.shutdown()
+
+
+def test_stream_persisted_window_tracks_failed_mine():
+    """The window mutates before the mine runs, so a failed mine must
+    still persist the appended batch — otherwise a restart restores a
+    window diverged from the live one."""
+    from spark_fsm_tpu.service import plugins
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import ServiceRequest
+    from spark_fsm_tpu.service.store import ResultStore
+
+    calls = {"n": 0}
+
+    def extract(req, db, stats=None, checkpoint=None):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("mine blew up")
+        return plugins._spade_cpu(req, db, stats)
+
+    plugins.ALGORITHMS["FLAKY_STREAM"] = plugins.AlgorithmPlugin(
+        "FLAKY_STREAM", "patterns", extract)
+    store = ResultStore()
+    master = Master(store=store)
+    try:
+        def push(seqs):
+            return master.handle(ServiceRequest("fsm", "stream:fwin", {
+                "sequences": seqs, "support": "0.5", "max_batches": "4",
+                "algorithm": "FLAKY_STREAM"}))
+
+        assert push("1 -1 2 -2\n").status == "finished"
+        assert push("3 -1 2 -2\n").status == "failure"  # mine #2 raises
+        persisted = json.loads(store.get("fsm:stream:window:fwin"))
+        assert len(persisted) == 2  # failed mine's batch IS in the window
+        # a restarted service restores the full 2-batch window
+        master.streamer._topics.clear()
+        resp = push("2 -1 1 -2\n")
+        assert resp.status == "finished"
+        assert resp.data["window_batches"] == "3"
+        assert len(json.loads(store.get("fsm:stream:window:fwin"))) == 3
+    finally:
+        del plugins.ALGORITHMS["FLAKY_STREAM"]
+        master.shutdown()
