@@ -36,7 +36,7 @@ File format (TOML shown; JSON with the same nesting also accepted):
     pipeline_depth = 4              # in-flight support readbacks
     chunk = 256                     # SPADE support-count batch width
     recompute_chunk = 256
-    tsr_chunk = 256                 # TSR candidate batch width
+    tsr_chunk = 2048                # TSR candidate batch (default adaptive)
     item_cap = 256                  # TSR iterative-deepening width
 
 Unknown keys are rejected (a typo'd knob must not silently no-op).
@@ -76,7 +76,8 @@ class EngineConfig:
     pipeline_depth: Optional[int] = None
     chunk: Optional[int] = None  # SPADE engines (default 2048 there)
     recompute_chunk: Optional[int] = None
-    tsr_chunk: Optional[int] = None  # TSR candidate batch (default 256)
+    tsr_chunk: Optional[int] = None  # TSR candidate batch (default: sized
+    # to the eval HBM budget — see models/tsr.py TsrTPU.__init__)
     item_cap: Optional[int] = None  # TSR iterative-deepening width
 
 

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
+from spark_fsm_tpu.models._common import next_pow2
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
@@ -116,15 +117,15 @@ class TsrTPU:
         minconf: float,
         *,
         mesh: Optional[Mesh] = None,
-        chunk: int = 256,
+        chunk: Optional[int] = None,
         item_cap: int = 256,
         max_side: Optional[int] = None,
+        eval_budget_bytes: int = 4 << 30,
     ):
         self.vdb = vdb
         self.k = int(k)
         self.minconf = float(minconf)
         self.mesh = mesh
-        self.chunk = int(chunk)
         self.item_cap = int(item_cap)
         self.max_side = max_side
         self.stats = {"evaluated": 0, "kernel_launches": 0, "deepening_rounds": 0}
@@ -138,6 +139,19 @@ class TsrTPU:
         if mesh is not None:
             self.n_seq = pad_to_multiple(self.n_seq, mesh.devices.size)
         self.n_words = vdb.n_words
+
+        if chunk is None:
+            # Per-launch dispatch latency dominates on remote/tunneled TPUs
+            # (~100ms+ each; measured 6x wall-clock win going 256 -> 8192
+            # on a Kosarak-shaped mine), so make launches as WIDE as the
+            # per-device eval budget allows: the evaluator keeps ~4 live
+            # [chunk, S_local, W] uint32 intermediates.  Pow2 so the eval
+            # fn's compiled shapes stay bucketed.
+            s_local = self.n_seq // (1 if mesh is None else mesh.devices.size)
+            per_cand = max(1, s_local * self.n_words * 4 * 4)
+            chunk = max(128, min(8192,
+                                 next_pow2(eval_budget_bytes // per_cand + 1) // 2))
+        self.chunk = int(chunk)
         # tok_item is nondecreasing (build_vertical emits tokens sorted by
         # item), so per-item token ranges are a searchsorted away
         self._tok_starts = np.searchsorted(

@@ -397,12 +397,26 @@ class Streamer:
         cfg = json.loads(raw)
         state = self._build_state(cfg["data"], cfg["max_batches"],
                                   cfg["max_sequences"])
-        wraw = self.store.get(f"fsm:stream:window:{topic}")
         window = state["miner"].window
-        for text in (json.loads(wraw) if wraw else []):
+        for text in self.store.lrange(f"fsm:stream:window:{topic}"):
             # refill WITHOUT re-mining: results are already durable, and
-            # the next push re-mines the full window anyway
+            # the next push re-mines the full window anyway.  Replaying
+            # through push() re-applies the eviction caps, so even a
+            # persisted list with stale head entries (a crash between the
+            # append and its trim) converges to the correct window.
             window.push(parse_spmf(text))
+        sraw = self.store.get(f"fsm:stats:stream:{topic}")
+        if sraw:
+            # cumulative counters survive the restart; the refill pushes
+            # above must not inflate them
+            prev = json.loads(sraw)
+            for key in ("pushes", "mines", "evicted_batches"):
+                if key in prev:
+                    state["miner"].stats[key] = int(prev[key])
+            window.pushed_batches = int(prev.get("pushes",
+                                                 window.pushed_batches))
+            window.evicted_batches = int(prev.get("evicted_batches",
+                                                  window.evicted_batches))
         log_event("stream_topic_restored", topic=topic,
                   batches=window.n_batches, sequences=window.n_sequences)
         return state
@@ -456,21 +470,20 @@ class Streamer:
             return model.response(req, Status.FAILURE, error=str(exc))
         uid = f"stream:{topic}"
         miner = state["miner"]
-        from spark_fsm_tpu.data.spmf import format_spmf
-
+        win_key = f"fsm:stream:window:{topic}"
         with state["lock"]:
             try:
                 try:
                     results = miner.push(batch)
                 finally:
-                    # persist whatever the window NOW holds — the window
-                    # mutates before the mine runs, so a failed mine must
-                    # still persist the appended batch or a restart would
-                    # restore a window diverged from the live one
-                    self.store.set(
-                        f"fsm:stream:window:{topic}",
-                        json.dumps([format_spmf(b)
-                                    for b in miner.window.batches()]))
+                    # persist the DELTA (append the batch, trim evictions to
+                    # the live batch count) — the window mutates before the
+                    # mine runs, so this happens even for a failed mine, or
+                    # a restart would restore a window diverged from the
+                    # live one.  Cost is O(batch), not O(window).
+                    self.store.rpush(win_key, text)
+                    while self.store.llen(win_key) > miner.window.n_batches:
+                        self.store.lpop(win_key)
                 # a prior failed push's error must not shadow this success
                 # in /status (the batch path clears via clear_job)
                 self.store.delete(f"fsm:error:{uid}")

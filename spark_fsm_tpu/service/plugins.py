@@ -116,7 +116,8 @@ def _tsr_params(req: ServiceRequest):
 
 def _tsr_kwargs() -> dict:
     # TSR's batch width is a separate boot knob from SPADE's (tsr_chunk):
-    # the two engines' defaults differ 8x and must not be tuned together.
+    # SPADE's is a fixed dispatch width, TSR's defaults to an HBM-budget-
+    # adaptive size — they must not be tuned together.
     kwargs = config.engine_kwargs("item_cap")
     tsr_chunk = config.engine_kwargs("tsr_chunk").get("tsr_chunk")
     if tsr_chunk is not None:

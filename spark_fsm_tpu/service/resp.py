@@ -155,6 +155,16 @@ class RespClient:
         assert isinstance(reply, list)
         return [r for r in reply if isinstance(r, str)]
 
+    def lpop(self, key: str) -> Optional[str]:
+        reply = self.command("LPOP", key)
+        assert reply is None or isinstance(reply, str)
+        return reply
+
+    def llen(self, key: str) -> int:
+        reply = self.command("LLEN", key)
+        assert isinstance(reply, int)
+        return reply
+
     def delete(self, key: str) -> int:
         reply = self.command("DEL", key)
         assert isinstance(reply, int)

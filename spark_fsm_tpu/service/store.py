@@ -50,6 +50,15 @@ class ResultStore:
         with self._lock:
             return list(self._lists.get(key, []))
 
+    def lpop(self, key: str) -> Optional[str]:
+        with self._lock:
+            lst = self._lists.get(key)
+            return lst.pop(0) if lst else None
+
+    def llen(self, key: str) -> int:
+        with self._lock:
+            return len(self._lists.get(key, ()))
+
     def delete(self, key: str) -> None:
         with self._lock:
             self._kv.pop(key, None)
@@ -148,6 +157,12 @@ class RedisResultStore(ResultStore):
 
     def lrange(self, key: str) -> List[str]:
         return self._r.lrange(key, 0, -1)
+
+    def lpop(self, key: str) -> Optional[str]:
+        return self._r.lpop(key)
+
+    def llen(self, key: str) -> int:
+        return self._r.llen(key)
 
     def delete(self, key: str) -> None:
         self._r.delete(key)

@@ -105,6 +105,14 @@ class MiniRedis:
                     vb = v.encode()
                     out.append(b"$%d\r\n%s\r\n" % (len(vb), vb))
                 return b"".join(out)
+            if cmd == "LPOP":
+                lst = self.lists.get(rest[0], [])
+                if not lst:
+                    return b"$-1\r\n"
+                vb = lst.pop(0).encode()
+                return b"$%d\r\n%s\r\n" % (len(vb), vb)
+            if cmd == "LLEN":
+                return b":%d\r\n" % len(self.lists.get(rest[0], []))
             if cmd == "DEL":
                 n = 0
                 for k in rest:
@@ -142,6 +150,10 @@ def test_client_roundtrip(mini_redis):
     assert c.rpush("l", "x") == 1
     assert c.rpush("l", "y") == 2
     assert c.lrange("l") == ["x", "y"]
+    assert c.llen("l") == 2
+    assert c.lpop("l") == "x"
+    assert c.lrange("l") == ["y"]
+    assert c.lpop("missing") is None
     assert c.incr("n") == 1
     assert c.incr("n") == 2
     assert c.delete("a") == 1

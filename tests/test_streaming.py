@@ -230,6 +230,11 @@ def test_stream_window_survives_restart():
         patterns = deserialize_patterns(store.patterns("stream:rwin"))
         want = mine_spade(seqs, abs_minsup(0.2, len(seqs)))
         assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+        # cumulative counters survive the restart (4 pushes total, and the
+        # restore's window refill did not inflate them)
+        stats = json.loads(store.get("fsm:stats:stream:rwin"))
+        assert stats["pushes"] == 4
+        assert resp.data["evicted_batches"] == "2"
     finally:
         m2.shutdown()
 
@@ -263,14 +268,14 @@ def test_stream_persisted_window_tracks_failed_mine():
 
         assert push("1 -1 2 -2\n").status == "finished"
         assert push("3 -1 2 -2\n").status == "failure"  # mine #2 raises
-        persisted = json.loads(store.get("fsm:stream:window:fwin"))
+        persisted = store.lrange("fsm:stream:window:fwin")
         assert len(persisted) == 2  # failed mine's batch IS in the window
         # a restarted service restores the full 2-batch window
         master.streamer._topics.clear()
         resp = push("2 -1 1 -2\n")
         assert resp.status == "finished"
         assert resp.data["window_batches"] == "3"
-        assert len(json.loads(store.get("fsm:stream:window:fwin"))) == 3
+        assert len(store.lrange("fsm:stream:window:fwin")) == 3
     finally:
         del plugins.ALGORITHMS["FLAKY_STREAM"]
         master.shutdown()
