@@ -398,7 +398,26 @@ class Streamer:
         state = self._build_state(cfg["data"], cfg["max_batches"],
                                   cfg["max_sequences"])
         window = state["miner"].window
-        for text in self.store.lrange(f"fsm:stream:window:{topic}"):
+        win_key = f"fsm:stream:window:{topic}"
+        try:
+            texts = self.store.lrange(win_key)
+        except Exception:  # real Redis: WRONGTYPE on a pre-delta-format key
+            texts = []
+        if not texts:
+            raw = None
+            try:
+                raw = self.store.get(win_key)
+            except Exception:
+                pass
+            if raw:  # migrate the old whole-window-JSON format in place
+                try:
+                    texts = json.loads(raw)
+                except ValueError:
+                    texts = []
+                self.store.delete(win_key)
+                for t in texts:
+                    self.store.rpush(win_key, t)
+        for text in texts:
             # refill WITHOUT re-mining: results are already durable, and
             # the next push re-mines the full window anyway.  Replaying
             # through push() re-applies the eviction caps, so even a

@@ -18,9 +18,10 @@ Registered sources:
              benchmark datasets; see data/synth.py).
   JDBC     — SQL database via stdlib sqlite3 (``db``/``url`` + ``query``
              or ``table``), with the same field-role mapping as TRACKED.
-  ELASTIC / PIWIK — interface stubs: constructing them raises a clear
-             error in this sandbox (no network egress), but the registry
-             seam and parameter names match SURVEY.md.
+  ELASTIC  — Elasticsearch search/scroll HTTP API (``url`` + ``index``),
+             hit ``_source`` fields role-mapped like TRACKED/JDBC.
+  PIWIK    — Piwik analytics DB export (sqlite): the ecommerce item log
+             grouped into per-visitor purchase sequences.
 """
 
 from __future__ import annotations
@@ -93,7 +94,16 @@ def events_to_db(events: List[dict], fm: Dict[str, str],
         ts_raw = ev.get(fm["timestamp"])
         ts = int(ts_raw) if ts_raw not in (None, "") else 0
         g_raw = ev.get(fm["group"])
-        group = int(g_raw) if g_raw not in (None, "") else ts
+        # group ids may be arbitrary strings (e.g. Piwik order ids like
+        # 'ORD-1001'); the tagged tuple keeps numeric and string ids in
+        # one deterministic sort order for the first-timestamp tiebreak
+        if g_raw in (None, ""):
+            group = (0, ts)
+        else:
+            try:
+                group = (0, int(g_raw))
+            except (TypeError, ValueError):
+                group = (1, str(g_raw))
         if fm["item"] not in ev or ev[fm["item"]] is None:
             # spec registered/changed after this event was recorded
             raise SourceError(
@@ -154,7 +164,15 @@ def jdbc_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
         if not table.replace("_", "").isalnum():
             raise SourceError(f"invalid table name {table!r}")
         query = f"SELECT * FROM {table}"
+    events = _sqlite_events(path, query, ())
+    if not events:
+        raise SourceError(f"JDBC query returned no rows: {query!r}")
+    fm = field_map(store, req.param("topic", "item"))
+    return events_to_db(events, fm, origin="JDBC row")
 
+
+def _sqlite_events(path: str, query: str, params: tuple) -> List[dict]:
+    """Run one SQL query read-only; rows as column-name dicts."""
     import sqlite3
 
     try:
@@ -166,19 +184,125 @@ def jdbc_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     except sqlite3.OperationalError as exc:
         raise SourceError(f"cannot open sqlite db {path!r}: {exc}") from exc
     try:
-        cur = conn.execute(query)
+        cur = conn.execute(query, params)
         if cur.description is None:  # empty/comment-only/non-SELECT query
-            raise SourceError(f"JDBC query returned no result set: {query!r}")
+            raise SourceError(f"query returned no result set: {query!r}")
         cols = [d[0] for d in cur.description]
-        events = [dict(zip(cols, row)) for row in cur.fetchall()]
+        return [dict(zip(cols, row)) for row in cur.fetchall()]
     except sqlite3.Error as exc:
-        raise SourceError(f"JDBC query failed: {exc}") from exc
+        raise SourceError(f"query failed: {exc}") from exc
     finally:
         conn.close()
+
+
+def elastic_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    """Elasticsearch source — the reference's ElasticSource seam, speaking
+    the real search/scroll HTTP API via stdlib urllib.
+
+    Params: ``url`` = ``http(s)://host:port``, ``index``; optional
+    ``query`` (JSON ES query object; default match_all) and ``page_size``
+    (scroll page, default 1000).  Hit ``_source`` fields map onto the
+    site/user/timestamp/group/item roles via the topic's registered field
+    spec, exactly like TRACKED/JDBC.  Protocol-tested against an
+    in-process mini-ES (tests/test_elastic_piwik_sources.py); the same
+    bytes reach a production cluster.
+    """
+    import urllib.error
+    import urllib.request
+
+    url = (req.param("url") or "").rstrip("/")
+    index = req.param("index")
+    if not url.startswith(("http://", "https://")) or not index:
+        raise SourceError("ELASTIC source needs 'url' (http(s)://host:port) "
+                          "and 'index' parameters")
+    if "/" in index or index.startswith(("_", "-")):
+        raise SourceError(f"invalid index name {index!r}")
+    try:
+        page_size = int(req.param("page_size", "1000"))
+        es_query = json.loads(req.param("query") or '{"match_all": {}}')
+    except ValueError as exc:
+        raise SourceError(f"bad ELASTIC parameter: {exc}") from exc
+
+    def post_json(endpoint: str, obj: dict) -> dict:
+        request = urllib.request.Request(
+            endpoint, data=json.dumps(obj).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(request, timeout=30) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            raise SourceError(f"Elasticsearch request to {endpoint} "
+                              f"failed: {exc}") from exc
+
+    events: List[dict] = []
+    try:
+        page = post_json(f"{url}/{index}/_search?scroll=1m",
+                         {"size": page_size, "query": es_query})
+        while True:
+            hits = page["hits"]["hits"]
+            if not hits:
+                break  # ES's documented scroll termination: an EMPTY page
+            # (a short page is NOT the end — multi-shard scrolls may
+            # legitimately return fewer than `size` hits mid-scroll)
+            events.extend(h["_source"] for h in hits)
+            scroll_id = page.get("_scroll_id")
+            if scroll_id is None:
+                break
+            page = post_json(f"{url}/_search/scroll",
+                             {"scroll": "1m", "scroll_id": scroll_id})
+    except (KeyError, TypeError) as exc:
+        raise SourceError(
+            f"malformed Elasticsearch response (missing {exc})") from exc
     if not events:
-        raise SourceError(f"JDBC query returned no rows: {query!r}")
+        raise SourceError(f"Elasticsearch query matched no documents in "
+                          f"index {index!r}")
     fm = field_map(store, req.param("topic", "item"))
-    return events_to_db(events, fm, origin="JDBC row")
+    return events_to_db(events, fm, origin="Elasticsearch hit")
+
+
+def piwik_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
+    """Piwik analytics source — the reference's PiwikSource seam.
+
+    Reads the ecommerce item log (``piwik_log_conversion_item``: one row
+    per purchased item) the way the reference mines Piwik commerce data:
+    site = idsite, user = idvisitor, timestamp = server_time, itemset
+    group = idorder, item = idaction_sku.  Params: ``db``/``url`` =
+    sqlite path of the (exported) Piwik database, optional ``idsite``
+    filter.  server_time may be a DATETIME string or an epoch integer.
+    """
+    url = req.param("url")
+    path = req.param("db")
+    if url:
+        if not url.startswith("sqlite:///"):
+            raise SourceError(
+                f"PIWIK url {url!r} unsupported: this build reads a "
+                f"sqlite:///path export (no network egress for MySQL)")
+        path = url[len("sqlite:///"):]
+    if not path:
+        raise SourceError("PIWIK source needs a 'db' (sqlite file path) "
+                          "or 'url' (sqlite:///path) parameter")
+    idsite = req.param("idsite")
+    # COALESCE: DATETIME strings go through strftime('%s', ...); already-
+    # integer epochs fall through the CAST
+    query = (
+        "SELECT idsite AS site, idvisitor AS user, "
+        "COALESCE(CAST(strftime('%s', server_time) AS INTEGER), "
+        "CAST(server_time AS INTEGER)) AS timestamp, "
+        'idorder AS "group", idaction_sku AS item '
+        "FROM piwik_log_conversion_item")
+    params: tuple = ()
+    if idsite is not None:
+        query += " WHERE idsite = ?"
+        try:
+            params = (int(idsite),)
+        except ValueError as exc:
+            raise SourceError(f"bad idsite {idsite!r}: {exc}") from exc
+    events = _sqlite_events(path, query, params)
+    if not events:
+        raise SourceError("no Piwik conversion items"
+                          + (f" for idsite {idsite}" if idsite else ""))
+    # roles are fixed by the Piwik schema (aliased above) — no field spec
+    return events_to_db(events, {r: r for r in ROLES}, origin="Piwik row")
 
 
 def synth_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
@@ -192,26 +316,14 @@ def synth_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     return gen(scale=scale)
 
 
-def _stub(name: str, needs: str) -> Callable[[ServiceRequest, ResultStore], SequenceDB]:
-    def raise_stub(req: ServiceRequest, store: ResultStore) -> SequenceDB:
-        raise SourceError(
-            f"{name} source is an interface stub in this build: {needs}. "
-            f"Use FILE/INLINE/TRACKED/SYNTH, or register a client via "
-            f"sources.register()."
-        )
-
-    return raise_stub
-
-
 SOURCES: Dict[str, Callable[[ServiceRequest, ResultStore], SequenceDB]] = {
     "FILE": file_source,
     "INLINE": inline_source,
     "TRACKED": tracked_source,
     "SYNTH": synth_source,
-    # reference parity: ElasticSource / JdbcSource / PiwikSource seams
-    "ELASTIC": _stub("ELASTIC", "requires an Elasticsearch endpoint"),
+    "ELASTIC": elastic_source,
     "JDBC": jdbc_source,
-    "PIWIK": _stub("PIWIK", "requires a Piwik analytics database"),
+    "PIWIK": piwik_source,
 }
 
 

@@ -1,0 +1,211 @@
+"""ELASTIC and PIWIK sources — the reference's last two source seams,
+exercised for real: the Elasticsearch client speaks the actual
+search/scroll HTTP API against an in-process mini-ES (the bytes a
+production cluster would receive), and the Piwik source reads the
+ecommerce item log schema from a sqlite export."""
+
+import json
+import sqlite3
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.sources import (
+    SourceError, elastic_source, piwik_source)
+from spark_fsm_tpu.service.store import ResultStore
+
+
+# ------------------------------------------------------------- mini ES
+
+class MiniES(BaseHTTPRequestHandler):
+    """Two-page scroll over a class-level document list."""
+
+    docs: list = []
+    page_size_seen: list = []
+    scrolls: dict = {}
+    short_pages: bool = False
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):  # noqa: N802
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length") or 0)) or b"{}")
+        if self.path.startswith("/_search/scroll"):
+            sid = body["scroll_id"]
+            offset = MiniES.scrolls.get(sid)
+            if offset is None:
+                self._send(404, {"error": "no such scroll"})
+                return
+            size = MiniES.scrolls["size"]
+            if MiniES.short_pages:  # multi-shard behavior: short non-final
+                size = 1            # pages mid-scroll
+            hits = MiniES.docs[offset:offset + size]
+            MiniES.scrolls[sid] = offset + len(hits)
+            self._send(200, {"_scroll_id": sid,
+                             "hits": {"hits": [{"_source": d} for d in hits]}})
+            return
+        # /{index}/_search?scroll=1m
+        size = int(body.get("size", 10))
+        MiniES.page_size_seen.append(size)
+        MiniES.scrolls = {"s1": size, "size": size}
+        hits = MiniES.docs[:size]
+        MiniES.scrolls["s1"] = len(hits)
+        self._send(200, {"_scroll_id": "s1",
+                         "hits": {"hits": [{"_source": d} for d in hits]}})
+
+    def _send(self, code, obj):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+@pytest.fixture()
+def mini_es():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), MiniES)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+    server.server_close()
+
+
+def test_elastic_scroll_and_field_spec(mini_es):
+    # 5 docs, page size 2 -> initial search + 2 scroll pages
+    MiniES.docs = [
+        {"shop": "s", "visitor": "u1", "ts": 1, "basket": 1, "sku": 3},
+        {"shop": "s", "visitor": "u1", "ts": 2, "basket": 2, "sku": 5},
+        {"shop": "s", "visitor": "u2", "ts": 1, "basket": 3, "sku": 3},
+        {"shop": "s", "visitor": "u2", "ts": 2, "basket": 4, "sku": 5},
+        {"shop": "s", "visitor": "u2", "ts": 2, "basket": 4, "sku": 7},
+    ]
+    MiniES.page_size_seen = []
+    store = ResultStore()
+    store.add_fields("clicks", json.dumps({
+        "site": "shop", "user": "visitor", "timestamp": "ts",
+        "group": "basket", "item": "sku"}))
+    db = elastic_source(ServiceRequest("fsm", "train", {
+        "url": mini_es, "index": "events", "topic": "clicks",
+        "page_size": "2"}), store)
+    assert MiniES.page_size_seen == [2]
+    assert db == [((3,), (5,)), ((3,), (5, 7))]
+
+
+def test_elastic_short_scroll_pages_not_truncated(mini_es):
+    """A scroll page with fewer than page_size hits is NOT the end of the
+    scroll (multi-shard clusters do this); only an empty page is."""
+    MiniES.docs = [
+        {"site": "s", "user": "u", "timestamp": t, "group": t, "item": t + 1}
+        for t in range(5)
+    ]
+    MiniES.short_pages = True
+    try:
+        db = elastic_source(ServiceRequest("fsm", "train", {
+            "url": mini_es, "index": "events", "page_size": "2"}),
+            ResultStore())
+    finally:
+        MiniES.short_pages = False
+    # all 5 docs survive: one 2-hit search page + three 1-hit scroll pages
+    assert db == [((1,), (2,), (3,), (4,), (5,))]
+
+
+def test_elastic_errors(mini_es):
+    store = ResultStore()
+    with pytest.raises(SourceError, match="needs 'url'"):
+        elastic_source(ServiceRequest("fsm", "train", {"index": "x"}), store)
+    with pytest.raises(SourceError, match="invalid index"):
+        elastic_source(ServiceRequest("fsm", "train", {
+            "url": mini_es, "index": "a/b"}), store)
+    MiniES.docs = []
+    with pytest.raises(SourceError, match="matched no documents"):
+        elastic_source(ServiceRequest("fsm", "train", {
+            "url": mini_es, "index": "events"}), store)
+    with pytest.raises(SourceError, match="failed"):
+        elastic_source(ServiceRequest("fsm", "train", {
+            "url": "http://127.0.0.1:1", "index": "events"}), store)
+
+
+# -------------------------------------------------------------- piwik
+
+@pytest.fixture()
+def piwik_db(tmp_path):
+    path = str(tmp_path / "piwik.sqlite")
+    conn = sqlite3.connect(path)
+    conn.execute("""CREATE TABLE piwik_log_conversion_item (
+        idsite INTEGER, idvisitor TEXT, server_time TEXT,
+        idorder INTEGER, idaction_sku INTEGER)""")
+    rows = [
+        # visitor A: order 1 {3}, later order 2 {5}
+        (1, "A", "2024-01-01 10:00:00", 1, 3),
+        (1, "A", "2024-01-02 10:00:00", 2, 5),
+        # visitor B: one order with two items
+        (1, "B", "2024-01-01 11:00:00", 3, 3),
+        (1, "B", "2024-01-01 11:00:00", 3, 7),
+        # another site, filtered out by idsite=1
+        (2, "C", "2024-01-01 12:00:00", 4, 9),
+    ]
+    conn.executemany(
+        "INSERT INTO piwik_log_conversion_item VALUES (?,?,?,?,?)", rows)
+    conn.commit()
+    conn.close()
+    return path
+
+
+def test_piwik_purchase_sequences(piwik_db):
+    store = ResultStore()
+    db = piwik_source(ServiceRequest("fsm", "train", {
+        "db": piwik_db, "idsite": "1"}), store)
+    assert db == [((3,), (5,)), ((3, 7),)]
+    # no filter: site 2's visitor appears too
+    db_all = piwik_source(ServiceRequest("fsm", "train",
+                                         {"db": piwik_db}), store)
+    assert ((9,),) in db_all and len(db_all) == 3
+
+
+def test_piwik_epoch_timestamps(tmp_path):
+    path = str(tmp_path / "p2.sqlite")
+    conn = sqlite3.connect(path)
+    conn.execute("""CREATE TABLE piwik_log_conversion_item (
+        idsite INTEGER, idvisitor TEXT, server_time INTEGER,
+        idorder INTEGER, idaction_sku INTEGER)""")
+    conn.executemany(
+        "INSERT INTO piwik_log_conversion_item VALUES (?,?,?,?,?)",
+        [(1, "A", 200, 2, 5), (1, "A", 100, 1, 3)])
+    conn.commit()
+    conn.close()
+    db = piwik_source(ServiceRequest("fsm", "train", {"db": path}),
+                      ResultStore())
+    assert db == [((3,), (5,))]  # epoch ints order the itemsets
+
+
+def test_piwik_varchar_order_ids(tmp_path):
+    """Real Piwik/Matomo idorder is a varchar (site-defined order ids);
+    non-numeric ids must group itemsets, not crash."""
+    path = str(tmp_path / "p3.sqlite")
+    conn = sqlite3.connect(path)
+    conn.execute("""CREATE TABLE piwik_log_conversion_item (
+        idsite INTEGER, idvisitor TEXT, server_time TEXT,
+        idorder TEXT, idaction_sku INTEGER)""")
+    conn.executemany(
+        "INSERT INTO piwik_log_conversion_item VALUES (?,?,?,?,?)",
+        [(1, "A", "2024-01-01 10:00:00", "ORD-1001", 3),
+         (1, "A", "2024-01-01 10:00:00", "ORD-1001", 7),
+         (1, "A", "2024-01-02 10:00:00", "ORD-1002", 5)])
+    conn.commit()
+    conn.close()
+    db = piwik_source(ServiceRequest("fsm", "train", {"db": path}),
+                      ResultStore())
+    assert db == [((3, 7), (5,))]
+
+
+def test_piwik_errors(tmp_path):
+    with pytest.raises(SourceError, match="needs a 'db'"):
+        piwik_source(ServiceRequest("fsm", "train", {}), ResultStore())
+    with pytest.raises(SourceError, match="cannot open"):
+        piwik_source(ServiceRequest("fsm", "train",
+                                    {"db": str(tmp_path / "nope.sqlite")}),
+                     ResultStore())

@@ -225,7 +225,7 @@ def test_failure_supervision(server):
     else:
         raise AssertionError("failure status never surfaced")
 
-    # stubbed source seams surface a clear error
+    # a source missing its required params surfaces a clear error
     resp = _post(server, "/train", algorithm="SPADE", source="ELASTIC",
                  support="0.5")
     uid = resp["data"]["uid"]
@@ -233,11 +233,11 @@ def test_failure_supervision(server):
     while time.time() < deadline:
         st = _post(server, f"/status/{uid}")
         if st["status"] == "failure":
-            assert "stub" in st["data"]["error"]
+            assert "ELASTIC source needs" in st["data"]["error"]
             break
         time.sleep(0.05)
     else:
-        raise AssertionError("stub source failure never surfaced")
+        raise AssertionError("source-param failure never surfaced")
 
 
 def test_unknown_uid_and_pending(server):
