@@ -414,6 +414,9 @@ class Streamer:
                     texts = json.loads(raw)
                 except ValueError:
                     texts = []
+                if not (isinstance(texts, list)
+                        and all(isinstance(t, str) for t in texts)):
+                    texts = []  # corrupt old value: start a fresh window
                 self.store.delete(win_key)
                 for t in texts:
                     self.store.rpush(win_key, t)

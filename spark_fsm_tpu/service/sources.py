@@ -88,7 +88,9 @@ def events_to_db(events: List[dict], fm: Dict[str, str],
     first timestamp — the reference's field-spec semantics (SURVEY.md
     sec 2 "Registrar / field spec").
     """
-    sessions: Dict[Tuple[str, str], Dict[int, List[Tuple[int, int]]]] = {}
+    # group key = (tag, id): tag 0 for numeric ids, 1 for string ids, so
+    # mixed id types keep one deterministic sort order
+    sessions: Dict[Tuple[str, str], Dict[tuple, List[Tuple[int, int]]]] = {}
     for ev in events:
         key = (str(ev.get(fm["site"], "")), str(ev.get(fm["user"], "")))
         ts_raw = ev.get(fm["timestamp"])
@@ -135,6 +137,22 @@ def tracked_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
                         origin=f"tracked topic {topic!r}")
 
 
+def _sqlite_path(req: ServiceRequest, source_name: str) -> str:
+    """Resolve the ``db``/``url`` params both sqlite-backed sources share."""
+    url = req.param("url")
+    path = req.param("db")
+    if url:
+        if not url.startswith("sqlite:///"):
+            raise SourceError(
+                f"{source_name} url {url!r} unsupported: this build speaks "
+                f"sqlite:///path (no network egress for remote databases)")
+        path = url[len("sqlite:///"):]
+    if not path:
+        raise SourceError(f"{source_name} source needs a 'db' (sqlite file "
+                          f"path) or 'url' (sqlite:///path) parameter")
+    return path
+
+
 def jdbc_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     """SQL database source — the reference's JdbcSource seam, implemented
     on stdlib sqlite3 (the sandbox's JDBC-reachable database).
@@ -144,17 +162,7 @@ def jdbc_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     ``table`` (SELECT * FROM table).  Column-name -> role mapping comes
     from the topic's registered field spec, exactly like TRACKED.
     """
-    url = req.param("url")
-    path = req.param("db")
-    if url:
-        if not url.startswith("sqlite:///"):
-            raise SourceError(
-                f"JDBC url {url!r} unsupported: this build speaks "
-                f"sqlite:///path (no network egress for remote databases)")
-        path = url[len("sqlite:///"):]
-    if not path:
-        raise SourceError("JDBC source needs a 'db' (sqlite file path) "
-                          "or 'url' (sqlite:///path) parameter")
+    path = _sqlite_path(req, "JDBC")
     query = req.param("query")
     table = req.param("table")
     if query is None:
@@ -235,6 +243,7 @@ def elastic_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
                               f"failed: {exc}") from exc
 
     events: List[dict] = []
+    scroll_id = None
     try:
         page = post_json(f"{url}/{index}/_search?scroll=1m",
                          {"size": page_size, "query": es_query})
@@ -253,6 +262,18 @@ def elastic_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     except (KeyError, TypeError) as exc:
         raise SourceError(
             f"malformed Elasticsearch response (missing {exc})") from exc
+    finally:
+        if scroll_id is not None:
+            # free the scroll context (clusters cap open scrolls at ~500);
+            # best-effort — the 1m keepalive reaps it anyway
+            request = urllib.request.Request(
+                f"{url}/_search/scroll", method="DELETE",
+                data=json.dumps({"scroll_id": scroll_id}).encode("utf-8"),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(request, timeout=10).close()
+            except (urllib.error.URLError, OSError):
+                pass
     if not events:
         raise SourceError(f"Elasticsearch query matched no documents in "
                           f"index {index!r}")
@@ -270,24 +291,18 @@ def piwik_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     sqlite path of the (exported) Piwik database, optional ``idsite``
     filter.  server_time may be a DATETIME string or an epoch integer.
     """
-    url = req.param("url")
-    path = req.param("db")
-    if url:
-        if not url.startswith("sqlite:///"):
-            raise SourceError(
-                f"PIWIK url {url!r} unsupported: this build reads a "
-                f"sqlite:///path export (no network egress for MySQL)")
-        path = url[len("sqlite:///"):]
-    if not path:
-        raise SourceError("PIWIK source needs a 'db' (sqlite file path) "
-                          "or 'url' (sqlite:///path) parameter")
+    path = _sqlite_path(req, "PIWIK")
     idsite = req.param("idsite")
-    # COALESCE: DATETIME strings go through strftime('%s', ...); already-
-    # integer epochs fall through the CAST
+    # DATETIME strings go through strftime('%s', ...); numeric values are
+    # epochs and pass through directly.  The typeof() dispatch matters:
+    # strftime on an INTEGER would interpret it as a Julian day number
+    # (strftime('%s', 2000000) = -38066760000, not NULL), so a COALESCE
+    # fallback would silently mis-order mixed-type columns.
     query = (
         "SELECT idsite AS site, idvisitor AS user, "
-        "COALESCE(CAST(strftime('%s', server_time) AS INTEGER), "
-        "CAST(server_time AS INTEGER)) AS timestamp, "
+        "CASE WHEN typeof(server_time) = 'text' "
+        "THEN CAST(strftime('%s', server_time) AS INTEGER) "
+        "ELSE CAST(server_time AS INTEGER) END AS timestamp, "
         'idorder AS "group", idaction_sku AS item '
         "FROM piwik_log_conversion_item")
     params: tuple = ()

@@ -182,6 +182,27 @@ def test_piwik_epoch_timestamps(tmp_path):
     assert db == [((3,), (5,))]  # epoch ints order the itemsets
 
 
+def test_piwik_mixed_timestamp_types(tmp_path):
+    """Small integers must stay epochs: sqlite's strftime('%s', N) would
+    read them as Julian day numbers (giving huge NEGATIVE epochs), so a
+    column mixing ints and DATETIME strings must dispatch on typeof."""
+    path = str(tmp_path / "p4.sqlite")
+    conn = sqlite3.connect(path)
+    conn.execute("""CREATE TABLE piwik_log_conversion_item (
+        idsite INTEGER, idvisitor TEXT, server_time,
+        idorder INTEGER, idaction_sku INTEGER)""")
+    conn.executemany(
+        "INSERT INTO piwik_log_conversion_item VALUES (?,?,?,?,?)",
+        [(1, "A", 2000000, 2, 5),                      # small int epoch
+         (1, "A", "1970-01-01 00:00:01", 1, 3)])       # epoch 1, earlier
+    conn.commit()
+    conn.close()
+    db = piwik_source(ServiceRequest("fsm", "train", {"db": path}),
+                      ResultStore())
+    assert db == [((3,), (5,))]  # int row did NOT collapse to a huge
+    #                              negative epoch before the text row
+
+
 def test_piwik_varchar_order_ids(tmp_path):
     """Real Piwik/Matomo idorder is a varchar (site-defined order ids);
     non-numeric ids must group itemsets, not crash."""
