@@ -10,6 +10,58 @@ from __future__ import annotations
 from typing import Callable, List, Optional
 
 
+def encode_frontier(fingerprint: dict, stack, results,
+                    results_from: int = 0) -> dict:
+    """JSON-able DFS snapshot shared by both SPADE engines (and persisted
+    verbatim by the service's StoreCheckpoint): unexplored nodes by their
+    extension paths — device state is rebuilt by each engine's
+    recompute-on-miss machinery on resume — plus the results emitted since
+    ``results_from`` (results are append-only during a mine, so periodic
+    checkpoints serialize only the delta)."""
+    return {
+        "version": 1,
+        "fingerprint": fingerprint,
+        "stack": [{"steps": [[int(i), int(s)] for i, s in n.steps],
+                   "s": [int(x) for x in n.s_list],
+                   "i": [int(x) for x in n.i_list]} for n in stack],
+        "results_done": int(results_from),
+        "results": [[[list(map(int, s)) for s in pat], int(sup)]
+                    for pat, sup in results[results_from:]],
+    }
+
+
+def decode_frontier(resume: dict, fingerprint: dict, node_cls):
+    """Inverse of encode_frontier; refuses a snapshot whose fingerprint
+    does not match this engine's (node steps hold dense item indices that
+    are only meaningful for the exact same projection + parameters)."""
+    fp = resume.get("fingerprint")
+    if fp != fingerprint:
+        raise ValueError(
+            "frontier checkpoint does not match this engine's (vdb, "
+            f"parameters); checkpointed {fp}, engine {fingerprint}")
+    results = [
+        (tuple(tuple(int(i) for i in s) for s in pat), int(sup))
+        for pat, sup in resume["results"]]
+    nodes = [
+        node_cls(tuple((int(i), bool(s)) for i, s in n["steps"]),
+                 None,  # state rebuilt on demand (recompute-on-miss)
+                 [int(x) for x in n["s"]], [int(x) for x in n["i"]])
+        for n in resume["stack"]]
+    return results, nodes
+
+
+def load_checkpoint(checkpoint, fingerprint: dict):
+    """Wrapper-side plumbing: ``(resume, save_cb, every_s)`` from an
+    optional checkpoint object; a stale/mismatched snapshot is ignored
+    (the mine restarts fresh) rather than refused."""
+    if checkpoint is None:
+        return None, None, 30.0
+    resume = checkpoint.load()
+    if resume is not None and resume.get("fingerprint") != fingerprint:
+        resume = None
+    return resume, checkpoint.save, getattr(checkpoint, "every_s", 30.0)
+
+
 def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
                         mesh=None, put=None):
     """Scatter-build a ``[n_rows, n_seq, n_words]`` uint32 bitmap store IN

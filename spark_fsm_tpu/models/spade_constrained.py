@@ -25,6 +25,7 @@ typical clickstream data.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import List, Optional, Tuple
 
@@ -36,7 +37,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, next_pow2, scatter_build_store)
+    SlotPool, decode_frontier, encode_frontier, load_checkpoint, next_pow2,
+    scatter_build_store)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
@@ -287,17 +289,46 @@ class ConstrainedSpadeTPU:
                 pat[-1].append(int(ids[it]))
         return tuple(tuple(s) for s in pat)
 
-    def mine(self) -> List[PatternResult]:
+    def frontier_fingerprint(self) -> dict:
+        """Identity a frontier checkpoint binds to — (vdb, minsup) plus the
+        constraint set, since maxgap/maxwindow/length change enumeration."""
+        ids = self.vdb.item_ids
+        return {
+            "minsup": self.minsup,
+            "maxgap": self.maxgap,
+            "maxwindow": self.maxwindow,
+            "n_items": self.n_items,
+            "n_sequences": self.vdb.n_sequences,
+            "max_itemsets": self.max_pattern_itemsets,
+            "item_ids_head": [int(i) for i in ids[:8]],
+            "item_ids_sum": int(ids.astype(np.int64).sum()),
+        }
+
+    def frontier_state(self, stack: List[_Node],
+                       results: List[PatternResult],
+                       results_from: int = 0) -> dict:
+        """Same snapshot contract as SpadeTPU (see _common.encode_frontier)."""
+        return encode_frontier(self.frontier_fingerprint(), stack, results,
+                               results_from)
+
+    def mine(self, *, resume: Optional[dict] = None,
+             checkpoint_cb=None,
+             checkpoint_every_s: float = 30.0) -> List[PatternResult]:
         minsup = self.minsup
         results: List[PatternResult] = []
         root_items = [i for i in range(self.n_items)
                       if int(self.vdb.item_supports[i]) >= minsup]
         stack: List[_Node] = []
-        for i in reversed(root_items):
-            results.append((self._pattern_of(((i, True),)),
-                            int(self.vdb.item_supports[i])))
-            stack.append(_Node(((i, True),), None, root_items,
-                               [j for j in root_items if j > i]))
+        if resume is not None:
+            results, stack = decode_frontier(
+                resume, self.frontier_fingerprint(), _Node)
+            self.stats["resumed_nodes"] = len(stack)
+        else:
+            for i in reversed(root_items):
+                results.append((self._pattern_of(((i, True),)),
+                                int(self.vdb.item_supports[i])))
+                stack.append(_Node(((i, True),), None, root_items,
+                                   [j for j in root_items if j > i]))
 
         # Same software-pipelined dispatch/resolve loop as the unconstrained
         # engine (see models/spade_tpu.py): one async support readback per
@@ -387,10 +418,21 @@ class ConstrainedSpadeTPU:
                 if len(node.steps) > 1:
                     self._free_slot(node.slot)
 
+        ckpt_done = len(results) if resume is not None else 0
+        last_ckpt = time.monotonic()
         while stack or inflight:
             while stack and len(inflight) < self.pipeline_depth:
                 inflight.append(dispatch())
             resolve(inflight.popleft())
+            if (checkpoint_cb is not None
+                    and time.monotonic() - last_ckpt >= checkpoint_every_s):
+                while inflight:  # drain for a consistent frontier
+                    resolve(inflight.popleft())
+                checkpoint_cb(self.frontier_state(stack, results,
+                                                  results_from=ckpt_done))
+                ckpt_done = len(results)
+                self.stats["checkpoints"] = self.stats.get("checkpoints", 0) + 1
+                last_ckpt = time.monotonic()
 
         self.stats["patterns"] = len(results)
         return sort_patterns(results)
@@ -405,15 +447,22 @@ def mine_cspade_tpu(
     mesh: Optional[Mesh] = None,
     max_pattern_itemsets: Optional[int] = None,
     stats_out: Optional[dict] = None,
+    checkpoint=None,
     **kwargs,
 ) -> List[PatternResult]:
+    """DB -> vertical build -> constrained mine; ``checkpoint`` follows the
+    same load/save/every_s contract as mine_spade_tpu (stale snapshots are
+    ignored, the mine restarts fresh)."""
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
         return []
     eng = ConstrainedSpadeTPU(vdb, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
                               mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
                               **kwargs)
-    results = eng.mine()
+    resume, save_cb, every_s = load_checkpoint(
+        checkpoint, eng.frontier_fingerprint())
+    results = eng.mine(resume=resume, checkpoint_cb=save_cb,
+                       checkpoint_every_s=every_s)
     if stats_out is not None:
         stats_out.update(eng.stats)
     return results

@@ -48,7 +48,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, next_pow2, scatter_build_store)
+    SlotPool, decode_frontier, encode_frontier, load_checkpoint, next_pow2,
+    scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
@@ -528,27 +529,11 @@ class SpadeTPU:
     def frontier_state(self, stack: List[_Node],
                        results: List[PatternResult],
                        results_from: int = 0) -> dict:
-        """JSON-able snapshot of a paused DFS: unexplored nodes (by their
-        extension paths — bitmaps are rebuilt by the recompute machinery on
-        resume) plus the results emitted since ``results_from``.
-
-        ``results`` entries are append-only during a mine, so periodic
-        checkpoints serialize only the DELTA (``results_from`` = count
-        already persisted) and the checkpoint sink appends — per-snapshot
-        cost stays O(frontier + new results), not O(all results), on the
-        long mines this feature exists for.  A ``resume`` dict passed back
-        to :meth:`mine` must carry the MERGED results list.
-        """
-        return {
-            "version": 1,
-            "fingerprint": self.frontier_fingerprint(),
-            "stack": [{"steps": [[int(i), int(s)] for i, s in n.steps],
-                       "s": [int(x) for x in n.s_list],
-                       "i": [int(x) for x in n.i_list]} for n in stack],
-            "results_done": int(results_from),
-            "results": [[[list(map(int, s)) for s in pat], int(sup)]
-                        for pat, sup in results[results_from:]],
-        }
+        """Snapshot of a paused DFS (see _common.encode_frontier).  A
+        ``resume`` dict passed back to :meth:`mine` must carry the MERGED
+        results list (StoreCheckpoint.load reassembles the deltas)."""
+        return encode_frontier(self.frontier_fingerprint(), stack, results,
+                               results_from)
 
     def mine(self, *, resume: Optional[dict] = None,
              checkpoint_cb=None,
@@ -567,19 +552,8 @@ class SpadeTPU:
         stack: List[_Node] = []
         results: List[PatternResult]
         if resume is not None:
-            fp = resume.get("fingerprint")
-            if fp != self.frontier_fingerprint():
-                raise ValueError(
-                    "frontier checkpoint does not match this (vdb, minsup); "
-                    f"checkpointed {fp}, engine {self.frontier_fingerprint()}")
-            results = [
-                (tuple(tuple(int(i) for i in s) for s in pat), int(sup))
-                for pat, sup in resume["results"]]
-            for n in resume["stack"]:
-                stack.append(_Node(
-                    tuple((int(i), bool(s)) for i, s in n["steps"]),
-                    None,  # bitmaps rebuilt on demand (recompute-on-miss)
-                    [int(x) for x in n["s"]], [int(x) for x in n["i"]]))
+            results, stack = decode_frontier(
+                resume, self.frontier_fingerprint(), _Node)
             self.stats["resumed_nodes"] = len(stack)
         else:
             results = []
@@ -640,14 +614,10 @@ def mine_spade_tpu(
         return []
     eng = SpadeTPU(vdb, minsup_abs, mesh=mesh,
                    max_pattern_itemsets=max_pattern_itemsets, **kwargs)
-    resume = checkpoint.load() if checkpoint is not None else None
-    if (resume is not None
-            and resume.get("fingerprint") != eng.frontier_fingerprint()):
-        resume = None  # dataset/minsup changed since the snapshot
-    results = eng.mine(
-        resume=resume,
-        checkpoint_cb=checkpoint.save if checkpoint is not None else None,
-        checkpoint_every_s=getattr(checkpoint, "every_s", 30.0))
+    resume, save_cb, every_s = load_checkpoint(
+        checkpoint, eng.frontier_fingerprint())
+    results = eng.mine(resume=resume, checkpoint_cb=save_cb,
+                       checkpoint_every_s=every_s)
     if stats_out is not None:
         stats_out.update(eng.stats)
     return results

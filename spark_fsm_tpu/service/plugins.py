@@ -35,7 +35,7 @@ class AlgorithmPlugin:
     """``extract(req, db, stats=None, checkpoint=None)``; a provided
     ``stats`` dict receives the engine's observability counters (SURVEY.md
     sec 5 metrics row); ``checkpoint`` (load/save/every_s) enables frontier
-    resume where the engine supports it (SPADE_TPU unconstrained)."""
+    resume where the engine supports it (SPADE_TPU, constrained or not)."""
 
     name: str
     kind: str  # "patterns" | "rules"
@@ -102,9 +102,9 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
     if maxgap is None and maxwindow is None:
         return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
                               checkpoint=checkpoint, **kwargs)
-    _checkpoint_unsupported(checkpoint, "SPADE_TPU[constrained]", stats)
     return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow,
-                           mesh=mesh, stats_out=stats, **kwargs)
+                           mesh=mesh, stats_out=stats, checkpoint=checkpoint,
+                           **kwargs)
 
 
 def _tsr_params(req: ServiceRequest):

@@ -248,14 +248,16 @@ def elastic_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
         page = post_json(f"{url}/{index}/_search?scroll=1m",
                          {"size": page_size, "query": es_query})
         while True:
+            # capture the scroll id FIRST: even a zero-hit search opened a
+            # server-side scroll context that the finally must free
+            scroll_id = page.get("_scroll_id", scroll_id)
             hits = page["hits"]["hits"]
             if not hits:
                 break  # ES's documented scroll termination: an EMPTY page
             # (a short page is NOT the end — multi-shard scrolls may
             # legitimately return fewer than `size` hits mid-scroll)
             events.extend(h["_source"] for h in hits)
-            scroll_id = page.get("_scroll_id")
-            if scroll_id is None:
+            if page.get("_scroll_id") is None:
                 break
             page = post_json(f"{url}/_search/scroll",
                              {"scroll": "1m", "scroll_id": scroll_id})
@@ -301,7 +303,10 @@ def piwik_source(req: ServiceRequest, store: ResultStore) -> SequenceDB:
     query = (
         "SELECT idsite AS site, idvisitor AS user, "
         "CASE WHEN typeof(server_time) = 'text' "
-        "THEN CAST(strftime('%s', server_time) AS INTEGER) "
+        # text: DATETIME via strftime; COALESCE keeps TEXT-affinity numeric
+        # epochs (e.g. a CSV import) instead of collapsing them to NULL
+        "THEN COALESCE(CAST(strftime('%s', server_time) AS INTEGER), "
+        "CAST(server_time AS INTEGER)) "
         "ELSE CAST(server_time AS INTEGER) END AS timestamp, "
         'idorder AS "group", idaction_sku AS item '
         "FROM piwik_log_conversion_item")

@@ -239,3 +239,55 @@ def test_service_checkpoint_plumbing():
         assert store.patterns(uid) is not None
     finally:
         master.shutdown()
+
+
+def test_constrained_crash_resume_parity():
+    """Same crash/resume contract for the maxgap/maxwindow engine."""
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    from spark_fsm_tpu.models.spade_constrained import ConstrainedSpadeTPU
+
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+
+    class Crash(Exception):
+        pass
+
+    saved, merged = [], []
+
+    def cb(state):
+        assert state["results_done"] == len(merged)
+        merged.extend(state["results"])
+        saved.append(state)
+        if len(saved) == 2:
+            raise Crash
+
+    eng = ConstrainedSpadeTPU(vdb, minsup, maxgap=2, maxwindow=6,
+                              node_batch=4, pipeline_depth=2,
+                              pool_bytes=32 << 20)
+    with pytest.raises(Crash):
+        eng.mine(checkpoint_cb=cb, checkpoint_every_s=0.0)
+    state = json.loads(json.dumps({**saved[-1], "results": list(merged)}))
+    assert state["stack"], "crash happened after the frontier emptied"
+
+    eng2 = ConstrainedSpadeTPU(build_vertical(db, min_item_support=minsup),
+                               minsup, maxgap=2, maxwindow=6, node_batch=16,
+                               pool_bytes=32 << 20)
+    got = eng2.mine(resume=state)
+    assert eng2.stats["resumed_nodes"] == len(state["stack"])
+    want = mine_cspade(db, minsup, maxgap=2, maxwindow=6)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_constrained_resume_rejects_changed_constraints():
+    from spark_fsm_tpu.models.spade_constrained import ConstrainedSpadeTPU
+
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    eng = ConstrainedSpadeTPU(build_vertical(db, min_item_support=minsup),
+                              minsup, maxgap=2)
+    state = eng.frontier_state([], [])
+    other = ConstrainedSpadeTPU(build_vertical(db, min_item_support=minsup),
+                                minsup, maxgap=3)
+    with pytest.raises(ValueError, match="fingerprint|does not match"):
+        other.mine(resume=state)

@@ -194,13 +194,16 @@ def test_piwik_mixed_timestamp_types(tmp_path):
     conn.executemany(
         "INSERT INTO piwik_log_conversion_item VALUES (?,?,?,?,?)",
         [(1, "A", 2000000, 2, 5),                      # small int epoch
-         (1, "A", "1970-01-01 00:00:01", 1, 3)])       # epoch 1, earlier
+         (1, "A", "1970-01-01 00:00:01", 1, 3),        # epoch 1, earlier
+         # TEXT-affinity numeric epoch (CSV imports store everything as
+         # text): must parse as 3000000, not NULL->0
+         (1, "A", "3000000", 3, 9)])
     conn.commit()
     conn.close()
     db = piwik_source(ServiceRequest("fsm", "train", {"db": path}),
                       ResultStore())
-    assert db == [((3,), (5,))]  # int row did NOT collapse to a huge
-    #                              negative epoch before the text row
+    assert db == [((3,), (5,), (9,))]  # int row did NOT collapse to a huge
+    #                  negative epoch, text-numeric row ordered last
 
 
 def test_piwik_varchar_order_ids(tmp_path):
