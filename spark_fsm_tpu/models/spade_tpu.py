@@ -235,10 +235,14 @@ class SpadeTPU:
                 return jax.lax.psum(sup, SEQ_AXIS)
 
             items_spec = P(None, None, SEQ_AXIS) if ikl else st
+            # multi-controller only: pallas_call's out_shape carries no
+            # varying-mesh-axes annotation, which that validator rejects;
+            # single-controller keeps the check (it passes there)
             self._pallas_supports_fn = jax.jit(
                 jax.shard_map(pallas_supports_body, mesh=mesh,
                               in_specs=(st, items_spec, rep, rep),
-                              out_specs=rep)
+                              out_specs=rep,
+                              check_vma=not self._multiproc)
             )
             self._prep_fn = jax.jit(
                 jax.shard_map(prep_body, mesh=mesh,

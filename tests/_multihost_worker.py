@@ -42,9 +42,20 @@ def main() -> None:
     got = eng.mine()
     want = mine_spade(db, minsup)
     ok = patterns_text(got) == patterns_text(want)
-    print(f"MULTIHOST_OK pid={pid} patterns={len(got)} parity={ok}",
-          flush=True)
-    assert ok
+
+    # the Pallas pair-support path must survive multi-controller too
+    # (per-shard kernel launch inside shard_map + psum; interpret mode on
+    # the CPU backend, the same program a real multi-host TPU runs)
+    eng_k = SpadeTPU(vdb, minsup, mesh=mesh, node_batch=16,
+                     pool_bytes=64 << 20, use_pallas=True)
+    assert eng_k.use_pallas and eng_k._multiproc
+    got_k = eng_k.mine()
+    ok_k = patterns_text(got_k) == patterns_text(want)
+    assert "pallas_fallback" not in eng_k.stats, eng_k.stats
+
+    print(f"MULTIHOST_OK pid={pid} patterns={len(got)} parity={ok} "
+          f"pallas_parity={ok_k}", flush=True)
+    assert ok and ok_k
     shutdown_distributed()
 
 

@@ -48,3 +48,4 @@ def test_two_process_mesh_parity():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out}"
         assert "MULTIHOST_OK" in out and "parity=True" in out, out
+        assert "pallas_parity=True" in out, out
