@@ -25,6 +25,7 @@ typical clickstream data.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from collections import deque
 from typing import List, Optional, Tuple
@@ -40,6 +41,7 @@ from spark_fsm_tpu.models._common import (
     SlotPool, decode_frontier, encode_frontier, load_checkpoint, next_pow2,
     scatter_build_store)
 from spark_fsm_tpu.ops import maxstart_jax as MS
+from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
@@ -75,6 +77,9 @@ class ConstrainedSpadeTPU:
         self.maxgap = maxgap
         self.maxwindow = maxwindow
         self.mesh = mesh
+        # Multi-host mesh: host-side inputs must become global replicated
+        # arrays (see parallel/multihost.py)
+        self._put = functools.partial(MH.host_to_device, mesh)
         self.chunk = int(chunk)
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.recompute_chunk = int(recompute_chunk)
@@ -106,7 +111,7 @@ class ConstrainedSpadeTPU:
         # the (large, all-zero) pool ever exists in host memory or crosses
         # the link (same plan as the unconstrained engine's store build).
         self.items = scatter_build_store(vdb, n_items, n_seq, n_words,
-                                         mesh=mesh)
+                                         mesh=mesh, put=self._put)
         pool_shape = (pool_slots + 1, n_seq, self.n_pos)
         zeros = lambda: jnp.zeros(pool_shape, self.dtype)
         if mesh is None:
@@ -228,8 +233,8 @@ class ConstrainedSpadeTPU:
                 for row, (it, s) in enumerate(node.steps):
                     items[row, col], iss[row, col], valid[row, col] = it, s, True
             self.pool = self._recompute_fn(
-                self.pool, self.items, jnp.asarray(items), jnp.asarray(iss),
-                jnp.asarray(valid), jnp.asarray(slots))
+                self.pool, self.items, self._put(items), self._put(iss),
+                self._put(valid), self._put(slots))
             self.stats["kernel_launches"] += 1
 
     # ------------------------------------------------------------- kernels
@@ -244,8 +249,8 @@ class ConstrainedSpadeTPU:
                 roots[i] = n.steps[0][0]
             else:
                 slots[i] = n.slot
-        m, pm = self._prep_fn(self.pool, self.items, jnp.asarray(slots),
-                              jnp.asarray(roots), jnp.asarray(is_root))
+        m, pm = self._prep_fn(self.pool, self.items, self._put(slots),
+                              self._put(roots), self._put(is_root))
         self.stats["kernel_launches"] += 1
         return m, pm
 
@@ -258,14 +263,14 @@ class ConstrainedSpadeTPU:
         for lo in range(0, n, c):
             hi = min(lo + c, n)
             pad = c - (hi - lo)
-            r = jnp.asarray(np.pad(ref[lo:hi], (0, pad)).astype(np.int32))
-            it = jnp.asarray(np.pad(item[lo:hi], (0, pad)).astype(np.int32))
-            ss = jnp.asarray(np.pad(iss[lo:hi], (0, pad)).astype(bool))
+            r = self._put(np.pad(ref[lo:hi], (0, pad)).astype(np.int32))
+            it = self._put(np.pad(item[lo:hi], (0, pad)).astype(np.int32))
+            ss = self._put(np.pad(iss[lo:hi], (0, pad)).astype(bool))
             if out_slot is None:
                 outs.append(fn_extra(r, it, ss))
             else:
-                os = jnp.asarray(np.pad(out_slot[lo:hi], (0, pad),
-                                        constant_values=self.scratch).astype(np.int32))
+                os = self._put(np.pad(out_slot[lo:hi], (0, pad),
+                                      constant_values=self.scratch).astype(np.int32))
                 fn_extra(r, it, ss, os)
             self.stats["kernel_launches"] += 1
         if out_slot is not None:

@@ -36,6 +36,7 @@ construction; supports are exact integers from popcounts.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from collections import deque
 from typing import List, Optional, Sequence, Tuple
@@ -52,6 +53,7 @@ from spark_fsm_tpu.models._common import (
     scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
+from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
@@ -102,7 +104,8 @@ class SpadeTPU:
         self.mesh = mesh
         # Multi-host mesh (jax.distributed): host-side inputs must become
         # global replicated arrays; see parallel/multihost.py.
-        self._multiproc = mesh is not None and jax.process_count() > 1
+        self._multiproc = MH.is_multihost(mesh)
+        self._put = functools.partial(MH.host_to_device, mesh)
         self.chunk = int(chunk)
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.recompute_chunk = int(recompute_chunk)
@@ -264,16 +267,6 @@ class SpadeTPU:
             )
 
     # ------------------------------------------------------------ slot mgmt
-
-    def _put(self, x) -> jax.Array:
-        """Host array -> device input.  On a multi-host mesh every process
-        contributes its identical copy as a global replicated array (SPMD
-        host loops keep the copies identical by construction)."""
-        if self._multiproc:
-            from spark_fsm_tpu.parallel.multihost import replicate
-
-            return replicate(self.mesh, x)
-        return jnp.asarray(x)
 
     def _alloc(self) -> Optional[int]:
         return self._pool.alloc()

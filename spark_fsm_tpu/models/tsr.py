@@ -27,6 +27,7 @@ restricted to M items is provably complete once sup(item_{M+1}) < s_k.
 from __future__ import annotations
 
 import bisect
+import functools
 import heapq
 import itertools
 from fractions import Fraction
@@ -42,6 +43,7 @@ from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import next_pow2
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
+from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
 
@@ -126,6 +128,10 @@ class TsrTPU:
         self.k = int(k)
         self.minconf = float(minconf)
         self.mesh = mesh
+        # Multi-host mesh: host-side inputs must become global replicated
+        # arrays (see parallel/multihost.py)
+        self._multiproc = MH.is_multihost(mesh)
+        self._put = functools.partial(MH.host_to_device, mesh)
         self.item_cap = int(item_cap)
         self.max_side = max_side
         self.stats = {"evaluated": 0, "kernel_launches": 0, "deepening_rounds": 0}
@@ -174,13 +180,38 @@ class TsrTPU:
         ti = np.repeat(np.arange(len(sel), dtype=np.int32), lens)
         return ti, vdb.tok_seq[idx], vdb.tok_word[idx], vdb.tok_mask[idx]
 
-    def _host_bitmaps(self, m: int) -> np.ndarray:
-        """[m, n_seq, n_words] dense rows for the top-m items, host-built
-        from the token slice (memory proportional to m, never n_items)."""
+    def _host_bitmaps(self, m: int, lo: int = 0,
+                      hi: Optional[int] = None) -> np.ndarray:
+        """[m, hi-lo, n_words] dense rows for the top-m items over the
+        sequence range [lo, hi), host-built from the token slice (memory
+        proportional to m and the range, never n_items x n_seq_global)."""
+        hi = self.n_seq if hi is None else hi
         ti, ts, tw, tm = self._sel_tokens(self._order[:m])
-        bm = np.zeros((m, self.n_seq, self.n_words), np.uint32)
-        np.add.at(bm, (ti, ts, tw), tm)  # distinct bits: add == OR
+        bm = np.zeros((m, hi - lo, self.n_words), np.uint32)
+        keep = (ts >= lo) & (ts < hi)
+        # distinct bits: add == OR
+        np.add.at(bm, (ti[keep], ts[keep] - lo, tw[keep]), tm[keep])
         return bm
+
+    def _sharded_bitmaps(self, m: int) -> jax.Array:
+        """Multi-host sharded store build: each process materializes ONLY
+        its seq-axis slice (replicating the full [m, n_seq, W] store on
+        every device would cost D x the sharded footprint and defeat the
+        per-device eval-budget sizing)."""
+        sharding = store_sharding(self.mesh)
+        shape = (m, self.n_seq, self.n_words)
+        pidx = jax.process_index()
+        slices = sorted(
+            (idx[1].start or 0, idx[1].stop or self.n_seq)
+            for dev, idx in sharding.devices_indices_map(shape).items()
+            if dev.process_index == pidx)
+        lo, hi = slices[0][0], slices[-1][1]
+        if (hi - lo) != sum(b - a for a, b in slices):
+            # non-contiguous addressable shards (exotic device order):
+            # fall back to the replicate-and-reshard path
+            return self._put(self._host_bitmaps(m))
+        return jax.make_array_from_process_local_data(
+            sharding, self._host_bitmaps(m, lo, hi))
 
     def _prep(self, m: int):
         """prefix/suffix-OR id-lists for the top-m items (one jit call).
@@ -202,8 +233,11 @@ class TsrTPU:
                 jnp.asarray(ti), jnp.asarray(ts), jnp.asarray(tw),
                 jnp.asarray(tm))
         else:
-            raw = jax.device_put(self._host_bitmaps(m),
-                                 store_sharding(self.mesh))
+            if self._multiproc:
+                raw = self._sharded_bitmaps(m)
+            else:
+                raw = jax.device_put(self._host_bitmaps(m),
+                                     store_sharding(self.mesh))
 
             def body(b):
                 return B.prefix_or_incl(b), B.suffix_or_incl(b)
@@ -269,8 +303,8 @@ class TsrTPU:
             for r, (x, y) in enumerate(cands[lo:hi]):
                 xs[r, :len(x)] = x; xv[r, :len(x)] = True
                 ys[r, :len(y)] = y; yv[r, :len(y)] = True
-            sup, supx = fn(p1, s1, jnp.asarray(xs), jnp.asarray(xv),
-                           jnp.asarray(ys), jnp.asarray(yv))
+            sup, supx = fn(p1, s1, self._put(xs), self._put(xv),
+                           self._put(ys), self._put(yv))
             sup_parts.append(sup); supx_parts.append(supx)
             self.stats["kernel_launches"] += 1
         self.stats["evaluated"] += n

@@ -81,6 +81,24 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def is_multihost(mesh) -> bool:
+    """True when ``mesh`` spans more than one controller process."""
+    return mesh is not None and jax.process_count() > 1
+
+
+def host_to_device(mesh, x) -> jax.Array:
+    """Host array -> device input for an engine's (possibly multi-host)
+    mesh fns: plain ``jnp.asarray`` single-controller, a global replicated
+    array otherwise (SPMD host loops keep per-process copies identical).
+    The single shared implementation behind every engine's ``_put``.
+    """
+    if is_multihost(mesh):
+        return replicate(mesh, x)
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
 def replicate(mesh: Mesh, x) -> jax.Array:
     """Host array -> fully-replicated global array over ``mesh``.
 

@@ -53,9 +53,24 @@ def main() -> None:
     ok_k = patterns_text(got_k) == patterns_text(want)
     assert "pallas_fallback" not in eng_k.stats, eng_k.stats
 
+    # constrained + TSR engines ride the same multi-host mesh
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu, mine_tsr_tpu
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    cgot = mine_cspade_tpu(db, minsup, maxgap=2, maxwindow=5, mesh=mesh,
+                           chunk=64, node_batch=8, pool_bytes=1 << 20)
+    ok_c = patterns_text(cgot) == patterns_text(
+        mine_cspade(db, minsup, maxgap=2, maxwindow=5))
+    rgot = mine_tsr_tpu(db, 15, 0.5, max_side=2, mesh=mesh)
+    ok_r = rules_text(rgot) == rules_text(
+        mine_tsr_cpu(db, 15, 0.5, max_side=2))
+
     print(f"MULTIHOST_OK pid={pid} patterns={len(got)} parity={ok} "
-          f"pallas_parity={ok_k}", flush=True)
-    assert ok and ok_k
+          f"pallas_parity={ok_k} cspade_parity={ok_c} tsr_parity={ok_r}",
+          flush=True)
+    assert ok and ok_k and ok_c and ok_r
     shutdown_distributed()
 
 

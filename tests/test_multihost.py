@@ -49,3 +49,4 @@ def test_two_process_mesh_parity():
         assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out}"
         assert "MULTIHOST_OK" in out and "parity=True" in out, out
         assert "pallas_parity=True" in out, out
+        assert "cspade_parity=True" in out and "tsr_parity=True" in out, out
