@@ -245,3 +245,38 @@ def test_unknown_uid_and_pending(server):
     assert resp["status"] == "failure"
     got = _post(server, "/get/patterns", uid="deadbeef")
     assert got["status"] == "failure"
+
+
+def test_concurrent_jobs_multiple_workers():
+    """Several train jobs in flight at once across 2 miner workers: every
+    job finishes with its OWN results (no cross-job state bleed through
+    the shared store or engines)."""
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import ServiceRequest
+    from spark_fsm_tpu.service.store import ResultStore
+
+    store = ResultStore()
+    master = Master(store=store, miner_workers=2)
+    try:
+        uids = []
+        for k in range(6):
+            # each job mines a distinct item alphabet {10k+1, 10k+2}
+            a, b = 10 * k + 1, 10 * k + 2
+            seqs = f"{a} -1 {b} -2\n" * (k + 2)
+            resp = master.handle(ServiceRequest("fsm", "train", {
+                "algorithm": "SPADE", "source": "INLINE",
+                "sequences": seqs, "support": "1.0"}))
+            uids.append((resp.data["uid"], a, b, k + 2))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            done = [store.status(u) for u, *_ in uids]
+            if all(s in ("finished", "failure") for s in done):
+                break
+            time.sleep(0.02)
+        for uid, a, b, n in uids:
+            assert store.status(uid) == "finished", store.get(f"fsm:error:{uid}")
+            patterns = json.loads(store.patterns(uid))
+            assert {"support": n, "itemsets": [[a], [b]]} in patterns, \
+                (uid, patterns)
+    finally:
+        master.shutdown()
