@@ -62,30 +62,100 @@ def synthetic_db(
     return db
 
 
+def synthetic_db_fast(
+    seed: int,
+    n_sequences: int,
+    n_items: int,
+    mean_itemsets: float,
+    mean_itemset_size: float = 1.0,
+    zipf_s: float = 1.2,
+    max_itemsets: int = 96,
+    correlation: float = 0.35,
+) -> SequenceDB:
+    """Vectorized variant of :func:`synthetic_db` for full-scale databases.
+
+    Same distribution family (Zipfian popularity, Poisson lengths,
+    per-sequence working sets) but every token is drawn with one
+    inverse-CDF ``searchsorted`` pass instead of a per-token
+    ``rng.choice`` over the whole alphabet, which is O(n_items) per draw
+    and makes the exact generator take ~35 minutes for a full
+    Kosarak-shaped DB (990k sequences x 41k items) where this takes
+    seconds.  NOT seed-compatible with ``synthetic_db`` (different rng
+    consumption order; working sets sample with replacement), so the two
+    generators produce different databases for the same seed — use this
+    for scale experiments, the exact one for anything whose numbers are
+    compared across runs of the other.
+    """
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_items + 1, dtype=np.float64)
+    probs = ranks ** (-zipf_s)
+    probs /= probs.sum()
+    cdf = np.cumsum(probs)
+
+    lengths = 1 + rng.poisson(max(mean_itemsets - 1.0, 0.0), size=n_sequences)
+    lengths = np.minimum(lengths, max_itemsets)
+    n_itemsets = int(lengths.sum())
+    sizes = 1 + rng.poisson(max(mean_itemset_size - 1.0, 0.0),
+                            size=n_itemsets)
+    n_tokens = int(sizes.sum())
+
+    wside = min(6, n_items)
+    wsets = np.searchsorted(cdf, rng.random((n_sequences, wside)),
+                            side="right")
+    seq_of_itemset = np.repeat(np.arange(n_sequences), lengths)
+    seq_of_token = np.repeat(seq_of_itemset, sizes)
+    use_wset = rng.random(n_tokens) < correlation
+    from_wset = wsets[seq_of_token, rng.integers(0, wside, size=n_tokens)]
+    from_global = np.searchsorted(cdf, rng.random(n_tokens), side="right")
+    # .tolist(): plain Python ints, the SequenceDB contract (np.int64 would
+    # leak into json serialization paths)
+    items = (np.where(use_wset, from_wset, from_global) + 1).tolist()
+
+    # assemble: one cheap Python pass over itemset boundaries
+    tok_bounds = np.concatenate(([0], np.cumsum(sizes))).tolist()
+    set_bounds = np.concatenate(([0], np.cumsum(lengths))).tolist()
+    itemsets = [tuple(sorted(set(items[tok_bounds[j]:tok_bounds[j + 1]])))
+                for j in range(n_itemsets)]
+    return [tuple(itemsets[set_bounds[i]:set_bounds[i + 1]])
+            for i in range(n_sequences)]
+
+
 # Shapes follow BASELINE.md "public dataset characteristics" (scaled variants
-# for tests; full-size variants for bench.py).
+# for tests; full-size variants for bench.py).  ``fast=True`` routes through
+# synthetic_db_fast (vectorized; different DBs for the same seed — see its
+# docstring) for full-scale experiments.
 
-def bms_webview1_like(seed: int = 1, scale: float = 1.0) -> SequenceDB:
-    return synthetic_db(seed, int(59600 * scale), max(32, int(497 * scale)),
-                        mean_itemsets=2.5, zipf_s=1.1)
-
-
-def bms_webview2_like(seed: int = 2, scale: float = 1.0) -> SequenceDB:
-    return synthetic_db(seed, int(77500 * scale), max(64, int(3300 * scale)),
-                        mean_itemsets=4.6, zipf_s=1.15)
+def _generator(fast: bool):
+    return synthetic_db_fast if fast else synthetic_db
 
 
-def msnbc_like(seed: int = 3, scale: float = 1.0) -> SequenceDB:
+def bms_webview1_like(seed: int = 1, scale: float = 1.0,
+                      fast: bool = False) -> SequenceDB:
+    return _generator(fast)(seed, int(59600 * scale), max(32, int(497 * scale)),
+                            mean_itemsets=2.5, zipf_s=1.1)
+
+
+def bms_webview2_like(seed: int = 2, scale: float = 1.0,
+                      fast: bool = False) -> SequenceDB:
+    return _generator(fast)(seed, int(77500 * scale), max(64, int(3300 * scale)),
+                            mean_itemsets=4.6, zipf_s=1.15)
+
+
+def msnbc_like(seed: int = 3, scale: float = 1.0,
+               fast: bool = False) -> SequenceDB:
     # 17 page categories, long-tailed lengths.
-    return synthetic_db(seed, int(990000 * scale), 17,
-                        mean_itemsets=5.7, zipf_s=0.9, max_itemsets=96)
+    return _generator(fast)(seed, int(990000 * scale), 17,
+                            mean_itemsets=5.7, zipf_s=0.9, max_itemsets=96)
 
 
-def kosarak_like(seed: int = 4, scale: float = 1.0) -> SequenceDB:
-    return synthetic_db(seed, int(990000 * scale), max(128, int(41000 * scale)),
-                        mean_itemsets=8.1, zipf_s=1.3)
+def kosarak_like(seed: int = 4, scale: float = 1.0,
+                 fast: bool = False) -> SequenceDB:
+    return _generator(fast)(seed, int(990000 * scale),
+                            max(128, int(41000 * scale)),
+                            mean_itemsets=8.1, zipf_s=1.3)
 
 
-def gazelle_like(seed: int = 5, scale: float = 1.0) -> SequenceDB:
-    return synthetic_db(seed, int(59000 * scale), max(64, int(498 * scale)),
-                        mean_itemsets=2.5, zipf_s=1.1)
+def gazelle_like(seed: int = 5, scale: float = 1.0,
+                 fast: bool = False) -> SequenceDB:
+    return _generator(fast)(seed, int(59000 * scale), max(64, int(498 * scale)),
+                            mean_itemsets=2.5, zipf_s=1.1)

@@ -1,0 +1,27 @@
+
+
+def test_synthetic_db_fast_shape_and_determinism():
+    """The vectorized generator matches the exact one's distribution family
+    (not its bytes — different rng consumption) and is deterministic."""
+    import numpy as np
+
+    from spark_fsm_tpu.data.synth import synthetic_db, synthetic_db_fast
+
+    slow = synthetic_db(7, 3000, 500, mean_itemsets=4.0, mean_itemset_size=1.3)
+    fast = synthetic_db_fast(7, 3000, 500, mean_itemsets=4.0,
+                             mean_itemset_size=1.3)
+    assert fast == synthetic_db_fast(7, 3000, 500, mean_itemsets=4.0,
+                                     mean_itemset_size=1.3)  # deterministic
+    for db in (slow, fast):
+        lens = np.array([len(s) for s in db])
+        assert len(db) == 3000 and lens.min() >= 1
+        items = [i for s in db for st in s for i in st]
+        assert min(items) >= 1 and max(items) <= 500
+    # same length distribution (both draw Poisson lengths first)
+    assert abs(np.mean([len(s) for s in slow])
+               - np.mean([len(s) for s in fast])) < 0.15
+    # mineable: frequent patterns exist (working-set correlation works)
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.oracle import mine_spade
+
+    assert len(mine_spade(fast, abs_minsup(0.05, len(fast)))) > 5

@@ -114,3 +114,25 @@ def test_blank_lines_skipped_and_concurrent_clients(remote):
     assert c2.request("status", {"uid": "nope"})["task"] == "status"
     c1.close()
     c2.close()
+
+
+def test_oversized_line_drained_and_framing_kept(remote, monkeypatch):
+    """A request line over MAX_LINE gets one failure envelope and the
+    remainder of the line is drained — framing stays one-reply-per-line."""
+    from spark_fsm_tpu.service import remote as remote_mod
+
+    monkeypatch.setattr(remote_mod, "MAX_LINE", 1024)
+    raw = socket.create_connection(("127.0.0.1", remote.port), timeout=10)
+    f = raw.makefile("rwb")
+    f.write(b'{"service": "fsm", "task": "status", "data": {"x": "'
+            + b"A" * 5000 + b'"}}\n')
+    f.flush()
+    resp = json.loads(f.readline())
+    assert resp["status"] == "failure" and "exceeds" in resp["data"]["error"]
+    # exactly ONE reply for the oversized line; the next request pairs
+    # with the next reply
+    f.write(b'{"service": "fsm", "task": "status", "data": {"uid": "x"}}\n')
+    f.flush()
+    resp = json.loads(f.readline())
+    assert resp["task"] == "status"
+    raw.close()
