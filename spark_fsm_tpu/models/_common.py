@@ -7,6 +7,7 @@ recompute-on-miss, and reclaim-from-stack-bottom when the pool runs dry.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, List, Optional
 
 
@@ -62,6 +63,42 @@ def load_checkpoint(checkpoint, fingerprint: dict):
     return resume, checkpoint.save, getattr(checkpoint, "every_s", 30.0)
 
 
+@functools.lru_cache(maxsize=128)
+def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh):
+    """Cached jitted store-build kernel.  ``jax.jit`` caches traces per
+    wrapped-function OBJECT, so handing it a fresh closure per engine
+    construction recompiles the scatter build every time — and the service
+    builds one engine per /train request.  Keyed on the store geometry and
+    mesh, the compiled kernel is shared by every engine with that shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from spark_fsm_tpu.parallel.mesh import SEQ_AXIS
+
+    if mesh is None:
+        def init_store(ti, ts, tw, tm):
+            z = jnp.zeros((n_rows, n_seq, n_words), jnp.uint32)
+            return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
+
+        return jax.jit(init_store)
+
+    shard = n_seq // mesh.devices.size
+
+    def init_store_shard(ti, ts, tw, tm):
+        ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
+        ok = (ls >= 0) & (ls < shard)
+        z = jnp.zeros((n_rows, shard, n_words), jnp.uint32)
+        return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
+            jnp.where(ok, tm, jnp.uint32(0)))
+
+    rep = P()
+    return jax.jit(jax.shard_map(
+        init_store_shard, mesh=mesh,
+        in_specs=(rep, rep, rep, rep),
+        out_specs=P(None, SEQ_AXIS, None)))
+
+
 def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
                         mesh=None, put=None):
     """Scatter-build a ``[n_rows, n_seq, n_words]`` uint32 bitmap store IN
@@ -76,37 +113,29 @@ def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
     ``put`` maps host token arrays to device inputs (the multi-host engine
     passes its global-replicate put; default jnp.asarray).
     """
-    import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
 
-    from spark_fsm_tpu.parallel.mesh import SEQ_AXIS
-
-    if mesh is None:
-        def init_store(ti, ts, tw, tm):
-            z = jnp.zeros((n_rows, n_seq, n_words), jnp.uint32)
-            return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
-
-        build = jax.jit(init_store)
-    else:
-        shard = n_seq // mesh.devices.size
-
-        def init_store_shard(ti, ts, tw, tm):
-            ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
-            ok = (ls >= 0) & (ls < shard)
-            z = jnp.zeros((n_rows, shard, n_words), jnp.uint32)
-            return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
-                jnp.where(ok, tm, jnp.uint32(0)))
-
-        rep = P()
-        build = jax.jit(jax.shard_map(
-            init_store_shard, mesh=mesh,
-            in_specs=(rep, rep, rep, rep),
-            out_specs=P(None, SEQ_AXIS, None)))
+    build = _store_builder(n_rows, n_seq, n_words, mesh)
     if put is None:
         put = jnp.asarray
     return build(put(vdb.tok_item), put(vdb.tok_seq),
                  put(vdb.tok_word), put(vdb.tok_mask))
+
+
+@functools.lru_cache(maxsize=64)
+def zeros_fn(shape, dt, mesh=None):
+    """Cached jitted pool allocator (same per-object jit-cache reasoning
+    as _store_builder; a zeros fill is trivial but a per-instance jit still
+    costs a trace + compile per engine construction)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_fsm_tpu.parallel.mesh import store_sharding
+
+    zeros = lambda: jnp.zeros(shape, dt)
+    if mesh is None:
+        return jax.jit(zeros)
+    return jax.jit(zeros, out_shardings=store_sharding(mesh))
 
 
 def next_pow2(n: int) -> int:

@@ -39,7 +39,7 @@ from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
     SlotPool, decode_frontier, encode_frontier, load_checkpoint, next_pow2,
-    scatter_build_store)
+    scatter_build_store, zeros_fn)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
@@ -54,6 +54,81 @@ class _Node:
     slot: Optional[int]
     s_list: List[int]  # s-candidates: siblings when maxgap is None, else all roots
     i_list: List[int]
+
+
+@functools.lru_cache(maxsize=64)
+def _cspade_fns(mesh: Optional[Mesh], maxgap: Optional[int],
+                maxwindow: Optional[int], dt):
+    """Jitted kernel set shared by every ConstrainedSpadeTPU with the same
+    (mesh, constraints, state dtype) — jax.jit caches per wrapped-function
+    object, so per-instance closures would recompile every kernel for each
+    engine construction (see models/spade_tpu._spade_fns)."""
+    NONE = jnp.asarray(-1, dt)
+
+    def root_states(items, item_idx):
+        occ = MS.expand_bits(items[item_idx])
+        pos = jnp.arange(occ.shape[-1], dtype=dt)
+        return jnp.where(occ, pos, NONE)
+
+    def prep_body(pool, items, node_slot, node_root, is_root):
+        # root nodes read their state straight from the item bitmaps
+        m = jnp.where(is_root[:, None, None],
+                      root_states(items, node_root),
+                      pool[node_slot].astype(dt))
+        return m, MS.prev_max(m, maxgap)
+
+    def _child(m, pm, items, ref, item_idx, iss):
+        occ = MS.expand_bits(items[item_idx])
+        base = jnp.where(iss[:, None, None], pm[ref], m[ref])
+        return jnp.where(occ & (base >= 0), base, NONE)
+
+    def supports_body(m, pm, items, ref, item_idx, iss):
+        part = MS.support(_child(m, pm, items, ref, item_idx, iss), maxwindow)
+        if mesh is not None:
+            part = jax.lax.psum(part, SEQ_AXIS)
+        return part
+
+    def materialize_body(m, pm, items, pool, ref, item_idx, iss, out_slot):
+        c = _child(m, pm, items, ref, item_idx, iss)
+        return pool.at[out_slot].set(c)
+
+    def recompute_body(pool, items, step_items, step_iss, step_valid, out_slot):
+        m = root_states(items, step_items[0])
+        def body(state, xs):
+            it, iss, valid = xs
+            pm = MS.prev_max(state, maxgap)
+            occ = MS.expand_bits(items[it])
+            base = jnp.where(iss[:, None, None], pm, state)
+            nm = jnp.where(occ & (base >= 0), base, NONE)
+            return jnp.where(valid[:, None, None], nm, state), None
+        m, _ = jax.lax.scan(body, m, (step_items[1:], step_iss[1:], step_valid[1:]))
+        return pool.at[out_slot].set(m)
+
+    if mesh is None:
+        return {
+            "prep": jax.jit(prep_body),
+            "supports": jax.jit(supports_body),
+            "materialize": jax.jit(materialize_body, donate_argnums=3),
+            "recompute": jax.jit(recompute_body, donate_argnums=0),
+        }
+    st = P(None, SEQ_AXIS, None)
+    rep = P()
+    return {
+        "prep": jax.jit(jax.shard_map(
+            prep_body, mesh=mesh, in_specs=(st, st, rep, rep, rep),
+            out_specs=(st, st))),
+        "supports": jax.jit(jax.shard_map(
+            supports_body, mesh=mesh,
+            in_specs=(st, st, st, rep, rep, rep), out_specs=rep)),
+        "materialize": jax.jit(jax.shard_map(
+            materialize_body, mesh=mesh,
+            in_specs=(st, st, st, st, rep, rep, rep, rep), out_specs=st),
+            donate_argnums=3),
+        "recompute": jax.jit(jax.shard_map(
+            recompute_body, mesh=mesh,
+            in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
+            donate_argnums=0),
+    }
 
 
 class ConstrainedSpadeTPU:
@@ -113,12 +188,7 @@ class ConstrainedSpadeTPU:
         self.items = scatter_build_store(vdb, n_items, n_seq, n_words,
                                          mesh=mesh, put=self._put)
         pool_shape = (pool_slots + 1, n_seq, self.n_pos)
-        zeros = lambda: jnp.zeros(pool_shape, self.dtype)
-        if mesh is None:
-            self.pool = jax.jit(zeros)()
-        else:
-            self.pool = jax.jit(
-                zeros, out_shardings=store_sharding(mesh))()
+        self.pool = zeros_fn(pool_shape, self.dtype, mesh)()
         self._pool_alloc = SlotPool(range(pool_slots))
         self._build_fns()
         # s_candidates vs i_candidates: under maxgap the s-side is ALL root
@@ -132,72 +202,13 @@ class ConstrainedSpadeTPU:
     # ------------------------------------------------------------------ fns
 
     def _build_fns(self) -> None:
-        mesh = self.mesh
-        maxgap, maxwindow = self.maxgap, self.maxwindow
-        dt = self.dtype
-        NONE = jnp.asarray(-1, dt)
-
-        def root_states(items, item_idx):
-            occ = MS.expand_bits(items[item_idx])
-            pos = jnp.arange(occ.shape[-1], dtype=dt)
-            return jnp.where(occ, pos, NONE)
-
-        def prep_body(pool, items, node_slot, node_root, is_root):
-            # root nodes read their state straight from the item bitmaps
-            m = jnp.where(is_root[:, None, None],
-                          root_states(items, node_root),
-                          pool[node_slot].astype(dt))
-            return m, MS.prev_max(m, maxgap)
-
-        def _child(m, pm, items, ref, item_idx, iss):
-            occ = MS.expand_bits(items[item_idx])
-            base = jnp.where(iss[:, None, None], pm[ref], m[ref])
-            return jnp.where(occ & (base >= 0), base, NONE)
-
-        def supports_body(m, pm, items, ref, item_idx, iss):
-            part = MS.support(_child(m, pm, items, ref, item_idx, iss), maxwindow)
-            if mesh is not None:
-                part = jax.lax.psum(part, SEQ_AXIS)
-            return part
-
-        def materialize_body(m, pm, items, pool, ref, item_idx, iss, out_slot):
-            c = _child(m, pm, items, ref, item_idx, iss)
-            return pool.at[out_slot].set(c)
-
-        def recompute_body(pool, items, step_items, step_iss, step_valid, out_slot):
-            m = root_states(items, step_items[0])
-            def body(state, xs):
-                it, iss, valid = xs
-                pm = MS.prev_max(state, maxgap)
-                occ = MS.expand_bits(items[it])
-                base = jnp.where(iss[:, None, None], pm, state)
-                nm = jnp.where(occ & (base >= 0), base, NONE)
-                return jnp.where(valid[:, None, None], nm, state), None
-            m, _ = jax.lax.scan(body, m, (step_items[1:], step_iss[1:], step_valid[1:]))
-            return pool.at[out_slot].set(m)
-
-        if mesh is None:
-            self._prep_fn = jax.jit(prep_body)
-            self._supports_fn = jax.jit(supports_body)
-            self._materialize_fn = jax.jit(materialize_body, donate_argnums=3)
-            self._recompute_fn = jax.jit(recompute_body, donate_argnums=0)
-        else:
-            st = P(None, SEQ_AXIS, None)
-            rep = P()
-            self._prep_fn = jax.jit(jax.shard_map(
-                prep_body, mesh=mesh, in_specs=(st, st, rep, rep, rep),
-                out_specs=(st, st)))
-            self._supports_fn = jax.jit(jax.shard_map(
-                supports_body, mesh=mesh,
-                in_specs=(st, st, st, rep, rep, rep), out_specs=rep))
-            self._materialize_fn = jax.jit(jax.shard_map(
-                materialize_body, mesh=mesh,
-                in_specs=(st, st, st, st, rep, rep, rep, rep), out_specs=st),
-                donate_argnums=3)
-            self._recompute_fn = jax.jit(jax.shard_map(
-                recompute_body, mesh=mesh,
-                in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
-                donate_argnums=0)
+        # Jitted callables are shared across engine instances (one engine
+        # per /train request): see _cspade_fns.
+        fns = _cspade_fns(self.mesh, self.maxgap, self.maxwindow, self.dtype)
+        self._prep_fn = fns["prep"]
+        self._supports_fn = fns["supports"]
+        self._materialize_fn = fns["materialize"]
+        self._recompute_fn = fns["recompute"]
 
     # ------------------------------------------------------------ slot mgmt
 

@@ -68,6 +68,106 @@ class _Node:
     i_list: List[int]
 
 
+@functools.lru_cache(maxsize=64)
+def _spade_fns(mesh: Optional[Mesh], pallas_key):
+    """Jitted kernel set shared by every SpadeTPU with the same mesh and
+    Pallas config.  ``jax.jit`` caches traces per wrapped-function OBJECT,
+    so per-instance closures would recompile the whole kernel chain on
+    every engine construction — ~10s per /train request on a v5e even for
+    tiny databases.  ``pallas_key`` = (n_items, s_block, multiword,
+    interpret) for the mesh Pallas launcher, or None when unused.
+    """
+    # The s-ext transform (~6 word-ops) dominates the AND (1 op), and a
+    # node typically has tens of candidates, so gather + transform the
+    # popped batch's bitmaps ONCE per batch.  Plain and transformed rows
+    # interleave into ONE [2*Bn, S, W] tensor so each candidate costs a
+    # single gathered row (a where(iss, trans[ref], parents[ref]) would
+    # gather BOTH branches — 2x HBM traffic on the parent side).
+    def prep_body(store, node_slot):
+        parents = store[node_slot]            # [Bn, S, W]
+        pt = jnp.stack([parents, B.sext_transform(parents)], axis=1)
+        return pt.reshape((-1,) + parents.shape[1:])  # [2*Bn, S, W]
+
+    def _joined(pt, store, parent_ref, item_slot, iss):
+        base = pt[2 * parent_ref + iss.astype(jnp.int32)]
+        return base & store[item_slot]
+
+    def supports_body(pt, store, parent_ref, item_slot, iss):
+        part = B.support(_joined(pt, store, parent_ref, item_slot, iss))
+        if mesh is not None:
+            part = jax.lax.psum(part, SEQ_AXIS)
+        return part
+
+    def materialize_body(pt, store, parent_ref, item_slot, iss, out_slot):
+        j = _joined(pt, store, parent_ref, item_slot, iss)
+        return store.at[out_slot].set(j)
+
+    def recompute_body(store, step_items, step_iss, step_valid, out_slot):
+        # step_* : [K, M]; fold the join chain along K.
+        bmp = store[step_items[0]]
+        def body(b, xs):
+            it, iss, valid = xs
+            nb = B.join(b, store[it], iss)
+            return jnp.where(valid[:, None, None], nb, b), None
+        bmp, _ = jax.lax.scan(body, bmp, (step_items[1:], step_iss[1:], step_valid[1:]))
+        return store.at[out_slot].set(bmp)
+
+    if mesh is None:
+        return {
+            "prep": jax.jit(prep_body),
+            "supports": jax.jit(supports_body),
+            "materialize": jax.jit(materialize_body, donate_argnums=1),
+            "recompute": jax.jit(recompute_body, donate_argnums=0),
+            "pallas_supports": None,
+        }
+
+    st = P(None, SEQ_AXIS, None)
+    rep = P()
+    pallas_supports = None
+    if pallas_key is not None:
+        # Per-shard pair-support kernel launch; psum the extracted
+        # candidate supports over ICI (same contract as supports_body).
+        n_items_s, sb, ikl, interp = pallas_key
+
+        def pallas_supports_body(pt, items, pref, item):
+            sup = PS.batch_supports(
+                pt, items, n_items_s, pref, item,
+                items_kernel_layout=ikl, s_block=sb, interpret=interp)
+            return jax.lax.psum(sup, SEQ_AXIS)
+
+        items_spec = P(None, None, SEQ_AXIS) if ikl else st
+        # check_vma=False: pallas_call's out_shape carries no varying-mesh-
+        # axes annotation and the vma validator rejects it on EVERY real-TPU
+        # lowering (interpret mode, which the CPU tests use, skips the check
+        # — which is how a check_vma=True version once passed tests yet
+        # silently knocked the whole mesh path onto the jnp fallback on
+        # hardware).
+        pallas_supports = jax.jit(
+            jax.shard_map(pallas_supports_body, mesh=mesh,
+                          in_specs=(st, items_spec, rep, rep),
+                          out_specs=rep,
+                          check_vma=False)
+        )
+
+    return {
+        "prep": jax.jit(
+            jax.shard_map(prep_body, mesh=mesh,
+                          in_specs=(st, rep), out_specs=st)),
+        "supports": jax.jit(
+            jax.shard_map(supports_body, mesh=mesh,
+                          in_specs=(st, st, rep, rep, rep), out_specs=rep)),
+        "materialize": jax.jit(
+            jax.shard_map(materialize_body, mesh=mesh,
+                          in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
+            donate_argnums=1),
+        "recompute": jax.jit(
+            jax.shard_map(recompute_body, mesh=mesh,
+                          in_specs=(st, rep, rep, rep, rep), out_specs=st),
+            donate_argnums=0),
+        "pallas_supports": pallas_supports,
+    }
+
+
 class SpadeTPU:
     """Single- or multi-chip SPADE miner.
 
@@ -181,90 +281,18 @@ class SpadeTPU:
     # ------------------------------------------------------------------ fns
 
     def _build_fns(self) -> None:
-        mesh = self.mesh
-
-        # The s-ext transform (~6 word-ops) dominates the AND (1 op), and a
-        # node typically has tens of candidates, so gather + transform the
-        # popped batch's bitmaps ONCE per batch.  Plain and transformed rows
-        # interleave into ONE [2*Bn, S, W] tensor so each candidate costs a
-        # single gathered row (a where(iss, trans[ref], parents[ref]) would
-        # gather BOTH branches — 2x HBM traffic on the parent side).
-        def prep_body(store, node_slot):
-            parents = store[node_slot]            # [Bn, S, W]
-            pt = jnp.stack([parents, B.sext_transform(parents)], axis=1)
-            return pt.reshape((-1,) + parents.shape[1:])  # [2*Bn, S, W]
-
-        def _joined(pt, store, parent_ref, item_slot, iss):
-            base = pt[2 * parent_ref + iss.astype(jnp.int32)]
-            return base & store[item_slot]
-
-        def supports_body(pt, store, parent_ref, item_slot, iss):
-            part = B.support(_joined(pt, store, parent_ref, item_slot, iss))
-            if mesh is not None:
-                part = jax.lax.psum(part, SEQ_AXIS)
-            return part
-
-        def materialize_body(pt, store, parent_ref, item_slot, iss, out_slot):
-            j = _joined(pt, store, parent_ref, item_slot, iss)
-            return store.at[out_slot].set(j)
-
-        def recompute_body(store, step_items, step_iss, step_valid, out_slot):
-            # step_* : [K, M]; fold the join chain along K.
-            bmp = store[step_items[0]]
-            def body(b, xs):
-                it, iss, valid = xs
-                nb = B.join(b, store[it], iss)
-                return jnp.where(valid[:, None, None], nb, b), None
-            bmp, _ = jax.lax.scan(body, bmp, (step_items[1:], step_iss[1:], step_valid[1:]))
-            return store.at[out_slot].set(bmp)
-
-        if mesh is None:
-            self._prep_fn = jax.jit(prep_body)
-            self._supports_fn = jax.jit(supports_body)
-            self._materialize_fn = jax.jit(materialize_body, donate_argnums=1)
-            self._recompute_fn = jax.jit(recompute_body, donate_argnums=0)
-        else:
-            st = P(None, SEQ_AXIS, None)
-            rep = P()
-            # Per-shard pair-support kernel launch; psum the extracted
-            # candidate supports over ICI (same contract as supports_body).
-            n_items_s, sb = self.n_items, self._s_block
-            ikl, interp = self.n_words > 1, self._pallas_interpret
-
-            def pallas_supports_body(pt, items, pref, item):
-                sup = PS.batch_supports(
-                    pt, items, n_items_s, pref, item,
-                    items_kernel_layout=ikl, s_block=sb, interpret=interp)
-                return jax.lax.psum(sup, SEQ_AXIS)
-
-            items_spec = P(None, None, SEQ_AXIS) if ikl else st
-            # multi-controller only: pallas_call's out_shape carries no
-            # varying-mesh-axes annotation, which that validator rejects;
-            # single-controller keeps the check (it passes there)
-            self._pallas_supports_fn = jax.jit(
-                jax.shard_map(pallas_supports_body, mesh=mesh,
-                              in_specs=(st, items_spec, rep, rep),
-                              out_specs=rep,
-                              check_vma=not self._multiproc)
-            )
-            self._prep_fn = jax.jit(
-                jax.shard_map(prep_body, mesh=mesh,
-                              in_specs=(st, rep), out_specs=st)
-            )
-            self._supports_fn = jax.jit(
-                jax.shard_map(supports_body, mesh=mesh,
-                              in_specs=(st, st, rep, rep, rep), out_specs=rep)
-            )
-            self._materialize_fn = jax.jit(
-                jax.shard_map(materialize_body, mesh=mesh,
-                              in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
-                donate_argnums=1,
-            )
-            self._recompute_fn = jax.jit(
-                jax.shard_map(recompute_body, mesh=mesh,
-                              in_specs=(st, rep, rep, rep, rep), out_specs=st),
-                donate_argnums=0,
-            )
+        # Jitted callables are shared across engine instances (the service
+        # builds one engine per /train): see _spade_fns.
+        pallas_key = None
+        if self.mesh is not None and self.use_pallas:
+            pallas_key = (self.n_items, self._s_block, self.n_words > 1,
+                          self._pallas_interpret)
+        fns = _spade_fns(self.mesh, pallas_key)
+        self._prep_fn = fns["prep"]
+        self._supports_fn = fns["supports"]
+        self._materialize_fn = fns["materialize"]
+        self._recompute_fn = fns["recompute"]
+        self._pallas_supports_fn = fns["pallas_supports"]
 
     # ------------------------------------------------------------ slot mgmt
 

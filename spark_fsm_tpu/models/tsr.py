@@ -100,6 +100,60 @@ def brute_force_rules(db: SequenceDB, k: int, minconf: float,
 # TPU engine
 # ---------------------------------------------------------------------------
 
+# Jitted kernels are module-level / lru_cached so every TsrTPU instance with
+# the same (mesh, shape bucket) shares compiles — jax.jit caches per
+# wrapped-function object, and the service builds one engine per /train
+# request (see models/spade_tpu._spade_fns for the full reasoning).
+
+@functools.partial(jax.jit, static_argnames=("m", "n_seq", "n_words"))
+def _build_prep_single(ti, ts, tw, tm, *, m, n_seq, n_words):
+    """Scatter-build the top-m item rows in HBM + prefix/suffix-OR them."""
+    z = jnp.zeros((m, n_seq, n_words), jnp.uint32)
+    b = z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
+    return B.prefix_or_incl(b), B.suffix_or_incl(b)
+
+
+@functools.lru_cache(maxsize=16)
+def _prep_fn_mesh(mesh: Mesh):
+    def body(b):
+        return B.prefix_or_incl(b), B.suffix_or_incl(b)
+
+    st = P(None, SEQ_AXIS, None)
+    return jax.jit(jax.shard_map(body, mesh=mesh,
+                                 in_specs=(st,), out_specs=(st, st)))
+
+
+@functools.lru_cache(maxsize=256)
+def _eval_kernel(mesh: Optional[Mesh], kmax: int):
+    """Jitted rule evaluator for side sizes <= kmax (bucketed compile)."""
+    FULL = jnp.uint32(0xFFFFFFFF)
+
+    def fold(t, idx, valid):
+        acc = None
+        for j in range(kmax):
+            g = jnp.where(valid[:, j, None, None], t[idx[:, j]], FULL)
+            acc = g if acc is None else acc & g
+        return acc
+
+    def body(p1, s1, xs, xv, ys, yv):
+        a = fold(p1, xs, xv)
+        c = fold(s1, ys, yv)
+        sup = B.support(B.shift_up_one(a) & c)
+        supx = B.support(a)
+        if mesh is not None:
+            sup = jax.lax.psum(sup, SEQ_AXIS)
+            supx = jax.lax.psum(supx, SEQ_AXIS)
+        return sup, supx
+
+    if mesh is None:
+        return jax.jit(body)
+    st = P(None, SEQ_AXIS, None)
+    rep = P()
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(st, st, rep, rep, rep, rep), out_specs=(rep, rep)))
+
+
 class TsrTPU:
     """Batched best-first TopSeqRules over the vertical bitmap DB.
 
@@ -135,7 +189,6 @@ class TsrTPU:
         self.item_cap = int(item_cap)
         self.max_side = max_side
         self.stats = {"evaluated": 0, "kernel_launches": 0, "deepening_rounds": 0}
-        self._eval_fns: dict = {}
 
         # NEVER materialize vdb.bitmaps here: with a Kosarak-shaped alphabet
         # (~41k items x ~990k sequences) the full dense store is ~160 GB.
@@ -223,66 +276,22 @@ class TsrTPU:
         """
         if self.mesh is None:
             ti, ts, tw, tm = self._sel_tokens(self._order[:m])
-
-            def build_and_prep(ti, ts, tw, tm):
-                z = jnp.zeros((m, self.n_seq, self.n_words), jnp.uint32)
-                b = z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
-                return B.prefix_or_incl(b), B.suffix_or_incl(b)
-
-            p1, s1 = jax.jit(build_and_prep)(
+            p1, s1 = _build_prep_single(
                 jnp.asarray(ti), jnp.asarray(ts), jnp.asarray(tw),
-                jnp.asarray(tm))
+                jnp.asarray(tm), m=m, n_seq=self.n_seq,
+                n_words=self.n_words)
         else:
             if self._multiproc:
                 raw = self._sharded_bitmaps(m)
             else:
                 raw = jax.device_put(self._host_bitmaps(m),
                                      store_sharding(self.mesh))
-
-            def body(b):
-                return B.prefix_or_incl(b), B.suffix_or_incl(b)
-
-            st = P(None, SEQ_AXIS, None)
-            fn = jax.jit(jax.shard_map(body, mesh=self.mesh,
-                                       in_specs=(st,), out_specs=(st, st)))
-            p1, s1 = fn(raw)
+            p1, s1 = _prep_fn_mesh(self.mesh)(raw)
         self.stats["kernel_launches"] += 1
         return p1, s1
 
     def _eval_fn(self, kmax: int):
-        """Jitted evaluator for side sizes <= kmax (bucketed compile)."""
-        if kmax in self._eval_fns:
-            return self._eval_fns[kmax]
-        mesh = self.mesh
-        FULL = jnp.uint32(0xFFFFFFFF)
-
-        def fold(t, idx, valid):
-            acc = None
-            for j in range(kmax):
-                g = jnp.where(valid[:, j, None, None], t[idx[:, j]], FULL)
-                acc = g if acc is None else acc & g
-            return acc
-
-        def body(p1, s1, xs, xv, ys, yv):
-            a = fold(p1, xs, xv)
-            c = fold(s1, ys, yv)
-            sup = B.support(B.shift_up_one(a) & c)
-            supx = B.support(a)
-            if mesh is not None:
-                sup = jax.lax.psum(sup, SEQ_AXIS)
-                supx = jax.lax.psum(supx, SEQ_AXIS)
-            return sup, supx
-
-        if mesh is None:
-            fn = jax.jit(body)
-        else:
-            st = P(None, SEQ_AXIS, None)
-            rep = P()
-            fn = jax.jit(jax.shard_map(
-                body, mesh=mesh,
-                in_specs=(st, st, rep, rep, rep, rep), out_specs=(rep, rep)))
-        self._eval_fns[kmax] = fn
-        return fn
+        return _eval_kernel(self.mesh, kmax)
 
     def _evaluate(self, p1, s1, cands: List[Tuple[Tuple[int, ...], Tuple[int, ...]]]):
         """Batch-evaluate (sup, supx) for candidate rules (local item idx)."""
