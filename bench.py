@@ -40,6 +40,8 @@ from spark_fsm_tpu.utils.probe import tpu_probe as _tpu_probe
 
 
 def main() -> None:
+    from spark_fsm_tpu.utils.jitcache import enable_compile_cache
+    enable_compile_cache()  # compiles persist across runs (cold-start win)
     fallback_reason = ""
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         fallback_reason = "JAX_PLATFORMS=cpu requested by caller"

@@ -42,7 +42,7 @@ from spark_fsm_tpu.models._common import (
     scatter_build_store, zeros_fn)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel import multihost as MH
-from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
 Step = Tuple[int, bool]

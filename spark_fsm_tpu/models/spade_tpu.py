@@ -69,13 +69,14 @@ class _Node:
 
 
 @functools.lru_cache(maxsize=64)
-def _spade_fns(mesh: Optional[Mesh], pallas_key):
-    """Jitted kernel set shared by every SpadeTPU with the same mesh and
-    Pallas config.  ``jax.jit`` caches traces per wrapped-function OBJECT,
-    so per-instance closures would recompile the whole kernel chain on
-    every engine construction — ~10s per /train request on a v5e even for
-    tiny databases.  ``pallas_key`` = (n_items, s_block, multiword,
-    interpret) for the mesh Pallas launcher, or None when unused.
+def _spade_fns(mesh: Optional[Mesh]):
+    """Jitted kernel set shared by every SpadeTPU with the same mesh.
+    ``jax.jit`` caches traces per wrapped-function OBJECT, so per-instance
+    closures would recompile the whole kernel chain on every engine
+    construction — ~10s per /train request on a v5e even for tiny
+    databases.  The Pallas launcher is cached separately
+    (:func:`_pallas_supports_fn`) because its key varies per DB geometry
+    and must not evict/miss these geometry-independent four.
     """
     # The s-ext transform (~6 word-ops) dominates the AND (1 op), and a
     # node typically has tens of candidates, so gather + transform the
@@ -118,37 +119,10 @@ def _spade_fns(mesh: Optional[Mesh], pallas_key):
             "supports": jax.jit(supports_body),
             "materialize": jax.jit(materialize_body, donate_argnums=1),
             "recompute": jax.jit(recompute_body, donate_argnums=0),
-            "pallas_supports": None,
         }
 
     st = P(None, SEQ_AXIS, None)
     rep = P()
-    pallas_supports = None
-    if pallas_key is not None:
-        # Per-shard pair-support kernel launch; psum the extracted
-        # candidate supports over ICI (same contract as supports_body).
-        n_items_s, sb, ikl, interp = pallas_key
-
-        def pallas_supports_body(pt, items, pref, item):
-            sup = PS.batch_supports(
-                pt, items, n_items_s, pref, item,
-                items_kernel_layout=ikl, s_block=sb, interpret=interp)
-            return jax.lax.psum(sup, SEQ_AXIS)
-
-        items_spec = P(None, None, SEQ_AXIS) if ikl else st
-        # check_vma=False: pallas_call's out_shape carries no varying-mesh-
-        # axes annotation and the vma validator rejects it on EVERY real-TPU
-        # lowering (interpret mode, which the CPU tests use, skips the check
-        # — which is how a check_vma=True version once passed tests yet
-        # silently knocked the whole mesh path onto the jnp fallback on
-        # hardware).
-        pallas_supports = jax.jit(
-            jax.shard_map(pallas_supports_body, mesh=mesh,
-                          in_specs=(st, items_spec, rep, rep),
-                          out_specs=rep,
-                          check_vma=False)
-        )
-
     return {
         "prep": jax.jit(
             jax.shard_map(prep_body, mesh=mesh,
@@ -164,8 +138,40 @@ def _spade_fns(mesh: Optional[Mesh], pallas_key):
             jax.shard_map(recompute_body, mesh=mesh,
                           in_specs=(st, rep, rep, rep, rep), out_specs=st),
             donate_argnums=0),
-        "pallas_supports": pallas_supports,
     }
+
+
+@functools.lru_cache(maxsize=64)
+def _pallas_supports_fn(mesh: Mesh, n_items: int, s_block: int,
+                        multiword: bool, interpret: bool):
+    """Cached mesh launcher for the Pallas pair-support kernel.  Keyed
+    separately from :func:`_spade_fns` because it varies with the DB
+    geometry (item-row count, seq block, word count) while the other four
+    kernels do not — bundling the keys would re-jit those four on every
+    new dataset alphabet."""
+    def pallas_supports_body(pt, items, pref, item):
+        # Per-shard pair-support kernel launch; psum the extracted
+        # candidate supports over ICI (same contract as supports_body).
+        sup = PS.batch_supports(
+            pt, items, n_items, pref, item,
+            items_kernel_layout=multiword, s_block=s_block,
+            interpret=interpret)
+        return jax.lax.psum(sup, SEQ_AXIS)
+
+    st = P(None, SEQ_AXIS, None)
+    rep = P()
+    items_spec = P(None, None, SEQ_AXIS) if multiword else st
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-
+    # axes annotation and the vma validator rejects it on EVERY real-TPU
+    # lowering (interpret mode, which the CPU tests use, skips the check
+    # — which is how a check_vma=True version once passed tests yet
+    # silently knocked the whole mesh path onto the jnp fallback on
+    # hardware).
+    return jax.jit(
+        jax.shard_map(pallas_supports_body, mesh=mesh,
+                      in_specs=(st, items_spec, rep, rep),
+                      out_specs=rep,
+                      check_vma=False))
 
 
 class SpadeTPU:
@@ -283,16 +289,16 @@ class SpadeTPU:
     def _build_fns(self) -> None:
         # Jitted callables are shared across engine instances (the service
         # builds one engine per /train): see _spade_fns.
-        pallas_key = None
-        if self.mesh is not None and self.use_pallas:
-            pallas_key = (self.n_items, self._s_block, self.n_words > 1,
-                          self._pallas_interpret)
-        fns = _spade_fns(self.mesh, pallas_key)
+        fns = _spade_fns(self.mesh)
         self._prep_fn = fns["prep"]
         self._supports_fn = fns["supports"]
         self._materialize_fn = fns["materialize"]
         self._recompute_fn = fns["recompute"]
-        self._pallas_supports_fn = fns["pallas_supports"]
+        self._pallas_supports_fn = None
+        if self.mesh is not None and self.use_pallas:
+            self._pallas_supports_fn = _pallas_supports_fn(
+                self.mesh, self.n_items, self._s_block, self.n_words > 1,
+                self._pallas_interpret)
 
     # ------------------------------------------------------------ slot mgmt
 

@@ -205,6 +205,9 @@ def main() -> None:
         cfg.service.remote_port = args.remote_port
     cfgmod.set_config(cfg)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
+    from spark_fsm_tpu.utils.jitcache import enable_compile_cache
+
+    enable_compile_cache()  # persistent XLA cache across service restarts
     if cfg.distributed.enabled:
         # Must run before anything touches the XLA backend: wires this
         # process into the multi-host runtime (SURVEY.md sec 2.2 DCN row).

@@ -1,0 +1,53 @@
+"""Persistent XLA compilation cache wiring.
+
+Every process that mines pays the full XLA compile bill for the kernel
+chain (~10-30s on a v5e; the Mosaic pair-support kernel dominates) even
+though the compiled artifacts are byte-stable across runs.  JAX ships a
+persistent on-disk compilation cache that turns those into millisecond
+deserializations; this module enables it with sane defaults for every
+entry point (service boot, bench harnesses, tests).
+
+The reference has no analog — JVM warmup played the same role and was
+equally re-paid per process — so this is purely a TPU-native cold-start
+win (the driver's recorded ``cold_wall_s`` is mostly compile time).
+
+Env knobs: ``SPARKFSM_COMPILE_CACHE=0`` disables; ``SPARKFSM_COMPILE_CACHE_DIR``
+overrides the location (default ``~/.cache/spark_fsm_tpu/xla``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` (or the default
+    location).  Returns the directory in use, or None when disabled or
+    unsupported.  Safe to call multiple times / before or after backend
+    init; never raises (a broken cache must not take down a mine)."""
+    if os.environ.get("SPARKFSM_COMPILE_CACHE") == "0":
+        return None
+    path = (path
+            or os.environ.get("SPARKFSM_COMPILE_CACHE_DIR")
+            or os.path.join(os.path.expanduser("~"), ".cache",
+                            "spark_fsm_tpu", "xla"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # default min-compile-time gate (1s) would skip most of the small
+        # per-shape kernels whose count is exactly what hurts cold starts
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass  # knob renamed/absent on some versions; cache still works
+        return path
+    except Exception as exc:
+        logging.getLogger(__name__).warning(
+            "persistent compile cache disabled (%s: %s) — every process "
+            "will re-pay full XLA compile time", type(exc).__name__, exc)
+        return None

@@ -23,3 +23,7 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+from spark_fsm_tpu.utils.jitcache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()  # persistent XLA cache: repeat suite runs skip compiles
