@@ -135,22 +135,34 @@ def main() -> None:
         batches = [
             db5[i * per: (i + 1) * per if i < n_batches - 1 else len(db5)]
             for i in range(n_batches)]  # remainder rides the last batch
-        wm = WindowMiner(0.02, max_batches=3)
         stream_parity = True
-        wall = 0.0
-        for batch in batches:
-            t0 = time.perf_counter()
-            got = wm.push(batch)
-            wall += time.perf_counter() - t0  # pushes only — the per-window
-            window_db = wm.window.sequences()  # oracle mines are the CHECK,
-            want = mine_spade(window_db, wm.minsup_abs())  # not the workload
-            stream_parity &= patterns_text(got) == patterns_text(want)
+
+        def run_stream(check_parity):
+            nonlocal stream_parity
+            wm = WindowMiner(0.02, max_batches=3)
+            wall = 0.0
+            for batch in batches:
+                t0 = time.perf_counter()
+                got = wm.push(batch)
+                wall += time.perf_counter() - t0  # pushes only — the
+                if check_parity:  # per-window oracle mines are the CHECK,
+                    window_db = wm.window.sequences()  # not the workload
+                    want = mine_spade(window_db, wm.minsup_abs())
+                    stream_parity &= patterns_text(got) == patterns_text(want)
+            return wm, wall
+
+        # same cold/warm split as configs 1-4: the first pass pays the
+        # window-shape compiles, the second (fresh miner, same shapes)
+        # measures steady-state push cost
+        wm, cold = run_stream(check_parity=True)
+        wm, wall = run_stream(check_parity=False)
         row = {
             "config": 5,
             "metric": (f"streaming SPADE sliding-window({n_batches} "
                        f"micro-batches, keep 3) minsup=2%"),
             "results": len(wm.patterns),
             "wall_s": round(wall, 3),
+            "cold_wall_s": round(cold, 3),
             "pushes": wm.stats["pushes"],
             "parity": stream_parity,  # every window state vs fresh oracle
             "platform": platform,

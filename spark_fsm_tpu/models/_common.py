@@ -100,7 +100,7 @@ def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh):
 
 
 def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
-                        mesh=None, put=None):
+                        mesh=None, put=None, bucket_tokens: bool = False):
     """Scatter-build a ``[n_rows, n_seq, n_words]`` uint32 bitmap store IN
     HBM from the vertical DB's token table (SURVEY.md sec 2.3 step 1 as a
     device kernel) — the dense store never exists on host or crosses the
@@ -114,12 +114,22 @@ def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
     passes its global-replicate put; default jnp.asarray).
     """
     import jax.numpy as jnp
+    import numpy as np
 
     build = _store_builder(n_rows, n_seq, n_words, mesh)
     if put is None:
         put = jnp.asarray
-    return build(put(vdb.tok_item), put(vdb.tok_seq),
-                 put(vdb.tok_word), put(vdb.tok_mask))
+    ti, ts, tw, tm = vdb.tok_item, vdb.tok_seq, vdb.tok_word, vdb.tok_mask
+    if bucket_tokens:
+        # pad the token arrays to a power of two so streaming windows with
+        # drifting token counts reuse the compiled scatter (pad tokens have
+        # mask 0 — adding 0 to row 0 is a no-op)
+        cap = next_pow2(max(1, len(ti)))
+        pad = cap - len(ti)
+        if pad:
+            z = ((0, pad),)
+            ti, ts, tw, tm = (np.pad(a, z) for a in (ti, ts, tw, tm))
+    return build(put(ti), put(ts), put(tw), put(tm))
 
 
 @functools.lru_cache(maxsize=64)

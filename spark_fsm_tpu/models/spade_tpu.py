@@ -142,6 +142,18 @@ def _spade_fns(mesh: Optional[Mesh]):
 
 
 @functools.lru_cache(maxsize=64)
+def _items_transpose(mesh: Optional[Mesh], ni: int):
+    """Cached jitted item-row transpose ([row, seq, word] -> kernel layout
+    [row, word, seq]) for the multiword Pallas path — once per mine, so a
+    per-instance jit would recompile it per engine construction."""
+    tr = lambda s: jnp.transpose(s[:ni], (0, 2, 1))
+    if mesh is None:
+        return jax.jit(tr)
+    return jax.jit(tr, out_shardings=NamedSharding(
+        mesh, P(None, None, SEQ_AXIS)))
+
+
+@functools.lru_cache(maxsize=64)
 def _pallas_supports_fn(mesh: Mesh, n_items: int, s_block: int,
                         multiword: bool, interpret: bool):
     """Cached mesh launcher for the Pallas pair-support kernel.  Keyed
@@ -204,6 +216,7 @@ class SpadeTPU:
         pool_bytes: int = 2 << 30,
         max_pattern_itemsets: Optional[int] = None,
         use_pallas="auto",
+        shape_buckets: bool = False,
     ):
         self.vdb = vdb
         self.minsup = int(minsup_abs)
@@ -233,11 +246,26 @@ class SpadeTPU:
         # (floor 128 lanes) for small databases so padding stays bounded by
         # the lane width, not by devices * 4096.
         n_shards = 1 if mesh is None else mesh.devices.size
+        # shape_buckets: round the device shapes up to powers of two so a
+        # stream of engines over growing/sliding windows (streaming/window.py
+        # re-mines per micro-batch) lands on a handful of compiled shapes
+        # instead of recompiling the whole kernel chain per window size.
+        # Trades bounded padding (<2x seq axis / store rows) for shape reuse;
+        # padded sequences are all-zero bitmaps and count nothing.
+        self._shape_buckets = bool(shape_buckets)
+        if self._shape_buckets:
+            n_seq = max(128, next_pow2(n_seq))
         self._s_block = min(PS.seq_block(n_words),
                             pad_to_multiple(-(-n_seq // n_shards), 128))
         mult = n_shards * self._s_block if self.use_pallas else n_shards
         n_seq = pad_to_multiple(n_seq, mult)
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
+        # the pair kernel's static item-row arg, pre-rounded to its I_TILE:
+        # passing raw n_items would recompile the kernel for every distinct
+        # alphabet size even though the lowered grid only changes per tile
+        # of 128 (matters for streaming, where the frequent-item projection
+        # drifts a little every window)
+        self._ni_tile = pad_to_multiple(max(n_items, 1), PS.I_TILE)
 
         # HBM budget covers the slot pool PLUS the in-flight prep tensors
         # (each pipelined batch holds a [2*node_batch, S, W] prep), and
@@ -252,15 +280,32 @@ class SpadeTPU:
         d = self.pipeline_depth
         nb = max(1, min(int(node_batch), budget_slots // (3 * (d + 2))))
         pool_slots = max(8, budget_slots - 2 * d * nb)
+        total = n_items + pool_slots + 1
+        floor_rows = n_items + 8 + 1  # min rows: items + minimal pool + scratch
+        if self.use_pallas:  # pair kernel reads item rows rounded to I_TILE
+            floor_rows = max(floor_rows, self._ni_tile)
+            total = max(total, self._ni_tile)
+        if self._shape_buckets:
+            # Round the store row count up too and hand the extra rows to
+            # the pool (pool SIZE is host-only state; only the row COUNT is
+            # a device shape).  Rounding UP can overshoot the pool_bytes
+            # budget by up to 2x, so when it does — and a pow2 below still
+            # fits the items + a minimal pool — round DOWN instead and
+            # re-clamp node_batch to keep the recompute-starvation
+            # invariant (nb <= pool // (3*(d+2))).
+            total = next_pow2(total)
+            budget_rows = n_items + 1 + budget_slots
+            if total > budget_rows and total // 2 >= floor_rows:
+                total //= 2
+            pool_slots = total - n_items - 1
+            nb = max(1, min(nb, pool_slots // (3 * (d + 2))))
         self.pool_slots = pool_slots
         self.node_batch = nb
         self.scratch = n_items + pool_slots
-        total = n_items + pool_slots + 1
-        if self.use_pallas:  # pair kernel reads item rows rounded to I_TILE
-            total = max(total, pad_to_multiple(n_items, PS.I_TILE))
 
         self.store = scatter_build_store(vdb, total, n_seq, n_words,
-                                         mesh=mesh, put=self._put)
+                                         mesh=mesh, put=self._put,
+                                         bucket_tokens=self._shape_buckets)
 
         # Multiword Pallas: the kernel wants [row, word, seq] layout, and
         # transposing the store per call would copy it — so transpose the
@@ -268,13 +313,7 @@ class SpadeTPU:
         # layouts are the same bytes there; see ops/pallas_support.py).
         self._items_t = None
         if self.use_pallas and n_words > 1:
-            ni = pad_to_multiple(n_items, PS.I_TILE)
-            tr = lambda s: jnp.transpose(s[:ni], (0, 2, 1))
-            if mesh is None:
-                self._items_t = jax.jit(tr)(self.store)
-            else:
-                self._items_t = jax.jit(tr, out_shardings=NamedSharding(
-                    mesh, P(None, None, SEQ_AXIS)))(self.store)
+            self._items_t = _items_transpose(mesh, self._ni_tile)(self.store)
         self._pool = SlotPool(range(n_items, n_items + pool_slots))
         self._build_fns()
 
@@ -297,7 +336,7 @@ class SpadeTPU:
         self._pallas_supports_fn = None
         if self.mesh is not None and self.use_pallas:
             self._pallas_supports_fn = _pallas_supports_fn(
-                self.mesh, self.n_items, self._s_block, self.n_words > 1,
+                self.mesh, self._ni_tile, self._s_block, self.n_words > 1,
                 self._pallas_interpret)
 
     # ------------------------------------------------------------ slot mgmt
@@ -363,7 +402,7 @@ class SpadeTPU:
             try:
                 if self.mesh is None:
                     sup = PS.batch_supports(
-                        prep, items, self.n_items,
+                        prep, items, self._ni_tile,
                         jnp.asarray(pref), jnp.asarray(itm),
                         items_kernel_layout=self._items_t is not None,
                         s_block=self._s_block,

@@ -126,7 +126,10 @@ MineFn = Callable[[SequenceDB, int], List[PatternResult]]
 def _default_mine(db: SequenceDB, minsup: int) -> List[PatternResult]:
     from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
 
-    return mine_spade_tpu(db, minsup)
+    # shape_buckets: window sizes drift every push; pow2-bucketed device
+    # shapes let consecutive re-mines reuse compiled kernels instead of
+    # recompiling per window geometry (the dominant streaming cost).
+    return mine_spade_tpu(db, minsup, shape_buckets=True)
 
 
 class WindowMiner:

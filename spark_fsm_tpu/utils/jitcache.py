@@ -39,12 +39,15 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
 
         jax.config.update("jax_compilation_cache_dir", path)
         # default min-compile-time gate (1s) would skip most of the small
-        # per-shape kernels whose count is exactly what hurts cold starts
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-        try:
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        except Exception:
-            pass  # knob renamed/absent on some versions; cache still works
+        # per-shape kernels whose count is exactly what hurts cold starts;
+        # each tuning knob is individually guarded — a renamed/absent knob
+        # must not disable the cache dir that already took effect
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.2),
+                          ("jax_persistent_cache_min_entry_size_bytes", 0)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:
+                pass  # knob renamed/absent on some versions; cache works
         return path
     except Exception as exc:
         logging.getLogger(__name__).warning(
