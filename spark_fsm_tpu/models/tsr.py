@@ -50,7 +50,36 @@ from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
 
 def conf_ok(sup: int, supx: int, minconf: float) -> bool:
     """Exact confidence test: sup/supx >= minconf (no float division)."""
-    return supx > 0 and Fraction(sup, supx) >= Fraction(str(minconf))
+    num, den = _conf_frac(minconf)
+    return supx > 0 and sup * den >= supx * num
+
+
+def _auto_eval_budget(dev) -> int:
+    """Per-device eval budget: 95% of the backend-reported HBM limit, or a
+    conservative per-generation table when the backend reports none (the
+    tunneled-PJRT case), or 4 GiB on unknown hardware/CPU."""
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        pass
+    limit = (stats or {}).get("bytes_limit")
+    if limit:
+        return int(limit * 0.95)
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, gib in (("v5 lite", 15), ("v5e", 15), ("v5p", 90),
+                     ("v6", 30), ("v4", 30), ("v3", 15), ("v2", 7)):
+        if key in kind:
+            return gib << 30
+    return 4 << 30
+
+
+@functools.lru_cache(maxsize=64)
+def _conf_frac(minconf: float) -> Tuple[int, int]:
+    """minconf as an exact (numerator, denominator) for the hot-loop
+    integer cross-multiply form of ``conf_ok``."""
+    f = Fraction(str(minconf))
+    return f.numerator, f.denominator
 
 
 # ---------------------------------------------------------------------------
@@ -125,33 +154,41 @@ def _prep_fn_mesh(mesh: Mesh):
 
 @functools.lru_cache(maxsize=256)
 def _eval_kernel(mesh: Optional[Mesh], kmax: int):
-    """Jitted rule evaluator for side sizes <= kmax (bucketed compile)."""
+    """Jitted rule evaluator for side sizes <= kmax (bucketed compile).
+
+    Candidates arrive PACKED as one [chunk, 2, kmax] int32 array (row 0 = X
+    item indices, row 1 = Y, -1 = unused slot) and results leave as one
+    [2, chunk] stack — a single host->device transfer and a single
+    device->host readback per launch.  On a tunneled TPU each transfer
+    costs tens of ms of pure latency, so the 4-upload/2-readback layout
+    this replaces paid ~6x the fixed cost per launch.
+    """
     FULL = jnp.uint32(0xFFFFFFFF)
 
-    def fold(t, idx, valid):
+    def fold(t, idx):
         acc = None
         for j in range(kmax):
-            g = jnp.where(valid[:, j, None, None], t[idx[:, j]], FULL)
+            i = idx[:, j]
+            g = jnp.where((i >= 0)[:, None, None], t[jnp.maximum(i, 0)], FULL)
             acc = g if acc is None else acc & g
         return acc
 
-    def body(p1, s1, xs, xv, ys, yv):
-        a = fold(p1, xs, xv)
-        c = fold(s1, ys, yv)
+    def body(p1, s1, xy):
+        a = fold(p1, xy[:, 0])
+        c = fold(s1, xy[:, 1])
         sup = B.support(B.shift_up_one(a) & c)
         supx = B.support(a)
         if mesh is not None:
             sup = jax.lax.psum(sup, SEQ_AXIS)
             supx = jax.lax.psum(supx, SEQ_AXIS)
-        return sup, supx
+        return jnp.stack([sup, supx])
 
     if mesh is None:
         return jax.jit(body)
     st = P(None, SEQ_AXIS, None)
     rep = P()
     return jax.jit(jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(st, st, rep, rep, rep, rep), out_specs=(rep, rep)))
+        body, mesh=mesh, in_specs=(st, st, rep), out_specs=rep))
 
 
 class TsrTPU:
@@ -166,6 +203,10 @@ class TsrTPU:
       max_side: optional cap on |X| and |Y|.
     """
 
+    # batches kept in flight by the mine loop; the device dispatch is
+    # async so depth 2 hides the readback latency behind the next launch
+    PIPELINE_DEPTH = 2
+
     def __init__(
         self,
         vdb: VerticalDB,
@@ -176,7 +217,7 @@ class TsrTPU:
         chunk: Optional[int] = None,
         item_cap: int = 256,
         max_side: Optional[int] = None,
-        eval_budget_bytes: int = 4 << 30,
+        eval_budget_bytes: Optional[int] = None,
     ):
         self.vdb = vdb
         self.k = int(k)
@@ -199,18 +240,22 @@ class TsrTPU:
             self.n_seq = pad_to_multiple(self.n_seq, mesh.devices.size)
         self.n_words = vdb.n_words
 
-        if chunk is None:
-            # Per-launch dispatch latency dominates on remote/tunneled TPUs
-            # (~100ms+ each; measured 6x wall-clock win going 256 -> 8192
-            # on a Kosarak-shaped mine), so make launches as WIDE as the
-            # per-device eval budget allows: the evaluator keeps ~4 live
-            # [chunk, S_local, W] uint32 intermediates.  Pow2 so the eval
-            # fn's compiled shapes stay bucketed.
-            s_local = self.n_seq // (1 if mesh is None else mesh.devices.size)
-            per_cand = max(1, s_local * self.n_words * 4 * 4)
-            chunk = max(128, min(8192,
-                                 next_pow2(eval_budget_bytes // per_cand + 1) // 2))
-        self.chunk = int(chunk)
+        # Per-launch dispatch latency dominates on remote/tunneled TPUs
+        # (~100ms+ each; measured 6x wall-clock win going 256 -> 8192 on a
+        # Kosarak-shaped mine), so launches are as WIDE as the per-device
+        # eval budget allows.  The budget-derived chunk is computed per
+        # deepening round (the prep store grows with m); a caller-supplied
+        # chunk pins it.  Empirically the evaluator keeps ~4 live
+        # [chunk, S_local, W] uint32 gather temps (verified against the
+        # XLA OOM report on v5e: 16384-cand launch = 24G of temps).
+        # chunk <= 0 (e.g. tsr_chunk = 0 in a config file) = adaptive sizing
+        self._chunk_user = None if not chunk or chunk <= 0 else int(chunk)
+        # None = resolve lazily in _round_chunk: probing the device budget
+        # initializes the JAX backend, which must not happen for engines
+        # that never need it (pinned chunk, or the NumPy TsrCPU subclass)
+        self._eval_budget = (None if eval_budget_bytes is None
+                             else int(eval_budget_bytes))
+        self.chunk = self._chunk_user or 8192
         # tok_item is nondecreasing (build_vertical emits tokens sorted by
         # item), so per-item token ranges are a searchsorted away
         self._tok_starts = np.searchsorted(
@@ -293,8 +338,31 @@ class TsrTPU:
     def _eval_fn(self, kmax: int):
         return _eval_kernel(self.mesh, kmax)
 
-    def _evaluate(self, p1, s1, cands: List[Tuple[Tuple[int, ...], Tuple[int, ...]]]):
-        """Batch-evaluate (sup, supx) for candidate rules (local item idx)."""
+    def _round_chunk(self, m: int) -> int:
+        """Launch width for a deepening round over m items: what the eval
+        budget allows after the round's [m, S, W] prefix/suffix stores,
+        assuming ~4 live [chunk, S_local, W] uint32 gather temps (the
+        XLA-verified factor), floored to a power of two for shape
+        bucketing."""
+        if self._chunk_user is not None:
+            return self._chunk_user
+        if self._eval_budget is None:
+            dev = (self.mesh.devices.flat[0] if self.mesh is not None
+                   else jax.devices()[0])
+            self._eval_budget = _auto_eval_budget(dev)
+        n_dev = 1 if self.mesh is None else self.mesh.devices.size
+        s_local = max(1, self.n_seq // n_dev)
+        per_cand = max(1, s_local * self.n_words * 4 * 4)
+        prep = 2 * m * s_local * self.n_words * 4
+        budget = max(per_cand, self._eval_budget - prep)
+        return max(128, min(8192, next_pow2(budget // per_cand + 1) // 2))
+
+    def _dispatch_eval(self, p1, s1,
+                       cands: List[Tuple[Tuple[int, ...], Tuple[int, ...]]]):
+        """Launch (sup, supx) evaluation for candidate rules (local item
+        idx); returns a device handle with the host copy already in
+        flight.  ``_resolve_eval`` blocks on it — the split lets the mine
+        loop pipeline the next dispatch behind the current readback."""
         n = len(cands)
         kmax = 1
         for x, y in cands:
@@ -304,34 +372,32 @@ class TsrTPU:
             km *= 2
         fn = self._eval_fn(km)
         c = self.chunk
-        sup_parts = []; supx_parts = []
+        parts = []
         for lo in range(0, n, c):
             hi = min(lo + c, n)
-            xs = np.zeros((c, km), np.int32); xv = np.zeros((c, km), bool)
-            ys = np.zeros((c, km), np.int32); yv = np.zeros((c, km), bool)
+            xy = np.full((c, 2, km), -1, np.int32)
             for r, (x, y) in enumerate(cands[lo:hi]):
-                xs[r, :len(x)] = x; xv[r, :len(x)] = True
-                ys[r, :len(y)] = y; yv[r, :len(y)] = True
-            sup, supx = fn(p1, s1, self._put(xs), self._put(xv),
-                           self._put(ys), self._put(yv))
-            sup_parts.append(sup); supx_parts.append(supx)
+                xy[r, 0, :len(x)] = x
+                xy[r, 1, :len(y)] = y
+            parts.append(fn(p1, s1, self._put(xy)))
             self.stats["kernel_launches"] += 1
         self.stats["evaluated"] += n
-        # One device->host readback for the whole candidate list (latency
-        # on remote TPUs dwarfs the transfer itself).
-        sup_all = sup_parts[0] if len(sup_parts) == 1 else jnp.concatenate(sup_parts)
-        supx_all = supx_parts[0] if len(supx_parts) == 1 else jnp.concatenate(supx_parts)
+        out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         try:
-            sup_all.copy_to_host_async(); supx_all.copy_to_host_async()
+            out.copy_to_host_async()
         except (AttributeError, NotImplementedError):
             pass  # method unavailable on this backend
-        return (np.asarray(sup_all)[:n].astype(np.int64),
-                np.asarray(supx_all)[:n].astype(np.int64))
+        return out
+
+    def _resolve_eval(self, handle, n: int):
+        arr = np.asarray(handle)
+        return arr[0, :n].astype(np.int64), arr[1, :n].astype(np.int64)
 
     # ---------------------------------------------------------------- mine
 
     def _mine_restricted(self, m: int) -> Tuple[List[RuleResult], int]:
         """Full search over the top-m items; returns (results, s_k)."""
+        self.chunk = self._round_chunk(m)
         sup_it = self._sup_sorted[:m].astype(np.int64)
         p1, s1 = self._prep(m)
         ids = self.vdb.item_ids[self._order[:m]]
@@ -345,32 +411,65 @@ class TsrTPU:
                 return 1
             return sup_sorted[-self.k]
 
-        # queue: (-bound, seq#, X, Y, can_right); X/Y are local index tuples
-        counter = itertools.count()
-        queue: List[Tuple[int, int, Tuple[int, ...], Tuple[int, ...], bool]] = []
-        for i in range(m):
-            for j in range(m):
-                if i != j:
-                    bound = int(min(sup_it[i], sup_it[j]))
-                    heapq.heappush(queue, (-bound, next(counter), (i,), (j,), True))
+        # queue: (-bound, X, Y, can_right); X/Y are local index tuples.
+        # No tie-break counter: entries are totally ordered by the tuples
+        # themselves, and the FINAL rule set is pop-order independent (the
+        # end-of-round s_k filter is exact), so tie order is free to vary.
+        sup_l = sup_it.tolist()  # python ints: no np-scalar overhead below
+        queue: List[Tuple[int, Tuple[int, ...], Tuple[int, ...], bool]] = [
+            (-(sup_l[j] if sup_l[j] < sup_l[i] else sup_l[i]), (i,), (j,), True)
+            for i in range(m) for j in range(m) if i != j]
+        heapq.heapify(queue)
 
-        while queue:
+        # sup_it is sorted descending, so "items with sup >= minsup" is the
+        # prefix [0, jcut) — the expansion loops stop there instead of
+        # scanning all m items against the sup check.
+        def item_cut() -> int:
+            return int(np.searchsorted(-sup_it, -minsup, side="right"))
+
+        jcut = item_cut()
+
+        def pop_batch():
             batch = []
             while queue and len(batch) < self.chunk:
-                nb, _, x, y, cr = queue[0]
+                nb, x, y, cr = queue[0]
                 if -nb < minsup:
+                    # every remaining entry is bound-pruned (minsup only
+                    # rises; in-flight batches may still push fresh
+                    # above-threshold children afterwards, which is fine)
                     queue.clear()
                     break
                 heapq.heappop(queue)
                 batch.append((x, y, cr))
-            if not batch:
+            return batch
+
+        # Pipeline: keep PIPELINE_DEPTH batches in flight so the blocking
+        # readback of batch i overlaps the device work of batch i+1 and the
+        # host-side heap work below.  Candidates dispatched with a stale
+        # (lower) minsup are wasted work at worst, never wrong — sup/conf
+        # acceptance and the final s_k filter use exact values.
+        inflight: List[Tuple[list, object]] = []
+        while True:
+            while queue and len(inflight) < self.PIPELINE_DEPTH:
+                batch = pop_batch()
+                if not batch:
+                    break
+                handle = self._dispatch_eval(
+                    p1, s1, [(x, y) for x, y, _ in batch])
+                inflight.append((batch, handle))
+            if not inflight:
                 break
-            sups, supxs = self._evaluate(p1, s1, [(x, y) for x, y, _ in batch])
-            for (x, y, can_right), sup, supx in zip(batch, sups, supxs):
-                sup, supx = int(sup), int(supx)
+            batch, handle = inflight.pop(0)
+            sups, supxs = self._resolve_eval(handle, len(batch))
+            # conf test as exact integer cross-multiply (no per-rule
+            # Fraction construction): sup/supx >= num/den
+            num, den = _conf_frac(self.minconf)
+            push = heapq.heappush
+            for (x, y, can_right), sup, supx in zip(
+                    batch, sups.tolist(), supxs.tolist()):
                 if sup < minsup:
                     continue
-                if conf_ok(sup, supx, self.minconf):
+                if supx > 0 and sup * den >= supx * num:
                     results.append((sup, supx, x, y))
                     bisect.insort(sup_sorted, sup)
                     new_t = s_k_threshold()
@@ -378,24 +477,22 @@ class TsrTPU:
                         minsup = new_t
                         results = [r for r in results if r[0] >= minsup]
                         del sup_sorted[: bisect.bisect_left(sup_sorted, minsup)]
-                # expansions (bound = min(sup, sup of added item))
+                        jcut = item_cut()
+                # expansions: bound = min(sup, sup_it[c]) >= minsup needs
+                # sup >= minsup (checked above) and c < jcut
                 used = set(x) | set(y)
                 if self.max_side is None or len(x) < self.max_side:
-                    for c in range(max(x) + 1, m):
-                        if c in used or sup_it[c] < minsup:
-                            continue
-                        bound = int(min(sup, sup_it[c]))
-                        if bound >= minsup:
-                            heapq.heappush(queue, (-bound, next(counter),
-                                                   x + (c,), y, False))
+                    for c in range(max(x) + 1, jcut):
+                        if c not in used:
+                            s_c = sup_l[c]
+                            push(queue, (-(s_c if s_c < sup else sup),
+                                         x + (c,), y, False))
                 if can_right and (self.max_side is None or len(y) < self.max_side):
-                    for c in range(max(y) + 1, m):
-                        if c in used or sup_it[c] < minsup:
-                            continue
-                        bound = int(min(sup, sup_it[c]))
-                        if bound >= minsup:
-                            heapq.heappush(queue, (-bound, next(counter),
-                                                   x, y + (c,), True))
+                    for c in range(max(y) + 1, jcut):
+                        if c not in used:
+                            s_c = sup_l[c]
+                            push(queue, (-(s_c if s_c < sup else sup),
+                                         x, y + (c,), True))
 
         s_k = s_k_threshold()
         # local indices are support-ordered; canonical form sorts by item id
@@ -427,12 +524,19 @@ class TsrCPU(TsrTPU):
     SPADE vs SPADE_TPU).  Shares byte semantics with the device engine via
     ops/bitops_np, so oracle comparisons are exact."""
 
+    PIPELINE_DEPTH = 1  # dispatch is synchronous — nothing to overlap
+
+    def _round_chunk(self, m: int) -> int:
+        # pure-NumPy evaluation: chunk is only the batch granularity of the
+        # host loop — never probe the JAX device budget for it
+        return self._chunk_user or 8192
+
     def _prep(self, m: int):
         assert self.mesh is None, "TsrCPU does not shard; use TsrTPU"
         bm = self._host_bitmaps(m)
         return Bnp.prefix_or_incl(bm), Bnp.suffix_or_incl(bm)
 
-    def _evaluate(self, p1, s1, cands):
+    def _dispatch_eval(self, p1, s1, cands):
         n = len(cands)
         sup = np.empty(n, np.int64)
         supx = np.empty(n, np.int64)
@@ -447,6 +551,9 @@ class TsrCPU(TsrTPU):
             supx[r] = int(Bnp.support(a))
         self.stats["evaluated"] += n
         return sup, supx
+
+    def _resolve_eval(self, handle, n: int):
+        return handle
 
 
 def mine_tsr_tpu(db: SequenceDB, k: int, minconf: float, *,
