@@ -31,8 +31,8 @@ File format (TOML shown; JSON with the same nesting also accepted):
 
     [engine]
     mesh_devices = 8                # 0 = single chip (no mesh)
-    pool_bytes = 2147483648         # HBM slot-pool budget
-    node_batch = 256                # DFS nodes per device dispatch
+    pool_bytes = 2147483648         # HBM slot-pool budget (default: adaptive, 35% of device HBM)
+    node_batch = 256                # DFS nodes per device dispatch (default 1024, clamped to the pool)
     pipeline_depth = 4              # in-flight support readbacks
     chunk = 256                     # SPADE support-count batch width
     recompute_chunk = 256

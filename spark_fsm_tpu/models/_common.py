@@ -64,12 +64,21 @@ def load_checkpoint(checkpoint, fingerprint: dict):
 
 
 @functools.lru_cache(maxsize=128)
-def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh):
+def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh,
+                   flat: bool = False):
     """Cached jitted store-build kernel.  ``jax.jit`` caches traces per
     wrapped-function OBJECT, so handing it a fresh closure per engine
     construction recompiles the scatter build every time — and the service
     builds one engine per /train request.  Keyed on the store geometry and
-    mesh, the compiled kernel is shared by every engine with that shape."""
+    mesh, the compiled kernel is shared by every engine with that shape.
+
+    ``flat=True`` emits the store as ``[n_rows, n_seq * n_words]`` (word
+    minor).  A persistent ``[rows, S, 1]`` array makes XLA's layout
+    assignment copy the ENTIRE store on every jit call that gathers from it
+    (measured: a 6.7 GB temp per prep on the headline workload); the flat
+    layout crosses jit boundaries copy-free and bodies reshape it back to
+    [rows, S, W] internally for the word-wise bit ops.
+    """
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -78,6 +87,9 @@ def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh):
 
     if mesh is None:
         def init_store(ti, ts, tw, tm):
+            if flat:
+                z = jnp.zeros((n_rows, n_seq * n_words), jnp.uint32)
+                return z.at[ti, ts * n_words + tw].add(tm)
             z = jnp.zeros((n_rows, n_seq, n_words), jnp.uint32)
             return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
 
@@ -88,24 +100,31 @@ def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh):
     def init_store_shard(ti, ts, tw, tm):
         ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
         ok = (ls >= 0) & (ls < shard)
+        lc = jnp.clip(ls, 0, shard - 1)
+        tm_ok = jnp.where(ok, tm, jnp.uint32(0))
+        if flat:
+            z = jnp.zeros((n_rows, shard * n_words), jnp.uint32)
+            return z.at[ti, lc * n_words + tw].add(tm_ok)
         z = jnp.zeros((n_rows, shard, n_words), jnp.uint32)
-        return z.at[ti, jnp.clip(ls, 0, shard - 1), tw].add(
-            jnp.where(ok, tm, jnp.uint32(0)))
+        return z.at[ti, lc, tw].add(tm_ok)
 
     rep = P()
+    out = P(None, SEQ_AXIS) if flat else P(None, SEQ_AXIS, None)
     return jax.jit(jax.shard_map(
         init_store_shard, mesh=mesh,
-        in_specs=(rep, rep, rep, rep),
-        out_specs=P(None, SEQ_AXIS, None)))
+        in_specs=(rep, rep, rep, rep), out_specs=out))
 
 
 def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
-                        mesh=None, put=None, bucket_tokens: bool = False):
+                        mesh=None, put=None, bucket_tokens: bool = False,
+                        flat: bool = False):
     """Scatter-build a ``[n_rows, n_seq, n_words]`` uint32 bitmap store IN
     HBM from the vertical DB's token table (SURVEY.md sec 2.3 step 1 as a
     device kernel) — the dense store never exists on host or crosses the
     link.  Item rows land in slots ``tok_item``; rows past the tokens'
-    reach (pattern pool, scratch) start zeroed.
+    reach (pattern pool, scratch) start zeroed.  ``flat=True`` emits
+    ``[n_rows, n_seq * n_words]`` (word minor) instead — see
+    :func:`_store_builder` for why persistent stores should be flat.
 
     With ``mesh``, each device scatters only the tokens whose sequence id
     lands in its seq-axis shard (out-of-shard tokens add a 0 mask — a
@@ -116,7 +135,7 @@ def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
     import jax.numpy as jnp
     import numpy as np
 
-    build = _store_builder(n_rows, n_seq, n_words, mesh)
+    build = _store_builder(n_rows, n_seq, n_words, mesh, flat)
     if put is None:
         put = jnp.asarray
     ti, ts, tw, tm = vdb.tok_item, vdb.tok_seq, vdb.tok_word, vdb.tok_mask
@@ -153,6 +172,38 @@ def next_pow2(n: int) -> int:
     while k < n:
         k *= 2
     return k
+
+
+def auto_pool_bytes(mesh) -> int:
+    """Default engine pool budget: 35% of the device's HBM.  Two engine
+    working sets must be able to coexist (back-to-back mines overlap while
+    the old engine is still referenced; the service can run multi-worker
+    miners), plus kernel temps take their share."""
+    import jax
+
+    dev = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
+    return int(device_hbm_budget(dev) * 0.35)
+
+
+def device_hbm_budget(dev) -> int:
+    """Usable per-device memory for engine working sets: 95% of the
+    backend-reported limit, or a conservative per-generation table when the
+    backend reports none (the tunneled-PJRT case), or 4 GiB on unknown
+    hardware/CPU."""
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        pass
+    limit = (stats or {}).get("bytes_limit")
+    if limit:
+        return int(limit * 0.95)
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, gib in (("v5 lite", 15), ("v5e", 15), ("v5p", 90),
+                     ("v6", 30), ("v4", 30), ("v3", 15), ("v2", 7)):
+        if key in kind:
+            return gib << 30
+    return 4 << 30
 
 
 class SlotPool:

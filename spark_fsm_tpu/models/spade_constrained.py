@@ -38,8 +38,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, decode_frontier, encode_frontier, load_checkpoint, next_pow2,
-    scatter_build_store, zeros_fn)
+    SlotPool, auto_pool_bytes, decode_frontier, encode_frontier,
+    load_checkpoint, next_pow2, scatter_build_store, zeros_fn)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
@@ -144,7 +144,7 @@ class ConstrainedSpadeTPU:
         node_batch: int = 32,
         pipeline_depth: int = 4,
         recompute_chunk: int = 32,
-        pool_bytes: int = 2 << 30,
+        pool_bytes: Optional[int] = None,
         max_pattern_itemsets: Optional[int] = None,
     ):
         self.vdb = vdb
@@ -171,6 +171,8 @@ class ConstrainedSpadeTPU:
         # pool shares HBM with pipeline_depth in-flight (m, pm) preps (2
         # slot-equivalents per node each), and node_batch is bounded so
         # in-flight batches can never starve a recompute.
+        if pool_bytes is None:
+            pool_bytes = auto_pool_bytes(mesh)
         slot_bytes = n_seq * self.n_pos * np.dtype(self.dtype.dtype).itemsize
         budget_slots = max(32, min(int(pool_bytes) // max(slot_bytes, 1), 8192))
         self.pipeline_depth = min(self.pipeline_depth,

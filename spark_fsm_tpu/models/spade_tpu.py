@@ -4,7 +4,10 @@ Architecture (the TPU-native replacement for the reference's JVM miner,
 SURVEY.md sec 3.1 hot loop):
 
 - The vertical DB and all live pattern bitmaps sit in one HBM-resident
-  ``store[slot, seq, word]`` uint32 tensor.  Slots ``0..n_items-1`` are the
+  ``store[slot, seq*word]`` uint32 tensor (word minor; kernels reshape
+  gathered rows to [*, seq, word] internally — a persistent trailing
+  word axis makes XLA's layout assignment copy the whole store on every
+  gather-launch).  Slots ``0..n_items-1`` are the
   item id-lists (never freed); the rest is a pool for pattern bitmaps plus a
   final scratch slot that absorbs padded-lane writes.
 - Host-side DFS pops nodes in batches; every candidate (parent x item x
@@ -49,8 +52,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, decode_frontier, encode_frontier, load_checkpoint, next_pow2,
-    scatter_build_store)
+    SlotPool, auto_pool_bytes, decode_frontier, encode_frontier,
+    load_checkpoint, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel import multihost as MH
@@ -69,7 +72,7 @@ class _Node:
 
 
 @functools.lru_cache(maxsize=64)
-def _spade_fns(mesh: Optional[Mesh]):
+def _spade_fns(mesh: Optional[Mesh], n_words: int):
     """Jitted kernel set shared by every SpadeTPU with the same mesh.
     ``jax.jit`` caches traces per wrapped-function OBJECT, so per-instance
     closures would recompile the whole kernel chain on every engine
@@ -77,24 +80,37 @@ def _spade_fns(mesh: Optional[Mesh]):
     databases.  The Pallas launcher is cached separately
     (:func:`_pallas_supports_fn`) because its key varies per DB geometry
     and must not evict/miss these geometry-independent four.
+
+    The store and the pt tensor cross every jit boundary FLAT
+    ([rows, S*W], word minor): XLA's layout assignment gives a persistent
+    [rows, S, 1] array a pathological tiled layout and inserts a copy of
+    the ENTIRE store into every program that gathers from it (a 6.7 GB
+    temp per call on the headline workload).  Bodies reshape gathered
+    rows back to [*, S, W] for the word-wise bit ops — reshaping the
+    small gathered subset, never the store.
     """
+    W = n_words
+
+    def _rows3(rows2):  # [n, S*W] -> [n, S, W] (free inside the program)
+        return rows2.reshape(rows2.shape[0], -1, W)
+
     # The s-ext transform (~6 word-ops) dominates the AND (1 op), and a
     # node typically has tens of candidates, so gather + transform the
     # popped batch's bitmaps ONCE per batch.  Plain and transformed rows
-    # interleave into ONE [2*Bn, S, W] tensor so each candidate costs a
+    # interleave into ONE [2*Bn, S*W] tensor so each candidate costs a
     # single gathered row (a where(iss, trans[ref], parents[ref]) would
     # gather BOTH branches — 2x HBM traffic on the parent side).
     def prep_body(store, node_slot):
-        parents = store[node_slot]            # [Bn, S, W]
+        parents = _rows3(store[node_slot])    # [Bn, S, W]
         pt = jnp.stack([parents, B.sext_transform(parents)], axis=1)
-        return pt.reshape((-1,) + parents.shape[1:])  # [2*Bn, S, W]
+        return pt.reshape(-1, parents.shape[1] * W)   # [2*Bn, S*W]
 
     def _joined(pt, store, parent_ref, item_slot, iss):
         base = pt[2 * parent_ref + iss.astype(jnp.int32)]
-        return base & store[item_slot]
+        return base & store[item_slot]        # [c, S*W]
 
     def supports_body(pt, store, parent_ref, item_slot, iss):
-        part = B.support(_joined(pt, store, parent_ref, item_slot, iss))
+        part = B.support(_rows3(_joined(pt, store, parent_ref, item_slot, iss)))
         if mesh is not None:
             part = jax.lax.psum(part, SEQ_AXIS)
         return part
@@ -105,13 +121,13 @@ def _spade_fns(mesh: Optional[Mesh]):
 
     def recompute_body(store, step_items, step_iss, step_valid, out_slot):
         # step_* : [K, M]; fold the join chain along K.
-        bmp = store[step_items[0]]
+        bmp = _rows3(store[step_items[0]])
         def body(b, xs):
             it, iss, valid = xs
-            nb = B.join(b, store[it], iss)
+            nb = B.join(b, _rows3(store[it]), iss)
             return jnp.where(valid[:, None, None], nb, b), None
         bmp, _ = jax.lax.scan(body, bmp, (step_items[1:], step_iss[1:], step_valid[1:]))
-        return store.at[out_slot].set(bmp)
+        return store.at[out_slot].set(bmp.reshape(bmp.shape[0], -1))
 
     if mesh is None:
         return {
@@ -121,7 +137,7 @@ def _spade_fns(mesh: Optional[Mesh]):
             "recompute": jax.jit(recompute_body, donate_argnums=0),
         }
 
-    st = P(None, SEQ_AXIS, None)
+    st = P(None, SEQ_AXIS)
     rep = P()
     return {
         "prep": jax.jit(
@@ -142,11 +158,12 @@ def _spade_fns(mesh: Optional[Mesh]):
 
 
 @functools.lru_cache(maxsize=64)
-def _items_transpose(mesh: Optional[Mesh], ni: int):
-    """Cached jitted item-row transpose ([row, seq, word] -> kernel layout
+def _items_transpose(mesh: Optional[Mesh], ni: int, n_words: int):
+    """Cached jitted item-row transpose (flat store rows -> kernel layout
     [row, word, seq]) for the multiword Pallas path — once per mine, so a
     per-instance jit would recompile it per engine construction."""
-    tr = lambda s: jnp.transpose(s[:ni], (0, 2, 1))
+    tr = lambda s: jnp.transpose(
+        s[:ni].reshape(ni, -1, n_words), (0, 2, 1))
     if mesh is None:
         return jax.jit(tr)
     return jax.jit(tr, out_shardings=NamedSharding(
@@ -155,22 +172,24 @@ def _items_transpose(mesh: Optional[Mesh], ni: int):
 
 @functools.lru_cache(maxsize=64)
 def _pallas_supports_fn(mesh: Mesh, n_items: int, s_block: int,
-                        multiword: bool, interpret: bool):
+                        n_words: int, interpret: bool):
     """Cached mesh launcher for the Pallas pair-support kernel.  Keyed
     separately from :func:`_spade_fns` because it varies with the DB
     geometry (item-row count, seq block, word count) while the other four
     kernels do not — bundling the keys would re-jit those four on every
     new dataset alphabet."""
+    multiword = n_words > 1
+
     def pallas_supports_body(pt, items, pref, item):
         # Per-shard pair-support kernel launch; psum the extracted
         # candidate supports over ICI (same contract as supports_body).
         sup = PS.batch_supports(
             pt, items, n_items, pref, item,
             items_kernel_layout=multiword, s_block=s_block,
-            interpret=interpret)
+            interpret=interpret, n_words=n_words)
         return jax.lax.psum(sup, SEQ_AXIS)
 
-    st = P(None, SEQ_AXIS, None)
+    st = P(None, SEQ_AXIS)
     rep = P()
     items_spec = P(None, None, SEQ_AXIS) if multiword else st
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-
@@ -210,10 +229,10 @@ class SpadeTPU:
         *,
         mesh: Optional[Mesh] = None,
         chunk: int = 2048,
-        node_batch: int = 256,
+        node_batch: int = 1024,
         pipeline_depth: int = 4,
         recompute_chunk: int = 256,
-        pool_bytes: int = 2 << 30,
+        pool_bytes: Optional[int] = None,
         max_pattern_itemsets: Optional[int] = None,
         use_pallas="auto",
         shape_buckets: bool = False,
@@ -273,8 +292,13 @@ class SpadeTPU:
         # never starve a recompute: slots held in flight <= depth*nb, so
         # free+stack-reclaimable >= pool - (depth+1)*nb >= nb holds whenever
         # nb <= pool // (depth+2).
+        if pool_bytes is None:
+            # each blocking readback on a tunneled TPU costs ~130ms of
+            # latency, so bigger batches (= fewer DFS sync points) are
+            # worth real memory
+            pool_bytes = auto_pool_bytes(mesh)
         slot_bytes = n_seq * n_words * 4
-        budget_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 16384))
+        budget_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 32768))
         self.pipeline_depth = min(self.pipeline_depth,
                                   max(1, budget_slots // 8))
         d = self.pipeline_depth
@@ -305,7 +329,8 @@ class SpadeTPU:
 
         self.store = scatter_build_store(vdb, total, n_seq, n_words,
                                          mesh=mesh, put=self._put,
-                                         bucket_tokens=self._shape_buckets)
+                                         bucket_tokens=self._shape_buckets,
+                                         flat=True)
 
         # Multiword Pallas: the kernel wants [row, word, seq] layout, and
         # transposing the store per call would copy it — so transpose the
@@ -313,7 +338,8 @@ class SpadeTPU:
         # layouts are the same bytes there; see ops/pallas_support.py).
         self._items_t = None
         if self.use_pallas and n_words > 1:
-            self._items_t = _items_transpose(mesh, self._ni_tile)(self.store)
+            self._items_t = _items_transpose(mesh, self._ni_tile,
+                                             n_words)(self.store)
         self._pool = SlotPool(range(n_items, n_items + pool_slots))
         self._build_fns()
 
@@ -328,7 +354,7 @@ class SpadeTPU:
     def _build_fns(self) -> None:
         # Jitted callables are shared across engine instances (the service
         # builds one engine per /train): see _spade_fns.
-        fns = _spade_fns(self.mesh)
+        fns = _spade_fns(self.mesh, self.n_words)
         self._prep_fn = fns["prep"]
         self._supports_fn = fns["supports"]
         self._materialize_fn = fns["materialize"]
@@ -336,7 +362,7 @@ class SpadeTPU:
         self._pallas_supports_fn = None
         if self.mesh is not None and self.use_pallas:
             self._pallas_supports_fn = _pallas_supports_fn(
-                self.mesh, self._ni_tile, self._s_block, self.n_words > 1,
+                self.mesh, self._ni_tile, self._s_block, self.n_words,
                 self._pallas_interpret)
 
     # ------------------------------------------------------------ slot mgmt
@@ -353,7 +379,7 @@ class SpadeTPU:
     def _prep(self, batch: List[_Node]):
         """Gather + s-ext-transform the popped batch's bitmaps, once.
 
-        Returns the interleaved [2*Bn, S, W] plain/transformed tensor; row
+        Returns the interleaved [2*Bn, S*W] plain/transformed tensor; row
         ``2*b`` is node b's bitmap, row ``2*b+1`` its s-ext transform.
         """
         slots = np.zeros(self.node_batch, np.int32)
@@ -406,7 +432,8 @@ class SpadeTPU:
                         jnp.asarray(pref), jnp.asarray(itm),
                         items_kernel_layout=self._items_t is not None,
                         s_block=self._s_block,
-                        interpret=self._pallas_interpret)
+                        interpret=self._pallas_interpret,
+                        n_words=self.n_words)
                 else:
                     sup = self._pallas_supports_fn(
                         prep, items, self._put(pref), self._put(itm))

@@ -40,7 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
-from spark_fsm_tpu.models._common import next_pow2
+from spark_fsm_tpu.models._common import device_hbm_budget, next_pow2
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.parallel import multihost as MH
@@ -54,24 +54,7 @@ def conf_ok(sup: int, supx: int, minconf: float) -> bool:
     return supx > 0 and sup * den >= supx * num
 
 
-def _auto_eval_budget(dev) -> int:
-    """Per-device eval budget: 95% of the backend-reported HBM limit, or a
-    conservative per-generation table when the backend reports none (the
-    tunneled-PJRT case), or 4 GiB on unknown hardware/CPU."""
-    stats = None
-    try:
-        stats = dev.memory_stats()
-    except Exception:
-        pass
-    limit = (stats or {}).get("bytes_limit")
-    if limit:
-        return int(limit * 0.95)
-    kind = getattr(dev, "device_kind", "").lower()
-    for key, gib in (("v5 lite", 15), ("v5e", 15), ("v5p", 90),
-                     ("v6", 30), ("v4", 30), ("v3", 15), ("v2", 7)):
-        if key in kind:
-            return gib << 30
-    return 4 << 30
+_auto_eval_budget = device_hbm_budget  # shared with the SPADE engines
 
 
 @functools.lru_cache(maxsize=64)

@@ -147,11 +147,12 @@ def pair_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "n_item_rows", "items_kernel_layout", "s_block", "interpret"))
+    "n_item_rows", "items_kernel_layout", "s_block", "interpret", "n_words"))
 def batch_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
                    pref: jax.Array, item: jax.Array,
                    *, items_kernel_layout: bool = False,
-                   s_block: int = S_BLOCK, interpret: bool = False) -> jax.Array:
+                   s_block: int = S_BLOCK, interpret: bool = False,
+                   n_words: int = 1) -> jax.Array:
     """Pair matrix + on-device candidate extraction in one dispatch.
 
     ``pref``/``item`` index (parent-or-transform row, item row) per
@@ -159,18 +160,20 @@ def batch_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
     keeps the host readback at 4 bytes/candidate instead of the full
     matrix.
 
-    ``pt`` arrives in the engine's native [P, S, W] layout (or [P, S]) and
-    is transposed here, inside jit — a free reshape when W == 1, a small
+    ``pt`` arrives in the engine's native [P, S, W] layout or FLAT
+    [P, S*W] (word minor; ``n_words`` splits it — the engine keeps its
+    store flat across jit boundaries to avoid XLA layout copies) and is
+    transposed here, inside jit — a free reshape when W == 1, a small
     per-batch copy otherwise.  ``items`` is the engine store ([T, S, W] /
-    [T, S], W == 1: free reshape) or, with ``items_kernel_layout=True``,
-    a pre-transposed [T, W, S] item block (W > 1: transposing the full
+    flat, same rule) or, with ``items_kernel_layout=True``, a
+    pre-transposed [T, W, S] item block (W > 1: transposing the full
     store per call would copy it, so the engine does it once per mine).
     """
     if pt.ndim == 2:
-        pt = pt[:, :, None]
+        pt = pt.reshape(pt.shape[0], -1, n_words)
     pt = jnp.transpose(pt, (0, 2, 1))               # [P, W, S]
     if items.ndim == 2:
-        items = items[:, :, None]
+        items = items.reshape(items.shape[0], -1, n_words)
     if not items_kernel_layout:
         items = jnp.transpose(items, (0, 2, 1))     # free iff W == 1
     p = pt.shape[0]
