@@ -179,8 +179,11 @@ def main() -> None:
             "note": ("suite runs at reduced scale; per-launch host<->device "
                      "latency dominates at the smallest config and the "
                      "device engine's win grows with DB size (headline "
-                     "full-size workload: see BASELINE.json published, "
-                     "~32x over the oracle)"),
+                     "full-size workload: see BASELINE.json published). "
+                     "cold_wall_s includes XLA compiles whenever the "
+                     "persistent compile cache has no entry for the current "
+                     "kernel shapes — any engine/kernel change recompiles "
+                     "once"),
             "configs": results,
         }
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
