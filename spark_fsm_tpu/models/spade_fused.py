@@ -1,0 +1,400 @@
+"""Fused SPADE: the ENTIRE mine as one device program (single readback).
+
+The classic engine (models/spade_tpu.py) is a host-driven DFS: the host
+pops node batches, dispatches support kernels, reads supports back, prunes,
+and pushes children.  Each DFS "wave" costs one blocking device->host
+readback — ~130ms of pure latency on a tunneled TPU — so a 4-level mine
+pays ~0.5s of latency regardless of how little compute it needs.  That is
+the whole wall-clock for small databases.
+
+This engine instead runs the level-wise BFS INSIDE one ``lax.while_loop``:
+
+- the frontier lives on device as fixed-capacity mask arrays
+  (``s_mask``/``i_mask`` over the dense item axis — the SPAM equivalence-
+  class candidate lists of models/oracle.py, vectorized);
+- each level computes the dense parent x item pair-support matrix (the
+  Pallas kernel on TPU, a blocked jnp reduction elsewhere), prunes by
+  minsup ON DEVICE (minsup is a traced scalar, NOT a compile-time
+  constant — streaming windows re-mine with drifting minsup on one
+  compiled program), emits surviving
+  (parent, item, ext-type, support) records into a device buffer, and
+  compacts surviving children into the next frontier with
+  ``jnp.nonzero(size=...)``;
+- child bitmaps are materialized into a double-buffered slot region
+  (parents of level k and children of level k alternate regions, so slot
+  allocation is just ``base + rank`` — no free-list);
+- the host makes exactly ONE blocking readback at the end: the record
+  buffer, from which it reconstructs the pattern set by following parent
+  links (records are appended level by level, so parents always precede
+  children).
+
+Static caps (frontier width, emissions per level, total records, levels)
+keep every shape compile-time constant.  Any cap overflow sets a flag and
+the caller falls back to the classic engine — capacity never costs
+correctness.  Enumeration is byte-identical to the oracle by construction:
+the masks implement exactly its S/I candidate-list rules
+(SURVEY.md sec 2.3 step 3; oracle.py mine_spade_vertical).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_fsm_tpu.data.vertical import VerticalDB
+from spark_fsm_tpu.models._common import next_pow2, scatter_build_store
+from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.ops import pallas_support as PS
+from spark_fsm_tpu.parallel import multihost as MH
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
+from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
+
+
+def _dense_pair_jnp(pt3: jax.Array, items3: jax.Array, i_tile: int = 128):
+    """[P, S, W] x [NI, S, W] -> [P, NI] support matrix, blocked over item
+    tiles so the [P, tile, S] hit tensor stays bounded.  Non-TPU stand-in
+    for ops/pallas_support.pair_supports (bit-identical counts)."""
+    p_rows, s, w = pt3.shape
+    ni = items3.shape[0]
+    n_tiles = ni // i_tile
+
+    def tile(idx):
+        it = jax.lax.dynamic_slice(items3, (idx * i_tile, 0, 0),
+                                   (i_tile, s, w))
+        hit = jnp.any((pt3[:, None, :, :] & it[None, :, :, :]) != 0, axis=3)
+        return jnp.sum(hit, axis=2, dtype=jnp.int32)      # [P, i_tile]
+
+    out = jax.lax.map(tile, jnp.arange(n_tiles))          # [T, P, i_tile]
+    return jnp.moveaxis(out, 0, 1).reshape(p_rows, ni)
+
+
+def fused_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
+                   caps: Optional["FusedCaps"] = None) -> bool:
+    """Size heuristic for auto-routing: the fused program computes the
+    DENSE [2*f_cap, ni_pad] pair matrix every level (inactive lanes
+    included — shapes are static), so its per-level HBM traffic is
+    ~S*W*4 * 2*f_cap*ni_pad * (1/I_TILE + 1/P_TILE) bytes.  Routing is
+    worth it while that stays well under the ~130ms/wave readback latency
+    the fusion removes (24 GB ~= 30ms on a v5e); beyond that the classic
+    host-driven DFS's exact candidate lists win.  Mesh path: not yet
+    validated on hardware — classic engine."""
+    if mesh is not None:
+        return False
+    caps = caps or FusedCaps()
+    ni_pad = pad_to_multiple(max(vdb.n_items, 1), PS.I_TILE)
+    if ni_pad > 1024:
+        return False
+    est = (vdb.n_sequences * vdb.n_words * 4 * 2 * caps.f_cap * ni_pad
+           * (1 / PS.I_TILE + 1 / PS.P_TILE))
+    return est <= 24 << 30
+
+
+class FusedCaps:
+    """Static capacities of the fused program (compile-time shapes)."""
+
+    def __init__(self, f_cap: int = 1024, c_cap: int = 8192,
+                 r_cap: int = 1 << 16, l_max: int = 128):
+        # f_cap rounded up so 2*f_cap rows tile the Pallas P_TILE (the
+        # kernel asserts P % P_TILE == 0 — a raw odd cap would crash on
+        # TPU instead of overflowing gracefully)
+        self.f_cap = pad_to_multiple(int(f_cap), PS.P_TILE // 2)
+        self.c_cap = int(c_cap)    # emitted records per level
+        self.r_cap = int(r_cap)    # total records (patterns)
+        self.l_max = int(l_max)    # levels (pattern steps)
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
+                   max_its: Optional[int],
+                   f_cap: int, c_cap: int, r_cap: int, l_max: int,
+                   use_pallas: bool, s_block: int, interpret: bool):
+    """Compiled whole-mine program, cached per geometry (see _spade_fns for
+    the per-object jit-cache reasoning).  ``minsup`` is a traced argument,
+    not part of the cache key — streaming windows re-mine with a drifting
+    absolute minsup and must reuse the compile.
+
+    Store rows: [0, ni_pad) item id-lists; two child regions of f_cap rows
+    each (double buffer); last row = scratch (all zeros, read by inactive
+    lanes, written by dropped scatters -> jnp scatter mode='drop').
+    """
+    W = n_words
+    region_a = ni_pad
+    region_b = ni_pad + f_cap
+    scratch = ni_pad + 2 * f_cap
+
+    def pair_matrix(pt_flat, store):
+        # [2F, S*W] x item rows -> [2F, ni_pad] supports
+        pt3 = pt_flat.reshape(pt_flat.shape[0], -1, W)
+        items3 = store[:ni_pad].reshape(ni_pad, -1, W)
+        if use_pallas:
+            return PS.pair_supports(
+                jnp.transpose(pt3, (0, 2, 1)),
+                jnp.transpose(items3, (0, 2, 1)),
+                ni_pad, s_block=s_block, interpret=interpret)
+        return _dense_pair_jnp(pt3, items3)
+
+    def body(carry):
+        (store, slots, s_mask, i_mask, nits, rec_idx,
+         n_nodes, rec_count, records, recsup, overflow, level,
+         minsup, n_cand) = carry
+
+        lane = jnp.arange(f_cap, dtype=jnp.int32)
+        active = lane < n_nodes
+        gslots = jnp.where(active, slots, scratch)
+
+        # prep: gather + s-ext transform, interleaved [2F, S*W]
+        parents = store[gslots].reshape(f_cap, -1, W)
+        pt = jnp.stack([parents, B.sext_transform(parents)], axis=1)
+        pt_flat = pt.reshape(2 * f_cap, -1)
+
+        pair = pair_matrix(pt_flat, store)
+        if mesh is not None:
+            pair = jax.lax.psum(pair, SEQ_AXIS)
+        pair = pair.reshape(f_cap, 2, ni_pad)
+        sup_i = pair[:, 0, :]     # plain & item  = i-extension
+        sup_s = pair[:, 1, :]     # transformed & item = s-extension
+
+        allow_s = active if max_its is None else (active & (nits < max_its))
+        cand_s = s_mask & allow_s[:, None]
+        cand_i = i_mask & active[:, None]
+        n_cand = n_cand + jnp.sum(cand_s, dtype=jnp.int32) + jnp.sum(
+            cand_i, dtype=jnp.int32)
+        surv_s = cand_s & (sup_s >= minsup)
+        surv_i = cand_i & (sup_i >= minsup)
+
+        # ---- emission: records for every surviving candidate ----
+        # flat order: (node, ext-type: s then i, item) — any fixed order
+        # works, the pattern SET is canonicalized on host.
+        flat = jnp.stack([surv_s, surv_i], axis=1).reshape(-1)
+        n_emit = jnp.sum(flat, dtype=jnp.int32)
+        (pos,) = jnp.nonzero(flat, size=c_cap, fill_value=2 * f_cap * ni_pad)
+        valid = jnp.arange(c_cap) < n_emit
+        e_f = (pos // (2 * ni_pad)).astype(jnp.int32)
+        e_iss = (1 - (pos // ni_pad) % 2).astype(jnp.int32)  # 1 = s-ext
+        e_item = (pos % ni_pad).astype(jnp.int32)
+        e_f_c = jnp.where(valid, e_f, 0)
+        e_item_c = jnp.where(valid, e_item, 0)
+        e_sup = jnp.where(
+            e_iss == 1,
+            sup_s[e_f_c, e_item_c], sup_i[e_f_c, e_item_c])
+        e_rec = rec_count + jnp.cumsum(valid.astype(jnp.int32)) - 1
+        widx = jnp.where(valid, e_rec, r_cap)
+        rec_rows = jnp.stack(
+            [rec_idx[e_f_c], e_item_c, e_iss], axis=1).astype(jnp.int32)
+        records = records.at[widx].set(rec_rows, mode="drop")
+        recsup = recsup.at[widx].set(e_sup.astype(jnp.int32), mode="drop")
+
+        # ---- children: surviving candidates with possible extensions ----
+        # child.s_mask = parent's surviving s-items; child.i_mask =
+        # (s-child ? surviving s-items : surviving i-items) restricted to
+        # items > extension item (oracle.py mine_spade_vertical).
+        srow = surv_s[e_f_c]                            # [C, NI]
+        irow = jnp.where((e_iss == 1)[:, None], srow, surv_i[e_f_c])
+        gt = jnp.arange(ni_pad)[None, :] > e_item_c[:, None]
+        child_i_mask = irow & gt
+        child_nits = nits[e_f_c] + e_iss
+        child_allow_s = (jnp.ones((c_cap,), bool) if max_its is None
+                         else child_nits < max_its)
+        has_ext = (jnp.any(srow, axis=1) & child_allow_s) | jnp.any(
+            child_i_mask, axis=1)
+        is_child = valid & has_ext
+        n_children = jnp.sum(is_child, dtype=jnp.int32)
+        (cpos,) = jnp.nonzero(is_child, size=f_cap, fill_value=c_cap - 1)
+        cvalid = jnp.arange(f_cap) < n_children
+        c_f = e_f_c[cpos]
+        c_item = e_item_c[cpos]
+        c_iss = e_iss[cpos]
+
+        # materialize child bitmaps into the other region
+        child_base = jnp.where(level % 2 == 0, region_a, region_b)
+        new_slots = (child_base + lane).astype(jnp.int32)
+        # pt interleave: row 2f is the PLAIN parent, 2f+1 its s-ext
+        # TRANSFORM; an s-extension (iss=1) joins the transform.
+        joins = pt_flat[2 * c_f + c_iss] & store[c_item]
+        widx2 = jnp.where(cvalid, new_slots, scratch)
+        store = store.at[widx2].set(joins)
+
+        new_s_mask = srow[cpos] & cvalid[:, None]
+        new_i_mask = child_i_mask[cpos] & cvalid[:, None]
+        new_nits = jnp.where(cvalid, child_nits[cpos], 0).astype(jnp.int32)
+        new_rec = jnp.where(cvalid, e_rec[cpos], 0).astype(jnp.int32)
+
+        overflow = (overflow | (n_emit > c_cap)
+                    | (rec_count + n_emit > r_cap) | (n_children > f_cap))
+        return (store, new_slots, new_s_mask, new_i_mask, new_nits, new_rec,
+                n_children, rec_count + n_emit, records, recsup, overflow,
+                level + 1, minsup, n_cand)
+
+    def cond(carry):
+        n_nodes, overflow, level = carry[6], carry[10], carry[11]
+        return (n_nodes > 0) & (~overflow) & (level < l_max)
+
+    def run(store, slots, s_mask, i_mask, nits, rec_idx, n_nodes, rec_count,
+            records, recsup, minsup):
+        carry = (store, slots, s_mask, i_mask, nits, rec_idx, n_nodes,
+                 rec_count, records, recsup, jnp.bool_(False),
+                 jnp.int32(0), minsup, jnp.int32(0))
+        out = jax.lax.while_loop(cond, body, carry)
+        # Pack EVERYTHING the host needs into two arrays: on a tunneled
+        # TPU every separate device->host array read costs its own
+        # ~100ms latency, so six scalar/array outputs would cost ~6
+        # roundtrips.  recsup rides as a 4th column of records.
+        packed = jnp.concatenate([out[8], out[9][:, None]], axis=1)
+        counters = jnp.stack([
+            out[7],                                  # rec_count
+            (out[10] | (out[6] > 0)).astype(jnp.int32),  # overflow
+            out[11],                                 # levels
+            out[13],                                 # candidates
+        ])
+        return packed, counters
+
+    # no donate: the store is not among run's outputs, so XLA cannot alias
+    # it anyway (donating would only emit a "not usable" warning); the
+    # while_loop carry reuses its buffer internally regardless
+    if mesh is None:
+        return jax.jit(run)
+    st = P(None, SEQ_AXIS)
+    rep = P()
+    return jax.jit(
+        jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(st, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep),
+            out_specs=(rep, rep),
+            check_vma=False))
+
+
+class FusedSpadeTPU:
+    """Whole-mine-on-device SPADE for small/medium databases.
+
+    Returns None from :meth:`mine` when a static cap overflowed — the
+    caller (``mine_spade_tpu(fused="auto")``) falls back to the classic
+    engine, which has no capacity limits.
+    """
+
+    def __init__(
+        self,
+        vdb: VerticalDB,
+        minsup_abs: int,
+        *,
+        mesh: Optional[Mesh] = None,
+        max_pattern_itemsets: Optional[int] = None,
+        caps: Optional[FusedCaps] = None,
+        use_pallas="auto",
+        shape_buckets: bool = False,
+    ):
+        self.vdb = vdb
+        self.minsup = int(minsup_abs)
+        self.mesh = mesh
+        self.max_its = max_pattern_itemsets
+        self.caps = caps or FusedCaps()
+        self._put = functools.partial(MH.host_to_device, mesh)
+
+        n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
+        if use_pallas == "auto":
+            self.use_pallas = (n_items > 0
+                               and jax.default_backend() == "tpu")
+        else:
+            self.use_pallas = bool(use_pallas) and n_items > 0
+        self._interpret = jax.default_backend() != "tpu"
+
+        # shape_buckets: pow2-bucket the sequence axis (and the item-row
+        # count, via ni_pad below on the bucketed alphabet) so streaming
+        # windows with drifting sizes reuse the compiled program — same
+        # trade as the classic engine's shape_buckets.
+        if shape_buckets:
+            n_seq = max(128, next_pow2(n_seq))
+        n_shards = 1 if mesh is None else mesh.devices.size
+        self._s_block = min(PS.seq_block(n_words),
+                            pad_to_multiple(-(-n_seq // n_shards), 128))
+        mult = n_shards * self._s_block if self.use_pallas else n_shards
+        n_seq = pad_to_multiple(n_seq, mult)
+        self.n_seq, self.n_words = n_seq, n_words
+        self.ni_pad = pad_to_multiple(max(n_items, 1), PS.I_TILE)
+        self.n_items = n_items
+        self.stats = {"patterns": 0, "levels": 0, "fused": True}
+
+    def nbytes(self) -> int:
+        rows = self.ni_pad + 2 * self.caps.f_cap + 1
+        return rows * self.n_seq * self.n_words * 4
+
+    def mine(self) -> Optional[List[PatternResult]]:
+        vdb, cap = self.vdb, self.caps
+        roots = [i for i in range(self.n_items)
+                 if int(vdb.item_supports[i]) >= self.minsup]
+        n_roots = len(roots)
+        if n_roots == 0:
+            return []
+        if n_roots > min(cap.f_cap, cap.r_cap):
+            self.stats["fused_overflow"] = True
+            return None  # frontier can't hold the roots: classic engine
+
+        rows = self.ni_pad + 2 * cap.f_cap + 1
+        store = scatter_build_store(vdb, rows, self.n_seq, self.n_words,
+                                    mesh=self.mesh, put=self._put, flat=True)
+
+        ni = self.ni_pad
+        root_mask = np.zeros(ni, bool)
+        root_mask[roots] = True
+        slots = np.zeros(cap.f_cap, np.int32)
+        s_mask = np.zeros((cap.f_cap, ni), bool)
+        i_mask = np.zeros((cap.f_cap, ni), bool)
+        nits = np.ones(cap.f_cap, np.int32)
+        rec_idx = np.arange(cap.f_cap, dtype=np.int32)
+        records = np.zeros((cap.r_cap, 3), np.int32)
+        recsup = np.zeros(cap.r_cap, np.int32)
+        for k, i in enumerate(roots):
+            slots[k] = i
+            s_mask[k] = root_mask
+            i_mask[k] = root_mask & (np.arange(ni) > i)
+            records[k] = (-1, i, 1)
+            recsup[k] = int(vdb.item_supports[i])
+
+        fn = _fused_mine_fn(
+            self.mesh, self.n_words, ni, self.max_its,
+            cap.f_cap, cap.c_cap, cap.r_cap, cap.l_max,
+            self.use_pallas, self._s_block, self._interpret)
+        packed_dev, counters_dev = fn(
+            store, self._put(slots), self._put(s_mask), self._put(i_mask),
+            self._put(nits), self._put(rec_idx), jnp.int32(n_roots),
+            jnp.int32(n_roots), self._put(records), self._put(recsup),
+            jnp.int32(self.minsup))
+        for a in (packed_dev, counters_dev):
+            try:
+                a.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass  # method unavailable on this backend
+
+        counters = np.asarray(counters_dev)
+        packed = np.asarray(packed_dev)
+        rec, sup = packed[:, :3], packed[:, 3]
+        n_rec = int(counters[0])
+        self.stats["levels"] = int(counters[2])
+        self.stats["candidates"] = int(counters[3])
+        self.stats["kernel_launches"] = 1  # the whole mine is one dispatch
+        if bool(counters[1]):
+            self.stats["fused_overflow"] = True
+            return None
+
+        # reconstruct patterns by following parent links (parents always
+        # precede children in the record order)
+        ids = vdb.item_ids
+        pats: List[Optional[tuple]] = [None] * n_rec
+        results: List[PatternResult] = []
+        for k in range(n_rec):
+            parent, item, iss = int(rec[k, 0]), int(rec[k, 1]), int(rec[k, 2])
+            it_id = int(ids[item])
+            if parent < 0:
+                pat = ((it_id,),)
+            elif iss:
+                pat = pats[parent] + ((it_id,),)
+            else:
+                pat = pats[parent][:-1] + (pats[parent][-1] + (it_id,),)
+            pats[k] = pat
+            results.append((pat, int(sup[k])))
+        self.stats["patterns"] = len(results)
+        return sort_patterns(results)

@@ -697,6 +697,7 @@ def mine_spade_tpu(
     max_pattern_itemsets: Optional[int] = None,
     stats_out: Optional[dict] = None,
     checkpoint=None,
+    fused: str = "auto",
     **kwargs,
 ) -> List[PatternResult]:
     """Convenience wrapper: DB -> vertical build -> TPU mine.
@@ -705,10 +706,45 @@ def mine_spade_tpu(
     ``save(state)``, and ``every_s`` — a saved frontier is resumed when its
     fingerprint still matches (a stale/mismatched one is ignored, the mine
     restarts fresh).
+
+    ``fused``: "auto" routes small/medium databases through the fused
+    whole-mine-on-device engine (models/spade_fused.py — ONE blocking
+    readback instead of one per DFS wave, the dominant cost on
+    remote/tunneled TPUs); a static-cap overflow falls back to this
+    classic engine transparently.  "never" pins the classic engine,
+    "always" tries the fused engine regardless of size (still falling
+    back on overflow).
     """
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
         return []
+    if fused not in ("auto", "always", "never"):
+        raise ValueError(f"fused must be 'auto', 'always' or 'never', "
+                         f"got {fused!r}")
+    if fused == "always" and checkpoint is not None:
+        raise ValueError("fused='always' cannot honor a checkpoint: the "
+                         "fused engine has no resumable frontier — pass "
+                         "fused='auto' or drop the checkpoint")
+    if checkpoint is None and fused in ("auto", "always"):
+        from spark_fsm_tpu.models.spade_fused import fused_eligible, FusedSpadeTPU
+        if fused == "always" or fused_eligible(vdb, mesh=mesh):
+            feng = FusedSpadeTPU(
+                vdb, minsup_abs, mesh=mesh,
+                max_pattern_itemsets=max_pattern_itemsets,
+                use_pallas=kwargs.get("use_pallas", "auto"),
+                shape_buckets=kwargs.get("shape_buckets", False))
+            res = feng.mine()
+            if res is not None:
+                if stats_out is not None:
+                    stats_out.update(feng.stats)
+                return res
+            # cap overflow: fall through to the classic engine, keeping
+            # the overflow marker visible so steady-state callers (e.g.
+            # streaming windows that overflow every push) can detect the
+            # doubled work and pin fused="never"
+            if stats_out is not None:
+                stats_out["fused_overflow"] = True
+                stats_out["fused_levels"] = feng.stats.get("levels", 0)
     eng = SpadeTPU(vdb, minsup_abs, mesh=mesh,
                    max_pattern_itemsets=max_pattern_itemsets, **kwargs)
     resume, save_cb, every_s = load_checkpoint(

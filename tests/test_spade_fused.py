@@ -1,0 +1,133 @@
+"""Fused whole-mine-on-device engine (models/spade_fused.py).
+
+Parity anchor: the CPU oracle, byte-identical pattern sets (SURVEY.md
+sec 4).  The fused engine's enumeration is mask-vectorized SPAM S/I
+candidate lists, so any divergence from the oracle's list rules shows up
+here as a set difference.
+"""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade, mine_spade_vertical
+from spark_fsm_tpu.models.spade_fused import (
+    FusedCaps, FusedSpadeTPU, fused_eligible)
+from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+ZAKI = "1 -1 2 -1 3 -2\n1 4 -1 3 -2\n1 -1 2 -1 3 4 -2\n1 3 -1 5 -2\n"
+SMALL_CAPS = FusedCaps(f_cap=256, c_cap=2048, r_cap=16384)
+
+
+def _fused(db, minsup, **kw):
+    vdb = build_vertical(db, min_item_support=minsup)
+    eng = FusedSpadeTPU(vdb, minsup, caps=kw.pop("caps", SMALL_CAPS), **kw)
+    return eng, eng.mine()
+
+
+def test_parity_zaki():
+    db = parse_spmf(ZAKI)
+    eng, got = _fused(db, 2)
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+    assert eng.stats["kernel_launches"] == 1
+    assert eng.stats["candidates"] > 0
+
+
+@pytest.mark.parametrize("seed,n,items,mi,misz,minsup,caps", [
+    (7, 400, 40, 4.0, 1.6, 8, SMALL_CAPS),
+    (9, 200, 25, 4.0, 2.5, 10, SMALL_CAPS),
+    (21, 300, 60, 6.0, 1.3, 6, None),  # wide levels: default caps
+])
+def test_parity_synthetic(seed, n, items, mi, misz, minsup, caps):
+    db = synthetic_db(seed=seed, n_sequences=n, n_items=items,
+                      mean_itemsets=mi, mean_itemset_size=misz)
+    _, got = _fused(db, minsup, caps=caps or FusedCaps())
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, minsup))
+
+
+def test_parity_multiword():
+    # > 32 itemsets/sequence -> n_words > 1 exercises the word-minor
+    # flat layout + carry chains inside the fused program
+    db = synthetic_db(seed=8, n_sequences=120, n_items=12,
+                      mean_itemsets=40.0, mean_itemset_size=1.2)
+    minsup = 60  # dense fixture: keep the pattern set bounded
+    _, got = _fused(db, minsup,
+                    caps=FusedCaps(f_cap=1024, c_cap=8192, r_cap=1 << 16))
+    if got is None:  # legitimately explosive at this minsup: nothing to test
+        pytest.skip("fixture overflowed fused caps")
+    assert patterns_text(got) == patterns_text(mine_spade(db, minsup))
+
+
+def test_max_pattern_itemsets():
+    db = synthetic_db(seed=9, n_sequences=200, n_items=25,
+                      mean_itemsets=4.0, mean_itemset_size=2.5)
+    vdb = build_vertical(db, min_item_support=10)
+    eng = FusedSpadeTPU(vdb, 10, max_pattern_itemsets=2, caps=SMALL_CAPS)
+    got = eng.mine()
+    want = mine_spade_vertical(vdb, 10, max_pattern_itemsets=2)
+    assert got is not None
+    assert patterns_text(got) == patterns_text(want)
+
+
+def test_overflow_returns_none_and_auto_falls_back():
+    db = synthetic_db(seed=7, n_sequences=400, n_items=40,
+                      mean_itemsets=4.0, mean_itemset_size=1.6)
+    tiny = FusedCaps(f_cap=16, c_cap=32, r_cap=64, l_max=8)
+    eng, got = _fused(db, 8, caps=tiny)
+    assert got is None and eng.stats.get("fused_overflow")
+    # the wrapper must still return the full, correct set via the
+    # classic engine
+    stats = {}
+    full = mine_spade_tpu(db, 8, stats_out=stats)
+    assert patterns_text(full) == patterns_text(mine_spade(db, 8))
+
+
+def test_auto_routing_uses_fused_for_small_dbs():
+    db = parse_spmf(ZAKI)
+    stats = {}
+    got = mine_spade_tpu(db, 2, stats_out=stats)
+    assert stats.get("fused") is True
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+    # fused="never" pins the classic engine
+    stats2 = {}
+    got2 = mine_spade_tpu(db, 2, stats_out=stats2, fused="never")
+    assert "fused" not in stats2
+    assert patterns_text(got2) == patterns_text(got)
+
+
+def test_eligibility_rejects_large_and_mesh():
+    db = parse_spmf(ZAKI)
+    vdb = build_vertical(db, min_item_support=2)
+    assert fused_eligible(vdb)
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(len(jax.devices()))
+    assert not fused_eligible(vdb, mesh=mesh)
+
+
+def test_empty_and_single():
+    assert _fused(parse_spmf("1 -2\n1 -2\n"), 2)[1] == [
+        (((1,),), 2)]
+    _, got = _fused(parse_spmf("1 -2\n"), 2)
+    assert got == []
+
+
+def test_shape_buckets_reuse_compile():
+    # two window-ish DBs with different sizes must land on one compiled
+    # shape when bucketed (streaming re-mines per micro-batch)
+    db1 = synthetic_db(seed=30, n_sequences=100, n_items=15,
+                      mean_itemsets=3.0)
+    db2 = synthetic_db(seed=31, n_sequences=120, n_items=15,
+                      mean_itemsets=3.0)
+    for db, ms in ((db1, 5), (db2, 5)):
+        vdb = build_vertical(db, min_item_support=ms)
+        eng = FusedSpadeTPU(vdb, ms, caps=SMALL_CAPS, shape_buckets=True)
+        got = eng.mine()
+        assert got is not None
+        assert patterns_text(got) == patterns_text(mine_spade(db, ms))
+        assert eng.n_seq == 128  # both bucket to the same shape
