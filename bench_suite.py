@@ -15,7 +15,9 @@ wall-clock, each with parity attested against the CPU oracle:
 Prints one JSON line per config and writes the collected results to
 ``BENCH_SUITE.json`` (with platform + timestamp) unless BENCH_SUITE_OUT=0.
 Scale knobs: BENCH_SUITE_SCALE (default 0.2) multiplies every dataset's
-size so a full-size run is one env var away.
+size so a full-size run is one env var away — EXCEPT config 1, which runs
+at ``min(1, scale*5)`` (full size by default; its oracle check is cheap,
+see the config-1 comment).
 
 The real public datasets are unreachable (zero-egress sandbox); the seeded
 synthetic generators in data/synth.py match each dataset's documented
@@ -91,10 +93,16 @@ def main() -> None:
         results.append(row)
         print(json.dumps(row), flush=True)
 
-    # 1. SPADE, BMS-WebView-1-shaped, minsup 1%
-    db1 = bms_webview1_like(scale=scale)
+    # 1. SPADE, BMS-WebView-1-shaped, minsup 1% — run at FULL size (the
+    # actual eval config).  What the reduced-scale knob buys elsewhere is
+    # a cheap CPU-oracle parity check; config 1's full-size oracle is
+    # sub-second (48 patterns at 1%), so full size costs nothing here,
+    # while configs 2-5 keep the knob because THEIR oracle checks grow
+    # into minutes at full size.  scale*5 < 1 still shrinks config 1.
+    s1 = min(1.0, scale * 5)
+    db1 = bms_webview1_like(scale=s1)
     ms1 = abs_minsup(0.01, len(db1))
-    record(1, f"SPADE synthetic BMS-WebView-1-shaped x{scale} minsup=1%",
+    record(1, f"SPADE synthetic BMS-WebView-1-shaped x{s1:g} minsup=1%",
            lambda: mine_spade_tpu(db1, ms1),
            lambda: mine_spade(db1, ms1), patterns_text, db=db1)
 
@@ -176,10 +184,13 @@ def main() -> None:
             "ts": round(time.time(), 1),
             "platform": platform,
             "all_parity": all(r["parity"] for r in results),
-            "note": ("suite runs at reduced scale; per-launch host<->device "
-                     "latency dominates at the smallest config and the "
-                     "device engine's win grows with DB size (headline "
-                     "full-size workload: see BASELINE.json published). "
+            "note": ("configs 2-5 run at reduced scale (full-size oracle "
+                     "parity checks cost minutes); config 1 runs the "
+                     "actual full-size eval config, where minsup=1% "
+                     "leaves only ~48 patterns — too little work for the "
+                     "device to beat a sub-second CPU mine, so ~1x there "
+                     "is expected and the device win grows with workload "
+                     "(headline: see BASELINE.json published). "
                      "cold_wall_s includes XLA compiles whenever the "
                      "persistent compile cache has no entry for the current "
                      "kernel shapes — any engine/kernel change recompiles "
