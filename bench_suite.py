@@ -184,17 +184,20 @@ def main() -> None:
             "ts": round(time.time(), 1),
             "platform": platform,
             "all_parity": all(r["parity"] for r in results),
-            "note": ("configs 2-5 run at reduced scale (full-size oracle "
-                     "parity checks cost minutes); config 1 runs the "
-                     "actual full-size eval config, where minsup=1% "
-                     "leaves only ~48 patterns — too little work for the "
-                     "device to beat a sub-second CPU mine, so ~1x there "
-                     "is expected and the device win grows with workload "
-                     "(headline: see BASELINE.json published). "
-                     "cold_wall_s includes XLA compiles whenever the "
-                     "persistent compile cache has no entry for the current "
-                     "kernel shapes — any engine/kernel change recompiles "
-                     "once"),
+            "config1_scale": s1,
+            "note": ((f"configs 2-5 run at reduced scale (full-size oracle "
+                      f"parity checks cost minutes); config 1 ran at scale "
+                      f"{s1:g}"
+                      + (" — the actual full-size eval config, where "
+                         "minsup=1% leaves so few patterns that ~1x vs the "
+                         "sub-second CPU mine is expected; the device win "
+                         "grows with workload"
+                         if s1 == 1.0 else "")
+                      + " (headline: see BASELINE.json published). "
+                      "cold_wall_s includes XLA compiles whenever the "
+                      "persistent compile cache has no entry for the "
+                      "current kernel shapes — any engine/kernel change "
+                      "recompiles once")),
             "configs": results,
         }
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -77,19 +77,22 @@ def fused_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
                    caps: Optional["FusedCaps"] = None) -> bool:
     """Size heuristic for auto-routing: the fused program computes the
     DENSE [2*f_cap, ni_pad] pair matrix every level (inactive lanes
-    included — shapes are static), so its per-level HBM traffic is
-    ~S*W*4 * 2*f_cap*ni_pad * (1/I_TILE + 1/P_TILE) bytes.  Routing is
-    worth it while that stays well under the ~130ms/wave readback latency
-    the fusion removes (24 GB ~= 30ms on a v5e); beyond that the classic
-    host-driven DFS's exact candidate lists win.  Mesh path: not yet
-    validated on hardware — classic engine."""
-    if mesh is not None:
+    included — shapes are static), so its PER-DEVICE per-level HBM
+    traffic is ~S_local*W*4 * 2*f_cap*ni_pad * (1/I_TILE + 1/P_TILE)
+    bytes (the sequence axis shards over the mesh).  Routing is worth it
+    while that stays well under the ~130ms/wave readback latency the
+    fusion removes (24 GB ~= 30ms on a v5e); beyond that the classic
+    host-driven DFS's exact candidate lists win.  Multi-host meshes take
+    the classic engine (fused multi-host is unvalidated)."""
+    if MH.is_multihost(mesh):
         return False
     caps = caps or FusedCaps()
     ni_pad = pad_to_multiple(max(vdb.n_items, 1), PS.I_TILE)
     if ni_pad > 1024:
         return False
-    est = (vdb.n_sequences * vdb.n_words * 4 * 2 * caps.f_cap * ni_pad
+    n_dev = 1 if mesh is None else mesh.devices.size
+    s_local = -(-vdb.n_sequences // n_dev)
+    est = (s_local * vdb.n_words * 4 * 2 * caps.f_cap * ni_pad
            * (1 / PS.I_TILE + 1 / PS.P_TILE))
     return est <= 24 << 30
 

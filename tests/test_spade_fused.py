@@ -100,14 +100,43 @@ def test_auto_routing_uses_fused_for_small_dbs():
     assert patterns_text(got2) == patterns_text(got)
 
 
-def test_eligibility_rejects_large_and_mesh():
+def test_eligibility(monkeypatch):
     db = parse_spmf(ZAKI)
     vdb = build_vertical(db, min_item_support=2)
     assert fused_eligible(vdb)
     import jax
     from spark_fsm_tpu.parallel.mesh import make_mesh
+    # single-process mesh: eligible (validated path)
     mesh = make_mesh(len(jax.devices()))
+    assert fused_eligible(vdb, mesh=mesh)
+    # negative paths: the routing guards must reject...
+    import spark_fsm_tpu.models.spade_fused as SF
+    # ...databases whose dense per-level traffic exceeds the cutoff
+    big = build_vertical(db, min_item_support=2,
+                         pad_sequences_to=300_000_000)
+    assert not fused_eligible(big)
+    # ...alphabets wider than the mask arrays support
+    class WideVdb:
+        n_items = 5000
+        n_sequences = vdb.n_sequences
+        n_words = vdb.n_words
+    assert not fused_eligible(WideVdb())
+    # ...multi-host meshes (fused multi-host is unvalidated)
+    monkeypatch.setattr(SF.MH, "is_multihost", lambda m: m is not None)
     assert not fused_eligible(vdb, mesh=mesh)
+
+
+def test_parity_mesh():
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(len(jax.devices()))
+    db = synthetic_db(seed=7, n_sequences=400, n_items=40,
+                      mean_itemsets=4.0, mean_itemset_size=1.6)
+    vdb = build_vertical(db, min_item_support=8)
+    eng = FusedSpadeTPU(vdb, 8, mesh=mesh, caps=SMALL_CAPS)
+    got = eng.mine()
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 8))
 
 
 def test_empty_and_single():
