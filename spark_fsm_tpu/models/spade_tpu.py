@@ -713,7 +713,10 @@ def mine_spade_tpu(
     remote/tunneled TPUs); a static-cap overflow falls back to this
     classic engine transparently.  "never" pins the classic engine,
     "always" tries the fused engine regardless of size (still falling
-    back on overflow).
+    back on overflow).  A checkpointed job always uses the classic
+    engine (the fused one has no resumable frontier); when that
+    overrides "auto"/"always", ``stats_out`` gets
+    ``fused_skipped="checkpoint"``.
     """
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
@@ -721,10 +724,11 @@ def mine_spade_tpu(
     if fused not in ("auto", "always", "never"):
         raise ValueError(f"fused must be 'auto', 'always' or 'never', "
                          f"got {fused!r}")
-    if fused == "always" and checkpoint is not None:
-        raise ValueError("fused='always' cannot honor a checkpoint: the "
-                         "fused engine has no resumable frontier — pass "
-                         "fused='auto' or drop the checkpoint")
+    if fused != "never" and checkpoint is not None and stats_out is not None:
+        # the fused engine has no resumable frontier; a checkpointed job
+        # degrades to the classic engine (flagged, not fatal — matching
+        # the service's checkpoint-unsupported convention)
+        stats_out["fused_skipped"] = "checkpoint"
     if checkpoint is None and fused in ("auto", "always"):
         from spark_fsm_tpu.models.spade_fused import fused_eligible, FusedSpadeTPU
         if fused == "always" or fused_eligible(vdb, mesh=mesh):

@@ -100,8 +100,11 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
                                   "pipeline_depth", "chunk", "recompute_chunk")
     mesh = config.get_mesh()
     if maxgap is None and maxwindow is None:
+        # fused routing is a plain-SPADE knob (the constrained engine has
+        # no fused counterpart), so it must not reach mine_cspade_tpu
         return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
-                              checkpoint=checkpoint, **kwargs)
+                              checkpoint=checkpoint,
+                              **config.engine_kwargs("fused"), **kwargs)
     return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow,
                            mesh=mesh, stats_out=stats, checkpoint=checkpoint,
                            **kwargs)
