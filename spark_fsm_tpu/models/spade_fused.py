@@ -55,19 +55,40 @@ from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
 
 
-def _dense_pair_jnp(pt3: jax.Array, items3: jax.Array, i_tile: int = 128):
+def _dense_pair_jnp(pt3: jax.Array, items3: jax.Array, i_tile: int = 128,
+                    block_elems: int = 1 << 28):
     """[P, S, W] x [NI, S, W] -> [P, NI] support matrix, blocked over item
-    tiles so the [P, tile, S] hit tensor stays bounded.  Non-TPU stand-in
-    for ops/pallas_support.pair_supports (bit-identical counts)."""
+    tiles AND sequence chunks so the [P, i_tile, s_chunk] hit tensor stays
+    bounded (a full-S block at mesh-validation sizes would be tens of GB).
+    The chunk is sized from P — mesh-scaled caps widen P (FusedCaps.
+    for_mesh), so a fixed chunk would defeat the bound exactly there.
+    Non-TPU stand-in for ops/pallas_support.pair_supports (bit-identical
+    counts)."""
     p_rows, s, w = pt3.shape
     ni = items3.shape[0]
     n_tiles = ni // i_tile
+    sc = min(max(128, block_elems // (p_rows * i_tile)), s)
+    n_s = -(-s // sc)
+    pad = n_s * sc - s
+    if pad:  # zero-pad: padded sequences contribute no support
+        pt3 = jnp.pad(pt3, ((0, 0), (0, pad), (0, 0)))
+        items3 = jnp.pad(items3, ((0, 0), (0, pad), (0, 0)))
 
     def tile(idx):
         it = jax.lax.dynamic_slice(items3, (idx * i_tile, 0, 0),
-                                   (i_tile, s, w))
-        hit = jnp.any((pt3[:, None, :, :] & it[None, :, :, :]) != 0, axis=3)
-        return jnp.sum(hit, axis=2, dtype=jnp.int32)      # [P, i_tile]
+                                   (i_tile, n_s * sc, w))
+
+        def s_step(j, acc):
+            p_blk = jax.lax.dynamic_slice(pt3, (0, j * sc, 0),
+                                          (p_rows, sc, w))
+            i_blk = jax.lax.dynamic_slice(it, (0, j * sc, 0),
+                                          (i_tile, sc, w))
+            hit = jnp.any(
+                (p_blk[:, None, :, :] & i_blk[None, :, :, :]) != 0, axis=3)
+            return acc + jnp.sum(hit, axis=2, dtype=jnp.int32)
+
+        return jax.lax.fori_loop(
+            0, n_s, s_step, jnp.zeros((p_rows, i_tile), jnp.int32))
 
     out = jax.lax.map(tile, jnp.arange(n_tiles))          # [T, P, i_tile]
     return jnp.moveaxis(out, 0, 1).reshape(p_rows, ni)
@@ -86,7 +107,7 @@ def fused_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
     the classic engine (fused multi-host is unvalidated)."""
     if MH.is_multihost(mesh):
         return False
-    caps = caps or FusedCaps()
+    caps = caps or FusedCaps.for_mesh(mesh)
     ni_pad = pad_to_multiple(max(vdb.n_items, 1), PS.I_TILE)
     if ni_pad > 1024:
         return False
@@ -100,15 +121,25 @@ def fused_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
 class FusedCaps:
     """Static capacities of the fused program (compile-time shapes)."""
 
-    def __init__(self, f_cap: int = 1024, c_cap: int = 8192,
-                 r_cap: int = 1 << 16, l_max: int = 128):
+    def __init__(self, f_cap: int = 1024, c_cap: Optional[int] = None,
+                 r_cap: int = 1 << 17, l_max: int = 128):
         # f_cap rounded up so 2*f_cap rows tile the Pallas P_TILE (the
         # kernel asserts P % P_TILE == 0 — a raw odd cap would crash on
         # TPU instead of overflowing gracefully)
         self.f_cap = pad_to_multiple(int(f_cap), PS.P_TILE // 2)
-        self.c_cap = int(c_cap)    # emitted records per level
+        self.c_cap = (8 * self.f_cap if c_cap is None
+                      else int(c_cap))  # emissions/level
         self.r_cap = int(r_cap)    # total records (patterns)
         self.l_max = int(l_max)    # levels (pattern steps)
+
+    @classmethod
+    def for_mesh(cls, mesh: Optional[Mesh]) -> "FusedCaps":
+        """Default caps scaled to the mesh: the dense pair matrix shards
+        its sequence axis over the devices, so the frontier cap can grow
+        with the device count at CONSTANT per-device traffic — on a
+        v5e-8 the headline-scale frontier (~2.6k nodes) fits fused."""
+        n_dev = 1 if mesh is None else mesh.devices.size
+        return cls(f_cap=min(8192, 1024 * n_dev))
 
 
 @functools.lru_cache(maxsize=32)
@@ -294,7 +325,7 @@ class FusedSpadeTPU:
         self.minsup = int(minsup_abs)
         self.mesh = mesh
         self.max_its = max_pattern_itemsets
-        self.caps = caps or FusedCaps()
+        self.caps = caps or FusedCaps.for_mesh(mesh)
         self._put = functools.partial(MH.host_to_device, mesh)
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
