@@ -75,13 +75,10 @@ def _dense_pair_jnp(pt3: jax.Array, items3: jax.Array, i_tile: int = 128,
         items3 = jnp.pad(items3, ((0, 0), (0, pad), (0, 0)))
 
     def tile(idx):
-        it = jax.lax.dynamic_slice(items3, (idx * i_tile, 0, 0),
-                                   (i_tile, n_s * sc, w))
-
         def s_step(j, acc):
             p_blk = jax.lax.dynamic_slice(pt3, (0, j * sc, 0),
                                           (p_rows, sc, w))
-            i_blk = jax.lax.dynamic_slice(it, (0, j * sc, 0),
+            i_blk = jax.lax.dynamic_slice(items3, (idx * i_tile, j * sc, 0),
                                           (i_tile, sc, w))
             hit = jnp.any(
                 (p_blk[:, None, :, :] & i_blk[None, :, :, :]) != 0, axis=3)

@@ -350,34 +350,57 @@ class TsrTPU:
         flight.  ``_resolve_eval`` blocks on it — the split lets the mine
         loop pipeline the next dispatch behind the current readback."""
         n = len(cands)
-        kmax = 1
-        for x, y in cands:
-            kmax = max(kmax, len(x), len(y))
-        km = 1
-        while km < kmax:
-            km *= 2
-        fn = self._eval_fn(km)
-        c = self.chunk
+        # Candidates dispatch per side-size bucket (pow2 km), NOT at one
+        # batch-wide kmax: the km kernel keeps ~2*km live [chunk, S_local,
+        # W] gather temps, so the adaptive width must NARROW as km grows
+        # (a km=4 launch at the km=1 width = 27G of temps on a 16G v5e) —
+        # and narrowing the WHOLE mixed batch for one large-side candidate
+        # would 4x the dispatch latency of the small-side majority.
+        # Bucketing keeps each candidate at its own bucket's widest safe
+        # launch.  A caller-pinned chunk is honored as-is.
+        kms = np.empty(n, np.int32)
+        for r, (x, y) in enumerate(cands):
+            side = max(len(x), len(y))
+            km = 1
+            while km < side:
+                km *= 2
+            kms[r] = km
+        order = np.argsort(kms, kind="stable")
         parts = []
-        for lo in range(0, n, c):
-            hi = min(lo + c, n)
-            xy = np.full((c, 2, km), -1, np.int32)
-            for r, (x, y) in enumerate(cands[lo:hi]):
-                xy[r, 0, :len(x)] = x
-                xy[r, 1, :len(y)] = y
-            parts.append(fn(p1, s1, self._put(xy)))
-            self.stats["kernel_launches"] += 1
+        cols = np.empty(n, np.int64)  # candidate r -> column in `out`
+        base = 0
+        g_lo = 0
+        while g_lo < n:
+            km = int(kms[order[g_lo]])
+            g_hi = g_lo
+            while g_hi < n and kms[order[g_hi]] == km:
+                g_hi += 1
+            fn = self._eval_fn(km)
+            c = self.chunk if self._chunk_user else max(32, self.chunk // km)
+            for lo in range(g_lo, g_hi, c):
+                hi = min(lo + c, g_hi)
+                xy = np.full((c, 2, km), -1, np.int32)
+                for r in range(lo, hi):
+                    x, y = cands[order[r]]
+                    xy[r - lo, 0, :len(x)] = x
+                    xy[r - lo, 1, :len(y)] = y
+                cols[order[lo:hi]] = base + np.arange(hi - lo)
+                base += c
+                parts.append(fn(p1, s1, self._put(xy)))
+                self.stats["kernel_launches"] += 1
+            g_lo = g_hi
         self.stats["evaluated"] += n
         out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         try:
             out.copy_to_host_async()
         except (AttributeError, NotImplementedError):
             pass  # method unavailable on this backend
-        return out
+        return out, cols
 
     def _resolve_eval(self, handle, n: int):
-        arr = np.asarray(handle)
-        return arr[0, :n].astype(np.int64), arr[1, :n].astype(np.int64)
+        out, cols = handle
+        arr = np.asarray(out)
+        return arr[0, cols].astype(np.int64), arr[1, cols].astype(np.int64)
 
     # ---------------------------------------------------------------- mine
 

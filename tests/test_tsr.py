@@ -116,6 +116,43 @@ def test_no_dense_bitmap_materialization():
     assert eng.stats["deepening_rounds"] >= 1
 
 
+def test_launch_width_narrows_with_side_bucket():
+    # The eval kernel holds ~2*km live [chunk, S, W] gather temps, so the
+    # adaptive launch width must shrink as the side-size bucket grows —
+    # a km=4 launch at the km=1 width OOMs real HBM (v5e: 27G on a 16G
+    # chip).  A caller-pinned chunk is honored unchanged.
+    db = synthetic_db(3, n_sequences=40, n_items=12, mean_itemsets=5.0)
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, k=5, minconf=0.5)
+    eng.chunk, eng._chunk_user = 512, None
+    p1, s1 = eng._prep(vdb.n_items)
+    cands = [((0,), (i % 3 + 1, 4, 5)) for i in range(512)]  # kmax=3 -> km=4
+    before = eng.stats["kernel_launches"]
+    handle = eng._dispatch_eval(p1, s1, cands)
+    assert eng.stats["kernel_launches"] - before == 512 // (512 // 4)
+    sups, supxs = eng._resolve_eval(handle, len(cands))
+    assert len(sups) == len(cands)
+
+    pinned = TsrTPU(vdb, k=5, minconf=0.5, chunk=512)
+    p1, s1 = pinned._prep(vdb.n_items)
+    before = pinned.stats["kernel_launches"]
+    pinned._dispatch_eval(p1, s1, cands)
+    assert pinned.stats["kernel_launches"] - before == 1  # pinned: one launch
+
+    # Mixed batch: one side-3 candidate must NOT narrow the km=1
+    # majority's launch — buckets dispatch separately (1 wide + 1 narrow
+    # launch), and results come back in the original candidate order.
+    mixed = [((i % 4,), (i % 3 + 5,)) for i in range(500)]
+    mixed.insert(250, ((0,), (1, 4, 5)))
+    before = eng.stats["kernel_launches"]
+    handle = eng._dispatch_eval(p1, s1, mixed)
+    assert eng.stats["kernel_launches"] - before == 2
+    sups, supxs = eng._resolve_eval(handle, len(mixed))
+    single = eng._resolve_eval(
+        eng._dispatch_eval(p1, s1, [mixed[250]]), 1)
+    assert sups[250] == single[0][0] and supxs[250] == single[1][0]
+
+
 @pytest.mark.slow
 @pytest.mark.skipif("not __import__('os').environ.get('RUN_SLOW')",
                     reason="minutes-long full-scale run; set RUN_SLOW=1")
