@@ -100,10 +100,10 @@ def fused_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
     bytes (the sequence axis shards over the mesh).  Routing is worth it
     while that stays well under the ~130ms/wave readback latency the
     fusion removes (24 GB ~= 30ms on a v5e); beyond that the classic
-    host-driven DFS's exact candidate lists win.  Multi-host meshes take
-    the classic engine (fused multi-host is unvalidated)."""
-    if MH.is_multihost(mesh):
-        return False
+    host-driven DFS's exact candidate lists win.  Multi-host meshes are
+    eligible: every process runs the identical program on replicated
+    frontier state, exactly the SPMD contract of parallel/multihost.py
+    (validated by the 2-process parity test)."""
     caps = caps or FusedCaps.for_mesh(mesh)
     ni_pad = pad_to_multiple(max(vdb.n_items, 1), PS.I_TILE)
     if ni_pad > 1024:
@@ -389,11 +389,14 @@ class FusedSpadeTPU:
             self.mesh, self.n_words, ni, self.max_its,
             cap.f_cap, cap.c_cap, cap.r_cap, cap.l_max,
             self.use_pallas, self._s_block, self._interpret)
+        # scalars go through _put too: a bare jnp.int32 is a committed
+        # single-device array, which cannot feed a multi-controller
+        # computation (parallel/multihost.py replicate)
         packed_dev, counters_dev = fn(
             store, self._put(slots), self._put(s_mask), self._put(i_mask),
-            self._put(nits), self._put(rec_idx), jnp.int32(n_roots),
-            jnp.int32(n_roots), self._put(records), self._put(recsup),
-            jnp.int32(self.minsup))
+            self._put(nits), self._put(rec_idx), self._put(np.int32(n_roots)),
+            self._put(np.int32(n_roots)), self._put(records),
+            self._put(recsup), self._put(np.int32(self.minsup)))
         for a in (packed_dev, counters_dev):
             try:
                 a.copy_to_host_async()

@@ -67,10 +67,24 @@ def main() -> None:
     ok_r = rules_text(rgot) == rules_text(
         mine_tsr_cpu(db, 15, 0.5, max_side=2))
 
+    # the fused whole-mine-on-device engine under multi-controller: every
+    # process runs the one compiled program on replicated frontier state
+    # and reconstructs the identical record buffer
+    from spark_fsm_tpu.models.spade_fused import FusedCaps, FusedSpadeTPU
+
+    # use_pallas=True (interpret mode on CPU) so the kernel branch of the
+    # fused program — what a real multi-host TPU runs — is the one tested,
+    # mirroring eng_k above
+    feng = FusedSpadeTPU(vdb, minsup, mesh=mesh, caps=FusedCaps(f_cap=256),
+                         use_pallas=True)
+    fgot = feng.mine()
+    ok_f = fgot is not None and patterns_text(fgot) == patterns_text(want)
+
     print(f"MULTIHOST_OK pid={pid} patterns={len(got)} parity={ok} "
-          f"pallas_parity={ok_k} cspade_parity={ok_c} tsr_parity={ok_r}",
+          f"pallas_parity={ok_k} cspade_parity={ok_c} tsr_parity={ok_r} "
+          f"fused_parity={ok_f}",
           flush=True)
-    assert ok and ok_k and ok_c and ok_r
+    assert ok and ok_k and ok_c and ok_r and ok_f
     shutdown_distributed()
 
 

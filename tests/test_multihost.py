@@ -50,3 +50,4 @@ def test_two_process_mesh_parity():
         assert "MULTIHOST_OK" in out and "parity=True" in out, out
         assert "pallas_parity=True" in out, out
         assert "cspade_parity=True" in out and "tsr_parity=True" in out, out
+        assert "fused_parity=True" in out, out
