@@ -30,6 +30,7 @@ import bisect
 import functools
 import heapq
 import itertools
+import time
 from fractions import Fraction
 from typing import List, Optional, Tuple
 
@@ -40,7 +41,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
-from spark_fsm_tpu.models._common import device_hbm_budget, next_pow2
+from spark_fsm_tpu.models._common import (
+    device_hbm_budget, load_checkpoint, next_pow2)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.parallel import multihost as MH
@@ -402,9 +404,55 @@ class TsrTPU:
         arr = np.asarray(out)
         return arr[0, cols].astype(np.int64), arr[1, cols].astype(np.int64)
 
+    # --------------------------------------------------------- checkpoints
+
+    def frontier_fingerprint(self) -> dict:
+        """Identity a frontier checkpoint binds to (SURVEY.md sec 5
+        checkpoint row, same contract as SpadeTPU.frontier_fingerprint):
+        queue entries hold support-order LOCAL item indices, which are
+        only meaningful for the exact same (vdb, k, minconf, max_side) —
+        a changed search must restart fresh, not resume garbage."""
+        ids = self.vdb.item_ids
+        return {
+            "algo": "tsr",
+            "k": self.k,
+            "minconf": float(self.minconf),
+            "max_side": self.max_side,
+            "n_items": int(self.vdb.n_items),
+            "n_sequences": int(self.vdb.n_sequences),
+            "item_ids_head": [int(i) for i in ids[:8]],
+            "item_ids_sum": int(ids.astype(np.int64).sum()),
+        }
+
+    def frontier_state(self, queue, results, m: int, minsup: int) -> dict:
+        """JSON-able snapshot of a paused best-first round.
+
+        Unlike the SPADE engines' append-only result deltas, a TSR round's
+        accepted-rule set SHRINKS when the internal minsup rises, so every
+        snapshot carries the FULL current set (``results_done=0`` makes
+        StoreCheckpoint rewrite its list rather than append).  Bound-pruned
+        queue entries (< minsup) are dropped — pop_batch would discard
+        them anyway — keeping snapshots proportional to the live frontier.
+        """
+        return {
+            "version": 1,
+            "fingerprint": self.frontier_fingerprint(),
+            "m": int(m),
+            "minsup": int(minsup),
+            "stack": [[int(-nb), [int(i) for i in x], [int(j) for j in y],
+                       bool(cr)]
+                      for nb, x, y, cr in queue if -nb >= minsup],
+            "results_done": 0,
+            "results": [[[int(i) for i in x], [int(j) for j in y],
+                         int(sup), int(supx)]
+                        for sup, supx, x, y in results],
+        }
+
     # ---------------------------------------------------------------- mine
 
-    def _mine_restricted(self, m: int) -> Tuple[List[RuleResult], int]:
+    def _mine_restricted(self, m: int, resume: Optional[dict] = None,
+                         checkpoint_cb=None,
+                         every_s: float = 30.0) -> Tuple[List[RuleResult], int]:
         """Full search over the top-m items; returns (results, s_k)."""
         self.chunk = self._round_chunk(m)
         sup_it = self._sup_sorted[:m].astype(np.int64)
@@ -425,9 +473,19 @@ class TsrTPU:
         # themselves, and the FINAL rule set is pop-order independent (the
         # end-of-round s_k filter is exact), so tie order is free to vary.
         sup_l = sup_it.tolist()  # python ints: no np-scalar overhead below
-        queue: List[Tuple[int, Tuple[int, ...], Tuple[int, ...], bool]] = [
-            (-(sup_l[j] if sup_l[j] < sup_l[i] else sup_l[i]), (i,), (j,), True)
-            for i in range(m) for j in range(m) if i != j]
+        if resume is not None:
+            minsup = int(resume["minsup"])
+            results = [(int(sup), int(supx), tuple(x), tuple(y))
+                       for x, y, sup, supx in resume["results"]]
+            sup_sorted = sorted(r[0] for r in results)
+            queue = [(-int(b), tuple(x), tuple(y), bool(cr))
+                     for b, x, y, cr in resume["stack"]]
+            self.stats["resumed_nodes"] = len(queue)
+        else:
+            queue = [
+                (-(sup_l[j] if sup_l[j] < sup_l[i] else sup_l[i]),
+                 (i,), (j,), True)
+                for i in range(m) for j in range(m) if i != j]
         heapq.heapify(queue)
 
         # sup_it is sorted descending, so "items with sup >= minsup" is the
@@ -452,23 +510,8 @@ class TsrTPU:
                 batch.append((x, y, cr))
             return batch
 
-        # Pipeline: keep PIPELINE_DEPTH batches in flight so the blocking
-        # readback of batch i overlaps the device work of batch i+1 and the
-        # host-side heap work below.  Candidates dispatched with a stale
-        # (lower) minsup are wasted work at worst, never wrong — sup/conf
-        # acceptance and the final s_k filter use exact values.
-        inflight: List[Tuple[list, object]] = []
-        while True:
-            while queue and len(inflight) < self.PIPELINE_DEPTH:
-                batch = pop_batch()
-                if not batch:
-                    break
-                handle = self._dispatch_eval(
-                    p1, s1, [(x, y) for x, y, _ in batch])
-                inflight.append((batch, handle))
-            if not inflight:
-                break
-            batch, handle = inflight.pop(0)
+        def consume(batch, handle):
+            nonlocal minsup, results, jcut
             sups, supxs = self._resolve_eval(handle, len(batch))
             # conf test as exact integer cross-multiply (no per-rule
             # Fraction construction): sup/supx >= num/den
@@ -503,6 +546,32 @@ class TsrTPU:
                             push(queue, (-(s_c if s_c < sup else sup),
                                          x, y + (c,), True))
 
+        # Pipeline: keep PIPELINE_DEPTH batches in flight so the blocking
+        # readback of batch i overlaps the device work of batch i+1 and the
+        # host-side heap work below.  Candidates dispatched with a stale
+        # (lower) minsup are wasted work at worst, never wrong — sup/conf
+        # acceptance and the final s_k filter use exact values.
+        inflight: List[Tuple[list, object]] = []
+        last_ckpt = time.monotonic()
+        while True:
+            while queue and len(inflight) < self.PIPELINE_DEPTH:
+                batch = pop_batch()
+                if not batch:
+                    break
+                handle = self._dispatch_eval(
+                    p1, s1, [(x, y) for x, y, _ in batch])
+                inflight.append((batch, handle))
+            if not inflight:
+                break
+            consume(*inflight.pop(0))
+            if (checkpoint_cb is not None
+                    and time.monotonic() - last_ckpt >= every_s):
+                while inflight:  # drain for a consistent frontier
+                    consume(*inflight.pop(0))
+                checkpoint_cb(self.frontier_state(queue, results, m, minsup))
+                self.stats["checkpoints"] = self.stats.get("checkpoints", 0) + 1
+                last_ckpt = time.monotonic()
+
         s_k = s_k_threshold()
         # local indices are support-ordered; canonical form sorts by item id
         out = [
@@ -512,12 +581,37 @@ class TsrTPU:
         ]
         return sort_rules(out), s_k
 
-    def mine(self) -> List[RuleResult]:
+    def mine(self, *, resume: Optional[dict] = None, checkpoint_cb=None,
+             checkpoint_every_s: float = 30.0) -> List[RuleResult]:
+        """Run the top-k search; optionally resumable (SURVEY.md sec 5
+        checkpoint row) — TSR mines are the framework's longest jobs, so
+        they benefit most from surviving a crash.
+
+        Args mirror SpadeTPU.mine: ``resume`` is a ``frontier_state``
+        snapshot (fingerprint must match, ValueError otherwise);
+        ``checkpoint_cb`` is called with a snapshot at most every
+        ``checkpoint_every_s`` seconds, after draining the in-flight
+        pipeline.  A resumed mine restarts at the snapshot's deepening
+        round m — earlier (completed) rounds are never replayed.
+        """
+        if resume is not None:
+            fp = resume.get("fingerprint")
+            if fp != self.frontier_fingerprint():
+                raise ValueError(
+                    "frontier checkpoint does not match this engine's "
+                    f"(vdb, k, minconf, max_side); checkpointed {fp}, "
+                    f"engine {self.frontier_fingerprint()}")
         n_total = self.vdb.n_items
-        m = max(1, min(self.item_cap, n_total))
+        if resume is not None:
+            m = max(1, min(int(resume["m"]), n_total))
+        else:
+            m = max(1, min(self.item_cap, n_total))
         while True:
             self.stats["deepening_rounds"] += 1
-            results, s_k = self._mine_restricted(m)
+            results, s_k = self._mine_restricted(
+                m, resume=resume, checkpoint_cb=checkpoint_cb,
+                every_s=checkpoint_every_s)
+            resume = None  # only the first (snapshot's) round resumes
             if m >= n_total:
                 return results
             next_item_sup = int(self._sup_sorted[m])
@@ -567,24 +661,35 @@ class TsrCPU(TsrTPU):
 
 def mine_tsr_tpu(db: SequenceDB, k: int, minconf: float, *,
                  mesh: Optional[Mesh] = None,
-                 stats_out: Optional[dict] = None, **kwargs) -> List[RuleResult]:
+                 stats_out: Optional[dict] = None,
+                 checkpoint=None, **kwargs) -> List[RuleResult]:
+    """``checkpoint`` (optional): an object with ``load() -> Optional[dict]``,
+    ``save(state)``, and ``every_s`` — a stale/mismatched snapshot is
+    ignored (the mine restarts fresh), same contract as mine_spade_tpu."""
     vdb = build_vertical(db, min_item_support=1)
     if vdb.n_items == 0:
         return []
     eng = TsrTPU(vdb, k, minconf, mesh=mesh, **kwargs)
-    results = eng.mine()
+    resume, save_cb, every_s = load_checkpoint(
+        checkpoint, eng.frontier_fingerprint())
+    results = eng.mine(resume=resume, checkpoint_cb=save_cb,
+                       checkpoint_every_s=every_s)
     if stats_out is not None:
         stats_out.update(eng.stats)
     return results
 
 
 def mine_tsr_cpu(db: SequenceDB, k: int, minconf: float, *,
-                 stats_out: Optional[dict] = None, **kwargs) -> List[RuleResult]:
+                 stats_out: Optional[dict] = None,
+                 checkpoint=None, **kwargs) -> List[RuleResult]:
     vdb = build_vertical(db, min_item_support=1)
     if vdb.n_items == 0:
         return []
     eng = TsrCPU(vdb, k, minconf, **kwargs)
-    results = eng.mine()
+    resume, save_cb, every_s = load_checkpoint(
+        checkpoint, eng.frontier_fingerprint())
+    results = eng.mine(resume=resume, checkpoint_cb=save_cb,
+                       checkpoint_every_s=every_s)
     if stats_out is not None:
         stats_out.update(eng.stats)
     return results

@@ -77,27 +77,40 @@ class StoreCheckpoint:
     so a retry against changed data safely restarts fresh instead of
     resuming garbage.
 
-    Two keys: ``fsm:frontier:{uid}`` holds the (small) frontier snapshot,
+    Two keys: ``fsm:frontier:{uid}`` holds the frontier snapshot,
     ``fsm:frontier:results:{uid}`` is an APPEND-ONLY list of result-delta
     chunks — each save writes only the patterns found since the previous
-    one, so checkpoint cost tracks the frontier, not the full output."""
+    one, so checkpoint cost tracks the frontier, not the full output.
+
+    A ``results_done=0`` save (a fresh mine's first snapshot, or EVERY
+    snapshot of a full-rewrite engine like TSR, whose accepted set shrinks
+    as minsup rises) embeds its results INSIDE the meta value instead: one
+    atomic SET.  A delete-list-then-rewrite scheme would reintroduce the
+    torn-snapshot hazard the count check cannot catch — consecutive top-k
+    rewrites routinely have the SAME length, so an old meta paired with a
+    newer list would pass ``results_total`` and resume duplicated rules."""
 
     def __init__(self, store: ResultStore, uid: str,
                  every_s: float = 30.0) -> None:
         self.store, self.uid, self.every_s = store, uid, every_s
         self._meta_key = f"fsm:frontier:{uid}"
         self._results_key = f"fsm:frontier:results:{uid}"
+        self._inline: list = []  # results_done=0 part of the loaded snapshot
 
     def load(self) -> Optional[dict]:
         raw = self.store.get(self._meta_key)
         if not raw:
             return None
         state = json.loads(raw)
-        results = []
+        inline = state.pop("results_inline", [])
+        results = list(inline)
         for chunk in self.store.lrange(self._results_key):
             results.extend(json.loads(chunk))
         if len(results) != state.pop("results_total", -1):
             return None  # torn snapshot (killed mid-save): refuse to resume
+        # append-mode saves after this resume must re-embed the inline part
+        # (their meta overwrites the one that carried it)
+        self._inline = inline
         state["results"] = results
         return state
 
@@ -105,12 +118,18 @@ class StoreCheckpoint:
         delta = state.pop("results")
         done = state.pop("results_done")
         if done == 0:
-            self.store.delete(self._results_key)  # fresh mine, fresh list
-        if delta:
-            self.store.rpush(self._results_key, json.dumps(delta))
-        state["results_total"] = done + len(delta)
-        # meta written LAST: results_total only matches the list once the
-        # delta is in, so a kill between the writes reads as torn, not valid
+            # single atomic meta SET; the chunk list (possibly stale from a
+            # crashed earlier incarnation) is dropped
+            self.store.delete(self._results_key)
+            self._inline = delta
+            state["results_total"] = len(delta)
+        else:
+            if delta:
+                self.store.rpush(self._results_key, json.dumps(delta))
+            state["results_total"] = done + len(delta)
+        state["results_inline"] = self._inline
+        # meta written LAST: results_total only matches inline+list once
+        # the delta is in, so a kill between writes reads as torn, not valid
         self.store.set(self._meta_key, json.dumps(state))
         log_event("frontier_checkpoint", uid=self.uid,
                   stack=len(state["stack"]), results=state["results_total"])

@@ -35,7 +35,9 @@ class AlgorithmPlugin:
     """``extract(req, db, stats=None, checkpoint=None)``; a provided
     ``stats`` dict receives the engine's observability counters (SURVEY.md
     sec 5 metrics row); ``checkpoint`` (load/save/every_s) enables frontier
-    resume where the engine supports it (SPADE_TPU, constrained or not)."""
+    resume where the engine supports it — SPADE_TPU (constrained or not:
+    DFS stack) and TSR/TSR_TPU (best-first queue + current top-k); only
+    the CPU-oracle SPADE plugin drops it (flagged in stats)."""
 
     name: str
     kind: str  # "patterns" | "rules"
@@ -132,20 +134,18 @@ def _tsr_cpu(req: ServiceRequest, db: SequenceDB,
              stats: Optional[dict] = None, checkpoint=None) -> Results:
     from spark_fsm_tpu.models.tsr import mine_tsr_cpu
 
-    _checkpoint_unsupported(checkpoint, "TSR", stats)
     k, minconf, max_side = _tsr_params(req)
     return mine_tsr_cpu(db, k, minconf, max_side=max_side, stats_out=stats,
-                        **_tsr_kwargs())
+                        checkpoint=checkpoint, **_tsr_kwargs())
 
 
 def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
              stats: Optional[dict] = None, checkpoint=None) -> Results:
     from spark_fsm_tpu.models.tsr import mine_tsr_tpu
 
-    _checkpoint_unsupported(checkpoint, "TSR_TPU", stats)
     k, minconf, max_side = _tsr_params(req)
     return mine_tsr_tpu(db, k, minconf, max_side=max_side, mesh=config.get_mesh(),
-                        stats_out=stats, **_tsr_kwargs())
+                        stats_out=stats, checkpoint=checkpoint, **_tsr_kwargs())
 
 
 ALGORITHMS: Dict[str, AlgorithmPlugin] = {

@@ -107,6 +107,31 @@ def test_wrapper_ignores_stale_checkpoint():
     assert patterns_text(got) == patterns_text(want)
 
 
+def test_store_checkpoint_rewrite_saves_are_atomic():
+    """Full-rewrite saves (results_done=0 every time — TSR) must be one
+    atomic meta SET: a delete-then-rewrite list would let a kill pair an
+    old meta with a newer list of the SAME length (top-k rewrites
+    routinely match lengths), which the count check cannot catch."""
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "job2")
+    ckpt.save({"version": 1, "stack": [[5, [0], [1], True]],
+               "results_done": 0, "results": [[[0], [1], 5, 9]]})
+    ckpt.save({"version": 1, "stack": [[4, [0], [2], True]],
+               "results_done": 0, "results": [[[0], [2], 6, 9]]})
+    assert store.lrange("fsm:frontier:results:job2") == []  # never listed
+    state = ckpt.load()
+    assert state["results"] == [[[0], [2], 6, 9]]  # exactly the last save
+    assert state["stack"] == [[4, [0], [2], True]]
+
+    # a NEW instance resuming this snapshot must carry the inline part
+    # into its own append-mode saves (its meta overwrites the carrier)
+    ckpt2 = StoreCheckpoint(store, "job2")
+    assert ckpt2.load()["results"] == [[[0], [2], 6, 9]]
+    ckpt2.save({"version": 1, "stack": [], "results_done": 1,
+                "results": [[[9], [8], 2, 2]]})
+    assert ckpt2.load()["results"] == [[[0], [2], 6, 9], [[9], [8], 2, 2]]
+
+
 def test_store_checkpoint_roundtrip_and_job_clear():
     store = ResultStore()
     ckpt = StoreCheckpoint(store, "job1", every_s=5.0)
@@ -291,3 +316,92 @@ def test_constrained_resume_rejects_changed_constraints():
                                 minsup, maxgap=3)
     with pytest.raises(ValueError, match="fingerprint|does not match"):
         other.mine(resume=state)
+
+
+def test_tsr_crash_resume_parity():
+    """Kill a TSR mine mid-round; a fresh engine resuming the last
+    checkpoint must produce the exact top-k rule set.  TSR snapshots are
+    FULL (results_done always 0): the accepted-rule set shrinks when the
+    internal minsup rises, so deltas cannot represent it."""
+    from spark_fsm_tpu.models.tsr import TsrTPU
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    db = _db()
+    vdb = build_vertical(db, min_item_support=1)
+
+    class Crash(Exception):
+        pass
+
+    saved = []
+
+    def cb(state):
+        assert state["results_done"] == 0
+        saved.append(state)
+        if len(saved) == 2:
+            raise Crash
+
+    # tiny pinned chunk -> many batches -> the every_s=0 callback fires
+    # between them, well before the round's frontier drains
+    eng = TsrTPU(vdb, k=10, minconf=0.4, max_side=2, chunk=16)
+    with pytest.raises(Crash):
+        eng.mine(checkpoint_cb=cb, checkpoint_every_s=0.0)
+    assert len(saved) == 2
+    state = json.loads(json.dumps(saved[-1]))
+    assert state["stack"], "crash happened after the frontier emptied"
+
+    eng2 = TsrTPU(build_vertical(db, min_item_support=1),
+                  k=10, minconf=0.4, max_side=2)
+    got = eng2.mine(resume=state)
+    assert eng2.stats["resumed_nodes"] == len(state["stack"])
+    want = TsrTPU(build_vertical(db, min_item_support=1),
+                  k=10, minconf=0.4, max_side=2).mine()
+    assert rules_text(got) == rules_text(want)
+
+
+def test_tsr_resume_rejects_mismatched_fingerprint():
+    from spark_fsm_tpu.models.tsr import TsrTPU
+
+    db = _db()
+    vdb = build_vertical(db, min_item_support=1)
+    state = TsrTPU(vdb, k=10, minconf=0.5,
+                   max_side=2).frontier_state([], [], m=4, minsup=1)
+    for other in (TsrTPU(vdb, k=11, minconf=0.5, max_side=2),
+                  TsrTPU(vdb, k=10, minconf=0.6, max_side=2),
+                  TsrTPU(vdb, k=10, minconf=0.5, max_side=3)):
+        with pytest.raises(ValueError, match="fingerprint|does not match"):
+            other.mine(resume=state)
+
+
+def test_tsr_service_checkpoint_plumbing():
+    """A TSR_TPU train job with checkpoint=1 writes frontier snapshots and
+    clears them once the rules are durable (checkpoint support is no
+    longer SPADE-only)."""
+    store = ResultStore()
+    master = Master(store=store)
+    seen = {"frontier": False}
+    orig_set = store.set
+
+    def spy_set(key, value):
+        if key.startswith("fsm:frontier:"):
+            seen["frontier"] = True
+        orig_set(key, value)
+
+    store.set = spy_set
+    try:
+        db_lines = "\n".join(
+            " -1 ".join(str(i) for i in seq_parts) + " -2"
+            for seq_parts in [(1, 2, 3), (1, 2), (2, 3), (1, 3), (3, 2)]
+            for _ in range(4))
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "TSR_TPU", "source": "INLINE",
+            "sequences": db_lines, "k": "5", "minconf": "0.3",
+            "max_side": "2", "checkpoint": "1", "checkpoint_every_s": "0"}))
+        uid = resp.data["uid"]
+        assert _wait(store, uid) == "finished"
+        assert seen["frontier"], "no frontier snapshot was ever written"
+        assert store.get(f"fsm:frontier:{uid}") is None  # cleared at end
+        assert store.rules(uid) is not None
+        stats = json.loads(store.get(f"fsm:stats:{uid}") or "{}")
+        assert "checkpoint_unsupported" not in stats
+    finally:
+        master.shutdown()
