@@ -353,13 +353,17 @@ class TsrTPU:
         loop pipeline the next dispatch behind the current readback."""
         n = len(cands)
         # Candidates dispatch per side-size bucket (pow2 km), NOT at one
-        # batch-wide kmax: the km kernel keeps ~2*km live [chunk, S_local,
-        # W] gather temps, so the adaptive width must NARROW as km grows
-        # (a km=4 launch at the km=1 width = 27G of temps on a 16G v5e) —
-        # and narrowing the WHOLE mixed batch for one large-side candidate
-        # would 4x the dispatch latency of the small-side majority.
+        # batch-wide kmax: the km kernel's live-temp footprint grows with
+        # km, so the adaptive width must NARROW as km grows — and
+        # narrowing the WHOLE mixed batch for one large-side candidate
+        # would multiply the dispatch latency of the small-side majority.
         # Bucketing keeps each candidate at its own bucket's widest safe
-        # launch.  A caller-pinned chunk is honored as-is.
+        # launch.  The 1/km scale factor is empirical (v5e, 15G budget,
+        # Kosarak-shaped S): km=4 at the km=1 width allocated 27.2G and
+        # OOMed; km=2 at that width fits (~12.4G, right at the ceiling,
+        # with XLA remat fusions in the dump) but measured no faster than
+        # half width, so the headroom is kept.  A caller-pinned chunk is
+        # honored as-is.
         kms = np.empty(n, np.int32)
         for r, (x, y) in enumerate(cands):
             side = max(len(x), len(y))

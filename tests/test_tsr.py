@@ -117,10 +117,11 @@ def test_no_dense_bitmap_materialization():
 
 
 def test_launch_width_narrows_with_side_bucket():
-    # The eval kernel holds ~2*km live [chunk, S, W] gather temps, so the
-    # adaptive launch width must shrink as the side-size bucket grows —
-    # a km=4 launch at the km=1 width OOMs real HBM (v5e: 27G on a 16G
-    # chip).  A caller-pinned chunk is honored unchanged.
+    # The eval kernel's live-temp footprint grows with km, so the
+    # adaptive launch width must shrink by 1/km as the side-size bucket
+    # grows — a km=4 launch at the km=1 width OOMs real HBM (v5e: 27G on
+    # a 16G chip; see _dispatch_eval).  A caller-pinned chunk is honored
+    # unchanged.
     db = synthetic_db(3, n_sequences=40, n_items=12, mean_itemsets=5.0)
     vdb = build_vertical(db, min_item_support=1)
     eng = TsrTPU(vdb, k=5, minconf=0.5)
