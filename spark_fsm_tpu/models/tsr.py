@@ -419,6 +419,7 @@ class TsrTPU:
         ids = self.vdb.item_ids
         return {
             "algo": "tsr",
+            "stack_format": 2,  # 2 = lazy sibling-chain entries
             "k": self.k,
             "minconf": float(self.minconf),
             "max_side": self.max_side,
@@ -444,8 +445,9 @@ class TsrTPU:
             "m": int(m),
             "minsup": int(minsup),
             "stack": [[int(-nb), [int(i) for i in x], [int(j) for j in y],
-                       bool(cr)]
-                      for nb, x, y, cr in queue if -nb >= minsup],
+                       bool(cr), int(side), int(psup)]
+                      for nb, x, y, cr, side, psup in queue
+                      if -nb >= minsup],
             "results_done": 0,
             "results": [[[int(i) for i in x], [int(j) for j in y],
                          int(sup), int(supx)]
@@ -472,45 +474,93 @@ class TsrTPU:
                 return 1
             return sup_sorted[-self.k]
 
-        # queue: (-bound, X, Y, can_right); X/Y are local index tuples.
-        # No tie-break counter: entries are totally ordered by the tuples
-        # themselves, and the FINAL rule set is pop-order independent (the
-        # end-of-round s_k filter is exact), so tie order is free to vary.
+        # queue: (-bound, X, Y, can_right, side, psup); X/Y are local index
+        # tuples.  No tie-break counter: entries are totally ordered by the
+        # tuples themselves, and the FINAL rule set is pop-order
+        # independent (the end-of-round s_k filter is exact), so tie order
+        # is free to vary.
+        #
+        # Expansion is LAZY ("sibling chains"): a popped entry re-pushes
+        # only its next sibling — the same-parent candidate whose variable
+        # item (the LAST of the `side` tuple, 0 = X, 1 = Y) is the next
+        # admissible index — instead of a parent eagerly pushing its whole
+        # child range.  Items are support-sorted, so sibling bounds
+        # min(psup, sup[c]) are NONINCREASING in c: pushing the sibling at
+        # pop time can never miss a higher-bound entry, best-first order
+        # is preserved exactly, and a sibling whose bound drops below
+        # minsup kills the whole remaining chain.  Eager expansion pushed
+        # (and later bound-pruned) the full O(jcut) range per accepted
+        # candidate — the dominant host cost of large mines.
         sup_l = sup_it.tolist()  # python ints: no np-scalar overhead below
+
+        # sup_it is sorted descending, so "items with sup >= minsup" is the
+        # prefix [0, jcut) — chains stop there instead of scanning all m
+        # items against the sup check.
+        def item_cut() -> int:
+            return int(np.searchsorted(-sup_it, -minsup, side="right"))
+
+        jcut = item_cut()
+        queue: list = []
+        push = heapq.heappush
+
+        def chain_push(xf, yf, cr, side, psup, start):
+            """Push the chain entry whose variable item is the first
+            admissible index >= start (xf/yf are the FIXED side contents,
+            the variable item excluded).  Admissible = not already used in
+            the rule and bound >= minsup; bounds are nonincreasing along
+            the chain, so a failing bound ends it for good."""
+            fixed = set(xf) | set(yf)
+            c = start
+            while True:
+                if c >= jcut:
+                    return
+                if c not in fixed:
+                    s_c = sup_l[c]
+                    b = s_c if s_c < psup else psup
+                    if b < minsup:
+                        return
+                    break
+                c += 1
+            if side == 0:
+                push(queue, (-b, xf + (c,), yf, cr, 0, psup))
+            else:
+                push(queue, (-b, xf, yf + (c,), cr, 1, psup))
+
         if resume is not None:
             minsup = int(resume["minsup"])
             results = [(int(sup), int(supx), tuple(x), tuple(y))
                        for x, y, sup, supx in resume["results"]]
             sup_sorted = sorted(r[0] for r in results)
-            queue = [(-int(b), tuple(x), tuple(y), bool(cr))
-                     for b, x, y, cr in resume["stack"]]
+            jcut = item_cut()
+            queue = [(-int(b), tuple(x), tuple(y), bool(cr), int(side),
+                      int(psup))
+                     for b, x, y, cr, side, psup in resume["stack"]]
+            heapq.heapify(queue)
             self.stats["resumed_nodes"] = len(queue)
         else:
-            queue = [
-                (-(sup_l[j] if sup_l[j] < sup_l[i] else sup_l[i]),
-                 (i,), (j,), True)
-                for i in range(m) for j in range(m) if i != j]
-        heapq.heapify(queue)
-
-        # sup_it is sorted descending, so "items with sup >= minsup" is the
-        # prefix [0, jcut) — the expansion loops stop there instead of
-        # scanning all m items against the sup check.
-        def item_cut() -> int:
-            return int(np.searchsorted(-sup_it, -minsup, side="right"))
-
-        jcut = item_cut()
+            # roots: one right-side chain per item i over partners j != i
+            # (bound min(sup_i, sup_j) is nonincreasing in j) — m entries
+            # instead of the m^2 of eager enumeration
+            for i in range(m):
+                chain_push((i,), (), True, 1, sup_l[i], 0)
 
         def pop_batch():
             batch = []
             while queue and len(batch) < self.chunk:
-                nb, x, y, cr = queue[0]
+                nb, x, y, cr, side, psup = queue[0]
                 if -nb < minsup:
-                    # every remaining entry is bound-pruned (minsup only
-                    # rises; in-flight batches may still push fresh
+                    # every remaining entry is bound-pruned, and chain
+                    # siblings bound even lower (minsup only rises;
+                    # in-flight batches may still push fresh
                     # above-threshold children afterwards, which is fine)
                     queue.clear()
                     break
                 heapq.heappop(queue)
+                # advance this entry's sibling chain before evaluating it
+                if side == 0:
+                    chain_push(x[:-1], y, cr, 0, psup, x[-1] + 1)
+                else:
+                    chain_push(x, y[:-1], cr, 1, psup, y[-1] + 1)
                 batch.append((x, y, cr))
             return batch
 
@@ -520,7 +570,6 @@ class TsrTPU:
             # conf test as exact integer cross-multiply (no per-rule
             # Fraction construction): sup/supx >= num/den
             num, den = _conf_frac(self.minconf)
-            push = heapq.heappush
             for (x, y, can_right), sup, supx in zip(
                     batch, sups.tolist(), supxs.tolist()):
                 if sup < minsup:
@@ -534,21 +583,13 @@ class TsrTPU:
                         results = [r for r in results if r[0] >= minsup]
                         del sup_sorted[: bisect.bisect_left(sup_sorted, minsup)]
                         jcut = item_cut()
-                # expansions: bound = min(sup, sup_it[c]) >= minsup needs
-                # sup >= minsup (checked above) and c < jcut
-                used = set(x) | set(y)
+                # expansions: start one left chain (grow X; kills further
+                # right expansion) and one right chain (grow Y) — their
+                # siblings materialize lazily as the chains are popped
                 if self.max_side is None or len(x) < self.max_side:
-                    for c in range(max(x) + 1, jcut):
-                        if c not in used:
-                            s_c = sup_l[c]
-                            push(queue, (-(s_c if s_c < sup else sup),
-                                         x + (c,), y, False))
+                    chain_push(x, y, False, 0, sup, max(x) + 1)
                 if can_right and (self.max_side is None or len(y) < self.max_side):
-                    for c in range(max(y) + 1, jcut):
-                        if c not in used:
-                            s_c = sup_l[c]
-                            push(queue, (-(s_c if s_c < sup else sup),
-                                         x, y + (c,), True))
+                    chain_push(x, y, True, 1, sup, max(y) + 1)
 
         # Pipeline: keep PIPELINE_DEPTH batches in flight so the blocking
         # readback of batch i overlaps the device work of batch i+1 and the
