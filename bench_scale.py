@@ -1,0 +1,111 @@
+"""Full-scale spot-check harness (BASELINE.md configs at REAL dataset size).
+
+`bench_suite.py` runs all five eval configs at reduced scale so every run
+can attest oracle parity (full-size oracle mines take minutes to hours);
+this harness runs selected configs at scale=1.0 WITHOUT the oracle to
+prove the engines handle the real sizes — the memory plans, shape
+bucketing, and launch sizing, not just the algorithmic speedups.  Parity
+at full scale is still guaranteed transitively: the engines are
+byte-identical to the oracles at every tested scale and contain no
+scale-dependent branches that change WHAT is enumerated (only HOW wide
+the launches are).
+
+Each config prints one JSON line.  Synthetic data uses the vectorized
+generators (`fast=True`, see data/synth.py — a full Kosarak draw takes
+seconds instead of ~35 minutes).
+
+Usage: python bench_scale.py [2] [3]   (default: both)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def config2() -> dict:
+    """SPADE over the full MSNBC-shaped DB (990k seqs, mesh path)."""
+    import jax
+
+    from spark_fsm_tpu.data.synth import msnbc_like
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    t0 = time.monotonic()
+    db = msnbc_like(scale=1.0, fast=True)
+    t1 = time.monotonic()
+    ms = abs_minsup(0.005, len(db))
+    mesh = make_mesh(len(jax.devices()))
+    stats: dict = {}
+    cold0 = time.monotonic()
+    pats = mine_spade_tpu(db, ms, mesh=mesh, stats_out=stats)
+    cold1 = time.monotonic()
+    warm0 = time.monotonic()
+    pats2 = mine_spade_tpu(db, ms, mesh=mesh)
+    warm1 = time.monotonic()
+    assert pats == pats2
+    return {
+        "config": 2, "scale": 1.0,
+        "metric": "SPADE synthetic MSNBC-shaped FULL (990k seqs) "
+                  f"mesh({mesh.devices.size}) minsup=0.5%",
+        "sequences": len(db), "patterns": len(pats),
+        "datagen_s": round(t1 - t0, 2),
+        "cold_wall_s": round(cold1 - cold0, 2),
+        "wall_s": round(warm1 - warm0, 2),
+        "fused": bool(stats.get("fused")),
+        "platform": jax.default_backend(),
+    }
+
+
+def config3() -> dict:
+    """TSR top-k over the full Kosarak-shaped DB (990k seqs, 39.6k items)."""
+    import jax
+
+    from spark_fsm_tpu.data.synth import kosarak_like
+    from spark_fsm_tpu.data.vertical import build_vertical
+    from spark_fsm_tpu.models.tsr import TsrTPU
+
+    t0 = time.monotonic()
+    db = kosarak_like(scale=1.0, fast=True)
+    t1 = time.monotonic()
+    vdb = build_vertical(db, min_item_support=1)
+    t2 = time.monotonic()
+    eng = TsrTPU(vdb, 100, 0.5, max_side=2)
+    t3 = time.monotonic()
+    rules = eng.mine()
+    t4 = time.monotonic()
+    return {
+        "config": 3, "scale": 1.0,
+        "metric": "TSR_TPU synthetic Kosarak-shaped FULL "
+                  "(990k x 39.6k) k=100 minconf=0.5",
+        "sequences": vdb.n_sequences, "items": vdb.n_items,
+        "rules": len(rules),
+        "datagen_s": round(t1 - t0, 2),
+        "vertical_build_s": round(t2 - t1, 2),
+        "wall_s": round(t4 - t3, 2),
+        "evaluated": eng.stats["evaluated"],
+        "kernel_launches": eng.stats["kernel_launches"],
+        "platform": jax.default_backend(),
+    }
+
+
+def main() -> None:
+    from spark_fsm_tpu.utils.jitcache import enable_compile_cache
+
+    enable_compile_cache()
+    runners = {2: config2, 3: config3}
+    try:
+        which = {int(a) for a in sys.argv[1:]} or set(runners)
+    except ValueError:
+        which = set()
+    if not which or not which <= set(runners):
+        sys.exit(f"usage: python bench_scale.py [{' '.join(map(str, sorted(runners)))}]"
+                 f" — full-scale spot-check configs (got {sys.argv[1:]})")
+    for n in sorted(which):
+        print(json.dumps(runners[n]()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -174,6 +174,14 @@ class ConstrainedSpadeTPU:
         if pool_bytes is None:
             pool_bytes = auto_pool_bytes(mesh)
         slot_bytes = n_seq * self.n_pos * np.dtype(self.dtype.dtype).itemsize
+        # memory-safety ceiling on per-launch candidate tensors (see the
+        # unconstrained engine: [chunk, S, n_pos] temps scale with the
+        # sequence axis, and a fixed width OOMs at ~1M sequences)
+        max_chunk = max(4, next_pow2(
+            (int(pool_bytes) // 8) // max(slot_bytes, 1) + 1) // 2)
+        self.chunk = min(self.chunk, max_chunk)
+        self.recompute_chunk = min(self.recompute_chunk,
+                                   max(2, max_chunk // 2))
         budget_slots = max(32, min(int(pool_bytes) // max(slot_bytes, 1), 8192))
         self.pipeline_depth = min(self.pipeline_depth,
                                   max(1, budget_slots // 8))

@@ -298,6 +298,19 @@ class SpadeTPU:
             # worth real memory
             pool_bytes = auto_pool_bytes(mesh)
         slot_bytes = n_seq * n_words * 4
+        # Per-launch temps scale with the sequence axis: a join/materialize
+        # launch materializes a [chunk, S*W] tensor (plus the store-update
+        # copy when the backend honors no donation aliasing), so the fixed
+        # default width that is invisible at 77k sequences is a 7.5G temp
+        # at 990k (observed: config-2 full scale requested 22.7G on a
+        # 15.75G chip).  Clamp launch widths so each candidate tensor
+        # stays within ~1/8 of the pool budget — a memory-safety ceiling
+        # that overrides even an explicit chunk knob.
+        max_chunk = max(8, next_pow2(
+            (int(pool_bytes) // 8) // max(slot_bytes, 1) + 1) // 2)
+        self.chunk = min(self.chunk, max_chunk)
+        self.recompute_chunk = min(self.recompute_chunk,
+                                   max(4, max_chunk // 2))
         budget_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 32768))
         self.pipeline_depth = min(self.pipeline_depth,
                                   max(1, budget_slots // 8))
