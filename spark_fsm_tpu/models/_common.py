@@ -174,6 +174,23 @@ def next_pow2(n: int) -> int:
     return k
 
 
+def launch_width_cap(pool_bytes: int, slot_bytes: int, floor: int) -> int:
+    """Memory-safety ceiling on per-launch candidate widths.
+
+    A join/materialize launch materializes a ``[width, slot]`` tensor, so
+    the width caps at the slots-worth that fits ~1/8 of the (per-device)
+    pool budget, floored to a power of two; ``floor`` only guards against
+    degenerate zero widths.  ``slot_bytes`` must be the PER-DEVICE
+    footprint of one store row — under a mesh the launch is shard_map'd
+    over the sequence axis, so divide the global row bytes by the device
+    count before calling (a full-row figure would over-throttle the mesh
+    path by the device count).  A fixed default width that was invisible
+    at 77k sequences was a 7.5G temp at 990k (observed full-scale OOM:
+    22.7G requested on a 15.75G chip)."""
+    return max(int(floor), next_pow2(
+        (int(pool_bytes) // 8) // max(int(slot_bytes), 1) + 1) // 2)
+
+
 def auto_pool_bytes(mesh) -> int:
     """Default engine pool budget: 35% of the device's HBM.  Two engine
     working sets must be able to coexist (back-to-back mines overlap while

@@ -39,7 +39,8 @@ from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
     SlotPool, auto_pool_bytes, decode_frontier, encode_frontier,
-    load_checkpoint, next_pow2, scatter_build_store, zeros_fn)
+    launch_width_cap, load_checkpoint, next_pow2, scatter_build_store,
+    zeros_fn)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
@@ -174,11 +175,12 @@ class ConstrainedSpadeTPU:
         if pool_bytes is None:
             pool_bytes = auto_pool_bytes(mesh)
         slot_bytes = n_seq * self.n_pos * np.dtype(self.dtype.dtype).itemsize
-        # memory-safety ceiling on per-launch candidate tensors (see the
-        # unconstrained engine: [chunk, S, n_pos] temps scale with the
-        # sequence axis, and a fixed width OOMs at ~1M sequences)
-        max_chunk = max(4, next_pow2(
-            (int(pool_bytes) // 8) // max(slot_bytes, 1) + 1) // 2)
+        # memory-safety ceiling on per-launch candidate tensors (see
+        # _common.launch_width_cap: [chunk, S, n_pos] temps scale with
+        # the sequence axis, and a fixed width OOMs at ~1M sequences)
+        n_shards = 1 if mesh is None else mesh.devices.size
+        max_chunk = launch_width_cap(
+            pool_bytes, -(-slot_bytes // n_shards), 4)
         self.chunk = min(self.chunk, max_chunk)
         self.recompute_chunk = min(self.recompute_chunk,
                                    max(2, max_chunk // 2))

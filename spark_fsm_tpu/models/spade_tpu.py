@@ -53,7 +53,7 @@ from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
     SlotPool, auto_pool_bytes, decode_frontier, encode_frontier,
-    load_checkpoint, next_pow2, scatter_build_store)
+    launch_width_cap, load_checkpoint, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel import multihost as MH
@@ -298,16 +298,11 @@ class SpadeTPU:
             # worth real memory
             pool_bytes = auto_pool_bytes(mesh)
         slot_bytes = n_seq * n_words * 4
-        # Per-launch temps scale with the sequence axis: a join/materialize
-        # launch materializes a [chunk, S*W] tensor (plus the store-update
-        # copy when the backend honors no donation aliasing), so the fixed
-        # default width that is invisible at 77k sequences is a 7.5G temp
-        # at 990k (observed: config-2 full scale requested 22.7G on a
-        # 15.75G chip).  Clamp launch widths so each candidate tensor
-        # stays within ~1/8 of the pool budget — a memory-safety ceiling
-        # that overrides even an explicit chunk knob.
-        max_chunk = max(8, next_pow2(
-            (int(pool_bytes) // 8) // max(slot_bytes, 1) + 1) // 2)
+        # Memory-safety ceiling on launch widths (see launch_width_cap) —
+        # overrides even an explicit chunk knob; per-device row footprint,
+        # since mesh launches shard the sequence axis.
+        max_chunk = launch_width_cap(
+            pool_bytes, -(-slot_bytes // n_shards), 8)
         self.chunk = min(self.chunk, max_chunk)
         self.recompute_chunk = min(self.recompute_chunk,
                                    max(4, max_chunk // 2))

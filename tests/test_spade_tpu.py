@@ -95,3 +95,27 @@ def test_empty_and_trivial():
     assert mine_spade_tpu(parse_spmf("1 -2\n2 -2\n"), 2) == []
     res = mine_spade_tpu(parse_spmf("1 -2\n1 -2\n"), 2)
     assert res == [(((1,),), 2)]
+
+
+def test_launch_width_clamps_to_pool_budget():
+    # Per-launch temps are [chunk, S*W]: a fixed chunk default that is
+    # invisible at small S was a 7.5G materialize temp at 990k sequences
+    # (full-scale MSNBC OOM).  The width must clamp so a launch's
+    # candidate tensor stays within ~1/8 of the pool budget — overriding
+    # even an explicitly passed chunk — while parity is unaffected.
+    db = synthetic_db(seed=9, n_sequences=200, n_items=25, mean_itemsets=4.0,
+                      mean_itemset_size=1.3)
+    minsup = abs_minsup(0.03, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    slot_bytes = 200 * vdb.n_words * 4  # n_seq unpadded here (no mesh)
+    eng = SpadeTPU(vdb, minsup, pool_bytes=slot_bytes * 512, chunk=4096)
+    assert eng.chunk <= 64  # (512/8 = 64 slots' worth per launch)
+    assert patterns_text(eng.mine()) == patterns_text(mine_spade(db, minsup))
+
+    from spark_fsm_tpu.models.spade_constrained import ConstrainedSpadeTPU
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    ceng = ConstrainedSpadeTPU(vdb, minsup, maxgap=2,
+                               pool_bytes=1, chunk=4096)
+    assert ceng.chunk <= 8
+    assert patterns_text(ceng.mine()) == patterns_text(
+        mine_cspade(db, minsup, maxgap=2))
