@@ -24,8 +24,13 @@ import sys
 import time
 
 
-def config2() -> dict:
-    """SPADE over the full MSNBC-shaped DB (990k seqs, mesh path)."""
+def config2(parity: bool = False) -> dict:
+    """SPADE over the full MSNBC-shaped DB (990k seqs, mesh path).
+
+    ``parity``: also run the NumPy oracle on the full DB (~1 min) and
+    attest byte-identical pattern sets at real size — the only eval
+    config whose oracle is feasible at scale=1.0.
+    """
     import jax
 
     from spark_fsm_tpu.data.synth import msnbc_like
@@ -46,7 +51,7 @@ def config2() -> dict:
     pats2 = mine_spade_tpu(db, ms, mesh=mesh)
     warm1 = time.monotonic()
     assert pats == pats2
-    return {
+    out = {
         "config": 2, "scale": 1.0,
         "metric": "SPADE synthetic MSNBC-shaped FULL (990k seqs) "
                   f"mesh({mesh.devices.size}) minsup=0.5%",
@@ -57,6 +62,18 @@ def config2() -> dict:
         "fused": bool(stats.get("fused")),
         "platform": jax.default_backend(),
     }
+    if parity:
+        from spark_fsm_tpu.models.oracle import mine_spade
+        from spark_fsm_tpu.utils.canonical import patterns_text
+
+        o0 = time.monotonic()
+        want = mine_spade(db, ms)
+        o1 = time.monotonic()
+        out["oracle_wall_s"] = round(o1 - o0, 2)
+        out["parity"] = patterns_text(pats) == patterns_text(want)
+        out["speedup_vs_oracle"] = round(out["oracle_wall_s"]
+                                         / max(out["wall_s"], 1e-9), 2)
+    return out
 
 
 def config3() -> dict:
@@ -96,15 +113,20 @@ def main() -> None:
 
     enable_compile_cache()
     runners = {2: config2, 3: config3}
+    args = sys.argv[1:]
+    parity = "--parity" in args
+    args = [a for a in args if a != "--parity"]
     try:
-        which = {int(a) for a in sys.argv[1:]} or set(runners)
+        which = {int(a) for a in args} or set(runners)
     except ValueError:
         which = set()
     if not which or not which <= set(runners):
-        sys.exit(f"usage: python bench_scale.py [{' '.join(map(str, sorted(runners)))}]"
+        sys.exit(f"usage: python bench_scale.py [--parity] "
+                 f"[{' '.join(map(str, sorted(runners)))}]"
                  f" — full-scale spot-check configs (got {sys.argv[1:]})")
     for n in sorted(which):
-        print(json.dumps(runners[n]()), flush=True)
+        kwargs = {"parity": parity} if n == 2 else {}
+        print(json.dumps(runners[n](**kwargs)), flush=True)
 
 
 if __name__ == "__main__":
