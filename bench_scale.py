@@ -14,7 +14,9 @@ Each config prints one JSON line.  Synthetic data uses the vectorized
 generators (`fast=True`, see data/synth.py — a full Kosarak draw takes
 seconds instead of ~35 minutes).
 
-Usage: python bench_scale.py [2] [3]   (default: both)
+Usage: python bench_scale.py [--parity] [2] [3]   (default: both configs;
+--parity additionally runs the full-size oracle where feasible — config 2
+only — and attests byte-identical pattern sets)
 """
 
 from __future__ import annotations
@@ -124,6 +126,9 @@ def main() -> None:
         sys.exit(f"usage: python bench_scale.py [--parity] "
                  f"[{' '.join(map(str, sorted(runners)))}]"
                  f" — full-scale spot-check configs (got {sys.argv[1:]})")
+    if parity and 2 not in which:
+        sys.exit("--parity requires config 2 (the only config whose "
+                 "full-size oracle is feasible); rerun with 2 included")
     for n in sorted(which):
         kwargs = {"parity": parity} if n == 2 else {}
         print(json.dumps(runners[n](**kwargs)), flush=True)
