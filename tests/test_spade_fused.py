@@ -128,6 +128,34 @@ def test_eligibility():
     # validates the actual multi-controller execution)
 
 
+def test_mesh_scaled_caps_widen_the_frontier():
+    # FusedCaps.for_mesh grows the frontier with the device count at
+    # constant per-device traffic (the pair matrix shards its sequence
+    # axis) — this is what keeps the headline BMS-WebView-2 frontier
+    # (~2.6k nodes) fused on a v5e-8 where the single-chip 1024-node cap
+    # overflows.
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    assert FusedCaps.for_mesh(None).f_cap == 1024
+    mesh = make_mesh(len(jax.devices()))
+    caps = FusedCaps.for_mesh(mesh)
+    assert caps.f_cap == min(8192, 1024 * mesh.devices.size)
+    assert caps.c_cap == 8 * caps.f_cap  # emission cap tracks the frontier
+
+    # The routing property itself, at test size: a dense low-minsup DB
+    # whose frontier exceeds 1024 nodes overflows the single-chip caps
+    # (mine() -> None, the classic-engine fallback signal) and completes
+    # byte-identically to the oracle at the mesh-scale frontier width.
+    db = synthetic_db(seed=13, n_sequences=60, n_items=40,
+                      mean_itemsets=6.0, mean_itemset_size=2.0,
+                      correlation=0.8)
+    vdb = build_vertical(db, min_item_support=2)
+    assert FusedSpadeTPU(vdb, 2, caps=FusedCaps(f_cap=1024)).mine() is None
+    wide = FusedSpadeTPU(vdb, 2, caps=FusedCaps(f_cap=8192)).mine()
+    assert patterns_text(wide) == patterns_text(mine_spade(db, 2))
+
+
 def test_parity_mesh():
     import jax
     from spark_fsm_tpu.parallel.mesh import make_mesh
