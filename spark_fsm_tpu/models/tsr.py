@@ -45,6 +45,7 @@ from spark_fsm_tpu.models._common import (
     device_hbm_budget, load_checkpoint, next_pow2)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
+from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
@@ -137,6 +138,52 @@ def _prep_fn_mesh(mesh: Mesh):
                                  in_specs=(st,), out_specs=(st, st)))
 
 
+@functools.lru_cache(maxsize=16)
+def _kernel_layout_fn(mesh: Optional[Mesh], single: bool):
+    """[m, S, W] engine-layout prep rows -> FOLDED kernel layout
+    [m+1, S/128, 128] (single-word) / [m+1, W, S/128, 128], with an
+    appended ALL-ONES pad row — the AND identity rule_supports points
+    unused candidate slots at (see ops/pallas_tsr.py for why the seq
+    axis folds to (sublane, lane) tiles)."""
+    def body(p):
+        pk = jnp.transpose(p, (0, 2, 1))            # [m, W, S]
+        m, w, s = pk.shape
+        if single:
+            pk = pk.reshape(m, s // PT.LANE, PT.LANE)
+        else:
+            pk = pk.reshape(m, w, s // PT.LANE, PT.LANE)
+        ones = jnp.full((1,) + pk.shape[1:], 0xFFFFFFFF, jnp.uint32)
+        return jnp.concatenate([pk, ones], axis=0)
+
+    if mesh is None:
+        return jax.jit(body)
+    st_in = P(None, SEQ_AXIS, None)
+    st_out = (P(None, SEQ_AXIS, None) if single
+              else P(None, None, SEQ_AXIS, None))
+    return jax.jit(jax.shard_map(body, mesh=mesh,
+                                 in_specs=(st_in,), out_specs=st_out))
+
+
+@functools.lru_cache(maxsize=128)
+def _kernel_eval_fn(mesh: Optional[Mesh], km: int, sb: int,
+                    interpret: bool, single: bool):
+    """Jitted rule_supports launcher (+ psum under a mesh), cached per
+    bucket geometry like _eval_kernel."""
+    def body(p1k, s1k, xy):
+        out = PT.rule_supports(p1k, s1k, xy, km=km, s_block=sb,
+                               interpret=interpret)
+        if mesh is not None:
+            out = jax.lax.psum(out, SEQ_AXIS)
+        return out
+
+    if mesh is None:
+        return jax.jit(body)
+    st = (P(None, SEQ_AXIS, None) if single
+          else P(None, None, SEQ_AXIS, None))
+    return jax.jit(jax.shard_map(body, mesh=mesh,
+                                 in_specs=(st, st, P()), out_specs=P()))
+
+
 @functools.lru_cache(maxsize=256)
 def _eval_kernel(mesh: Optional[Mesh], kmax: int):
     """Jitted rule evaluator for side sizes <= kmax (bucketed compile).
@@ -206,6 +253,7 @@ class TsrTPU:
         item_cap: int = 256,
         max_side: Optional[int] = None,
         eval_budget_bytes: Optional[int] = None,
+        use_pallas="auto",
     ):
         self.vdb = vdb
         self.k = int(k)
@@ -224,9 +272,37 @@ class TsrTPU:
         # Each deepening round instead builds ONLY the top-m item rows from
         # the token table (host memory/HBM proportional to m, not n_items).
         self.n_seq = vdb.n_sequences
+        n_shards = 1 if mesh is None else mesh.devices.size
         if mesh is not None:
-            self.n_seq = pad_to_multiple(self.n_seq, mesh.devices.size)
+            self.n_seq = pad_to_multiple(self.n_seq, n_shards)
         self.n_words = vdb.n_words
+        # Pallas rule-support kernel (ops/pallas_tsr.py): streams seq
+        # blocks through VMEM instead of materializing [chunk, S, W]
+        # gather temps, so launches can be dispatch-width-bound instead of
+        # HBM-temp-bound.  "auto" = on for a real TPU backend; explicit
+        # True runs interpret mode off-TPU (tests); explicit False never
+        # probes the backend (the NumPy TsrCPU subclass must not
+        # initialize JAX).
+        if use_pallas == "auto":
+            backend = jax.default_backend()
+            self.use_pallas = backend == "tpu"
+            self._interpret = backend != "tpu"
+        elif use_pallas:
+            self.use_pallas = True
+            self._interpret = jax.default_backend() != "tpu"
+        else:
+            self.use_pallas = False
+            self._interpret = False
+        self._jnp_prep = None   # engine-layout prep for downgraded buckets
+        self._jnp_chunk = None  # budget-derived width for those buckets
+        self._pallas_bad: set = set()  # km buckets whose kernel failed
+        self._round_m = 0
+        if self.use_pallas:
+            # per-shard seq axis must tile the kernel's seq block, which
+            # itself must tile the folded (8, 128) layout
+            self._sb = PT.seq_block(self.n_words,
+                                    -(-self.n_seq // n_shards))
+            self.n_seq = pad_to_multiple(self.n_seq, n_shards * self._sb)
 
         # Per-launch dispatch latency dominates on remote/tunneled TPUs
         # (~100ms+ each; measured 6x wall-clock win going 256 -> 8192 on a
@@ -307,6 +383,17 @@ class TsrTPU:
         jit — the dense rows never exist on host.  Mesh: only the m selected
         rows are host-built, then sharded over the sequence axis.
         """
+        p1, s1 = self._prep_engine(m)
+        if self.use_pallas:
+            # folded kernel layout (all-ones pad row); the engine-layout
+            # intermediates are dropped — a downgraded bucket rebuilds
+            # them once per round (_dispatch_eval)
+            to_k = _kernel_layout_fn(self.mesh, self.n_words == 1)
+            return to_k(p1), to_k(s1)
+        return p1, s1
+
+    def _prep_engine(self, m: int):
+        """Engine-layout ([m, S, W]) prefix/suffix-OR rows."""
         if self.mesh is None:
             ti, ts, tw, tm = self._sel_tokens(self._order[:m])
             p1, s1 = _build_prep_single(
@@ -331,7 +418,22 @@ class TsrTPU:
         budget allows after the round's [m, S, W] prefix/suffix stores,
         assuming ~4 live [chunk, S_local, W] uint32 gather temps (the
         XLA-verified factor), floored to a power of two for shape
-        bucketing."""
+        bucketing.  The Pallas kernel path holds NO [chunk, S, W] temps
+        (seq blocks stream through VMEM), so its width is bounded by
+        dispatch cost alone."""
+        if self._chunk_user is not None:
+            return self._chunk_user
+        if self.use_pallas:
+            return 8192
+        return self._round_chunk_jnp(m)
+
+    def _round_chunk_jnp(self, m: int, resident_preps: int = 1) -> int:
+        """Budget-derived width for the jnp gather path.
+
+        ``resident_preps``: prep pairs alive in HBM when the launches
+        run — 1 normally; 2 for a kernel-mode mine's downgraded buckets,
+        where the kernel-layout pair stays resident next to the rebuilt
+        engine-layout one."""
         if self._chunk_user is not None:
             return self._chunk_user
         if self._eval_budget is None:
@@ -341,7 +443,7 @@ class TsrTPU:
         n_dev = 1 if self.mesh is None else self.mesh.devices.size
         s_local = max(1, self.n_seq // n_dev)
         per_cand = max(1, s_local * self.n_words * 4 * 4)
-        prep = 2 * m * s_local * self.n_words * 4
+        prep = resident_preps * 2 * m * s_local * self.n_words * 4
         budget = max(per_cand, self._eval_budget - prep)
         return max(128, min(8192, next_pow2(budget // per_cand + 1) // 2))
 
@@ -381,8 +483,34 @@ class TsrTPU:
             g_hi = g_lo
             while g_hi < n and kms[order[g_hi]] == km:
                 g_hi += 1
+            if self.use_pallas and km not in self._pallas_bad:
+                mark = len(parts)
+                try:
+                    base = self._dispatch_kernel_bucket(
+                        p1, s1, cands, order, g_lo, g_hi, km,
+                        parts, cols, base)
+                    g_lo = g_hi
+                    continue
+                except Exception as exc:  # pragma: no cover - device-specific
+                    # compile/lowering failures surface at the bucket's
+                    # first launch; mark only THIS km bucket bad (other
+                    # buckets keep the kernel) and evaluate it via the
+                    # jnp path, whose prep/width differ from the kernel's
+                    del parts[mark:]
+                    base = sum(p.shape[1] for p in parts)
+                    self._pallas_bad.add(km)
+                    self.stats[f"pallas_fallback_km{km}"] = repr(exc)
+            if self.use_pallas and self._jnp_prep is None:
+                # first jnp bucket while the kernel path is live: build
+                # the engine-layout prep + budget width it needs (both
+                # prep pairs stay resident -> resident_preps=2)
+                self._jnp_prep = self._prep_engine(self._round_m)
+                self._jnp_chunk = self._round_chunk_jnp(self._round_m,
+                                                        resident_preps=2)
+            pj, sj = self._jnp_prep if self._jnp_prep is not None else (p1, s1)
             fn = self._eval_fn(km)
-            c = self.chunk if self._chunk_user else max(32, self.chunk // km)
+            cw = self.chunk if not self.use_pallas else self._jnp_chunk
+            c = cw if self._chunk_user else max(32, cw // km)
             for lo in range(g_lo, g_hi, c):
                 hi = min(lo + c, g_hi)
                 xy = np.full((c, 2, km), -1, np.int32)
@@ -392,7 +520,7 @@ class TsrTPU:
                     xy[r - lo, 1, :len(y)] = y
                 cols[order[lo:hi]] = base + np.arange(hi - lo)
                 base += c
-                parts.append(fn(p1, s1, self._put(xy)))
+                parts.append(fn(pj, sj, self._put(xy)))
                 self.stats["kernel_launches"] += 1
             g_lo = g_hi
         self.stats["evaluated"] += n
@@ -402,6 +530,46 @@ class TsrTPU:
         except (AttributeError, NotImplementedError):
             pass  # method unavailable on this backend
         return out, cols
+
+    def _bucket_seq_block(self, km: int) -> int:
+        """Per-bucket kernel seq block: halve the engine block until the
+        bucket's 2*km double-buffered row blocks fit the scoped-VMEM
+        budget (large-km buckets of unlimited-side mines would otherwise
+        fail to compile); halving preserves the (8,128)-tile and
+        S-divisibility invariants."""
+        sb = self._sb
+        need = lambda b: 2 * km * 2 * self.n_words * b * 4
+        while (need(sb) > PT._VMEM_BUDGET and sb % 2 == 0
+               and (sb // 2) % (8 * PT.LANE) == 0):
+            sb //= 2
+        return sb
+
+    def _dispatch_kernel_bucket(self, p1k, s1k, cands, order, g_lo, g_hi,
+                                km, parts, cols, base):
+        """Pallas-path dispatch for one km bucket: full launch width (the
+        kernel streams seq blocks through VMEM — no [chunk, S, W] gather
+        temps to narrow for), candidate count padded to the out-block lane
+        width.  Appends to parts/cols and returns the advanced base."""
+        fn = _kernel_eval_fn(self.mesh, km, self._bucket_seq_block(km),
+                             self._interpret, self.n_words == 1)
+        c = self.chunk
+        for lo in range(g_lo, g_hi, c):
+            hi = min(lo + c, g_hi)
+            # pow2 width bucket (floor C_LANES): an exact 128-padded
+            # remainder would give each batch a distinct xy shape and
+            # retrace + recompile the kernel per width
+            width = max(PT.C_LANES, next_pow2(hi - lo))
+            xy = np.full((width, 2, km), -1, np.int32)
+            for r in range(lo, hi):
+                x, y = cands[order[r]]
+                xy[r - lo, 0, :len(x)] = x
+                xy[r - lo, 1, :len(y)] = y
+            part = fn(p1k, s1k, self._put(xy))
+            self.stats["kernel_launches"] += 1
+            cols[order[lo:hi]] = base + np.arange(hi - lo)
+            base += width
+            parts.append(part)
+        return base
 
     def _resolve_eval(self, handle, n: int):
         out, cols = handle
@@ -461,6 +629,8 @@ class TsrTPU:
                          every_s: float = 30.0) -> Tuple[List[RuleResult], int]:
         """Full search over the top-m items; returns (results, s_k)."""
         self.chunk = self._round_chunk(m)
+        self._round_m = m
+        self._jnp_prep = None  # cleared per round (downgrade state is stale)
         sup_it = self._sup_sorted[:m].astype(np.int64)
         p1, s1 = self._prep(m)
         ids = self.vdb.item_ids[self._order[:m]]
@@ -673,6 +843,11 @@ class TsrCPU(TsrTPU):
     ops/bitops_np, so oracle comparisons are exact."""
 
     PIPELINE_DEPTH = 1  # dispatch is synchronous — nothing to overlap
+
+    def __init__(self, *args, **kwargs):
+        # never the device kernel — and never probe the JAX backend
+        kwargs["use_pallas"] = False
+        super().__init__(*args, **kwargs)
 
     def _round_chunk(self, m: int) -> int:
         # pure-NumPy evaluation: chunk is only the batch granularity of the

@@ -1,0 +1,205 @@
+"""Pallas TPU kernel for TSR rule-support evaluation (the 2nd hot loop).
+
+A TSR candidate rule X => Y evaluates as (SURVEY.md sec 2.4; models/tsr.py
+module docstring): A = AND over x in X of prefix-or rows, C = AND over
+y in Y of suffix-or rows, sup = #seqs with (shift_up_one(A) & C) != 0 and
+supx = #seqs with A != 0.
+
+The jnp path gathers every candidate's rows into [chunk, S, W] temps —
+~4 live copies per launch — which caps the launch width at ~512
+candidates on a 990k-sequence DB (15G HBM) and makes full-scale mines
+dispatch-latency-bound (5k+ launches x ~55ms tunnel RTT).  This kernel
+streams the sequence axis through VMEM instead:
+
+- grid (C, S/S_B), sequence-block innermost; each step DMAs the 2*km
+  candidate rows' current seq block straight from the prep stores (NO
+  [C, S] materialization anywhere), folds the ANDs, applies the
+  multiword shift_up_one carry chain, and accumulates the two counts
+  into the out block — per-launch HBM temp is O(1), so the launch width
+  is bounded by dispatch cost alone (8192 default).
+- row selection is dynamic via scalar-prefetched candidate indices
+  (PrefetchScalarGridSpec): in_spec j's index_map reads xy[c, side, j];
+  unused slots (-1, sides shorter than the km bucket) map to the pad row
+  M (all ones — the AND identity), which the caller appends to the prep
+  stores (models/tsr.py _kernel_layout_fn builds it once per round).
+- out[2, C] accumulates (sup, supx) per candidate: the block is a
+  [2, 128] lane tile revisited for 128 consecutive candidates x all seq
+  blocks; a broadcasted-iota mask adds each step's two scalars into its
+  candidate's lane.
+
+Operand layout: the seq axis is FOLDED to 2-D (sublane, lane) tiles —
+``[M+1, S/128, 128]`` single-word, ``[M+1, W, S/128, 128]`` multiword —
+because Mosaic requires the last two block dims to be (divisible by 8,
+divisible by 128): a flat ``(1, S_B)`` row block fails lowering on real
+hardware (the row index must live on a LEADING dim, where any block size
+is legal).  The word axis is a static inner loop with exact cross-word
+carries, mirroring ops/bitops_jax.shift_up_one.
+
+Under shard_map each device runs the kernel on its seq-axis shard and the
+engine psums the partial counts (identical to the jnp path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C_LANES = 128     # candidates per out block (lane width)
+LANE = 128        # folded seq-axis minor dim
+# Worst-case live row blocks per step.  The scoped-VMEM limit is 16M on
+# v5e and the row blocks are not its only occupant (out block, prefetch,
+# pipeline overheads) — a 16M budget compiled to 17.86M of scoped vmem
+# and was rejected; 12M leaves the observed ~2M of headroom.
+_VMEM_BUDGET = 12 << 20
+
+
+def seq_block(n_words: int, s_local: int) -> int:
+    """Seq lanes per grid step — as LARGE as the VMEM budget allows (the
+    whole shard when it fits).  The grid has C x S/s_block steps and the
+    per-step work is tiny, so small blocks make launches per-step-
+    overhead-bound: measured on v5e, 4096-lane blocks ran a 99k-seq
+    8192-candidate launch ~10x slower than the same launch at one
+    whole-shard block.  Budget: 2*km row refs of [n_words, sb/128, 128]
+    uint32, double-buffered, at the worst km=4 bucket.  Always a multiple
+    of 8*128 (the folded sublane x lane tile)."""
+    cap = _VMEM_BUDGET // (2 * 4 * 2 * 4 * max(1, n_words))  # lanes
+    cap = max(8 * LANE, cap // (8 * LANE) * (8 * LANE))
+    n_blocks = max(1, -(-s_local // cap))
+    per = -(-s_local // n_blocks)
+    return max(8 * LANE, -(-per // (8 * LANE)) * (8 * LANE))
+
+
+def _mask_add(out_ref, c, sup, supx):
+    """Accumulate this candidate's two counts into its lane of the
+    [2, C_LANES] out block."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (2, C_LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (2, C_LANES), 0)
+    val = jnp.where(row == 0, sup, supx)
+    out_ref[:] += jnp.where(lane == (c % C_LANES), val, 0)
+
+
+def _make_kernel_1w(km: int):
+    def kernel(xy_ref, *refs):
+        # refs: km prefix blocks, km suffix blocks ([1, sb/128, 128]), out
+        p_refs, s_refs, out_ref = refs[:km], refs[km:2 * km], refs[2 * km]
+        c, sb = pl.program_id(0), pl.program_id(1)
+
+        @pl.when(((c % C_LANES) == 0) & (sb == 0))
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        a = p_refs[0][0]                            # [sb/128, 128]
+        for j in range(1, km):
+            a = a & p_refs[j][0]
+        cc = s_refs[0][0]
+        for j in range(1, km):
+            cc = cc & s_refs[j][0]
+        # single word: shift toward higher positions, carry-in 0
+        shifted = a << jnp.uint32(1)
+        sup = jnp.sum(((shifted & cc) != 0).astype(jnp.int32))
+        supx = jnp.sum((a != 0).astype(jnp.int32))
+        _mask_add(out_ref, c, sup, supx)
+
+    return kernel
+
+
+def _make_kernel(km: int, n_words: int):
+    def kernel(xy_ref, *refs):
+        p_refs, s_refs, out_ref = refs[:km], refs[km:2 * km], refs[2 * km]
+        c, sb = pl.program_id(0), pl.program_id(1)
+
+        @pl.when(((c % C_LANES) == 0) & (sb == 0))
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        hit = None     # any word of (shift_up_one(A) & C) != 0
+        hitx = None    # any word of A != 0
+        carry = None   # shift_up_one cross-word carry (bit 31 -> next word)
+        for w in range(n_words):   # static unroll, words low -> high
+            a = p_refs[0][0, w]                     # [sb/128, 128]
+            for j in range(1, km):
+                a = a & p_refs[j][0, w]
+            cc = s_refs[0][0, w]
+            for j in range(1, km):
+                cc = cc & s_refs[j][0, w]
+            shifted = a << jnp.uint32(1)
+            if carry is not None:
+                shifted = shifted | carry
+            carry = a >> jnp.uint32(31)
+            h = (shifted & cc) != 0
+            hx = a != 0
+            hit = h if hit is None else (hit | h)
+            hitx = hx if hitx is None else (hitx | hx)
+        sup = jnp.sum(hit.astype(jnp.int32))
+        supx = jnp.sum(hitx.astype(jnp.int32))
+        _mask_add(out_ref, c, sup, supx)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("km", "s_block", "interpret"))
+def rule_supports(p1: jax.Array, s1: jax.Array, xy: jax.Array, *,
+                  km: int, s_block: int = 0,
+                  interpret: bool = False) -> jax.Array:
+    """(sup, supx) for a batch of candidate rules.
+
+    Args:
+      p1: prefix-or-incl item rows in FOLDED kernel layout —
+        [M+1, S/128, 128] uint32 single-word, [M+1, W, S/128, 128]
+        multiword — with row M = ALL ONES (the AND identity for unused
+        slots).  S must be a multiple of ``s_block``.
+      s1: suffix-or-incl rows, same shape/convention.
+      xy: [C, 2, km] int32 — row indices (side 0 = X, 1 = Y); -1 = unused
+        slot.  C must be a multiple of 128.
+      km: side-size bucket (static).
+
+    Returns:
+      [2, C] int32 — row 0 = sup(X=>Y), row 1 = sup(X).
+    """
+    single = p1.ndim == 3
+    W = 1 if single else p1.shape[1]
+    S = p1.shape[-2] * LANE
+    M = p1.shape[0] - 1   # pad row index
+    C = xy.shape[0]
+    sb = s_block or seq_block(W, S)
+    assert S % sb == 0 and C % C_LANES == 0, (S, sb, C)
+    assert p1.shape[-1] == LANE, p1.shape
+    assert xy.shape[1:] == (2, km), (xy.shape, km)
+    sb_rows = sb // LANE
+
+    # The prefetched candidate indices live in SMEM, which pads the MINOR
+    # dim of multi-D arrays to the 128-lane tile (a [C, 2, km] array
+    # became an 8 MB "prefetched SMEM operand" against a 1 MB budget on
+    # v5e) — so they ride FLAT: xy_flat[(c*2 + side)*km + j].
+    xy_flat = xy.reshape(-1)
+
+    def row(side, j):
+        # -1 (unused slot) -> the all-ones pad row
+        def index_map(c, s, xy_ref):
+            r = xy_ref[(c * 2 + side) * km + j]
+            r = jnp.where(r < 0, M, r)
+            return (r, s, 0) if single else (r, 0, s, 0)
+        shape = ((1, sb_rows, LANE) if single
+                 else (1, W, sb_rows, LANE))
+        return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(C, S // sb),
+        in_specs=([row(0, j) for j in range(km)]
+                  + [row(1, j) for j in range(km)]),
+        out_specs=pl.BlockSpec((2, C_LANES), lambda c, s, xy_ref:
+                               (0, c // C_LANES),
+                               memory_space=pltpu.VMEM),
+    )
+    kernel = _make_kernel_1w(km) if single else _make_kernel(km, W)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((2, C), jnp.int32),
+        interpret=interpret,
+    )(xy_flat, *([p1] * km + [s1] * km))

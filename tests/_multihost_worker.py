@@ -64,8 +64,13 @@ def main() -> None:
     ok_c = patterns_text(cgot) == patterns_text(
         mine_cspade(db, minsup, maxgap=2, maxwindow=5))
     rgot = mine_tsr_tpu(db, 15, 0.5, max_side=2, mesh=mesh)
-    ok_r = rules_text(rgot) == rules_text(
-        mine_tsr_cpu(db, 15, 0.5, max_side=2))
+    rwant = rules_text(mine_tsr_cpu(db, 15, 0.5, max_side=2))
+    ok_r = rules_text(rgot) == rwant
+    # the Pallas rule-support kernel under multi-controller (interpret
+    # mode on CPU — the same program a real multi-host TPU runs)
+    rgot_k = mine_tsr_tpu(db, 15, 0.5, max_side=2, mesh=mesh,
+                          use_pallas=True)
+    ok_r = ok_r and rules_text(rgot_k) == rwant
 
     # the fused whole-mine-on-device engine under multi-controller: every
     # process runs the one compiled program on replicated frontier state
