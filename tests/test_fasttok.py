@@ -1,0 +1,52 @@
+"""Native tokenizer (data/_fasttok.c): parity with the numpy flatten.
+
+The extension builds on demand into the user cache; when that fails
+(no compiler, SPARKFSM_FASTTOK=0) every consumer falls back to the
+numpy path — these tests pin that both paths produce byte-identical
+token tables.
+"""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data import fasttok
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import build_vertical
+
+
+def test_flatten_parity():
+    db = synthetic_db(seed=5, n_sequences=300, n_items=20,
+                      mean_itemsets=4.0, mean_itemset_size=1.5)
+    ft = fasttok.flatten(db)
+    if ft is None:
+        pytest.skip("native tokenizer unavailable in this environment")
+    # compared against the REAL numpy fallback, not a copy of it
+    want = fasttok.flatten_numpy(db)
+    for got, exp in zip(ft, want):
+        np.testing.assert_array_equal(got, exp)
+
+
+def test_flatten_accepts_lists_and_rejects_garbage():
+    if fasttok.flatten([((1,),)]) is None:
+        pytest.skip("native tokenizer unavailable in this environment")
+    # lists are sequences too (sources may build lists, not tuples)
+    lengths, counts, items = fasttok.flatten([[[1, 2], [3]], [[2]]])
+    assert lengths.tolist() == [2, 1]
+    assert counts.tolist() == [2, 1, 1]
+    assert items.tolist() == [1, 2, 3, 2]
+    # non-integer items surface as an exception, not silent corruption
+    with pytest.raises(TypeError):
+        fasttok.flatten([((1, "x"),)])
+
+
+def test_build_vertical_identical_with_and_without_native(monkeypatch):
+    db = synthetic_db(seed=7, n_sequences=200, n_items=15,
+                      mean_itemsets=3.0, mean_itemset_size=1.4)
+    with_native = build_vertical(db, min_item_support=2)
+    monkeypatch.setattr(fasttok, "_mod", None)
+    monkeypatch.setattr(fasttok, "_tried", True)
+    without = build_vertical(db, min_item_support=2)
+    for attr in ("item_ids", "seq_lengths", "item_supports",
+                 "tok_item", "tok_seq", "tok_word", "tok_mask"):
+        np.testing.assert_array_equal(getattr(with_native, attr),
+                                      getattr(without, attr))
