@@ -8,9 +8,12 @@ sequences, 3.3k item alphabet, ~4.6 itemsets/sequence) stands in; point
 BENCH_DATASET at a real SPMF file to override.  The metric string names the
 dataset truthfully either way.
 
-Metric: patterns/sec of the steady-state mine (second run, compiles warm).
-vs_baseline: 10s-target ratio = 10.0 / steady wall-clock (>1 beats the
-"<10s on v5e-8" north star; here a single chip).
+Metric: patterns/sec of the steady-state mine — the MEDIAN of
+BENCH_REPEATS warm passes (default 3; compiles cached from the cold run),
+with `wall_min_s` and relative `wall_spread` reported so tunnel noise is
+visible in the artifact.  vs_baseline: 10s-target ratio = 10.0 / median
+steady wall-clock (>1 beats the "<10s on v5e-8" north star; here a
+single chip).
 
 Parity (the north star's other half) is checked by default against the CPU
 oracle — `"parity": true` in the output attests a byte-identical pattern
@@ -26,11 +29,12 @@ If the TPU tunnel is down the harness retries for BENCH_TPU_WAIT seconds
 
 Env knobs: BENCH_SCALE (default 1.0), BENCH_MINSUP (default 0.001),
 BENCH_DATASET (SPMF file path), BENCH_PARITY=0, BENCH_PALLAS=0,
-BENCH_TPU_WAIT (seconds).
+BENCH_REPEATS (steady passes, default 3), BENCH_TPU_WAIT (seconds).
 """
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -82,10 +86,21 @@ def main() -> None:
     res = eng.mine()
     cold_s = time.time() - t0
 
-    eng.stats = {k: 0 for k in eng.stats}  # per-run stats for the steady pass
-    t0 = time.time()
-    res = eng.mine()
-    steady_s = time.time() - t0
+    # Steady state, median of N passes: the shared host + TPU tunnel are
+    # noisy (the same code has measured 0.82s and 1.17s hours apart), so a
+    # single sample makes vs_baseline a roll of the dice.  The median is
+    # the headline; min and relative spread ((max-min)/median) are reported
+    # so a noisy session is visible in the artifact instead of silently
+    # inflating or deflating the number.
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    walls = []
+    for _ in range(repeats):
+        eng.stats = {k: 0 for k in eng.stats}  # per-pass stats
+        t0 = time.time()
+        res = eng.mine()
+        walls.append(time.time() - t0)
+    walls.sort()
+    steady_s = statistics.median(walls)
 
     patterns_per_sec = len(res) / steady_s if steady_s > 0 else 0.0
     out = {
@@ -95,6 +110,10 @@ def main() -> None:
         "vs_baseline": round(10.0 / steady_s, 3) if steady_s > 0 else 0.0,
         "patterns": len(res),
         "wall_s": round(steady_s, 3),
+        "wall_min_s": round(walls[0], 3),
+        "wall_spread": round((walls[-1] - walls[0]) / steady_s, 3)
+        if steady_s > 0 else 0.0,
+        "steady_repeats": repeats,
         "cold_wall_s": round(cold_s, 3),
         "datagen_s": round(datagen_s, 3),
         "vertical_build_s": round(build_s, 3),

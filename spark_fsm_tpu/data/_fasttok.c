@@ -29,8 +29,17 @@ flatten(PyObject *self, PyObject *arg)
     Py_ssize_t n_seq = PySequence_Fast_GET_SIZE(db);
     Py_ssize_t n_sets = 0, n_toks = 0;
 
-    /* pass 1: sizes */
+    /* pass 1: sizes.  Container sizes are re-read every iteration before
+     * each unchecked GET_ITEM macro read: PySequence_Size below can
+     * re-enter Python (__len__), and a re-entrant callback shrinking a
+     * borrowed list would otherwise turn GET_ITEM into a read past the
+     * new size -- undefined behavior before any write guard exists. */
     for (Py_ssize_t i = 0; i < n_seq; i++) {
+        if (i >= PySequence_Fast_GET_SIZE(db)) {
+            PyErr_SetString(PyExc_RuntimeError,
+                            "db changed size during tokenizer pass 1");
+            goto fail_db;
+        }
         PyObject *seq = PySequence_Fast(
             PySequence_Fast_GET_ITEM(db, i), "sequence must be a sequence");
         if (seq == NULL)
@@ -38,6 +47,13 @@ flatten(PyObject *self, PyObject *arg)
         Py_ssize_t ns = PySequence_Fast_GET_SIZE(seq);
         n_sets += ns;
         for (Py_ssize_t j = 0; j < ns; j++) {
+            if (j >= PySequence_Fast_GET_SIZE(seq)) {
+                Py_DECREF(seq);
+                PyErr_SetString(PyExc_RuntimeError,
+                                "sequence changed size during tokenizer "
+                                "pass 1");
+                goto fail_db;
+            }
             Py_ssize_t sz = PySequence_Size(PySequence_Fast_GET_ITEM(seq, j));
             if (sz < 0) {
                 Py_DECREF(seq);
@@ -66,8 +82,12 @@ flatten(PyObject *self, PyObject *arg)
     int64_t *cp_end = cp + n_sets;
     int64_t *ip_end = ip + n_toks;
 
-    /* pass 2: fill */
+    /* pass 2: fill.  Same re-read-before-GET_ITEM discipline as pass 1
+     * (here PyLong_AsLongLong can re-enter via an item's __index__);
+     * size drift bails to fail_mutated like the write guards. */
     for (Py_ssize_t i = 0; i < n_seq; i++) {
+        if (i >= PySequence_Fast_GET_SIZE(db))
+            goto fail_mutated;
         PyObject *seq = PySequence_Fast(
             PySequence_Fast_GET_ITEM(db, i), "sequence must be a sequence");
         if (seq == NULL)
@@ -79,6 +99,10 @@ flatten(PyObject *self, PyObject *arg)
         }
         *lp++ = (int32_t)ns;
         for (Py_ssize_t j = 0; j < ns; j++) {
+            if (j >= PySequence_Fast_GET_SIZE(seq)) {
+                Py_DECREF(seq);
+                goto fail_mutated;
+            }
             PyObject *iset = PySequence_Fast(
                 PySequence_Fast_GET_ITEM(seq, j), "itemset must be a sequence");
             if (iset == NULL) {
@@ -93,6 +117,11 @@ flatten(PyObject *self, PyObject *arg)
             }
             *cp++ = (int64_t)sz;
             for (Py_ssize_t k = 0; k < sz; k++) {
+                if (k >= PySequence_Fast_GET_SIZE(iset)) {
+                    Py_DECREF(iset);
+                    Py_DECREF(seq);
+                    goto fail_mutated;
+                }
                 int64_t v = PyLong_AsLongLong(
                     PySequence_Fast_GET_ITEM(iset, k));
                 if (v == -1 && PyErr_Occurred()) {

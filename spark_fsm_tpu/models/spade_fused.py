@@ -150,8 +150,10 @@ def _fused_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
     absolute minsup and must reuse the compile.
 
     Store rows: [0, ni_pad) item id-lists; two child regions of f_cap rows
-    each (double buffer); last row = scratch (all zeros, read by inactive
-    lanes, written by dropped scatters -> jnp scatter mode='drop').
+    each (double buffer); last row = scratch, which must STAY all zeros —
+    inactive lanes read it as their parent bitmap, so every masked scatter
+    drops its garbage rows OUT OF BOUNDS (jnp mode='drop'), never into
+    scratch.
     """
     W = n_words
     region_a = ni_pad
@@ -246,9 +248,12 @@ def _fused_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
         new_slots = (child_base + lane).astype(jnp.int32)
         # pt interleave: row 2f is the PLAIN parent, 2f+1 its s-ext
         # TRANSFORM; an s-extension (iss=1) joins the transform.
+        # invalid child lanes drop their (garbage) joins rows out of
+        # bounds, like the records path — writing them into scratch would
+        # break its all-zeros invariant (inactive lanes READ scratch)
         joins = pt_flat[2 * c_f + c_iss] & store[c_item]
-        widx2 = jnp.where(cvalid, new_slots, scratch)
-        store = store.at[widx2].set(joins)
+        widx2 = jnp.where(cvalid, new_slots, store.shape[0])
+        store = store.at[widx2].set(joins, mode="drop")
 
         new_s_mask = srow[cpos] & cvalid[:, None]
         new_i_mask = child_i_mask[cpos] & cvalid[:, None]

@@ -485,6 +485,7 @@ class TsrTPU:
                 g_hi += 1
             if self.use_pallas and km not in self._pallas_bad:
                 mark = len(parts)
+                launches_mark = self.stats["kernel_launches"]
                 try:
                     base = self._dispatch_kernel_bucket(
                         p1, s1, cands, order, g_lo, g_hi, km,
@@ -498,6 +499,9 @@ class TsrTPU:
                     # jnp path, whose prep/width differ from the kernel's
                     del parts[mark:]
                     base = sum(p.shape[1] for p in parts)
+                    # discarded launches must not stay in the exported
+                    # per-job stats (the jnp re-evaluation recounts)
+                    self.stats["kernel_launches"] = launches_mark
                     self._pallas_bad.add(km)
                     self.stats[f"pallas_fallback_km{km}"] = repr(exc)
             if self.use_pallas and self._jnp_prep is None:

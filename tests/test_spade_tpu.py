@@ -119,3 +119,65 @@ def test_launch_width_clamps_to_pool_budget():
     assert ceng.chunk <= 8
     assert patterns_text(ceng.mine()) == patterns_text(
         mine_cspade(db, minsup, maxgap=2))
+
+
+def test_pallas_dispatch_fault_downgrades(monkeypatch):
+    # A kernel fault at DISPATCH (lowering/compile failures surface on the
+    # batch_supports call) must downgrade the engine to the jnp path for
+    # the rest of the mine with a visible flag and byte-identical results
+    # — mirror of tests/test_tsr.py's per-km downgrade test.
+    import spark_fsm_tpu.models.spade_tpu as M
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic dispatch fault")
+
+    monkeypatch.setattr(M.PS, "batch_supports", boom)
+    db = synthetic_db(seed=13, n_sequences=200, n_items=25,
+                      mean_itemsets=4.0, mean_itemset_size=1.3)
+    minsup = abs_minsup(0.03, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    eng = SpadeTPU(vdb, minsup, use_pallas=True)
+    got = eng.mine()
+    assert eng.use_pallas is False
+    assert "synthetic dispatch fault" in eng.stats["pallas_fallback"]
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_pallas_readback_fault_recounts_inflight_batches(monkeypatch):
+    # TPU kernel RUNTIME faults surface at readback (np.asarray), not at
+    # dispatch.  With pipeline_depth > 1 several Pallas-dispatched batches
+    # are already in flight when the first fault lands; each must be
+    # recounted on the jnp path (the `was_pallas` gating in _resolve) and
+    # the final pattern set must be byte-identical.
+    import spark_fsm_tpu.models.spade_tpu as M
+
+    faults = []
+
+    class FaultyArray:
+        def copy_to_host_async(self):
+            pass
+
+        def __array__(self, *a, **k):
+            faults.append(1)
+            raise RuntimeError("synthetic readback fault")
+
+    monkeypatch.setattr(M.PS, "batch_supports",
+                        lambda *a, **k: FaultyArray())
+    db = synthetic_db(seed=14, n_sequences=200, n_items=30,
+                      mean_itemsets=4.0, mean_itemset_size=1.3)
+    minsup = abs_minsup(0.03, len(db))
+    vdb = build_vertical(db, min_item_support=minsup)
+    # small node batches + deep pipeline: the root frontier alone fills
+    # several in-flight Pallas batches before the first resolve faults
+    eng = SpadeTPU(vdb, minsup, use_pallas=True, node_batch=4,
+                   pipeline_depth=4)
+    assert eng.node_batch == 4 and eng.pipeline_depth == 4
+    got = eng.mine()
+    assert eng.use_pallas is False
+    assert "synthetic readback fault" in eng.stats["pallas_fallback"]
+    # more than one in-flight Pallas batch hit the readback fault and
+    # went through the recount path
+    assert len(faults) >= 2
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
