@@ -92,27 +92,57 @@ def _dense_pair_jnp(pt3: jax.Array, items3: jax.Array, i_tile: int = 128,
 
 
 def fused_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
-                   caps: Optional["FusedCaps"] = None) -> bool:
-    """Size heuristic for auto-routing: the fused program computes the
-    DENSE [2*f_cap, ni_pad] pair matrix every level (inactive lanes
-    included — shapes are static), so its PER-DEVICE per-level HBM
-    traffic is ~S_local*W*4 * 2*f_cap*ni_pad * (1/I_TILE + 1/P_TILE)
-    bytes (the sequence axis shards over the mesh).  Routing is worth it
-    while that stays well under the ~130ms/wave readback latency the
-    fusion removes (24 GB ~= 30ms on a v5e); beyond that the classic
-    host-driven DFS's exact candidate lists win.  Multi-host meshes are
-    eligible: every process runs the identical program on replicated
-    frontier state, exactly the SPMD contract of parallel/multihost.py
-    (validated by the 2-process parity test)."""
+                   caps: Optional["FusedCaps"] = None,
+                   shape_buckets: bool = False) -> bool:
+    """Size heuristic for auto-routing, two independent ceilings:
+
+    TRAFFIC: the fused program computes the DENSE [2*f_cap, ni_pad] pair
+    matrix every level (inactive lanes included — shapes are static), so
+    its PER-DEVICE per-level HBM traffic is ~S_local*W*4 * 2*f_cap*ni_pad
+    * (1/I_TILE + 1/P_TILE) bytes (the sequence axis shards over the
+    mesh).  Routing is worth it while that stays well under the
+    ~130ms/wave readback latency the fusion removes (24 GB ~= 30ms on a
+    v5e); beyond that the classic host-driven DFS's exact candidate
+    lists win.
+
+    ALLOCATION: the while_loop body holds the store (ni_pad + 2*f_cap
+    rows), the [2*f_cap, S*W] prep stack, the joins temp, and the
+    kernel-layout transposes LIVE AT ONCE — traffic can pass while peak
+    allocation OOMs (a 99k-seq x 3-word streaming window did exactly
+    that: ~22 GB traffic 'eligible', ~16 GB live on a 16 GB chip).  The
+    model store + 4x prep must fit ~45% of the device budget, leaving
+    the rest for XLA temps and a coexisting engine (the
+    auto_pool_bytes reasoning).
+
+    ``shape_buckets`` mirrors the engine knob: bucketed mines pad the
+    sequence axis to a power of two, so eligibility must judge the
+    PADDED size (streaming windows route through here).
+
+    Multi-host meshes are eligible: every process runs the identical
+    program on replicated frontier state, exactly the SPMD contract of
+    parallel/multihost.py (validated by the 2-process parity test)."""
+    import jax
+
+    from spark_fsm_tpu.models._common import device_hbm_budget
+
     caps = caps or FusedCaps.for_mesh(mesh)
     ni_pad = pad_to_multiple(max(vdb.n_items, 1), PS.I_TILE)
     if ni_pad > 1024:
         return False
     n_dev = 1 if mesh is None else mesh.devices.size
-    s_local = -(-vdb.n_sequences // n_dev)
-    est = (s_local * vdb.n_words * 4 * 2 * caps.f_cap * ni_pad
+    n_seq = vdb.n_sequences
+    if shape_buckets:
+        n_seq = max(128, next_pow2(n_seq))
+    s_local = -(-n_seq // n_dev)
+    row_bytes = s_local * vdb.n_words * 4
+    est = (row_bytes * 2 * caps.f_cap * ni_pad
            * (1 / PS.I_TILE + 1 / PS.P_TILE))
-    return est <= 24 << 30
+    if est > 24 << 30:
+        return False
+    store_bytes = (ni_pad + 2 * caps.f_cap + 1) * row_bytes
+    prep_bytes = 2 * caps.f_cap * row_bytes
+    dev = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
+    return store_bytes + 4 * prep_bytes <= 0.45 * device_hbm_budget(dev)
 
 
 class FusedCaps:
@@ -137,6 +167,39 @@ class FusedCaps:
         v5e-8 the headline-scale frontier (~2.6k nodes) fits fused."""
         n_dev = 1 if mesh is None else mesh.devices.size
         return cls(f_cap=min(8192, 1024 * n_dev))
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_init_fn(mesh: Optional[Mesh], f_cap: int, ni: int, r_cap: int):
+    """Device-side frontier/record-buffer init.  Shipping the zero-filled
+    host buffers instead (records alone is r_cap*16 B = ~2 MB at the
+    default caps) costs ~200 ms of host->device transfer per mine on a
+    tunneled TPU (~10 MB/s) — for buffers that are almost entirely zeros.
+    This builds them from ~8 KB of root data: padded root ids/supports,
+    the root item mask, and the live root count."""
+    m = min(f_cap, r_cap)
+
+    def init(root_ids, root_sups, root_mask, n_roots):
+        lane = jnp.arange(f_cap, dtype=jnp.int32)
+        active = lane < n_roots
+        slots = jnp.where(active, root_ids, 0).astype(jnp.int32)
+        s_mask = active[:, None] & root_mask[None, :]
+        i_mask = s_mask & (jnp.arange(ni)[None, :] > slots[:, None])
+        nits = jnp.ones(f_cap, jnp.int32)
+        rec_idx = lane
+        rec_head = jnp.stack(
+            [jnp.where(active, -1, 0), slots, active.astype(jnp.int32)],
+            axis=1)
+        records = jnp.zeros((r_cap, 3), jnp.int32).at[:m].set(rec_head[:m])
+        recsup = jnp.zeros(r_cap, jnp.int32).at[:m].set(
+            jnp.where(active, root_sups, 0)[:m])
+        return slots, s_mask, i_mask, nits, rec_idx, records, recsup
+
+    if mesh is None:
+        return jax.jit(init)
+    from jax.sharding import NamedSharding
+    rep = NamedSharding(mesh, P())
+    return jax.jit(init, out_shardings=(rep,) * 7)
 
 
 @functools.lru_cache(maxsize=32)
@@ -352,7 +415,12 @@ class FusedSpadeTPU:
         self.n_seq, self.n_words = n_seq, n_words
         self.ni_pad = pad_to_multiple(max(n_items, 1), PS.I_TILE)
         self.n_items = n_items
-        self.stats = {"patterns": 0, "levels": 0, "fused": True}
+        # shape_key: compiled-geometry identity (same contract as
+        # SpadeTPU.stats) — distinct keys across a stream of mines bound
+        # its recompile count
+        self.stats = {"patterns": 0, "levels": 0, "fused": True,
+                      "shape_key": (f"fused:s{self.n_seq}w{n_words}"
+                                    f"ni{self.ni_pad}f{self.caps.f_cap}")}
 
     def nbytes(self) -> int:
         rows = self.ni_pad + 2 * self.caps.f_cap + 1
@@ -376,19 +444,19 @@ class FusedSpadeTPU:
         ni = self.ni_pad
         root_mask = np.zeros(ni, bool)
         root_mask[roots] = True
-        slots = np.zeros(cap.f_cap, np.int32)
-        s_mask = np.zeros((cap.f_cap, ni), bool)
-        i_mask = np.zeros((cap.f_cap, ni), bool)
-        nits = np.ones(cap.f_cap, np.int32)
-        rec_idx = np.arange(cap.f_cap, dtype=np.int32)
-        records = np.zeros((cap.r_cap, 3), np.int32)
-        recsup = np.zeros(cap.r_cap, np.int32)
+        root_ids = np.zeros(cap.f_cap, np.int32)
+        root_sups = np.zeros(cap.f_cap, np.int32)
         for k, i in enumerate(roots):
-            slots[k] = i
-            s_mask[k] = root_mask
-            i_mask[k] = root_mask & (np.arange(ni) > i)
-            records[k] = (-1, i, 1)
-            recsup[k] = int(vdb.item_supports[i])
+            root_ids[k] = i
+            root_sups[k] = int(vdb.item_supports[i])
+        # frontier + record buffers are built ON DEVICE from the ~8 KB of
+        # root data (see _fused_init_fn) — the zero-dominated buffers
+        # themselves never cross the host->device link
+        n_roots_dev = self._put(np.int32(n_roots))
+        slots, s_mask, i_mask, nits, rec_idx, records, recsup = (
+            _fused_init_fn(self.mesh, cap.f_cap, ni, cap.r_cap)(
+                self._put(root_ids), self._put(root_sups),
+                self._put(root_mask), n_roots_dev))
 
         fn = _fused_mine_fn(
             self.mesh, self.n_words, ni, self.max_its,
@@ -398,26 +466,31 @@ class FusedSpadeTPU:
         # single-device array, which cannot feed a multi-controller
         # computation (parallel/multihost.py replicate)
         packed_dev, counters_dev = fn(
-            store, self._put(slots), self._put(s_mask), self._put(i_mask),
-            self._put(nits), self._put(rec_idx), self._put(np.int32(n_roots)),
-            self._put(np.int32(n_roots)), self._put(records),
-            self._put(recsup), self._put(np.int32(self.minsup)))
-        for a in (packed_dev, counters_dev):
-            try:
-                a.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass  # method unavailable on this backend
+            store, slots, s_mask, i_mask, nits, rec_idx, n_roots_dev,
+            n_roots_dev, records, recsup, self._put(np.int32(self.minsup)))
+        try:
+            counters_dev.copy_to_host_async()
+        except (AttributeError, NotImplementedError):
+            pass  # method unavailable on this backend
 
         counters = np.asarray(counters_dev)
-        packed = np.asarray(packed_dev)
-        rec, sup = packed[:, :3], packed[:, 3]
         n_rec = int(counters[0])
         self.stats["levels"] = int(counters[2])
         self.stats["candidates"] = int(counters[3])
         self.stats["kernel_launches"] = 1  # the whole mine is one dispatch
         if bool(counters[1]):
             self.stats["fused_overflow"] = True
-            return None
+            return None  # the record buffer is garbage: never transferred
+        # Two-step readback: fetch only the VALID prefix of the record
+        # buffer.  The full [r_cap, 4] buffer is ~2 MB, and on a tunneled
+        # TPU (~10 MB/s, ~100 ms/roundtrip) its transfer dominates small
+        # mines; reading the counters first and slicing costs one extra
+        # roundtrip but transfers n_rec rows instead of r_cap.  The slice
+        # length is pow2-bucketed so the lowered slice program is reused
+        # across mines instead of recompiling per result count.
+        n_fetch = min(cap.r_cap, next_pow2(max(n_rec, 1)))
+        packed = np.asarray(packed_dev[:n_fetch])
+        rec, sup = packed[:, :3], packed[:, 3]
 
         # reconstruct patterns by following parent links (parents always
         # precede children in the record order)

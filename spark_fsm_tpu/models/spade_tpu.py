@@ -351,10 +351,16 @@ class SpadeTPU:
         self._pool = SlotPool(range(n_items, n_items + pool_slots))
         self._build_fns()
 
-        # mining statistics (observability, SURVEY.md sec 5)
+        # mining statistics (observability, SURVEY.md sec 5).  shape_key
+        # identifies the compiled device geometry: two mines with equal
+        # keys reuse every compiled program, so the number of DISTINCT
+        # keys across a stream of mines bounds its recompile count — the
+        # quantity shape_buckets exists to hold down (streaming/window.py).
         self.stats = {
             "candidates": 0, "kernel_launches": 0, "recomputed_nodes": 0,
             "reclaimed_slots": 0, "patterns": 0,
+            "shape_key": (f"classic:s{self.n_seq}w{n_words}"
+                          f"r{total}nb{self.node_batch}c{self.chunk}"),
         }
 
     # ------------------------------------------------------------------ fns
@@ -739,7 +745,9 @@ def mine_spade_tpu(
         stats_out["fused_skipped"] = "checkpoint"
     if checkpoint is None and fused in ("auto", "always"):
         from spark_fsm_tpu.models.spade_fused import fused_eligible, FusedSpadeTPU
-        if fused == "always" or fused_eligible(vdb, mesh=mesh):
+        if fused == "always" or fused_eligible(
+                vdb, mesh=mesh,
+                shape_buckets=kwargs.get("shape_buckets", False)):
             feng = FusedSpadeTPU(
                 vdb, minsup_abs, mesh=mesh,
                 max_pattern_itemsets=max_pattern_itemsets,
@@ -765,4 +773,8 @@ def mine_spade_tpu(
                        checkpoint_every_s=every_s)
     if stats_out is not None:
         stats_out.update(eng.stats)
+        # the routing decision is always recorded: callers (the suite's
+        # `route` field, streaming diagnostics) distinguish "routed
+        # classic" from "no routing exists" by this key's presence
+        stats_out.setdefault("fused", False)
     return results

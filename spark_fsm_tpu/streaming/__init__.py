@@ -7,6 +7,7 @@ count-based eviction, re-mined per push (re-mining the window is the
 survey-sanctioned baseline; windows are small relative to the batch path).
 """
 
+from spark_fsm_tpu.streaming.consumer import PollConsumer, StopConsumer
 from spark_fsm_tpu.streaming.window import SlidingWindow, WindowMiner
 
-__all__ = ["SlidingWindow", "WindowMiner"]
+__all__ = ["PollConsumer", "SlidingWindow", "StopConsumer", "WindowMiner"]

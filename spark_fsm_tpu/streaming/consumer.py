@@ -1,0 +1,193 @@
+"""Pull-based micro-batch consumer driver (the Kafka-consumer shape).
+
+SURVEY.md sec 2.5 names "Kafka micro-batches" as the reference ecosystem's
+streaming feed and sec 7 step 9 makes the consumer "optional behind the
+source interface".  No broker is reachable in this sandbox (zero egress),
+so what the framework ships is the consumer SHAPE, not a Kafka client: a
+user-supplied ``fetch() -> Optional[SequenceDB]`` callable — poll one
+micro-batch, return None when the broker has nothing right now — driven
+by a poll loop that feeds every batch to a sink (``WindowMiner.push``, a
+service Streamer topic, or any callable).  A production deployment plugs
+a real client in without touching the framework::
+
+    consumer = kafka.KafkaConsumer(...)          # external library
+    def fetch():
+        recs = consumer.poll(timeout_ms=500)
+        batch = [parse_spmf_line(r.value) for rs in recs.values() for r in rs]
+        return batch or None
+    PollConsumer(fetch, miner.push).run()
+
+Semantics:
+
+- ``None`` from fetch = idle: sleep ``poll_interval_s`` and poll again
+  (a blocking fetch can always return batches back-to-back; the interval
+  then never applies).
+- An EMPTY batch from fetch is treated as idle too — the window layer
+  rejects empty pushes (they would evict real data while adding none).
+- ``StopConsumer`` raised by fetch ends the loop cleanly (the
+  end-of-partition signal); ``stop()`` ends it from another thread.
+- fetch/sink exceptions do NOT kill the loop by default: they are
+  counted, reported through ``on_error``, and polling continues after
+  the interval — a flaky broker must not tear down the mining service
+  (the reference's supervision contract, SURVEY.md sec 5 failure row).
+  ``max_consecutive_errors`` bounds that patience; crossing it stops
+  the loop with ``stats["stopped"] = "errors"``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+
+FetchFn = Callable[[], Optional[SequenceDB]]
+
+
+class StopConsumer(Exception):
+    """Raised by a fetch callable to end the poll loop cleanly."""
+
+
+class PollConsumer:
+    """Drives a pull-based micro-batch source into a push-based sink.
+
+    Args:
+      fetch: poll one micro-batch; ``None``/empty = nothing available.
+      sink: called with each non-empty batch (e.g. ``WindowMiner.push``).
+        Its return value is handed to ``on_result`` when given.
+      poll_interval_s: sleep between polls after an idle poll or an error.
+      max_consecutive_errors: stop after this many back-to-back
+        fetch/sink failures (None = keep retrying forever).
+      on_result: optional callback with the sink's return value (e.g. the
+        window's new pattern set) after every consumed batch.
+      on_error: optional callback with the exception; exceptions raised
+        BY this callback are swallowed (reporting must not kill the loop).
+    """
+
+    def __init__(self, fetch: FetchFn, sink: Callable, *,
+                 poll_interval_s: float = 1.0,
+                 max_consecutive_errors: Optional[int] = None,
+                 on_result: Optional[Callable] = None,
+                 on_error: Optional[Callable] = None) -> None:
+        if poll_interval_s < 0:
+            raise ValueError(f"poll_interval_s must be >= 0 "
+                             f"(got {poll_interval_s})")
+        if max_consecutive_errors is not None and max_consecutive_errors < 1:
+            raise ValueError(f"max_consecutive_errors must be >= 1 or None "
+                             f"(got {max_consecutive_errors})")
+        self._fetch = fetch
+        self._sink = sink
+        self.poll_interval_s = float(poll_interval_s)
+        self.max_consecutive_errors = max_consecutive_errors
+        self._on_result = on_result
+        self._on_error = on_error
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"polls": 0, "idle_polls": 0, "batches": 0,
+                      "sequences": 0, "errors": 0, "stopped": None}
+
+    # ------------------------------------------------------------- polling
+
+    def poll_once(self) -> bool:
+        """One fetch->sink cycle; True when a batch was consumed.
+
+        Raises StopConsumer through (the run loop turns it into a clean
+        stop); other exceptions are absorbed into the error counters.
+        """
+        self.stats["polls"] += 1
+        try:
+            batch = self._fetch()
+            if not batch:
+                self.stats["idle_polls"] += 1
+                return False
+            result = self._sink(batch)
+        except StopConsumer:
+            raise
+        except Exception as exc:
+            self.stats["errors"] += 1
+            self._consecutive_errors += 1
+            if self._on_error is not None:
+                try:
+                    self._on_error(exc)
+                except Exception:
+                    pass  # reporting must not kill the loop
+            return False
+        self._consecutive_errors = 0
+        self.stats["batches"] += 1
+        self.stats["sequences"] += len(batch)
+        if self._on_result is not None:
+            try:
+                self._on_result(result)
+            except Exception as exc:
+                # the batch WAS consumed (the sink advanced), so this is a
+                # reporting failure, not a consume failure: count + surface
+                # it, never kill the loop (the supervision contract), and
+                # leave the consecutive-error streak reset by the consume
+                self.stats["errors"] += 1
+                if self._on_error is not None:
+                    try:
+                        self._on_error(exc)
+                    except Exception:
+                        pass  # reporting must not kill the loop
+        return True
+
+    _consecutive_errors = 0
+
+    def run(self, max_polls: Optional[int] = None) -> dict:
+        """Poll until stopped; returns the stats dict.
+
+        ``max_polls`` bounds the loop for tests/drains (None = until
+        ``stop()``, ``StopConsumer``, or the error bound).
+
+        The stop event is NOT cleared here: ``start()`` clears it before
+        launching the thread, so a ``stop()`` racing a fresh ``start()``
+        can never be erased by the new thread entering this loop (it
+        would spin unstoppably).  A direct ``run()`` call after a
+        ``stop()`` therefore returns immediately with
+        ``stopped="stop"`` — restart via ``start()``.
+        """
+        self._consecutive_errors = 0
+        polls = 0
+        while not self._stop.is_set():
+            if max_polls is not None and polls >= max_polls:
+                self.stats["stopped"] = "max_polls"
+                break
+            polls += 1
+            try:
+                consumed = self.poll_once()
+            except StopConsumer:
+                self.stats["stopped"] = "end_of_stream"
+                break
+            if (self.max_consecutive_errors is not None
+                    and self._consecutive_errors
+                    >= self.max_consecutive_errors):
+                self.stats["stopped"] = "errors"
+                break
+            if not consumed and self.poll_interval_s:
+                # idle or errored: wait out the interval, but wake
+                # immediately on stop()
+                self._stop.wait(self.poll_interval_s)
+        else:
+            self.stats["stopped"] = "stop"
+        return self.stats
+
+    # ----------------------------------------------------- thread wrapper
+
+    def start(self, max_polls: Optional[int] = None) -> "PollConsumer":
+        """Run the poll loop in a daemon thread (idempotent while live)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()  # before the spawn: see run()'s docstring
+        self._thread = threading.Thread(
+            target=self.run, kwargs={"max_polls": max_polls},
+            name="fsm-poll-consumer", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout_s: float = 10.0) -> None:
+        """Signal the loop to end; joins the thread when one is running."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(join_timeout_s)

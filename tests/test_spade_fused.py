@@ -93,10 +93,12 @@ def test_auto_routing_uses_fused_for_small_dbs():
     got = mine_spade_tpu(db, 2, stats_out=stats)
     assert stats.get("fused") is True
     assert patterns_text(got) == patterns_text(mine_spade(db, 2))
-    # fused="never" pins the classic engine
+    # fused="never" pins the classic engine; the routing decision is
+    # still recorded (False), so artifact consumers can distinguish
+    # "routed classic" from "this algorithm has no routing"
     stats2 = {}
     got2 = mine_spade_tpu(db, 2, stats_out=stats2, fused="never")
-    assert "fused" not in stats2
+    assert stats2["fused"] is False
     assert patterns_text(got2) == patterns_text(got)
 
 
@@ -190,3 +192,39 @@ def test_shape_buckets_reuse_compile():
         assert got is not None
         assert patterns_text(got) == patterns_text(mine_spade(db, ms))
         assert eng.n_seq == 128  # both bucket to the same shape
+
+
+def test_fused_eligible_allocation_ceiling():
+    # Traffic alone once routed a 99k-seq x 3-word streaming window into
+    # the fused engine, whose PEAK ALLOCATION (store + prep stack + joins
+    # + kernel-layout transposes live at once) then OOM'd the chip.
+    # Eligibility must model allocation too, and must judge the pow2-
+    # BUCKETED sequence axis when shape_buckets is on (streaming windows).
+    from types import SimpleNamespace
+
+    from spark_fsm_tpu.models.spade_fused import fused_eligible
+
+    small = SimpleNamespace(n_items=17, n_sequences=5000, n_words=1)
+    assert fused_eligible(small)
+
+    # CPU budget fallback is 4 GiB; a 300k x 3-word store (2177 rows x
+    # ~6.3 MB bucketed) is tens of GB — must be rejected
+    big = SimpleNamespace(n_items=17, n_sequences=300_000, n_words=3)
+    assert not fused_eligible(big, shape_buckets=True)
+    assert not fused_eligible(big)
+
+    # bucketing must be part of the judgment: a size whose UNbucketed
+    # allocation fits but whose pow2 bucket does not
+    import jax
+
+    from spark_fsm_tpu.models._common import device_hbm_budget
+    budget = 0.45 * device_hbm_budget(jax.devices()[0])
+    # store+4*prep ~= (2177 + 4*2048) * row_bytes; pick n_seq so that
+    # unbucketed row bytes fit but the next pow2 does not
+    rows_factor = (128 + 2 * 1024 + 1) + 4 * (2 * 1024)
+    n_fit = int(budget / rows_factor / 4 * 0.9)  # W=1, 90% of the edge
+    edge = SimpleNamespace(n_items=17, n_sequences=n_fit, n_words=1)
+    if fused_eligible(edge):  # traffic cap may reject first on tiny budgets
+        assert not fused_eligible(edge, shape_buckets=True) or (
+            # only if the pow2 bucket still fits (n_fit just under a pow2)
+            2 ** (n_fit - 1).bit_length() * rows_factor * 4 <= budget)

@@ -279,3 +279,139 @@ def test_stream_persisted_window_tracks_failed_mine():
     finally:
         del plugins.ALGORITHMS["FLAKY_STREAM"]
         master.shutdown()
+
+
+# -------------------------------------------------------- poll consumer
+
+
+def _queue_fetch(q):
+    """The broker stand-in: a non-blocking queue poll (the Kafka-consumer
+    shape — None when nothing is available right now)."""
+    import queue as _queue
+
+    from spark_fsm_tpu.streaming.consumer import StopConsumer
+
+    def fetch():
+        try:
+            item = q.get_nowait()
+        except _queue.Empty:
+            return None
+        if item is StopConsumer:
+            raise StopConsumer()
+        return item
+
+    return fetch
+
+
+def test_poll_consumer_drains_queue_with_window_parity():
+    import queue
+
+    from spark_fsm_tpu.streaming.consumer import PollConsumer, StopConsumer
+
+    batches = _batches(seed=31, n=4, size=8)
+    q = queue.Queue()
+    for b in batches:
+        q.put(b)
+    q.put(StopConsumer)
+
+    wm = WindowMiner(0.2, max_batches=2,
+                     mine=lambda db, ms: mine_spade(db, ms))
+    seen = []
+    pc = PollConsumer(_queue_fetch(q), wm.push, poll_interval_s=0,
+                      on_result=seen.append)
+    stats = pc.run()
+    assert stats["stopped"] == "end_of_stream"
+    assert stats["batches"] == 4
+    assert stats["sequences"] == 32
+    assert wm.stats["pushes"] == 4
+    # the final window state is byte-identical to a fresh oracle mine of
+    # exactly the window's sequences (the streaming determinism contract)
+    want = mine_spade(wm.window.sequences(), wm.minsup_abs())
+    assert patterns_text(wm.patterns) == patterns_text(want)
+    # on_result saw every push's pattern set; the last one is current
+    assert len(seen) == 4 and seen[-1] == wm.patterns
+
+
+def test_poll_consumer_idle_and_empty_batches():
+    import queue
+
+    from spark_fsm_tpu.streaming.consumer import PollConsumer
+
+    (batch,) = _batches(seed=32, n=1, size=6)
+    q = queue.Queue()
+    q.put([])      # empty batch = idle, never pushed (would evict data)
+    q.put(batch)
+    wm = WindowMiner(0.5, max_batches=3,
+                     mine=lambda db, ms: mine_spade(db, ms))
+    pc = PollConsumer(_queue_fetch(q), wm.push, poll_interval_s=0)
+    stats = pc.run(max_polls=4)  # 1 empty + 1 batch + 2 idle polls
+    assert stats["stopped"] == "max_polls"
+    assert stats["batches"] == 1
+    assert stats["idle_polls"] == 3
+    assert wm.stats["pushes"] == 1
+
+
+def test_poll_consumer_flaky_fetch_keeps_polling():
+    from spark_fsm_tpu.streaming.consumer import PollConsumer
+
+    (batch,) = _batches(seed=33, n=1, size=5)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("broker hiccup")
+        return batch if calls["n"] == 3 else None
+
+    wm = WindowMiner(0.5, max_batches=2,
+                     mine=lambda db, ms: mine_spade(db, ms))
+    errors = []
+    pc = PollConsumer(flaky, wm.push, poll_interval_s=0,
+                      on_error=errors.append)
+    stats = pc.run(max_polls=4)
+    assert stats["errors"] == 2 and len(errors) == 2
+    assert isinstance(errors[0], ConnectionError)
+    assert stats["batches"] == 1  # recovered and consumed the batch
+    assert wm.stats["pushes"] == 1
+
+
+def test_poll_consumer_error_bound_stops_loop():
+    from spark_fsm_tpu.streaming.consumer import PollConsumer
+
+    def broken():
+        raise ConnectionError("broker down")
+
+    pc = PollConsumer(broken, lambda b: None, poll_interval_s=0,
+                      max_consecutive_errors=3)
+    stats = pc.run()
+    assert stats["stopped"] == "errors"
+    assert stats["errors"] == 3
+
+
+def test_poll_consumer_background_thread_stop():
+    import queue
+
+    from spark_fsm_tpu.streaming.consumer import PollConsumer
+
+    batches = _batches(seed=34, n=2, size=5)
+    q = queue.Queue()
+    for b in batches:
+        q.put(b)
+    wm = WindowMiner(0.5, max_batches=2,
+                     mine=lambda db, ms: mine_spade(db, ms))
+    pc = PollConsumer(_queue_fetch(q), wm.push, poll_interval_s=0.01)
+    pc.start()
+    deadline = time.time() + 10
+    while wm.stats["pushes"] < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    pc.stop()
+    assert wm.stats["pushes"] == 2
+    assert pc.stats["stopped"] == "stop"
+    # stopped loop stays stopped; start() is idempotent on a dead thread
+    q.put(batches[0])
+    pc.start(max_polls=2)
+    deadline = time.time() + 10
+    while pc.stats["batches"] < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    pc.stop()
+    assert pc.stats["batches"] == 3
