@@ -415,3 +415,40 @@ def test_poll_consumer_background_thread_stop():
         time.sleep(0.01)
     pc.stop()
     assert pc.stats["batches"] == 3
+
+
+def test_poll_consumer_feeds_service_stream(server):
+    # The full Kafka-to-service shape: a PollConsumer drains an
+    # in-process queue (the broker stand-in) and POSTs each micro-batch
+    # to /stream/{topic}; the window's served pattern set after the drain
+    # is byte-identical to a fresh oracle mine of the live window.
+    import queue
+
+    from spark_fsm_tpu.service.model import deserialize_patterns
+    from spark_fsm_tpu.streaming.consumer import PollConsumer, StopConsumer
+    from spark_fsm_tpu.utils.canonical import sort_patterns
+
+    batches = _batches(seed=41, n=3, size=12)
+    q = queue.Queue()
+    for b in batches:
+        q.put(b)
+    q.put(StopConsumer)
+
+    def sink(batch):
+        resp = _post(server, "/stream/pollwin", sequences=format_spmf(batch),
+                     support="0.2", max_batches="2", algorithm="SPADE_TPU")
+        assert resp["status"] == "finished", resp
+        return resp
+
+    errors = []  # surface sink assertion failures with their server
+    pc = PollConsumer(_queue_fetch(q), sink, poll_interval_s=0,  # response
+                      on_error=errors.append)
+    stats = pc.run()
+    assert not errors, errors
+    assert stats["stopped"] == "end_of_stream" and stats["batches"] == 3
+
+    got = _post(server, "/get/patterns", uid="stream:pollwin")
+    patterns = deserialize_patterns(got["data"]["patterns"])
+    window = [s for b in batches[-2:] for s in b]  # keep 2 of 3
+    want = mine_spade(window, abs_minsup(0.2, len(window)))
+    assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
