@@ -122,6 +122,30 @@ def bench_pair_supports() -> dict:
     jnp_wall, _ = _amortized_wall(lambda: dense(pt3, items3),
                                   n_iters=4, roundtrip_s=rt)
 
+    # tile sweep: the evidence behind the default tiles (and the check
+    # that no neighboring config leaves real wall time on the table).
+    # Every config is feasible at this geometry (i_tile must divide into
+    # the allocated NI=384 rows after rounding; s_block must divide
+    # S=77824, a multiple of 4096 but not 8192).  Skipped with
+    # BENCH_KERNELS_SWEEP=0.  Sweep walls use the same amortized fence
+    # as the headline; an unexpected failure records its error.
+    sweep = []
+    if os.environ.get("BENCH_KERNELS_SWEEP") != "0":
+        for ptile, itile, sb in ((8, 128, 4096), (32, 128, 4096),
+                                 (16, 384, 4096), (32, 384, 4096),
+                                 (16, 128, 2048)):
+            try:
+                w, _ = _amortized_wall(
+                    lambda: PS.pair_supports(pt, items, NI, s_block=sb,
+                                             p_tile=ptile, i_tile=itile),
+                    n_iters=8, repeats=3, roundtrip_s=rt)
+                sweep.append({"p_tile": ptile, "i_tile": itile,
+                              "s_block": sb, "wall_ms": round(w * 1e3, 2)})
+            except Exception as exc:
+                sweep.append({"p_tile": ptile, "i_tile": itile,
+                              "s_block": sb,
+                              "error": repr(exc).split("\n")[0][:120]})
+
     return {
         "kernel": "pair_supports (ops/pallas_support.py)",
         "geometry": f"P={P} NI={NI} S={S} W={W} "
@@ -136,6 +160,7 @@ def bench_pair_supports() -> dict:
         "effective_GBps_min_bytes": round(min_bytes / wall / 1e9, 1),
         "jnp_wall_ms": round(jnp_wall * 1e3, 2),
         "speedup_vs_jnp": round(jnp_wall / wall, 2),
+        "tile_sweep": sweep,
     }
 
 

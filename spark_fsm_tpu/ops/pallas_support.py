@@ -43,10 +43,12 @@ from jax.experimental.pallas import tpu as pltpu
 # Tile sizes obey the TPU (sublane, lane) = (8, 128) layout: the out block
 # [P_TILE, I_TILE] puts item tiles on lanes, so I_TILE must be a multiple
 # of 128; the seq-block (lane width of the streamed bitmap blocks) shrinks
-# with the word count so VMEM residency stays ~constant.  P_TILE=32 was
-# measured NO faster at headline shapes (48.7ms vs 45.5ms for a
-# [2048x384x78k] matrix on v5e) — the kernel is VPU-compute-bound there,
-# not item-refetch-bound, so halving item re-reads buys nothing.
+# with the word count so VMEM residency stays ~constant.  The defaults are
+# measured, not load-bearing: the tile sweep in KERNELS.json (`python
+# bench_kernels.py`, amortized-fence walls) covers (p_tile, i_tile) and
+# s_block neighbors at the headline [2048x384x78k] geometry — the kernel
+# is VPU-compute-bound there, so tile choice moves the wall only within
+# session noise; trust the committed artifact over any remembered number.
 P_TILE = 16
 I_TILE = 128
 S_BLOCK = 4096
@@ -57,77 +59,91 @@ def seq_block(n_words: int) -> int:
     return max(128, (S_BLOCK // max(1, n_words)) // 128 * 128)
 
 
-def _pair_support_kernel_1w(pt_ref, items_ref, out_ref):
+def _make_pair_kernel_1w(p_tile: int):
     """Single-word fast path: 2-D blocks.  Kept separate from the general
     kernel because the degenerate [*, 1, S] block shape compiles ~15x
     slower in Mosaic (measured ~420s vs ~25s full-engine cold start) for
     identical steady-state throughput."""
 
-    @pl.when(pl.program_id(2) == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+    def kernel(pt_ref, items_ref, out_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
 
-    items = items_ref[:]                            # [I_T, S_B]
-    acc = []
-    for p in range(P_TILE):                         # static unroll
-        row = pt_ref[p, :]                          # [S_B]
-        hit = ((row[None, :] & items) != 0).astype(jnp.int32)
-        acc.append(jnp.sum(hit, axis=-1))           # [I_T]
-    out_ref[:] += jnp.stack(acc)                    # [P_T, I_T]
+        items = items_ref[:]                        # [I_T, S_B]
+        acc = []
+        for p in range(p_tile):                     # static unroll
+            row = pt_ref[p, :]                      # [S_B]
+            hit = ((row[None, :] & items) != 0).astype(jnp.int32)
+            acc.append(jnp.sum(hit, axis=-1))       # [I_T]
+        out_ref[:] += jnp.stack(acc)                # [P_T, I_T]
+
+    return kernel
 
 
-def _pair_support_kernel(pt_ref, items_ref, out_ref):
+def _make_pair_kernel(p_tile: int):
     """out[p_tile, i_tile] += #seqs with any word of (pt[p] & items[i]) != 0."""
 
-    @pl.when(pl.program_id(2) == 0)
-    def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+    def kernel(pt_ref, items_ref, out_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
 
-    n_words = items_ref.shape[1]
-    acc = []
-    for p in range(P_TILE):                         # static unroll
-        hit = None
-        for w in range(n_words):                    # static unroll
-            row = pt_ref[p, w, :]                   # [S_B]
-            h = (row[None, :] & items_ref[:, w, :]) != 0
-            hit = h if hit is None else (hit | h)   # any word -> seq contains
-        acc.append(jnp.sum(hit.astype(jnp.int32), axis=-1))  # [I_T]
-    out_ref[:] += jnp.stack(acc)                    # [P_T, I_T]
+        n_words = items_ref.shape[1]
+        acc = []
+        for p in range(p_tile):                     # static unroll
+            hit = None
+            for w in range(n_words):                # static unroll
+                row = pt_ref[p, w, :]               # [S_B]
+                h = (row[None, :] & items_ref[:, w, :]) != 0
+                hit = h if hit is None else (hit | h)  # any word -> contains
+            acc.append(jnp.sum(hit.astype(jnp.int32), axis=-1))  # [I_T]
+        out_ref[:] += jnp.stack(acc)                # [P_T, I_T]
+
+    return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("n_item_rows", "s_block", "interpret"))
+@functools.partial(jax.jit, static_argnames=(
+    "n_item_rows", "s_block", "p_tile", "i_tile", "interpret"))
 def pair_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
-                  *, s_block: int = S_BLOCK, interpret: bool = False) -> jax.Array:
+                  *, s_block: int = S_BLOCK, p_tile: int = P_TILE,
+                  i_tile: int = I_TILE,
+                  interpret: bool = False) -> jax.Array:
     """Pair-support matrix between parent rows and item rows.
 
     Args:
       pt: [P, W, S] uint32 — (plain, s-ext-transformed) parent rows in
-        kernel layout; P must be a multiple of P_TILE, S of s_block.
+        kernel layout; P must be a multiple of p_tile, S of s_block.
       items: [T, W, S] uint32 item id-lists in kernel layout; rows
         0..n_item_rows-1 are paired against.
       n_item_rows: number of leading item rows to pair against (rounded up
-        to I_TILE internally; callers index out[:, :n_items]).
+        to i_tile internally; callers index out[:, :n_items]).
+      p_tile/i_tile: tile overrides (bench_kernels sweeps them; engines
+        use the measured defaults — i_tile must stay a multiple of the
+        128-lane tile).
 
     Returns:
-      [P, NI] int32 supports, NI = n_item_rows rounded up to I_TILE.
+      [P, NI] int32 supports, NI = n_item_rows rounded up to i_tile.
     """
     P, W, S = pt.shape
-    assert P % P_TILE == 0 and S % s_block == 0, (P, S, s_block)
+    assert P % p_tile == 0, (P, p_tile)
+    assert S % s_block == 0, (S, s_block)
+    assert i_tile % 128 == 0, i_tile
     assert items.shape[1] == W, (items.shape, W)
-    ni = -(-n_item_rows // I_TILE) * I_TILE
+    ni = -(-n_item_rows // i_tile) * i_tile
     assert ni <= items.shape[0], (ni, items.shape)
-    grid = (P // P_TILE, ni // I_TILE, S // s_block)
-    out_specs = pl.BlockSpec((P_TILE, I_TILE), lambda p, i, sb: (p, i),
+    grid = (P // p_tile, ni // i_tile, S // s_block)
+    out_specs = pl.BlockSpec((p_tile, i_tile), lambda p, i, sb: (p, i),
                              memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((P, ni), jnp.int32)
-    if W == 1:  # 2-D fast path (see _pair_support_kernel_1w)
+    if W == 1:  # 2-D fast path (see _make_pair_kernel_1w)
         return pl.pallas_call(
-            _pair_support_kernel_1w,
+            _make_pair_kernel_1w(p_tile),
             grid=grid,
             in_specs=[
-                pl.BlockSpec((P_TILE, s_block), lambda p, i, sb: (p, sb),
+                pl.BlockSpec((p_tile, s_block), lambda p, i, sb: (p, sb),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((I_TILE, s_block), lambda p, i, sb: (i, sb),
+                pl.BlockSpec((i_tile, s_block), lambda p, i, sb: (i, sb),
                              memory_space=pltpu.VMEM),
             ],
             out_specs=out_specs,
@@ -135,12 +151,12 @@ def pair_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
             interpret=interpret,
         )(pt[:, 0, :], items[:, 0, :])
     return pl.pallas_call(
-        _pair_support_kernel,
+        _make_pair_kernel(p_tile),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((P_TILE, W, s_block), lambda p, i, sb: (p, 0, sb),
+            pl.BlockSpec((p_tile, W, s_block), lambda p, i, sb: (p, 0, sb),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((I_TILE, W, s_block), lambda p, i, sb: (i, 0, sb),
+            pl.BlockSpec((i_tile, W, s_block), lambda p, i, sb: (i, 0, sb),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=out_specs,
