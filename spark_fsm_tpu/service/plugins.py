@@ -103,7 +103,14 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
     mesh = config.get_mesh()
     if maxgap is None and maxwindow is None:
         # fused routing is a plain-SPADE knob (the constrained engine has
-        # no fused counterpart), so it must not reach mine_cspade_tpu
+        # no fused counterpart), so it must not reach mine_cspade_tpu.
+        # Streaming pushes (task == "stream") re-mine a window whose
+        # geometry drifts every micro-batch: pow2-bucket the device
+        # shapes so consecutive pushes reuse compiled programs instead of
+        # recompiling per window size (same knob WindowMiner's default
+        # mine uses; the constrained engine has no bucketing knob yet).
+        if req.task == "stream":
+            kwargs["shape_buckets"] = True
         return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
                               checkpoint=checkpoint,
                               **config.engine_kwargs("fused"), **kwargs)

@@ -452,3 +452,29 @@ def test_poll_consumer_feeds_service_stream(server):
     window = [s for b in batches[-2:] for s in b]  # keep 2 of 3
     want = mine_spade(window, abs_minsup(0.2, len(window)))
     assert patterns_text(sort_patterns(patterns)) == patterns_text(want)
+
+
+def test_stream_task_buckets_device_shapes():
+    # Streaming pushes through the SERVICE plugin boundary must bucket
+    # the device shapes (the window drifts every micro-batch; without
+    # bucketing every push recompiles the kernel chain), while a plain
+    # train request keeps exact shapes.  shape_key encodes the compiled
+    # geometry: pow2-bucketed seq axis for the stream task.
+    from spark_fsm_tpu.service import plugins
+    from spark_fsm_tpu.service.model import ServiceRequest
+
+    db = _batches(seed=51, n=1, size=50)[0]  # 50 seqs -> bucket 128
+    data = {"algorithm": "SPADE_TPU", "support": "0.2"}
+    stats_stream: dict = {}
+    plug = plugins.get_plugin(ServiceRequest("fsm", "stream", data))
+    plug.extract(ServiceRequest("fsm", "stream", data), db,
+                 stats=stats_stream)
+    assert ":s128" in stats_stream["shape_key"], stats_stream["shape_key"]
+
+    stats_train: dict = {}
+    plug = plugins.get_plugin(ServiceRequest("fsm", "train", data))
+    plug.extract(ServiceRequest("fsm", "train", data), db,
+                 stats=stats_train)
+    # CPU backend (conftest): an unbucketed 50-seq train mine compiles at
+    # the exact size — strictly stronger than asserting "not bucketed"
+    assert ":s50" in stats_train["shape_key"], stats_train["shape_key"]
