@@ -174,6 +174,15 @@ def next_pow2(n: int) -> int:
     return k
 
 
+def bucket_seq(n_seq: int) -> int:
+    """The shape_buckets sequence-axis bucket shared by every engine:
+    pow2 with a 128-lane floor.  One definition so a retune (floor for a
+    new TPU generation, bucket growth factor) cannot drift between the
+    engines — streaming windows mix them and must land on consistent
+    geometry."""
+    return max(128, next_pow2(n_seq))
+
+
 def launch_width_cap(pool_bytes: int, slot_bytes: int, floor: int) -> int:
     """Memory-safety ceiling on per-launch candidate widths.
 

@@ -38,7 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, auto_pool_bytes, decode_frontier, encode_frontier,
+    SlotPool, auto_pool_bytes, bucket_seq, decode_frontier, encode_frontier,
     launch_width_cap, load_checkpoint, next_pow2, scatter_build_store,
     zeros_fn)
 from spark_fsm_tpu.ops import maxstart_jax as MS
@@ -147,6 +147,7 @@ class ConstrainedSpadeTPU:
         recompute_chunk: int = 32,
         pool_bytes: Optional[int] = None,
         max_pattern_itemsets: Optional[int] = None,
+        shape_buckets: bool = False,
     ):
         self.vdb = vdb
         self.minsup = int(minsup_abs)
@@ -162,6 +163,16 @@ class ConstrainedSpadeTPU:
         self.max_pattern_itemsets = max_pattern_itemsets
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
+        # shape_buckets: pow2-bucket the sequence axis AND the item-row
+        # count so streaming windows with drifting geometry (size + the
+        # frequent-item projection) land on a handful of compiled shapes —
+        # same trade as the unconstrained engine (spade_tpu.py).  Extra
+        # item rows hold all-zero bitmaps; candidate indices stay < n_items.
+        self._shape_buckets = bool(shape_buckets)
+        item_rows = n_items
+        if self._shape_buckets:
+            n_seq = bucket_seq(n_seq)
+            item_rows = max(16, next_pow2(n_items))
         if mesh is not None:
             n_seq = pad_to_multiple(n_seq, mesh.devices.size)
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
@@ -197,8 +208,9 @@ class ConstrainedSpadeTPU:
         # allocate the state pool on device — neither the dense bitmaps nor
         # the (large, all-zero) pool ever exists in host memory or crosses
         # the link (same plan as the unconstrained engine's store build).
-        self.items = scatter_build_store(vdb, n_items, n_seq, n_words,
-                                         mesh=mesh, put=self._put)
+        self.items = scatter_build_store(vdb, item_rows, n_seq, n_words,
+                                         mesh=mesh, put=self._put,
+                                         bucket_tokens=self._shape_buckets)
         pool_shape = (pool_slots + 1, n_seq, self.n_pos)
         self.pool = zeros_fn(pool_shape, self.dtype, mesh)()
         self._pool_alloc = SlotPool(range(pool_slots))
@@ -206,10 +218,14 @@ class ConstrainedSpadeTPU:
         # s_candidates vs i_candidates: under maxgap the s-side is ALL root
         # items per node (the unsound-sibling-prune rule), so its share of
         # the candidate volume is the cost of that constraint — measured
-        # here, surfaced through job stats.
+        # here, surfaced through job stats.  shape_key: compiled-geometry
+        # identity (same contract as SpadeTPU.stats).
         self.stats = {"candidates": 0, "s_candidates": 0, "i_candidates": 0,
                       "kernel_launches": 0, "recomputed_nodes": 0,
-                      "reclaimed_slots": 0, "patterns": 0}
+                      "reclaimed_slots": 0, "patterns": 0,
+                      "shape_key": (f"cspade:s{n_seq}w{n_words}"
+                                    f"i{item_rows}p{pool_slots}"
+                                    f"nb{nb}c{self.chunk}")}
 
     # ------------------------------------------------------------------ fns
 

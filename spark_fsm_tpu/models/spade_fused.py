@@ -47,7 +47,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.vertical import VerticalDB
-from spark_fsm_tpu.models._common import next_pow2, scatter_build_store
+from spark_fsm_tpu.models._common import (
+    bucket_seq, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel import multihost as MH
@@ -132,7 +133,7 @@ def fused_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
     n_dev = 1 if mesh is None else mesh.devices.size
     n_seq = vdb.n_sequences
     if shape_buckets:
-        n_seq = max(128, next_pow2(n_seq))
+        n_seq = bucket_seq(n_seq)
     s_local = -(-n_seq // n_dev)
     row_bytes = s_local * vdb.n_words * 4
     est = (row_bytes * 2 * caps.f_cap * ni_pad
@@ -406,7 +407,7 @@ class FusedSpadeTPU:
         # windows with drifting sizes reuse the compiled program — same
         # trade as the classic engine's shape_buckets.
         if shape_buckets:
-            n_seq = max(128, next_pow2(n_seq))
+            n_seq = bucket_seq(n_seq)
         n_shards = 1 if mesh is None else mesh.devices.size
         self._s_block = min(PS.seq_block(n_words),
                             pad_to_multiple(-(-n_seq // n_shards), 128))

@@ -52,7 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, auto_pool_bytes, decode_frontier, encode_frontier,
+    SlotPool, auto_pool_bytes, bucket_seq, decode_frontier, encode_frontier,
     launch_width_cap, load_checkpoint, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
@@ -273,7 +273,7 @@ class SpadeTPU:
         # padded sequences are all-zero bitmaps and count nothing.
         self._shape_buckets = bool(shape_buckets)
         if self._shape_buckets:
-            n_seq = max(128, next_pow2(n_seq))
+            n_seq = bucket_seq(n_seq)
         self._s_block = min(PS.seq_block(n_words),
                             pad_to_multiple(-(-n_seq // n_shards), 128))
         mult = n_shards * self._s_block if self.use_pallas else n_shards

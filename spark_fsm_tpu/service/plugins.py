@@ -101,16 +101,16 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
     kwargs = config.engine_kwargs("pool_bytes", "node_batch",
                                   "pipeline_depth", "chunk", "recompute_chunk")
     mesh = config.get_mesh()
+    # Streaming pushes (task == "stream") re-mine a window whose geometry
+    # drifts every micro-batch: pow2-bucket the device shapes (both
+    # engines support the knob) so consecutive pushes reuse compiled
+    # programs instead of recompiling per window size — same knob
+    # WindowMiner's default mine uses.
+    if req.task == "stream":
+        kwargs["shape_buckets"] = True
     if maxgap is None and maxwindow is None:
         # fused routing is a plain-SPADE knob (the constrained engine has
-        # no fused counterpart), so it must not reach mine_cspade_tpu.
-        # Streaming pushes (task == "stream") re-mine a window whose
-        # geometry drifts every micro-batch: pow2-bucket the device
-        # shapes so consecutive pushes reuse compiled programs instead of
-        # recompiling per window size (same knob WindowMiner's default
-        # mine uses; the constrained engine has no bucketing knob yet).
-        if req.task == "stream":
-            kwargs["shape_buckets"] = True
+        # no fused counterpart), so it must not reach mine_cspade_tpu
         return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
                               checkpoint=checkpoint,
                               **config.engine_kwargs("fused"), **kwargs)

@@ -172,3 +172,45 @@ def test_engine_int16_path():
     got = eng.mine()
     want = mine_cspade(db, minsup, maxgap=1, maxwindow=3, max_pattern_itemsets=3)
     assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_engine_shape_buckets_parity_and_reuse():
+    # shape_buckets pow2-buckets the sequence axis and the item-row count
+    # (streaming windows re-mine with drifting geometry): parity must be
+    # unaffected, and two windows in the same buckets must compile to the
+    # SAME geometry (equal shape_key) while exact shapes would differ.
+    db = synthetic_db(seed=17, n_sequences=150, n_items=20,
+                      mean_itemsets=4.0, mean_itemset_size=1.3)
+    minsup = abs_minsup(0.05, len(db))
+    want = mine_cspade(db, minsup, maxgap=2, maxwindow=5)
+    s1 = {}
+    got = mine_cspade_tpu(db, minsup, maxgap=2, maxwindow=5,
+                          shape_buckets=True, stats_out=s1)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+    assert ":s256" in s1["shape_key"], s1["shape_key"]  # 150 -> 256
+
+    db2 = db[:140]  # different exact size, same pow2 bucket
+    s2 = {}
+    mine_cspade_tpu(db2, abs_minsup(0.05, len(db2)), maxgap=2, maxwindow=5,
+                    shape_buckets=True, stats_out=s2)
+    assert s1["shape_key"] == s2["shape_key"]
+    s3 = {}
+    mine_cspade_tpu(db2, abs_minsup(0.05, len(db2)), maxgap=2, maxwindow=5,
+                    stats_out=s3)  # unbucketed: exact geometry
+    assert ":s140" in s3["shape_key"], s3["shape_key"]
+
+
+def test_stream_task_buckets_constrained_path():
+    # the service plugin boundary applies shape_buckets to CONSTRAINED
+    # streaming pushes too (mirror of the unconstrained test in
+    # test_streaming.py)
+    from spark_fsm_tpu.service import plugins
+    from spark_fsm_tpu.service.model import ServiceRequest
+
+    db = synthetic_db(seed=18, n_sequences=50, n_items=12,
+                      mean_itemsets=4.0)
+    data = {"algorithm": "SPADE_TPU", "support": "0.2", "maxgap": "2"}
+    st: dict = {}
+    plug = plugins.get_plugin(ServiceRequest("fsm", "stream", data))
+    plug.extract(ServiceRequest("fsm", "stream", data), db, stats=st)
+    assert st["shape_key"].startswith("cspade:s128w"), st["shape_key"]
