@@ -140,14 +140,7 @@ def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
         put = jnp.asarray
     ti, ts, tw, tm = vdb.tok_item, vdb.tok_seq, vdb.tok_word, vdb.tok_mask
     if bucket_tokens:
-        # pad the token arrays to a power of two so streaming windows with
-        # drifting token counts reuse the compiled scatter (pad tokens have
-        # mask 0 — adding 0 to row 0 is a no-op)
-        cap = next_pow2(max(1, len(ti)))
-        pad = cap - len(ti)
-        if pad:
-            z = ((0, pad),)
-            ti, ts, tw, tm = (np.pad(a, z) for a in (ti, ts, tw, tm))
+        ti, ts, tw, tm = pad_tokens_pow2(ti, ts, tw, tm)
     return build(put(ti), put(ts), put(tw), put(tm))
 
 
@@ -181,6 +174,23 @@ def bucket_seq(n_seq: int) -> int:
     engines — streaming windows mix them and must land on consistent
     geometry."""
     return max(128, next_pow2(n_seq))
+
+
+def pad_tokens_pow2(ti, ts, tw, tm):
+    """Pow2-pad the four parallel token arrays (token-array LENGTH is a
+    traced shape, so drifting windows would otherwise retrace the scatter
+    per token count).  Pad tokens carry mask 0 — scattering them is an
+    add of 0 to row 0, a no-op.  Shared by scatter_build_store's
+    bucket_tokens path and TsrTPU's per-round prep (same one-definition
+    rationale as bucket_seq)."""
+    import numpy as np
+
+    cap = next_pow2(max(1, len(ti)))
+    pad = cap - len(ti)
+    if pad:
+        z = ((0, pad),)
+        ti, ts, tw, tm = (np.pad(a, z) for a in (ti, ts, tw, tm))
+    return ti, ts, tw, tm
 
 
 def launch_width_cap(pool_bytes: int, slot_bytes: int, floor: int) -> int:

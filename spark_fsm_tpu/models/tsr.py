@@ -42,7 +42,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    device_hbm_budget, load_checkpoint, next_pow2)
+    bucket_seq, device_hbm_budget, load_checkpoint, next_pow2,
+    pad_tokens_pow2)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.ops import pallas_tsr as PT
@@ -254,6 +255,7 @@ class TsrTPU:
         max_side: Optional[int] = None,
         eval_budget_bytes: Optional[int] = None,
         use_pallas="auto",
+        shape_buckets: bool = False,
     ):
         self.vdb = vdb
         self.k = int(k)
@@ -272,6 +274,14 @@ class TsrTPU:
         # Each deepening round instead builds ONLY the top-m item rows from
         # the token table (host memory/HBM proportional to m, not n_items).
         self.n_seq = vdb.n_sequences
+        # shape_buckets: pow2-bucket the sequence axis (and, downstream,
+        # the token-array lengths — _prep_engine) so streaming rule
+        # windows with drifting geometry reuse compiled programs; padded
+        # sequences hold all-zero bitmaps and support nothing.  Same knob
+        # as the SPADE engines (models/_common.bucket_seq).
+        self._shape_buckets = bool(shape_buckets)
+        if self._shape_buckets:
+            self.n_seq = bucket_seq(self.n_seq)
         n_shards = 1 if mesh is None else mesh.devices.size
         if mesh is not None:
             self.n_seq = pad_to_multiple(self.n_seq, n_shards)
@@ -303,6 +313,10 @@ class TsrTPU:
             self._sb = PT.seq_block(self.n_words,
                                     -(-self.n_seq // n_shards))
             self.n_seq = pad_to_multiple(self.n_seq, n_shards * self._sb)
+        # compiled-geometry identity (the static part — per-round top-m
+        # and km-bucket shapes vary by design); same contract as the
+        # SPADE engines' shape_key
+        self.stats["shape_key"] = f"tsr:s{self.n_seq}w{self.n_words}"
 
         # Per-launch dispatch latency dominates on remote/tunneled TPUs
         # (~100ms+ each; measured 6x wall-clock win going 256 -> 8192 on a
@@ -396,6 +410,10 @@ class TsrTPU:
         """Engine-layout ([m, S, W]) prefix/suffix-OR rows."""
         if self.mesh is None:
             ti, ts, tw, tm = self._sel_tokens(self._order[:m])
+            if self._shape_buckets:
+                # token-array length is a traced shape; see
+                # _common.pad_tokens_pow2
+                ti, ts, tw, tm = pad_tokens_pow2(ti, ts, tw, tm)
             p1, s1 = _build_prep_single(
                 jnp.asarray(ti), jnp.asarray(ts), jnp.asarray(tw),
                 jnp.asarray(tm), m=m, n_seq=self.n_seq,

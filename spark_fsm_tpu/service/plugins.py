@@ -151,8 +151,11 @@ def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
     from spark_fsm_tpu.models.tsr import mine_tsr_tpu
 
     k, minconf, max_side = _tsr_params(req)
+    kwargs = _tsr_kwargs()
+    if req.task == "stream":  # see _spade_tpu: bucket drifting windows
+        kwargs["shape_buckets"] = True
     return mine_tsr_tpu(db, k, minconf, max_side=max_side, mesh=config.get_mesh(),
-                        stats_out=stats, checkpoint=checkpoint, **_tsr_kwargs())
+                        stats_out=stats, checkpoint=checkpoint, **kwargs)
 
 
 ALGORITHMS: Dict[str, AlgorithmPlugin] = {

@@ -220,3 +220,41 @@ def test_kosarak_scale_runnable():
     rules = mine_tsr_tpu(db, k=100, minconf=0.5)
     assert len(rules) >= 100
     assert all(conf_ok(sup, supx, 0.5) for _, _, sup, supx in rules)
+
+
+def test_shape_buckets_parity_and_reuse():
+    # shape_buckets pow2-buckets the sequence axis and token-array lengths
+    # (streaming rule windows drift every push): rule set must be
+    # unaffected, and two windows in the same bucket must share the
+    # compiled geometry (equal shape_key static part).
+    rng = np.random.default_rng(61)
+    db = random_db(rng, n_seq=60, n_items=6, max_itemsets=5, max_set=2)
+    s1 = {}
+    got = mine_tsr_tpu(db, 8, 0.4, max_side=2, shape_buckets=True,
+                       stats_out=s1)
+    want = brute_force_rules(db, 8, 0.4, max_side=2)
+    assert rules_text(got) == rules_text(want)
+    assert s1["shape_key"].startswith("tsr:s128"), s1["shape_key"]  # 60->128
+
+    s2 = {}
+    mine_tsr_tpu(db[:50], 8, 0.4, max_side=2, shape_buckets=True,
+                 stats_out=s2)
+    assert s1["shape_key"] == s2["shape_key"]
+    s3 = {}
+    mine_tsr_tpu(db[:50], 8, 0.4, max_side=2, stats_out=s3)
+    assert s3["shape_key"].startswith("tsr:s50"), s3["shape_key"]
+
+
+def test_stream_task_buckets_tsr_path():
+    # the service plugin boundary buckets TSR streaming pushes too
+    from spark_fsm_tpu.service import plugins
+    from spark_fsm_tpu.service.model import ServiceRequest
+
+    rng = np.random.default_rng(62)
+    db = random_db(rng, n_seq=40, n_items=6, max_itemsets=5, max_set=2)
+    data = {"algorithm": "TSR_TPU", "k": "5", "minconf": "0.4",
+            "max_side": "2"}
+    st: dict = {}
+    plug = plugins.get_plugin(ServiceRequest("fsm", "stream", data))
+    plug.extract(ServiceRequest("fsm", "stream", data), db, stats=st)
+    assert st["shape_key"].startswith("tsr:s128"), st["shape_key"]
