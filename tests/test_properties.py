@@ -1,0 +1,93 @@
+"""Property-based tests (hypothesis): the invariants that hold for EVERY
+database, not just the seeded fixtures.
+
+Strategy sizes are kept small (the oracle is the per-example cost) and
+example counts modest so the whole file stays interactive; the point is
+randomized structural coverage — empty itemsets never exist, duplicate
+items collapse, single-sequence DBs, all-identical sequences, etc. —
+that seeded generators tend to miss.
+"""
+
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis",  # optional test dep: see [project.optional-dependencies]
+    reason="property tests need hypothesis (pip install .[test])")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from spark_fsm_tpu.data.spmf import format_spmf, parse_spmf
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+from spark_fsm_tpu.models.tsr import brute_force_rules, mine_tsr_tpu
+from spark_fsm_tpu.utils.canonical import (
+    diff_patterns, patterns_text, rules_text)
+
+# a SequenceDB: 1-12 sequences of 1-5 itemsets of 1-3 items from a small
+# alphabet (small enough that the oracle is instant, rich enough to hit
+# repeats, single-item sets, and duplicate sequences)
+_itemset = st.frozensets(st.integers(1, 6), min_size=1, max_size=3)
+_sequence = st.lists(_itemset, min_size=1, max_size=5).map(
+    lambda s: tuple(tuple(sorted(i)) for i in s))
+_db = st.lists(_sequence, min_size=1, max_size=12)
+
+
+@settings(max_examples=40, deadline=None)
+@given(_db)
+def test_spmf_roundtrip(db):
+    # format -> parse is the identity on canonical (sorted-itemset) DBs
+    assert parse_spmf(format_spmf(db)) == [tuple(seq) for seq in db]
+
+
+@settings(max_examples=25, deadline=None)
+@given(_db, st.integers(1, 4))
+def test_engine_parity_random(db, minsup):
+    want = mine_spade(db, minsup)
+    got = mine_spade_tpu(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+@settings(max_examples=25, deadline=None)
+@given(_db, st.integers(1, 4))
+def test_fused_vs_classic_random(db, minsup):
+    # both execution strategies must enumerate identically
+    classic = mine_spade_tpu(db, minsup, fused="never")
+    fused = mine_spade_tpu(db, minsup, fused="always")
+    assert patterns_text(classic) == patterns_text(fused), \
+        diff_patterns(classic, fused)
+
+
+@settings(max_examples=15, deadline=None)
+@given(_db, st.sampled_from([0.3, 0.5, 0.8]))
+def test_tsr_parity_random(db, minconf):
+    want = brute_force_rules(db, 5, minconf, max_side=2)
+    got = mine_tsr_tpu(db, 5, minconf, max_side=2)
+    assert rules_text(got) == rules_text(want)
+
+
+@settings(max_examples=25, deadline=None)
+@given(_db)
+def test_support_monotonicity(db):
+    # anti-monotonicity: every pattern's support is <= the support of
+    # each of its single-item patterns (a consequence the whole prune
+    # logic relies on), and supports never exceed |DB|
+    res = mine_spade(db, 1)
+    singles = {p[0][0]: s for p, s in res if len(p) == 1 and len(p[0]) == 1}
+    for pat, sup in res:
+        assert 1 <= sup <= len(db)
+        for itemset in pat:
+            for it in itemset:
+                assert sup <= singles[it]
+
+
+@settings(max_examples=25, deadline=None)
+@given(_db)
+def test_vertical_build_supports_match_oracle_singles(db):
+    # the vertical DB's per-item sequence supports equal the oracle's
+    # 1-pattern supports (the projection the whole mine seeds from)
+    vdb = build_vertical(db, min_item_support=1)
+    singles = {p[0][0]: s for p, s in mine_spade(db, 1)
+               if len(p) == 1 and len(p[0]) == 1}
+    got = {int(vdb.item_ids[i]): int(vdb.item_supports[i])
+           for i in range(vdb.n_items)}
+    assert got == singles
