@@ -85,11 +85,28 @@ def main() -> None:
     fgot = feng.mine()
     ok_f = fgot is not None and patterns_text(fgot) == patterns_text(want)
 
+    # streaming over the same multi-host mesh (SURVEY.md sec 2.5 meets
+    # sec 2.2): every process pushes the identical micro-batches; the
+    # shape-bucketed window re-mines run the one compiled program per
+    # bucket and every process computes the identical pattern set
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+    from spark_fsm_tpu.streaming.window import WindowMiner
+
+    wm = WindowMiner(0.1, max_batches=2,
+                     mine=lambda d, ms: mine_spade_tpu(
+                         d, ms, mesh=mesh, shape_buckets=True,
+                         pool_bytes=32 << 20, node_batch=16))
+    ok_s = True
+    for lo in (0, 70, 140):
+        wm.push(db[lo:lo + 70])
+        wwant = mine_spade(wm.window.sequences(), wm.minsup_abs())
+        ok_s &= patterns_text(wm.patterns) == patterns_text(wwant)
+
     print(f"MULTIHOST_OK pid={pid} patterns={len(got)} parity={ok} "
           f"pallas_parity={ok_k} cspade_parity={ok_c} tsr_parity={ok_r} "
-          f"fused_parity={ok_f}",
+          f"fused_parity={ok_f} stream_parity={ok_s}",
           flush=True)
-    assert ok and ok_k and ok_c and ok_r and ok_f
+    assert ok and ok_k and ok_c and ok_r and ok_f and ok_s
     shutdown_distributed()
 
 

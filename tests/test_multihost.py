@@ -51,3 +51,4 @@ def test_two_process_mesh_parity():
         assert "pallas_parity=True" in out, out
         assert "cspade_parity=True" in out and "tsr_parity=True" in out, out
         assert "fused_parity=True" in out, out
+        assert "stream_parity=True" in out, out
