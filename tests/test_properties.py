@@ -91,3 +91,35 @@ def test_vertical_build_supports_match_oracle_singles(db):
     got = {int(vdb.item_ids[i]): int(vdb.item_supports[i])
            for i in range(vdb.n_items)}
     assert got == singles
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.text(max_size=200))
+def test_parser_total_on_arbitrary_text(text):
+    # the service parses CLIENT-supplied text: for arbitrary input the
+    # parser must either raise ValueError or return a well-formed DB —
+    # never crash differently, hang, or return malformed structures
+    try:
+        db = parse_spmf(text)
+    except ValueError:
+        return
+    for seq in db:
+        assert isinstance(seq, tuple) and seq
+        for itemset in seq:
+            assert isinstance(itemset, tuple) and itemset
+            assert list(itemset) == sorted(set(itemset))
+            assert all(isinstance(i, int) and i > 0 for i in itemset)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.integers(-5, 8), min_size=0, max_size=30))
+def test_parser_total_on_numeric_token_soup(tokens):
+    # all-numeric lines exercise the -1/-2 state machine itself (random
+    # text rarely gets past int()): same totality property, plus the
+    # round-trip holds for whatever the parser accepted
+    line = " ".join(map(str, tokens))
+    try:
+        db = parse_spmf(line)
+    except ValueError:
+        return
+    assert parse_spmf(format_spmf(db)) == db
