@@ -84,6 +84,7 @@ class PollConsumer:
         self._on_error = on_error
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._consecutive_errors = 0
         self.stats = {"polls": 0, "idle_polls": 0, "batches": 0,
                       "sequences": 0, "errors": 0, "stopped": None}
 
@@ -131,8 +132,6 @@ class PollConsumer:
                     except Exception:
                         pass  # reporting must not kill the loop
         return True
-
-    _consecutive_errors = 0
 
     def run(self, max_polls: Optional[int] = None) -> dict:
         """Poll until stopped; returns the stats dict.
