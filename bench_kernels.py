@@ -99,7 +99,7 @@ def bench_pair_supports() -> dict:
     from spark_fsm_tpu.ops import pallas_support as PS
 
     P, NI, W = 2048, 384, 1
-    S = -(-77500 // PS.S_BLOCK) * PS.S_BLOCK  # 79872
+    S = -(-77500 // PS.S_BLOCK) * PS.S_BLOCK  # 77824 (19 x 4096)
     # synthesize ON DEVICE: shipping ~0.8 GB of host randomness through a
     # ~10 MB/s tunnel would take minutes and measure nothing
     k1, k2 = jax.random.split(jax.random.PRNGKey(7))

@@ -103,10 +103,15 @@ def main() -> None:
             # decision that was never made)
             if "fused" in stats:
                 row["route"] = "fused" if stats["fused"] else "classic"
-            for key in ("fused_overflow", "fused_skipped", "kernel_launches",
-                        "pallas_fallback"):
+            for key in ("fused_overflow", "fused_skipped", "kernel_launches"):
                 if stats.get(key) is not None:
                     row[key] = stats[key]
+            # mid-mine Pallas downgrades: SPADE records "pallas_fallback",
+            # TSR one key per failed km bucket ("pallas_fallback_km2") —
+            # match by prefix so neither engine's faults go unreported
+            for key, val in stats.items():
+                if key.startswith("pallas_fallback"):
+                    row[key] = val
         results.append(row)
         print(json.dumps(row), flush=True)
 

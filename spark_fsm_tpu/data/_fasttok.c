@@ -45,6 +45,16 @@ flatten(PyObject *self, PyObject *arg)
         if (seq == NULL)
             goto fail_db;
         Py_ssize_t ns = PySequence_Fast_GET_SIZE(seq);
+        /* totals feed n_sets*8 / n_toks*8 byte counts below: cap them so
+         * a lying __len__ cannot overflow signed Py_ssize_t (UB) — the
+         * same adversarial inputs the re-read guards handle get a clean
+         * error here too */
+        if (ns > PY_SSIZE_T_MAX / 8 - n_sets) {
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_OverflowError,
+                            "tokenizer size totals overflow");
+            goto fail_db;
+        }
         n_sets += ns;
         for (Py_ssize_t j = 0; j < ns; j++) {
             if (j >= PySequence_Fast_GET_SIZE(seq)) {
@@ -57,6 +67,12 @@ flatten(PyObject *self, PyObject *arg)
             Py_ssize_t sz = PySequence_Size(PySequence_Fast_GET_ITEM(seq, j));
             if (sz < 0) {
                 Py_DECREF(seq);
+                goto fail_db;
+            }
+            if (sz > PY_SSIZE_T_MAX / 8 - n_toks) {
+                Py_DECREF(seq);
+                PyErr_SetString(PyExc_OverflowError,
+                                "tokenizer size totals overflow");
                 goto fail_db;
             }
             n_toks += sz;

@@ -106,13 +106,8 @@ class PollConsumer:
         except StopConsumer:
             raise
         except Exception as exc:
-            self.stats["errors"] += 1
+            self._report_error(exc)
             self._consecutive_errors += 1
-            if self._on_error is not None:
-                try:
-                    self._on_error(exc)
-                except Exception:
-                    pass  # reporting must not kill the loop
             return False
         self._consecutive_errors = 0
         self.stats["batches"] += 1
@@ -125,13 +120,18 @@ class PollConsumer:
                 # reporting failure, not a consume failure: count + surface
                 # it, never kill the loop (the supervision contract), and
                 # leave the consecutive-error streak reset by the consume
-                self.stats["errors"] += 1
-                if self._on_error is not None:
-                    try:
-                        self._on_error(exc)
-                    except Exception:
-                        pass  # reporting must not kill the loop
+                self._report_error(exc)
         return True
+
+    def _report_error(self, exc: Exception) -> None:
+        """Count + surface an error; the reporting callback itself must
+        never kill the loop."""
+        self.stats["errors"] += 1
+        if self._on_error is not None:
+            try:
+                self._on_error(exc)
+            except Exception:
+                pass  # reporting must not kill the loop
 
     def run(self, max_polls: Optional[int] = None) -> dict:
         """Poll until stopped; returns the stats dict.
