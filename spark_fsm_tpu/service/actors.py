@@ -266,7 +266,9 @@ class Questor:
 
     Supports the reference's rule-filtering queries for prediction
     (SURVEY.md sec 3.2): 'antecedent'/'consequent' params restrict rules
-    to those whose side intersects the given items.
+    to those whose side intersects the given items, and
+    ``/get/prediction?items=...`` returns ranked next-item candidates
+    (best rule per item, confidence-ordered).
     """
 
     def __init__(self, store: ResultStore) -> None:
@@ -300,6 +302,51 @@ class Questor:
                 rules = [r for r in rules if want & set(r[1])]
             return model.response(req, Status.FINISHED,
                                   rules=model.serialize_rules(rules))
+        if subject == "prediction":
+            # Next-item prediction (SURVEY.md sec 3.2): rules whose
+            # antecedent is CONTAINED in the observed item set vote for
+            # their consequent items; each candidate keeps its best rule
+            # (confidence first, support as tie-break) and items already
+            # observed are excluded.  This is the ranked form of the
+            # antecedent filter above — the reference ecosystem's use of
+            # mined rules.
+            payload = self.store.rules(uid)
+            if payload is None:
+                return model.response(req, Status.FAILURE, error="no rules")
+            items_param = req.param("items")
+            if not items_param:
+                return model.response(
+                    req, Status.FAILURE,
+                    error="prediction needs 'items' (comma-separated item "
+                          "ids observed so far)")
+            try:
+                have = {int(i) for i in items_param.split(",")}
+            except ValueError:
+                return model.response(
+                    req, Status.FAILURE,
+                    error=f"bad 'items' value {items_param!r}")
+            best: Dict[int, tuple] = {}
+            for x, y, sup, supx in model.deserialize_rules(payload):
+                if supx <= 0 or not set(x) <= have:
+                    continue
+                conf = sup / supx
+                for it in y:
+                    if it in have:
+                        continue
+                    cur = best.get(it)
+                    if cur is None or (conf, sup) > (cur[0], cur[1]):
+                        best[it] = (conf, sup, supx, x, y)
+            ranked = sorted(best.items(),
+                            key=lambda kv: (-kv[1][0], -kv[1][1], kv[0]))
+            # entry shape mirrors serialize_rules (exact sup/supx kept
+            # integral, confidence the same float division) so a
+            # prediction cross-references its /get/rules entry exactly
+            return model.response(
+                req, Status.FINISHED, predictions=json.dumps([
+                    {"item": it, "confidence": conf, "support": sup,
+                     "antecedent_support": supx,
+                     "antecedent": list(x), "consequent": list(y)}
+                    for it, (conf, sup, supx, x, y) in ranked]))
         return model.response(req, Status.FAILURE,
                               error=f"unknown subject {subject!r}")
 

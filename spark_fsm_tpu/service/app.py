@@ -6,6 +6,8 @@ Endpoints (POST, form- or JSON-encoded parameters):
   /status/{uid}       — job lifecycle status (also /status?uid=...)
   /get/patterns       — mined patterns for uid (when finished)
   /get/rules          — mined rules, optional antecedent/consequent filter
+  /get/prediction     — ranked next-item candidates from mined rules
+                        (items=observed ids; best rule per candidate)
   /track/{topic}      — ingest one event for later TRACKED-source mining
   /stream/{topic}     — push an SPMF micro-batch into the topic's sliding
                         window; the window is re-mined and results served

@@ -204,6 +204,37 @@ def test_tsr_rules_and_filtering(server):
     assert frules and all(some_item in r[0] for r in frules)
     assert len(frules) <= len(rules)
 
+    # ranked next-item prediction: every candidate's best rule has its
+    # antecedent contained in the observed items (a MULTI-item observed
+    # set, so real subset matching runs), candidates exclude the
+    # observed items, ordering is confidence-desc (support tie-break),
+    # and each entry carries the exact sup/supx pair of its quoted rule
+    import json as _json
+
+    have = set(rules[0][0]) | {rules[-1][0][0]}
+    items_arg = ",".join(map(str, sorted(have)))
+    pred = _post(server, "/get/prediction", uid=uid, items=items_arg)
+    assert pred["status"] == "finished", pred
+    preds = _json.loads(pred["data"]["predictions"])
+    assert preds, "expected at least one prediction"
+    confs = [p["confidence"] for p in preds]
+    assert confs == sorted(confs, reverse=True)
+    rule_index = {(tuple(r[0]), tuple(r[1])): r for r in rules}
+    for p in preds:
+        assert p["item"] not in have
+        assert set(p["antecedent"]) <= have
+        assert p["item"] in p["consequent"]
+        src_rule = rule_index[(tuple(p["antecedent"]), tuple(p["consequent"]))]
+        assert (p["support"], p["antecedent_support"]) == (src_rule[2], src_rule[3])
+        assert p["confidence"] == src_rule[2] / src_rule[3]
+    # observed items with no matching rules -> empty prediction list,
+    # still a finished response; missing items param -> failure
+    none = _post(server, "/get/prediction", uid=uid, items="999999")
+    assert none["status"] == "finished"
+    assert _json.loads(none["data"]["predictions"]) == []
+    bad = _post(server, "/get/prediction", uid=uid)
+    assert bad["status"] == "failure"
+
 
 def test_failure_supervision(server):
     # unknown algorithm rejected synchronously
