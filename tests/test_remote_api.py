@@ -136,3 +136,22 @@ def test_oversized_line_drained_and_framing_kept(remote, monkeypatch):
     resp = json.loads(f.readline())
     assert resp["task"] == "status"
     raw.close()
+
+
+def test_prediction_over_socket(remote):
+    # the prediction subject rides the same task vocabulary over TCP
+    client = RemoteClient(port=remote.port)
+    resp = client.request("train", {
+        "algorithm": "TSR", "source": "INLINE",
+        "sequences": "1 -1 2 -2\n1 -1 2 -2\n1 -1 3 -2\n2 -1 3 -2\n",
+        "k": "5", "minconf": "0.3", "max_side": "1"})
+    uid = resp["data"]["uid"]
+    assert _wait_finished(client, uid)["status"] == "finished"
+    got = client.request("get:prediction", {"uid": uid, "items": "1"})
+    assert got["status"] == "finished", got
+    preds = json.loads(got["data"]["predictions"])
+    assert preds and all(p["item"] != 1 and p["antecedent"] == [1]
+                         for p in preds)
+    # 1 -> 2 holds in 2 of 3 sequences containing 1
+    top = {p["item"]: p for p in preds}
+    assert top[2]["support"] == 2 and top[2]["antecedent_support"] == 3
