@@ -157,6 +157,7 @@ class Miner:
     def __init__(self, store: ResultStore, workers: int = 1) -> None:
         self.store = store
         self._q: "queue.Queue[Optional[ServiceRequest]]" = queue.Queue()
+        self._stopping = False
         self._threads = [
             threading.Thread(target=self._loop, daemon=True,
                              name=f"fsm-miner-{i}")
@@ -182,6 +183,13 @@ class Miner:
             req = self._q.get()
             if req is None:
                 return
+            if self._stopping:
+                # draining: do NOT start queued backlog jobs — give each a
+                # durable failure status (visible through /status) instead
+                # of leaving it "started" forever or dying with the process
+                _record_failure(self.store, req.uid,
+                                RuntimeError("service shutting down"))
+                continue
             # Clear again at run start: with a reused uid, an EARLIER job
             # with the same uid may have written its error/results after
             # submit()'s clear (it was still queued/running then).  The
@@ -256,9 +264,23 @@ class Miner:
         self.store.incr("fsm:metric:jobs_finished")
         log_event("job_finished", uid=req.uid, **stats)
 
-    def shutdown(self) -> None:
+    def shutdown(self, join_timeout_s: float = 30.0) -> None:
+        """Drain: workers finish their CURRENT job only — queued backlog
+        jobs get a durable "service shutting down" failure status instead
+        of starting (the ``_stopping`` flag), and the threads are joined
+        against ONE shared deadline so shutdown wall time is bounded by
+        ``join_timeout_s`` total, not per worker.  A job outrunning the
+        deadline is abandoned loudly (logged; daemon threads die with the
+        process; a checkpointed job resumes on restart — the
+        torn-snapshot-safe StoreCheckpoint contract)."""
+        self._stopping = True
         for _ in self._threads:
             self._q.put(None)
+        deadline = time.monotonic() + join_timeout_s
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                log_event("shutdown_abandoned_worker", thread=t.name)
 
 
 class Questor:

@@ -29,6 +29,7 @@ import argparse
 import dataclasses
 import json
 import logging
+import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -222,7 +223,8 @@ def main() -> None:
     server = make_server(cfg.service.port, cfg.service.host,
                          miner_workers=cfg.service.miner_workers)
     print(f"spark_fsm_tpu service on http://{cfg.service.host}:"
-          f"{server.server_port}")
+          f"{server.server_port}", flush=True)
+    remote = None
     if cfg.service.remote_port:
         # Second protocol entry (the reference's Akka-remote analog):
         # actor-vocabulary JSON lines over TCP, same Master.
@@ -232,11 +234,35 @@ def main() -> None:
             server.master, cfg.service.host,  # type: ignore[attr-defined]
             cfg.service.remote_port)
         print(f"spark_fsm_tpu actor protocol on {cfg.service.host}:"
-              f"{remote.port}")
+              f"{remote.port}", flush=True)
+
+    def _term(signum, frame):
+        # SIGTERM (k8s / systemd stop) drains exactly like Ctrl-C: the
+        # serve loop exits, miners finish their CURRENT job and reach a
+        # durable status, both protocol servers close — instead of the
+        # default hard kill mid-mine.
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _term)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
+        pass
+    finally:
+        # cleanup can block on the miner drain (up to its join timeout):
+        # a second TERM/Ctrl-C must not raise inside this block and skip
+        # the remaining teardown
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        # close the listening sockets BEFORE draining so clients get
+        # connection-refused instead of hanging in the accept backlog of
+        # a server whose loop has already exited
+        server.server_close()
+        if remote is not None:
+            remote.shutdown()
+            remote.server_close()
         server.master.shutdown()  # type: ignore[attr-defined]
+        print("spark_fsm_tpu service stopped", flush=True)
 
 
 if __name__ == "__main__":

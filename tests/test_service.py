@@ -29,23 +29,31 @@ def server():
     srv.shutdown()
 
 
-def _post(server, endpoint, **params):
+def _post_port(port, endpoint, **params):
     data = urllib.parse.urlencode(params).encode()
-    url = f"http://127.0.0.1:{server.server_port}{endpoint}"
+    url = f"http://127.0.0.1:{port}{endpoint}"
     with urllib.request.urlopen(url, data=data, timeout=30) as resp:
         return json.loads(resp.read().decode())
 
 
-def _await_status(server, uid, want="finished", timeout=60.0):
+def _post(server, endpoint, **params):
+    return _post_port(server.server_port, endpoint, **params)
+
+
+def _await_status_port(port, uid, want="finished", timeout=60.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
-        resp = _post(server, f"/status/{uid}")
+        resp = _post_port(port, f"/status/{uid}")
         if resp["status"] == want:
             return resp
         if resp["status"] == "failure":
             raise AssertionError(f"job failed: {resp}")
         time.sleep(0.05)
     raise AssertionError(f"timeout waiting for {want}")
+
+
+def _await_status(server, uid, want="finished", timeout=60.0):
+    return _await_status_port(server.server_port, uid, want, timeout)
 
 
 def test_admin(server):
@@ -311,3 +319,66 @@ def test_concurrent_jobs_multiple_workers():
                 (uid, patterns)
     finally:
         master.shutdown()
+
+
+def test_sigterm_drains_service_cleanly():
+    # k8s/systemd stop: SIGTERM must drain like Ctrl-C — miners finish
+    # their current job to a durable status, both servers close, process
+    # exits 0 with the stop line printed (service/app.py main()).
+    import os
+    import pathlib
+    import signal as _signal
+    import subprocess
+    import sys
+
+    import socket
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # a REAL remote port so the drain also closes the actor-protocol
+    # server (remote-port 0 would disable it and skip that branch)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    rport = s.getsockname()[1]
+    s.close()
+    child = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import sys\n"
+        f"sys.argv = ['app', '--port', '0', '--remote-port', '{rport}']\n"
+        "from spark_fsm_tpu.service.app import main\n"
+        "main()\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        # wait for the boot line, then exercise one request and stop
+        line = proc.stdout.readline()
+        assert "spark_fsm_tpu service on http://" in line, line
+        port = int(line.rsplit(":", 1)[1])
+        # the remote server logs structured lines too — read until its
+        # boot banner appears (bounded; reading a fixed count would block
+        # on the pipe once the expected lines are exhausted)
+        seen = []
+        for _ in range(5):
+            line2 = proc.stdout.readline()
+            seen.append(line2)
+            if "actor protocol" in line2:
+                break
+        assert any("actor protocol" in l for l in seen), seen
+        resp = _post_port(port, "/train",
+                          algorithm="SPADE", source="INLINE",
+                          sequences="1 -1 2 -2\n1 -1 2 -2\n", support="0.5")
+        uid = resp["data"]["uid"]
+        _await_status_port(port, uid)
+        proc.send_signal(_signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{out}"
+    assert "spark_fsm_tpu service stopped" in out, out
+
+
