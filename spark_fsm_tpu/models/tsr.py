@@ -494,7 +494,8 @@ class TsrTPU:
         order = np.argsort(kms, kind="stable")
         parts = []
         cols = np.empty(n, np.int64)  # candidate r -> column in `out`
-        base = 0
+        used_kernel = False  # any bucket through the Pallas path: a
+        base = 0             # readback fault is then recountable
         g_lo = 0
         while g_lo < n:
             km = int(kms[order[g_lo]])
@@ -508,6 +509,7 @@ class TsrTPU:
                     base = self._dispatch_kernel_bucket(
                         p1, s1, cands, order, g_lo, g_hi, km,
                         parts, cols, base)
+                    used_kernel = True
                     g_lo = g_hi
                     continue
                 except Exception as exc:  # pragma: no cover - device-specific
@@ -522,13 +524,10 @@ class TsrTPU:
                     self.stats["kernel_launches"] = launches_mark
                     self._pallas_bad.add(km)
                     self.stats[f"pallas_fallback_km{km}"] = repr(exc)
-            if self.use_pallas and self._jnp_prep is None:
-                # first jnp bucket while the kernel path is live: build
-                # the engine-layout prep + budget width it needs (both
-                # prep pairs stay resident -> resident_preps=2)
-                self._jnp_prep = self._prep_engine(self._round_m)
-                self._jnp_chunk = self._round_chunk_jnp(self._round_m,
-                                                        resident_preps=2)
+            if self.use_pallas:
+                # first jnp bucket while the kernel path is live: both
+                # prep pairs stay resident (see _ensure_jnp_downgrade)
+                self._ensure_jnp_downgrade()
             pj, sj = self._jnp_prep if self._jnp_prep is not None else (p1, s1)
             fn = self._eval_fn(km)
             cw = self.chunk if not self.use_pallas else self._jnp_chunk
@@ -551,7 +550,18 @@ class TsrTPU:
             out.copy_to_host_async()
         except (AttributeError, NotImplementedError):
             pass  # method unavailable on this backend
-        return out, cols
+        return out, cols, used_kernel
+
+    def _ensure_jnp_downgrade(self) -> None:
+        """Build the engine-layout prep + budget width the jnp evaluator
+        needs after a kernel-path downgrade (the kernel path keeps
+        folded-layout preps and kernel-sized chunks).  Shared by the
+        per-bucket dispatch fallback and the readback recount so the two
+        downgrade paths cannot drift in sizing or layout."""
+        if self._jnp_prep is None:
+            self._jnp_prep = self._prep_engine(self._round_m)
+            self._jnp_chunk = self._round_chunk_jnp(self._round_m,
+                                                    resident_preps=2)
 
     def _bucket_seq_block(self, km: int) -> int:
         """Per-bucket kernel seq block: halve the engine block until the
@@ -594,7 +604,7 @@ class TsrTPU:
         return base
 
     def _resolve_eval(self, handle, n: int):
-        out, cols = handle
+        out, cols, _ = handle
         arr = np.asarray(out)
         return arr[0, cols].astype(np.int64), arr[1, cols].astype(np.int64)
 
@@ -758,7 +768,28 @@ class TsrTPU:
 
         def consume(batch, handle):
             nonlocal minsup, results, jcut
-            sups, supxs = self._resolve_eval(handle, len(batch))
+            try:
+                sups, supxs = self._resolve_eval(handle, len(batch))
+            except Exception as exc:
+                # TPU kernel RUNTIME faults surface at readback (compile/
+                # lowering faults were already caught per km bucket at
+                # dispatch).  Gate on whether THIS handle involved the
+                # kernel path — with PIPELINE_DEPTH>1 several kernel
+                # batches are in flight when the first fault lands, and
+                # each must be recounted (same contract as
+                # spade_tpu._resolve's was_pallas gating); a jnp-only
+                # handle failing is a real error.
+                if not (len(handle) > 2 and handle[2]):
+                    raise
+                self.use_pallas = False
+                self.stats["pallas_fallback"] = repr(exc)
+                self._ensure_jnp_downgrade()
+                if not self._chunk_user:
+                    self.chunk = self._jnp_chunk
+                self.stats["evaluated"] -= len(batch)  # recount, not new work
+                handle = self._dispatch_eval(
+                    p1, s1, [(x, y) for x, y, _ in batch])
+                sups, supxs = self._resolve_eval(handle, len(batch))
             # conf test as exact integer cross-multiply (no per-rule
             # Fraction construction): sup/supx >= num/den
             num, den = _conf_frac(self.minconf)

@@ -258,3 +258,41 @@ def test_stream_task_buckets_tsr_path():
     plug = plugins.get_plugin(ServiceRequest("fsm", "stream", data))
     plug.extract(ServiceRequest("fsm", "stream", data), db, stats=st)
     assert st["shape_key"].startswith("tsr:s128"), st["shape_key"]
+
+
+def test_pallas_readback_fault_recounts_batches(monkeypatch):
+    # TPU kernel RUNTIME faults surface at np.asarray in _resolve_eval,
+    # not at dispatch: the engine must downgrade to the jnp path, recount
+    # the in-flight batch(es), and still produce the exact rule set —
+    # mirror of test_spade_tpu's readback-fault test.  max_side=1 keeps
+    # every candidate in the km=1 bucket (single part, no concat), so the
+    # fault object survives dispatch and fails exactly at readback.
+    import spark_fsm_tpu.models.tsr as T
+
+    faults = []
+
+    class FaultyArray:
+        def copy_to_host_async(self):
+            pass
+
+        def __array__(self, *a, **k):
+            faults.append(1)
+            raise RuntimeError("synthetic readback fault")
+
+    monkeypatch.setattr(T, "_kernel_eval_fn",
+                        lambda *a, **k: lambda p1k, s1k, xy: FaultyArray())
+    rng = np.random.default_rng(71)
+    db = random_db(rng, n_seq=25, n_items=8, max_itemsets=5, max_set=2)
+    want = brute_force_rules(db, 8, 0.4, max_side=1)
+    # tiny pinned chunk: the frontier splits into several batches, so
+    # PIPELINE_DEPTH(=3) kernel handles are in flight when the first
+    # fault lands — each must be recounted (the used_kernel gating)
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                 max_side=1, use_pallas=True, chunk=2)
+    got = eng.mine()
+    assert rules_text(got) == rules_text(want)
+    assert eng.use_pallas is False
+    assert "synthetic readback fault" in eng.stats["pallas_fallback"]
+    # multiple in-flight kernel batches hit the fault and went through
+    # the recount path, not just the first
+    assert len(faults) >= 2, faults
