@@ -274,11 +274,14 @@ class TsrTPU:
         # Each deepening round instead builds ONLY the top-m item rows from
         # the token table (host memory/HBM proportional to m, not n_items).
         self.n_seq = vdb.n_sequences
-        # shape_buckets: pow2-bucket the sequence axis (and, downstream,
-        # the token-array lengths — _prep_engine) so streaming rule
+        # shape_buckets: pow2-bucket the sequence axis so streaming rule
         # windows with drifting geometry reuse compiled programs; padded
         # sequences hold all-zero bitmaps and support nothing.  Same knob
-        # as the SPADE engines (models/_common.bucket_seq).
+        # as the SPADE engines (models/_common.bucket_seq).  Single-device
+        # prep additionally pow2-pads the token arrays (they are traced
+        # shapes there — _prep_engine); the mesh branch scatter-builds the
+        # [m, S, W] rows on HOST (numpy), so token length never enters
+        # tracing and the seq-axis bucket above is the only shape knob.
         self._shape_buckets = bool(shape_buckets)
         if self._shape_buckets:
             self.n_seq = bucket_seq(self.n_seq)
@@ -472,6 +475,8 @@ class TsrTPU:
         flight.  ``_resolve_eval`` blocks on it — the split lets the mine
         loop pipeline the next dispatch behind the current readback."""
         n = len(cands)
+        launches0 = self.stats["kernel_launches"]  # handle carries its own
+        # launch count so a readback-fault recount can discard them (below)
         # Candidates dispatch per side-size bucket (pow2 km), NOT at one
         # batch-wide kmax: the km kernel's live-temp footprint grows with
         # km, so the adaptive width must NARROW as km grows — and
@@ -526,8 +531,13 @@ class TsrTPU:
                     self.stats[f"pallas_fallback_km{km}"] = repr(exc)
             if self.use_pallas:
                 # first jnp bucket while the kernel path is live: both
-                # prep pairs stay resident (see _ensure_jnp_downgrade)
+                # prep pairs stay resident (see _ensure_jnp_downgrade).
+                # Its prep-rebuild launch is REAL retained work — exclude
+                # it from this handle's discardable launch delta so a
+                # later readback-fault recount cannot subtract it.
+                before = self.stats["kernel_launches"]
                 self._ensure_jnp_downgrade()
+                launches0 += self.stats["kernel_launches"] - before
             pj, sj = self._jnp_prep if self._jnp_prep is not None else (p1, s1)
             fn = self._eval_fn(km)
             cw = self.chunk if not self.use_pallas else self._jnp_chunk
@@ -550,7 +560,8 @@ class TsrTPU:
             out.copy_to_host_async()
         except (AttributeError, NotImplementedError):
             pass  # method unavailable on this backend
-        return out, cols, used_kernel
+        return out, cols, used_kernel, \
+            self.stats["kernel_launches"] - launches0
 
     def _ensure_jnp_downgrade(self) -> None:
         """Build the engine-layout prep + budget width the jnp evaluator
@@ -604,7 +615,7 @@ class TsrTPU:
         return base
 
     def _resolve_eval(self, handle, n: int):
-        out, cols, _ = handle
+        out, cols = handle[0], handle[1]
         arr = np.asarray(out)
         return arr[0, cols].astype(np.int64), arr[1, cols].astype(np.int64)
 
@@ -784,9 +795,13 @@ class TsrTPU:
                 self.use_pallas = False
                 self.stats["pallas_fallback"] = repr(exc)
                 self._ensure_jnp_downgrade()
-                if not self._chunk_user:
+                if self._chunk_user is None:
                     self.chunk = self._jnp_chunk
-                self.stats["evaluated"] -= len(batch)  # recount, not new work
+                # recount, not new work: the faulted handle's evaluations
+                # AND its launches leave the exported stats (same contract
+                # as the dispatch-time fallback's launches_mark reset)
+                self.stats["evaluated"] -= len(batch)
+                self.stats["kernel_launches"] -= handle[3]
                 handle = self._dispatch_eval(
                     p1, s1, [(x, y) for x, y, _ in batch])
                 sups, supxs = self._resolve_eval(handle, len(batch))

@@ -158,6 +158,10 @@ class Miner:
         self.store = store
         self._q: "queue.Queue[Optional[ServiceRequest]]" = queue.Queue()
         self._stopping = False
+        # guards the _stopping check-and-enqueue in submit() against
+        # shutdown(): without it a submit could pass the check, lose the
+        # CPU, and enqueue BEHIND the sentinels after the workers exited
+        self._stop_lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._loop, daemon=True,
                              name=f"fsm-miner-{i}")
@@ -176,7 +180,20 @@ class Miner:
         log_event("job_submitted", uid=req.uid,
                   algorithm=req.param("algorithm", "SPADE_TPU"),
                   source=req.param("source", "FILE"))
-        self._q.put(req)
+        with self._stop_lock:
+            if not self._stopping:
+                # enqueued strictly BEFORE the sentinels (the lock orders
+                # us against shutdown), so a worker will dequeue it: either
+                # it runs, or the drain check gives it a durable failure
+                self._q.put(req)
+                return
+        # shutdown() already enqueued the worker sentinels; a request
+        # enqueued now would never be dequeued (workers exit on the
+        # sentinel) and would sit "started" forever — the exact state
+        # the drain exists to prevent.  Record the durable failure
+        # here, same status shape as the drained-backlog path.
+        _record_failure(self.store, req.uid,
+                        RuntimeError("service shutting down"))
 
     def _loop(self) -> None:
         while True:
@@ -273,9 +290,10 @@ class Miner:
         deadline is abandoned loudly (logged; daemon threads die with the
         process; a checkpointed job resumes on restart — the
         torn-snapshot-safe StoreCheckpoint contract)."""
-        self._stopping = True
-        for _ in self._threads:
-            self._q.put(None)
+        with self._stop_lock:
+            self._stopping = True
+            for _ in self._threads:
+                self._q.put(None)
         deadline = time.monotonic() + join_timeout_s
         for t in self._threads:
             t.join(max(0.0, deadline - time.monotonic()))

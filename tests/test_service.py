@@ -382,3 +382,22 @@ def test_sigterm_drains_service_cleanly():
     assert "spark_fsm_tpu service stopped" in out, out
 
 
+def test_submit_after_shutdown_fails_durably():
+    # A request racing past the closed listeners (remote/actor path, or an
+    # in-flight HTTP handler) and hitting Miner.submit() AFTER shutdown()
+    # has enqueued the worker sentinels must land in a durable 'failure'
+    # status — never sit 'started' forever on a queue no worker reads.
+    from spark_fsm_tpu.service.actors import Miner
+    from spark_fsm_tpu.service.model import ServiceRequest
+    from spark_fsm_tpu.service.store import ResultStore
+
+    store = ResultStore()
+    miner = Miner(store, workers=1)
+    miner.shutdown(join_timeout_s=10.0)
+    miner.submit(ServiceRequest("fsm", "train", {
+        "algorithm": "SPADE", "source": "INLINE",
+        "sequences": "1 -1 2 -2\n", "support": "0.5", "uid": "late"}))
+    assert store.status("late") == "failure"
+    assert "shutting down" in (store.get("fsm:error:late") or "")
+
+

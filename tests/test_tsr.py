@@ -296,3 +296,14 @@ def test_pallas_readback_fault_recounts_batches(monkeypatch):
     # multiple in-flight kernel batches hit the fault and went through
     # the recount path, not just the first
     assert len(faults) >= 2, faults
+    # exported stats must count ONLY the surviving jnp work: the faulted
+    # handles' evaluations AND their kernel launches are discarded (both
+    # downgrade paths share this contract), so the stats match a mine
+    # that never touched the kernel path at all
+    ref = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                 max_side=1, use_pallas=False, chunk=2)
+    assert rules_text(ref.mine()) == rules_text(want)
+    assert eng.stats["evaluated"] == ref.stats["evaluated"]
+    # +1: the downgrade's engine-layout prep rebuild is REAL work that
+    # stays counted; the discarded kernel eval launches do not
+    assert eng.stats["kernel_launches"] == ref.stats["kernel_launches"] + 1
