@@ -62,6 +62,7 @@ def main() -> None:
     from spark_fsm_tpu.data.spmf import load_spmf
     from spark_fsm_tpu.data.synth import bms_webview2_like
     from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+    from spark_fsm_tpu.models.spade_queue import QueueSpadeTPU, queue_eligible
     from spark_fsm_tpu.models.spade_tpu import SpadeTPU
     from spark_fsm_tpu.utils.canonical import patterns_text
 
@@ -81,9 +82,29 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     use_pallas = False if os.environ.get("BENCH_PALLAS") == "0" else "auto"
+    # Engine route mirrors the service default (mine_spade_tpu
+    # fused="auto"): the sparse-frontier queue engine where eligible —
+    # ONE readback for the whole mine vs one per DFS wave, the dominant
+    # cost on this tunneled chip (docs/DESIGN.md wall anatomy) — with the
+    # classic host-driven DFS as fallback.  BENCH_ENGINE=classic pins the
+    # old path for comparison runs (non-canonical: routing IS the
+    # default config).
+    want_engine = os.environ.get("BENCH_ENGINE", "auto")
+    if want_engine not in ("auto", "classic", "queue"):
+        print(f"bench: unknown BENCH_ENGINE={want_engine!r} "
+              "(accepted: auto, classic, queue)", file=sys.stderr)
+        sys.exit(2)
+    use_queue = (want_engine == "queue"
+                 or (want_engine == "auto" and queue_eligible(vdb)))
     t0 = time.time()
-    eng = SpadeTPU(vdb, minsup, use_pallas=use_pallas)
-    res = eng.mine()
+    if use_queue:
+        eng = QueueSpadeTPU(vdb, minsup, use_pallas=use_pallas)
+        res = eng.mine()
+        if res is None:  # cap overflow: route to classic like the service
+            use_queue = False
+    if not use_queue:
+        eng = SpadeTPU(vdb, minsup, use_pallas=use_pallas)
+        res = eng.mine()
     cold_s = time.time() - t0
 
     # Steady state, median of N passes: the shared host + TPU tunnel are
@@ -121,6 +142,7 @@ def main() -> None:
         "frequent_items": vdb.n_items,
         "platform": platform,
         "pallas": bool(eng.use_pallas),
+        "engine": "queue" if use_queue else "classic",
         "candidates": eng.stats["candidates"],
     }
     if fallback_reason:
@@ -139,6 +161,7 @@ def main() -> None:
     # quick run, or a parity FAILURE must never masquerade as the baseline.
     canonical = (scale == 1.0 and rel_minsup == 0.001 and not dataset
                  and os.environ.get("BENCH_PALLAS") != "0"
+                 and os.environ.get("BENCH_ENGINE", "auto") == "auto"
                  and out.get("parity") is True)
     if canonical:
         _publish(out)

@@ -72,7 +72,8 @@ def config2(parity: bool = False) -> dict:
         "datagen_s": round(t1 - t0, 2),
         "cold_wall_s": round(cold1 - cold0, 2),
         "wall_s": round(warm1 - warm0, 2),
-        "route": "fused" if stats.get("fused") else "classic",
+        "route": (stats["fused"] if isinstance(stats.get("fused"), str)
+                  else ("fused" if stats.get("fused") else "classic")),
         "fused_overflow": bool(stats.get("fused_overflow")),
         "platform": jax.default_backend(),
     }
@@ -205,7 +206,9 @@ def config5() -> dict:
         p0 = time.monotonic()
         wm.push(batch)
         walls.append(round(time.monotonic() - p0, 2))
-        routes.append("fused" if push_stats.get("fused") else "classic")
+        f = push_stats.get("fused")
+        routes.append(f if isinstance(f, str)
+                      else ("fused" if f else "classic"))
     return {
         "config": "5", "scale": 1.0,
         "metric": f"streaming SPADE sliding-window FULL ({n_push} "

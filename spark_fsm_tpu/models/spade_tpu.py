@@ -721,47 +721,70 @@ def mine_spade_tpu(
     fingerprint still matches (a stale/mismatched one is ignored, the mine
     restarts fresh).
 
-    ``fused``: "auto" routes small/medium databases through the fused
-    whole-mine-on-device engine (models/spade_fused.py — ONE blocking
-    readback instead of one per DFS wave, the dominant cost on
-    remote/tunneled TPUs); a static-cap overflow falls back to this
-    classic engine transparently.  "never" pins the classic engine,
-    "always" tries the fused engine regardless of size (still falling
-    back on overflow).  A checkpointed job always uses the classic
-    engine (the fused one has no resumable frontier); when that
-    overrides "auto"/"always", ``stats_out`` gets
-    ``fused_skipped="checkpoint"``.
+    ``fused``: "auto" routes through the best whole-mine-on-device engine
+    (ONE blocking readback instead of one per DFS wave, the dominant cost
+    on remote/tunneled TPUs): first the sparse-frontier queue engine
+    (models/spade_queue.py — classic-engine compute, works at headline
+    scale), then the dense fused engine (models/spade_fused.py) where
+    only it is eligible; a static-cap overflow falls back to this classic
+    engine transparently.  "never" pins the classic engine, "queue" /
+    "dense" pin one fused engine (still falling back on overflow),
+    "always" tries queue then dense regardless of the size heuristics.
+    A checkpointed job always uses the classic engine (the fused ones
+    have no resumable frontier); when that overrides a fused mode,
+    ``stats_out`` gets ``fused_skipped="checkpoint"``.
     """
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
         return []
-    if fused not in ("auto", "always", "never"):
-        raise ValueError(f"fused must be 'auto', 'always' or 'never', "
-                         f"got {fused!r}")
+    if fused not in ("auto", "always", "never", "queue", "dense"):
+        raise ValueError(f"fused must be 'auto', 'always', 'never', "
+                         f"'queue' or 'dense', got {fused!r}")
     if fused != "never" and checkpoint is not None and stats_out is not None:
-        # the fused engine has no resumable frontier; a checkpointed job
+        # the fused engines have no resumable frontier; a checkpointed job
         # degrades to the classic engine (flagged, not fatal — matching
         # the service's checkpoint-unsupported convention)
         stats_out["fused_skipped"] = "checkpoint"
-    if checkpoint is None and fused in ("auto", "always"):
-        from spark_fsm_tpu.models.spade_fused import fused_eligible, FusedSpadeTPU
-        if fused == "always" or fused_eligible(
-                vdb, mesh=mesh,
-                shape_buckets=kwargs.get("shape_buckets", False)):
-            feng = FusedSpadeTPU(
-                vdb, minsup_abs, mesh=mesh,
-                max_pattern_itemsets=max_pattern_itemsets,
-                use_pallas=kwargs.get("use_pallas", "auto"),
-                shape_buckets=kwargs.get("shape_buckets", False))
+    shape_buckets = kwargs.get("shape_buckets", False)
+    ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
+               use_pallas=kwargs.get("use_pallas", "auto"),
+               shape_buckets=shape_buckets)
+    queue_ran = False
+    if checkpoint is None and fused in ("auto", "always", "queue"):
+        from spark_fsm_tpu.models.spade_queue import (
+            QueueSpadeTPU, queue_eligible)
+        if fused in ("always", "queue") or queue_eligible(
+                vdb, mesh=mesh, shape_buckets=shape_buckets):
+            queue_ran = True
+            qeng = QueueSpadeTPU(vdb, minsup_abs, **ekw)
+            res = qeng.mine()
+            if res is not None:
+                if stats_out is not None:
+                    stats_out.update(qeng.stats)
+                return res
+            # cap overflow: fall through (classic, or dense under
+            # "always"), keeping the overflow marker visible so
+            # steady-state callers (e.g. streaming windows that overflow
+            # every push) can detect the doubled work and pin
+            # fused="never"
+            if stats_out is not None:
+                stats_out["fused_overflow"] = True
+                stats_out["fused_waves"] = qeng.stats.get("waves", 0)
+    if checkpoint is None and (
+            fused in ("always", "dense")
+            or (fused == "auto" and not queue_ran)):
+        # dense engine: pinned, "always"'s second try, or the rare
+        # queue-ineligible-but-dense-eligible corner of "auto"
+        from spark_fsm_tpu.models.spade_fused import (
+            FusedSpadeTPU, fused_eligible)
+        if fused in ("always", "dense") or fused_eligible(
+                vdb, mesh=mesh, shape_buckets=shape_buckets):
+            feng = FusedSpadeTPU(vdb, minsup_abs, **ekw)
             res = feng.mine()
             if res is not None:
                 if stats_out is not None:
                     stats_out.update(feng.stats)
                 return res
-            # cap overflow: fall through to the classic engine, keeping
-            # the overflow marker visible so steady-state callers (e.g.
-            # streaming windows that overflow every push) can detect the
-            # doubled work and pin fused="never"
             if stats_out is not None:
                 stats_out["fused_overflow"] = True
                 stats_out["fused_levels"] = feng.stats.get("levels", 0)

@@ -50,11 +50,14 @@ def test_engine_parity_random(db, minsup):
 @settings(max_examples=25, deadline=None)
 @given(_db, st.integers(1, 4))
 def test_fused_vs_classic_random(db, minsup):
-    # both execution strategies must enumerate identically
+    # all three execution strategies must enumerate identically ("queue"
+    # and "dense" pin one fused engine each — "always" would only reach
+    # the dense engine on queue overflow, silently dropping its coverage)
     classic = mine_spade_tpu(db, minsup, fused="never")
-    fused = mine_spade_tpu(db, minsup, fused="always")
-    assert patterns_text(classic) == patterns_text(fused), \
-        diff_patterns(classic, fused)
+    for mode in ("queue", "dense"):
+        fused = mine_spade_tpu(db, minsup, fused=mode)
+        assert patterns_text(classic) == patterns_text(fused), \
+            (mode, diff_patterns(classic, fused))
 
 
 @settings(max_examples=15, deadline=None)

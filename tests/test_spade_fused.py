@@ -91,8 +91,14 @@ def test_auto_routing_uses_fused_for_small_dbs():
     db = parse_spmf(ZAKI)
     stats = {}
     got = mine_spade_tpu(db, 2, stats_out=stats)
-    assert stats.get("fused") is True
+    # auto prefers the sparse-frontier queue engine (models/spade_queue)
+    assert stats.get("fused") == "queue"
     assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+    # the dense engine stays reachable, pinned
+    stats_d = {}
+    got_d = mine_spade_tpu(db, 2, stats_out=stats_d, fused="dense")
+    assert stats_d.get("fused") is True
+    assert patterns_text(got_d) == patterns_text(got)
     # fused="never" pins the classic engine; the routing decision is
     # still recorded (False), so artifact consumers can distinguish
     # "routed classic" from "this algorithm has no routing"

@@ -1,0 +1,218 @@
+"""Queue-fused sparse-frontier engine (models/spade_queue.py).
+
+Parity anchor: the CPU oracle, byte-identical pattern sets (SURVEY.md
+sec 4).  The queue engine reuses the dense fused engine's mask rules but
+drives them through a device-resident FIFO ring, so the extra surface
+under test is the ring discipline itself: slot reuse, wave splitting of
+wide levels, root aliasing of item rows, and overflow detection.
+"""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade, mine_spade_vertical
+from spark_fsm_tpu.models.spade_queue import (
+    QueueCaps, QueueSpadeTPU, queue_eligible)
+from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+ZAKI = "1 -1 2 -1 3 -2\n1 4 -1 3 -2\n1 -1 2 -1 3 4 -2\n1 3 -1 5 -2\n"
+SMALL_CAPS = QueueCaps(nb=32, ring=512, c_cap=2048, r_cap=16384)
+
+
+def _queue(db, minsup, **kw):
+    vdb = build_vertical(db, min_item_support=minsup)
+    eng = QueueSpadeTPU(vdb, minsup, caps=kw.pop("caps", SMALL_CAPS), **kw)
+    return eng, eng.mine()
+
+
+def test_parity_zaki():
+    db = parse_spmf(ZAKI)
+    eng, got = _queue(db, 2)
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+    assert eng.stats["kernel_launches"] == 1
+    assert eng.stats["candidates"] > 0
+    assert eng.stats["waves"] > 0
+
+
+@pytest.mark.parametrize("seed,n,items,mi,misz,minsup,caps", [
+    (7, 400, 40, 4.0, 1.6, 8, SMALL_CAPS),
+    (9, 200, 25, 4.0, 2.5, 10, SMALL_CAPS),
+    (21, 300, 60, 6.0, 1.3, 6, None),  # wide levels: default caps
+])
+def test_parity_synthetic(seed, n, items, mi, misz, minsup, caps):
+    db = synthetic_db(seed=seed, n_sequences=n, n_items=items,
+                      mean_itemsets=mi, mean_itemset_size=misz)
+    _, got = _queue(db, minsup, caps=caps or QueueCaps())
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, minsup))
+
+
+def test_wave_splitting_of_wide_levels():
+    # nb far below the root count: every level is popped across several
+    # waves, children enqueue behind remaining parents, and ring slots
+    # recycle — the FIFO-specific machinery the dense engine doesn't have
+    db = synthetic_db(seed=21, n_sequences=300, n_items=60,
+                      mean_itemsets=6.0, mean_itemset_size=1.3)
+    eng, got = _queue(db, 6, caps=QueueCaps(nb=16, ring=4096,
+                                            c_cap=4096, r_cap=1 << 16))
+    assert got is not None
+    # far more waves than BFS levels proves the splitting actually ran
+    assert eng.stats["waves"] > 8
+    assert patterns_text(got) == patterns_text(mine_spade(db, 6))
+
+
+def test_parity_multiword():
+    # > 32 itemsets/sequence -> n_words > 1 exercises the word-minor
+    # flat layout + carry chains inside the queue program (minsup 90
+    # keeps the 2k-pattern set inside the caps; 60 is explosive)
+    db = synthetic_db(seed=8, n_sequences=120, n_items=12,
+                      mean_itemsets=40.0, mean_itemset_size=1.2)
+    minsup = 90
+    eng, got = _queue(db, minsup,
+                      caps=QueueCaps(nb=64, ring=4096, c_cap=8192,
+                                     r_cap=1 << 17))
+    assert got is not None
+    assert eng.n_words > 1
+    assert patterns_text(got) == patterns_text(mine_spade(db, minsup))
+
+
+def test_max_pattern_itemsets():
+    db = synthetic_db(seed=9, n_sequences=200, n_items=25,
+                      mean_itemsets=4.0, mean_itemset_size=2.5)
+    vdb = build_vertical(db, min_item_support=10)
+    eng = QueueSpadeTPU(vdb, 10, max_pattern_itemsets=2, caps=SMALL_CAPS)
+    got = eng.mine()
+    want = mine_spade_vertical(vdb, 10, max_pattern_itemsets=2)
+    assert got is not None
+    assert patterns_text(got) == patterns_text(want)
+
+
+def test_overflow_returns_none_and_auto_falls_back():
+    db = synthetic_db(seed=7, n_sequences=400, n_items=40,
+                      mean_itemsets=4.0, mean_itemset_size=1.6)
+    tiny = QueueCaps(nb=16, ring=32, c_cap=32, r_cap=64, i_max=8)
+    eng, got = _queue(db, 8, caps=tiny)
+    assert got is None and eng.stats.get("fused_overflow")
+    stats = {}
+    full = mine_spade_tpu(db, 8, stats_out=stats)
+    assert patterns_text(full) == patterns_text(mine_spade(db, 8))
+
+
+def test_ring_overflow_is_detected_not_corrupted():
+    # a ring big enough for the roots but too small for the peak live
+    # frontier must flag overflow (never silently overwrite live slots)
+    db = synthetic_db(seed=13, n_sequences=60, n_items=40,
+                      mean_itemsets=6.0, mean_itemset_size=2.0,
+                      correlation=0.8)
+    vdb = build_vertical(db, min_item_support=2)
+    n_roots = sum(1 for s in vdb.item_supports if int(s) >= 2)
+    tight = QueueCaps(nb=16, ring=max(64, ((n_roots + 15) // 16) * 16),
+                      c_cap=4096, r_cap=1 << 16)
+    eng = QueueSpadeTPU(vdb, 2, caps=tight)
+    assert eng.mine() is None and eng.stats.get("fused_overflow")
+    wide = QueueSpadeTPU(vdb, 2, caps=QueueCaps(nb=64, ring=16384,
+                                                c_cap=8192, r_cap=1 << 17))
+    got = wide.mine()
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+
+
+def test_eligibility():
+    db = parse_spmf(ZAKI)
+    vdb = build_vertical(db, min_item_support=2)
+    assert queue_eligible(vdb)
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(len(jax.devices()))
+    assert queue_eligible(vdb, mesh=mesh)
+
+    class FakeVdb:
+        n_items = vdb.n_items
+        n_sequences = vdb.n_sequences
+        n_words = vdb.n_words
+    # huge stores exceed the allocation envelope (no traffic ceiling:
+    # per-wave traffic tracks the actual frontier)
+    big = FakeVdb()
+    big.n_sequences = 300_000_000
+    assert not queue_eligible(big)
+    # Kosarak-scale alphabets belong to the classic engine
+    wide = FakeVdb()
+    wide.n_items = 5000
+    assert not queue_eligible(wide)
+
+
+def test_caps_for_budget_scale_with_memory():
+    row = 80_000 * 4  # headline-ish single-word row
+    small = QueueCaps.for_budget(row, 384, 1 << 30)
+    big = QueueCaps.for_budget(row, 384, 8 << 30)
+    assert big.ring > small.ring
+    assert small.ring >= 2048
+    # nb rows must tile the Pallas P_TILE
+    from spark_fsm_tpu.ops import pallas_support as PS
+    assert (2 * small.nb) % PS.P_TILE == 0
+
+
+def test_store_survives_repeat_mines():
+    # steady-state re-mines reuse the store built in __init__: item rows
+    # must be intact after a mine (the loop writes only ring slots)
+    db = synthetic_db(seed=9, n_sequences=200, n_items=25,
+                      mean_itemsets=4.0, mean_itemset_size=2.5)
+    vdb = build_vertical(db, min_item_support=10)
+    eng = QueueSpadeTPU(vdb, 10, caps=SMALL_CAPS)
+    first = eng.mine()
+    second = eng.mine()
+    assert first is not None and second is not None
+    assert patterns_text(first) == patterns_text(second)
+
+
+def test_parity_mesh():
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(len(jax.devices()))
+    db = synthetic_db(seed=7, n_sequences=400, n_items=40,
+                      mean_itemsets=4.0, mean_itemset_size=1.6)
+    vdb = build_vertical(db, min_item_support=8)
+    eng = QueueSpadeTPU(vdb, 8, mesh=mesh, caps=SMALL_CAPS)
+    got = eng.mine()
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 8))
+
+
+def test_empty_and_single():
+    assert _queue(parse_spmf("1 -2\n1 -2\n"), 2)[1] == [(((1,),), 2)]
+    _, got = _queue(parse_spmf("1 -2\n"), 2)
+    assert got == []
+
+
+def test_shape_buckets_reuse_compile():
+    db1 = synthetic_db(seed=30, n_sequences=100, n_items=15,
+                       mean_itemsets=3.0)
+    db2 = synthetic_db(seed=31, n_sequences=120, n_items=15,
+                       mean_itemsets=3.0)
+    keys = set()
+    for db, ms in ((db1, 5), (db2, 5)):
+        vdb = build_vertical(db, min_item_support=ms)
+        eng = QueueSpadeTPU(vdb, ms, caps=SMALL_CAPS, shape_buckets=True)
+        got = eng.mine()
+        assert got is not None
+        assert patterns_text(got) == patterns_text(mine_spade(db, ms))
+        assert eng.n_seq == 128  # both bucket to the same shape
+        keys.add(eng.stats["shape_key"])
+    assert len(keys) == 1
+
+
+def test_traced_minsup_reuses_compile():
+    # the same engine geometry mined at two minsups must share the
+    # compiled program (minsup is a traced scalar, not a cache key)
+    db = synthetic_db(seed=9, n_sequences=200, n_items=25,
+                      mean_itemsets=4.0, mean_itemset_size=2.5)
+    for ms in (10, 14):
+        vdb = build_vertical(db, min_item_support=ms)
+        eng = QueueSpadeTPU(vdb, ms, caps=SMALL_CAPS)
+        got = eng.mine()
+        assert patterns_text(got) == patterns_text(mine_spade(db, ms))
