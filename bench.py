@@ -44,6 +44,13 @@ from spark_fsm_tpu.utils.probe import tpu_probe as _tpu_probe
 
 
 def main() -> None:
+    # fail a typo'd engine pin in milliseconds, not after ~15s of datagen
+    want_engine = os.environ.get("BENCH_ENGINE", "auto")
+    if want_engine not in ("auto", "classic", "queue"):
+        print(f"bench: unknown BENCH_ENGINE={want_engine!r} "
+              "(accepted: auto, classic, queue)", file=sys.stderr)
+        sys.exit(2)
+
     from spark_fsm_tpu.utils.jitcache import enable_compile_cache
     enable_compile_cache()  # compiles persist across runs (cold-start win)
     fallback_reason = ""
@@ -89,11 +96,6 @@ def main() -> None:
     # classic host-driven DFS as fallback.  BENCH_ENGINE=classic pins the
     # old path for comparison runs (non-canonical: routing IS the
     # default config).
-    want_engine = os.environ.get("BENCH_ENGINE", "auto")
-    if want_engine not in ("auto", "classic", "queue"):
-        print(f"bench: unknown BENCH_ENGINE={want_engine!r} "
-              "(accepted: auto, classic, queue)", file=sys.stderr)
-        sys.exit(2)
     use_queue = (want_engine == "queue"
                  or (want_engine == "auto" and queue_eligible(vdb)))
     t0 = time.time()

@@ -19,13 +19,14 @@ data/synth.py — a full Kosarak draw takes seconds instead of ~35 min).
 Configs: 2 (full MSNBC SPADE, mesh path), 3 (full Kosarak TSR,
 max_side=2), 3d (same but the service DEFAULT — unlimited rule sides),
 4 (full Gazelle cSPADE, maxgap=2/maxwindow=5), 5 (full-scale sliding
-window: 10 MSNBC-shaped micro-batches, keep 5, per-push walls + the
-distinct compiled-shape count that proves shape_buckets bounds
-recompiles).
+window on the INCREMENTAL service-default route: per-push walls + repair
+counters), 5r (same stream on the re-mine fallback: window-scaled walls
++ the compiled-shape count that proves shape_buckets bounds recompiles).
 
-Usage: python bench_scale.py [--parity] [2 3 3d 4 5]   (default: all;
---parity additionally runs the full-size oracle where feasible — config 2
-only — and attests byte-identical pattern sets)
+Usage: python bench_scale.py [--parity] [2 3 3d 4 5 5r]   (default: all;
+--parity additionally runs the full-size oracle where feasible — configs
+2 and 4, and per-push window oracles for 5 — attesting byte-identical
+pattern sets; 3/3d have no feasible full-size oracle)
 """
 
 from __future__ import annotations
@@ -34,6 +35,8 @@ import json
 import os
 import sys
 import time
+
+from spark_fsm_tpu.utils.obs import engine_route as _route
 
 
 def config2(parity: bool = False) -> dict:
@@ -72,8 +75,7 @@ def config2(parity: bool = False) -> dict:
         "datagen_s": round(t1 - t0, 2),
         "cold_wall_s": round(cold1 - cold0, 2),
         "wall_s": round(warm1 - warm0, 2),
-        "route": (stats["fused"] if isinstance(stats.get("fused"), str)
-                  else ("fused" if stats.get("fused") else "classic")),
+        "route": _route(stats),
         "fused_overflow": bool(stats.get("fused_overflow")),
         "platform": jax.default_backend(),
     }
@@ -133,8 +135,13 @@ def config3d() -> dict:
     return _tsr(None, "3d", "max_side unlimited (service default)")
 
 
-def config4() -> dict:
-    """cSPADE over the full Gazelle-shaped DB (59k seqs), maxgap/maxwindow."""
+def config4(parity: bool = False) -> dict:
+    """cSPADE over the full Gazelle-shaped DB (59k seqs), maxgap/maxwindow.
+
+    ``parity``: also run the NumPy cSPADE oracle at full size (minutes —
+    the engine's 35 s scale-0.2 oracle extrapolates to low single
+    digits) and attest byte-identical constrained pattern sets.
+    """
     import jax
 
     from spark_fsm_tpu.data.synth import gazelle_like
@@ -153,7 +160,7 @@ def config4() -> dict:
     pats2 = mine_cspade_tpu(db, ms, maxgap=2, maxwindow=5)
     warm1 = time.monotonic()
     assert pats == pats2
-    return {
+    out = {
         "config": "4", "scale": 1.0,
         "metric": "cSPADE synthetic Gazelle-shaped FULL (59k seqs) "
                   "maxgap=2 maxwindow=5 minsup=0.5%",
@@ -164,21 +171,22 @@ def config4() -> dict:
         "kernel_launches": stats.get("kernel_launches"),
         "platform": jax.default_backend(),
     }
+    if parity:
+        from spark_fsm_tpu.models.oracle import mine_cspade
+        from spark_fsm_tpu.utils.canonical import patterns_text
+
+        o0 = time.monotonic()
+        want = mine_cspade(db, ms, maxgap=2, maxwindow=5)
+        o1 = time.monotonic()
+        out["oracle_wall_s"] = round(o1 - o0, 2)
+        out["parity"] = patterns_text(pats) == patterns_text(want)
+        out["speedup_vs_oracle"] = round(out["oracle_wall_s"]
+                                         / max(out["wall_s"], 1e-9), 2)
+    return out
 
 
-def config5() -> dict:
-    """Full-scale sliding window: 10 MSNBC-shaped micro-batches (~99k
-    seqs each), keep 5 — per-push walls, plus the distinct compiled-shape
-    count across pushes.  shape_buckets pow2-buckets the device shapes,
-    so window-geometry drift (495k±99k seqs, drifting frequent-item
-    projection) must land on O(few) compiled shapes instead of
-    recompiling the kernel chain every push; the shape_keys field is the
-    proof (every key = one compiled geometry)."""
-    import jax
-
+def _stream_batches():
     from spark_fsm_tpu.data.synth import msnbc_like
-    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
-    from spark_fsm_tpu.streaming.window import WindowMiner
 
     t0 = time.monotonic()
     db = msnbc_like(scale=1.0, fast=True)
@@ -187,7 +195,79 @@ def config5() -> dict:
     per = len(db) // n_push
     batches = [db[i * per: (i + 1) * per if i < n_push - 1 else len(db)]
                for i in range(n_push)]
+    return batches, n_push, keep, per, round(t1 - t0, 2)
 
+
+def config5(parity: bool = False) -> dict:
+    """Full-scale streaming, SERVICE-DEFAULT route: true incremental
+    mining (streaming/incremental.py — count the arriving batch, evict
+    by subtraction, border repair).  10 MSNBC-shaped micro-batches
+    (~99k seqs each), keep 5.  The point of the row: steady-state push
+    wall scales with the BATCH, not the 495k-seq window (config 5r is
+    the re-mine comparison), and the repair counters prove steady pushes
+    ride the sweep.
+
+    ``parity``: per-push full-window oracle mines (~10 x ~1 min) attest
+    the incremental state byte-identical to a fresh mine at real size.
+    """
+    import jax
+
+    from spark_fsm_tpu.streaming.incremental import IncrementalWindowMiner
+
+    batches, n_push, keep, per, datagen_s = _stream_batches()
+    wm = IncrementalWindowMiner(0.005, max_batches=keep)
+    walls, repaired, parities = [], [], []
+    for batch in batches:
+        before = wm.stats["repaired_nodes"]
+        p0 = time.monotonic()
+        wm.push(batch)
+        walls.append(round(time.monotonic() - p0, 2))
+        repaired.append(wm.stats["repaired_nodes"] - before)
+        if parity:
+            from spark_fsm_tpu.models.oracle import mine_spade
+            from spark_fsm_tpu.utils.canonical import patterns_text
+
+            want = mine_spade(wm.window.sequences(), wm.minsup_abs())
+            parities.append(
+                patterns_text(wm.patterns) == patterns_text(want))
+    out = {
+        "config": "5", "scale": 1.0,
+        "metric": f"streaming SPADE sliding-window FULL ({n_push} "
+                  f"MSNBC-shaped micro-batches of ~{per // 1000}k seqs, "
+                  f"keep {keep}) minsup=0.5% — INCREMENTAL (service "
+                  "default)",
+        "datagen_s": datagen_s,
+        "pushes": n_push,
+        "window_sequences": wm.window.n_sequences,
+        "patterns": len(wm.patterns),
+        "per_push_wall_s": walls,
+        "steady_push_wall_s": round(
+            sorted(walls[keep:])[len(walls[keep:]) // 2], 2),
+        "route": wm.stats["route"],
+        "repaired_nodes_per_push": repaired,
+        "tracked_nodes": wm.stats["tracked_nodes"],
+        "border_nodes": wm.stats["border_nodes"],
+        "sweep_candidates": wm.stats["sweep_candidates"],
+        "platform": jax.default_backend(),
+    }
+    if parity:
+        out["parity"] = all(parities)
+        out["parity_per_push"] = parities
+    return out
+
+
+def config5r() -> dict:
+    """Full-scale streaming, RE-MINE fallback route (streaming/window.py
+    with incremental pinned off — the pre-incremental baseline and the
+    path constrained/TSR windows still use).  Same batches as config 5;
+    per-push walls scale with the window, and the distinct compiled-shape
+    count proves shape_buckets bounds recompiles."""
+    import jax
+
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+    from spark_fsm_tpu.streaming.window import WindowMiner
+
+    batches, n_push, keep, per, datagen_s = _stream_batches()
     shape_keys = set()
     push_stats: dict = {}
 
@@ -206,15 +286,13 @@ def config5() -> dict:
         p0 = time.monotonic()
         wm.push(batch)
         walls.append(round(time.monotonic() - p0, 2))
-        f = push_stats.get("fused")
-        routes.append(f if isinstance(f, str)
-                      else ("fused" if f else "classic"))
+        routes.append(_route(push_stats))
     return {
-        "config": "5", "scale": 1.0,
+        "config": "5r", "scale": 1.0,
         "metric": f"streaming SPADE sliding-window FULL ({n_push} "
                   f"MSNBC-shaped micro-batches of ~{per // 1000}k seqs, "
-                  f"keep {keep}) minsup=0.5%",
-        "datagen_s": round(t1 - t0, 2),
+                  f"keep {keep}) minsup=0.5% — RE-MINE fallback",
+        "datagen_s": datagen_s,
         "pushes": n_push,
         "window_sequences": wm.window.n_sequences,
         "patterns": len(wm.patterns),
@@ -233,7 +311,8 @@ def main() -> None:
 
     enable_compile_cache()
     runners = {"2": config2, "3": config3, "3d": config3d,
-               "4": config4, "5": config5}
+               "4": config4, "5": config5, "5r": config5r}
+    parity_capable = {"2", "4", "5"}  # feasible full-size oracles
     args = sys.argv[1:]
     parity = "--parity" in args
     which = [a for a in args if a != "--parity"]
@@ -243,12 +322,13 @@ def main() -> None:
         sys.exit(f"usage: python bench_scale.py [--parity] "
                  f"[{' '.join(runners)}]"
                  f" — full-scale spot-check configs (got {sys.argv[1:]})")
-    if parity and "2" not in which:
-        sys.exit("--parity requires config 2 (the only config whose "
-                 "full-size oracle is feasible); rerun with 2 included")
+    if parity and not (set(which) & parity_capable):
+        sys.exit("--parity needs at least one parity-capable config "
+                 f"({sorted(parity_capable)}); configs 3/3d have no "
+                 "feasible full-size oracle (219 s at scale 0.2)")
     rows = []
     for n in dict.fromkeys(which):  # de-dup, keep order
-        kwargs = {"parity": parity} if n == "2" else {}
+        kwargs = {"parity": parity} if n in parity_capable else {}
         row = runners[n](**kwargs)
         rows.append(row)
         print(json.dumps(row), flush=True)

@@ -102,12 +102,10 @@ def main() -> None:
             # fused engine, so emitting "classic" for them would imply a
             # decision that was never made)
             if "fused" in stats:
-                # stats["fused"] is False (classic), True (dense fused) or
-                # an engine name ("queue") — record the actual engine so a
-                # queue regression is distinguishable from a dense one
-                f = stats["fused"]
-                row["route"] = (f if isinstance(f, str)
-                                else ("fused" if f else "classic"))
+                # record the actual engine (obs.engine_route) so a queue
+                # regression is distinguishable from a dense one
+                from spark_fsm_tpu.utils.obs import engine_route
+                row["route"] = engine_route(stats)
             for key in ("fused_overflow", "fused_skipped", "kernel_launches"):
                 if stats.get(key) is not None:
                     row[key] = stats[key]

@@ -481,9 +481,32 @@ class Streamer:
             return _plugin.extract(
                 ServiceRequest(_base.service, _base.task, d), db)
 
+        # Streaming route: true incremental mining (count the arriving
+        # batch + border repair — streaming/incremental.py) is the
+        # default for plain single-device SPADE_TPU windows; everything
+        # else (TSR, constraints, CPU oracle, mesh) re-mines the window
+        # (streaming/window.py, the SURVEY sec 7 fallback).
+        # ``incremental=0`` pins the re-mine path.
+        algo = (data.get("algorithm") or "SPADE_TPU").upper()
+        # same falsy spellings as the checkpoint param (Miner._run)
+        inc_param = (data.get("incremental", "1") or "").lower()
+        use_inc = (plugin.kind == "patterns"
+                   and algo == "SPADE_TPU"
+                   and base.param("maxgap") is None
+                   and base.param("maxwindow") is None
+                   and config.get_mesh() is None
+                   and inc_param not in ("", "0", "false", "no", "off"))
+        if use_inc:
+            from spark_fsm_tpu.streaming.incremental import \
+                IncrementalWindowMiner
+            miner = IncrementalWindowMiner(support, max_batches=mb,
+                                           max_sequences=ms)
+        else:
+            miner = WindowMiner(support, max_batches=mb, max_sequences=ms,
+                                mine=plugin_mine)
+
         return {
-            "miner": WindowMiner(support, max_batches=mb, max_sequences=ms,
-                                 mine=plugin_mine),
+            "miner": miner,
             "kind": plugin.kind,
             "cfg": {"data": data, "max_batches": mb, "max_sequences": ms},
             # held across push + result sink + response-field reads

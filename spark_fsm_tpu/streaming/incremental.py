@@ -1,0 +1,674 @@
+"""Incremental sliding-window SPADE — push cost scales with the BATCH.
+
+Eval config #5 is named "Streaming *incremental* SPADE" (BASELINE.md);
+SURVEY.md sec 7 lists "incremental frontier repair" among the hard parts
+and sanctions re-mine only as a fallback.  streaming/window.py is that
+fallback: every push re-mines the whole window, so the steady-state push
+wall scales with the WINDOW (measured ~4.2 s at 495k sequences,
+BENCH_SCALE config 5).  This module is the real thing.
+
+The key algebra: SPADE supports are ADDITIVE over the sequence axis —
+``support_window(P) = sum over live batches of support_batch(P)`` (each
+sequence lives in exactly one micro-batch).  So the miner tracks, on
+host, a pattern tree T = the frequent set F plus its negative border
+(every candidate an exact mine would have evaluated), with PER-BATCH
+support counts per node.  A push then costs:
+
+- **count the arriving batch only** (device): one level-order sweep of T
+  over the new batch's bitmap store — the classic engine's
+  prep/pair-support/materialize kernels (models/spade_tpu._spade_fns),
+  driven by T's known structure instead of by pruning decisions, so the
+  whole sweep needs ZERO intermediate readbacks (one fetch of the
+  concatenated support vector at the end);
+- **evict by subtraction** (host): an expired batch's stored partial
+  supports leave each node's running total — no device work at all;
+- **border repair** (device, only when a pattern crosses minsup in
+  either direction): candidate lists are recomputed top-down from the
+  new frequent sets, and candidates T has never evaluated are counted on
+  every live batch by a ``lax.scan`` join-fold over that batch's
+  device-resident token scatter (steady-state pushes repair nothing).
+
+Downward closure makes the bookkeeping exact: every item of a tracked
+node is window-frequent, a node whose ancestor falls below minsup falls
+with it, and candidate lists derive from sibling survival exactly as in
+the classic engine's ``_resolve`` — so after every push the frequent set
+and its supports are **byte-identical to a fresh mine of the window**
+(the determinism contract of streaming/window.py, tested per push).
+
+Scope: single-device, plain SPADE (no maxgap/maxwindow, no
+max_pattern_itemsets — the service routes those to the re-mine path).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models._common import bucket_seq, next_pow2
+from spark_fsm_tpu.models.spade_tpu import _spade_fns
+from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.ops import pallas_support as PS
+from spark_fsm_tpu.parallel.mesh import pad_to_multiple
+from spark_fsm_tpu.streaming.window import SlidingWindow
+from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
+
+Key = Tuple[int, bool]  # (GLOBAL item id, is_s_extension)
+
+
+class _TNode:
+    """Tracked pattern: frequent node or border leaf.  ``steps`` holds
+    GLOBAL item ids (the projection drifts across pushes, so dense
+    indices would go stale); ``sup`` maps live batch id -> exact batch
+    support; ``total`` is kept equal to ``sum(sup.values())`` over live
+    batches incrementally."""
+
+    __slots__ = ("steps", "children", "sup", "total")
+
+    def __init__(self, steps: Tuple[Key, ...]):
+        self.steps = steps
+        self.children: Dict[Key, "_TNode"] = {}
+        self.sup: Dict[int, int] = {}
+        self.total = 0
+
+
+@functools.lru_cache(maxsize=64)
+def _inc_store_builder(n_rows: int, n_seq: int, n_words: int):
+    """Scatter-build a batch bitmap store from device-resident tokens.
+    ``remap`` maps the batch's dense item index -> store row for items
+    the current frequent projection needs; unneeded items point out of
+    bounds and drop (mode="drop"), so one cached program serves every
+    push's drifting projection."""
+
+    def build(ti, ts, tw, tm, remap):
+        z = jnp.zeros((n_rows, n_seq * n_words), jnp.uint32)
+        return z.at[remap[ti], ts * n_words + tw].add(tm, mode="drop")
+
+    return jax.jit(build)
+
+
+@functools.lru_cache(maxsize=32)
+def _fold_supports_fn(n_words: int):
+    """Border-repair evaluator: fold a candidate pattern's join chain
+    from the item rows (the classic engine's recompute_body without the
+    store write — repair needs supports, not bitmaps) and popcount.
+    ``items/iss/valid`` are [K, M]: M candidates, K pow2-bucketed steps;
+    padded rows carry valid=False and leave the carry untouched."""
+    W = n_words
+
+    def run(store, items, iss, valid):
+        m = items.shape[1]
+        b = store[items[0]].reshape(m, -1, W)
+
+        def body(carry, xs):
+            it, s, v = xs
+            nb = B.join(carry, store[it].reshape(carry.shape), s)
+            return jnp.where(v[:, None, None], nb, carry), None
+
+        b, _ = jax.lax.scan(body, b, (items[1:], iss[1:], valid[1:]))
+        return B.support(b)
+
+    return jax.jit(run)
+
+
+class _BatchTokens:
+    """Per-live-batch device state: the token table (uploaded once when
+    the batch arrives, ~1000x smaller than the dense store) plus the
+    batch's item census.  Bitmap stores are rebuilt from these tokens on
+    demand (one on-device scatter) — the dense store never crosses the
+    link and old batches hold no HBM beyond their tokens."""
+
+    def __init__(self, bid: int, db: SequenceDB, use_pallas: bool):
+        self.bid = bid
+        self.db = db
+        vdb = build_vertical(db, min_item_support=1)
+        self.item_ids = vdb.item_ids                      # ascending
+        self.item_counts: Dict[int, int] = {
+            int(i): int(s)
+            for i, s in zip(vdb.item_ids, vdb.item_supports)}
+        self.n_local = vdb.n_items
+        # pow2-bucket both device axes so drifting batch geometry lands
+        # on a handful of compiled programs (the shape_buckets policy)
+        self.n_words = next_pow2(vdb.n_words)
+        s_block = (min(PS.seq_block(self.n_words),
+                       pad_to_multiple(bucket_seq(vdb.n_sequences), 128))
+                   if use_pallas else 1)
+        self.s_block = s_block
+        self.n_seq = pad_to_multiple(bucket_seq(vdb.n_sequences),
+                                     max(1, s_block))
+        self.ti = jnp.asarray(vdb.tok_item)
+        self.ts = jnp.asarray(vdb.tok_seq)
+        self.tw = jnp.asarray(vdb.tok_word)
+        self.tm = jnp.asarray(vdb.tok_mask)
+        # projection-dependent state, set by _project and CACHED across
+        # pushes while the frequent projection holds still (steady-state
+        # repair then skips every store rebuild):
+        self.row_of: Dict[int, int] = {}
+        self.ni_rows = 0
+        self.store = None
+        self.items_t = None
+        self._proj_key = None
+        self._n_rows = 0
+
+    def _project(self, needed: List[int], extra_rows: int):
+        """Build (or reuse) this batch's store for the given GLOBAL item
+        set + ``extra_rows`` work rows; items absent from the batch
+        simply get no row (their patterns are zero-support here)."""
+        present = [g for g in needed if g in self.item_counts]
+        ni_rows = pad_to_multiple(max(len(present), 1), PS.I_TILE)
+        n_rows = next_pow2(ni_rows + extra_rows + 1)
+        key = (tuple(present), ni_rows)
+        if (self.store is not None and self._proj_key == key
+                and self._n_rows >= n_rows):
+            return self._n_rows
+        self.row_of = {g: r for r, g in enumerate(present)}
+        self.ni_rows = ni_rows
+        remap = np.full(max(self.n_local, 1), n_rows + 1, np.int32)
+        idx = np.searchsorted(self.item_ids, present)
+        remap[idx] = np.arange(len(present), dtype=np.int32)
+        self.store = _inc_store_builder(n_rows, self.n_seq, self.n_words)(
+            self.ti, self.ts, self.tw, self.tm, jnp.asarray(remap))
+        self.items_t = None
+        self._proj_key = key
+        self._n_rows = n_rows
+        return n_rows
+
+    def store_bytes(self) -> int:
+        return (0 if self.store is None
+                else self._n_rows * self.n_seq * self.n_words * 4)
+
+    def drop_store(self):
+        self.store = None
+        self.items_t = None
+        self._proj_key = None
+        self._n_rows = 0
+
+
+class IncrementalWindowMiner:
+    """WindowMiner-compatible incremental miner (same push/stats/window
+    surface, so the service Streamer and the bench harness can swap it in
+    for the re-mine path).
+
+    ``min_support`` < 1 is relative to the current window size, >= 1 an
+    absolute count — the train-request contract.
+    """
+
+    def __init__(self, min_support: float, *,
+                 max_batches: Optional[int] = None,
+                 max_sequences: Optional[int] = None,
+                 use_pallas="auto",
+                 repair_chunk: int = 256,
+                 support_chunk: int = 2048) -> None:
+        self.min_support = float(min_support)
+        self.window = SlidingWindow(max_batches=max_batches,
+                                    max_sequences=max_sequences)
+        if use_pallas == "auto":
+            self.use_pallas = jax.default_backend() == "tpu"
+        else:
+            self.use_pallas = bool(use_pallas)
+        self._interpret = jax.default_backend() != "tpu"
+        self.repair_chunk = int(repair_chunk)
+        self.support_chunk = int(support_chunk)
+        self._lock = threading.Lock()
+        self._next_bid = 0
+        self._states: Dict[int, _BatchTokens] = {}   # keyed by id(batch)
+        self._item_totals: Dict[int, int] = {}       # window item census
+        self._root: Dict[Key, _TNode] = {}           # tracked F1 subtrees
+        self.patterns: List[PatternResult] = []
+        self.stats = {"pushes": 0, "mines": 0, "evicted_batches": 0,
+                      "window_sequences": 0, "patterns": 0,
+                      "route": "incremental", "tracked_nodes": 0,
+                      "border_nodes": 0, "repaired_nodes": 0,
+                      "swept_batches": 0, "sweep_candidates": 0,
+                      "repair_rounds": 0, "kernel_launches": 0}
+
+    # ------------------------------------------------------------- util
+
+    def minsup_abs(self) -> int:
+        if self.min_support >= 1.0:
+            return int(self.min_support)
+        return abs_minsup(self.min_support, max(1, self.window.n_sequences))
+
+    def _live_bids(self) -> List[int]:
+        return [self._states[id(b)].bid for b in self.window.batches()]
+
+    def _zero_subtree(self, node: _TNode, bid: int) -> None:
+        node.sup[bid] = 0
+        for child in node.children.values():
+            self._zero_subtree(child, bid)
+
+    # ------------------------------------------------------------- push
+
+    def push(self, batch: SequenceDB) -> List[PatternResult]:
+        with self._lock:
+            t0 = time.monotonic()
+            self.window.push(batch)
+            live = self.window.batches()
+            live_ids = {id(b) for b in live}
+
+            # --- evict by subtraction (host only) ---
+            evicted = [st for key, st in self._states.items()
+                       if key not in live_ids]
+            for key in [k for k in self._states if k not in live_ids]:
+                del self._states[key]
+            if evicted:
+                ev_bids = {st.bid for st in evicted}
+                for st in evicted:
+                    for g, c in st.item_counts.items():
+                        left = self._item_totals.get(g, 0) - c
+                        if left:
+                            self._item_totals[g] = left
+                        else:
+                            # drop zeroed entries: a rotating item
+                            # universe must not grow the census (and the
+                            # per-push f1 scan) without bound
+                            self._item_totals.pop(g, None)
+                self._subtract_evicted(ev_bids)
+
+            # --- register unseen batches (the pushed one; after a
+            # service restart, every restored batch) ---
+            fresh: List[_BatchTokens] = []
+            for b in live:
+                if id(b) not in self._states:
+                    st = _BatchTokens(self._next_bid, b, self.use_pallas)
+                    self._next_bid += 1
+                    self._states[id(b)] = st
+                    fresh.append(st)
+                    for g, c in st.item_counts.items():
+                        self._item_totals[g] = self._item_totals.get(g, 0) + c
+            t_tok = time.monotonic()
+
+            minsup = self.minsup_abs()
+            f1 = sorted(g for g, c in self._item_totals.items()
+                        if c >= minsup)
+
+            # --- count the arriving batch(es): sweep T (pre-repair
+            # structure) over each fresh batch ---
+            for st in fresh:
+                self._sweep(st, f1)
+                self.stats["swept_batches"] += 1
+            t_sweep = time.monotonic()
+
+            # --- border repair + result collection ---
+            self._repair(minsup, f1)
+            t_rep = time.monotonic()
+            self.patterns = self._collect_and_prune(minsup, f1)
+            self.stats["phase_s"] = {
+                "tokens": round(t_tok - t0, 3),
+                "sweep": round(t_sweep - t_tok, 3),
+                "repair": round(t_rep - t_sweep, 3),
+                "prune": round(time.monotonic() - t_rep, 3),
+            }
+
+            self.stats["pushes"] += 1
+            self.stats["mines"] += 1
+            self.stats["evicted_batches"] = self.window.evicted_batches
+            self.stats["window_sequences"] = self.window.n_sequences
+            self.stats["patterns"] = len(self.patterns)
+            n_nodes = sum(1 for _ in self._iter_nodes())
+            self.stats["tracked_nodes"] = n_nodes
+            self.stats["border_nodes"] = n_nodes - len(self.patterns)
+            self.stats["push_wall_s"] = round(time.monotonic() - t0, 4)
+            # keep projected stores warm across pushes (steady-state
+            # repair skips every rebuild) under a fraction of device
+            # memory; beyond it, drop oldest-batch stores first
+            from spark_fsm_tpu.models._common import device_hbm_budget
+            budget = 0.2 * device_hbm_budget(jax.devices()[0])
+            total = sum(st.store_bytes() for st in self._states.values())
+            for b in live:  # oldest first
+                if total <= budget:
+                    break
+                st = self._states[id(b)]
+                total -= st.store_bytes()
+                st.drop_store()
+            self.stats["store_cache_bytes"] = int(
+                sum(st.store_bytes() for st in self._states.values()))
+            return self.patterns
+
+    def _iter_nodes(self):
+        stack = list(self._root.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    def _subtract_evicted(self, ev_bids) -> None:
+        for node in self._iter_nodes():
+            for bid in ev_bids:
+                node.total -= node.sup.pop(bid, 0)
+
+    # ------------------------------------------------------------ sweep
+
+    def _sweep(self, st: _BatchTokens, f1: List[int]) -> None:
+        """Fill ``node.sup[st.bid]`` for every tracked node by walking
+        T's levels over the batch store.  No pruning happens here, so no
+        level needs the previous level's supports — every kernel is
+        dispatched back-to-back and ONE readback at the end resolves the
+        whole batch."""
+        bid = st.bid
+        # depth-1 supports come from the batch census (host)
+        for (g, _), node in self._root.items():
+            c = st.item_counts.get(g, 0)
+            node.sup[bid] = c
+            node.total += c
+
+        # parents per level = tracked nodes with tracked children
+        cur: List[Tuple[_TNode, int]] = []
+        lcap = 0
+        lvl_nodes = [n for n in self._root.values() if n.children]
+        probe = lvl_nodes
+        while probe:
+            lcap = max(lcap, len(probe))
+            probe = [c for n in probe for c in n.children.values()
+                     if c.children]
+        n_rows = st._project(f1, 2 * max(lcap, 1))
+        region = [st.ni_rows, st.ni_rows + max(lcap, 1)]
+        scratch = n_rows - 1
+        fns = _spade_fns(None, st.n_words)
+        if self.use_pallas and st.n_words > 1 and st.items_t is None:
+            from spark_fsm_tpu.models.spade_tpu import _items_transpose
+            st.items_t = _items_transpose(None, st.ni_rows,
+                                          st.n_words)(st.store)
+
+        for node in lvl_nodes:
+            g = node.steps[0][0]
+            row = st.row_of.get(g)
+            if row is None:  # item absent from this batch: subtree is 0
+                for c in node.children.values():
+                    self._zero_subtree(c, bid)
+            else:
+                cur.append((node, row))
+
+        pend: List[Tuple[jax.Array, List[_TNode]]] = []
+        depth = 0
+        while cur:
+            slots = np.full(next_pow2(max(len(cur), 8)), scratch, np.int32)
+            for i, (_, slot) in enumerate(cur):
+                slots[i] = slot
+            pt = fns["prep"](st.store, jnp.asarray(slots))
+            self.stats["kernel_launches"] += 1
+
+            refs: List[int] = []
+            items: List[int] = []
+            iss: List[bool] = []
+            meta: List[_TNode] = []
+            mat: List[Tuple[int, int, bool, int]] = []
+            nxt: List[Tuple[_TNode, int]] = []
+            out_base = region[depth % 2]
+            for b, (node, _) in enumerate(cur):
+                for (g, s), child in node.children.items():
+                    jrow = st.row_of.get(g)
+                    if jrow is None:
+                        self._zero_subtree(child, bid)
+                        continue
+                    refs.append(b)
+                    items.append(jrow)
+                    iss.append(s)
+                    meta.append(child)
+                    if child.children:
+                        out = out_base + len(nxt)
+                        mat.append((b, jrow, s, out))
+                        nxt.append((child, out))
+            if refs:
+                # each dispatch stays pow2-padded on device — slicing to
+                # the live count or concatenating varying shapes would
+                # compile a fresh program per candidate count (a multi-
+                # second remote AOT on the tunneled backend, per PUSH)
+                for sup_dev, n, sub in self._supports_dispatch(
+                        st, fns, pt, np.asarray(refs, np.int32),
+                        np.asarray(items, np.int32),
+                        np.asarray(iss, bool), meta):
+                    pend.append((sup_dev, n, sub))
+                self.stats["sweep_candidates"] += len(refs)
+            if mat:
+                c = self.support_chunk
+                mr = np.asarray([m[0] for m in mat], np.int32)
+                mi = np.asarray([m[1] for m in mat], np.int32)
+                ms = np.asarray([m[2] for m in mat], bool)
+                mo = np.asarray([m[3] for m in mat], np.int32)
+                for lo in range(0, len(mat), c):
+                    hi = min(lo + c, len(mat))
+                    pad = next_pow2(max(hi - lo, 8)) - (hi - lo)
+                    # donates the store; the item rows (and st.items_t,
+                    # which mirrors only them) are untouched — writes land
+                    # in the work regions
+                    st.store = fns["materialize"](
+                        pt, st.store,
+                        jnp.asarray(np.pad(mr[lo:hi], (0, pad))),
+                        jnp.asarray(np.pad(mi[lo:hi], (0, pad))),
+                        jnp.asarray(np.pad(ms[lo:hi], (0, pad))),
+                        jnp.asarray(np.pad(mo[lo:hi], (0, pad),
+                                           constant_values=scratch)))
+                    self.stats["kernel_launches"] += 1
+            cur = nxt
+            depth += 1
+
+        # resolve: start every host copy first (they overlap on the
+        # link), then block — total wall ~ one roundtrip + transfers
+        for dev, _, _ in pend:
+            try:
+                dev.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass  # method unavailable on this backend
+        for dev, n, meta in pend:
+            sups = np.asarray(dev)
+            for i, child in enumerate(meta):
+                s = int(sups[i])
+                child.sup[bid] = s
+                child.total += s
+
+    def _supports_dispatch(self, st: _BatchTokens, fns, pt,
+                           refs: np.ndarray, items: np.ndarray,
+                           iss: np.ndarray, meta):
+        """Support vectors for a candidate list (classic engine's dual
+        path: Pallas pair matrix + on-device extraction on TPU, chunked
+        gather joins elsewhere).  Yields ``(padded device array, live
+        count, meta slice)`` triples — arrays keep their pow2 padding
+        (device-side trimming would compile per live count) and the
+        caller slices on host after the readback."""
+        n = len(refs)
+        if self.use_pallas:
+            cap = max(1024, next_pow2(n))
+            pref = np.zeros(cap, np.int32)
+            itm = np.zeros(cap, np.int32)
+            pref[:n] = 2 * refs + iss
+            itm[:n] = items
+            items_arr = st.items_t if st.items_t is not None else st.store
+            sup = PS.batch_supports(
+                pt, items_arr, st.ni_rows,
+                jnp.asarray(pref), jnp.asarray(itm),
+                items_kernel_layout=st.items_t is not None,
+                s_block=st.s_block, interpret=self._interpret,
+                n_words=st.n_words)
+            self.stats["kernel_launches"] += 1
+            return [(sup, n, meta)]
+        out = []
+        c = self.support_chunk
+        for lo in range(0, n, c):
+            hi = min(lo + c, n)
+            pad = next_pow2(max(hi - lo, 8)) - (hi - lo)
+            out.append((fns["supports"](
+                pt, st.store,
+                jnp.asarray(np.pad(refs[lo:hi], (0, pad))),
+                jnp.asarray(np.pad(items[lo:hi], (0, pad))),
+                jnp.asarray(np.pad(iss[lo:hi], (0, pad)))),
+                hi - lo, meta[lo:hi]))
+            self.stats["kernel_launches"] += 1
+        return out
+
+    # ----------------------------------------------------------- repair
+
+    def _walk_candidates(self, minsup: int, f1: List[int], missing):
+        """Top-down recompute of candidate lists from CURRENT frequent
+        sets (the classic _resolve rules); collect candidates T has
+        never evaluated.  Returns False if any were found (tree not yet
+        at fixpoint)."""
+
+        def walk(node: _TNode, s_list: List[int], i_list: List[int]):
+            for j in s_list:
+                if (j, True) not in node.children:
+                    missing.append((node, (j, True)))
+            for j in i_list:
+                if (j, False) not in node.children:
+                    missing.append((node, (j, False)))
+            s_items = [j for j in s_list
+                       if node.children.get((j, True)) is not None
+                       and node.children[(j, True)].total >= minsup]
+            i_items = [j for j in i_list
+                       if node.children.get((j, False)) is not None
+                       and node.children[(j, False)].total >= minsup]
+            for j in s_items:
+                walk(node.children[(j, True)], s_items,
+                     [x for x in s_items if x > j])
+            for j in i_items:
+                walk(node.children[(j, False)], s_items,
+                     [x for x in i_items if x > j])
+
+        for i in f1:
+            node = self._root.get((i, True))
+            if node is None:
+                # newly frequent item: materialize its root node from the
+                # batch censuses (host data, no device work)
+                node = _TNode(((i, True),))
+                for st in self._states.values():
+                    node.sup[st.bid] = st.item_counts.get(i, 0)
+                node.total = self._item_totals.get(i, 0)
+                self._root[(i, True)] = node
+            walk(node, f1, [x for x in f1 if x > i])
+
+    def _repair(self, minsup: int, f1: List[int]) -> None:
+        rounds = 0
+        while True:
+            missing: List[Tuple[_TNode, Key]] = []
+            self._walk_candidates(minsup, f1, missing)
+            if not missing:
+                break
+            rounds += 1
+            self._evaluate_missing(missing, f1)
+            self.stats["repaired_nodes"] += len(missing)
+        self.stats["repair_rounds"] += rounds
+
+    def _evaluate_missing(self, missing, f1: List[int]) -> None:
+        """Count never-evaluated candidates on EVERY live batch (the fold
+        evaluator); insert them as tracked children."""
+        children: List[_TNode] = []
+        for parent, key in missing:
+            child = _TNode(parent.steps + (key,))
+            parent.children[key] = child
+            children.append(child)
+
+        # dispatch every (batch, chunk) fold back-to-back, THEN resolve —
+        # blocking per batch would serialize one tunnel roundtrip per
+        # live batch into every repair round
+        pend = []
+        for st in self._states.values():
+            # every candidate/step item is window-frequent (downward
+            # closure), so the f1 projection serves all repair rounds.
+            # _project reuses the cached store only when its key matches
+            # THIS f1 — a cached store from an older projection must
+            # never serve stale rows.
+            st._project(f1, 0)
+            fold = _fold_supports_fn(st.n_words)
+            todo: List[Tuple[int, List[Tuple[int, bool]]]] = []
+            for ci, child in enumerate(children):
+                rows = [(st.row_of.get(g), s) for g, s in child.steps]
+                if any(r is None for r, _ in rows):
+                    child.sup[st.bid] = 0  # an item absent from batch
+                    continue
+                todo.append((ci, rows))
+            m = self.repair_chunk
+            for lo in range(0, len(todo), m):
+                grp = todo[lo:lo + m]
+                width = next_pow2(max(len(grp), 8))
+                k = next_pow2(max(max(len(r) for _, r in grp), 2))
+                it = np.zeros((k, width), np.int32)
+                ss = np.zeros((k, width), bool)
+                va = np.zeros((k, width), bool)
+                for col, (_, rows) in enumerate(grp):
+                    for row_i, (r, s) in enumerate(rows):
+                        it[row_i, col] = r
+                        ss[row_i, col] = s
+                        va[row_i, col] = True
+                sup = fold(st.store, jnp.asarray(it), jnp.asarray(ss),
+                           jnp.asarray(va))
+                self.stats["kernel_launches"] += 1
+                pend.append((sup, st.bid, grp))
+        for sup_dev, _, _ in pend:
+            try:
+                sup_dev.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass  # method unavailable on this backend
+        for sup_dev, bid, grp in pend:
+            sups = np.asarray(sup_dev)
+            for col, (ci, _) in enumerate(grp):
+                children[ci].sup[bid] = int(sups[col])
+        for child in children:
+            child.total = sum(child.sup.values())
+
+    # ---------------------------------------------------- prune/collect
+
+    def _collect_and_prune(self, minsup: int,
+                           f1: List[int]) -> List[PatternResult]:
+        """Final walk: collect the frequent set (byte-identical contract)
+        and prune T down to F plus its CURRENT negative border, so
+        tracked state cannot grow monotonically."""
+        results: List[PatternResult] = []
+
+        def pattern_of(steps: Tuple[Key, ...]):
+            pat: List[List[int]] = []
+            for g, s in steps:
+                if s:
+                    pat.append([g])
+                else:
+                    pat[-1].append(g)
+            return tuple(tuple(p) for p in pat)
+
+        def walk(node: _TNode, s_list: List[int], i_list: List[int]):
+            keep: Dict[Key, _TNode] = {}
+            s_items = [j for j in s_list
+                       if (c := node.children.get((j, True))) is not None
+                       and c.total >= minsup]
+            i_items = [j for j in i_list
+                       if (c := node.children.get((j, False))) is not None
+                       and c.total >= minsup]
+            for j in s_list:
+                c = node.children.get((j, True))
+                if c is not None:
+                    keep[(j, True)] = c
+            for j in i_list:
+                c = node.children.get((j, False))
+                if c is not None:
+                    keep[(j, False)] = c
+            # drop stale children outside the current candidate lists
+            # AND the whole subtree of any non-frequent child (border
+            # nodes are leaves)
+            node.children = keep
+            for key, c in keep.items():
+                if c.total < minsup:
+                    c.children = {}
+            for j in s_items:
+                c = node.children[(j, True)]
+                results.append((pattern_of(c.steps), c.total))
+                walk(c, s_items, [x for x in s_items if x > j])
+            for j in i_items:
+                c = node.children[(j, False)]
+                results.append((pattern_of(c.steps), c.total))
+                walk(c, s_items, [x for x in i_items if x > j])
+
+        f1_set = set(f1)
+        for key in list(self._root):
+            if key[0] not in f1_set:
+                del self._root[key]  # item fell below minsup: whole
+                # subtree is infrequent by downward closure
+        for i in f1:
+            node = self._root[(i, True)]
+            results.append((pattern_of(node.steps), node.total))
+            walk(node, f1, [x for x in f1 if x > i])
+        return sort_patterns(results)

@@ -152,8 +152,11 @@ class WindowMiner:
         self._mine = mine
         self._lock = threading.Lock()
         self.patterns: List[PatternResult] = []
+        # route mirrors IncrementalWindowMiner's stats key so /status and
+        # the bench artifacts always say which streaming path ran
         self.stats = {"pushes": 0, "mines": 0, "evicted_batches": 0,
-                      "window_sequences": 0, "patterns": 0}
+                      "window_sequences": 0, "patterns": 0,
+                      "route": "re-mine"}
 
     def minsup_abs(self) -> int:
         if self.min_support >= 1.0:

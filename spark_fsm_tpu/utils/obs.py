@@ -18,6 +18,18 @@ import time
 logger = logging.getLogger("spark_fsm_tpu")
 
 
+def engine_route(stats: dict) -> str:
+    """Canonical route label from a SPADE engine stats dict: the
+    ``fused`` key is False (classic DFS), True (dense fused engine) or
+    an engine name string ("queue").  One definition so every artifact
+    (BENCH_SUITE, BENCH_SCALE, service stats) records identical labels —
+    a new engine name must not drift between them."""
+    f = stats.get("fused")
+    if isinstance(f, str):
+        return f
+    return "fused" if f else "classic"
+
+
 def log_event(event: str, **fields) -> None:
     """Emit one JSON object per line: {"event": ..., "ts": ..., **fields}.
 

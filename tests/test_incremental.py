@@ -1,0 +1,151 @@
+"""Incremental sliding-window miner (streaming/incremental.py).
+
+The binding contract is the determinism clause of streaming/window.py:
+after EVERY push the pattern set must be byte-identical to a fresh mine
+of exactly the window's sequences — incrementality changes WHEN counting
+happens (arriving batch only + border repair), never WHAT is mined.
+These tests drive pushes through eviction, minsup drift, border
+crossings in both directions, and late-appearing items, checking parity
+against the CPU oracle after each push.
+"""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.spmf import parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.streaming.incremental import IncrementalWindowMiner
+from spark_fsm_tpu.streaming.window import WindowMiner
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+
+def _assert_parity(wm, extra=""):
+    seqs = wm.window.sequences()
+    want = mine_spade(seqs, wm.minsup_abs())
+    assert patterns_text(wm.patterns) == patterns_text(want), \
+        f"push {wm.stats['pushes']} diverged {extra}"
+
+
+def _batches(seed, n_batches, per_batch, n_items=12, mean_itemsets=3.0,
+             mean_itemset_size=1.5):
+    rng = np.random.default_rng(seed)
+    out = []
+    for k in range(n_batches):
+        out.append(synthetic_db(
+            seed=int(rng.integers(1 << 30)), n_sequences=per_batch,
+            n_items=n_items, mean_itemsets=mean_itemsets,
+            mean_itemset_size=mean_itemset_size))
+    return out
+
+
+def test_parity_every_push_with_eviction():
+    wm = IncrementalWindowMiner(0.2, max_batches=3)
+    for batch in _batches(7, 7, 60):
+        wm.push(batch)
+        _assert_parity(wm)
+    # eviction happened (7 pushes, keep 3)
+    assert wm.window.evicted_batches == 4
+    assert wm.stats["route"] == "incremental"
+
+
+def test_steady_state_repairs_nothing():
+    # identical batch distribution + absolute minsup: after warmup, the
+    # border should not cross and pushes should not re-enumerate
+    batches = _batches(11, 6, 80, n_items=8, mean_itemsets=2.5)
+    wm = IncrementalWindowMiner(30, max_batches=3)  # absolute minsup
+    repaired = []
+    for batch in batches:
+        before = wm.stats["repaired_nodes"]
+        wm.push(batch)
+        _assert_parity(wm)
+        repaired.append(wm.stats["repaired_nodes"] - before)
+    # the first pushes build the tree; later pushes should mostly ride
+    # the sweep (this is the entire point of the incremental path)
+    assert repaired[0] > 0
+    assert sum(repaired[3:]) < sum(repaired[:3])
+
+
+def test_minsup_drift_crosses_borders():
+    # relative minsup + growing window: the absolute threshold rises
+    # every push, pushing patterns out of F (downward crossings)
+    wm = IncrementalWindowMiner(0.25, max_batches=None, max_sequences=None)
+    for batch in _batches(13, 5, 50, n_items=10):
+        wm.push(batch)
+        _assert_parity(wm)
+
+
+def test_late_appearing_item_becomes_frequent():
+    # an item absent from early batches must enter F (and its subtree be
+    # built by repair) when later batches make it frequent
+    a = parse_spmf("1 -1 2 -2\n1 -2\n2 -1 1 -2\n")
+    b = parse_spmf("9 -1 1 -2\n9 -2\n9 -1 9 -2\n")
+    c = parse_spmf("9 -1 1 -2\n9 -1 2 -2\n9 -2\n")
+    wm = IncrementalWindowMiner(2, max_batches=None)
+    for batch in (a, b, c):
+        wm.push(list(batch))
+        _assert_parity(wm)
+    assert any(p == ((9,),) for p, _ in wm.patterns)
+
+
+def test_item_falls_out_and_returns():
+    hot = parse_spmf("5 -1 6 -2\n5 -2\n5 -1 6 -2\n5 -2\n")
+    cold = parse_spmf("1 -2\n2 -2\n1 -1 2 -2\n3 -2\n")
+    wm = IncrementalWindowMiner(3, max_batches=2)
+    for batch in (hot, cold, cold, hot, hot):
+        wm.push(list(batch))
+        _assert_parity(wm)
+
+
+def test_multi_itemset_patterns_and_iext():
+    # itemsets wider than one item exercise the i-extension candidate
+    # rules through sweep AND repair
+    for seed in (3, 4):
+        wm = IncrementalWindowMiner(0.3, max_batches=2)
+        for batch in _batches(seed, 4, 50, n_items=8,
+                              mean_itemset_size=2.5):
+            wm.push(batch)
+            _assert_parity(wm)
+
+
+def test_multiword_batches():
+    # > 32 itemsets/sequence -> n_words > 1 in the batch stores
+    wm = IncrementalWindowMiner(0.5, max_batches=2)
+    for batch in _batches(8, 3, 40, n_items=6, mean_itemsets=40.0,
+                          mean_itemset_size=1.1):
+        wm.push(batch)
+        _assert_parity(wm)
+
+
+def test_restored_window_is_swept_in_full():
+    # the service restart path refills the window WITHOUT miner.push;
+    # the next real push must sweep every unseen batch and converge
+    batches = _batches(21, 3, 50)
+    wm = IncrementalWindowMiner(0.2, max_batches=4)
+    for b in batches[:2]:
+        wm.window.push(b)  # refill, bypassing the miner
+    wm.push(batches[2])
+    _assert_parity(wm)
+    assert wm.stats["swept_batches"] == 3
+
+
+def test_matches_remine_miner_exactly():
+    # same stream through the re-mine WindowMiner and the incremental
+    # one: identical pattern sets at every push
+    batches = _batches(17, 5, 60, n_items=10)
+    inc = IncrementalWindowMiner(0.25, max_batches=3)
+    rem = WindowMiner(0.25, max_batches=3)
+    for batch in batches:
+        got = inc.push(list(batch))
+        want = rem.push(list(batch))
+        assert patterns_text(got) == patterns_text(want)
+
+
+def test_single_sequence_batches_and_empty_f1():
+    wm = IncrementalWindowMiner(5, max_batches=2)
+    wm.push(parse_spmf("1 -2\n"))
+    assert wm.patterns == []  # nothing reaches minsup 5
+    _assert_parity(wm)
+    wm.push(parse_spmf("1 -2\n1 -2\n1 -2\n1 -2\n1 -2\n"))
+    _assert_parity(wm)
+    assert wm.patterns == [(((1,),), 6)]

@@ -135,6 +135,29 @@ def test_stream_service_lifecycle(server):
     assert resp["data"]["evicted_batches"] == "1"
 
 
+def test_stream_routes_incremental_by_default(server):
+    # plain single-device SPADE_TPU windows ride the true incremental
+    # path; incremental=0 pins the re-mine fallback; constraints force it
+    b = format_spmf(_batches(seed=9, n=1, size=12)[0])
+    resp = _post(server, "/stream/increq", sequences=b, support="0.3",
+                 max_batches="2", algorithm="SPADE_TPU")
+    assert resp["status"] == "finished", resp
+    st = _post(server, "/status/stream:increq")
+    assert json.loads(st["data"]["stats"])["route"] == "incremental"
+
+    resp = _post(server, "/stream/rmq", sequences=b, support="0.3",
+                 max_batches="2", algorithm="SPADE_TPU", incremental="0")
+    assert resp["status"] == "finished", resp
+    st = _post(server, "/status/stream:rmq")
+    assert json.loads(st["data"]["stats"])["route"] == "re-mine"
+
+    resp = _post(server, "/stream/cstrq", sequences=b, support="0.3",
+                 max_batches="2", algorithm="SPADE_TPU", maxgap="2")
+    assert resp["status"] == "finished", resp
+    st = _post(server, "/status/stream:cstrq")
+    assert json.loads(st["data"]["stats"])["route"] == "re-mine"
+
+
 def test_stream_constrained_and_rules(server):
     # constrained SPADE over a sliding window
     batches = _batches(seed=8, n=2, size=20)
