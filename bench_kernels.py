@@ -42,6 +42,17 @@ from spark_fsm_tpu.utils.probe import tpu_probe
 
 V5E_HBM_GBPS = 819.0  # v5e HBM peak per chip
 
+# v5e VPU throughput for the op-level compute model: (8 x 128) vector
+# slots x 4 ALUs x ~1.5 GHz clock.  The clock is derived from the public
+# peak (197 bf16 TFLOP/s over 4 MXUs x 128x128 MACs x 2 flops =>
+# 197e12 / 131072 ~= 1.5e9); int8's 394 TOP/s gives the same figure.
+V5E_VPU_OPS = 8 * 128 * 4 * 1.5e9
+
+# pair kernel inner loop, per uint32 word element: AND, nonzero compare,
+# int32 cast, lane accumulate — the minimum op sequence the semantics
+# need on a VPU with no fused popcount-accumulate over masks.
+PAIR_VPU_OPS_PER_WORD = 4
+
 
 def _roundtrip_s() -> float:
     """One dispatch + 4-byte readback on the current backend — the fence
@@ -146,6 +157,14 @@ def bench_pair_supports() -> dict:
                               "s_block": sb,
                               "error": repr(exc).split("\n")[0][:120]})
 
+    # Op-level compute model: is 46%-of-HBM-peak a tuning failure or the
+    # VPU roofline?  Every (parent, item, seq-word) element costs
+    # PAIR_VPU_OPS_PER_WORD VPU ops; the theoretical compute-bound wall
+    # at the v5e VPU rate decides which roofline binds.
+    compute_ops = PAIR_VPU_OPS_PER_WORD * P * NI * S * W
+    compute_wall_s = compute_ops / V5E_VPU_OPS
+    hbm_wall_s = model_bytes / (V5E_HBM_GBPS * 1e9)
+
     return {
         "kernel": "pair_supports (ops/pallas_support.py)",
         "geometry": f"P={P} NI={NI} S={S} W={W} "
@@ -158,6 +177,16 @@ def bench_pair_supports() -> dict:
                                   / V5E_HBM_GBPS, 1),
         "min_useful_bytes": int(min_bytes),
         "effective_GBps_min_bytes": round(min_bytes / wall / 1e9, 1),
+        "vpu_model": {
+            "ops_per_word": PAIR_VPU_OPS_PER_WORD,
+            "total_vpu_ops": int(compute_ops),
+            "v5e_vpu_ops_per_s": V5E_VPU_OPS,
+            "compute_bound_wall_ms": round(compute_wall_s * 1e3, 2),
+            "hbm_bound_wall_ms": round(hbm_wall_s * 1e3, 2),
+            "binding_roofline": ("vpu" if compute_wall_s > hbm_wall_s
+                                 else "hbm"),
+            "pct_vpu_roofline": round(100 * compute_wall_s / wall, 1),
+        },
         "jnp_wall_ms": round(jnp_wall * 1e3, 2),
         "speedup_vs_jnp": round(jnp_wall / wall, 2),
         "tile_sweep": sweep,

@@ -110,7 +110,7 @@ def _tsr(max_side, tag: str, note: str) -> dict:
     t3 = time.monotonic()
     rules = eng.mine()
     t4 = time.monotonic()
-    return {
+    out = {
         "config": tag, "scale": 1.0,
         "metric": "TSR_TPU synthetic Kosarak-shaped FULL "
                   f"(990k x 39.6k) k=100 minconf=0.5 {note}",
@@ -123,6 +123,17 @@ def _tsr(max_side, tag: str, note: str) -> dict:
         "kernel_launches": eng.stats["kernel_launches"],
         "platform": jax.default_backend(),
     }
+    # per-km decomposition (models/tsr.py per-bucket counters): padded
+    # width x km is the kernel's per-candidate traffic unit, so these
+    # separate candidate-mix cost (irreducible) from launch underfill
+    per_km = {k: v for k, v in sorted(eng.stats.items())
+              if k.startswith(("evaluated_km", "launches_km", "width_km"))}
+    if per_km:
+        out["per_km"] = per_km
+        out["traffic_units"] = sum(
+            v * int(k[len("width_km"):]) for k, v in per_km.items()
+            if k.startswith("width_km"))
+    return out
 
 
 def config3() -> dict:

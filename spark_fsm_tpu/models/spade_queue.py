@@ -151,13 +151,19 @@ def queue_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
     if shape_buckets:
         n_seq = bucket_seq(n_seq)
     row_bytes = -(-n_seq // n_dev) * vdb.n_words * 4
-    caps = caps or QueueCaps()
+    dev = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
+    budget = 0.45 * device_hbm_budget(dev)
+    if caps is None:
+        # judge the caps the engine would actually auto-size (for_budget
+        # shrinks the ring to fit), not the roomy defaults — otherwise
+        # eligibility refuses workloads the engine handles fine
+        caps = QueueCaps.for_budget(n_seq * vdb.n_words * 4, ni_pad,
+                                    int(budget), n_dev)
     store_rows = ni_pad + caps.ring + 1
     need = (2 * store_rows * row_bytes
             + (2 * caps.nb + caps.m_cap) * row_bytes
             + 2 * caps.ring * ni_pad)
-    dev = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
-    return need <= 0.45 * device_hbm_budget(dev)
+    return need <= budget
 
 
 @functools.lru_cache(maxsize=32)

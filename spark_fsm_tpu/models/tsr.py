@@ -496,6 +496,14 @@ class TsrTPU:
             while km < side:
                 km *= 2
             kms[r] = km
+        # per-bucket accounting (evaluated + padded launch widths land in
+        # stats below): the service-default unlimited-side path spreads
+        # every dispatch over several km buckets, and these counters are
+        # what lets BENCH_SCALE's 3-vs-3d gap be decomposed into candidate
+        # mix (irreducible) vs launch underfill (fixable)
+        for km_v, cnt in zip(*np.unique(kms, return_counts=True)):
+            key = f"evaluated_km{int(km_v)}"
+            self.stats[key] = self.stats.get(key, 0) + int(cnt)
         order = np.argsort(kms, kind="stable")
         parts = []
         cols = np.empty(n, np.int64)  # candidate r -> column in `out`
@@ -596,12 +604,23 @@ class TsrTPU:
         fn = _kernel_eval_fn(self.mesh, km, self._bucket_seq_block(km),
                              self._interpret, self.n_words == 1)
         c = self.chunk
-        for lo in range(g_lo, g_hi, c):
-            hi = min(lo + c, g_hi)
-            # pow2 width bucket (floor C_LANES): an exact 128-padded
-            # remainder would give each batch a distinct xy shape and
-            # retrace + recompile the kernel per width
-            width = max(PT.C_LANES, next_pow2(hi - lo))
+        lo = g_lo
+        while lo < g_hi:
+            rem = g_hi - lo
+            # Greedy pow2 split instead of one over-padded launch: the
+            # kernel's wall is ~linear in the PADDED width (every lane
+            # streams its km seq blocks), and the service-default
+            # unlimited-side path measured 1.5x padded-over-ideal traffic
+            # from chunk-then-next_pow2 alone (BENCH_SCALE 3d per_km).
+            # Take the largest pow2 <= remaining (capped at chunk) while
+            # >= 1024 — 100% fill — then one padded tail launch.  Widths
+            # stay the same pow2 set, so no new kernel compiles.
+            if rem >= 1024:
+                take = min(c, 1 << (rem.bit_length() - 1))
+            else:
+                take = rem
+            hi = lo + take
+            width = max(PT.C_LANES, next_pow2(take))
             xy = np.full((width, 2, km), -1, np.int32)
             for r in range(lo, hi):
                 x, y = cands[order[r]]
@@ -609,6 +628,10 @@ class TsrTPU:
                 xy[r - lo, 1, :len(y)] = y
             part = fn(p1k, s1k, self._put(xy))
             self.stats["kernel_launches"] += 1
+            lk = f"launches_km{km}"
+            wk = f"width_km{km}"
+            self.stats[lk] = self.stats.get(lk, 0) + 1
+            self.stats[wk] = self.stats.get(wk, 0) + width
             cols[order[lo:hi]] = base + np.arange(hi - lo)
             base += width
             parts.append(part)

@@ -149,12 +149,15 @@ def service_stats(master: Master) -> dict:
                      "stream_pushes", "stream_failures")
     }
     mesh_devices = cfgmod.get_config().engine.mesh_devices
+    from spark_fsm_tpu.service.devcache import spade_engine_cache
     return {
         "jobs": counters,
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
         "mesh_devices": mesh_devices,
         "algorithms": sorted(plugins.ALGORITHMS),
+        # repeat-/train device-store reuse (service/devcache.py)
+        "store_cache": dict(spade_engine_cache.stats),
     }
 
 

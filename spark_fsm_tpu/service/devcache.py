@@ -1,0 +1,239 @@
+"""Device-store cache for repeat ``/train`` mines (Spark's cached-RDD
+analog, SURVEY.md sec 2.2).
+
+Every ``/train`` used to rebuild the vertical DB's device store from
+scratch: token upload over the host link plus the HBM scatter-build —
+~0.3 s of fixed cost per mine on a tunneled TPU (BENCH_SUITE config-1
+note), paid even when the client re-mines the exact same data at the
+same support (the reference's explore/track->mine loop).  This cache
+keeps the constructed ENGINE — device store, Pallas launchers, compiled
+programs — keyed by a CONTENT fingerprint of the sequence data plus
+every parameter that shapes the engine, so a repeat mine skips the
+upload, the scatter-build, and engine construction entirely.
+
+Correctness by construction:
+
+- the fingerprint hashes the flattened token representation (the exact
+  arrays the vertical build consumes), so any data change — including a
+  ``/track`` write feeding a TRACKED source — changes the key and
+  misses; no explicit invalidation hook can be forgotten;
+- entries are checked out EXCLUSIVELY for the duration of a mine (the
+  engines' device stores are mutable scratch); a concurrent identical
+  request simply builds its own engine (counted as a busy miss);
+- eviction is LRU under an HBM budget — dropping an entry only drops
+  the reference, the device memory frees when the arrays do.
+
+Scope: the plain SPADE_TPU path (queue or classic engine — the two that
+keep their store across ``mine()`` calls).  Constrained, checkpointed,
+and TSR jobs pass through uncached.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.utils.canonical import PatternResult
+
+
+def db_fingerprint(db: SequenceDB) -> str:
+    """Content hash of the flattened token representation — two DBs with
+    equal flattenings are identical inputs to the vertical build."""
+    from spark_fsm_tpu.data import fasttok
+
+    ft = fasttok.flatten(db)
+    if ft is None:
+        ft = fasttok.flatten_numpy(db)
+    seq_lengths, counts, raw_items = ft
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.int64(len(db)).tobytes())
+    for arr in (seq_lengths, counts, raw_items):
+        a = np.ascontiguousarray(arr)
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class _Entry:
+    __slots__ = ("engine", "nbytes", "busy")
+
+    def __init__(self, engine, nbytes: int):
+        self.engine = engine
+        self.nbytes = nbytes
+        self.busy = False
+
+
+class SpadeEngineCache:
+    """LRU engine cache with exclusive checkout; see module docstring."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self._budget = budget_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self.stats = {"hits": 0, "misses": 0, "busy_misses": 0,
+                      "evictions": 0}
+
+    def _budget_bytes(self) -> int:
+        if self._budget is not None:
+            return self._budget
+        import jax
+
+        from spark_fsm_tpu.models._common import device_hbm_budget
+
+        return int(0.25 * device_hbm_budget(jax.devices()[0]))
+
+    def mine(self, db: SequenceDB, minsup_abs: int, *,
+             mesh=None, stats_out: Optional[dict] = None,
+             max_pattern_itemsets: Optional[int] = None,
+             shape_buckets: bool = False,
+             fused: str = "auto",
+             **kwargs) -> List[PatternResult]:
+        """Cached equivalent of ``mine_spade_tpu`` for the plain path.
+
+        Modes without a store-keeping engine ("never"/"dense" pins, or
+        explicit engine kwargs the cache does not key) fall through to
+        the uncached wrapper.
+        """
+        from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+
+        if fused not in ("auto", "queue") or kwargs:
+            return mine_spade_tpu(
+                db, minsup_abs, mesh=mesh, stats_out=stats_out,
+                max_pattern_itemsets=max_pattern_itemsets,
+                shape_buckets=shape_buckets, fused=fused, **kwargs)
+
+        key = (db_fingerprint(db), int(minsup_abs), mesh,
+               max_pattern_itemsets, bool(shape_buckets), fused)
+        entry = None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and not e.busy:
+                e.busy = True
+                self._entries.move_to_end(key)
+                entry = e
+                self.stats["hits"] += 1
+            elif e is not None:
+                self.stats["busy_misses"] += 1
+            else:
+                self.stats["misses"] += 1
+
+        if entry is not None:
+            eng = entry.engine
+            # the classic engine ACCUMULATES counters across mine()
+            # calls — zero the numeric stats so a hit reports this
+            # mine's work, not the engine's lifetime totals
+            for k, v in eng.stats.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    eng.stats[k] = 0
+            try:
+                res = eng.mine()
+            finally:
+                with self._lock:
+                    entry.busy = False
+            if res is not None:  # a cap overflow on re-mine: fall through
+                if stats_out is not None:
+                    stats_out.update(eng.stats)
+                    # classic engines carry no 'fused' key in their own
+                    # stats; artifact consumers key the route on it
+                    stats_out.setdefault("fused", False)
+                    stats_out["store_cache_hit"] = True
+                return res
+            with self._lock:
+                self._entries.pop(key, None)
+
+        res, engine = self._build_and_mine(
+            db, minsup_abs, mesh=mesh, stats_out=stats_out,
+            max_pattern_itemsets=max_pattern_itemsets,
+            shape_buckets=shape_buckets, fused=fused)
+        if stats_out is not None:
+            stats_out["store_cache_hit"] = False
+        if engine is not None:
+            self._insert(key, engine)
+        return res
+
+    def _build_and_mine(self, db, minsup_abs, *, mesh, stats_out,
+                        max_pattern_itemsets, shape_buckets, fused):
+        """mine_spade_tpu's routing, but keeping the engine object."""
+        from spark_fsm_tpu.data.vertical import build_vertical
+        from spark_fsm_tpu.models.spade_queue import (
+            QueueSpadeTPU, queue_eligible)
+        from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+
+        vdb = build_vertical(db, min_item_support=minsup_abs)
+        if vdb.n_items == 0:
+            return [], None
+        ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
+                   shape_buckets=shape_buckets)
+        if fused in ("auto", "queue") and (
+                fused == "queue"
+                or queue_eligible(vdb, mesh=mesh,
+                                  shape_buckets=shape_buckets)):
+            qeng = QueueSpadeTPU(vdb, minsup_abs, **ekw)
+            res = qeng.mine()
+            if res is not None:
+                if stats_out is not None:
+                    stats_out.update(qeng.stats)
+                return res, qeng
+            if stats_out is not None:
+                stats_out["fused_overflow"] = True
+        elif fused == "auto":
+            # mirror mine_spade_tpu's queue-ineligible-but-dense-eligible
+            # corner: the dense engine rebuilds its store per mine(), so
+            # it is not worth caching, but it must still WIN the route —
+            # degrading it to the classic DFS would re-add one readback
+            # per wave on tunneled TPUs
+            from spark_fsm_tpu.models.spade_fused import (
+                FusedSpadeTPU, fused_eligible)
+            if fused_eligible(vdb, mesh=mesh, shape_buckets=shape_buckets):
+                feng = FusedSpadeTPU(vdb, minsup_abs, **ekw)
+                res = feng.mine()
+                if res is not None:
+                    if stats_out is not None:
+                        stats_out.update(feng.stats)
+                    return res, None
+                if stats_out is not None:
+                    stats_out["fused_overflow"] = True
+        eng = SpadeTPU(vdb, minsup_abs, **ekw)
+        res = eng.mine()
+        if stats_out is not None:
+            stats_out.update(eng.stats)
+            stats_out.setdefault("fused", False)
+        return res, eng
+
+    def _engine_bytes(self, engine) -> int:
+        if hasattr(engine, "nbytes"):
+            return int(engine.nbytes())
+        rows = engine.store.shape[0]
+        return rows * engine.n_seq * engine.n_words * 4
+
+    def _insert(self, key, engine) -> None:
+        nbytes = self._engine_bytes(engine)
+        budget = self._budget_bytes()
+        if nbytes > budget:
+            return  # a store bigger than the whole budget never caches
+        with self._lock:
+            self._entries[key] = _Entry(engine, nbytes)
+            self._entries.move_to_end(key)
+            total = sum(e.nbytes for e in self._entries.values())
+            for k in list(self._entries):
+                if total <= budget:
+                    break
+                e = self._entries[k]
+                if e.busy or k == key:
+                    continue
+                total -= e.nbytes
+                del self._entries[k]
+                self.stats["evictions"] += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# process-wide cache the service plugin layer uses
+spade_engine_cache = SpadeEngineCache()

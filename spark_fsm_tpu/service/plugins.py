@@ -111,9 +111,21 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
     if maxgap is None and maxwindow is None:
         # fused routing is a plain-SPADE knob (the constrained engine has
         # no fused counterpart), so it must not reach mine_cspade_tpu
+        fused_kw = config.engine_kwargs("fused")
+        if checkpoint is None and req.task != "stream":
+            # repeat mines over identical data reuse the HBM store +
+            # compiled engine (service/devcache.py); a checkpointed job
+            # stays uncached (its classic engine binds to the resume
+            # fingerprint, not the cache key), and stream re-mines skip
+            # it (a sliding window's data changes every push, so every
+            # push would insert a dead entry)
+            from spark_fsm_tpu.service.devcache import spade_engine_cache
+            return spade_engine_cache.mine(db, minsup, mesh=mesh,
+                                           stats_out=stats,
+                                           **fused_kw, **kwargs)
         return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
                               checkpoint=checkpoint,
-                              **config.engine_kwargs("fused"), **kwargs)
+                              **fused_kw, **kwargs)
     return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow,
                            mesh=mesh, stats_out=stats, checkpoint=checkpoint,
                            **kwargs)

@@ -236,9 +236,6 @@ class IncrementalWindowMiner:
             return int(self.min_support)
         return abs_minsup(self.min_support, max(1, self.window.n_sequences))
 
-    def _live_bids(self) -> List[int]:
-        return [self._states[id(b)].bid for b in self.window.batches()]
-
     def _zero_subtree(self, node: _TNode, bid: int) -> None:
         node.sup[bid] = 0
         for child in node.children.values():

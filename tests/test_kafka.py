@@ -1,0 +1,108 @@
+"""Kafka adapter contract tests (streaming/kafka.py) against a fake
+poll()-shaped consumer — the shape both kafka-python and a wrapped
+confluent-kafka expose.  No broker or client library involved; what is
+under test is the fetch contract PollConsumer relies on."""
+
+import pytest
+
+from spark_fsm_tpu.data.spmf import format_spmf, parse_spmf
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.streaming.consumer import PollConsumer
+from spark_fsm_tpu.streaming.incremental import IncrementalWindowMiner
+from spark_fsm_tpu.streaming.kafka import KafkaFetch
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+
+class _Rec:
+    def __init__(self, value):
+        self.value = value
+
+
+class _FakeConsumer:
+    """kafka-python poll() shape: {partition: [records]} per call."""
+
+    def __init__(self, polls):
+        self._polls = list(polls)
+        self.seen_timeouts = []
+
+    def poll(self, timeout_ms=None):
+        self.seen_timeouts.append(timeout_ms)
+        return self._polls.pop(0) if self._polls else {}
+
+
+def test_poll_concatenates_partitions_in_order():
+    fake = _FakeConsumer([{
+        "tp0": [_Rec(b"1 -2\n"), _Rec(b"2 -2\n")],
+        "tp1": [_Rec("3 -1 4 -2\n")],        # str values pass through
+    }])
+    fetch = KafkaFetch(fake, timeout_ms=250)
+    batch = fetch()
+    assert batch == parse_spmf("1 -2\n2 -2\n3 -1 4 -2\n")
+    assert fake.seen_timeouts == [250]
+    assert fetch.stats == {"polls": 1, "records": 3, "bad_records": 0}
+
+
+def test_empty_poll_and_empty_records_are_idle():
+    fake = _FakeConsumer([{}, {"tp0": [_Rec(b"")]}])
+    fetch = KafkaFetch(fake)
+    assert fetch() is None          # broker had nothing
+    assert fetch() is None          # records parsed to zero sequences
+    assert fetch.stats["polls"] == 2
+
+
+def test_multiline_record_values():
+    fake = _FakeConsumer([{"tp0": [_Rec(b"1 -2\n2 -2\n1 2 -2\n")]}])
+    assert KafkaFetch(fake)() == parse_spmf("1 -2\n2 -2\n1 2 -2\n")
+
+
+def test_bad_record_raise_surfaces_to_supervision():
+    fake = _FakeConsumer([{"tp0": [_Rec(b"not spmf")]}])
+    fetch = KafkaFetch(fake)
+    with pytest.raises(ValueError):
+        fetch()
+    # and PollConsumer turns that into a counted, non-fatal error
+    fake2 = _FakeConsumer([{"tp0": [_Rec(b"garbage")]},
+                           {"tp0": [_Rec(b"7 -2\n")]}])
+    got = []
+    pc = PollConsumer(KafkaFetch(fake2), got.append, poll_interval_s=0)
+    pc.run(max_polls=2)
+    assert pc.stats["errors"] == 1 and got == [parse_spmf("7 -2\n")]
+
+
+def test_bad_record_skip_counts_and_keeps_good_ones():
+    fake = _FakeConsumer([{"tp0": [_Rec(b"\xff\xfe bad utf8"),
+                                   _Rec(b"5 -2\n"),
+                                   _Rec(b"oops")]}])
+    fetch = KafkaFetch(fake, on_bad="skip")
+    assert fetch() == parse_spmf("5 -2\n")
+    assert fetch.stats["bad_records"] == 2
+
+
+def test_constructor_validation():
+    with pytest.raises(TypeError, match="poll"):
+        KafkaFetch(object())
+    with pytest.raises(ValueError, match="on_bad"):
+        KafkaFetch(_FakeConsumer([]), on_bad="ignore")
+
+
+def test_end_to_end_kafka_to_incremental_window_parity():
+    # the full seam: fake broker -> KafkaFetch -> PollConsumer ->
+    # incremental window miner, with per-push oracle parity
+    from spark_fsm_tpu.data.synth import synthetic_db
+
+    dbs = [synthetic_db(seed=s, n_sequences=40, n_items=8,
+                        mean_itemsets=2.5) for s in (1, 2, 3)]
+    polls = [{"tp0": [_Rec(format_spmf(db).encode())]} for db in dbs]
+    fake = _FakeConsumer(polls)
+    wm = IncrementalWindowMiner(0.3, max_batches=2)
+    parities = []
+
+    def check(patterns):
+        want = mine_spade(wm.window.sequences(), wm.minsup_abs())
+        parities.append(patterns_text(patterns) == patterns_text(want))
+
+    pc = PollConsumer(KafkaFetch(fake), wm.push, poll_interval_s=0,
+                      on_result=check)
+    pc.run(max_polls=4)  # 3 batches + 1 idle
+    assert pc.stats["batches"] == 3
+    assert parities == [True, True, True]

@@ -1,0 +1,106 @@
+"""Mid-scale multichip parity (RUN_SLOW): non-toy widths on the 8-mesh.
+
+The CI-sized mesh tests (test_spade_tpu/test_spade_queue/test_multihost)
+run hundreds of sequences and <1k candidates, so shard-degenerate edge
+cases — empty shards after padding, psum at real frontier widths,
+mesh-scaled caps — are only ever exercised at toy width.  This module
+mines a BMS-WebView-1-shaped DB (~59.6k sequences, 8 virtual CPU
+devices, tens of thousands of candidates) through every sharded engine
+and requires byte-identical parity with the CPU oracle.
+
+Minutes-long (CPU mesh + full-size oracle): gated behind RUN_SLOW=1,
+same convention as tests/test_tsr.py's full-scale run.
+"""
+
+import os
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW"),
+    reason="minutes-long mid-scale mesh run; set RUN_SLOW=1")
+
+
+@pytest.fixture(scope="module")
+def midscale():
+    import jax
+
+    from spark_fsm_tpu.data.synth import bms_webview1_like
+    from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(len(jax.devices()))
+    assert mesh.devices.size == 8
+    db = bms_webview1_like(scale=1.0)
+    minsup = abs_minsup(0.002, len(db))  # ~0.2%: tens of thousands of
+    # candidates — the non-toy width this module exists to exercise
+    vdb = build_vertical(db, min_item_support=minsup)
+    want = mine_spade(db, minsup)
+    return mesh, db, vdb, minsup, want
+
+
+def test_classic_engine_midscale_mesh(midscale):
+    from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+    from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+    mesh, db, vdb, minsup, want = midscale
+    eng = SpadeTPU(vdb, minsup, mesh=mesh)
+    got = eng.mine()
+    assert patterns_text(got) == patterns_text(want), \
+        diff_patterns(want, got)
+    # the point of mid-scale: candidate counts far beyond the CI fixtures
+    assert eng.stats["candidates"] >= 10_000, eng.stats
+
+
+def test_queue_engine_midscale_mesh(midscale):
+    from spark_fsm_tpu.models.spade_queue import QueueSpadeTPU
+    from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+    mesh, db, vdb, minsup, want = midscale
+    eng = QueueSpadeTPU(vdb, minsup, mesh=mesh)
+    got = eng.mine()
+    assert got is not None, f"queue caps overflowed mid-scale: {eng.stats}"
+    assert patterns_text(got) == patterns_text(want), \
+        diff_patterns(want, got)
+    assert eng.stats["candidates"] >= 10_000, eng.stats
+
+
+def test_fused_engine_midscale_mesh(midscale):
+    from spark_fsm_tpu.models.spade_fused import FusedCaps, FusedSpadeTPU
+    from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+    mesh, db, vdb, minsup, want = midscale
+    eng = FusedSpadeTPU(vdb, minsup, mesh=mesh,
+                        caps=FusedCaps.for_mesh(mesh))
+    got = eng.mine()
+    assert got is not None, f"fused caps overflowed mid-scale: {eng.stats}"
+    assert patterns_text(got) == patterns_text(want), \
+        diff_patterns(want, got)
+    assert eng.stats["candidates"] >= 10_000, eng.stats
+
+
+def test_constrained_engine_midscale_mesh(midscale):
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
+    from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+    mesh, db, vdb, minsup, want = midscale
+    stats: dict = {}
+    got = mine_cspade_tpu(db, minsup, maxgap=2, maxwindow=5, mesh=mesh,
+                          stats_out=stats)
+    cwant = mine_cspade(db, minsup, maxgap=2, maxwindow=5)
+    assert patterns_text(got) == patterns_text(cwant), \
+        diff_patterns(cwant, got)
+
+
+def test_tsr_engine_midscale_mesh(midscale):
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu, mine_tsr_tpu
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    mesh, db, vdb, minsup, want = midscale
+    stats: dict = {}
+    got = mine_tsr_tpu(db, 50, 0.5, max_side=2, mesh=mesh, stats_out=stats)
+    cwant = mine_tsr_cpu(db, 50, 0.5, max_side=2)
+    assert rules_text(got) == rules_text(cwant)
+    assert stats["evaluated"] >= 1_000, stats
