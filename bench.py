@@ -99,11 +99,17 @@ def main() -> None:
     use_queue = (want_engine == "queue"
                  or (want_engine == "auto" and queue_eligible(vdb)))
     t0 = time.time()
+    fused_fallback_s = None
     if use_queue:
         eng = QueueSpadeTPU(vdb, minsup, use_pallas=use_pallas)
         res = eng.mine()
         if res is None:  # cap overflow: route to classic like the service
             use_queue = False
+            # the failed attempt's wall is recorded separately and the
+            # cold timer restarts, so cold_wall_s is the REPORTED engine's
+            # cold wall, not queue-attempt + classic conflated
+            fused_fallback_s = time.time() - t0
+            t0 = time.time()
     if not use_queue:
         eng = SpadeTPU(vdb, minsup, use_pallas=use_pallas)
         res = eng.mine()
@@ -147,6 +153,9 @@ def main() -> None:
         "engine": "queue" if use_queue else "classic",
         "candidates": eng.stats["candidates"],
     }
+    if fused_fallback_s is not None:
+        out["fused_overflow"] = True
+        out["fused_fallback_s"] = round(fused_fallback_s, 3)
     if fallback_reason:
         out["tpu_fallback_reason"] = fallback_reason
 

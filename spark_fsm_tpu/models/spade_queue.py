@@ -105,28 +105,42 @@ class QueueCaps:
     @classmethod
     def for_budget(cls, row_bytes: int, ni_pad: int,
                    budget: int, n_dev: int = 1) -> "QueueCaps":
-        """Size the ring to the memory budget: largest pow2 ring (floor
-        2048) whose working set fits ``budget`` per device.  The working
-        set is ~2x the store (the while_loop carry cannot alias the
-        engine's persistent input store) plus the prep/joins temps and
-        the boolean candidate masks."""
-        caps = cls()
-        per_dev_row = max(1, row_bytes // n_dev)
-        # item rows ride in the doubled store; prep/joins temps are
-        # transient singles
-        fixed = ((ni_pad + 1) * per_dev_row * 2
-                 + (2 * caps.nb + caps.m_cap) * per_dev_row)
-        ring = 2048
-        while ring < 65536:
-            nxt = ring * 2
-            # ring slots are store rows (doubled by the while carry);
-            # the two boolean candidate masks are carry state too
-            need = fixed + nxt * per_dev_row * 2 + 2 * (2 * nxt * ni_pad)
-            if need > budget:
+        """Size the ring to the memory budget: largest pow2 ring in
+        [256, 65536] whose working set (the ONE estimator
+        ``working_set_bytes`` — also what ``queue_eligible`` judges)
+        fits ``budget`` per device.  When even the smallest ring
+        overshoots, the smallest is returned anyway — ``queue_eligible``
+        refuses such workloads, so only an explicit ``fused="queue"``
+        pin reaches the engine then, at the least-memory geometry."""
+        per_dev_row = max(1, -(-row_bytes // n_dev))
+        best = None
+        ring = 256
+        while ring <= 65536:
+            caps = cls(ring=ring)
+            if working_set_bytes(caps, per_dev_row, ni_pad) > budget:
                 break
-            ring = nxt
-        caps.ring = ring
-        return caps
+            best = caps
+            ring *= 2
+        return best if best is not None else cls(ring=256)
+
+
+def working_set_bytes(caps: QueueCaps, per_dev_row: int,
+                      ni_pad: int) -> int:
+    """Per-device working set of the queue program — the SINGLE estimator
+    shared by ``QueueCaps.for_budget`` (sizing) and ``queue_eligible``
+    (routing), so the two can never disagree about what fits.
+
+    Counts: the store carry-doubled (the ``lax.while_loop`` carry cannot
+    alias the engine's persistent input store), the per-wave parent/join
+    temps, both boolean candidate masks carry-doubled, the int32 queue
+    bookkeeping (``q_slot``/``q_nits``/``q_rec``) carry-doubled, and the
+    record buffer + supports carry-doubled."""
+    store_rows = ni_pad + caps.ring + 1
+    return (2 * store_rows * per_dev_row                 # store (x2 carry)
+            + (2 * caps.nb + caps.m_cap) * per_dev_row   # wave temps
+            + 2 * (2 * caps.ring * ni_pad)               # bool masks (x2)
+            + 2 * (3 * caps.ring * 4)                    # int32 queue state
+            + 2 * (4 * caps.r_cap * 4))                  # records + recsup
 
 
 def queue_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
@@ -156,14 +170,18 @@ def queue_eligible(vdb: VerticalDB, mesh: Optional[Mesh] = None,
     if caps is None:
         # judge the caps the engine would actually auto-size (for_budget
         # shrinks the ring to fit), not the roomy defaults — otherwise
-        # eligibility refuses workloads the engine handles fine
-        caps = QueueCaps.for_budget(n_seq * vdb.n_words * 4, ni_pad,
+        # eligibility refuses workloads the engine handles fine.  Feed it
+        # the SAME per-device row bytes this check uses (row_bytes is
+        # already ceil-per-device), so sizing and judging cannot diverge
+        # on non-divisible seq counts.
+        caps = QueueCaps.for_budget(row_bytes * n_dev, ni_pad,
                                     int(budget), n_dev)
-    store_rows = ni_pad + caps.ring + 1
-    need = (2 * store_rows * row_bytes
-            + (2 * caps.nb + caps.m_cap) * row_bytes
-            + 2 * caps.ring * ni_pad)
-    return need <= budget
+    if caps.ring < vdb.n_items:
+        # the ring must hold the whole root level or every mine would
+        # build the store only to abort at n_roots > ring (the smaller
+        # rings for_budget can now return make this reachable)
+        return False
+    return working_set_bytes(caps, row_bytes, ni_pad) <= budget
 
 
 @functools.lru_cache(maxsize=32)

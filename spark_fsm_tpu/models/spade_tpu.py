@@ -749,13 +749,11 @@ def mine_spade_tpu(
     ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
                use_pallas=kwargs.get("use_pallas", "auto"),
                shape_buckets=shape_buckets)
-    queue_ran = False
     if checkpoint is None and fused in ("auto", "always", "queue"):
         from spark_fsm_tpu.models.spade_queue import (
             QueueSpadeTPU, queue_eligible)
         if fused in ("always", "queue") or queue_eligible(
                 vdb, mesh=mesh, shape_buckets=shape_buckets):
-            queue_ran = True
             qeng = QueueSpadeTPU(vdb, minsup_abs, **ekw)
             res = qeng.mine()
             if res is not None:
@@ -770,11 +768,11 @@ def mine_spade_tpu(
             if stats_out is not None:
                 stats_out["fused_overflow"] = True
                 stats_out["fused_waves"] = qeng.stats.get("waves", 0)
-    if checkpoint is None and (
-            fused in ("always", "dense")
-            or (fused == "auto" and not queue_ran)):
-        # dense engine: pinned, "always"'s second try, or the rare
-        # queue-ineligible-but-dense-eligible corner of "auto"
+    if checkpoint is None and fused in ("always", "dense", "auto"):
+        # dense engine: pinned, or "auto"/"always"'s second try — reached
+        # when the queue engine was ineligible OR overflowed its caps
+        # (a queue success returned above), so an overflowing workload
+        # still gets the one-readback path where the dense engine fits
         from spark_fsm_tpu.models.spade_fused import (
             FusedSpadeTPU, fused_eligible)
         if fused in ("always", "dense") or fused_eligible(

@@ -489,7 +489,9 @@ class Streamer:
         # ``incremental=0`` pins the re-mine path.
         algo = (data.get("algorithm") or "SPADE_TPU").upper()
         # same falsy spellings as the checkpoint param (Miner._run)
-        inc_param = (data.get("incremental", "1") or "").lower()
+        # str() first: clients may send a JSON number/boolean and the
+        # falsy-spelling contract must hold regardless of value type
+        inc_param = str(data.get("incremental", "1") or "").lower()
         use_inc = (plugin.kind == "patterns"
                    and algo == "SPADE_TPU"
                    and base.param("maxgap") is None

@@ -145,6 +145,20 @@ class SpadeEngineCache:
                 return res
             with self._lock:
                 self._entries.pop(key, None)
+            # a cached queue engine that overflowed would overflow again
+            # deterministically on identical inputs — tell the rebuild to
+            # skip the queue attempt instead of doubling the device work
+            if stats_out is not None:
+                stats_out["fused_overflow"] = True
+            res, engine = self._build_and_mine(
+                db, minsup_abs, mesh=mesh, stats_out=stats_out,
+                max_pattern_itemsets=max_pattern_itemsets,
+                shape_buckets=shape_buckets, fused=fused, skip_queue=True)
+            if stats_out is not None:
+                stats_out["store_cache_hit"] = False
+            if engine is not None:
+                self._insert(key, engine)
+            return res
 
         res, engine = self._build_and_mine(
             db, minsup_abs, mesh=mesh, stats_out=stats_out,
@@ -157,8 +171,14 @@ class SpadeEngineCache:
         return res
 
     def _build_and_mine(self, db, minsup_abs, *, mesh, stats_out,
-                        max_pattern_itemsets, shape_buckets, fused):
-        """mine_spade_tpu's routing, but keeping the engine object."""
+                        max_pattern_itemsets, shape_buckets, fused,
+                        skip_queue=False):
+        """mine_spade_tpu's routing, but keeping the engine object.
+
+        ``skip_queue``: the caller already observed this exact workload
+        overflow the queue engine's caps (a cached engine's re-mine) —
+        don't pay for a second deterministic overflow.
+        """
         from spark_fsm_tpu.data.vertical import build_vertical
         from spark_fsm_tpu.models.spade_queue import (
             QueueSpadeTPU, queue_eligible)
@@ -169,7 +189,7 @@ class SpadeEngineCache:
             return [], None
         ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
                    shape_buckets=shape_buckets)
-        if fused in ("auto", "queue") and (
+        if not skip_queue and fused in ("auto", "queue") and (
                 fused == "queue"
                 or queue_eligible(vdb, mesh=mesh,
                                   shape_buckets=shape_buckets)):
@@ -181,12 +201,13 @@ class SpadeEngineCache:
                 return res, qeng
             if stats_out is not None:
                 stats_out["fused_overflow"] = True
-        elif fused == "auto":
-            # mirror mine_spade_tpu's queue-ineligible-but-dense-eligible
-            # corner: the dense engine rebuilds its store per mine(), so
-            # it is not worth caching, but it must still WIN the route —
-            # degrading it to the classic DFS would re-add one readback
-            # per wave on tunneled TPUs
+        if fused == "auto":
+            # mirror mine_spade_tpu: the dense engine is "auto"'s second
+            # try — queue-ineligible, queue-overflowed (this mine or a
+            # cached one, per skip_queue), it must still WIN the route
+            # where eligible.  It rebuilds its store per mine(), so it is
+            # not worth caching — degrading it to the classic DFS would
+            # re-add one readback per wave on tunneled TPUs.
             from spark_fsm_tpu.models.spade_fused import (
                 FusedSpadeTPU, fused_eligible)
             if fused_eligible(vdb, mesh=mesh, shape_buckets=shape_buckets):
@@ -217,6 +238,13 @@ class SpadeEngineCache:
         if nbytes > budget:
             return  # a store bigger than the whole budget never caches
         with self._lock:
+            old = self._entries.get(key)
+            if old is not None and old.busy:
+                # a busy-miss rebuild racing the checked-out entry: keep
+                # the in-use one (replacing it would transiently hold two
+                # stores above the budget); the second engine is simply
+                # not cached
+                return
             self._entries[key] = _Entry(engine, nbytes)
             self._entries.move_to_end(key)
             total = sum(e.nbytes for e in self._entries.values())

@@ -246,6 +246,14 @@ class IncrementalWindowMiner:
     def push(self, batch: SequenceDB) -> List[PatternResult]:
         with self._lock:
             t0 = time.monotonic()
+            # the per-batch state below is keyed by object identity, and
+            # each _BatchTokens pins its batch (no id reuse while live) —
+            # but a caller pushing the SAME list object twice would
+            # collapse two window entries onto one state and undercount
+            # supports.  A shallow copy makes every window entry a
+            # distinct object (and freezes the content this push counted
+            # against later caller mutation).
+            batch = list(batch)
             self.window.push(batch)
             live = self.window.batches()
             live_ids = {id(b) for b in live}

@@ -149,3 +149,20 @@ def test_single_sequence_batches_and_empty_f1():
     wm.push(parse_spmf("1 -2\n1 -2\n1 -2\n1 -2\n1 -2\n"))
     _assert_parity(wm)
     assert wm.patterns == [(((1,),), 6)]
+
+
+def test_duplicate_batch_object_pushed_twice():
+    # pushing the SAME list object twice must count as two window
+    # entries (the miner copies on push; identity-keyed state would
+    # otherwise collapse them and undercount supports)
+    batch = _batches(23, 1, 50)[0]
+    wm = IncrementalWindowMiner(0.3, max_batches=3)
+    wm.push(batch)
+    _assert_parity(wm)
+    wm.push(batch)  # same object again
+    assert wm.window.n_sequences == 2 * len(batch)
+    _assert_parity(wm)
+    # and the counted content is frozen against caller mutation
+    batch.clear()
+    wm.push(_batches(24, 1, 50)[0])
+    _assert_parity(wm)

@@ -147,11 +147,22 @@ def test_eligibility():
 
 
 def test_caps_for_budget_scale_with_memory():
+    from spark_fsm_tpu.models.spade_queue import working_set_bytes
+
     row = 80_000 * 4  # headline-ish single-word row
     small = QueueCaps.for_budget(row, 384, 1 << 30)
     big = QueueCaps.for_budget(row, 384, 8 << 30)
     assert big.ring > small.ring
-    assert small.ring >= 2048
+    # the sized caps actually FIT their budget (the one shared estimator
+    # for_budget and queue_eligible both use)
+    assert working_set_bytes(small, row, 384) <= 1 << 30
+    assert working_set_bytes(big, row, 384) <= 8 << 30
+    # and a budget too small for even the minimum ring still returns the
+    # least-memory geometry (an explicit fused="queue" pin allocates the
+    # smallest thing possible; queue_eligible refuses such workloads)
+    tiny = QueueCaps.for_budget(row, 384, 1 << 20)
+    assert tiny.ring == 256
+    assert working_set_bytes(tiny, row, 384) > 1 << 20
     # nb rows must tile the Pallas P_TILE
     from spark_fsm_tpu.ops import pallas_support as PS
     assert (2 * small.nb) % PS.P_TILE == 0
