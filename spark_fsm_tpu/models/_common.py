@@ -11,6 +11,25 @@ import functools
 from typing import Callable, List, Optional
 
 
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass
+class FrontierNode:
+    """DFS frontier node — the ONE shape `encode_frontier` serializes.
+    Shared by every SPADE engine (classic, constrained, queue) so their
+    snapshots interchange byte-for-byte: ``steps`` is the extension path
+    in dense item indices, ``slot`` the device bitmap slot (None =
+    rebuild on demand), ``s_list``/``i_list`` the surviving s-/i-
+    extension candidate items."""
+
+    steps: Tuple[Tuple[int, bool], ...]
+    slot: object
+    s_list: list
+    i_list: list
+
+
 def encode_frontier(fingerprint: dict, stack, results,
                     results_from: int = 0) -> dict:
     """JSON-able DFS snapshot shared by both SPADE engines (and persisted

@@ -24,7 +24,6 @@ typical clickstream data.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import time
 from collections import deque
@@ -38,9 +37,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, auto_pool_bytes, bucket_seq, decode_frontier, encode_frontier,
-    launch_width_cap, load_checkpoint, next_pow2, scatter_build_store,
-    zeros_fn)
+    FrontierNode, SlotPool, auto_pool_bytes, bucket_seq, decode_frontier,
+    encode_frontier, launch_width_cap, load_checkpoint, next_pow2,
+    scatter_build_store, zeros_fn)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
@@ -49,12 +48,9 @@ from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 Step = Tuple[int, bool]
 
 
-@dataclasses.dataclass
-class _Node:
-    steps: Tuple[Step, ...]
-    slot: Optional[int]
-    s_list: List[int]  # s-candidates: siblings when maxgap is None, else all roots
-    i_list: List[int]
+# the ONE frontier-node shape every engine snapshots (see _common);
+# here s_list holds siblings when maxgap is None, else all roots
+_Node = FrontierNode
 
 
 @functools.lru_cache(maxsize=64)

@@ -47,6 +47,7 @@ canonicalized on host.
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, Optional
 
 import jax
@@ -56,7 +57,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.vertical import VerticalDB
 from spark_fsm_tpu.models._common import (
-    bucket_seq, device_hbm_budget, next_pow2, scatter_build_store)
+    FrontierNode, bucket_seq, decode_frontier, device_hbm_budget,
+    encode_frontier, next_pow2, scatter_build_store)
 from spark_fsm_tpu.models.spade_fused import _dense_pair_jnp
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
@@ -218,13 +220,57 @@ def _queue_init_fn(mesh: Optional[Mesh], ring: int, ni: int, r_cap: int,
 
 
 @functools.lru_cache(maxsize=32)
+def _queue_refill_fn(mesh: Optional[Mesh], n_words: int,
+                     k_steps: int, m_nodes: int):
+    """Resume-time ring rebuild: fold each node's join chain from the
+    item rows (a pattern's bitmap IS the fold of its extension joins —
+    the classic engine's recompute-on-miss contract) and write it into
+    the node's ring slot.  ``items/iss/valid`` are [K, M] (M nodes, K
+    pow2-bucketed steps; rows past a node's chain carry valid=False and
+    leave the fold carry untouched); padded lanes' ``out_slot`` points
+    past the store and drops."""
+    W = n_words
+
+    def fill(store, items, iss, valid, out_slot):
+        b = store[items[0]].reshape(m_nodes, -1, W)
+
+        def body(c, xs):
+            it, s, v = xs
+            nb = B.join(c, store[it].reshape(c.shape), s)
+            return jnp.where(v[:, None, None], nb, c), None
+
+        b, _ = jax.lax.scan(body, b, (items[1:], iss[1:], valid[1:]))
+        return store.at[out_slot].set(
+            b.reshape(m_nodes, -1), mode="drop")
+
+    if mesh is None:
+        return jax.jit(fill)
+    st = P(None, SEQ_AXIS)
+    rep = P()
+    return jax.jit(jax.shard_map(
+        fill, mesh=mesh, in_specs=(st, rep, rep, rep, rep),
+        out_specs=st, check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
 def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
                    max_its: Optional[int],
                    nb: int, ring: int, c_cap: int, m_cap: int, r_cap: int,
                    i_max: int,
-                   use_pallas: bool, s_block: int, interpret: bool):
+                   use_pallas: bool, s_block: int, interpret: bool,
+                   seg: bool = False, donate: bool = False):
     """Compiled whole-mine program, cached per geometry.  ``minsup`` is a
     traced argument (streaming windows re-mine on one compile).
+
+    ``seg``: False compiles the whole-mine program (one dispatch, packed
+    records out).  True compiles the SEGMENTED variant for checkpointed
+    mines: run at most ``wave_budget`` waves (a TRACED argument — one
+    compile serves every segment size), return the full device carry plus
+    a small counter vector — the host loops segments, reading only the
+    counters between them, and snapshots the frontier at wave boundaries.
+    ``donate`` donates the carry arrays (segments >= 2, whose inputs are
+    the previous segment's outputs — the FIRST segment must not donate
+    the engine's persistent store).
 
     Store rows: [0, ni_pad) item id-lists (read-only — child writes index
     >= ni_pad by construction); [ni_pad, ni_pad + ring) the slot ring;
@@ -364,16 +410,57 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
             [counters[None, :],
              jnp.concatenate([out[9], out[10][:, None]], axis=1)], axis=0)
 
+    def run_seg(store, q_slot, q_smask, q_imask, q_nits, q_rec, head, tail,
+                rec_count, records, recsup, overflow, wave, minsup, n_cand,
+                wave_budget):
+        wave_end = wave + wave_budget
+
+        def body_seg(c):
+            return body(c[:15]) + (c[15],)
+
+        def cond_seg(c):
+            return cond(c[:15]) & (c[12] < c[15])
+
+        out = jax.lax.while_loop(
+            cond_seg, body_seg,
+            (store, q_slot, q_smask, q_imask, q_nits, q_rec, head, tail,
+             rec_count, records, recsup, overflow, wave, minsup, n_cand,
+             wave_end))
+        counters = jnp.stack([
+            out[8],                                   # rec_count
+            out[11].astype(jnp.int32),                # overflow
+            out[12],                                  # waves so far
+            out[14],                                  # candidates
+            (out[7] > out[6]).astype(jnp.int32),      # work pending
+            out[6],                                   # head
+            out[7],                                   # tail
+        ])
+        return out[:15], counters
+
+    if not seg:
+        if mesh is None:
+            return jax.jit(run)
+        st = P(None, SEQ_AXIS)
+        rep = P()
+        return jax.jit(
+            jax.shard_map(
+                run, mesh=mesh,
+                in_specs=(st, rep, rep, rep, rep, rep, rep, rep, rep, rep),
+                out_specs=rep,
+                check_vma=False))
+    donate_nums = (0, 1, 2, 3, 4, 5, 9, 10) if donate else ()
     if mesh is None:
-        return jax.jit(run)
+        return jax.jit(run_seg, donate_argnums=donate_nums)
     st = P(None, SEQ_AXIS)
     rep = P()
+    carry_specs = (st,) + (rep,) * 14
     return jax.jit(
         jax.shard_map(
-            run, mesh=mesh,
-            in_specs=(st, rep, rep, rep, rep, rep, rep, rep, rep, rep),
-            out_specs=rep,
-            check_vma=False))
+            run_seg, mesh=mesh,
+            in_specs=carry_specs + (rep,),
+            out_specs=(carry_specs, rep),
+            check_vma=False),
+        donate_argnums=donate_nums)
 
 
 class QueueSpadeTPU:
@@ -442,10 +529,100 @@ class QueueSpadeTPU:
         rows = self.ni_pad + self.caps.ring + 1
         return rows * self.n_seq * self.n_words * 4
 
-    def mine(self) -> Optional[List[PatternResult]]:
+    def mine(self, *, resume: Optional[dict] = None,
+             checkpoint_cb=None, checkpoint_every_s: float = 30.0,
+             seg_waves: int = 256) -> Optional[List[PatternResult]]:
+        """Run the queue-fused mine.  Without checkpoint plumbing this is
+        the ONE-dispatch/one-readback program (the headline path).  With
+        ``resume``/``checkpoint_cb`` (SURVEY.md sec 5 checkpoint row) the
+        mine runs in <= ``seg_waves``-wave segments: between segments the
+        host reads a 7-int counter vector, and at most every
+        ``checkpoint_every_s`` seconds snapshots the live frontier into
+        the classic engine's ``encode_frontier`` format — so a snapshot
+        taken here resumes in EITHER engine (e.g. the classic fallback
+        after a mid-mine cap overflow)."""
+        if resume is None and checkpoint_cb is None:
+            return self._mine_oneshot()
+        return self._mine_segmented(resume, checkpoint_cb,
+                                    checkpoint_every_s, seg_waves)
+
+    def frontier_fingerprint(self) -> dict:
+        """Identical dict to ``SpadeTPU.frontier_fingerprint`` — the two
+        engines enumerate identically, so their snapshots interchange
+        (a queue snapshot resumes in the classic engine and vice versa)."""
+        ids = self.vdb.item_ids
+        return {
+            "minsup": self.minsup,
+            "n_items": self.n_items,
+            "n_sequences": self.vdb.n_sequences,
+            "max_itemsets": self.max_its,
+            "item_ids_head": [int(i) for i in ids[:8]],
+            "item_ids_sum": int(ids.astype(np.int64).sum()),
+        }
+
+    def _roots(self) -> List[int]:
+        return [i for i in range(self.n_items)
+                if int(self.vdb.item_supports[i]) >= self.minsup]
+
+    def _root_init(self, roots: List[int]):
+        """Device-side queue init from the root level (shared by both
+        mine paths; uploads only ~KBs of root data + one counter)."""
+        cap, ni = self.caps, self.ni_pad
+        root_mask = np.zeros(ni, bool)
+        root_mask[roots] = True
+        root_ids = np.zeros(cap.ring, np.int32)
+        root_sups = np.zeros(cap.ring, np.int32)
+        for k, i in enumerate(roots):
+            root_ids[k] = i
+            root_sups[k] = int(self.vdb.item_supports[i])
+        n_roots_dev = self._put(np.int32(len(roots)))
+        q_state = _queue_init_fn(self.mesh, cap.ring, ni, cap.r_cap,
+                                 ni + cap.ring)(
+            self._put(root_ids), self._put(root_sups),
+            self._put(root_mask), n_roots_dev)
+        return q_state, n_roots_dev
+
+    def _root_carry(self, roots: List[int]):
+        """Fresh-mine init as the segmented carry tuple (the scalar
+        extras here are segmented-only — the one-shot hot path must not
+        pay their uploads)."""
+        (q_slot, q_smask, q_imask, q_nits, q_rec, records, recsup), \
+            n_roots_dev = self._root_init(roots)
+        z = self._put(np.int32(0))
+        return (self.store, q_slot, q_smask, q_imask, q_nits, q_rec,
+                z, n_roots_dev, n_roots_dev, records, recsup,
+                self._put(np.bool_(False)), self._put(np.int32(0)),
+                self._put(np.int32(self.minsup)), self._put(np.int32(0)))
+
+    def _decode_records(self, rec: np.ndarray, sup: np.ndarray, n_rec: int,
+                        want_steps: bool = False):
+        """Patterns (GLOBAL ids) from the packed parent-linked records;
+        optionally also each record's step chain in LOCAL indices (the
+        snapshot encoder needs both)."""
+        ids = self.vdb.item_ids
+        pats: List[Optional[tuple]] = [None] * n_rec
+        steps_of: List[Optional[tuple]] = [None] * n_rec
+        results: List[PatternResult] = []
+        for k in range(n_rec):
+            parent, item, iss = int(rec[k, 0]), int(rec[k, 1]), int(rec[k, 2])
+            it_id = int(ids[item])
+            if parent < 0:
+                pat = ((it_id,),)
+                steps = ((item, True),)
+            elif iss:
+                pat = pats[parent] + ((it_id,),)
+                steps = steps_of[parent] + ((item, True),)
+            else:
+                pat = pats[parent][:-1] + (pats[parent][-1] + (it_id,),)
+                steps = steps_of[parent] + ((item, False),)
+            pats[k] = pat
+            steps_of[k] = steps
+            results.append((pat, int(sup[k])))
+        return results, steps_of if want_steps else None
+
+    def _mine_oneshot(self) -> Optional[List[PatternResult]]:
         vdb, cap = self.vdb, self.caps
-        roots = [i for i in range(self.n_items)
-                 if int(vdb.item_supports[i]) >= self.minsup]
+        roots = self._roots()
         n_roots = len(roots)
         if n_roots == 0:
             return []
@@ -454,20 +631,8 @@ class QueueSpadeTPU:
             return None  # ring can't hold the root level: classic engine
 
         ni = self.ni_pad
-        root_mask = np.zeros(ni, bool)
-        root_mask[roots] = True
-        root_ids = np.zeros(cap.ring, np.int32)
-        root_sups = np.zeros(cap.ring, np.int32)
-        for k, i in enumerate(roots):
-            root_ids[k] = i
-            root_sups[k] = int(vdb.item_supports[i])
-        n_roots_dev = self._put(np.int32(n_roots))
-        q_slot, q_smask, q_imask, q_nits, q_rec, records, recsup = (
-            _queue_init_fn(self.mesh, cap.ring, ni, cap.r_cap,
-                           ni + cap.ring)(
-                self._put(root_ids), self._put(root_sups),
-                self._put(root_mask), n_roots_dev))
-
+        (q_slot, q_smask, q_imask, q_nits, q_rec, records, recsup), \
+            n_roots_dev = self._root_init(roots)
         fn = _queue_mine_fn(
             self.mesh, self.n_words, ni, self.max_its,
             cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
@@ -501,20 +666,200 @@ class QueueSpadeTPU:
             n_fetch = min(cap.r_cap, next_pow2(n_rec))
             packed = np.asarray(packed_dev[1:1 + n_fetch])
         rec, sup = packed[:, :3], packed[:, 3]
-
-        ids = vdb.item_ids
-        pats: List[Optional[tuple]] = [None] * n_rec
-        results: List[PatternResult] = []
-        for k in range(n_rec):
-            parent, item, iss = int(rec[k, 0]), int(rec[k, 1]), int(rec[k, 2])
-            it_id = int(ids[item])
-            if parent < 0:
-                pat = ((it_id,),)
-            elif iss:
-                pat = pats[parent] + ((it_id,),)
-            else:
-                pat = pats[parent][:-1] + (pats[parent][-1] + (it_id,),)
-            pats[k] = pat
-            results.append((pat, int(sup[k])))
+        results, _ = self._decode_records(rec, sup, n_rec)
         self.stats["patterns"] = len(results)
         return sort_patterns(results)
+
+    # ------------------------------------------------ checkpointed path
+
+    def _mine_segmented(self, resume, checkpoint_cb, every_s: float,
+                        seg_waves: int) -> Optional[List[PatternResult]]:
+        cap, ni = self.caps, self.ni_pad
+        if resume is not None:
+            results, nodes = decode_frontier(
+                resume, self.frontier_fingerprint(), FrontierNode)
+            self.stats["resumed_nodes"] = len(nodes)
+            if not nodes:
+                self.stats["patterns"] = len(results)
+                return sort_patterns(results)
+            carry = self._resume_carry(results, nodes)
+            if carry is None:
+                self.stats["fused_overflow"] = True
+                return None
+            ckpt_done = len(results)
+        else:
+            roots = self._roots()
+            if not roots:
+                return []
+            if len(roots) > min(cap.ring, cap.r_cap):
+                self.stats["fused_overflow"] = True
+                return None
+            carry = self._root_carry(roots)
+            ckpt_done = 0
+        mkw = (self.mesh, self.n_words, ni, self.max_its,
+               cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
+               self.use_pallas, self._s_block, self._interpret, True)
+        fn_first = _queue_mine_fn(*mkw, False)
+        fn_next = _queue_mine_fn(*mkw, True)
+        last_ckpt = time.monotonic()
+        first = True
+        # geometric wave-budget growth: fine-grained early boundaries (a
+        # checkpoint=1 job writes its first snapshot after wave 1, even
+        # for mines that finish inside one interval), coarse later so a
+        # long mine pays ~log + wall/interval counter readbacks, not one
+        # per wave.  One compiled program serves every budget (traced).
+        budget = 1 if checkpoint_cb is not None else seg_waves
+        while True:
+            carry, counters_dev = (fn_first if first else fn_next)(
+                *carry, self._put(np.int32(budget)))
+            budget = min(seg_waves, budget * 4)
+            first = False
+            self.stats["kernel_launches"] = (
+                self.stats.get("kernel_launches", 0) + 1)
+            counters = np.asarray(counters_dev)
+            n_rec, oflow, waves, n_cand, pending, head, tail = (
+                int(x) for x in counters)
+            if oflow or (pending and waves >= cap.i_max):
+                self.stats["fused_overflow"] = True
+                self.stats["waves"] = waves
+                return None  # classic fallback resumes from the last save
+            if not pending:
+                break
+            if (checkpoint_cb is not None
+                    and time.monotonic() - last_ckpt >= every_s):
+                checkpoint_cb(
+                    self._snapshot(carry, head, tail, n_rec, ckpt_done))
+                ckpt_done = n_rec
+                self.stats["checkpoints"] = (
+                    self.stats.get("checkpoints", 0) + 1)
+                last_ckpt = time.monotonic()
+        self.stats["waves"] = waves
+        self.stats["candidates"] = n_cand
+        rec = np.asarray(carry[9][:max(n_rec, 1)])[:n_rec]
+        sup = np.asarray(carry[10][:max(n_rec, 1)])[:n_rec]
+        results, _ = self._decode_records(rec, sup, n_rec)
+        self.stats["patterns"] = len(results)
+        return sort_patterns(results)
+
+    def _snapshot(self, carry, head: int, tail: int, n_rec: int,
+                  ckpt_done: int) -> dict:
+        """Wave-boundary frontier snapshot in the classic engine's
+        format: live ring entries become stack nodes (their candidate
+        masks ARE the s/i candidate lists), records become results.
+        Cost: one readback of the two candidate masks + the record
+        buffer — never the ring bitmaps, which are rebuilt by join-chain
+        fold on resume."""
+        cap = self.caps
+        q_smask = np.asarray(carry[2])
+        q_imask = np.asarray(carry[3])
+        q_rec = np.asarray(carry[5])
+        rec = np.asarray(carry[9][:max(n_rec, 1)])[:n_rec]
+        sup = np.asarray(carry[10][:max(n_rec, 1)])[:n_rec]
+        results, steps_of = self._decode_records(rec, sup, n_rec,
+                                                 want_steps=True)
+        nodes = []
+        nim = self.n_items
+        for qid in range(head, tail):
+            ridx = qid % cap.ring
+            steps = steps_of[int(q_rec[ridx])]
+            s_list = np.nonzero(q_smask[ridx][:nim])[0]
+            i_list = np.nonzero(q_imask[ridx][:nim])[0]
+            nodes.append(FrontierNode(steps, None,
+                                [int(x) for x in s_list],
+                                [int(x) for x in i_list]))
+        return encode_frontier(self.frontier_fingerprint(), nodes, results,
+                               ckpt_done)
+
+    def _resume_carry(self, results, nodes):
+        """Rebuild the device state a snapshot describes: re-upload the
+        parent-linked records (reconstructed from the result patterns),
+        the candidate masks, and the queue bookkeeping; recompute the
+        live ring BITMAPS on device by folding each node's join chain
+        from the item rows.  Returns None when the snapshot does not fit
+        this engine's caps (the caller falls back to the classic engine,
+        which resumes the same snapshot)."""
+        vdb, cap, ni = self.vdb, self.caps, self.ni_pad
+        ring = cap.ring
+        scratch = ni + ring
+        n_live = len(nodes)
+        if n_live > min(ring, cap.r_cap) or len(results) > cap.r_cap:
+            return None
+        ids = vdb.item_ids
+        g2l = {int(g): l for l, g in enumerate(ids)}
+        rec_np = np.zeros((cap.r_cap, 3), np.int32)
+        sup_np = np.zeros(cap.r_cap, np.int32)
+        idx_of: dict = {}
+        for k, (pat, s) in enumerate(results):
+            # the last step is removable from the canonical pattern:
+            # i-extensions only ever add items LARGER than the itemset's
+            # current max, so the last itemset's last (max) item is the
+            # most recent extension
+            last = pat[-1]
+            if len(last) == 1:
+                ppat, g, iss = pat[:-1], last[0], 1
+            else:
+                ppat, g, iss = pat[:-1] + (last[:-1],), last[-1], 0
+            loc = g2l.get(int(g))
+            if loc is None:
+                return None  # projection drift the fingerprint missed
+            if ppat:
+                parent = idx_of.get(ppat)
+                if parent is None:
+                    return None  # malformed snapshot: orphan pattern
+            else:
+                parent = -1
+            rec_np[k] = (parent, loc, iss)
+            sup_np[k] = int(s)
+            idx_of[pat] = k
+
+        def pattern_of_steps(steps):
+            pat: List[List[int]] = []
+            for it, s in steps:
+                if s:
+                    pat.append([int(ids[it])])
+                else:
+                    pat[-1].append(int(ids[it]))
+            return tuple(tuple(p) for p in pat)
+
+        q_slot_np = np.full(ring, scratch, np.int32)
+        q_smask_np = np.zeros((ring, ni), bool)
+        q_imask_np = np.zeros((ring, ni), bool)
+        q_nits_np = np.ones(ring, np.int32)
+        q_rec_np = np.zeros(ring, np.int32)
+        K = next_pow2(max(2, max(len(n.steps) for n in nodes)))
+        M = next_pow2(max(8, n_live))
+        items = np.zeros((K, M), np.int32)
+        iss_a = np.zeros((K, M), bool)
+        valid = np.zeros((K, M), bool)
+        out_slot = np.full(M, scratch + 1, np.int32)  # pad lanes drop
+        for k, node in enumerate(nodes):
+            r = idx_of.get(pattern_of_steps(node.steps))
+            if r is None:
+                return None  # node without its own record: malformed
+            q_rec_np[k] = r
+            q_slot_np[k] = ni + k
+            for j in node.s_list:
+                if 0 <= j < ni:
+                    q_smask_np[k, j] = True
+            for j in node.i_list:
+                if 0 <= j < ni:
+                    q_imask_np[k, j] = True
+            q_nits_np[k] = sum(1 for _, s in node.steps if s)
+            for d, (it, s) in enumerate(node.steps):
+                if not 0 <= it < self.n_items:
+                    return None
+                items[d, k] = it
+                iss_a[d, k] = s
+                valid[d, k] = True
+            out_slot[k] = ni + k
+        store = _queue_refill_fn(self.mesh, self.n_words, K, M)(
+            self.store, self._put(items), self._put(iss_a),
+            self._put(valid), self._put(out_slot))
+        return (store, self._put(q_slot_np), self._put(q_smask_np),
+                self._put(q_imask_np), self._put(q_nits_np),
+                self._put(q_rec_np), self._put(np.int32(0)),
+                self._put(np.int32(n_live)),
+                self._put(np.int32(len(results))),
+                self._put(rec_np), self._put(sup_np),
+                self._put(np.bool_(False)), self._put(np.int32(0)),
+                self._put(np.int32(self.minsup)), self._put(np.int32(0)))

@@ -38,7 +38,6 @@ construction; supports are exact integers from popcounts.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import time
 from collections import deque
@@ -52,8 +51,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    SlotPool, auto_pool_bytes, bucket_seq, decode_frontier, encode_frontier,
-    launch_width_cap, load_checkpoint, next_pow2, scatter_build_store)
+    FrontierNode, SlotPool, auto_pool_bytes, bucket_seq, decode_frontier,
+    encode_frontier, launch_width_cap, load_checkpoint, next_pow2,
+    scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel import multihost as MH
@@ -63,12 +63,8 @@ from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 Step = Tuple[int, bool]  # (item index, is_s_extension)
 
 
-@dataclasses.dataclass
-class _Node:
-    steps: Tuple[Step, ...]
-    slot: Optional[int]
-    s_list: List[int]
-    i_list: List[int]
+# the ONE frontier-node shape every engine snapshots (see _common)
+_Node = FrontierNode
 
 
 @functools.lru_cache(maxsize=64)
@@ -730,9 +726,12 @@ def mine_spade_tpu(
     engine transparently.  "never" pins the classic engine, "queue" /
     "dense" pin one fused engine (still falling back on overflow),
     "always" tries queue then dense regardless of the size heuristics.
-    A checkpointed job always uses the classic engine (the fused ones
-    have no resumable frontier); when that overrides a fused mode,
-    ``stats_out`` gets ``fused_skipped="checkpoint"``.
+    A checkpointed job routes through the queue engine too (it runs in
+    wave segments and snapshots the frontier in the classic engine's
+    format, so the two engines resume each other's checkpoints); only
+    the dense engine has no resumable frontier — a pinned "dense" with a
+    checkpoint degrades to the classic engine with ``stats_out``
+    ``fused_skipped="checkpoint"``.
     """
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
@@ -740,22 +739,20 @@ def mine_spade_tpu(
     if fused not in ("auto", "always", "never", "queue", "dense"):
         raise ValueError(f"fused must be 'auto', 'always', 'never', "
                          f"'queue' or 'dense', got {fused!r}")
-    if fused != "never" and checkpoint is not None and stats_out is not None:
-        # the fused engines have no resumable frontier; a checkpointed job
-        # degrades to the classic engine (flagged, not fatal — matching
-        # the service's checkpoint-unsupported convention)
-        stats_out["fused_skipped"] = "checkpoint"
     shape_buckets = kwargs.get("shape_buckets", False)
     ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
                use_pallas=kwargs.get("use_pallas", "auto"),
                shape_buckets=shape_buckets)
-    if checkpoint is None and fused in ("auto", "always", "queue"):
+    if fused in ("auto", "always", "queue"):
         from spark_fsm_tpu.models.spade_queue import (
             QueueSpadeTPU, queue_eligible)
         if fused in ("always", "queue") or queue_eligible(
                 vdb, mesh=mesh, shape_buckets=shape_buckets):
             qeng = QueueSpadeTPU(vdb, minsup_abs, **ekw)
-            res = qeng.mine()
+            q_resume, q_save, q_every = load_checkpoint(
+                checkpoint, qeng.frontier_fingerprint())
+            res = qeng.mine(resume=q_resume, checkpoint_cb=q_save,
+                            checkpoint_every_s=q_every)
             if res is not None:
                 if stats_out is not None:
                     stats_out.update(qeng.stats)
@@ -764,10 +761,23 @@ def mine_spade_tpu(
             # "always"), keeping the overflow marker visible so
             # steady-state callers (e.g. streaming windows that overflow
             # every push) can detect the doubled work and pin
-            # fused="never"
+            # fused="never".  A checkpointed mine's classic fallback
+            # resumes from the queue engine's last snapshot — shared
+            # frontier format, same fingerprint.
             if stats_out is not None:
                 stats_out["fused_overflow"] = True
                 stats_out["fused_waves"] = qeng.stats.get("waves", 0)
+    if checkpoint is not None and fused in ("always", "dense", "auto"):
+        # the dense engine alone has no resumable frontier; a checkpointed
+        # job that would otherwise have used it (pinned, or auto with the
+        # queue route unavailable but dense eligible) degrades to the
+        # classic engine — flagged, not fatal (the service's
+        # checkpoint-unsupported convention)
+        if stats_out is not None:
+            from spark_fsm_tpu.models.spade_fused import fused_eligible
+            if fused in ("always", "dense") or fused_eligible(
+                    vdb, mesh=mesh, shape_buckets=shape_buckets):
+                stats_out["fused_skipped"] = "checkpoint"
     if checkpoint is None and fused in ("always", "dense", "auto"):
         # dense engine: pinned, or "auto"/"always"'s second try — reached
         # when the queue engine was ineligible OR overflowed its caps

@@ -262,6 +262,11 @@ def test_service_checkpoint_plumbing():
         assert seen["frontier"], "no frontier snapshot was ever written"
         assert store.get(f"fsm:frontier:{uid}") is None  # cleared at end
         assert store.patterns(uid) is not None
+        # the checkpointed job kept the default (queue) engine — the
+        # fused_skipped="checkpoint" degradation is gone (VERDICT r4 #3)
+        stats = json.loads(store.get(f"fsm:stats:{uid}") or "{}")
+        assert stats.get("fused") == "queue"
+        assert "fused_skipped" not in stats
     finally:
         master.shutdown()
 
@@ -405,3 +410,135 @@ def test_tsr_service_checkpoint_plumbing():
         assert "checkpoint_unsupported" not in stats
     finally:
         master.shutdown()
+
+
+def _queue_caps():
+    # small waves so the geometric segment schedule yields several
+    # boundaries on this 240-sequence db (default nb=512 would finish
+    # the whole mine in ~2 waves)
+    from spark_fsm_tpu.models.spade_queue import QueueCaps
+    return QueueCaps(nb=32, ring=2048, c_cap=512, m_cap=512)
+
+
+def test_queue_crash_resume_parity():
+    """Kill a checkpointed QUEUE mine mid-run; a fresh queue engine
+    resuming the last snapshot must produce the exact full pattern set
+    (VERDICT r4 #3: the default engine is resumable — no more
+    fused_skipped="checkpoint" degradation)."""
+    from spark_fsm_tpu.models.spade_queue import QueueSpadeTPU
+
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+
+    class Crash(Exception):
+        pass
+
+    saved, merged = [], []
+
+    def cb(state):
+        assert state["results_done"] == len(merged)
+        merged.extend(state["results"])
+        saved.append(state)
+        if len(saved) == 2:
+            raise Crash
+
+    eng = QueueSpadeTPU(build_vertical(db, min_item_support=minsup),
+                        minsup, caps=_queue_caps())
+    with pytest.raises(Crash):
+        eng.mine(checkpoint_cb=cb, checkpoint_every_s=0.0, seg_waves=1)
+    state = json.loads(json.dumps({**saved[-1], "results": list(merged)}))
+    assert state["stack"], "crash happened after the frontier emptied"
+
+    eng2 = QueueSpadeTPU(build_vertical(db, min_item_support=minsup),
+                         minsup, caps=_queue_caps())
+    got = eng2.mine(resume=state)
+    assert eng2.stats["resumed_nodes"] == len(state["stack"])
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+def test_queue_classic_snapshots_interchange():
+    """The queue engine writes snapshots in the classic engine's format
+    with the same fingerprint, so each engine resumes the other's — the
+    contract that lets a mid-mine cap overflow fall from queue to classic
+    WITHOUT restarting the mine."""
+    from spark_fsm_tpu.models.spade_queue import QueueSpadeTPU
+
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    want = mine_spade(db, minsup)
+
+    class Crash(Exception):
+        pass
+
+    # queue snapshot -> classic resume
+    saved, merged = [], []
+
+    def cb(state):
+        merged.extend(state["results"])
+        saved.append(state)
+        if len(saved) == 2:
+            raise Crash
+
+    qeng = QueueSpadeTPU(build_vertical(db, min_item_support=minsup),
+                         minsup, caps=_queue_caps())
+    assert (qeng.frontier_fingerprint()
+            == SpadeTPU(build_vertical(db, min_item_support=minsup),
+                        minsup).frontier_fingerprint())
+    with pytest.raises(Crash):
+        qeng.mine(checkpoint_cb=cb, checkpoint_every_s=0.0, seg_waves=1)
+    state = json.loads(json.dumps({**saved[-1], "results": list(merged)}))
+    assert state["stack"]
+    ceng = SpadeTPU(build_vertical(db, min_item_support=minsup), minsup,
+                    pool_bytes=32 << 20)
+    got = ceng.mine(resume=state)
+    assert patterns_text(got) == patterns_text(want)
+
+    # classic snapshot -> queue resume
+    saved2, merged2 = [], []
+
+    def cb2(state):
+        merged2.extend(state["results"])
+        saved2.append(state)
+        if len(saved2) == 2:
+            raise Crash
+
+    ceng2 = SpadeTPU(build_vertical(db, min_item_support=minsup), minsup,
+                     node_batch=4, pipeline_depth=2, pool_bytes=32 << 20)
+    with pytest.raises(Crash):
+        ceng2.mine(checkpoint_cb=cb2, checkpoint_every_s=0.0)
+    state2 = json.loads(json.dumps({**saved2[-1], "results": list(merged2)}))
+    assert state2["stack"]
+    qeng2 = QueueSpadeTPU(build_vertical(db, min_item_support=minsup),
+                          minsup, caps=_queue_caps())
+    got2 = qeng2.mine(resume=state2)
+    assert qeng2.stats["resumed_nodes"] == len(state2["stack"])
+    assert patterns_text(got2) == patterns_text(want)
+
+
+def test_checkpointed_wrapper_routes_queue():
+    """mine_spade_tpu with a checkpoint keeps the queue route (stats
+    prove it) instead of degrading to the classic engine."""
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+
+    class Ckpt:
+        every_s = 0.0
+
+        def __init__(self):
+            self.saves = []
+
+        def load(self):
+            return None
+
+        def save(self, state):
+            self.saves.append(state)
+
+    ck = Ckpt()
+    stats = {}
+    got = mine_spade_tpu(db, minsup, checkpoint=ck, stats_out=stats)
+    assert stats.get("fused") == "queue"
+    assert "fused_skipped" not in stats
+    assert ck.saves, "no snapshot written despite every_s=0"
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want)
