@@ -84,7 +84,7 @@ def load_checkpoint(checkpoint, fingerprint: dict):
 
 @functools.lru_cache(maxsize=128)
 def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh,
-                   flat: bool = False):
+                   flat: bool = False, remap: bool = False):
     """Cached jitted store-build kernel.  ``jax.jit`` caches traces per
     wrapped-function OBJECT, so handing it a fresh closure per engine
     construction recompiles the scatter build every time — and the service
@@ -97,6 +97,11 @@ def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh,
     (measured: a 6.7 GB temp per prep on the headline workload); the flat
     layout crosses jit boundaries copy-free and bodies reshape it back to
     [rows, S, W] internally for the word-wise bit ops.
+
+    ``remap=True`` (streaming's drifting-projection variant) adds a fifth
+    input mapping each token's dense item index -> store row; unneeded
+    items point out of bounds and drop, so ONE compiled program serves
+    every push's projection.
     """
     import jax
     import jax.numpy as jnp
@@ -104,34 +109,39 @@ def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh,
 
     from spark_fsm_tpu.parallel.mesh import SEQ_AXIS
 
+    kw = {"mode": "drop"} if remap else {}
+
     if mesh is None:
-        def init_store(ti, ts, tw, tm):
+        def init_store(ti, ts, tw, tm, *rm):
+            row = rm[0][ti] if remap else ti
             if flat:
                 z = jnp.zeros((n_rows, n_seq * n_words), jnp.uint32)
-                return z.at[ti, ts * n_words + tw].add(tm)
+                return z.at[row, ts * n_words + tw].add(tm, **kw)
             z = jnp.zeros((n_rows, n_seq, n_words), jnp.uint32)
-            return z.at[ti, ts, tw].add(tm)  # distinct bits: add == OR
+            return z.at[row, ts, tw].add(tm, **kw)  # distinct bits: add == OR
 
         return jax.jit(init_store)
 
     shard = n_seq // mesh.devices.size
 
-    def init_store_shard(ti, ts, tw, tm):
+    def init_store_shard(ti, ts, tw, tm, *rm):
+        row = rm[0][ti] if remap else ti
         ls = ts - jax.lax.axis_index(SEQ_AXIS) * shard
         ok = (ls >= 0) & (ls < shard)
         lc = jnp.clip(ls, 0, shard - 1)
         tm_ok = jnp.where(ok, tm, jnp.uint32(0))
         if flat:
             z = jnp.zeros((n_rows, shard * n_words), jnp.uint32)
-            return z.at[ti, lc * n_words + tw].add(tm_ok)
+            return z.at[row, lc * n_words + tw].add(tm_ok, **kw)
         z = jnp.zeros((n_rows, shard, n_words), jnp.uint32)
-        return z.at[ti, lc, tw].add(tm_ok)
+        return z.at[row, lc, tw].add(tm_ok, **kw)
 
     rep = P()
     out = P(None, SEQ_AXIS) if flat else P(None, SEQ_AXIS, None)
+    n_in = 5 if remap else 4
     return jax.jit(jax.shard_map(
         init_store_shard, mesh=mesh,
-        in_specs=(rep, rep, rep, rep), out_specs=out))
+        in_specs=(rep,) * n_in, out_specs=out))
 
 
 def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,

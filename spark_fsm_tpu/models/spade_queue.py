@@ -608,15 +608,14 @@ class QueueSpadeTPU:
             it_id = int(ids[item])
             if parent < 0:
                 pat = ((it_id,),)
-                steps = ((item, True),)
             elif iss:
                 pat = pats[parent] + ((it_id,),)
-                steps = steps_of[parent] + ((item, True),)
             else:
                 pat = pats[parent][:-1] + (pats[parent][-1] + (it_id,),)
-                steps = steps_of[parent] + ((item, False),)
             pats[k] = pat
-            steps_of[k] = steps
+            if want_steps:  # snapshot-only lineage; skip on the hot path
+                steps_of[k] = (((item, True),) if parent < 0
+                               else steps_of[parent] + ((item, bool(iss)),))
             results.append((pat, int(sup[k])))
         return results, steps_of if want_steps else None
 

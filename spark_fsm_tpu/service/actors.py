@@ -483,8 +483,10 @@ class Streamer:
 
         # Streaming route: true incremental mining (count the arriving
         # batch + border repair — streaming/incremental.py) is the
-        # default for plain single-device SPADE_TPU windows; everything
-        # else (TSR, constraints, CPU oracle, mesh) re-mines the window
+        # default for plain SPADE_TPU windows, single-device OR meshed
+        # (the incremental miner shards each batch store's sequence
+        # axis, SURVEY sec 2.2 x 2.5); everything else (TSR,
+        # constraints, CPU oracle) re-mines the window
         # (streaming/window.py, the SURVEY sec 7 fallback).
         # ``incremental=0`` pins the re-mine path.
         algo = (data.get("algorithm") or "SPADE_TPU").upper()
@@ -496,13 +498,13 @@ class Streamer:
                    and algo == "SPADE_TPU"
                    and base.param("maxgap") is None
                    and base.param("maxwindow") is None
-                   and config.get_mesh() is None
                    and inc_param not in ("", "0", "false", "no", "off"))
         if use_inc:
             from spark_fsm_tpu.streaming.incremental import \
                 IncrementalWindowMiner
             miner = IncrementalWindowMiner(support, max_batches=mb,
-                                           max_sequences=ms)
+                                           max_sequences=ms,
+                                           mesh=config.get_mesh())
         else:
             miner = WindowMiner(support, max_batches=mb, max_sequences=ms,
                                 mine=plugin_mine)

@@ -35,8 +35,12 @@ the classic engine's ``_resolve`` — so after every push the frequent set
 and its supports are **byte-identical to a fresh mine of the window**
 (the determinism contract of streaming/window.py, tested per push).
 
-Scope: single-device, plain SPADE (no maxgap/maxwindow, no
-max_pattern_itemsets — the service routes those to the re-mine path).
+Scope: plain SPADE (no maxgap/maxwindow, no max_pattern_itemsets — the
+service routes those to the re-mine path).  With a ``mesh``, every batch
+store's sequence axis shards over the devices exactly like the batch
+engines' (``shard_map`` sweep/fold kernels, ``psum`` partial supports
+over ICI before the host prune — SURVEY.md sec 2.2), so streaming and
+partitioning compose the way the reference's Spark streaming does.
 """
 
 from __future__ import annotations
@@ -49,6 +53,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
@@ -56,7 +61,8 @@ from spark_fsm_tpu.models._common import bucket_seq, next_pow2
 from spark_fsm_tpu.models.spade_tpu import _spade_fns
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
-from spark_fsm_tpu.parallel.mesh import pad_to_multiple
+from spark_fsm_tpu.parallel import multihost as MH
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
 from spark_fsm_tpu.streaming.window import SlidingWindow
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
 
@@ -79,28 +85,28 @@ class _TNode:
         self.total = 0
 
 
-@functools.lru_cache(maxsize=64)
-def _inc_store_builder(n_rows: int, n_seq: int, n_words: int):
-    """Scatter-build a batch bitmap store from device-resident tokens.
-    ``remap`` maps the batch's dense item index -> store row for items
-    the current frequent projection needs; unneeded items point out of
-    bounds and drop (mode="drop"), so one cached program serves every
-    push's drifting projection."""
+def _inc_store_builder(n_rows: int, n_seq: int, n_words: int,
+                       mesh: Optional[Mesh] = None):
+    """Batch bitmap store scatter from device-resident tokens — the
+    engines' shared ``_store_builder`` in its flat + remap form: the
+    fifth input maps the batch's dense item index -> store row for items
+    the current frequent projection needs (unneeded items drop), so one
+    cached program serves every push's drifting projection; with a mesh
+    each device scatters only its sequence-axis shard."""
+    from spark_fsm_tpu.models._common import _store_builder
 
-    def build(ti, ts, tw, tm, remap):
-        z = jnp.zeros((n_rows, n_seq * n_words), jnp.uint32)
-        return z.at[remap[ti], ts * n_words + tw].add(tm, mode="drop")
-
-    return jax.jit(build)
+    return _store_builder(n_rows, n_seq, n_words, mesh, flat=True,
+                          remap=True)
 
 
 @functools.lru_cache(maxsize=32)
-def _fold_supports_fn(n_words: int):
+def _fold_supports_fn(n_words: int, mesh: Optional[Mesh] = None):
     """Border-repair evaluator: fold a candidate pattern's join chain
     from the item rows (the classic engine's recompute_body without the
     store write — repair needs supports, not bitmaps) and popcount.
     ``items/iss/valid`` are [K, M]: M candidates, K pow2-bucketed steps;
-    padded rows carry valid=False and leave the carry untouched."""
+    padded rows carry valid=False and leave the carry untouched.  With a
+    mesh, per-shard partial supports ``psum`` over ICI."""
     W = n_words
 
     def run(store, items, iss, valid):
@@ -113,9 +119,17 @@ def _fold_supports_fn(n_words: int):
             return jnp.where(v[:, None, None], nb, carry), None
 
         b, _ = jax.lax.scan(body, b, (items[1:], iss[1:], valid[1:]))
-        return B.support(b)
+        part = B.support(b)
+        if mesh is not None:
+            part = jax.lax.psum(part, SEQ_AXIS)
+        return part
 
-    return jax.jit(run)
+    if mesh is None:
+        return jax.jit(run)
+    st = P(None, SEQ_AXIS)
+    rep = P()
+    return jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(st, rep, rep, rep), out_specs=rep))
 
 
 class _BatchTokens:
@@ -125,9 +139,12 @@ class _BatchTokens:
     demand (one on-device scatter) — the dense store never crosses the
     link and old batches hold no HBM beyond their tokens."""
 
-    def __init__(self, bid: int, db: SequenceDB, use_pallas: bool):
+    def __init__(self, bid: int, db: SequenceDB, use_pallas: bool,
+                 mesh: Optional[Mesh] = None, put=jnp.asarray):
         self.bid = bid
         self.db = db
+        self.mesh = mesh
+        self._put = put
         vdb = build_vertical(db, min_item_support=1)
         self.item_ids = vdb.item_ids                      # ascending
         self.item_counts: Dict[int, int] = {
@@ -135,18 +152,22 @@ class _BatchTokens:
             for i, s in zip(vdb.item_ids, vdb.item_supports)}
         self.n_local = vdb.n_items
         # pow2-bucket both device axes so drifting batch geometry lands
-        # on a handful of compiled programs (the shape_buckets policy)
+        # on a handful of compiled programs (the shape_buckets policy);
+        # under a mesh the bucketed axis must also split evenly across
+        # devices (and per-shard stay a Pallas s_block multiple)
         self.n_words = next_pow2(vdb.n_words)
+        n_shards = 1 if mesh is None else mesh.devices.size
+        seq_bucket = bucket_seq(vdb.n_sequences)
         s_block = (min(PS.seq_block(self.n_words),
-                       pad_to_multiple(bucket_seq(vdb.n_sequences), 128))
+                       pad_to_multiple(-(-seq_bucket // n_shards), 128))
                    if use_pallas else 1)
         self.s_block = s_block
-        self.n_seq = pad_to_multiple(bucket_seq(vdb.n_sequences),
-                                     max(1, s_block))
-        self.ti = jnp.asarray(vdb.tok_item)
-        self.ts = jnp.asarray(vdb.tok_seq)
-        self.tw = jnp.asarray(vdb.tok_word)
-        self.tm = jnp.asarray(vdb.tok_mask)
+        self.n_seq = pad_to_multiple(seq_bucket,
+                                     max(1, n_shards * s_block))
+        self.ti = put(vdb.tok_item)
+        self.ts = put(vdb.tok_seq)
+        self.tw = put(vdb.tok_word)
+        self.tm = put(vdb.tok_mask)
         # projection-dependent state, set by _project and CACHED across
         # pushes while the frequent projection holds still (steady-state
         # repair then skips every store rebuild):
@@ -173,8 +194,9 @@ class _BatchTokens:
         remap = np.full(max(self.n_local, 1), n_rows + 1, np.int32)
         idx = np.searchsorted(self.item_ids, present)
         remap[idx] = np.arange(len(present), dtype=np.int32)
-        self.store = _inc_store_builder(n_rows, self.n_seq, self.n_words)(
-            self.ti, self.ts, self.tw, self.tm, jnp.asarray(remap))
+        self.store = _inc_store_builder(
+            n_rows, self.n_seq, self.n_words, self.mesh)(
+            self.ti, self.ts, self.tw, self.tm, self._put(remap))
         self.items_t = None
         self._proj_key = key
         self._n_rows = n_rows
@@ -203,12 +225,15 @@ class IncrementalWindowMiner:
     def __init__(self, min_support: float, *,
                  max_batches: Optional[int] = None,
                  max_sequences: Optional[int] = None,
+                 mesh: Optional[Mesh] = None,
                  use_pallas="auto",
                  repair_chunk: int = 256,
                  support_chunk: int = 2048) -> None:
         self.min_support = float(min_support)
         self.window = SlidingWindow(max_batches=max_batches,
                                     max_sequences=max_sequences)
+        self.mesh = mesh
+        self._put = functools.partial(MH.host_to_device, mesh)
         if use_pallas == "auto":
             self.use_pallas = jax.default_backend() == "tpu"
         else:
@@ -282,7 +307,8 @@ class IncrementalWindowMiner:
             fresh: List[_BatchTokens] = []
             for b in live:
                 if id(b) not in self._states:
-                    st = _BatchTokens(self._next_bid, b, self.use_pallas)
+                    st = _BatchTokens(self._next_bid, b, self.use_pallas,
+                                      mesh=self.mesh, put=self._put)
                     self._next_bid += 1
                     self._states[id(b)] = st
                     fresh.append(st)
@@ -325,13 +351,20 @@ class IncrementalWindowMiner:
             # repair skips every rebuild) under a fraction of device
             # memory; beyond it, drop oldest-batch stores first
             from spark_fsm_tpu.models._common import device_hbm_budget
-            budget = 0.2 * device_hbm_budget(jax.devices()[0])
-            total = sum(st.store_bytes() for st in self._states.values())
+            dev = (self.mesh.devices.flat[0] if self.mesh is not None
+                   else jax.devices()[0])
+            budget = 0.2 * device_hbm_budget(dev)
+            # stores shard over the mesh's sequence axis, so the budget
+            # (per-device HBM) compares against PER-DEVICE bytes — the
+            # global figure would evict n_shards times too eagerly
+            n_sh = 1 if self.mesh is None else self.mesh.devices.size
+            total = sum(st.store_bytes() for st in self._states.values()
+                        ) // n_sh
             for b in live:  # oldest first
                 if total <= budget:
                     break
                 st = self._states[id(b)]
-                total -= st.store_bytes()
+                total -= st.store_bytes() // n_sh
                 st.drop_store()
             self.stats["store_cache_bytes"] = int(
                 sum(st.store_bytes() for st in self._states.values()))
@@ -376,10 +409,10 @@ class IncrementalWindowMiner:
         n_rows = st._project(f1, 2 * max(lcap, 1))
         region = [st.ni_rows, st.ni_rows + max(lcap, 1)]
         scratch = n_rows - 1
-        fns = _spade_fns(None, st.n_words)
+        fns = _spade_fns(self.mesh, st.n_words)
         if self.use_pallas and st.n_words > 1 and st.items_t is None:
             from spark_fsm_tpu.models.spade_tpu import _items_transpose
-            st.items_t = _items_transpose(None, st.ni_rows,
+            st.items_t = _items_transpose(self.mesh, st.ni_rows,
                                           st.n_words)(st.store)
 
         for node in lvl_nodes:
@@ -397,7 +430,7 @@ class IncrementalWindowMiner:
             slots = np.full(next_pow2(max(len(cur), 8)), scratch, np.int32)
             for i, (_, slot) in enumerate(cur):
                 slots[i] = slot
-            pt = fns["prep"](st.store, jnp.asarray(slots))
+            pt = fns["prep"](st.store, self._put(slots))
             self.stats["kernel_launches"] += 1
 
             refs: List[int] = []
@@ -446,11 +479,11 @@ class IncrementalWindowMiner:
                     # in the work regions
                     st.store = fns["materialize"](
                         pt, st.store,
-                        jnp.asarray(np.pad(mr[lo:hi], (0, pad))),
-                        jnp.asarray(np.pad(mi[lo:hi], (0, pad))),
-                        jnp.asarray(np.pad(ms[lo:hi], (0, pad))),
-                        jnp.asarray(np.pad(mo[lo:hi], (0, pad),
-                                           constant_values=scratch)))
+                        self._put(np.pad(mr[lo:hi], (0, pad))),
+                        self._put(np.pad(mi[lo:hi], (0, pad))),
+                        self._put(np.pad(ms[lo:hi], (0, pad))),
+                        self._put(np.pad(mo[lo:hi], (0, pad),
+                                         constant_values=scratch)))
                     self.stats["kernel_launches"] += 1
             cur = nxt
             depth += 1
@@ -486,12 +519,22 @@ class IncrementalWindowMiner:
             pref[:n] = 2 * refs + iss
             itm[:n] = items
             items_arr = st.items_t if st.items_t is not None else st.store
-            sup = PS.batch_supports(
-                pt, items_arr, st.ni_rows,
-                jnp.asarray(pref), jnp.asarray(itm),
-                items_kernel_layout=st.items_t is not None,
-                s_block=st.s_block, interpret=self._interpret,
-                n_words=st.n_words)
+            if self.mesh is not None:
+                # the classic engine's cached shard_map launcher: per-
+                # shard Pallas pair kernel + psum of extracted supports
+                from spark_fsm_tpu.models.spade_tpu import (
+                    _pallas_supports_fn)
+                sup = _pallas_supports_fn(
+                    self.mesh, st.ni_rows, st.s_block, st.n_words,
+                    self._interpret)(
+                    pt, items_arr, self._put(pref), self._put(itm))
+            else:
+                sup = PS.batch_supports(
+                    pt, items_arr, st.ni_rows,
+                    jnp.asarray(pref), jnp.asarray(itm),
+                    items_kernel_layout=st.items_t is not None,
+                    s_block=st.s_block, interpret=self._interpret,
+                    n_words=st.n_words)
             self.stats["kernel_launches"] += 1
             return [(sup, n, meta)]
         out = []
@@ -501,9 +544,9 @@ class IncrementalWindowMiner:
             pad = next_pow2(max(hi - lo, 8)) - (hi - lo)
             out.append((fns["supports"](
                 pt, st.store,
-                jnp.asarray(np.pad(refs[lo:hi], (0, pad))),
-                jnp.asarray(np.pad(items[lo:hi], (0, pad))),
-                jnp.asarray(np.pad(iss[lo:hi], (0, pad)))),
+                self._put(np.pad(refs[lo:hi], (0, pad))),
+                self._put(np.pad(items[lo:hi], (0, pad))),
+                self._put(np.pad(iss[lo:hi], (0, pad)))),
                 hi - lo, meta[lo:hi]))
             self.stats["kernel_launches"] += 1
         return out
@@ -580,7 +623,7 @@ class IncrementalWindowMiner:
             # THIS f1 — a cached store from an older projection must
             # never serve stale rows.
             st._project(f1, 0)
-            fold = _fold_supports_fn(st.n_words)
+            fold = _fold_supports_fn(st.n_words, self.mesh)
             todo: List[Tuple[int, List[Tuple[int, bool]]]] = []
             for ci, child in enumerate(children):
                 rows = [(st.row_of.get(g), s) for g, s in child.steps]
@@ -601,8 +644,8 @@ class IncrementalWindowMiner:
                         it[row_i, col] = r
                         ss[row_i, col] = s
                         va[row_i, col] = True
-                sup = fold(st.store, jnp.asarray(it), jnp.asarray(ss),
-                           jnp.asarray(va))
+                sup = fold(st.store, self._put(it), self._put(ss),
+                           self._put(va))
                 self.stats["kernel_launches"] += 1
                 pend.append((sup, st.bid, grp))
         for sup_dev, _, _ in pend:

@@ -166,3 +166,82 @@ def test_duplicate_batch_object_pushed_twice():
     batch.clear()
     wm.push(_batches(24, 1, 50)[0])
     _assert_parity(wm)
+
+
+def _mesh8():
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    return make_mesh(8)
+
+
+def test_mesh_parity_every_push_with_eviction():
+    # VERDICT r4 #4: streaming and partitioning compose — each batch
+    # store's sequence axis shards over the 8-device mesh (shard_map
+    # sweep/fold + psum partial supports) with unchanged per-push parity
+    wm = IncrementalWindowMiner(0.2, max_batches=3, mesh=_mesh8())
+    for batch in _batches(7, 6, 60):
+        wm.push(batch)
+        _assert_parity(wm)
+    assert wm.window.evicted_batches == 3
+    assert wm.stats["route"] == "incremental"
+
+
+def test_mesh_multiword():
+    # >32 itemsets/sequence -> n_words > 1 batch stores on the mesh
+    wm = IncrementalWindowMiner(0.5, max_batches=2, mesh=_mesh8())
+    for batch in _batches(8, 3, 30, n_items=6, mean_itemsets=40.0,
+                          mean_itemset_size=1.1):
+        wm.push(batch)
+        _assert_parity(wm)
+
+
+@pytest.mark.skipif(not __import__("os").environ.get("RUN_SLOW"),
+                    reason="interpret-mode Pallas under an 8-way CPU mesh "
+                           "serializes 8 interpreted shards per collective; "
+                           "on a 1-core box that overruns XLA's 40s "
+                           "rendezvous deadline and ABORTS the process "
+                           "(the real-TPU path is the classic engine's "
+                           "chip-validated _pallas_supports_fn)")
+def test_mesh_multiword_pallas_interpret_slow():
+    # use_pallas=True routes the sweep through the shard_map'd Pallas
+    # launcher (interpret mode on the virtual CPU mesh)
+    wm = IncrementalWindowMiner(0.5, max_batches=2, mesh=_mesh8(),
+                                use_pallas=True)
+    for batch in _batches(8, 2, 20, n_items=6, mean_itemsets=40.0,
+                          mean_itemset_size=1.1):
+        wm.push(batch)
+        _assert_parity(wm)
+
+
+def test_streamer_routes_incremental_under_mesh():
+    # the service no longer gates incrementality on get_mesh() is None:
+    # a meshed deployment's stream pushes keep batch-scaled cost, and
+    # the route label proves it
+    from spark_fsm_tpu import config
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import ServiceRequest
+    from spark_fsm_tpu.service.store import ResultStore
+
+    old = config.get_config()
+    config.set_config(config.parse_config({"engine": {"mesh_devices": 8}}))
+    master = None
+    try:
+        assert config.get_mesh() is not None
+        store = ResultStore()
+        master = Master(store=store)
+        batches = _batches(29, 2, 40)
+        for b in batches:
+            resp = master.handle(ServiceRequest("fsm", "stream:mtopic", {
+                "sequences": format_spmf(b), "support": "0.25",
+                "max_batches": "3", "algorithm": "SPADE_TPU"}))
+            assert resp.status == "finished", resp.data
+        import json as _json
+        stats = _json.loads(store.get("fsm:stats:stream:mtopic"))
+        assert stats["route"] == "incremental"
+        # parity of the served result set against the oracle
+        miner = master.streamer._topics["mtopic"]["miner"]
+        _assert_parity(miner)
+    finally:
+        config.set_config(old)
+        if master is not None:
+            master.shutdown()
