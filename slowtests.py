@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""RUN_SLOW evidence harness -> SLOWTESTS.json (VERDICT r4 #2).
+
+The RUN_SLOW-gated tests (mid-scale 8-mesh parity at >=10k candidates,
+full-scale TSR) are exactly the capability evidence CI skips — and an
+un-run test is not evidence.  This harness runs them with RUN_SLOW=1,
+parses the junit report into per-test rows (id, wall, outcome), merges
+the stats sidecar the tests append (candidate counts, pattern counts),
+and commits the result as SLOWTESTS.json so every round carries a green
+run's provenance, not just the tests' existence.
+
+Selection: the two RUN_SLOW files the evidence demand names.  The
+interpret-Pallas mesh variant in test_incremental.py is deliberately
+NOT selected — 8 interpreted shards serialized on a 1-core box overrun
+XLA's 40s collective rendezvous deadline and ABORT the process (see its
+skip reason), which would take the whole evidence run down with it.
+
+Usage: python slowtests.py   (takes tens of CPU-minutes on a 1-core box)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import xml.etree.ElementTree as ET
+
+FILES = ["tests/test_midscale_multichip.py", "tests/test_tsr.py"]
+
+
+def main() -> None:
+    root = os.path.dirname(os.path.abspath(__file__))
+    junit = tempfile.NamedTemporaryFile(suffix=".xml", delete=False).name
+    stats_path = tempfile.NamedTemporaryFile(suffix=".jsonl",
+                                             delete=False).name
+    env = dict(os.environ, RUN_SLOW="1", SLOWTESTS_STATS=stats_path)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", *FILES, "-q",
+         f"--junit-xml={junit}"],
+        cwd=root, env=env, capture_output=True, text=True)
+    wall = time.monotonic() - t0
+
+    tests = []
+    counts = {"passed": 0, "failed": 0, "skipped": 0, "errors": 0}
+    try:
+        for case in ET.parse(junit).getroot().iter("testcase"):
+            outcome = "passed"
+            for child in case:
+                if child.tag in ("failure", "error"):
+                    outcome = "failed" if child.tag == "failure" else "errors"
+                elif child.tag == "skipped":
+                    outcome = "skipped"
+            counts[outcome] += 1
+            tests.append({
+                "id": f"{case.get('classname')}::{case.get('name')}",
+                "wall_s": round(float(case.get("time", 0)), 2),
+                "outcome": outcome,
+            })
+    except ET.ParseError:
+        pass
+
+    stats_rows = []
+    try:
+        with open(stats_path) as fh:
+            stats_rows = [json.loads(line) for line in fh if line.strip()]
+    except OSError:
+        pass
+    by_test = {r.pop("test"): r for r in stats_rows}
+    for t in tests:
+        name = t["id"].rsplit("::", 1)[-1]
+        if name in by_test:
+            t["stats"] = by_test[name]
+
+    out = {
+        "ts": round(time.time(), 1),
+        "cmd": f"RUN_SLOW=1 pytest {' '.join(FILES)} -q",
+        "host_cores": os.cpu_count(),
+        "pytest_wall_s": round(wall, 1),
+        "exit_code": proc.returncode,
+        "all_passed": proc.returncode == 0 and counts["failed"] == 0
+        and counts["errors"] == 0,
+        "counts": counts,
+        "tests": tests,
+        "tail": proc.stdout.strip().splitlines()[-3:],
+    }
+    path = os.path.join(root, "SLOWTESTS.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+    print(json.dumps({k: out[k] for k in
+                      ("all_passed", "counts", "pytest_wall_s")}))
+    for fn in (junit, stats_path):
+        try:
+            os.unlink(fn)
+        except OSError:
+            pass
+    sys.exit(proc.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,7 @@ import heapq
 import itertools
 import time
 from fractions import Fraction
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -504,64 +504,83 @@ class TsrTPU:
         for km_v, cnt in zip(*np.unique(kms, return_counts=True)):
             key = f"evaluated_km{int(km_v)}"
             self.stats[key] = self.stats.get(key, 0) + int(cnt)
-        order = np.argsort(kms, kind="stable")
+        # candidate pools per km bucket; the kernel pass drains them
+        # LARGEST km first so each bucket's tail-launch pad lanes can be
+        # filled ("borrowed") from the still-unprocessed smaller pools
+        remaining: Dict[int, List[int]] = {}
+        for r in range(n):
+            remaining.setdefault(int(kms[r]), []).append(r)
         parts = []
         cols = np.empty(n, np.int64)  # candidate r -> column in `out`
         used_kernel = False  # any bucket through the Pallas path: a
         base = 0             # readback fault is then recountable
-        g_lo = 0
-        while g_lo < n:
-            km = int(kms[order[g_lo]])
-            g_hi = g_lo
-            while g_hi < n and kms[order[g_hi]] == km:
-                g_hi += 1
-            if self.use_pallas and km not in self._pallas_bad:
+        if self.use_pallas:
+            for km in sorted(remaining, reverse=True):
+                if km in self._pallas_bad or not remaining[km]:
+                    continue
                 mark = len(parts)
                 launches_mark = self.stats["kernel_launches"]
+                km_keys = (f"launches_km{km}", f"width_km{km}",
+                           f"borrowed_km{km}")
+                km_marks = {kk: self.stats.get(kk) for kk in km_keys}
+                undo: List[Tuple[int, int]] = []
                 try:
                     base = self._dispatch_kernel_bucket(
-                        p1, s1, cands, order, g_lo, g_hi, km,
-                        parts, cols, base)
+                        p1, s1, cands, remaining, km, parts, cols, base,
+                        undo)
                     used_kernel = True
-                    g_lo = g_hi
-                    continue
+                    remaining[km] = []
                 except Exception as exc:  # pragma: no cover - device-specific
                     # compile/lowering failures surface at the bucket's
                     # first launch; mark only THIS km bucket bad (other
                     # buckets keep the kernel) and evaluate it via the
-                    # jnp path, whose prep/width differ from the kernel's
+                    # jnp path, whose prep/width differ from the kernel's.
+                    # The bucket's own candidates are still in its pool;
+                    # borrowed ones return to theirs.
                     del parts[mark:]
                     base = sum(p.shape[1] for p in parts)
                     # discarded launches must not stay in the exported
-                    # per-job stats (the jnp re-evaluation recounts)
+                    # per-job stats — neither the global launch count nor
+                    # the per-km fill counters the 3-vs-3d decomposition
+                    # reads (the jnp re-evaluation recounts)
                     self.stats["kernel_launches"] = launches_mark
+                    for kk, v in km_marks.items():
+                        if v is None:
+                            self.stats.pop(kk, None)
+                        else:
+                            self.stats[kk] = v
+                    for skm, r in undo:
+                        remaining[skm].append(r)
                     self._pallas_bad.add(km)
                     self.stats[f"pallas_fallback_km{km}"] = repr(exc)
-            if self.use_pallas:
-                # first jnp bucket while the kernel path is live: both
-                # prep pairs stay resident (see _ensure_jnp_downgrade).
-                # Its prep-rebuild launch is REAL retained work — exclude
-                # it from this handle's discardable launch delta so a
-                # later readback-fault recount cannot subtract it.
-                before = self.stats["kernel_launches"]
-                self._ensure_jnp_downgrade()
-                launches0 += self.stats["kernel_launches"] - before
+        leftover = sorted(km for km, idxs in remaining.items() if idxs)
+        if leftover and self.use_pallas:
+            # jnp buckets while the kernel path is live: both prep pairs
+            # stay resident (see _ensure_jnp_downgrade).  The
+            # prep-rebuild launch is REAL retained work — exclude it
+            # from this handle's discardable launch delta so a later
+            # readback-fault recount cannot subtract it.
+            before = self.stats["kernel_launches"]
+            self._ensure_jnp_downgrade()
+            launches0 += self.stats["kernel_launches"] - before
+        for km in leftover:
             pj, sj = self._jnp_prep if self._jnp_prep is not None else (p1, s1)
             fn = self._eval_fn(km)
             cw = self.chunk if not self.use_pallas else self._jnp_chunk
             c = cw if self._chunk_user else max(32, cw // km)
-            for lo in range(g_lo, g_hi, c):
-                hi = min(lo + c, g_hi)
+            idxs = remaining[km]
+            for lo in range(0, len(idxs), c):
+                hi = min(lo + c, len(idxs))
                 xy = np.full((c, 2, km), -1, np.int32)
-                for r in range(lo, hi):
-                    x, y = cands[order[r]]
-                    xy[r - lo, 0, :len(x)] = x
-                    xy[r - lo, 1, :len(y)] = y
-                cols[order[lo:hi]] = base + np.arange(hi - lo)
+                for j, r in enumerate(idxs[lo:hi]):
+                    x, y = cands[r]
+                    xy[j, 0, :len(x)] = x
+                    xy[j, 1, :len(y)] = y
+                cols[idxs[lo:hi]] = base + np.arange(hi - lo)
                 base += c
                 parts.append(fn(pj, sj, self._put(xy)))
                 self.stats["kernel_launches"] += 1
-            g_lo = g_hi
+            remaining[km] = []
         self.stats["evaluated"] += n
         out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         try:
@@ -595,46 +614,70 @@ class TsrTPU:
             sb //= 2
         return sb
 
-    def _dispatch_kernel_bucket(self, p1k, s1k, cands, order, g_lo, g_hi,
-                                km, parts, cols, base):
+    def _dispatch_kernel_bucket(self, p1k, s1k, cands, remaining, km,
+                                parts, cols, base, undo):
         """Pallas-path dispatch for one km bucket: full launch width (the
         kernel streams seq blocks through VMEM — no [chunk, S, W] gather
         temps to narrow for), candidate count padded to the out-block lane
-        width.  Appends to parts/cols and returns the advanced base."""
+        width.  Appends to parts/cols and returns the advanced base.
+
+        Pad BORROWING closes the launch-underfill gap (BENCH_SCALE 3d
+        per_km: 61-78% fill at km>=2): a pad lane streams exactly the
+        same seq blocks as a real lane, so tail-launch pads are filled
+        with candidates from the smaller-km pools (largest km first —
+        each filled lane saves that candidate's lane at its own km for
+        free; a side of length <= skm < km trivially fits the km-wide
+        layout).  ``undo`` records (km, candidate) borrows so a
+        bucket-level compile failure restores the pools."""
         fn = _kernel_eval_fn(self.mesh, km, self._bucket_seq_block(km),
                              self._interpret, self.n_words == 1)
         c = self.chunk
-        lo = g_lo
-        while lo < g_hi:
-            rem = g_hi - lo
+        mine = remaining[km]
+        lo = 0
+        while lo < len(mine):
+            rem = len(mine) - lo
             # Greedy pow2 split instead of one over-padded launch: the
             # kernel's wall is ~linear in the PADDED width (every lane
-            # streams its km seq blocks), and the service-default
-            # unlimited-side path measured 1.5x padded-over-ideal traffic
-            # from chunk-then-next_pow2 alone (BENCH_SCALE 3d per_km).
-            # Take the largest pow2 <= remaining (capped at chunk) while
-            # >= 1024 — 100% fill — then one padded tail launch.  Widths
-            # stay the same pow2 set, so no new kernel compiles.
+            # streams its km seq blocks).  Take the largest pow2 <=
+            # remaining (capped at chunk) while >= 1024 — 100% fill —
+            # then one padded tail launch.  Widths stay the same pow2
+            # set, so no new kernel compiles.
             if rem >= 1024:
                 take = min(c, 1 << (rem.bit_length() - 1))
             else:
                 take = rem
-            hi = lo + take
+            rows = list(mine[lo:lo + take])
             width = max(PT.C_LANES, next_pow2(take))
+            pad = width - len(rows)
+            if pad:
+                for skm in sorted((k for k in remaining if k < km),
+                                  reverse=True):
+                    pool = remaining[skm]
+                    while pad > 0 and pool:
+                        r = pool.pop()
+                        undo.append((skm, r))
+                        rows.append(r)
+                        pad -= 1
+                    if pad == 0:
+                        break
             xy = np.full((width, 2, km), -1, np.int32)
-            for r in range(lo, hi):
-                x, y = cands[order[r]]
-                xy[r - lo, 0, :len(x)] = x
-                xy[r - lo, 1, :len(y)] = y
+            for j, r in enumerate(rows):
+                x, y = cands[r]
+                xy[j, 0, :len(x)] = x
+                xy[j, 1, :len(y)] = y
             part = fn(p1k, s1k, self._put(xy))
             self.stats["kernel_launches"] += 1
             lk = f"launches_km{km}"
             wk = f"width_km{km}"
             self.stats[lk] = self.stats.get(lk, 0) + 1
             self.stats[wk] = self.stats.get(wk, 0) + width
-            cols[order[lo:hi]] = base + np.arange(hi - lo)
+            if len(rows) > take:
+                bk = f"borrowed_km{km}"
+                self.stats[bk] = self.stats.get(bk, 0) + len(rows) - take
+            cols[rows] = base + np.arange(len(rows))
             base += width
             parts.append(part)
+            lo += take
         return base
 
     def _resolve_eval(self, handle, n: int):

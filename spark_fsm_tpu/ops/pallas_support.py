@@ -34,6 +34,7 @@ supports over ICI (SURVEY.md sec 2.2), identical to the jnp path.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +58,28 @@ S_BLOCK = 4096
 def seq_block(n_words: int) -> int:
     """Lane width per grid step for a given word count (multiple of 128)."""
     return max(128, (S_BLOCK // max(1, n_words)) // 128 * 128)
+
+
+def effective_tiles(P: int, n_item_rows: int, W: int,
+                    items_rows: int) -> tuple:
+    """The (p_tile, i_tile) the kernel's adaptive default actually runs
+    at a given geometry — the ONE definition shared by ``pair_supports``
+    and the roofline bench's traffic model (a diverging inline copy
+    would make the bench describe tiles the measured program never ran).
+
+    (32, 384) halves block re-reads (1/384 + 1/32 vs 1/128 + 1/16 of the
+    P*NI*S traffic) and cuts grid steps 6x — measured 42.98 ms vs
+    47.81 ms at the headline geometry (KERNELS.json tile sweep,
+    consistent direction across sessions).  Widening is only taken when
+    it changes NO shapes: P already divides 32, and the 128-rounded item
+    count already divides 384.  W > 1 keeps i_tile=128: a 384-row item
+    block is ~6.3 MB in VMEM and the multiword variant is unswept on
+    hardware."""
+    p_tile = 32 if P % 32 == 0 else P_TILE
+    ni128 = -(-n_item_rows // 128) * 128
+    i_tile = (384 if W == 1 and ni128 % 384 == 0 and ni128 <= items_rows
+              else I_TILE)
+    return p_tile, i_tile
 
 
 def _make_pair_kernel_1w(p_tile: int):
@@ -106,8 +129,8 @@ def _make_pair_kernel(p_tile: int):
 @functools.partial(jax.jit, static_argnames=(
     "n_item_rows", "s_block", "p_tile", "i_tile", "interpret"))
 def pair_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
-                  *, s_block: int = S_BLOCK, p_tile: int = P_TILE,
-                  i_tile: int = I_TILE,
+                  *, s_block: int = S_BLOCK, p_tile: Optional[int] = None,
+                  i_tile: Optional[int] = None,
                   interpret: bool = False) -> jax.Array:
     """Pair-support matrix between parent rows and item rows.
 
@@ -126,6 +149,12 @@ def pair_supports(pt: jax.Array, items: jax.Array, n_item_rows: int,
       [P, NI] int32 supports, NI = n_item_rows rounded up to i_tile.
     """
     P, W, S = pt.shape
+    # None = the kernel's adaptive default (see effective_tiles); an
+    # EXPLICIT p_tile/i_tile (the bench sweep) is honored verbatim
+    if p_tile is None or i_tile is None:
+        ap, ai = effective_tiles(P, n_item_rows, W, items.shape[0])
+        p_tile = ap if p_tile is None else p_tile
+        i_tile = ai if i_tile is None else i_tile
     assert P % p_tile == 0, (P, p_tile)
     assert S % s_block == 0, (S, s_block)
     assert i_tile % 128 == 0, i_tile

@@ -24,8 +24,9 @@ Correctness by construction:
   the reference, the device memory frees when the arrays do.
 
 Scope: the plain SPADE_TPU path (queue or classic engine — the two that
-keep their store across ``mine()`` calls).  Constrained, checkpointed,
-and TSR jobs pass through uncached.
+keep their store across ``mine()`` calls) via :class:`SpadeEngineCache`,
+and TSR_TPU via :class:`TsrEngineCache` (host-side reuse — see its
+docstring).  Constrained and checkpointed jobs pass through uncached.
 """
 
 from __future__ import annotations
@@ -68,15 +69,77 @@ class _Entry:
         self.busy = False
 
 
-class SpadeEngineCache:
-    """LRU engine cache with exclusive checkout; see module docstring."""
+class _EngineCacheBase:
+    """The concurrency-sensitive scaffolding both engine caches share:
+    lock + LRU OrderedDict + exclusive busy-flag checkout + insert that
+    never displaces a checked-out entry.  Subclasses supply only the
+    eviction policy (``_evict_locked``) and the engine-build bodies —
+    one copy of the checkout/release/insert logic means a race fixed
+    here is fixed for both caches."""
 
-    def __init__(self, budget_bytes: Optional[int] = None):
-        self._budget = budget_bytes
+    def __init__(self):
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self.stats = {"hits": 0, "misses": 0, "busy_misses": 0,
                       "evictions": 0}
+
+    def _checkout(self, key) -> Optional[_Entry]:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and not e.busy:
+                e.busy = True
+                self._entries.move_to_end(key)
+                self.stats["hits"] += 1
+                return e
+            self.stats["busy_misses" if e is not None else "misses"] += 1
+            return None
+
+    def _mine_checked_out(self, entry: _Entry):
+        """Run a checked-out engine's mine: zero the accumulated numeric
+        stats (engines carry lifetime totals across mine() calls), run,
+        and SNAPSHOT the stats dict BEFORE releasing the busy flag — a
+        concurrent checkout zeroes the same dict the moment busy drops,
+        so reading ``engine.stats`` after release races.  Returns
+        ``(result, stats_snapshot)``."""
+        eng = entry.engine
+        for k, v in eng.stats.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                eng.stats[k] = 0
+        snap = None
+        try:
+            res = eng.mine()
+            snap = dict(eng.stats)
+            return res, snap
+        finally:
+            with self._lock:
+                entry.busy = False
+
+    def _insert(self, key, engine, nbytes: int) -> None:
+        with self._lock:
+            old = self._entries.get(key)
+            if old is not None and old.busy:
+                # a busy-miss rebuild racing the checked-out entry: keep
+                # the in-use one (replacing it would transiently hold
+                # two engines' working sets); this engine stays uncached
+                return
+            self._entries[key] = _Entry(engine, nbytes)
+            self._entries.move_to_end(key)
+            self._evict_locked(key)
+
+    def _evict_locked(self, new_key) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+class SpadeEngineCache(_EngineCacheBase):
+    """LRU engine cache with exclusive checkout; see module docstring."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        super().__init__()
+        self._budget = budget_bytes
 
     def _budget_bytes(self) -> int:
         if self._budget is not None:
@@ -109,35 +172,12 @@ class SpadeEngineCache:
 
         key = (db_fingerprint(db), int(minsup_abs), mesh,
                max_pattern_itemsets, bool(shape_buckets), fused)
-        entry = None
-        with self._lock:
-            e = self._entries.get(key)
-            if e is not None and not e.busy:
-                e.busy = True
-                self._entries.move_to_end(key)
-                entry = e
-                self.stats["hits"] += 1
-            elif e is not None:
-                self.stats["busy_misses"] += 1
-            else:
-                self.stats["misses"] += 1
-
+        entry = self._checkout(key)
         if entry is not None:
-            eng = entry.engine
-            # the classic engine ACCUMULATES counters across mine()
-            # calls — zero the numeric stats so a hit reports this
-            # mine's work, not the engine's lifetime totals
-            for k, v in eng.stats.items():
-                if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    eng.stats[k] = 0
-            try:
-                res = eng.mine()
-            finally:
-                with self._lock:
-                    entry.busy = False
+            res, snap = self._mine_checked_out(entry)
             if res is not None:  # a cap overflow on re-mine: fall through
                 if stats_out is not None:
-                    stats_out.update(eng.stats)
+                    stats_out.update(snap)
                     # classic engines carry no 'fused' key in their own
                     # stats; artifact consumers key the route on it
                     stats_out.setdefault("fused", False)
@@ -157,7 +197,7 @@ class SpadeEngineCache:
             if stats_out is not None:
                 stats_out["store_cache_hit"] = False
             if engine is not None:
-                self._insert(key, engine)
+                self._insert_engine(key, engine)
             return res
 
         res, engine = self._build_and_mine(
@@ -167,7 +207,7 @@ class SpadeEngineCache:
         if stats_out is not None:
             stats_out["store_cache_hit"] = False
         if engine is not None:
-            self._insert(key, engine)
+            self._insert_engine(key, engine)
         return res
 
     def _build_and_mine(self, db, minsup_abs, *, mesh, stats_out,
@@ -232,36 +272,83 @@ class SpadeEngineCache:
         rows = engine.store.shape[0]
         return rows * engine.n_seq * engine.n_words * 4
 
-    def _insert(self, key, engine) -> None:
+    def _insert_engine(self, key, engine) -> None:
         nbytes = self._engine_bytes(engine)
-        budget = self._budget_bytes()
-        if nbytes > budget:
+        if nbytes > self._budget_bytes():
             return  # a store bigger than the whole budget never caches
-        with self._lock:
-            old = self._entries.get(key)
-            if old is not None and old.busy:
-                # a busy-miss rebuild racing the checked-out entry: keep
-                # the in-use one (replacing it would transiently hold two
-                # stores above the budget); the second engine is simply
-                # not cached
-                return
-            self._entries[key] = _Entry(engine, nbytes)
-            self._entries.move_to_end(key)
-            total = sum(e.nbytes for e in self._entries.values())
-            for k in list(self._entries):
-                if total <= budget:
-                    break
-                e = self._entries[k]
-                if e.busy or k == key:
-                    continue
-                total -= e.nbytes
-                del self._entries[k]
-                self.stats["evictions"] += 1
+        self._insert(key, engine, nbytes)
 
-    def clear(self) -> None:
-        with self._lock:
-            self._entries.clear()
+    def _evict_locked(self, new_key) -> None:
+        budget = self._budget_bytes()
+        total = sum(e.nbytes for e in self._entries.values())
+        for k in list(self._entries):
+            if total <= budget:
+                break
+            e = self._entries[k]
+            if e.busy or k == new_key:
+                continue
+            total -= e.nbytes
+            del self._entries[k]
+            self.stats["evictions"] += 1
 
 
-# process-wide cache the service plugin layer uses
+class TsrEngineCache(_EngineCacheBase):
+    """LRU TSR-engine cache with exclusive checkout (the TSR half of the
+    repeat-``/train`` story; SpadeEngineCache covers plain SPADE).
+
+    A TSR engine holds NO persistent HBM between mines — each deepening
+    round's prefix/suffix prep stores are transients — so what a hit
+    skips is the full vertical build + token indexing (~7.4 s of host
+    work at Kosarak scale, BENCH_SCALE config 3 ``vertical_build_s``)
+    plus engine construction, paid today on EVERY repeat ``/train`` of
+    the framework's longest jobs.  Entries are therefore capped by
+    COUNT (each holds ~100 MB of host token arrays at Kosarak scale),
+    not by the HBM budget; the same content-fingerprint key discipline
+    as SpadeEngineCache makes staleness impossible by construction."""
+
+    def __init__(self, max_entries: int = 2):
+        super().__init__()
+        self._max = int(max_entries)
+
+    def mine(self, db: SequenceDB, k: int, minconf: float, *,
+             max_side=None, mesh=None, stats_out: Optional[dict] = None,
+             **kwargs) -> List:
+        from spark_fsm_tpu.data.vertical import build_vertical
+        from spark_fsm_tpu.models.tsr import TsrTPU
+
+        key = (db_fingerprint(db), int(k), float(minconf), max_side, mesh,
+               tuple(sorted(kwargs.items())))
+        entry = self._checkout(key)
+        if entry is not None:
+            res, snap = self._mine_checked_out(entry)
+            if stats_out is not None:
+                stats_out.update(snap)
+                stats_out["store_cache_hit"] = True
+            return res
+
+        vdb = build_vertical(db, min_item_support=1)
+        if vdb.n_items == 0:
+            return []
+        eng = TsrTPU(vdb, k, minconf, max_side=max_side, mesh=mesh,
+                     **kwargs)
+        res = eng.mine()
+        if stats_out is not None:
+            stats_out.update(eng.stats)
+            stats_out["store_cache_hit"] = False
+        self._insert(key, eng, 0)
+        return res
+
+    def _evict_locked(self, new_key) -> None:
+        for ek in list(self._entries):
+            if len(self._entries) <= self._max:
+                break
+            e = self._entries[ek]
+            if e.busy or ek == new_key:
+                continue
+            del self._entries[ek]
+            self.stats["evictions"] += 1
+
+
+# process-wide caches the service plugin layer uses
 spade_engine_cache = SpadeEngineCache()
+tsr_engine_cache = TsrEngineCache()

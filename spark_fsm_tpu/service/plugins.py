@@ -166,6 +166,16 @@ def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
     kwargs = _tsr_kwargs()
     if req.task == "stream":  # see _spade_tpu: bucket drifting windows
         kwargs["shape_buckets"] = True
+    if checkpoint is None and req.task != "stream":
+        # repeat TSR mines over identical data reuse the built engine
+        # (vertical build + token indexing are the fixed ~7s cost of the
+        # framework's longest jobs); checkpointed jobs stay uncached
+        # (resume binds its own fingerprint) and stream windows change
+        # every push (see _spade_tpu's identical reasoning)
+        from spark_fsm_tpu.service.devcache import tsr_engine_cache
+        return tsr_engine_cache.mine(db, k, minconf, max_side=max_side,
+                                     mesh=config.get_mesh(),
+                                     stats_out=stats, **kwargs)
     return mine_tsr_tpu(db, k, minconf, max_side=max_side, mesh=config.get_mesh(),
                         stats_out=stats, checkpoint=checkpoint, **kwargs)
 

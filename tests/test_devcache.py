@@ -134,3 +134,49 @@ def test_train_twice_hits_store_cache(server, tmp_path):
     p1 = _post(server, "/get/patterns", uid="dc1")["data"]["patterns"]
     p2 = _post(server, "/get/patterns", uid="dc2")["data"]["patterns"]
     assert p1 == p2
+
+
+def test_tsr_repeat_mine_hits_and_matches():
+    # VERDICT r4 #7: TSR mines (the framework's longest) now reuse the
+    # built engine on repeat /train — a hit skips vertical build + token
+    # indexing and returns the identical rule set
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu
+    from spark_fsm_tpu.service.devcache import TsrEngineCache
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    cache = TsrEngineCache()
+    db = _db()
+    want = mine_tsr_cpu(db, 10, 0.4, max_side=2)
+    s1, s2 = {}, {}
+    r1 = cache.mine(db, 10, 0.4, max_side=2, stats_out=s1)
+    r2 = cache.mine(db, 10, 0.4, max_side=2, stats_out=s2)
+    assert rules_text(r1) == rules_text(r2) == rules_text(want)
+    assert s1["store_cache_hit"] is False
+    assert s2["store_cache_hit"] is True
+    # a parameter change is a different engine: miss, not stale reuse
+    s3: dict = {}
+    cache.mine(db, 11, 0.4, max_side=2, stats_out=s3)
+    assert s3["store_cache_hit"] is False
+    assert cache.stats == {"hits": 1, "misses": 2, "busy_misses": 0,
+                           "evictions": 0}  # both fit max_entries=2
+    # a third distinct engine exceeds max_entries: LRU (k=10) drops
+    cache.mine(db, 12, 0.4, max_side=2)
+    assert cache.stats["evictions"] == 1
+
+
+def test_tsr_service_route_uses_cache():
+    from spark_fsm_tpu.service import plugins
+    from spark_fsm_tpu.service.devcache import tsr_engine_cache
+    from spark_fsm_tpu.service.model import ServiceRequest
+
+    tsr_engine_cache.clear()
+    db = _db(seed=9)
+    req = ServiceRequest("fsm", "train", {
+        "algorithm": "TSR_TPU", "k": "5", "minconf": "0.3",
+        "max_side": "2"})
+    s1, s2 = {}, {}
+    r1 = plugins.get_plugin(req).extract(req, db, s1)
+    r2 = plugins.get_plugin(req).extract(req, db, s2)
+    assert r1 == r2
+    assert s1["store_cache_hit"] is False
+    assert s2["store_cache_hit"] is True

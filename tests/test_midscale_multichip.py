@@ -12,6 +12,7 @@ Minutes-long (CPU mesh + full-size oracle): gated behind RUN_SLOW=1,
 same convention as tests/test_tsr.py's full-scale run.
 """
 
+import json
 import os
 
 import pytest
@@ -19,6 +20,15 @@ import pytest
 pytestmark = pytest.mark.skipif(
     not os.environ.get("RUN_SLOW"),
     reason="minutes-long mid-scale mesh run; set RUN_SLOW=1")
+
+
+def _record(test: str, **kv) -> None:
+    """Append measured evidence (candidate counts etc.) for the
+    SLOWTESTS.json harness (slowtests.py); no-op outside it."""
+    path = os.environ.get("SLOWTESTS_STATS")
+    if path:
+        with open(path, "a") as fh:
+            fh.write(json.dumps({"test": test, **kv}) + "\n")
 
 
 @pytest.fixture(scope="module")
@@ -51,6 +61,9 @@ def test_classic_engine_midscale_mesh(midscale):
         diff_patterns(want, got)
     # the point of mid-scale: candidate counts far beyond the CI fixtures
     assert eng.stats["candidates"] >= 10_000, eng.stats
+    _record("test_classic_engine_midscale_mesh", sequences=len(db),
+            devices=mesh.devices.size, candidates=eng.stats["candidates"],
+            patterns=len(got))
 
 
 def test_queue_engine_midscale_mesh(midscale):
@@ -64,6 +77,9 @@ def test_queue_engine_midscale_mesh(midscale):
     assert patterns_text(got) == patterns_text(want), \
         diff_patterns(want, got)
     assert eng.stats["candidates"] >= 10_000, eng.stats
+    _record("test_queue_engine_midscale_mesh", sequences=len(db),
+            devices=mesh.devices.size, candidates=eng.stats["candidates"],
+            waves=eng.stats["waves"], patterns=len(got))
 
 
 def test_fused_engine_midscale_mesh(midscale):
