@@ -60,6 +60,11 @@ def conf_ok(sup: int, supx: int, minconf: float) -> bool:
 
 _auto_eval_budget = device_hbm_budget  # shared with the SPADE engines
 
+# per-km-bucket stat keys (fill/borrow decomposition, BENCH_SCALE 3 vs
+# 3d); dispatch handles carry their deltas so fault recounts are exact
+_KM_STAT_PREFIXES = ("evaluated_km", "launches_km", "width_km",
+                     "borrowed_km")
+
 
 @functools.lru_cache(maxsize=64)
 def _conf_frac(minconf: float) -> Tuple[int, int]:
@@ -477,6 +482,8 @@ class TsrTPU:
         n = len(cands)
         launches0 = self.stats["kernel_launches"]  # handle carries its own
         # launch count so a readback-fault recount can discard them (below)
+        km_stats0 = {sk: v for sk, v in self.stats.items()
+                     if sk.startswith(_KM_STAT_PREFIXES)}
         # Candidates dispatch per side-size bucket (pow2 km), NOT at one
         # batch-wide kmax: the km kernel's live-temp footprint grows with
         # km, so the adaptive width must NARROW as km grows — and
@@ -587,8 +594,16 @@ class TsrTPU:
             out.copy_to_host_async()
         except (AttributeError, NotImplementedError):
             pass  # method unavailable on this backend
-        return out, cols, used_kernel, \
-            self.stats["kernel_launches"] - launches0
+        # the handle also carries this dispatch's per-km counter DELTAS,
+        # so a readback-fault recount can subtract them exactly — the
+        # fill/borrow decomposition must not keep discarded launches
+        km_keys = set(km_stats0) | {sk for sk in self.stats
+                                    if sk.startswith(_KM_STAT_PREFIXES)}
+        km_delta = {sk: self.stats.get(sk, 0) - km_stats0.get(sk, 0)
+                    for sk in km_keys
+                    if self.stats.get(sk, 0) != km_stats0.get(sk, 0)}
+        return (out, cols, used_kernel,
+                self.stats["kernel_launches"] - launches0, km_delta)
 
     def _ensure_jnp_downgrade(self) -> None:
         """Build the engine-layout prep + budget width the jnp evaluator
@@ -863,11 +878,19 @@ class TsrTPU:
                 self._ensure_jnp_downgrade()
                 if self._chunk_user is None:
                     self.chunk = self._jnp_chunk
-                # recount, not new work: the faulted handle's evaluations
-                # AND its launches leave the exported stats (same contract
-                # as the dispatch-time fallback's launches_mark reset)
+                # recount, not new work: the faulted handle's evaluations,
+                # its launches AND its per-km fill/borrow counters leave
+                # the exported stats (same contract as the dispatch-time
+                # fallback's marks) — the jnp re-dispatch recounts all of
+                # them
                 self.stats["evaluated"] -= len(batch)
                 self.stats["kernel_launches"] -= handle[3]
+                for sk, dv in (handle[4] if len(handle) > 4 else {}).items():
+                    left = self.stats.get(sk, 0) - dv
+                    if left:
+                        self.stats[sk] = left
+                    else:
+                        self.stats.pop(sk, None)
                 handle = self._dispatch_eval(
                     p1, s1, [(x, y) for x, y, _ in batch])
                 sups, supxs = self._resolve_eval(handle, len(batch))

@@ -67,19 +67,24 @@ def effective_tiles(P: int, n_item_rows: int, W: int,
     and the roofline bench's traffic model (a diverging inline copy
     would make the bench describe tiles the measured program never ran).
 
-    (32, 384) halves block re-reads (1/384 + 1/32 vs 1/128 + 1/16 of the
-    P*NI*S traffic) and cuts grid steps 6x — measured 42.98 ms vs
-    47.81 ms at the headline geometry (KERNELS.json tile sweep,
-    consistent direction across sessions).  Widening is only taken when
-    it changes NO shapes: P already divides 32, and the 128-rounded item
-    count already divides 384.  W > 1 keeps i_tile=128: a 384-row item
-    block is ~6.3 MB in VMEM and the multiword variant is unswept on
-    hardware."""
-    p_tile = 32 if P % 32 == 0 else P_TILE
+    i_tile=384 cuts the parent-block re-read term 3x (1/384 vs 1/128 of
+    the P*NI*S traffic) and the grid steps with it — measured 51.6 ms ->
+    44.3 ms at the headline geometry (KERNELS.json tile sweep).
+    Widening is only taken when it changes NO shapes: the 128-rounded
+    item count already divides 384.  W > 1 keeps i_tile=128: a 384-row
+    item block is ~6.3 MB in VMEM and the multiword variant is unswept
+    on hardware.
+
+    p_tile stays 16 DELIBERATELY: a p_tile=32 variant measured the same
+    steady wall within session noise but ~4x the Mosaic compile time
+    (~15 s/shape — the kernel body statically unrolls p_tile rows),
+    which multiplied across the incremental miner's shape-bucketed
+    sweep programs into 10+ s per streaming push (config-5 regression,
+    caught 2026-07-31)."""
     ni128 = -(-n_item_rows // 128) * 128
     i_tile = (384 if W == 1 and ni128 % 384 == 0 and ni128 <= items_rows
               else I_TILE)
-    return p_tile, i_tile
+    return P_TILE, i_tile
 
 
 def _make_pair_kernel_1w(p_tile: int):

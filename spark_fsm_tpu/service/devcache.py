@@ -105,14 +105,19 @@ class _EngineCacheBase:
         for k, v in eng.stats.items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 eng.stats[k] = 0
-        snap = None
         try:
             res = eng.mine()
             snap = dict(eng.stats)
+            self._scrub(eng)
             return res, snap
         finally:
             with self._lock:
                 entry.busy = False
+
+    def _scrub(self, engine) -> None:
+        """Drop transient device state a mine may have left on the
+        engine before it goes back on the shelf (called while the entry
+        is still exclusively checked out).  Base: nothing to drop."""
 
     def _insert(self, key, engine, nbytes: int) -> None:
         with self._lock:
@@ -328,6 +333,8 @@ class TsrEngineCache(_EngineCacheBase):
 
         vdb = build_vertical(db, min_item_support=1)
         if vdb.n_items == 0:
+            if stats_out is not None:
+                stats_out["store_cache_hit"] = False
             return []
         eng = TsrTPU(vdb, k, minconf, max_side=max_side, mesh=mesh,
                      **kwargs)
@@ -335,8 +342,18 @@ class TsrEngineCache(_EngineCacheBase):
         if stats_out is not None:
             stats_out.update(eng.stats)
             stats_out["store_cache_hit"] = False
+        self._scrub(eng)
         self._insert(key, eng, 0)
         return res
+
+    def _scrub(self, engine) -> None:
+        # a per-bucket kernel downgrade in the mine's FINAL round leaves
+        # the engine-layout prep pair on device (_jnp_prep is cleared at
+        # ROUND start, tsr._mine_restricted) — dropping it here keeps
+        # the "cached TSR engines hold no persistent HBM" contract the
+        # count-based (not byte-based) eviction relies on
+        engine._jnp_prep = None
+        engine._jnp_chunk = None
 
     def _evict_locked(self, new_key) -> None:
         for ek in list(self._entries):
