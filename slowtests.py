@@ -37,6 +37,11 @@ def main() -> None:
     stats_path = tempfile.NamedTemporaryFile(suffix=".jsonl",
                                              delete=False).name
     env = dict(os.environ, RUN_SLOW="1", SLOWTESTS_STATS=stats_path)
+    # weak 1-core boxes: shrink the midscale SEQUENCE axis (the fused/
+    # queue engines' dense per-wave pair matrices are CPU-bound there);
+    # candidate width — the evidence — barely moves (see the fixture)
+    if (os.cpu_count() or 1) <= 2:
+        env.setdefault("MIDSCALE_SCALE", "0.35")
     t0 = time.monotonic()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", *FILES, "-q",
@@ -86,6 +91,9 @@ def main() -> None:
         "counts": counts,
         "tests": tests,
         "tail": proc.stdout.strip().splitlines()[-3:],
+        # an XLA abort (SIGABRT) reports on stderr, not stdout — keep
+        # enough of it to diagnose a dead run from the artifact alone
+        "stderr_tail": proc.stderr.strip().splitlines()[-6:],
     }
     path = os.path.join(root, "SLOWTESTS.json")
     tmp = path + ".tmp"

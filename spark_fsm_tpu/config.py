@@ -39,6 +39,7 @@ File format (TOML shown; JSON with the same nesting also accepted):
     tsr_chunk = 2048                # TSR candidate batch (default adaptive)
     item_cap = 256                  # TSR iterative-deepening width
     fused = "auto"                  # SPADE routing: auto / always / never
+                                    # / queue / dense (engine pins)
 
 Unknown keys are rejected (a typo'd knob must not silently no-op).
 """
@@ -81,7 +82,8 @@ class EngineConfig:
     # to the eval HBM budget — see models/tsr.py TsrTPU.__init__)
     item_cap: Optional[int] = None  # TSR iterative-deepening width
     fused: Optional[str] = None  # SPADE engine routing: "auto" (default) /
-    # "always" / "never" — see models/spade_tpu.mine_spade_tpu
+    # "always" / "never" / "queue" / "dense" (engine pins) — see
+    # models/spade_tpu.mine_spade_tpu
 
 
 @dataclasses.dataclass
@@ -155,10 +157,11 @@ def parse_config(obj: Dict[str, Any]) -> Config:
             f"got {cfg.store.backend!r}")
     if cfg.engine.mesh_devices < 0:
         raise ConfigError("engine.mesh_devices must be >= 0")
-    if cfg.engine.fused not in (None, "auto", "always", "never"):
+    if cfg.engine.fused not in (None, "auto", "always", "never",
+                                "queue", "dense"):
         raise ConfigError(
-            f"engine.fused must be 'auto', 'always' or 'never', "
-            f"got {cfg.engine.fused!r}")
+            f"engine.fused must be 'auto', 'always', 'never', 'queue' "
+            f"or 'dense', got {cfg.engine.fused!r}")
     return cfg
 
 

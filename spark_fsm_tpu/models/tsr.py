@@ -597,11 +597,13 @@ class TsrTPU:
         # the handle also carries this dispatch's per-km counter DELTAS,
         # so a readback-fault recount can subtract them exactly — the
         # fill/borrow decomposition must not keep discarded launches
-        km_keys = set(km_stats0) | {sk for sk in self.stats
-                                    if sk.startswith(_KM_STAT_PREFIXES)}
-        km_delta = {sk: self.stats.get(sk, 0) - km_stats0.get(sk, 0)
-                    for sk in km_keys
-                    if self.stats.get(sk, 0) != km_stats0.get(sk, 0)}
+        # (km keys are never REMOVED during a dispatch — the bucket-
+        # failure handler only pops keys absent at bucket start — so the
+        # current key set covers every delta)
+        km_delta = {sk: self.stats[sk] - km_stats0.get(sk, 0)
+                    for sk in self.stats
+                    if sk.startswith(_KM_STAT_PREFIXES)
+                    and self.stats[sk] != km_stats0.get(sk, 0)}
         return (out, cols, used_kernel,
                 self.stats["kernel_launches"] - launches0, km_delta)
 

@@ -108,11 +108,15 @@ class _EngineCacheBase:
         try:
             res = eng.mine()
             snap = dict(eng.stats)
-            self._scrub(eng)
             return res, snap
         finally:
-            with self._lock:
-                entry.busy = False
+            # scrub on EVERY exit (a raising mine may have left transient
+            # device state too), and always before the busy release
+            try:
+                self._scrub(eng)
+            finally:
+                with self._lock:
+                    entry.busy = False
 
     def _scrub(self, engine) -> None:
         """Drop transient device state a mine may have left on the

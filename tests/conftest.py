@@ -18,7 +18,17 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "--xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+    # XLA CPU ABORTS the whole process when an 8-way collective's
+    # participants don't all arrive within 40s — on a 1-core box the 8
+    # virtual devices timeshare one core, so a mid-scale mesh program
+    # (RUN_SLOW) can genuinely need minutes to reach the rendezvous.
+    # Raise the failure-detection deadline; a real deadlock still
+    # terminates, just later.
+    _flags = (_flags
+              + " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 

@@ -202,3 +202,14 @@ def test_stream_failure_counter_separate(server):
     after = _post(server, "/admin/stats")["jobs"]
     assert after["jobs_failed"] == before["jobs_failed"]
     assert after["stream_failures"] == before["stream_failures"] + 1
+
+
+def test_fused_accepts_engine_pins():
+    # the engine supports queue/dense pins (mine_spade_tpu); the boot
+    # vocabulary must accept them — and still reject typos
+    from spark_fsm_tpu.config import ConfigError, parse_config
+
+    for v in ("auto", "always", "never", "queue", "dense"):
+        assert parse_config({"engine": {"fused": v}}).engine.fused == v
+    with pytest.raises(ConfigError, match="fused"):
+        parse_config({"engine": {"fused": "qeue"}})
