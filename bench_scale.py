@@ -228,6 +228,7 @@ def config5(parity: bool = False) -> dict:
     batches, n_push, keep, per, datagen_s = _stream_batches()
     wm = IncrementalWindowMiner(0.005, max_batches=keep)
     walls, repaired, phases, parities = [], [], [], []
+    snaps = []  # per-push (window, minsup, patterns) for DEFERRED parity
     for batch in batches:
         before = wm.stats["repaired_nodes"]
         p0 = time.monotonic()
@@ -240,12 +241,22 @@ def config5(parity: bool = False) -> dict:
         # nodes needs its time accounted, not hand-waved to contention)
         phases.append(wm.stats.get("phase_s"))
         if parity:
-            from spark_fsm_tpu.models.oracle import mine_spade
-            from spark_fsm_tpu.utils.canonical import patterns_text
+            # snapshot now, mine the oracle AFTER the loop: an in-loop
+            # oracle (~1 min of CPU grind per push) contends with the
+            # next push's host phases and corrupts the committed walls
+            # (measured: ~9 s token-phase spikes from exactly this).
+            # Batches are frozen shallow copies, so the 5 references
+            # ARE the window content — no per-push O(window) flatten
+            snaps.append((wm.window.batches(), wm.minsup_abs(),
+                          list(wm.patterns)))
+    if parity:
+        from spark_fsm_tpu.models.oracle import mine_spade
+        from spark_fsm_tpu.utils.canonical import patterns_text
 
-            want = mine_spade(wm.window.sequences(), wm.minsup_abs())
-            parities.append(
-                patterns_text(wm.patterns) == patterns_text(want))
+        for win_batches, ms, pats in snaps:
+            seqs = [s for b in win_batches for s in b]
+            want = mine_spade(seqs, ms)
+            parities.append(patterns_text(pats) == patterns_text(want))
     out = {
         "config": "5", "scale": 1.0,
         "metric": f"streaming SPADE sliding-window FULL ({n_push} "

@@ -542,3 +542,29 @@ def test_checkpointed_wrapper_routes_queue():
     assert ck.saves, "no snapshot written despite every_s=0"
     want = mine_spade(db, minsup)
     assert patterns_text(got) == patterns_text(want)
+
+
+def test_checkpointed_queue_overflow_resumes_in_classic(monkeypatch):
+    """A queue-engine cap overflow MID-checkpointed-mine must fall back
+    to the classic engine AND resume from the queue engine's last
+    snapshot (shared frontier format + fingerprint), not restart."""
+    from spark_fsm_tpu.models import spade_queue
+
+    # caps sized so wave 1 fits (snapshot lands at its boundary) and the
+    # record buffer overflows on a later wave
+    monkeypatch.setattr(
+        spade_queue.QueueCaps, "for_budget",
+        classmethod(lambda cls, *a, **k: spade_queue.QueueCaps(
+            nb=16, ring=2048, c_cap=512, m_cap=512, r_cap=96)))
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "qovf", every_s=0.0)
+    stats: dict = {}
+    got = mine_spade_tpu(db, minsup, checkpoint=ckpt, stats_out=stats)
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+    assert stats.get("fused_overflow") is True, stats
+    # the classic fallback RESUMED the queue engine's snapshot: its
+    # stack was non-empty, not a fresh root frontier restart
+    assert stats.get("resumed_nodes", 0) > 0, stats
