@@ -41,6 +41,15 @@ File format (TOML shown; JSON with the same nesting also accepted):
     fused = "auto"                  # SPADE routing: auto / always / never
                                     # / queue / dense (engine pins)
 
+    [prewarm]
+    enabled = true                  # AOT-compile the declared envelope at boot
+    sequences = 77500               # expected dataset scale
+    items = 384                     # expected frequent-projection width
+    words = 1
+    stream_batch_sequences = 99000  # per-push micro-batch size (0 = skip)
+    stream_items = 256
+    stream_seq_floor = 99000        # pin early pushes to the steady bucket
+
 Unknown keys are rejected (a typo'd knob must not silently no-op).
 """
 
@@ -87,6 +96,42 @@ class EngineConfig:
 
 
 @dataclasses.dataclass
+class PrewarmConfig:
+    """AOT prewarm envelope (service/prewarm.py): the data geometry the
+    deployment expects to serve, declared so every compile is paid at
+    boot instead of on the first live ``/train``/``/stream`` (the 41.7 s
+    cache-miss cold start, BASELINE.json ``cold_start``).
+
+    ``sequences``/``items``/``words``: expected dataset scale and
+    frequent-projection width for batch mines (0 = skip batch shapes).
+    ``maxgap``/``maxwindow``: the cSPADE constraint pair requests will
+    carry (each pair compiles different kernels; unset = skip).
+    ``tsr``: also compile the TSR engine's static geometry.
+    ``stream_batch_sequences``/``stream_items``: the incremental
+    streaming envelope (per-push micro-batch size + window frequent-item
+    width; 0 = skip streaming shapes).  ``stream_seq_floor``: pin live
+    batch stores to at least this sequence bucket so early small pushes
+    land on the prewarmed shapes (normally = stream_batch_sequences).
+    ``checkpointed``: also compile the segmented (resumable) queue
+    programs.
+    """
+
+    enabled: bool = False
+    sequences: int = 0
+    items: int = 0
+    words: int = 1
+    maxgap: Optional[int] = None
+    maxwindow: Optional[int] = None
+    tsr: bool = False
+    stream_batch_sequences: int = 0
+    stream_items: int = 0
+    stream_seq_floor: int = 0
+    checkpointed: bool = False
+    max_tokens: int = 0  # token-table bound for store-build warming
+    # (0 = 8 x sequences; see utils/shapes.WorkloadSpec)
+
+
+@dataclasses.dataclass
 class DistributedConfig:
     """Multi-host (jax.distributed) wiring; all-defaults = single host.
 
@@ -107,6 +152,7 @@ class Config:
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     distributed: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig)
+    prewarm: PrewarmConfig = dataclasses.field(default_factory=PrewarmConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
 
 
@@ -142,6 +188,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "store": (StoreConfig, top.pop("store", {})),
         "engine": (EngineConfig, top.pop("engine", {})),
         "distributed": (DistributedConfig, top.pop("distributed", {})),
+        "prewarm": (PrewarmConfig, top.pop("prewarm", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     if top:
@@ -170,7 +217,10 @@ def load_config(path: str) -> Config:
     with open(path, "rb") as fh:
         raw = fh.read()
     if path.endswith(".toml"):
-        import tomllib
+        try:
+            import tomllib  # py >= 3.11
+        except ImportError:  # py 3.10: the API-identical backport
+            import tomli as tomllib
 
         obj = tomllib.loads(raw.decode("utf-8"))
     else:

@@ -107,7 +107,7 @@ def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    from spark_fsm_tpu.parallel.mesh import SEQ_AXIS
+    from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, shard_map
 
     kw = {"mode": "drop"} if remap else {}
 
@@ -139,7 +139,7 @@ def _store_builder(n_rows: int, n_seq: int, n_words: int, mesh,
     rep = P()
     out = P(None, SEQ_AXIS) if flat else P(None, SEQ_AXIS, None)
     n_in = 5 if remap else 4
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         init_store_shard, mesh=mesh,
         in_specs=(rep,) * n_in, out_specs=out))
 
@@ -160,6 +160,13 @@ def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
     no-op); ``n_seq`` must already be padded to a device multiple.
     ``put`` maps host token arrays to device inputs (the multi-host engine
     passes its global-replicate put; default jnp.asarray).
+
+    Token arrays are ALWAYS pow2-padded (mask-0 pads scatter nothing):
+    token-array length is a traced shape, so unpadded tokens would
+    recompile the scatter for every distinct token count — which made
+    the store-build compile unenumerable (a prewarmed deployment would
+    still pay it on the first live ``/train``).  ``bucket_tokens`` is
+    kept for call-site compatibility; padding no longer depends on it.
     """
     import jax.numpy as jnp
     import numpy as np
@@ -167,9 +174,8 @@ def scatter_build_store(vdb, n_rows: int, n_seq: int, n_words: int,
     build = _store_builder(n_rows, n_seq, n_words, mesh, flat)
     if put is None:
         put = jnp.asarray
-    ti, ts, tw, tm = vdb.tok_item, vdb.tok_seq, vdb.tok_word, vdb.tok_mask
-    if bucket_tokens:
-        ti, ts, tw, tm = pad_tokens_pow2(ti, ts, tw, tm)
+    ti, ts, tw, tm = pad_tokens_pow2(
+        vdb.tok_item, vdb.tok_seq, vdb.tok_word, vdb.tok_mask)
     return build(put(ti), put(ts), put(tw), put(tm))
 
 
@@ -194,6 +200,49 @@ def next_pow2(n: int) -> int:
     while k < n:
         k *= 2
     return k
+
+
+def device_axes(n_sequences: int, n_items: int, n_words: int, *,
+                mesh=None, use_pallas: bool = False,
+                shape_buckets: bool = False):
+    """The seq-axis/item-row sizing shared by the classic, queue, and
+    fused geometries: optional pow2 seq bucket, per-shard Pallas seq
+    block, padding to a (shards x block) multiple, and the pair
+    kernel's I_TILE-rounded item-row count.  ONE definition — these
+    numbers feed the shape keys (utils/shapes.py), and a sizing drift
+    between per-engine copies is exactly the unenumerable-compile bug
+    the registry exists to prevent.  Returns (n_seq, s_block, ni_pad)."""
+    from spark_fsm_tpu.ops import pallas_support as PS
+    from spark_fsm_tpu.parallel.mesh import pad_to_multiple
+
+    n_seq = int(n_sequences)
+    if shape_buckets:
+        n_seq = bucket_seq(n_seq)
+    n_shards = 1 if mesh is None else mesh.devices.size
+    s_block = min(PS.seq_block(n_words),
+                  pad_to_multiple(-(-n_seq // n_shards), 128))
+    mult = n_shards * s_block if use_pallas else n_shards
+    n_seq = pad_to_multiple(n_seq, mult)
+    ni_pad = pad_to_multiple(max(n_items, 1), PS.I_TILE)
+    return n_seq, s_block, ni_pad
+
+
+def concat_pow2(outs):
+    """Concatenate per-chunk support outputs with the ARITY padded to a
+    power of two (all-zero chunks; callers slice to the live candidate
+    count anyway).  jnp.concatenate compiles one program per input
+    count, and the raw arity ceil(n_cand/chunk) is unbounded — pow2
+    bucketing makes the program set log-sized, hence enumerable by the
+    prewarm driver (service/prewarm.py warms the ladder).  The padding
+    cost is <2x on a ~KB-per-chunk int32 array — noise next to the
+    support kernels that produced it."""
+    import jax.numpy as jnp
+
+    cap = next_pow2(len(outs))
+    if cap != len(outs):
+        z = jnp.zeros_like(outs[0])
+        outs = list(outs) + [z] * (cap - len(outs))
+    return jnp.concatenate(outs)
 
 
 def bucket_seq(n_seq: int) -> int:

@@ -37,12 +37,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    FrontierNode, SlotPool, auto_pool_bytes, bucket_seq, decode_frontier,
-    encode_frontier, launch_width_cap, load_checkpoint, next_pow2,
-    scatter_build_store, zeros_fn)
+    FrontierNode, SlotPool, auto_pool_bytes, bucket_seq, concat_pow2,
+    decode_frontier, encode_frontier, launch_width_cap, load_checkpoint,
+    next_pow2, scatter_build_store, zeros_fn)
 from spark_fsm_tpu.ops import maxstart_jax as MS
 from spark_fsm_tpu.parallel import multihost as MH
-from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
+from spark_fsm_tpu.utils import shapes
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
 Step = Tuple[int, bool]
@@ -51,6 +52,63 @@ Step = Tuple[int, bool]
 # the ONE frontier-node shape every engine snapshots (see _common);
 # here s_list holds siblings when maxgap is None, else all roots
 _Node = FrontierNode
+
+
+def cspade_geometry(n_sequences: int, n_items: int, n_words: int, *,
+                    maxgap: Optional[int] = None,
+                    maxwindow: Optional[int] = None,
+                    mesh: Optional[Mesh] = None, chunk: int = 256,
+                    node_batch: int = 32, pipeline_depth: int = 4,
+                    recompute_chunk: int = 32,
+                    pool_bytes: Optional[int] = None,
+                    shape_buckets: bool = False) -> dict:
+    """Derived device geometry of a :class:`ConstrainedSpadeTPU` —
+    shared by the constructor and the shape-key enumerator
+    (utils/shapes.py).  maxgap/maxwindow ride in the shape key because
+    ``_cspade_fns`` compiles a DIFFERENT kernel set per constraint pair
+    (and per state dtype), even at identical array shapes."""
+    n_seq = int(n_sequences)
+    item_rows = n_items
+    if shape_buckets:
+        n_seq = bucket_seq(n_seq)
+        item_rows = max(16, next_pow2(n_items))
+    if mesh is not None:
+        n_seq = pad_to_multiple(n_seq, mesh.devices.size)
+    n_pos = n_words * 32
+    state_bits = 8 if n_pos <= 127 else 16
+    dtype = jnp.int8 if state_bits == 8 else jnp.int16
+
+    # Same budget/invariant accounting as the unconstrained engine: the
+    # pool shares HBM with pipeline_depth in-flight (m, pm) preps (2
+    # slot-equivalents per node each), and node_batch is bounded so
+    # in-flight batches can never starve a recompute.
+    if pool_bytes is None:
+        pool_bytes = auto_pool_bytes(mesh)
+    slot_bytes = n_seq * n_pos * np.dtype(dtype.dtype).itemsize
+    # memory-safety ceiling on per-launch candidate tensors (see
+    # _common.launch_width_cap: [chunk, S, n_pos] temps scale with
+    # the sequence axis, and a fixed width OOMs at ~1M sequences)
+    n_shards = 1 if mesh is None else mesh.devices.size
+    max_chunk = launch_width_cap(
+        pool_bytes, -(-slot_bytes // n_shards), 4)
+    chunk = min(int(chunk), max_chunk)
+    recompute_chunk = min(int(recompute_chunk), max(2, max_chunk // 2))
+    budget_slots = max(32, min(int(pool_bytes) // max(slot_bytes, 1), 8192))
+    pipeline_depth = min(max(1, int(pipeline_depth)),
+                         max(1, budget_slots // 8))
+    d = pipeline_depth
+    nb = max(1, min(int(node_batch), budget_slots // (3 * (d + 2))))
+    pool_slots = max(8, budget_slots - 2 * d * nb)
+    return {
+        "n_seq": n_seq, "item_rows": item_rows, "n_pos": n_pos,
+        "dtype": dtype, "state_bits": state_bits, "chunk": chunk,
+        "recompute_chunk": recompute_chunk,
+        "pipeline_depth": pipeline_depth, "node_batch": nb,
+        "pool_slots": pool_slots,
+        "shape_key": shapes.key_cspade(n_seq, n_words, item_rows,
+                                       pool_slots, nb, chunk, maxgap,
+                                       maxwindow, state_bits),
+    }
 
 
 @functools.lru_cache(maxsize=64)
@@ -111,17 +169,17 @@ def _cspade_fns(mesh: Optional[Mesh], maxgap: Optional[int],
     st = P(None, SEQ_AXIS, None)
     rep = P()
     return {
-        "prep": jax.jit(jax.shard_map(
+        "prep": jax.jit(shard_map(
             prep_body, mesh=mesh, in_specs=(st, st, rep, rep, rep),
             out_specs=(st, st))),
-        "supports": jax.jit(jax.shard_map(
+        "supports": jax.jit(shard_map(
             supports_body, mesh=mesh,
             in_specs=(st, st, st, rep, rep, rep), out_specs=rep)),
-        "materialize": jax.jit(jax.shard_map(
+        "materialize": jax.jit(shard_map(
             materialize_body, mesh=mesh,
             in_specs=(st, st, st, st, rep, rep, rep, rep), out_specs=st),
             donate_argnums=3),
-        "recompute": jax.jit(jax.shard_map(
+        "recompute": jax.jit(shard_map(
             recompute_body, mesh=mesh,
             in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
             donate_argnums=0),
@@ -153,9 +211,6 @@ class ConstrainedSpadeTPU:
         # Multi-host mesh: host-side inputs must become global replicated
         # arrays (see parallel/multihost.py)
         self._put = functools.partial(MH.host_to_device, mesh)
-        self.chunk = int(chunk)
-        self.pipeline_depth = max(1, int(pipeline_depth))
-        self.recompute_chunk = int(recompute_chunk)
         self.max_pattern_itemsets = max_pattern_itemsets
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
@@ -165,40 +220,25 @@ class ConstrainedSpadeTPU:
         # same trade as the unconstrained engine (spade_tpu.py).  Extra
         # item rows hold all-zero bitmaps; candidate indices stay < n_items.
         self._shape_buckets = bool(shape_buckets)
-        item_rows = n_items
-        if self._shape_buckets:
-            n_seq = bucket_seq(n_seq)
-            item_rows = max(16, next_pow2(n_items))
-        if mesh is not None:
-            n_seq = pad_to_multiple(n_seq, mesh.devices.size)
+        # Derived sizing lives in cspade_geometry — shared with the
+        # shape-key enumerator (utils/shapes.py).
+        g = cspade_geometry(
+            n_seq, n_items, n_words, maxgap=maxgap, maxwindow=maxwindow,
+            mesh=mesh, chunk=chunk, node_batch=node_batch,
+            pipeline_depth=pipeline_depth, recompute_chunk=recompute_chunk,
+            pool_bytes=pool_bytes, shape_buckets=self._shape_buckets)
+        n_seq = g["n_seq"]
+        item_rows = g["item_rows"]
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
-        self.n_pos = n_words * 32
-        self.dtype = jnp.int8 if self.n_pos <= 127 else jnp.int16
-
-        # Same budget/invariant accounting as the unconstrained engine: the
-        # pool shares HBM with pipeline_depth in-flight (m, pm) preps (2
-        # slot-equivalents per node each), and node_batch is bounded so
-        # in-flight batches can never starve a recompute.
-        if pool_bytes is None:
-            pool_bytes = auto_pool_bytes(mesh)
-        slot_bytes = n_seq * self.n_pos * np.dtype(self.dtype.dtype).itemsize
-        # memory-safety ceiling on per-launch candidate tensors (see
-        # _common.launch_width_cap: [chunk, S, n_pos] temps scale with
-        # the sequence axis, and a fixed width OOMs at ~1M sequences)
-        n_shards = 1 if mesh is None else mesh.devices.size
-        max_chunk = launch_width_cap(
-            pool_bytes, -(-slot_bytes // n_shards), 4)
-        self.chunk = min(self.chunk, max_chunk)
-        self.recompute_chunk = min(self.recompute_chunk,
-                                   max(2, max_chunk // 2))
-        budget_slots = max(32, min(int(pool_bytes) // max(slot_bytes, 1), 8192))
-        self.pipeline_depth = min(self.pipeline_depth,
-                                  max(1, budget_slots // 8))
-        d = self.pipeline_depth
-        nb = max(1, min(int(node_batch), budget_slots // (3 * (d + 2))))
-        pool_slots = max(8, budget_slots - 2 * d * nb)
+        self.n_pos = g["n_pos"]
+        self.dtype = g["dtype"]
+        self.chunk = g["chunk"]
+        self.recompute_chunk = g["recompute_chunk"]
+        self.pipeline_depth = g["pipeline_depth"]
+        pool_slots = g["pool_slots"]
         self.pool_slots = pool_slots
-        self.node_batch = nb
+        self.item_rows = item_rows
+        self.node_batch = g["node_batch"]
         self.scratch = pool_slots
         # Scatter-build the item bitmaps IN HBM from the token table and
         # allocate the state pool on device — neither the dense bitmaps nor
@@ -215,13 +255,20 @@ class ConstrainedSpadeTPU:
         # items per node (the unsound-sibling-prune rule), so its share of
         # the candidate volume is the cost of that constraint — measured
         # here, surfaced through job stats.  shape_key: compiled-geometry
-        # identity (same contract as SpadeTPU.stats).
+        # identity (same contract as SpadeTPU.stats), registry-recorded.
         self.stats = {"candidates": 0, "s_candidates": 0, "i_candidates": 0,
                       "kernel_launches": 0, "recomputed_nodes": 0,
                       "reclaimed_slots": 0, "patterns": 0,
-                      "shape_key": (f"cspade:s{n_seq}w{n_words}"
-                                    f"i{item_rows}p{pool_slots}"
-                                    f"nb{nb}c{self.chunk}")}
+                      "shape_key": g["shape_key"]}
+        shapes.record(g["shape_key"])
+
+    def nbytes(self) -> int:
+        """Device working set held BETWEEN mines (items store + state
+        pool) — what a devcache entry pins in HBM."""
+        item_bytes = self.item_rows * self.n_seq * self.n_words * 4
+        pool_bytes = ((self.pool_slots + 1) * self.n_seq * self.n_pos
+                      * np.dtype(self.dtype.dtype).itemsize)
+        return item_bytes + pool_bytes
 
     # ------------------------------------------------------------------ fns
 
@@ -310,7 +357,7 @@ class ConstrainedSpadeTPU:
             self.stats["kernel_launches"] += 1
         if out_slot is not None:
             return None
-        sup = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        sup = outs[0] if len(outs) == 1 else concat_pow2(outs)
         try:
             sup.copy_to_host_async()
         except (AttributeError, NotImplementedError):

@@ -48,12 +48,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.vertical import VerticalDB
 from spark_fsm_tpu.models._common import (
-    bucket_seq, next_pow2, scatter_build_store)
+    bucket_seq, device_axes, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel import multihost as MH
-from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
+from spark_fsm_tpu.utils import shapes
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
+
+
+def fused_geometry(n_sequences: int, n_items: int, n_words: int, *,
+                   mesh: Optional[Mesh] = None, use_pallas: bool = False,
+                   shape_buckets: bool = False,
+                   caps: Optional["FusedCaps"] = None) -> dict:
+    """Derived device geometry of a :class:`FusedSpadeTPU` — shared by
+    the constructor and the shape-key enumerator (utils/shapes.py)."""
+    caps = caps or FusedCaps.for_mesh(mesh)
+    n_seq, s_block, ni_pad = device_axes(
+        n_sequences, n_items, n_words, mesh=mesh, use_pallas=use_pallas,
+        shape_buckets=shape_buckets)
+    return {"n_seq": n_seq, "s_block": s_block, "ni_pad": ni_pad,
+            "caps": caps,
+            "shape_key": shapes.key_fused(n_seq, n_words, ni_pad,
+                                          caps.f_cap)}
 
 
 def _dense_pair_jnp(pt3: jax.Array, items3: jax.Array, i_tile: int = 128,
@@ -361,7 +378,7 @@ def _fused_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
     st = P(None, SEQ_AXIS)
     rep = P()
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=mesh,
             in_specs=(st, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep),
             out_specs=(rep, rep),
@@ -405,23 +422,22 @@ class FusedSpadeTPU:
         # shape_buckets: pow2-bucket the sequence axis (and the item-row
         # count, via ni_pad below on the bucketed alphabet) so streaming
         # windows with drifting sizes reuse the compiled program — same
-        # trade as the classic engine's shape_buckets.
-        if shape_buckets:
-            n_seq = bucket_seq(n_seq)
-        n_shards = 1 if mesh is None else mesh.devices.size
-        self._s_block = min(PS.seq_block(n_words),
-                            pad_to_multiple(-(-n_seq // n_shards), 128))
-        mult = n_shards * self._s_block if self.use_pallas else n_shards
-        n_seq = pad_to_multiple(n_seq, mult)
+        # trade as the classic engine's shape_buckets.  Derived sizing
+        # lives in fused_geometry, shared with the shape-key enumerator.
+        g = fused_geometry(n_seq, n_items, n_words, mesh=mesh,
+                           use_pallas=self.use_pallas,
+                           shape_buckets=shape_buckets, caps=self.caps)
+        n_seq = g["n_seq"]
+        self._s_block = g["s_block"]
         self.n_seq, self.n_words = n_seq, n_words
-        self.ni_pad = pad_to_multiple(max(n_items, 1), PS.I_TILE)
+        self.ni_pad = g["ni_pad"]
         self.n_items = n_items
         # shape_key: compiled-geometry identity (same contract as
         # SpadeTPU.stats) — distinct keys across a stream of mines bound
-        # its recompile count
+        # its recompile count; registry-recorded for /admin/shapes
         self.stats = {"patterns": 0, "levels": 0, "fused": True,
-                      "shape_key": (f"fused:s{self.n_seq}w{n_words}"
-                                    f"ni{self.ni_pad}f{self.caps.f_cap}")}
+                      "shape_key": g["shape_key"]}
+        shapes.record(g["shape_key"])
 
     def nbytes(self) -> int:
         rows = self.ni_pad + 2 * self.caps.f_cap + 1

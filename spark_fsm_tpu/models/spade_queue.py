@@ -57,14 +57,40 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_fsm_tpu.data.vertical import VerticalDB
 from spark_fsm_tpu.models._common import (
-    FrontierNode, bucket_seq, decode_frontier, device_hbm_budget,
-    encode_frontier, next_pow2, scatter_build_store)
+    FrontierNode, bucket_seq, decode_frontier, device_axes,
+    device_hbm_budget, encode_frontier, next_pow2, scatter_build_store)
 from spark_fsm_tpu.models.spade_fused import _dense_pair_jnp
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel import multihost as MH
-from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
+from spark_fsm_tpu.utils import shapes
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
+
+
+def queue_geometry(n_sequences: int, n_items: int, n_words: int, *,
+                   mesh: Optional[Mesh] = None, use_pallas: bool = False,
+                   shape_buckets: bool = False,
+                   caps: Optional["QueueCaps"] = None) -> dict:
+    """Derived device geometry of a :class:`QueueSpadeTPU` — shared by
+    the constructor and the shape-key enumerator (utils/shapes.py); pure
+    host arithmetic, no device allocation (the budget probe reads device
+    metadata only)."""
+    import jax as _jax
+
+    n_shards = 1 if mesh is None else mesh.devices.size
+    n_seq, s_block, ni_pad = device_axes(
+        n_sequences, n_items, n_words, mesh=mesh, use_pallas=use_pallas,
+        shape_buckets=shape_buckets)
+    if caps is None:
+        dev = mesh.devices.flat[0] if mesh is not None else _jax.devices()[0]
+        caps = QueueCaps.for_budget(
+            n_seq * n_words * 4, ni_pad,
+            int(0.45 * device_hbm_budget(dev)), n_shards)
+    return {"n_seq": n_seq, "s_block": s_block, "ni_pad": ni_pad,
+            "caps": caps,
+            "shape_key": shapes.key_queue(n_seq, n_words, ni_pad,
+                                          caps.nb, caps.ring)}
 
 
 class QueueCaps:
@@ -247,7 +273,7 @@ def _queue_refill_fn(mesh: Optional[Mesh], n_words: int,
         return jax.jit(fill)
     st = P(None, SEQ_AXIS)
     rep = P()
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fill, mesh=mesh, in_specs=(st, rep, rep, rep, rep),
         out_specs=st, check_vma=False))
 
@@ -443,7 +469,7 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
         st = P(None, SEQ_AXIS)
         rep = P()
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 run, mesh=mesh,
                 in_specs=(st, rep, rep, rep, rep, rep, rep, rep, rep, rep),
                 out_specs=rep,
@@ -455,7 +481,7 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
     rep = P()
     carry_specs = (st,) + (rep,) * 14
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             run_seg, mesh=mesh,
             in_specs=carry_specs + (rep,),
             out_specs=(carry_specs, rep),
@@ -499,26 +525,22 @@ class QueueSpadeTPU:
             self.use_pallas = bool(use_pallas) and n_items > 0
         self._interpret = jax.default_backend() != "tpu"
 
-        if shape_buckets:
-            n_seq = bucket_seq(n_seq)
-        n_shards = 1 if mesh is None else mesh.devices.size
-        self._s_block = min(PS.seq_block(n_words),
-                            pad_to_multiple(-(-n_seq // n_shards), 128))
-        mult = n_shards * self._s_block if self.use_pallas else n_shards
-        n_seq = pad_to_multiple(n_seq, mult)
+        # Derived sizing lives in queue_geometry — shared with the
+        # shape-key enumerator (utils/shapes.py) so prewarm's key set is
+        # exactly what this constructor fixes.
+        g = queue_geometry(n_seq, n_items, n_words, mesh=mesh,
+                           use_pallas=self.use_pallas,
+                           shape_buckets=shape_buckets, caps=caps)
+        n_seq = g["n_seq"]
+        self._s_block = g["s_block"]
         self.n_seq, self.n_words = n_seq, n_words
-        self.ni_pad = pad_to_multiple(max(n_items, 1), PS.I_TILE)
+        self.ni_pad = g["ni_pad"]
         self.n_items = n_items
-        if caps is None:
-            dev = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
-            caps = QueueCaps.for_budget(
-                n_seq * n_words * 4, self.ni_pad,
-                int(0.45 * device_hbm_budget(dev)), n_shards)
+        caps = g["caps"]
         self.caps = caps
         self.stats = {"patterns": 0, "waves": 0, "fused": "queue",
-                      "shape_key": (f"queue:s{self.n_seq}w{n_words}"
-                                    f"ni{self.ni_pad}nb{caps.nb}"
-                                    f"r{caps.ring}")}
+                      "shape_key": g["shape_key"]}
+        shapes.record(g["shape_key"])
 
         rows = self.ni_pad + caps.ring + 1
         self.store = scatter_build_store(

@@ -51,13 +51,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_fsm_tpu.data.spmf import SequenceDB
 from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
 from spark_fsm_tpu.models._common import (
-    FrontierNode, SlotPool, auto_pool_bytes, bucket_seq, decode_frontier,
-    encode_frontier, launch_width_cap, load_checkpoint, next_pow2,
-    scatter_build_store)
+    FrontierNode, SlotPool, auto_pool_bytes, concat_pow2, decode_frontier,
+    device_axes, encode_frontier, launch_width_cap, load_checkpoint,
+    next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.parallel import multihost as MH
-from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
+from spark_fsm_tpu.utils import shapes
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
 Step = Tuple[int, bool]  # (item index, is_s_extension)
@@ -65,6 +66,84 @@ Step = Tuple[int, bool]  # (item index, is_s_extension)
 
 # the ONE frontier-node shape every engine snapshots (see _common)
 _Node = FrontierNode
+
+
+def classic_geometry(n_sequences: int, n_items: int, n_words: int, *,
+                     mesh: Optional[Mesh] = None, chunk: int = 2048,
+                     node_batch: int = 1024, pipeline_depth: int = 4,
+                     recompute_chunk: int = 256,
+                     pool_bytes: Optional[int] = None,
+                     use_pallas: bool = False,
+                     shape_buckets: bool = False) -> dict:
+    """Derived device geometry of a :class:`SpadeTPU` — the ONE sizing
+    routine shared by the constructor and the shape-key enumerator
+    (utils/shapes.py), so "what will compile" cannot drift from "what
+    does compile".  Pure host arithmetic: no device allocation.
+
+    ``use_pallas`` must be the RESOLVED boolean (the constructor probes
+    the backend; the enumerator passes the service's resolution)."""
+    n_shards = 1 if mesh is None else mesh.devices.size
+    # ni_tile: the pair kernel's static item-row arg, pre-rounded to its
+    # I_TILE — passing raw n_items would recompile the kernel for every
+    # distinct alphabet size even though the lowered grid only changes
+    # per tile of 128 (matters for streaming, where the frequent-item
+    # projection drifts a little every window)
+    n_seq, s_block, ni_tile = device_axes(
+        n_sequences, n_items, n_words, mesh=mesh, use_pallas=use_pallas,
+        shape_buckets=shape_buckets)
+
+    # HBM budget covers the slot pool PLUS the in-flight prep tensors
+    # (each pipelined batch holds a [2*node_batch, S, W] prep), and
+    # node_batch is bounded so pipeline_depth in-flight batches can
+    # never starve a recompute: slots held in flight <= depth*nb, so
+    # free+stack-reclaimable >= pool - (depth+1)*nb >= nb holds whenever
+    # nb <= pool // (depth+2).
+    if pool_bytes is None:
+        # each blocking readback on a tunneled TPU costs ~130ms of
+        # latency, so bigger batches (= fewer DFS sync points) are
+        # worth real memory
+        pool_bytes = auto_pool_bytes(mesh)
+    slot_bytes = n_seq * n_words * 4
+    # Memory-safety ceiling on launch widths (see launch_width_cap) —
+    # overrides even an explicit chunk knob; per-device row footprint,
+    # since mesh launches shard the sequence axis.
+    max_chunk = launch_width_cap(
+        pool_bytes, -(-slot_bytes // n_shards), 8)
+    chunk = min(int(chunk), max_chunk)
+    recompute_chunk = min(int(recompute_chunk), max(4, max_chunk // 2))
+    budget_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 32768))
+    pipeline_depth = min(max(1, int(pipeline_depth)),
+                         max(1, budget_slots // 8))
+    d = pipeline_depth
+    nb = max(1, min(int(node_batch), budget_slots // (3 * (d + 2))))
+    pool_slots = max(8, budget_slots - 2 * d * nb)
+    total = n_items + pool_slots + 1
+    floor_rows = n_items + 8 + 1  # min rows: items + minimal pool + scratch
+    if use_pallas:  # pair kernel reads item rows rounded to I_TILE
+        floor_rows = max(floor_rows, ni_tile)
+        total = max(total, ni_tile)
+    if shape_buckets:
+        # Round the store row count up too and hand the extra rows to
+        # the pool (pool SIZE is host-only state; only the row COUNT is
+        # a device shape).  Rounding UP can overshoot the pool_bytes
+        # budget by up to 2x, so when it does — and a pow2 below still
+        # fits the items + a minimal pool — round DOWN instead and
+        # re-clamp node_batch to keep the recompute-starvation
+        # invariant (nb <= pool // (3*(d+2))).
+        total = next_pow2(total)
+        budget_rows = n_items + 1 + budget_slots
+        if total > budget_rows and total // 2 >= floor_rows:
+            total //= 2
+        pool_slots = total - n_items - 1
+        nb = max(1, min(nb, pool_slots // (3 * (d + 2))))
+    return {
+        "n_seq": n_seq, "s_block": s_block, "ni_tile": ni_tile,
+        "chunk": chunk, "recompute_chunk": recompute_chunk,
+        "pipeline_depth": pipeline_depth, "node_batch": nb,
+        "pool_slots": pool_slots, "total_rows": total,
+        "scratch": n_items + pool_slots,
+        "shape_key": shapes.key_classic(n_seq, n_words, total, nb, chunk),
+    }
 
 
 @functools.lru_cache(maxsize=64)
@@ -137,17 +216,17 @@ def _spade_fns(mesh: Optional[Mesh], n_words: int):
     rep = P()
     return {
         "prep": jax.jit(
-            jax.shard_map(prep_body, mesh=mesh,
+            shard_map(prep_body, mesh=mesh,
                           in_specs=(st, rep), out_specs=st)),
         "supports": jax.jit(
-            jax.shard_map(supports_body, mesh=mesh,
+            shard_map(supports_body, mesh=mesh,
                           in_specs=(st, st, rep, rep, rep), out_specs=rep)),
         "materialize": jax.jit(
-            jax.shard_map(materialize_body, mesh=mesh,
+            shard_map(materialize_body, mesh=mesh,
                           in_specs=(st, st, rep, rep, rep, rep), out_specs=st),
             donate_argnums=1),
         "recompute": jax.jit(
-            jax.shard_map(recompute_body, mesh=mesh,
+            shard_map(recompute_body, mesh=mesh,
                           in_specs=(st, rep, rep, rep, rep), out_specs=st),
             donate_argnums=0),
     }
@@ -195,7 +274,7 @@ def _pallas_supports_fn(mesh: Mesh, n_items: int, s_block: int,
     # silently knocked the whole mesh path onto the jnp fallback on
     # hardware).
     return jax.jit(
-        jax.shard_map(pallas_supports_body, mesh=mesh,
+        shard_map(pallas_supports_body, mesh=mesh,
                       in_specs=(st, items_spec, rep, rep),
                       out_specs=rep,
                       check_vma=False))
@@ -240,9 +319,6 @@ class SpadeTPU:
         # global replicated arrays; see parallel/multihost.py.
         self._multiproc = MH.is_multihost(mesh)
         self._put = functools.partial(MH.host_to_device, mesh)
-        self.chunk = int(chunk)
-        self.pipeline_depth = max(1, int(pipeline_depth))
-        self.recompute_chunk = int(recompute_chunk)
         self.max_pattern_itemsets = max_pattern_itemsets
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
@@ -256,11 +332,6 @@ class SpadeTPU:
         else:
             self.use_pallas = bool(use_pallas) and eligible
         self._pallas_interpret = jax.default_backend() != "tpu"
-        # seq-axis padding: a device multiple for the mesh shards, times the
-        # kernel's seq-block so every shard tiles evenly.  The block shrinks
-        # (floor 128 lanes) for small databases so padding stays bounded by
-        # the lane width, not by devices * 4096.
-        n_shards = 1 if mesh is None else mesh.devices.size
         # shape_buckets: round the device shapes up to powers of two so a
         # stream of engines over growing/sliding windows (streaming/window.py
         # re-mines per micro-batch) lands on a handful of compiled shapes
@@ -268,68 +339,26 @@ class SpadeTPU:
         # Trades bounded padding (<2x seq axis / store rows) for shape reuse;
         # padded sequences are all-zero bitmaps and count nothing.
         self._shape_buckets = bool(shape_buckets)
-        if self._shape_buckets:
-            n_seq = bucket_seq(n_seq)
-        self._s_block = min(PS.seq_block(n_words),
-                            pad_to_multiple(-(-n_seq // n_shards), 128))
-        mult = n_shards * self._s_block if self.use_pallas else n_shards
-        n_seq = pad_to_multiple(n_seq, mult)
+        # All derived sizing lives in classic_geometry — the one routine
+        # the shape-key enumerator (utils/shapes.py) shares, so the keys
+        # prewarm compiles are exactly the keys this constructor will fix.
+        g = classic_geometry(
+            n_seq, n_items, n_words, mesh=mesh, chunk=chunk,
+            node_batch=node_batch, pipeline_depth=pipeline_depth,
+            recompute_chunk=recompute_chunk, pool_bytes=pool_bytes,
+            use_pallas=self.use_pallas,
+            shape_buckets=self._shape_buckets)
+        n_seq = g["n_seq"]
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
-        # the pair kernel's static item-row arg, pre-rounded to its I_TILE:
-        # passing raw n_items would recompile the kernel for every distinct
-        # alphabet size even though the lowered grid only changes per tile
-        # of 128 (matters for streaming, where the frequent-item projection
-        # drifts a little every window)
-        self._ni_tile = pad_to_multiple(max(n_items, 1), PS.I_TILE)
-
-        # HBM budget covers the slot pool PLUS the in-flight prep tensors
-        # (each pipelined batch holds a [2*node_batch, S, W] prep), and
-        # node_batch is bounded so pipeline_depth in-flight batches can
-        # never starve a recompute: slots held in flight <= depth*nb, so
-        # free+stack-reclaimable >= pool - (depth+1)*nb >= nb holds whenever
-        # nb <= pool // (depth+2).
-        if pool_bytes is None:
-            # each blocking readback on a tunneled TPU costs ~130ms of
-            # latency, so bigger batches (= fewer DFS sync points) are
-            # worth real memory
-            pool_bytes = auto_pool_bytes(mesh)
-        slot_bytes = n_seq * n_words * 4
-        # Memory-safety ceiling on launch widths (see launch_width_cap) —
-        # overrides even an explicit chunk knob; per-device row footprint,
-        # since mesh launches shard the sequence axis.
-        max_chunk = launch_width_cap(
-            pool_bytes, -(-slot_bytes // n_shards), 8)
-        self.chunk = min(self.chunk, max_chunk)
-        self.recompute_chunk = min(self.recompute_chunk,
-                                   max(4, max_chunk // 2))
-        budget_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 32768))
-        self.pipeline_depth = min(self.pipeline_depth,
-                                  max(1, budget_slots // 8))
-        d = self.pipeline_depth
-        nb = max(1, min(int(node_batch), budget_slots // (3 * (d + 2))))
-        pool_slots = max(8, budget_slots - 2 * d * nb)
-        total = n_items + pool_slots + 1
-        floor_rows = n_items + 8 + 1  # min rows: items + minimal pool + scratch
-        if self.use_pallas:  # pair kernel reads item rows rounded to I_TILE
-            floor_rows = max(floor_rows, self._ni_tile)
-            total = max(total, self._ni_tile)
-        if self._shape_buckets:
-            # Round the store row count up too and hand the extra rows to
-            # the pool (pool SIZE is host-only state; only the row COUNT is
-            # a device shape).  Rounding UP can overshoot the pool_bytes
-            # budget by up to 2x, so when it does — and a pow2 below still
-            # fits the items + a minimal pool — round DOWN instead and
-            # re-clamp node_batch to keep the recompute-starvation
-            # invariant (nb <= pool // (3*(d+2))).
-            total = next_pow2(total)
-            budget_rows = n_items + 1 + budget_slots
-            if total > budget_rows and total // 2 >= floor_rows:
-                total //= 2
-            pool_slots = total - n_items - 1
-            nb = max(1, min(nb, pool_slots // (3 * (d + 2))))
-        self.pool_slots = pool_slots
-        self.node_batch = nb
-        self.scratch = n_items + pool_slots
+        self._s_block = g["s_block"]
+        self._ni_tile = g["ni_tile"]
+        self.chunk = g["chunk"]
+        self.recompute_chunk = g["recompute_chunk"]
+        self.pipeline_depth = g["pipeline_depth"]
+        self.pool_slots = g["pool_slots"]
+        self.node_batch = g["node_batch"]
+        self.scratch = g["scratch"]
+        total = g["total_rows"]
 
         self.store = scatter_build_store(vdb, total, n_seq, n_words,
                                          mesh=mesh, put=self._put,
@@ -344,7 +373,7 @@ class SpadeTPU:
         if self.use_pallas and n_words > 1:
             self._items_t = _items_transpose(mesh, self._ni_tile,
                                              n_words)(self.store)
-        self._pool = SlotPool(range(n_items, n_items + pool_slots))
+        self._pool = SlotPool(range(n_items, n_items + self.pool_slots))
         self._build_fns()
 
         # mining statistics (observability, SURVEY.md sec 5).  shape_key
@@ -352,12 +381,14 @@ class SpadeTPU:
         # keys reuse every compiled program, so the number of DISTINCT
         # keys across a stream of mines bounds its recompile count — the
         # quantity shape_buckets exists to hold down (streaming/window.py).
+        # Recorded in the process-wide registry so /admin/shapes can diff
+        # observed geometry against the prewarm enumeration.
         self.stats = {
             "candidates": 0, "kernel_launches": 0, "recomputed_nodes": 0,
             "reclaimed_slots": 0, "patterns": 0,
-            "shape_key": (f"classic:s{self.n_seq}w{n_words}"
-                          f"r{total}nb{self.node_batch}c{self.chunk}"),
+            "shape_key": g["shape_key"],
         }
+        shapes.record(g["shape_key"])
 
     # ------------------------------------------------------------------ fns
 
@@ -461,7 +492,7 @@ class SpadeTPU:
                 ref.astype(np.int32), item.astype(np.int32), iss.astype(bool)):
             outs.append(self._supports_fn(prep, self.store, r, it, ss))
             self.stats["kernel_launches"] += 1
-        sup = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        sup = outs[0] if len(outs) == 1 else concat_pow2(outs)
         try:
             sup.copy_to_host_async()
         except (AttributeError, NotImplementedError):

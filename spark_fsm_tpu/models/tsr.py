@@ -48,8 +48,31 @@ from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.parallel import multihost as MH
-from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, store_sharding
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
+from spark_fsm_tpu.utils import shapes
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
+
+
+def tsr_geometry(n_sequences: int, n_words: int, *,
+                 mesh: Optional[Mesh] = None, use_pallas: bool = False,
+                 shape_buckets: bool = False) -> dict:
+    """Static device geometry of a :class:`TsrTPU` (the per-round top-m
+    and km-bucket shapes vary by design) — shared by the constructor and
+    the shape-key enumerator (utils/shapes.py)."""
+    n_seq = int(n_sequences)
+    if shape_buckets:
+        n_seq = bucket_seq(n_seq)
+    n_shards = 1 if mesh is None else mesh.devices.size
+    if mesh is not None:
+        n_seq = pad_to_multiple(n_seq, n_shards)
+    sb = None
+    if use_pallas:
+        # per-shard seq axis must tile the kernel's seq block, which
+        # itself must tile the folded (8, 128) layout
+        sb = PT.seq_block(n_words, -(-n_seq // n_shards))
+        n_seq = pad_to_multiple(n_seq, n_shards * sb)
+    return {"n_seq": n_seq, "sb": sb,
+            "shape_key": shapes.key_tsr(n_seq, n_words)}
 
 
 def conf_ok(sup: int, supx: int, minconf: float) -> bool:
@@ -140,7 +163,7 @@ def _prep_fn_mesh(mesh: Mesh):
         return B.prefix_or_incl(b), B.suffix_or_incl(b)
 
     st = P(None, SEQ_AXIS, None)
-    return jax.jit(jax.shard_map(body, mesh=mesh,
+    return jax.jit(shard_map(body, mesh=mesh,
                                  in_specs=(st,), out_specs=(st, st)))
 
 
@@ -166,7 +189,7 @@ def _kernel_layout_fn(mesh: Optional[Mesh], single: bool):
     st_in = P(None, SEQ_AXIS, None)
     st_out = (P(None, SEQ_AXIS, None) if single
               else P(None, None, SEQ_AXIS, None))
-    return jax.jit(jax.shard_map(body, mesh=mesh,
+    return jax.jit(shard_map(body, mesh=mesh,
                                  in_specs=(st_in,), out_specs=st_out))
 
 
@@ -186,7 +209,7 @@ def _kernel_eval_fn(mesh: Optional[Mesh], km: int, sb: int,
         return jax.jit(body)
     st = (P(None, SEQ_AXIS, None) if single
           else P(None, None, SEQ_AXIS, None))
-    return jax.jit(jax.shard_map(body, mesh=mesh,
+    return jax.jit(shard_map(body, mesh=mesh,
                                  in_specs=(st, st, P()), out_specs=P()))
 
 
@@ -225,7 +248,7 @@ def _eval_kernel(mesh: Optional[Mesh], kmax: int):
         return jax.jit(body)
     st = P(None, SEQ_AXIS, None)
     rep = P()
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh, in_specs=(st, st, rep), out_specs=rep))
 
 
@@ -247,6 +270,10 @@ class TsrTPU:
     # depth 2 = 14.2s, depth 3 = 9.8s, depth 4 = 9.5s — 3 takes most of
     # the win with the least stale-minsup overspeculation)
     PIPELINE_DEPTH = 3
+
+    # compiled-geometry registry participation (utils/shapes.py); the
+    # NumPy TsrCPU subclass opts out — it compiles nothing
+    _RECORD_SHAPES = True
 
     def __init__(
         self,
@@ -278,7 +305,6 @@ class TsrTPU:
         # (~41k items x ~990k sequences) the full dense store is ~160 GB.
         # Each deepening round instead builds ONLY the top-m item rows from
         # the token table (host memory/HBM proportional to m, not n_items).
-        self.n_seq = vdb.n_sequences
         # shape_buckets: pow2-bucket the sequence axis so streaming rule
         # windows with drifting geometry reuse compiled programs; padded
         # sequences hold all-zero bitmaps and support nothing.  Same knob
@@ -288,11 +314,6 @@ class TsrTPU:
         # [m, S, W] rows on HOST (numpy), so token length never enters
         # tracing and the seq-axis bucket above is the only shape knob.
         self._shape_buckets = bool(shape_buckets)
-        if self._shape_buckets:
-            self.n_seq = bucket_seq(self.n_seq)
-        n_shards = 1 if mesh is None else mesh.devices.size
-        if mesh is not None:
-            self.n_seq = pad_to_multiple(self.n_seq, n_shards)
         self.n_words = vdb.n_words
         # Pallas rule-support kernel (ops/pallas_tsr.py): streams seq
         # blocks through VMEM instead of materializing [chunk, S, W]
@@ -315,16 +336,19 @@ class TsrTPU:
         self._jnp_chunk = None  # budget-derived width for those buckets
         self._pallas_bad: set = set()  # km buckets whose kernel failed
         self._round_m = 0
+        # Derived static geometry lives in tsr_geometry — shared with the
+        # shape-key enumerator (utils/shapes.py); same contract as the
+        # SPADE engines' shape_key (per-round top-m and km-bucket shapes
+        # vary by design).
+        g = tsr_geometry(vdb.n_sequences, self.n_words, mesh=mesh,
+                         use_pallas=self.use_pallas,
+                         shape_buckets=self._shape_buckets)
+        self.n_seq = g["n_seq"]
         if self.use_pallas:
-            # per-shard seq axis must tile the kernel's seq block, which
-            # itself must tile the folded (8, 128) layout
-            self._sb = PT.seq_block(self.n_words,
-                                    -(-self.n_seq // n_shards))
-            self.n_seq = pad_to_multiple(self.n_seq, n_shards * self._sb)
-        # compiled-geometry identity (the static part — per-round top-m
-        # and km-bucket shapes vary by design); same contract as the
-        # SPADE engines' shape_key
-        self.stats["shape_key"] = f"tsr:s{self.n_seq}w{self.n_words}"
+            self._sb = g["sb"]
+        self.stats["shape_key"] = g["shape_key"]
+        if self._RECORD_SHAPES:  # CPU oracle engines stay out of the
+            shapes.record(g["shape_key"])  # compiled-geometry registry
 
         # Per-launch dispatch latency dominates on remote/tunneled TPUs
         # (~100ms+ each; measured 6x wall-clock win going 256 -> 8192 on a
@@ -1002,6 +1026,7 @@ class TsrCPU(TsrTPU):
     ops/bitops_np, so oracle comparisons are exact."""
 
     PIPELINE_DEPTH = 1  # dispatch is synchronous — nothing to overlap
+    _RECORD_SHAPES = False  # host-only mines compile no device geometry
 
     def __init__(self, *args, **kwargs):
         # never the device kernel — and never probe the JAX backend

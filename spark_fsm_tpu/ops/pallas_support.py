@@ -34,6 +34,7 @@ supports over ICI (SURVEY.md sec 2.2), identical to the jnp path.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -75,16 +76,35 @@ def effective_tiles(P: int, n_item_rows: int, W: int,
     item block is ~6.3 MB in VMEM and the multiword variant is unswept
     on hardware.
 
-    p_tile stays 16 DELIBERATELY: a p_tile=32 variant measured the same
-    steady wall within session noise but ~4x the Mosaic compile time
-    (~15 s/shape — the kernel body statically unrolls p_tile rows),
-    which multiplied across the incremental miner's shape-bucketed
-    sweep programs into 10+ s per streaming push (config-5 regression,
-    caught 2026-07-31)."""
+    p_tile: 32 where the wide-i_tile conditions hold AND P divides it —
+    the measured-best sweep point (32,384 -> 43.35 ms vs 44.59 ms at
+    the old (16,384) default, KERNELS.json tile_sweep) and it halves
+    the grid steps.  The historical objection was COMPILE time, not
+    throughput: the kernel body statically unrolls p_tile rows, so
+    p_tile=32 compiles ~4x slower per shape (~15 s), which once
+    multiplied into 10+ s mid-push stalls across the incremental
+    miner's sweep programs (config-5 regression, caught 2026-07-31).
+    The AOT prewarm subsystem (service/prewarm.py) now pays per-shape
+    compiles at boot, which flips that trade — but RE-MEASURE before
+    trusting the promotion on new hardware (``python bench_kernels.py``
+    refreshes KERNELS.json, whose tile_sweep is the evidence this
+    default cites), and ``SPARKFSM_PAIR_P_TILE=16`` pins the old tile
+    for deployments that cannot prewarm (the re-measure guard)."""
     ni128 = -(-n_item_rows // 128) * 128
     i_tile = (384 if W == 1 and ni128 % 384 == 0 and ni128 <= items_rows
               else I_TILE)
-    return P_TILE, i_tile
+    p_tile = P_TILE
+    if i_tile == 384 and P % 32 == 0:
+        p_tile = 32
+    pin = os.environ.get("SPARKFSM_PAIR_P_TILE")
+    if pin:
+        try:
+            pin = int(pin)
+            if pin > 0 and P % pin == 0:
+                p_tile = pin
+        except ValueError:
+            pass
+    return p_tile, i_tile
 
 
 def _make_pair_kernel_1w(p_tile: int):

@@ -17,6 +17,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SEQ_AXIS = "seq"
 
+# jax.shard_map moved namespaces across jax versions (top-level on
+# current jax, jax.experimental.shard_map before) and renamed its
+# replication-check kwarg (check_rep -> check_vma).  ONE compat symbol —
+# every engine imports it from here, so the repo runs on either.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pre-move jax: experimental namespace + old kwarg
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _exp_shard_map(f, **kw)
+
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     """1-D mesh over the sequence axis.  Multi-host: pass jax.devices()."""

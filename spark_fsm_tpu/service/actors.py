@@ -502,9 +502,14 @@ class Streamer:
         if use_inc:
             from spark_fsm_tpu.streaming.incremental import \
                 IncrementalWindowMiner
-            miner = IncrementalWindowMiner(support, max_batches=mb,
-                                           max_sequences=ms,
-                                           mesh=config.get_mesh())
+            # stream_seq_floor (boot [prewarm] section): pin batch-store
+            # buckets to the declared steady-state size so the first
+            # pushes land on prewarmed shapes instead of compiling
+            # throwaway small-bucket programs
+            miner = IncrementalWindowMiner(
+                support, max_batches=mb, max_sequences=ms,
+                mesh=config.get_mesh(),
+                seq_floor=config.get_config().prewarm.stream_seq_floor)
         else:
             miner = WindowMiner(support, max_batches=mb, max_sequences=ms,
                                 mine=plugin_mine)

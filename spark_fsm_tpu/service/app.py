@@ -15,8 +15,12 @@ Endpoints (POST, form- or JSON-encoded parameters):
   /register/{topic}   — register a field spec
   /index/{topic}      — alias of register (reference keeps both)
   /admin/ping         — liveness; /admin/algorithms — plugin listing;
-  /admin/stats        — service metrics (job counters, backend, devices);
-  /admin/config       — the active boot config
+  /admin/stats        — service metrics (job counters, backend, devices,
+                        per-cache counters, last prewarm walls);
+  /admin/config       — the active boot config;
+  /admin/prewarm      — AOT-compile the declared workload envelope NOW
+                        (params override the boot [prewarm] section);
+  /admin/shapes       — enumerated vs runtime-recorded shape keys + drift
 
 Runs on the stdlib ThreadingHTTPServer: the service layer is deliberately
 dependency-free; heavy lifting happens in the engines (device) behind the
@@ -86,7 +90,7 @@ class FsmHandler(BaseHTTPRequestHandler):
             return
 
         if head == "admin":
-            self._admin(tail)
+            self._admin(tail, data)
             return
         if head not in ("train", "status", "get", "track", "register",
                         "index", "stream"):
@@ -117,7 +121,7 @@ class FsmHandler(BaseHTTPRequestHandler):
             self._send(405, json.dumps({"status": "failure",
                                         "error": "use POST"}))
 
-    def _admin(self, task: str) -> None:
+    def _admin(self, task: str, data: Optional[dict] = None) -> None:
         try:
             if task == "ping":
                 self._send(200, json.dumps({"status": "up"}))
@@ -128,6 +132,36 @@ class FsmHandler(BaseHTTPRequestHandler):
             elif task == "config":
                 self._send(200, json.dumps(
                     dataclasses.asdict(cfgmod.get_config())))
+            elif task == "prewarm":
+                # AOT-compile the declared workload envelope NOW (request
+                # params override the boot [prewarm] section field-by-
+                # field) — synchronous on purpose: the caller is an
+                # operator/boot hook who wants the compiles PAID before
+                # traffic lands, and the report is per-key compile walls
+                from spark_fsm_tpu.service import prewarm
+
+                spec = prewarm.spec_from_params(
+                    data or {}, cfgmod.get_config().prewarm)
+                report = prewarm.run(
+                    spec, mesh=cfgmod.get_mesh(),
+                    engine_kwargs=cfgmod.engine_kwargs(
+                        "pool_bytes", "node_batch", "pipeline_depth",
+                        "chunk", "recompute_chunk"))
+                self._send(200, json.dumps(report))
+            elif task == "shapes":
+                # enumerated (last prewarm) vs runtime-recorded shape
+                # keys; "drift" lists observed geometries prewarm missed
+                from spark_fsm_tpu.service import prewarm
+                from spark_fsm_tpu.utils import shapes as shapereg
+
+                report = prewarm.last_report()
+                enumerated = report["enumerated"] if report else []
+                self._send(200, json.dumps({
+                    "enumerated": enumerated,
+                    "recorded": shapereg.recorded(),
+                    "drift": (shapereg.drift(enumerated)
+                              if report else None),
+                }))
             else:
                 self._send(404, json.dumps(
                     {"status": "failure",
@@ -149,15 +183,30 @@ def service_stats(master: Master) -> dict:
                      "stream_pushes", "stream_failures")
     }
     mesh_devices = cfgmod.get_config().engine.mesh_devices
-    from spark_fsm_tpu.service.devcache import spade_engine_cache
+    from spark_fsm_tpu.service import prewarm
+    from spark_fsm_tpu.service.devcache import (
+        cspade_engine_cache, spade_engine_cache, tsr_engine_cache)
+    from spark_fsm_tpu.utils import shapes as shapereg
+
+    report = prewarm.last_report()
     return {
         "jobs": counters,
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
         "mesh_devices": mesh_devices,
         "algorithms": sorted(plugins.ALGORITHMS),
-        # repeat-/train device-store reuse (service/devcache.py)
+        # repeat-/train device-store reuse (service/devcache.py); one
+        # counter block per cache so a cSPADE hit is visible as such
         "store_cache": dict(spade_engine_cache.stats),
+        "cspade_cache": dict(cspade_engine_cache.stats),
+        "tsr_cache": dict(tsr_engine_cache.stats),
+        # warm-path observability: distinct compiled geometries seen,
+        # plus the last prewarm's per-key compile walls (if any ran)
+        "shape_keys_recorded": len(shapereg.recorded()),
+        "prewarm": (None if report is None else
+                    {"keys": report["keys"],
+                     "total_wall_s": report["total_wall_s"],
+                     "ts": report["ts"]}),
     }
 
 
@@ -223,6 +272,28 @@ def main() -> None:
             coordinator_address=cfg.distributed.coordinator_address or None,
             num_processes=cfg.distributed.num_processes or None,
             process_id=cfg.distributed.process_id)
+    if cfg.prewarm.enabled:
+        # Boot-time AOT prewarm: compile the declared workload envelope
+        # BEFORE accepting traffic, so the first live /train or /stream
+        # push deserializes from warm caches instead of paying a ~40 s
+        # Mosaic compile (BASELINE.json cold_start).  Synchronous by
+        # design — a not-yet-listening service is the honest signal that
+        # the deployment is still paying its compile bill.
+        from spark_fsm_tpu.service import prewarm
+
+        spec = prewarm.spec_from_config(cfg.prewarm)
+        if spec is None:
+            print("prewarm enabled but the [prewarm] envelope is empty "
+                  "(set sequences/items or stream_batch_sequences)",
+                  flush=True)
+        else:
+            report = prewarm.run(
+                spec, mesh=cfgmod.get_mesh(),
+                engine_kwargs=cfgmod.engine_kwargs(
+                    "pool_bytes", "node_batch", "pipeline_depth",
+                    "chunk", "recompute_chunk"))
+            print(f"prewarm: {len(report['keys'])} shape keys in "
+                  f"{report['total_wall_s']}s", flush=True)
     server = make_server(cfg.service.port, cfg.service.host,
                          miner_workers=cfg.service.miner_workers)
     print(f"spark_fsm_tpu service on http://{cfg.service.host}:"

@@ -24,9 +24,18 @@ Correctness by construction:
   the reference, the device memory frees when the arrays do.
 
 Scope: the plain SPADE_TPU path (queue or classic engine — the two that
-keep their store across ``mine()`` calls) via :class:`SpadeEngineCache`,
-and TSR_TPU via :class:`TsrEngineCache` (host-side reuse — see its
-docstring).  Constrained and checkpointed jobs pass through uncached.
+keep their store across ``mine()`` calls) via :class:`SpadeEngineCache`
+— INCLUDING checkpointed jobs (the cached engine holds only the
+immutable store + compiled programs; frontier state arrives per call
+from the checkpoint snapshot, whose engine fingerprint is validated
+against the checked-out engine before resuming); the constrained cSPADE
+path via :class:`CSpadeEngineCache` (the max-start engine keeps its
+item store and state pool across ``mine()`` calls exactly like the
+classic engine — its fingerprint folds in maxgap/maxwindow, which
+select different compiled kernels AND different enumerations); and
+TSR_TPU via :class:`TsrEngineCache` (host-side reuse — see its
+docstring).  Stream pushes stay uncached (a sliding window's data
+changes every push, so every push would insert a dead entry).
 """
 
 from __future__ import annotations
@@ -94,19 +103,21 @@ class _EngineCacheBase:
             self.stats["busy_misses" if e is not None else "misses"] += 1
             return None
 
-    def _mine_checked_out(self, entry: _Entry):
+    def _mine_checked_out(self, entry: _Entry, runner=None):
         """Run a checked-out engine's mine: zero the accumulated numeric
         stats (engines carry lifetime totals across mine() calls), run,
         and SNAPSHOT the stats dict BEFORE releasing the busy flag — a
         concurrent checkout zeroes the same dict the moment busy drops,
-        so reading ``engine.stats`` after release races.  Returns
+        so reading ``engine.stats`` after release races.  ``runner``
+        overrides the default ``engine.mine()`` call (the checkpointed
+        path resumes from a snapshot).  Returns
         ``(result, stats_snapshot)``."""
         eng = entry.engine
         for k, v in eng.stats.items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 eng.stats[k] = 0
         try:
-            res = eng.mine()
+            res = eng.mine() if runner is None else runner(eng)
             snap = dict(eng.stats)
             return res, snap
         finally:
@@ -143,8 +154,18 @@ class _EngineCacheBase:
             self._entries.clear()
 
 
-class SpadeEngineCache(_EngineCacheBase):
-    """LRU engine cache with exclusive checkout; see module docstring."""
+class _HbmBudgetCache(_EngineCacheBase):
+    """Byte-budgeted LRU shared by the device-store caches (plain SPADE
+    and cSPADE): entries are charged their engine's persistent HBM
+    working set and LRU-evicted under a fraction of device memory.
+
+    ``_BUDGET_FRACTION`` is per-CLASS and the module-level cache
+    instances' fractions must SUM to a figure that coexists with a live
+    queue-engine working set (~45% of HBM, QueueCaps.for_budget) plus
+    kernel temps: plain 25% + cSPADE 12.5% = 37.5% pinned worst-case.
+    A subclass raising its fraction must re-do that arithmetic."""
+
+    _BUDGET_FRACTION = 0.25
 
     def __init__(self, budget_bytes: Optional[int] = None):
         super().__init__()
@@ -157,123 +178,8 @@ class SpadeEngineCache(_EngineCacheBase):
 
         from spark_fsm_tpu.models._common import device_hbm_budget
 
-        return int(0.25 * device_hbm_budget(jax.devices()[0]))
-
-    def mine(self, db: SequenceDB, minsup_abs: int, *,
-             mesh=None, stats_out: Optional[dict] = None,
-             max_pattern_itemsets: Optional[int] = None,
-             shape_buckets: bool = False,
-             fused: str = "auto",
-             **kwargs) -> List[PatternResult]:
-        """Cached equivalent of ``mine_spade_tpu`` for the plain path.
-
-        Modes without a store-keeping engine ("never"/"dense" pins, or
-        explicit engine kwargs the cache does not key) fall through to
-        the uncached wrapper.
-        """
-        from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
-
-        if fused not in ("auto", "queue") or kwargs:
-            return mine_spade_tpu(
-                db, minsup_abs, mesh=mesh, stats_out=stats_out,
-                max_pattern_itemsets=max_pattern_itemsets,
-                shape_buckets=shape_buckets, fused=fused, **kwargs)
-
-        key = (db_fingerprint(db), int(minsup_abs), mesh,
-               max_pattern_itemsets, bool(shape_buckets), fused)
-        entry = self._checkout(key)
-        if entry is not None:
-            res, snap = self._mine_checked_out(entry)
-            if res is not None:  # a cap overflow on re-mine: fall through
-                if stats_out is not None:
-                    stats_out.update(snap)
-                    # classic engines carry no 'fused' key in their own
-                    # stats; artifact consumers key the route on it
-                    stats_out.setdefault("fused", False)
-                    stats_out["store_cache_hit"] = True
-                return res
-            with self._lock:
-                self._entries.pop(key, None)
-            # a cached queue engine that overflowed would overflow again
-            # deterministically on identical inputs — tell the rebuild to
-            # skip the queue attempt instead of doubling the device work
-            if stats_out is not None:
-                stats_out["fused_overflow"] = True
-            res, engine = self._build_and_mine(
-                db, minsup_abs, mesh=mesh, stats_out=stats_out,
-                max_pattern_itemsets=max_pattern_itemsets,
-                shape_buckets=shape_buckets, fused=fused, skip_queue=True)
-            if stats_out is not None:
-                stats_out["store_cache_hit"] = False
-            if engine is not None:
-                self._insert_engine(key, engine)
-            return res
-
-        res, engine = self._build_and_mine(
-            db, minsup_abs, mesh=mesh, stats_out=stats_out,
-            max_pattern_itemsets=max_pattern_itemsets,
-            shape_buckets=shape_buckets, fused=fused)
-        if stats_out is not None:
-            stats_out["store_cache_hit"] = False
-        if engine is not None:
-            self._insert_engine(key, engine)
-        return res
-
-    def _build_and_mine(self, db, minsup_abs, *, mesh, stats_out,
-                        max_pattern_itemsets, shape_buckets, fused,
-                        skip_queue=False):
-        """mine_spade_tpu's routing, but keeping the engine object.
-
-        ``skip_queue``: the caller already observed this exact workload
-        overflow the queue engine's caps (a cached engine's re-mine) —
-        don't pay for a second deterministic overflow.
-        """
-        from spark_fsm_tpu.data.vertical import build_vertical
-        from spark_fsm_tpu.models.spade_queue import (
-            QueueSpadeTPU, queue_eligible)
-        from spark_fsm_tpu.models.spade_tpu import SpadeTPU
-
-        vdb = build_vertical(db, min_item_support=minsup_abs)
-        if vdb.n_items == 0:
-            return [], None
-        ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
-                   shape_buckets=shape_buckets)
-        if not skip_queue and fused in ("auto", "queue") and (
-                fused == "queue"
-                or queue_eligible(vdb, mesh=mesh,
-                                  shape_buckets=shape_buckets)):
-            qeng = QueueSpadeTPU(vdb, minsup_abs, **ekw)
-            res = qeng.mine()
-            if res is not None:
-                if stats_out is not None:
-                    stats_out.update(qeng.stats)
-                return res, qeng
-            if stats_out is not None:
-                stats_out["fused_overflow"] = True
-        if fused == "auto":
-            # mirror mine_spade_tpu: the dense engine is "auto"'s second
-            # try — queue-ineligible, queue-overflowed (this mine or a
-            # cached one, per skip_queue), it must still WIN the route
-            # where eligible.  It rebuilds its store per mine(), so it is
-            # not worth caching — degrading it to the classic DFS would
-            # re-add one readback per wave on tunneled TPUs.
-            from spark_fsm_tpu.models.spade_fused import (
-                FusedSpadeTPU, fused_eligible)
-            if fused_eligible(vdb, mesh=mesh, shape_buckets=shape_buckets):
-                feng = FusedSpadeTPU(vdb, minsup_abs, **ekw)
-                res = feng.mine()
-                if res is not None:
-                    if stats_out is not None:
-                        stats_out.update(feng.stats)
-                    return res, None
-                if stats_out is not None:
-                    stats_out["fused_overflow"] = True
-        eng = SpadeTPU(vdb, minsup_abs, **ekw)
-        res = eng.mine()
-        if stats_out is not None:
-            stats_out.update(eng.stats)
-            stats_out.setdefault("fused", False)
-        return res, eng
+        return int(self._BUDGET_FRACTION
+                   * device_hbm_budget(jax.devices()[0]))
 
     def _engine_bytes(self, engine) -> int:
         if hasattr(engine, "nbytes"):
@@ -299,6 +205,240 @@ class SpadeEngineCache(_EngineCacheBase):
             total -= e.nbytes
             del self._entries[k]
             self.stats["evictions"] += 1
+
+
+class SpadeEngineCache(_HbmBudgetCache):
+    """LRU engine cache with exclusive checkout; see module docstring."""
+
+    def mine(self, db: SequenceDB, minsup_abs: int, *,
+             mesh=None, stats_out: Optional[dict] = None,
+             max_pattern_itemsets: Optional[int] = None,
+             shape_buckets: bool = False,
+             fused: str = "auto",
+             checkpoint=None,
+             **kwargs) -> List[PatternResult]:
+        """Cached equivalent of ``mine_spade_tpu`` for the plain path.
+
+        Modes without a store-keeping engine ("never"/"dense" pins, or
+        explicit engine kwargs the cache does not key) fall through to
+        the uncached wrapper.
+
+        ``checkpoint`` (the load/save/every_s contract): a checkpointed
+        job rides the SAME data-keyed entries as plain mines — the
+        cached engine holds only the immutable store + compiled
+        programs, never frontier state, so a resume simply seeds the
+        checked-out engine from the snapshot.  Snapshot identity is
+        enforced where it must be: ``load_checkpoint`` validates the
+        frontier fingerprint (data + minsup + parameters) against the
+        checked-out engine before resuming, so a stale snapshot
+        restarts fresh instead of garbling.
+        """
+        from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+
+        if fused not in ("auto", "queue") or kwargs:
+            return mine_spade_tpu(
+                db, minsup_abs, mesh=mesh, stats_out=stats_out,
+                max_pattern_itemsets=max_pattern_itemsets,
+                shape_buckets=shape_buckets, fused=fused,
+                checkpoint=checkpoint, **kwargs)
+
+        key = (db_fingerprint(db), int(minsup_abs), mesh,
+               max_pattern_itemsets, bool(shape_buckets), fused)
+        entry = self._checkout(key)
+        if entry is not None:
+            runner = None
+            if checkpoint is not None:
+                from spark_fsm_tpu.models._common import load_checkpoint
+
+                def runner(eng):
+                    resume, save_cb, every_s = load_checkpoint(
+                        checkpoint, eng.frontier_fingerprint())
+                    return eng.mine(resume=resume, checkpoint_cb=save_cb,
+                                    checkpoint_every_s=every_s)
+
+            res, snap = self._mine_checked_out(entry, runner)
+            if res is not None:  # a cap overflow on re-mine: fall through
+                if stats_out is not None:
+                    stats_out.update(snap)
+                    # classic engines carry no 'fused' key in their own
+                    # stats; artifact consumers key the route on it
+                    stats_out.setdefault("fused", False)
+                    stats_out["store_cache_hit"] = True
+                return res
+            with self._lock:
+                self._entries.pop(key, None)
+            # a cached queue engine that overflowed would overflow again
+            # deterministically on identical inputs — tell the rebuild to
+            # skip the queue attempt instead of doubling the device work.
+            # A checkpointed overflow resumes in the rebuilt classic
+            # engine from the queue segments' last snapshot (shared
+            # frontier format, same fingerprint).
+            if stats_out is not None:
+                stats_out["fused_overflow"] = True
+            res, engine = self._build_and_mine(
+                db, minsup_abs, mesh=mesh, stats_out=stats_out,
+                max_pattern_itemsets=max_pattern_itemsets,
+                shape_buckets=shape_buckets, fused=fused,
+                checkpoint=checkpoint, skip_queue=True)
+            if stats_out is not None:
+                stats_out["store_cache_hit"] = False
+            if engine is not None:
+                self._insert_engine(key, engine)
+            return res
+
+        res, engine = self._build_and_mine(
+            db, minsup_abs, mesh=mesh, stats_out=stats_out,
+            max_pattern_itemsets=max_pattern_itemsets,
+            shape_buckets=shape_buckets, fused=fused, checkpoint=checkpoint)
+        if stats_out is not None:
+            stats_out["store_cache_hit"] = False
+        if engine is not None:
+            self._insert_engine(key, engine)
+        return res
+
+    def _build_and_mine(self, db, minsup_abs, *, mesh, stats_out,
+                        max_pattern_itemsets, shape_buckets, fused,
+                        checkpoint=None, skip_queue=False):
+        """mine_spade_tpu's routing, but keeping the engine object.
+
+        ``skip_queue``: the caller already observed this exact workload
+        overflow the queue engine's caps (a cached engine's re-mine) —
+        don't pay for a second deterministic overflow.
+        """
+        from spark_fsm_tpu.data.vertical import build_vertical
+        from spark_fsm_tpu.models._common import load_checkpoint
+        from spark_fsm_tpu.models.spade_queue import (
+            QueueSpadeTPU, queue_eligible)
+        from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+
+        vdb = build_vertical(db, min_item_support=minsup_abs)
+        if vdb.n_items == 0:
+            return [], None
+        ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
+                   shape_buckets=shape_buckets)
+        if not skip_queue and fused in ("auto", "queue") and (
+                fused == "queue"
+                or queue_eligible(vdb, mesh=mesh,
+                                  shape_buckets=shape_buckets)):
+            qeng = QueueSpadeTPU(vdb, minsup_abs, **ekw)
+            q_resume, q_save, q_every = load_checkpoint(
+                checkpoint, qeng.frontier_fingerprint())
+            res = qeng.mine(resume=q_resume, checkpoint_cb=q_save,
+                            checkpoint_every_s=q_every)
+            if res is not None:
+                if stats_out is not None:
+                    stats_out.update(qeng.stats)
+                return res, qeng
+            if stats_out is not None:
+                stats_out["fused_overflow"] = True
+        if fused == "auto" and checkpoint is None:
+            # mirror mine_spade_tpu: the dense engine is "auto"'s second
+            # try — queue-ineligible, queue-overflowed (this mine or a
+            # cached one, per skip_queue), it must still WIN the route
+            # where eligible.  It rebuilds its store per mine(), so it is
+            # not worth caching — degrading it to the classic DFS would
+            # re-add one readback per wave on tunneled TPUs.
+            from spark_fsm_tpu.models.spade_fused import (
+                FusedSpadeTPU, fused_eligible)
+            if fused_eligible(vdb, mesh=mesh, shape_buckets=shape_buckets):
+                feng = FusedSpadeTPU(vdb, minsup_abs, **ekw)
+                res = feng.mine()
+                if res is not None:
+                    if stats_out is not None:
+                        stats_out.update(feng.stats)
+                    return res, None
+                if stats_out is not None:
+                    stats_out["fused_overflow"] = True
+        elif fused == "auto" and stats_out is not None:
+            # the dense engine alone has no resumable frontier; a
+            # checkpointed job that would have routed to it degrades to
+            # the classic engine — flagged, not fatal (mine_spade_tpu's
+            # checkpoint-unsupported convention)
+            from spark_fsm_tpu.models.spade_fused import fused_eligible
+            if fused_eligible(vdb, mesh=mesh, shape_buckets=shape_buckets):
+                stats_out["fused_skipped"] = "checkpoint"
+        eng = SpadeTPU(vdb, minsup_abs, **ekw)
+        resume, save_cb, every_s = load_checkpoint(
+            checkpoint, eng.frontier_fingerprint())
+        res = eng.mine(resume=resume, checkpoint_cb=save_cb,
+                       checkpoint_every_s=every_s)
+        if stats_out is not None:
+            stats_out.update(eng.stats)
+            stats_out.setdefault("fused", False)
+        return res, eng
+
+class CSpadeEngineCache(_HbmBudgetCache):
+    """The cSPADE half of the repeat-``/train`` story (SpadeEngineCache
+    covers plain SPADE, TsrEngineCache covers rules).
+
+    A :class:`~spark_fsm_tpu.models.spade_constrained.ConstrainedSpadeTPU`
+    keeps its item store and max-start state pool in HBM across
+    ``mine()`` calls exactly like the classic engine, so a repeat
+    constrained mine was re-paying the token upload + scatter-build +
+    engine construction (~2 s of full-Gazelle prep per ``/train``,
+    BENCH_SCALE config 4 cold-vs-warm) for nothing.  The fingerprint
+    folds in maxgap/maxwindow: the constraint pair selects a DIFFERENT
+    compiled kernel set (``_cspade_fns``) and a different enumeration,
+    so two mines differing only in constraints must never share an
+    entry.  Checkpointed constrained jobs fall through uncached (the
+    per-request resume plumbing stays on the wrapper path).
+
+    Budget: half the plain cache's fraction — constrained engines are
+    positions-wide (int8/16 pools), and the TWO module-level caches'
+    pinned bytes must jointly leave room for a live queue working set
+    (see _HbmBudgetCache)."""
+
+    _BUDGET_FRACTION = 0.125
+
+    def mine(self, db: SequenceDB, minsup_abs: int, *,
+             maxgap: Optional[int] = None,
+             maxwindow: Optional[int] = None,
+             mesh=None, stats_out: Optional[dict] = None,
+             max_pattern_itemsets: Optional[int] = None,
+             shape_buckets: bool = False,
+             checkpoint=None,
+             **kwargs) -> List[PatternResult]:
+        from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
+
+        if kwargs or checkpoint is not None:
+            # explicit engine knobs the cache does not key, or a
+            # checkpointed job: uncached wrapper
+            return mine_cspade_tpu(
+                db, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
+                mesh=mesh, stats_out=stats_out,
+                max_pattern_itemsets=max_pattern_itemsets,
+                shape_buckets=shape_buckets, checkpoint=checkpoint,
+                **kwargs)
+
+        key = (db_fingerprint(db), int(minsup_abs), maxgap, maxwindow,
+               mesh, max_pattern_itemsets, bool(shape_buckets))
+        entry = self._checkout(key)
+        if entry is not None:
+            res, snap = self._mine_checked_out(entry)
+            if stats_out is not None:
+                stats_out.update(snap)
+                stats_out["store_cache_hit"] = True
+            return res
+
+        from spark_fsm_tpu.data.vertical import build_vertical
+        from spark_fsm_tpu.models.spade_constrained import (
+            ConstrainedSpadeTPU)
+
+        vdb = build_vertical(db, min_item_support=minsup_abs)
+        if vdb.n_items == 0:
+            if stats_out is not None:
+                stats_out["store_cache_hit"] = False
+            return []
+        eng = ConstrainedSpadeTPU(
+            vdb, minsup_abs, maxgap=maxgap, maxwindow=maxwindow, mesh=mesh,
+            max_pattern_itemsets=max_pattern_itemsets,
+            shape_buckets=shape_buckets)
+        res = eng.mine()
+        if stats_out is not None:
+            stats_out.update(eng.stats)
+            stats_out["store_cache_hit"] = False
+        self._insert_engine(key, eng)
+        return res
 
 
 class TsrEngineCache(_EngineCacheBase):
@@ -372,4 +512,5 @@ class TsrEngineCache(_EngineCacheBase):
 
 # process-wide caches the service plugin layer uses
 spade_engine_cache = SpadeEngineCache()
+cspade_engine_cache = CSpadeEngineCache()
 tsr_engine_cache = TsrEngineCache()

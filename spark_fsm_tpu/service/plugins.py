@@ -112,20 +112,30 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
         # fused routing is a plain-SPADE knob (the constrained engine has
         # no fused counterpart), so it must not reach mine_cspade_tpu
         fused_kw = config.engine_kwargs("fused")
-        if checkpoint is None and req.task != "stream":
+        if req.task != "stream":
             # repeat mines over identical data reuse the HBM store +
-            # compiled engine (service/devcache.py); a checkpointed job
-            # stays uncached (its classic engine binds to the resume
-            # fingerprint, not the cache key), and stream re-mines skip
-            # it (a sliding window's data changes every push, so every
-            # push would insert a dead entry)
+            # compiled engine (service/devcache.py) — checkpointed jobs
+            # included: the cached engine holds only the immutable
+            # store, and a resume seeds it from the snapshot (the
+            # frontier fingerprint is validated first).  Stream
+            # re-mines skip the cache (a sliding window's data changes
+            # every push, so every push would insert a dead entry).
             from spark_fsm_tpu.service.devcache import spade_engine_cache
             return spade_engine_cache.mine(db, minsup, mesh=mesh,
                                            stats_out=stats,
+                                           checkpoint=checkpoint,
                                            **fused_kw, **kwargs)
         return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
                               checkpoint=checkpoint,
                               **fused_kw, **kwargs)
+    if checkpoint is None and req.task != "stream":
+        # repeat cSPADE mines reuse the constrained engine (item store +
+        # max-start pool); the cache key folds maxgap/maxwindow — they
+        # select different kernels AND different enumerations
+        from spark_fsm_tpu.service.devcache import cspade_engine_cache
+        return cspade_engine_cache.mine(db, minsup, maxgap=maxgap,
+                                        maxwindow=maxwindow, mesh=mesh,
+                                        stats_out=stats, **kwargs)
     return mine_cspade_tpu(db, minsup, maxgap=maxgap, maxwindow=maxwindow,
                            mesh=mesh, stats_out=stats, checkpoint=checkpoint,
                            **kwargs)

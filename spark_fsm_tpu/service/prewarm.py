@@ -1,0 +1,451 @@
+"""AOT prewarm driver: pay every enumerable compile at boot.
+
+A fresh deployment's first mine of a geometry costs ~41.7 s of XLA/Mosaic
+compile (BASELINE.json ``cold_start.cache_miss_cold_wall_s``), and a
+streaming consumer hits a one-time mid-stream sweep-compile stall when
+the tracked tree first outgrows its store bucket (12.85 s at config-5
+scale, BENCH_SCALE ``per_push_phase_s[1]``).  Both are *enumerable*
+costs: the shape-key registry (utils/shapes.py) lists the finite set of
+compiled geometries a declared workload envelope will touch.
+
+This driver walks that set and compiles every entry against a TINY
+synthetic store with the DECLARED global geometry: ``build_vertical``'s
+``pad_sequences_to``/``word_multiple`` stretch a KB-scale token table to
+the full padded device shape, so the store scatter-build and the whole
+kernel chain compile at exactly the shapes live requests will hit —
+populating the in-process jit caches and the persistent XLA cache
+(utils/jitcache.py).  The synthetic content is one single-itemset
+sequence per item: every item is a frequent root (one full DFS wave runs
+— prep, pair supports, prune), but no two items ever co-occur, so there
+are no frequent children and the mine is milliseconds of device work on
+top of the compiles it exists to trigger.
+
+Entry points: ``run(spec)`` (the driver), ``POST /admin/prewarm``
+(service/app.py; parameters override the boot ``[prewarm]`` config), and
+the app boot hook (``[prewarm] enabled = true``).  Per-key compile walls
+land in the returned report and in ``/admin/stats``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_fsm_tpu.utils import shapes
+from spark_fsm_tpu.utils.jitcache import compile_counts, enable_compile_counter
+from spark_fsm_tpu.utils.obs import log_event
+
+_lock = threading.Lock()
+_last_report: Optional[dict] = None
+
+
+def _tiny_vdb(n_sequences: int, n_items: int, n_words: int):
+    """Vertical DB with the declared GLOBAL geometry but ~KB content:
+    one single-itemset sequence per item (all roots frequent at
+    minsup=1, no co-occurrence, so no frequent children), padded out to
+    ``n_sequences`` all-zero sequences and ``n_words`` bitmap words."""
+    from spark_fsm_tpu.data.vertical import build_vertical
+
+    if n_items < 1 or n_sequences < n_items:
+        raise ValueError(
+            f"prewarm spec needs 1 <= items <= sequences, got "
+            f"items={n_items} sequences={n_sequences}")
+    db = [[[i]] for i in range(1, n_items + 1)]
+    if n_words > 1:
+        # one long sequence forces the declared word count's position
+        # range too (word_multiple pads the rest)
+        db[0] = [[1]] * (32 * (n_words - 1) + 1)
+    return build_vertical(db, min_item_support=1,
+                          pad_sequences_to=n_sequences,
+                          word_multiple=n_words)
+
+
+def _warm_support_concat(chunk: int) -> None:
+    """Batches wider than one support chunk concatenate their per-chunk
+    device outputs into one array (for the single async host copy);
+    the engines pow2-bucket the arity (spade_tpu._concat_pow2) exactly
+    so this ladder is finite — warm arities 2..512 plus the zeros pad
+    program (covers batches up to 512*chunk candidates; beyond that a
+    live mine pays one ~ms concat compile, not a kernel compile)."""
+    import jax.numpy as jnp
+
+    z = jnp.zeros(chunk, jnp.int32)
+    jnp.zeros_like(z)
+    k = 2
+    while k <= 512:
+        jnp.concatenate([z] * k)
+        k *= 2
+
+
+def _force_classic_chain(eng) -> None:
+    """Compile the chain members a no-children mine never dispatches
+    (materialize at chunk width, recompute at a representative step
+    depth) — all writes land in the scratch row of a throwaway engine."""
+    pt = eng._prep_fn(eng.store, eng._put(np.zeros(eng.node_batch,
+                                                   np.int32)))
+    c = eng.chunk
+    z32 = eng._put(np.zeros(c, np.int32))
+    zb = eng._put(np.zeros(c, bool))
+    os_ = eng._put(np.full(c, eng.scratch, np.int32))
+    eng.store = eng._materialize_fn(pt, eng.store, z32, z32, zb, os_)
+    rc = eng.recompute_chunk
+    for k in (2, 4, 8, 16):  # pow2-bucketed step depth of live rebuilds
+        eng.store = eng._recompute_fn(
+            eng.store, eng._put(np.zeros((k, rc), np.int32)),
+            eng._put(np.zeros((k, rc), bool)),
+            eng._put(np.zeros((k, rc), bool)),
+            eng._put(np.full(rc, eng.scratch, np.int32)))
+    _warm_support_concat(eng.chunk)
+
+
+def _force_cspade_chain(eng) -> None:
+    """Constrained-engine analog of :func:`_force_classic_chain`."""
+    nb = eng.node_batch
+    m, pm = eng._prep_fn(eng.pool, eng.items,
+                         eng._put(np.zeros(nb, np.int32)),
+                         eng._put(np.zeros(nb, np.int32)),
+                         eng._put(np.ones(nb, bool)))
+    c = eng.chunk
+    z32 = eng._put(np.zeros(c, np.int32))
+    zb = eng._put(np.zeros(c, bool))
+    os_ = eng._put(np.full(c, eng.scratch, np.int32))
+    eng.pool = eng._materialize_fn(m, pm, eng.items, eng.pool,
+                                   z32, z32, zb, os_)
+    rc = eng.recompute_chunk
+    for k in (2, 4, 8, 16):  # pow2-bucketed step depth of live rebuilds
+        eng.pool = eng._recompute_fn(
+            eng.pool, eng.items, eng._put(np.zeros((k, rc), np.int32)),
+            eng._put(np.zeros((k, rc), bool)),
+            eng._put(np.zeros((k, rc), bool)),
+            eng._put(np.full(rc, eng.scratch, np.int32)))
+    _warm_support_concat(eng.chunk)
+
+
+def _token_buckets(n_items: int, max_tokens: int) -> List[int]:
+    from spark_fsm_tpu.models._common import next_pow2
+
+    b = next_pow2(max(16, n_items))
+    hi = next_pow2(max(b, max_tokens))
+    out = []
+    while b <= hi:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def _warm_store_builders(n_rows: int, n_seq: int, n_words: int, mesh,
+                         flat: bool, n_items: int, max_tokens: int,
+                         put) -> None:
+    """Compile the store scatter-build for every pow2 token-count bucket
+    up to the declared bound: token-array length is a traced shape
+    (pow2-bucketed by scatter_build_store), so real data's token count
+    lands on one of these buckets — all-zero dummy tokens scatter
+    nothing and the output is discarded."""
+    from spark_fsm_tpu.models._common import _store_builder
+
+    fn = _store_builder(n_rows, n_seq, n_words, mesh, flat)
+    for nt in _token_buckets(n_items, max_tokens):
+        z = np.zeros(nt, np.int32)
+        fn(put(z), put(z), put(z), put(np.zeros(nt, np.uint32)))
+
+
+def _warm_classic(t: dict, mesh, ekw: dict) -> None:
+    from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+
+    vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
+    eng = SpadeTPU(vdb, 1, mesh=mesh, **ekw)
+    eng.mine()
+    _force_classic_chain(eng)
+    _warm_store_builders(eng.store.shape[0], eng.n_seq, eng.n_words, mesh,
+                         True, t["n_items"], t["max_tokens"], eng._put)
+
+
+def _warm_queue(t: dict, mesh) -> None:
+    from spark_fsm_tpu.models.spade_queue import QueueSpadeTPU, _queue_mine_fn
+
+    vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
+    eng = QueueSpadeTPU(vdb, 1, mesh=mesh)
+    eng.mine()  # the whole-mine one-shot program: the 41.7 s item
+    _warm_store_builders(eng.store.shape[0], eng.n_seq, eng.n_words, mesh,
+                         True, t["n_items"], t["max_tokens"], eng._put)
+    if t.get("checkpointed"):
+        # the segmented (resumable) variants: the first-segment program
+        # compiles through a checkpointed mine; the donating
+        # continuation program only runs from segment 2, which a tiny
+        # single-wave mine never reaches — dispatch it directly on a
+        # fresh root carry (the engine is throwaway; donation is fine)
+        eng2 = QueueSpadeTPU(vdb, 1, mesh=mesh)
+        eng2.mine(checkpoint_cb=lambda s: None, checkpoint_every_s=1e9)
+        cap = eng2.caps
+        mkw = (eng2.mesh, eng2.n_words, eng2.ni_pad, eng2.max_its,
+               cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap,
+               cap.i_max, eng2.use_pallas, eng2._s_block,
+               eng2._interpret, True)
+        carry = eng2._root_carry(eng2._roots())
+        _queue_mine_fn(*mkw, True)(*carry, eng2._put(np.int32(1)))
+
+
+def _warm_fused(t: dict, mesh) -> None:
+    from spark_fsm_tpu.models.spade_fused import FusedSpadeTPU
+
+    vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
+    eng = FusedSpadeTPU(vdb, 1, mesh=mesh)
+    eng.mine()
+    _warm_store_builders(eng.ni_pad + 2 * eng.caps.f_cap + 1, eng.n_seq,
+                         eng.n_words, mesh, True, t["n_items"],
+                         t["max_tokens"], eng._put)
+
+
+def _warm_cspade(t: dict, mesh, ekw: dict) -> None:
+    from spark_fsm_tpu.models.spade_constrained import ConstrainedSpadeTPU
+
+    vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
+    eng = ConstrainedSpadeTPU(vdb, 1, maxgap=t["maxgap"],
+                              maxwindow=t["maxwindow"], mesh=mesh, **ekw)
+    eng.mine()
+    _force_cspade_chain(eng)
+    _warm_store_builders(eng.item_rows, eng.n_seq, eng.n_words, mesh,
+                         False, t["n_items"], t["max_tokens"], eng._put)
+
+
+def _warm_tsr(t: dict, mesh) -> None:
+    from spark_fsm_tpu.models.tsr import TsrTPU
+
+    vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
+    TsrTPU(vdb, min(8, t["n_items"]), 0.5, max_side=2, mesh=mesh).mine()
+
+
+def _warm_sweep(t: dict, mesh) -> None:
+    """Compile the incremental sweep chain at one enumerated row bucket:
+    rebuild a live batch's store at that bucket, then dispatch the
+    prep/supports/materialize kernels (and the repair fold) across the
+    pow2 width ladder live sweeps use."""
+    import jax.numpy as jnp
+
+    from spark_fsm_tpu.models._common import next_pow2
+    from spark_fsm_tpu.models.spade_tpu import _spade_fns
+    from spark_fsm_tpu.streaming.incremental import (
+        IncrementalWindowMiner, _fold_supports_fn)
+
+    miner = IncrementalWindowMiner(
+        1.0, max_batches=4, mesh=mesh,
+        # live batch stores bucket at bucket_seq(max(push, floor)); the
+        # warm pushes are tiny, so the floor must carry BOTH envelope
+        # knobs to land on the live bucket
+        seq_floor=max(t["batch_sequences"], t.get("seq_floor", 0)))
+    batch = [[[i]] for i in range(1, t["n_items"] + 1)]
+    if t["n_words"] > 1:
+        batch[0] = [[1]] * (32 * (t["n_words"] - 1) + 1)
+    # two pushes: the first compiles the token scatter + repair fold for
+    # the fresh tree, the second the sweep over an existing tree — the
+    # exact mid-stream pattern behind the config-5 push-2 stall
+    miner.push(batch)
+    miner.push(list(batch))
+    st = next(iter(miner._states.values()))
+    f1 = sorted(miner._item_totals)
+    target = t["n_rows"]
+    if st._n_rows != target:
+        st.drop_store()
+        st._project(f1, max(0, target - st.ni_rows - 1))
+    assert st._n_rows == target, (st._n_rows, target)
+    fns = _spade_fns(miner.mesh, st.n_words)
+    put = miner._put
+    scratch = st._n_rows - 1
+    # Live sweep shapes form a 2-D family: prep (pt) width = pow2 bucket
+    # of the level's NODE count, candidate width = pow2 bucket of the
+    # level's candidate count (chunk-capped at support_chunk), and the
+    # two compose into one compiled program per (p, c) pair.  Warm the
+    # full pow2 grid — it is bounded (log x log) and each entry is a
+    # small XLA program; absorbing it at boot is the whole point.  The
+    # tree's level width is bounded by the row bucket it projects into
+    # (extra work rows = 2*level width), so the ladders follow n_rows,
+    # not the item count — tracked nodes share items, so levels run far
+    # wider than the alphabet.
+    p_hi = max(8, next_pow2(max(t["n_items"], t["n_rows"] // 2)))
+    c_hi = min(miner.support_chunk,
+               next_pow2(max(8, t["n_items"] * t["n_items"],
+                             t["n_rows"])))
+    p = 8
+    while p <= p_hi:
+        slots = np.full(p, scratch, np.int32)
+        pt = fns["prep"](st.store, put(slots))
+        c = 8
+        while c <= c_hi:
+            if not miner.use_pallas:  # TPU routes supports via Pallas
+                fns["supports"](pt, st.store,
+                                put(np.zeros(c, np.int32)),
+                                put(np.zeros(c, np.int32)),
+                                put(np.zeros(c, bool)))
+            st.store = fns["materialize"](
+                pt, st.store, put(np.zeros(c, np.int32)),
+                put(np.zeros(c, np.int32)), put(np.zeros(c, bool)),
+                put(np.full(c, scratch, np.int32)))
+            c *= 2
+        if miner.use_pallas:
+            # the Pallas pair-matrix path pads candidates to pow2 caps
+            # >= 1024 — the dominant per-shape Mosaic compile (this IS
+            # the config-5 push-2 stall, paid here instead).  Drive the
+            # SAME launcher the live sweep uses (the shard_map'd
+            # _pallas_supports_fn under a mesh; a mismatched dummy call
+            # would warm a program the stream never runs), across cap
+            # buckets up to 16384 — levels with more candidates pay a
+            # live recompile of the cheap extraction program, not of
+            # the pair kernel (which is keyed per pt width, warmed
+            # here).
+            from spark_fsm_tpu.ops import pallas_support as PS
+            items_arr = st.items_t if st.items_t is not None else st.store
+            cap = 1024
+            while cap <= 16384:
+                pref = np.zeros(cap, np.int32)
+                if miner.mesh is not None:
+                    from spark_fsm_tpu.models.spade_tpu import (
+                        _pallas_supports_fn)
+                    _pallas_supports_fn(
+                        miner.mesh, st.ni_rows, st.s_block, st.n_words,
+                        miner._interpret)(pt, items_arr, put(pref),
+                                          put(pref))
+                else:
+                    PS.batch_supports(
+                        pt, items_arr, st.ni_rows, jnp.asarray(pref),
+                        jnp.asarray(pref),
+                        items_kernel_layout=st.items_t is not None,
+                        s_block=st.s_block, interpret=miner._interpret,
+                        n_words=st.n_words)
+                cap *= 2
+        p *= 2
+    fold = _fold_supports_fn(st.n_words, miner.mesh)
+    for k in (2, 4, 8, 16):  # pow2-bucketed step depth x chunk width
+        fw = 8
+        while fw <= next_pow2(miner.repair_chunk):
+            fold(st.store, put(np.zeros((k, fw), np.int32)),
+                 put(np.zeros((k, fw), bool)),
+                 put(np.zeros((k, fw), bool)))
+            fw *= 2
+    # the remap scatter-build: live batches land on pow2 token-count and
+    # remap-length buckets (both traced shapes) — warm a small grid
+    # around the declared envelope
+    from spark_fsm_tpu.streaming.incremental import _inc_store_builder
+    fn = _inc_store_builder(target, st.n_seq, st.n_words, miner.mesh)
+    rb0 = next_pow2(max(16, t["n_items"]))
+    for nt in _token_buckets(t["n_items"], t["max_tokens"]):
+        for rb in (rb0, 2 * rb0):
+            z = np.zeros(nt, np.int32)
+            fn(put(z), put(z), put(z), put(np.zeros(nt, np.uint32)),
+               put(np.full(rb, target + 1, np.int32)))
+
+
+def run(spec: shapes.WorkloadSpec, *, mesh=None,
+        engine_kwargs: Optional[dict] = None) -> dict:
+    """Walk the enumerated shape set and compile every entry; returns a
+    report with per-key walls + fresh-compile counts and stores it for
+    ``/admin/stats`` / ``/admin/shapes``."""
+    import jax
+
+    enable_compile_counter()
+    engine_kwargs = dict(engine_kwargs or {})
+    eng_sub = {k: v for k, v in engine_kwargs.items()
+               if k in ("chunk", "node_batch", "pipeline_depth",
+                        "recompute_chunk", "pool_bytes")}
+    targets = shapes.enumerate_shapes(spec, mesh=mesh,
+                                      engine_kwargs=engine_kwargs)
+    rows: List[dict] = []
+    t_all = time.monotonic()
+    for key, t in sorted(targets.items()):
+        c0 = compile_counts()
+        t0 = time.monotonic()
+        err = None
+        try:
+            if t["kind"] == "classic":
+                _warm_classic(t, mesh, eng_sub)
+            elif t["kind"] == "queue":
+                _warm_queue(t, mesh)
+            elif t["kind"] == "fused":
+                _warm_fused(t, mesh)
+            elif t["kind"] == "cspade":
+                _warm_cspade(t, mesh, eng_sub)
+            elif t["kind"] == "tsr":
+                _warm_tsr(t, mesh)
+            elif t["kind"] == "sweep":
+                _warm_sweep(t, mesh)
+        except Exception as exc:  # a failed warm must not take down boot
+            err = f"{type(exc).__name__}: {exc}"
+        c1 = compile_counts()
+        row = {"shape_key": key, "kind": t["kind"],
+               "wall_s": round(time.monotonic() - t0, 3),
+               "fresh_compiles": c1["count"] - c0["count"],
+               "compile_s": round(c1["seconds"] - c0["seconds"], 3)}
+        if err:
+            row["error"] = err
+        rows.append(row)
+        log_event("prewarm_key", **row)
+    report = {
+        "keys": rows,
+        "enumerated": sorted(targets),
+        "total_wall_s": round(time.monotonic() - t_all, 3),
+        "backend": jax.default_backend(),
+        "ts": round(time.time(), 3),
+    }
+    global _last_report
+    with _lock:
+        _last_report = report
+    log_event("prewarm_done", keys=len(rows),
+              total_wall_s=report["total_wall_s"])
+    return report
+
+
+def last_report() -> Optional[dict]:
+    with _lock:
+        return _last_report
+
+
+def spec_from_config(pc) -> Optional[shapes.WorkloadSpec]:
+    """WorkloadSpec from a config.PrewarmConfig; None when the envelope
+    is empty (nothing to warm)."""
+    constraints = ()
+    if pc.maxgap is not None or pc.maxwindow is not None:
+        constraints = ((pc.maxgap, pc.maxwindow),)
+    if pc.sequences <= 0 and pc.stream_batch_sequences <= 0:
+        return None
+    return shapes.WorkloadSpec(
+        n_sequences=int(pc.sequences), n_items=int(pc.items),
+        n_words=max(1, int(pc.words)), constraints=constraints,
+        tsr=bool(pc.tsr),
+        stream_batch_sequences=int(pc.stream_batch_sequences),
+        stream_items=int(pc.stream_items),
+        stream_seq_floor=int(pc.stream_seq_floor),
+        checkpointed=bool(pc.checkpointed),
+        max_tokens=int(pc.max_tokens))
+
+
+def spec_from_params(params: Dict[str, str], pc) -> shapes.WorkloadSpec:
+    """WorkloadSpec for ``POST /admin/prewarm``: request parameters
+    override the boot ``[prewarm]`` envelope field-by-field."""
+    def geti(name, default):
+        v = params.get(name)
+        return int(v) if v not in (None, "") else int(default or 0)
+
+    maxgap = params.get("maxgap", pc.maxgap)
+    maxwindow = params.get("maxwindow", pc.maxwindow)
+    constraints = ()
+    if maxgap not in (None, "") or maxwindow not in (None, ""):
+        constraints = ((int(maxgap) if maxgap not in (None, "") else None,
+                        int(maxwindow) if maxwindow not in (None, "")
+                        else None),)
+    truthy = lambda v, d: (str(v).lower() not in ("", "0", "false", "no",
+                                                  "off")
+                           if v is not None else bool(d))
+    return shapes.WorkloadSpec(
+        n_sequences=geti("sequences", pc.sequences),
+        n_items=geti("items", pc.items),
+        n_words=max(1, geti("words", pc.words)),
+        constraints=constraints,
+        tsr=truthy(params.get("tsr"), pc.tsr),
+        stream_batch_sequences=geti("stream_batch_sequences",
+                                    pc.stream_batch_sequences),
+        stream_items=geti("stream_items", pc.stream_items),
+        stream_seq_floor=geti("stream_seq_floor", pc.stream_seq_floor),
+        checkpointed=truthy(params.get("checkpointed"), pc.checkpointed),
+        max_tokens=geti("max_tokens", pc.max_tokens))

@@ -19,7 +19,52 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Optional
+
+# ---------------------------------------------------------------------------
+# Fresh-compile observability: count actual XLA backend compiles via
+# jax.monitoring ('/jax/core/compile/backend_compile_duration' fires once
+# per compiled program; in-process jit-cache hits and persistent-cache
+# deserializations do not).  The prewarm driver uses this to report how
+# much compile work it prepaid, and the drift test to assert a prewarmed
+# first mine compiles NOTHING fresh.
+# ---------------------------------------------------------------------------
+
+_counter_lock = threading.Lock()
+_compile_counter = {"count": 0, "seconds": 0.0}
+_counter_registered = False
+
+
+def enable_compile_counter() -> bool:
+    """Install the (idempotent, process-wide) compile-event listener.
+    Returns False when this jax version emits no such event — callers
+    fall back to wall-clock heuristics then."""
+    global _counter_registered
+    with _counter_lock:
+        if _counter_registered:
+            return True
+        try:
+            from jax import monitoring
+
+            def _on_event(event: str, duration: float, **kw) -> None:
+                if event.endswith("backend_compile_duration"):
+                    with _counter_lock:
+                        _compile_counter["count"] += 1
+                        _compile_counter["seconds"] += float(duration)
+
+            monitoring.register_event_duration_secs_listener(_on_event)
+            _counter_registered = True
+            return True
+        except Exception:
+            return False
+
+
+def compile_counts() -> dict:
+    """Snapshot of fresh-compile count + total seconds since process
+    start (zeros until :func:`enable_compile_counter` ran)."""
+    with _counter_lock:
+        return dict(_compile_counter)
 
 
 def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:

@@ -1,0 +1,244 @@
+"""Shape-key registry: compiled device geometry, enumerable and observed.
+
+Every engine stamps its job stats with a ``shape_key`` — a string that
+identifies the COMPILED geometry of its device programs (two mines with
+equal keys reuse every compiled program).  Until now each engine built
+that string inline, which made the set of keys a runtime observation
+only: an operator could count distinct keys after the fact, but nothing
+could say, for a given config, which keys a deployment WILL compile —
+so a fresh deployment learned its cold-start bill (41.7 s per
+cache-missed geometry, BASELINE.json ``cold_start``) by paying it on a
+live ``/train``.
+
+This module closes that loop:
+
+- **one definition per key format** (``key_*``): the engines call these
+  when stamping stats, so the enumerator and the engines cannot drift
+  on spelling;
+- **a runtime registry** (:func:`record` / :func:`recorded`): engines
+  record their key at construction time — the moment that decides which
+  programs compile — so ``/admin/shapes`` can diff what actually ran
+  against what was enumerated (:func:`drift`);
+- **an enumerator** (:func:`enumerate_shapes`): given a
+  :class:`WorkloadSpec` (the data geometry an operator expects) and the
+  boot engine knobs, compute the finite set of shape keys the
+  service-default paths will compile — WITHOUT mining — by calling the
+  same geometry functions the engines' constructors use
+  (``classic_geometry`` et al.).  ``service/prewarm.py`` walks this set
+  at boot and compiles every entry against tiny synthetic stores.
+
+Key formats (the geometry axes that decide compiled shapes):
+
+  ``classic:s{S}w{W}r{R}nb{NB}c{C}``        models/spade_tpu.py
+  ``queue:s{S}w{W}ni{NI}nb{NB}r{RING}``     models/spade_queue.py
+  ``fused:s{S}w{W}ni{NI}f{FCAP}``           models/spade_fused.py
+  ``cspade:s{S}w{W}i{I}p{P}nb{NB}c{C}g{G}x{X}d{BITS}``
+                                            models/spade_constrained.py
+                                            (g/x: maxgap/maxwindow — they
+                                            select DIFFERENT compiled
+                                            kernels; d: state dtype bits)
+  ``tsr:s{S}w{W}``                          models/tsr.py (static part;
+                                            per-km buckets vary by design)
+  ``sweep:s{S}w{W}r{R}i{NI}``               streaming/incremental.py
+                                            batch-store geometry (the
+                                            config-5 mid-stream compile)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ----------------------------------------------------------------- formats
+
+
+def key_classic(n_seq: int, n_words: int, rows: int, node_batch: int,
+                chunk: int) -> str:
+    return f"classic:s{n_seq}w{n_words}r{rows}nb{node_batch}c{chunk}"
+
+
+def key_queue(n_seq: int, n_words: int, ni_pad: int, nb: int,
+              ring: int) -> str:
+    return f"queue:s{n_seq}w{n_words}ni{ni_pad}nb{nb}r{ring}"
+
+
+def key_fused(n_seq: int, n_words: int, ni_pad: int, f_cap: int) -> str:
+    return f"fused:s{n_seq}w{n_words}ni{ni_pad}f{f_cap}"
+
+
+def key_cspade(n_seq: int, n_words: int, item_rows: int, pool_slots: int,
+               node_batch: int, chunk: int, maxgap: Optional[int],
+               maxwindow: Optional[int], state_bits: int) -> str:
+    g = "n" if maxgap is None else int(maxgap)
+    x = "n" if maxwindow is None else int(maxwindow)
+    return (f"cspade:s{n_seq}w{n_words}i{item_rows}p{pool_slots}"
+            f"nb{node_batch}c{chunk}g{g}x{x}d{state_bits}")
+
+
+def key_tsr(n_seq: int, n_words: int) -> str:
+    return f"tsr:s{n_seq}w{n_words}"
+
+
+def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
+    return f"sweep:s{n_seq}w{n_words}r{n_rows}i{ni_rows}"
+
+
+# ---------------------------------------------------------------- registry
+
+_lock = threading.Lock()
+_recorded: Dict[str, int] = {}
+
+
+def record(key: str) -> None:
+    """Note a compiled-geometry key at engine-construction time (the
+    moment that fixes which device programs compile)."""
+    with _lock:
+        _recorded[key] = _recorded.get(key, 0) + 1
+
+
+def recorded() -> Dict[str, int]:
+    """Every shape key observed this process, with construction counts."""
+    with _lock:
+        return dict(_recorded)
+
+
+def reset_recorded() -> None:
+    with _lock:
+        _recorded.clear()
+
+
+def drift(enumerated: Iterable[str]) -> List[str]:
+    """Runtime-observed keys absent from an enumerated set — each one is
+    a geometry a prewarmed deployment would still compile on a live
+    request (registry drift; surfaced by ``/admin/shapes``)."""
+    known = set(enumerated)
+    return sorted(k for k in recorded() if k not in known)
+
+
+# -------------------------------------------------------------- enumerator
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """The data geometry an operator expects to serve — everything the
+    enumerator needs to list the compiled shapes without mining.
+
+    ``n_sequences``/``n_items``/``n_words``: the batch ``/train``
+    envelope (sequence count, FREQUENT-projection width at the service
+    support, bitmap word count — ``build_vertical`` computes the latter
+    two from a data sample for free, no mining involved).
+    ``constraints``: (maxgap, maxwindow) pairs cSPADE requests will
+    carry — each pair selects different compiled kernels.
+    ``tsr``: also enumerate the TSR engine's static geometry.
+    ``stream_batch_sequences``/``stream_items``: the incremental
+    streaming envelope — per-push micro-batch size and window frequent-
+    item width; ``sweep_row_buckets`` successive pow2 work-row buckets
+    are enumerated per sweep geometry (the tracked tree's level width
+    decides the bucket at runtime — levels run far wider than the
+    alphabet because tracked nodes share items, so the default covers
+    trees up to 8x the item-row bucket).
+    ``checkpointed``: prewarm also compiles the segmented (resumable)
+    queue programs.
+    """
+
+    n_sequences: int
+    n_items: int
+    n_words: int = 1
+    constraints: Tuple[Tuple[Optional[int], Optional[int]], ...] = ()
+    tsr: bool = False
+    stream_batch_sequences: int = 0
+    stream_items: int = 0
+    stream_seq_floor: int = 0  # must mirror [prewarm] stream_seq_floor:
+    # live batch stores bucket at bucket_seq(max(push, floor)), so an
+    # enumeration without the floor would list the WRONG seq bucket
+    sweep_row_buckets: int = 4
+    checkpointed: bool = False
+    # token-table size bound for store-build warming: token-array LENGTH
+    # is a traced shape of the scatter build (pow2-bucketed by
+    # _common.scatter_build_store), so prewarm compiles the builder for
+    # every pow2 bucket up to this bound.  0 = 8 x n_sequences.
+    max_tokens: int = 0
+
+
+def enumerate_shapes(spec: WorkloadSpec, *, mesh=None,
+                     engine_kwargs: Optional[dict] = None
+                     ) -> Dict[str, dict]:
+    """The finite set of service-default shape keys for ``spec`` under
+    the given boot knobs — a superset of what the router will actually
+    run (queue AND its classic fallback AND the dense engine where
+    eligible are all listed; compiling a fallback at boot is cheap
+    insurance, missing one is a 40 s live stall).
+
+    Returns ``{shape_key: target}`` where ``target`` carries the kind
+    and geometry parameters ``service/prewarm.py`` needs to compile the
+    entry.  Uses the SAME geometry functions the engine constructors
+    use, so enumeration cannot drift from construction (and the drift
+    test pins it).
+    """
+    import jax
+
+    from spark_fsm_tpu.models import spade_constrained, spade_fused
+    from spark_fsm_tpu.models import spade_queue, spade_tpu, tsr
+
+    ekw = dict(engine_kwargs or {})
+    use_pallas = jax.default_backend() == "tpu"
+    out: Dict[str, dict] = {}
+
+    def add(key: str, **target) -> None:
+        out.setdefault(key, target)
+
+    ns, ni, nw = int(spec.n_sequences), int(spec.n_items), int(spec.n_words)
+    max_tokens = int(spec.max_tokens) or 8 * ns
+    if ns > 0 and ni > 0:
+        ckw = {k: v for k, v in ekw.items()
+               if k in ("chunk", "node_batch", "pipeline_depth",
+                        "recompute_chunk", "pool_bytes")}
+        g = spade_tpu.classic_geometry(ns, ni, nw, mesh=mesh,
+                                       use_pallas=use_pallas, **ckw)
+        add(g["shape_key"], kind="classic", n_sequences=ns, n_items=ni,
+            n_words=nw, max_tokens=max_tokens)
+        q = spade_queue.queue_geometry(ns, ni, nw, mesh=mesh,
+                                       use_pallas=use_pallas)
+        add(q["shape_key"], kind="queue", n_sequences=ns, n_items=ni,
+            n_words=nw, max_tokens=max_tokens,
+            checkpointed=bool(spec.checkpointed))
+        f = spade_fused.fused_geometry(ns, ni, nw, mesh=mesh,
+                                       use_pallas=use_pallas)
+        add(f["shape_key"], kind="fused", n_sequences=ns, n_items=ni,
+            n_words=nw, max_tokens=max_tokens)
+        for maxgap, maxwindow in spec.constraints:
+            cg = spade_constrained.cspade_geometry(
+                ns, ni, nw, maxgap=maxgap, maxwindow=maxwindow, mesh=mesh,
+                **{k: v for k, v in ekw.items()
+                   if k in ("chunk", "node_batch", "pipeline_depth",
+                            "recompute_chunk", "pool_bytes")})
+            add(cg["shape_key"], kind="cspade", n_sequences=ns, n_items=ni,
+                n_words=nw, max_tokens=max_tokens,
+                maxgap=maxgap, maxwindow=maxwindow)
+        if spec.tsr:
+            tg = tsr.tsr_geometry(ns, nw, mesh=mesh, use_pallas=use_pallas)
+            add(tg["shape_key"], kind="tsr", n_sequences=ns, n_items=ni,
+                n_words=nw)
+
+    if spec.stream_batch_sequences > 0 and spec.stream_items > 0:
+        from spark_fsm_tpu.streaming import incremental
+
+        sg = incremental.sweep_geometry(
+            int(spec.stream_batch_sequences), nw, mesh=mesh,
+            use_pallas=use_pallas, seq_floor=int(spec.stream_seq_floor))
+        from spark_fsm_tpu.models._common import next_pow2
+        from spark_fsm_tpu.ops import pallas_support as PS
+
+        ni_rows = -(-max(int(spec.stream_items), 1) // PS.I_TILE) * PS.I_TILE
+        rows = next_pow2(ni_rows + 1)
+        for _ in range(max(1, int(spec.sweep_row_buckets))):
+            add(key_sweep(sg["n_seq"], sg["n_words"], rows, ni_rows),
+                kind="sweep",
+                batch_sequences=int(spec.stream_batch_sequences),
+                n_items=int(spec.stream_items), n_words=nw,
+                max_tokens=8 * int(spec.stream_batch_sequences),
+                seq_floor=int(spec.stream_seq_floor),
+                ni_rows=ni_rows, n_rows=rows)
+            rows *= 2
+    return out

@@ -14,20 +14,47 @@ dryrun_multichip uses the same mechanism.
 """
 
 import os
+import subprocess
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
-if "--xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+
+_COLLECTIVE_FLAG = "--xla_cpu_collective_call_terminate_timeout_seconds=1200"
+
+
+def _xla_accepts(flag: str) -> bool:
+    """Probe (in a throwaway process) whether this jaxlib's XLA knows
+    ``flag``: XLA parse_flags_from_env FATALS the whole process on any
+    unknown XLA_FLAGS entry, so appending an unsupported flag here
+    would abort EVERY test run at first backend init — which is exactly
+    what happened when the sandbox's jaxlib moved to a version without
+    the collective-timeout flag (the 'seed tests failing' state)."""
+    code = ("import os; os.environ['JAX_PLATFORMS']='cpu'; "
+            "import jax; jax.config.update('jax_platforms','cpu'); "
+            "jax.devices()")
+    env = dict(os.environ, XLA_FLAGS=flag)
+    try:
+        return subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True,
+                              timeout=120).returncode == 0
+    except Exception:
+        return False
+
+
+if (os.environ.get("RUN_SLOW")
+        and "--xla_cpu_collective_call_terminate_timeout_seconds" not in _flags
+        and _xla_accepts(_COLLECTIVE_FLAG)):
     # XLA CPU ABORTS the whole process when an 8-way collective's
     # participants don't all arrive within 40s — on a 1-core box the 8
     # virtual devices timeshare one core, so a mid-scale mesh program
     # (RUN_SLOW) can genuinely need minutes to reach the rendezvous.
     # Raise the failure-detection deadline; a real deadlock still
-    # terminates, just later.
-    _flags = (_flags
-              + " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+    # terminates, just later.  Only needed for the RUN_SLOW mesh tests,
+    # and only when this jaxlib actually knows the flag (see probe).
+    _flags = (_flags + " " + _COLLECTIVE_FLAG)
 os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402

@@ -180,3 +180,167 @@ def test_tsr_service_route_uses_cache():
     assert r1 == r2
     assert s1["store_cache_hit"] is False
     assert s2["store_cache_hit"] is True
+
+
+def test_cspade_repeat_mine_hits_and_matches_oracle():
+    # the cSPADE half of the repeat-/train story (ISSUE-1 tentpole):
+    # the constrained engine keeps its item store + max-start pool
+    # across mine() calls, so a repeat hit skips build + construction
+    # and returns the byte-identical constrained pattern set
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    from spark_fsm_tpu.service.devcache import CSpadeEngineCache
+
+    cache = CSpadeEngineCache()
+    db = _db(seed=21)
+    want = mine_cspade(db, 6, maxgap=2, maxwindow=5)
+    s1, s2 = {}, {}
+    r1 = cache.mine(db, 6, maxgap=2, maxwindow=5, stats_out=s1)
+    r2 = cache.mine(db, 6, maxgap=2, maxwindow=5, stats_out=s2)
+    assert patterns_text(r1) == patterns_text(r2) == patterns_text(want)
+    assert s1["store_cache_hit"] is False
+    assert s2["store_cache_hit"] is True
+    assert cache.stats["hits"] == 1 and cache.stats["misses"] == 1
+
+
+def test_cspade_key_folds_constraints():
+    # maxgap/maxwindow select different kernels AND different
+    # enumerations — entries must never be shared across constraint
+    # pairs, and each entry must keep answering its own pair correctly
+    from spark_fsm_tpu.models.oracle import mine_cspade
+    from spark_fsm_tpu.service.devcache import CSpadeEngineCache
+
+    cache = CSpadeEngineCache()
+    db = _db(seed=22)
+    cache.mine(db, 6, maxgap=2, maxwindow=5, stats_out={})
+    s = {}
+    cache.mine(db, 6, maxgap=1, maxwindow=5, stats_out=s)
+    assert s["store_cache_hit"] is False  # different maxgap: miss
+    s = {}
+    cache.mine(db, 6, maxgap=2, maxwindow=None, stats_out=s)
+    assert s["store_cache_hit"] is False  # different maxwindow: miss
+    assert cache.stats["hits"] == 0
+    s = {}
+    got = cache.mine(db, 6, maxgap=1, maxwindow=5, stats_out=s)
+    assert s["store_cache_hit"] is True
+    assert patterns_text(got) == patterns_text(
+        mine_cspade(db, 6, maxgap=1, maxwindow=5))
+
+
+def test_cspade_checkpoint_and_kwargs_fall_through():
+    from spark_fsm_tpu.service.devcache import CSpadeEngineCache
+
+    class Ckpt:
+        every_s = 30.0
+
+        def load(self):
+            return None
+
+        def save(self, state):
+            pass
+
+    cache = CSpadeEngineCache()
+    db = _db(seed=23)
+    s = {}
+    cache.mine(db, 6, maxgap=2, stats_out=s, checkpoint=Ckpt())
+    assert "store_cache_hit" not in s  # uncached wrapper path
+    s = {}
+    cache.mine(db, 6, maxgap=2, stats_out=s, chunk=64)
+    assert "store_cache_hit" not in s
+    assert not cache.stats["hits"] and not cache.stats["misses"]
+
+
+def test_checkpointed_mine_reuses_cached_engine():
+    """ISSUE-1 acceptance: a checkpoint-resumed mine checks out the
+    cached engine and seeds it from the snapshot — the repeat pays
+    neither upload nor build, and the resumed result set is exact."""
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.service.devcache import SpadeEngineCache
+
+    db = _db(seed=24, n=240)
+    minsup = abs_minsup(0.05, len(db))
+    cache = SpadeEngineCache()
+    want = mine_spade(db, minsup)
+
+    # 1. a plain mine populates the cache with the (queue) engine
+    s0 = {}
+    r0 = cache.mine(db, minsup, stats_out=s0)
+    assert patterns_text(r0) == patterns_text(want)
+    assert s0["store_cache_hit"] is False
+
+    # 2. a checkpointed job crashes mid-mine, leaving a snapshot
+    class Crash(Exception):
+        pass
+
+    class CrashingCkpt:
+        every_s = 0.0
+
+        def __init__(self):
+            self.saved = []
+            self.merged = []
+            self.crash = True
+
+        def load(self):
+            if not self.saved:
+                return None
+            state = dict(self.saved[-1])
+            state["results"] = list(self.merged)
+            return state
+
+        def save(self, state):
+            assert state["results_done"] == len(self.merged)
+            self.merged.extend(state.pop("results"))
+            state["results"] = None  # guard: load() rebuilds it
+            self.saved.append(state)
+            if self.crash and len(self.saved) == 1:
+                raise Crash
+
+    ckpt = CrashingCkpt()
+    with pytest.raises(Crash):
+        cache.mine(db, minsup, stats_out={}, checkpoint=ckpt)
+    assert ckpt.saved and ckpt.saved[-1]["stack"], \
+        "crash happened after the frontier emptied — lower every_s"
+
+    # 3. the retry resumes ON THE CACHED ENGINE from the snapshot
+    ckpt.crash = False
+    s2 = {}
+    r2 = cache.mine(db, minsup, stats_out=s2, checkpoint=ckpt)
+    assert s2["store_cache_hit"] is True, s2
+    assert s2.get("resumed_nodes", 0) > 0, s2
+    assert patterns_text(r2) == patterns_text(want)
+
+
+def test_cspade_train_twice_hits_cache_visible_in_admin_stats(server):
+    # ISSUE-1 acceptance: a repeat cSPADE /train (same data, same
+    # maxgap/maxwindow, same minsup) is a cache hit visible both in the
+    # job's own stats and in /admin/stats' cspade_cache counters
+    import time
+
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.service.devcache import cspade_engine_cache
+
+    cspade_engine_cache.clear()
+    hits0 = cspade_engine_cache.stats["hits"]
+    db = _db(seed=25)
+
+    def train(uid):
+        r = _post(server, "/train", algorithm="SPADE_TPU", source="INLINE",
+                  sequences=format_spmf(db), support="6",
+                  maxgap="2", maxwindow="5", uid=uid)
+        assert r["status"] == "started", r
+        for _ in range(200):
+            st = _post(server, "/status/" + uid)
+            if st["status"] in ("finished", "failure"):
+                assert st["status"] == "finished", st
+                return st
+            time.sleep(0.1)
+        raise AssertionError("job did not finish")
+
+    st1 = train("cs1")
+    st2 = train("cs2")
+    assert json.loads(st1["data"]["stats"])["store_cache_hit"] is False
+    assert json.loads(st2["data"]["stats"])["store_cache_hit"] is True
+    p1 = _post(server, "/get/patterns", uid="cs1")["data"]["patterns"]
+    p2 = _post(server, "/get/patterns", uid="cs2")["data"]["patterns"]
+    assert p1 == p2
+    admin = _post(server, "/admin/stats")
+    assert admin["cspade_cache"]["hits"] >= hits0 + 1, admin

@@ -10,6 +10,18 @@ and requires byte-identical parity with the CPU oracle.
 
 Minutes-long (CPU mesh + full-size oracle): gated behind RUN_SLOW=1,
 same convention as tests/test_tsr.py's full-scale run.
+
+The queue and fused engines additionally carry the ``veryslow`` marker:
+their whole-mine ``lax.while_loop`` programs run INTERPRETED on the
+virtual CPU mesh, so the wall is dominated by compile + interpretation,
+not by the parity check the test exists for — measured 169.9 s (queue)
+vs 4.36 s (classic) for the same DB and candidate width (SLOWTESTS.json,
+round 5), which made the RUN_SLOW suite ~43 min of mostly queue/fused
+compile.  On real TPU hardware the same engines are the FASTEST route
+(BENCH_r05: queue engine, 0.43 s steady), so the cost is an artifact of
+the emulation substrate, not the engines.  Keep them in RUN_SLOW
+evidence runs (slowtests.py); deselect with ``-m 'not veryslow'`` when
+iterating locally.
 """
 
 import json
@@ -76,6 +88,7 @@ def test_classic_engine_midscale_mesh(midscale):
             patterns=len(got))
 
 
+@pytest.mark.veryslow
 def test_queue_engine_midscale_mesh(midscale):
     from spark_fsm_tpu.models.spade_queue import QueueSpadeTPU
     from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
@@ -92,6 +105,7 @@ def test_queue_engine_midscale_mesh(midscale):
             waves=eng.stats["waves"], patterns=len(got))
 
 
+@pytest.mark.veryslow
 def test_fused_engine_midscale_mesh(midscale):
     from spark_fsm_tpu.models.spade_fused import FusedCaps, FusedSpadeTPU
     from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
