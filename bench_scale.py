@@ -125,14 +125,19 @@ def _tsr(max_side, tag: str, note: str) -> dict:
     }
     # per-km decomposition (models/tsr.py per-bucket counters): padded
     # width x km is the kernel's per-candidate traffic unit, so these
-    # separate candidate-mix cost (irreducible) from launch underfill
+    # separate candidate-mix cost (irreducible) from launch packing
     per_km = {k: v for k, v in sorted(eng.stats.items())
-              if k.startswith(("evaluated_km", "launches_km", "width_km"))}
+              if k.startswith(("evaluated_km", "launches_km", "width_km",
+                               "borrowed_km"))}
     if per_km:
         out["per_km"] = per_km
-        out["traffic_units"] = sum(
-            v * int(k[len("width_km"):]) for k, v in per_km.items()
-            if k.startswith("width_km"))
+    # super-batch / pruning counters (ops/ragged_batch.py + the TSR
+    # conf-bound pruning): the engine maintains traffic_units itself
+    # (width x geometry-km summed over launches, jnp path included)
+    out["traffic_units"] = eng.stats.get("traffic_units")
+    out["superbatches"] = eng.stats.get("superbatches", 0)
+    out["pruned_conf"] = eng.stats.get("pruned_conf", 0)
+    out["pruned_conf_chains"] = eng.stats.get("pruned_conf_chains", 0)
     return out
 
 

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Seconds-scale launch/traffic smoke of the BENCH_SCALE hot configs.
+
+Runs shaped miniatures of configs 3 (full-Kosarak TSR, max_side=2),
+3d (same, unlimited sides — the service default) and 5 (incremental
+streaming) and diffs the DISPATCH-SHAPE counters — ``kernel_launches``,
+``evaluated``, ``traffic_units`` — against the committed expectations in
+``scripts/bench_smoke_expect.json``.  Walls are reported but never
+compared: the point is that launch-packing / candidate-generation
+regressions fail in seconds on any machine (CI, laptop) instead of
+surfacing weeks later in an hours-long BENCH_SCALE session on real
+hardware.  The TSR rows double as the dryrun-scale record of the
+super-batch collapse (pre-superbatch policy on the same 3d miniature:
+49 launches; committed: 10).
+
+Counters are deterministic on the CPU backend, so the diff is EXACT.
+``--update`` rewrites the expectations (do this only for a deliberate
+dispatch-policy change, and say so in the commit).
+
+Usage: scripts/bench_smoke.sh [--update]   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+EXPECT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_smoke_expect.json")
+
+COMPARED = ("kernel_launches", "evaluated", "traffic_units",
+            "pruned_conf", "superbatches")
+
+
+def smoke_tsr(max_side):
+    from spark_fsm_tpu.data.synth import kosarak_like
+    from spark_fsm_tpu.data.vertical import build_vertical
+    from spark_fsm_tpu.models.tsr import TsrTPU
+
+    db = kosarak_like(scale=0.002, fast=True)
+    vdb = build_vertical(db, min_item_support=1)
+    t0 = time.monotonic()
+    eng = TsrTPU(vdb, 100, 0.5, max_side=max_side)
+    rules = eng.mine()
+    return {
+        "kernel_launches": eng.stats["kernel_launches"],
+        "evaluated": eng.stats["evaluated"],
+        "traffic_units": eng.stats["traffic_units"],
+        "rules": len(rules),
+        "pruned_conf": eng.stats.get("pruned_conf", 0),
+        "superbatches": eng.stats.get("superbatches", 0),
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+
+
+def smoke_stream():
+    from spark_fsm_tpu.data.synth import msnbc_like
+    from spark_fsm_tpu.streaming.incremental import IncrementalWindowMiner
+
+    db = msnbc_like(scale=0.002, fast=True)
+    per = len(db) // 4
+    t0 = time.monotonic()
+    wm = IncrementalWindowMiner(0.02, max_batches=2)
+    for i in range(4):
+        wm.push(db[i * per:(i + 1) * per])
+    return {
+        "patterns": len(wm.patterns),
+        "tracked_nodes": wm.stats["tracked_nodes"],
+        "sweep_candidates": wm.stats["sweep_candidates"],
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+
+
+def main() -> int:
+    update = "--update" in sys.argv[1:]
+    rows = {
+        "3": smoke_tsr(2),
+        "3d": smoke_tsr(None),
+        "5": smoke_stream(),
+    }
+    print(json.dumps(rows, indent=2))
+    if update:
+        with open(EXPECT_PATH, "w") as fh:
+            json.dump(rows, fh, indent=2)
+            fh.write("\n")
+        print(f"bench_smoke: expectations rewritten -> {EXPECT_PATH}")
+        return 0
+    try:
+        with open(EXPECT_PATH) as fh:
+            expect = json.load(fh)
+    except OSError:
+        sys.exit(f"bench_smoke: no committed expectations at {EXPECT_PATH}"
+                 " (run with --update once, then commit the file)")
+    failures = []
+    for cfg, row in rows.items():
+        for key, want in expect.get(cfg, {}).items():
+            if key == "wall_s" or key not in row:
+                continue  # walls are machine-dependent; never compared
+            if cfg == "5" and key not in ("patterns", "tracked_nodes",
+                                          "sweep_candidates"):
+                continue
+            if cfg != "5" and key not in COMPARED + ("rules",):
+                continue
+            if row[key] != want:
+                failures.append(f"config {cfg}: {key} = {row[key]}, "
+                                f"committed {want}")
+    if failures:
+        print("bench_smoke: DISPATCH-SHAPE DRIFT (deliberate? re-run "
+              "with --update and commit):", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print("bench_smoke: all counters match the committed expectations")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Launch/traffic smoke — the seconds-scale companion to verify_t1.sh.
+# Shaped miniatures of BENCH_SCALE configs 3/3d/5 on the CPU backend,
+# diffing kernel_launches / evaluated / traffic_units against the
+# committed scripts/bench_smoke_expect.json (walls reported, never
+# compared).  Pass --update to rewrite the expectations after a
+# deliberate dispatch-policy change.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/bench_smoke.py "$@"

@@ -62,6 +62,7 @@ from spark_fsm_tpu.models._common import (
 from spark_fsm_tpu.models.spade_fused import _dense_pair_jnp
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import pallas_support as PS
+from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
 from spark_fsm_tpu.utils import shapes
@@ -89,6 +90,12 @@ def queue_geometry(n_sequences: int, n_items: int, n_words: int, *,
             int(0.45 * device_hbm_budget(dev)), n_shards)
     return {"n_seq": n_seq, "s_block": s_block, "ni_pad": ni_pad,
             "caps": caps,
+            # late-wave geometry (ops/ragged_batch.py): the narrow wave
+            # width the mine switches to once the live frontier drops
+            # below it.  Derived from nb by a pure function, so it adds
+            # no shape-key axis — prewarm compiles both wave programs
+            # under the one key.
+            "nb_late": RB.late_wave_nb(caps.nb, PS.P_TILE),
             "shape_key": shapes.key_queue(n_seq, n_words, ni_pad,
                                           caps.nb, caps.ring)}
 
@@ -284,7 +291,8 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
                    nb: int, ring: int, c_cap: int, m_cap: int, r_cap: int,
                    i_max: int,
                    use_pallas: bool, s_block: int, interpret: bool,
-                   seg: bool = False, donate: bool = False):
+                   seg: bool = False, donate: bool = False,
+                   nb_late: int = 0):
     """Compiled whole-mine program, cached per geometry.  ``minsup`` is a
     traced argument (streaming windows re-mine on one compile).
 
@@ -297,6 +305,19 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
     ``donate`` donates the carry arrays (segments >= 2, whose inputs are
     the previous segment's outputs — the FIRST segment must not donate
     the engine's persistent store).
+
+    ``nb_late`` (one-shot only; 0 or >= nb disables): the LATE-WAVE
+    geometry (ops/ragged_batch.py).  The wave width is static, so a
+    shrinking frontier pays a full [2*nb, ni_pad] pair matrix for a
+    handful of live lanes every late wave; the carry, however, is
+    nb-INDEPENDENT (ring/record shapes only), so the one dispatch runs
+    TWO while_loops back to back — wide waves while the live frontier
+    exceeds ``nb_late``, then narrow ``nb_late`` waves to drain it —
+    merging what were many underfilled full-width waves into well-filled
+    narrow ones at zero extra readbacks.  The segmented path gets the
+    same ladder host-side: the caller constructs a second seg program at
+    ``nb = nb_late`` and switches when the counters show a small
+    frontier (carry shapes match, so programs interchange mid-mine).
 
     Store rows: [0, ni_pad) item id-lists (read-only — child writes index
     >= ni_pad by construction); [ni_pad, ni_pad + ring) the slot ring;
@@ -316,7 +337,12 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
                 ni_pad, s_block=s_block, interpret=interpret)
         return _dense_pair_jnp(pt3, items3)
 
-    def body(carry):
+    def make_body(nbw: int):
+        return lambda carry: _body(carry, nbw)
+
+    def _body(carry, nb):
+        # ``nb`` here is the BODY's wave width (wide or late geometry);
+        # every carry shape below is width-independent
         (store, q_slot, q_smask, q_imask, q_nits, q_rec, head, tail,
          rec_count, records, recsup, overflow, wave, minsup, n_cand) = carry
 
@@ -411,17 +437,51 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
                 new_tail, rec_count + n_emit, records, recsup, overflow,
                 wave + 1, minsup, n_cand)
 
+    body = make_body(nb)
+
     def cond(carry):
         head, tail, overflow, wave = carry[6], carry[7], carry[11], carry[12]
         return (tail > head) & (~overflow) & (wave < i_max)
+
+    # late-wave phase shapes (one-shot only): the narrow loop gets a
+    # proportionally larger wave ceiling — it pops nb/nb_late fewer
+    # nodes per wave, so the same mine legitimately needs that many
+    # more waves before the overflow guard may fire
+    ladder = bool(nb_late) and nb_late < nb and not seg
+    if ladder:
+        i_max_late = i_max * max(1, nb // nb_late)
+        body_late = make_body(nb_late)
+
+        def cond_wide(carry):
+            head, tail = carry[6], carry[7]
+            overflow, wave = carry[11], carry[12]
+            return ((tail - head) > nb_late) & (~overflow) & (wave < i_max)
+
+        def cond_late(carry):
+            head, tail = carry[6], carry[7]
+            overflow, wave = carry[11], carry[12]
+            return (tail > head) & (~overflow) & (wave < i_max_late)
 
     def run(store, q_slot, q_smask, q_imask, q_nits, q_rec, n_roots,
             records, recsup, minsup):
         carry = (store, q_slot, q_smask, q_imask, q_nits, q_rec,
                  jnp.int32(0), n_roots, n_roots, records, recsup,
                  jnp.bool_(False), jnp.int32(0), minsup, jnp.int32(0))
-        out = jax.lax.while_loop(cond, body, carry)
-        # ONE packed array: row 0 is the counter vector, rows 1.. the
+        if ladder:
+            # two sequential while_loops in the ONE compiled program:
+            # wide waves while the live frontier exceeds nb_late (a
+            # frontier of <= nb_late roots skips straight to narrow),
+            # then narrow waves drain the tail.  The frontier may
+            # briefly regrow past nb_late inside the narrow phase —
+            # correct either way, just more (cheap) waves.
+            out = jax.lax.while_loop(cond_wide, body, carry)
+            wide_waves = out[12]
+            out = jax.lax.while_loop(cond_late, body_late, out)
+            late_waves = out[12] - wide_waves
+        else:
+            out = jax.lax.while_loop(cond, body, carry)
+            late_waves = jnp.int32(0)
+        # ONE packed array: rows 0-1 the counter block, rows 2.. the
         # records with supports as a 4th column.  Folding the counters in
         # lets the host prefetch a fixed-size prefix and finish typical
         # mines in a single device->host roundtrip (~100 ms each on a
@@ -432,8 +492,10 @@ def _queue_mine_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
             out[12],                                     # waves
             out[14],                                     # candidates
         ])
+        z = jnp.int32(0)
+        counters2 = jnp.stack([late_waves, z, z, z])  # late-wave row
         return jnp.concatenate(
-            [counters[None, :],
+            [counters[None, :], counters2[None, :],
              jnp.concatenate([out[9], out[10][:, None]], axis=1)], axis=0)
 
     def run_seg(store, q_slot, q_smask, q_imask, q_nits, q_rec, head, tail,
@@ -538,6 +600,7 @@ class QueueSpadeTPU:
         self.n_items = n_items
         caps = g["caps"]
         self.caps = caps
+        self._nb_late = g["nb_late"]
         self.stats = {"patterns": 0, "waves": 0, "fused": "queue",
                       "shape_key": g["shape_key"]}
         shapes.record(g["shape_key"])
@@ -657,17 +720,18 @@ class QueueSpadeTPU:
         fn = _queue_mine_fn(
             self.mesh, self.n_words, ni, self.max_its,
             cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
-            self.use_pallas, self._s_block, self._interpret)
+            self.use_pallas, self._s_block, self._interpret,
+            nb_late=self._nb_late)
         packed_dev = fn(
             self.store, q_slot, q_smask, q_imask, q_nits, q_rec,
             n_roots_dev, records, recsup,
             self._put(np.int32(self.minsup)))
-        # Single-roundtrip fast path: prefetch a fixed prefix (counters
-        # row + the first PREFETCH records, 64 KB) — most mines fit it,
+        # Single-roundtrip fast path: prefetch a fixed prefix (counter
+        # block + the first PREFETCH records, 64 KB) — most mines fit it,
         # so the counter read and the record read share one device->host
         # roundtrip.  Bigger result sets pay one more pow2-bucketed fetch.
         PREFETCH = 4096
-        prefix_dev = packed_dev[:1 + min(PREFETCH, cap.r_cap)]
+        prefix_dev = packed_dev[:2 + min(PREFETCH, cap.r_cap)]
         try:
             prefix_dev.copy_to_host_async()
         except (AttributeError, NotImplementedError):
@@ -677,15 +741,18 @@ class QueueSpadeTPU:
         n_rec = int(counters[0])
         self.stats["waves"] = int(counters[2])
         self.stats["candidates"] = int(counters[3])
+        # narrow-phase waves (row 1 of the counter block): how much of
+        # the drain ran at the late-wave geometry instead of full width
+        self.stats["late_waves"] = int(prefix[1][0])
         self.stats["kernel_launches"] = 1  # the whole mine is one dispatch
         if bool(counters[1]):
             self.stats["fused_overflow"] = True
             return None  # the record buffer is garbage: never transferred
         if n_rec <= PREFETCH:
-            packed = prefix[1:1 + n_rec]
+            packed = prefix[2:2 + n_rec]
         else:
             n_fetch = min(cap.r_cap, next_pow2(n_rec))
-            packed = np.asarray(packed_dev[1:1 + n_fetch])
+            packed = np.asarray(packed_dev[2:2 + n_fetch])
         rec, sup = packed[:, :3], packed[:, 3]
         results, _ = self._decode_records(rec, sup, n_rec)
         self.stats["patterns"] = len(results)
@@ -708,6 +775,7 @@ class QueueSpadeTPU:
                 self.stats["fused_overflow"] = True
                 return None
             ckpt_done = len(results)
+            pending_n = len(nodes)
         else:
             roots = self._roots()
             if not roots:
@@ -717,13 +785,28 @@ class QueueSpadeTPU:
                 return None
             carry = self._root_carry(roots)
             ckpt_done = 0
-        mkw = (self.mesh, self.n_words, ni, self.max_its,
-               cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
-               self.use_pallas, self._s_block, self._interpret, True)
-        fn_first = _queue_mine_fn(*mkw, False)
-        fn_next = _queue_mine_fn(*mkw, True)
+            pending_n = len(roots)
+        nbl = self._nb_late
+        ratio = max(1, cap.nb // max(1, nbl))
+
+        def seg_fn(narrow: bool, first: bool):
+            # the late-wave ladder, host-driven: the narrow program is
+            # the SAME segmented program at nb = nb_late (carry shapes
+            # are width-independent, so programs interchange mid-mine);
+            # its wave ceiling scales by the width ratio, like the
+            # one-shot narrow phase
+            nbw = nbl if narrow else cap.nb
+            return _queue_mine_fn(
+                self.mesh, self.n_words, ni, self.max_its,
+                nbw, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap,
+                cap.i_max * (ratio if narrow else 1),
+                self.use_pallas, self._s_block, self._interpret, True,
+                not first)
+
+        narrow = nbl < cap.nb and pending_n <= nbl
         last_ckpt = time.monotonic()
         first = True
+        last_waves = 0
         # geometric wave-budget growth: fine-grained early boundaries (a
         # checkpoint=1 job writes its first snapshot after wave 1, even
         # for mines that finish inside one interval), coarse later so a
@@ -731,7 +814,7 @@ class QueueSpadeTPU:
         # per wave.  One compiled program serves every budget (traced).
         budget = 1 if checkpoint_cb is not None else seg_waves
         while True:
-            carry, counters_dev = (fn_first if first else fn_next)(
+            carry, counters_dev = seg_fn(narrow, first)(
                 *carry, self._put(np.int32(budget)))
             budget = min(seg_waves, budget * 4)
             first = False
@@ -740,12 +823,21 @@ class QueueSpadeTPU:
             counters = np.asarray(counters_dev)
             n_rec, oflow, waves, n_cand, pending, head, tail = (
                 int(x) for x in counters)
-            if oflow or (pending and waves >= cap.i_max):
+            if narrow:
+                self.stats["late_waves"] = (
+                    self.stats.get("late_waves", 0) + waves - last_waves)
+            last_waves = waves
+            wave_ceil = cap.i_max * (ratio if narrow else 1)
+            if oflow or (pending and waves >= wave_ceil):
                 self.stats["fused_overflow"] = True
                 self.stats["waves"] = waves
                 return None  # classic fallback resumes from the last save
             if not pending:
                 break
+            if not narrow and nbl < cap.nb and (tail - head) <= nbl:
+                narrow = True  # frontier drained below the late-wave
+                # geometry: switch programs for the remaining segments
+                # (never switched back — a late regrow just costs waves)
             if (checkpoint_cb is not None
                     and time.monotonic() - last_ckpt >= every_s):
                 checkpoint_cb(

@@ -22,6 +22,17 @@ rules may still left-expand but not vice versa — the standard duplicate-free
 expansion scheme), batch-evaluating candidates on device.  Large alphabets
 are handled by iterative deepening over the top-M items by support: a run
 restricted to M items is provably complete once sup(item_{M+1}) < s_k.
+
+Two traffic levers on top of the search (this file + ops/ragged_batch.py):
+DYNAMIC-THRESHOLD PRUNING — right-expansion candidates carry their exact
+antecedent support (X is fixed along a right chain), so a support bound
+below the confidence floor proves the rule can never enter the top-k;
+when the antecedent can also never grow again, the whole right-growing
+subtree is provably dead and is never materialized on device (sibling
+chains end wholesale) — and RAGGED SUPER-BATCHING — per-km launch pools
+split into full pow2 launches at their own km, with the per-km tails
+merged into shared mixed-km launches, collapsing the one-launch-per-
+bucket dispatch pattern of unlimited-side mines (BENCH_SCALE 3 vs 3d).
 """
 
 from __future__ import annotations
@@ -47,6 +58,7 @@ from spark_fsm_tpu.models._common import (
 from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.ops import pallas_tsr as PT
+from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
 from spark_fsm_tpu.utils import shapes
@@ -83,10 +95,14 @@ def conf_ok(sup: int, supx: int, minconf: float) -> bool:
 
 _auto_eval_budget = device_hbm_budget  # shared with the SPADE engines
 
-# per-km-bucket stat keys (fill/borrow decomposition, BENCH_SCALE 3 vs
-# 3d); dispatch handles carry their deltas so fault recounts are exact
+# per-dispatch stat keys (fill/borrow/traffic decomposition, BENCH_SCALE
+# 3 vs 3d); dispatch handles carry their deltas so fault recounts are
+# exact.  launches_km/width_km/borrowed_km are keyed by launch GEOMETRY
+# km; evaluated_km by each candidate's OWN km bucket; traffic_units is
+# the kernel-streamed sum of width x geometry-km; superbatches counts
+# mixed-km launches (ops/ragged_batch.py).
 _KM_STAT_PREFIXES = ("evaluated_km", "launches_km", "width_km",
-                     "borrowed_km")
+                     "borrowed_km", "traffic_units", "superbatches")
 
 
 @functools.lru_cache(maxsize=64)
@@ -299,7 +315,20 @@ class TsrTPU:
         self._put = functools.partial(MH.host_to_device, mesh)
         self.item_cap = int(item_cap)
         self.max_side = max_side
-        self.stats = {"evaluated": 0, "kernel_launches": 0, "deepening_rounds": 0}
+        self.stats = {"evaluated": 0, "kernel_launches": 0,
+                      "deepening_rounds": 0, "pruned_conf": 0,
+                      "traffic_units": 0}
+        # per-geometry xy staging with donated-buffer lifetime
+        # (ops/ragged_batch.py): candidate packing reuses free-listed
+        # buffers and overlaps the in-flight device work of earlier
+        # launches; each dispatch's buffers recycle at its readback
+        self._stager = RB.XYStager()
+        self._xy_bufs: List[np.ndarray] = []
+        # budget-derived jnp launch width BEFORE the dispatch-efficiency
+        # clamp (set by _round_chunk_jnp; the per-km memory caps divide
+        # THIS, so a small-S mine is not narrowed by a rule that only
+        # binds at full scale)
+        self._jnp_raw = 8192
 
         # NEVER materialize vdb.bitmaps here: with a Kosarak-shaped alphabet
         # (~41k items x ~990k sequences) the full dense store is ~160 GB.
@@ -474,7 +503,10 @@ class TsrTPU:
         if self._chunk_user is not None:
             return self._chunk_user
         if self.use_pallas:
-            return 8192
+            # dispatch-efficiency quantum: 8192 lanes at the full
+            # Kosarak axis (measured best), more lanes as the axis
+            # shrinks — same device time per launch either way
+            return RB.dispatch_quantum_lanes(self.n_seq, self.n_words)
         return self._round_chunk_jnp(m)
 
     def _round_chunk_jnp(self, m: int, resident_preps: int = 1) -> int:
@@ -495,31 +527,45 @@ class TsrTPU:
         per_cand = max(1, s_local * self.n_words * 4 * 4)
         prep = resident_preps * 2 * m * s_local * self.n_words * 4
         budget = max(per_cand, self._eval_budget - prep)
-        return max(128, min(8192, next_pow2(budget // per_cand + 1) // 2))
+        # the raw budget width is what the per-km memory caps divide
+        # (1/km live-temp growth, measured OOM boundary); the clamp
+        # below is dispatch efficiency, not memory — applying the km
+        # narrowing AFTER it would over-throttle small-S mines whose
+        # budget allows far more than 8192 lanes at any km.  The
+        # efficiency ceiling itself is the lane-time quantum (8192 at
+        # the full Kosarak axis, wider as S shrinks).
+        self._jnp_raw = max(128, next_pow2(budget // per_cand + 1) // 2)
+        return min(RB.dispatch_quantum_lanes(self.n_seq, self.n_words),
+                   self._jnp_raw)
 
     def _dispatch_eval(self, p1, s1,
                        cands: List[Tuple[Tuple[int, ...], Tuple[int, ...]]]):
         """Launch (sup, supx) evaluation for candidate rules (local item
         idx); returns a device handle with the host copy already in
         flight.  ``_resolve_eval`` blocks on it — the split lets the mine
-        loop pipeline the next dispatch behind the current readback."""
+        loop pipeline the next dispatch behind the current readback.
+
+        Launch planning is the ragged super-batch packer
+        (ops/ragged_batch.py): per-km pools split greedily into FULL
+        pow2 launches at their own km (a candidate never pays a wider
+        geometry's traffic when its pool fills launches alone), then the
+        per-km TAILS merge into shared mixed-km launches at the largest
+        participating km — what used to be one underfilled launch per
+        (km bucket x dispatch) collapses into one shared launch when the
+        packer's cost model says the pad traffic is cheaper than the
+        extra dispatches (BENCH_SCALE 3d: 371 launches -> the ~41-launch
+        profile of config 3).  The per-geometry width caps keep the old
+        memory reasoning: the jnp evaluator's live-temp footprint grows
+        with km, so its cap NARROWS 1/km (measured OOM boundary — km=4
+        at the km=1 width allocated 27.2G on a 15G chip); the kernel
+        path streams seq blocks through VMEM and stays flat at the
+        engine chunk.  A caller-pinned chunk is honored as the cap.
+        """
         n = len(cands)
         launches0 = self.stats["kernel_launches"]  # handle carries its own
         # launch count so a readback-fault recount can discard them (below)
         km_stats0 = {sk: v for sk, v in self.stats.items()
                      if sk.startswith(_KM_STAT_PREFIXES)}
-        # Candidates dispatch per side-size bucket (pow2 km), NOT at one
-        # batch-wide kmax: the km kernel's live-temp footprint grows with
-        # km, so the adaptive width must NARROW as km grows — and
-        # narrowing the WHOLE mixed batch for one large-side candidate
-        # would multiply the dispatch latency of the small-side majority.
-        # Bucketing keeps each candidate at its own bucket's widest safe
-        # launch.  The 1/km scale factor is empirical (v5e, 15G budget,
-        # Kosarak-shaped S): km=4 at the km=1 width allocated 27.2G and
-        # OOMed; km=2 at that width fits (~12.4G, right at the ceiling,
-        # with XLA remat fusions in the dump) but measured no faster than
-        # half width, so the headroom is kept.  A caller-pinned chunk is
-        # honored as-is.
         kms = np.empty(n, np.int32)
         for r, (x, y) in enumerate(cands):
             side = max(len(x), len(y))
@@ -527,66 +573,59 @@ class TsrTPU:
             while km < side:
                 km *= 2
             kms[r] = km
-        # per-bucket accounting (evaluated + padded launch widths land in
-        # stats below): the service-default unlimited-side path spreads
-        # every dispatch over several km buckets, and these counters are
-        # what lets BENCH_SCALE's 3-vs-3d gap be decomposed into candidate
-        # mix (irreducible) vs launch underfill (fixable)
+        # per-bucket accounting (evaluated by OWN km; launch widths land
+        # in stats per GEOMETRY km below): these counters are what lets
+        # BENCH_SCALE's 3-vs-3d gap be decomposed into candidate mix
+        # (irreducible) vs launch packing (the packer's job)
         for km_v, cnt in zip(*np.unique(kms, return_counts=True)):
             key = f"evaluated_km{int(km_v)}"
             self.stats[key] = self.stats.get(key, 0) + int(cnt)
-        # candidate pools per km bucket; the kernel pass drains them
-        # LARGEST km first so each bucket's tail-launch pad lanes can be
-        # filled ("borrowed") from the still-unprocessed smaller pools
-        remaining: Dict[int, List[int]] = {}
+        pools: Dict[int, List[int]] = {}
         for r in range(n):
-            remaining.setdefault(int(kms[r]), []).append(r)
+            pools.setdefault(int(kms[r]), []).append(r)
         parts = []
         cols = np.empty(n, np.int64)  # candidate r -> column in `out`
-        used_kernel = False  # any bucket through the Pallas path: a
+        used_kernel = False  # any launch through the Pallas path: a
         base = 0             # readback fault is then recountable
+        xy_bufs: List[np.ndarray] = []  # staging buffers donated to this
+        # dispatch; recycled at readback (ops/ragged_batch.XYStager)
+        self._xy_bufs = xy_bufs
+        leftover: Dict[int, List[int]] = {}
         if self.use_pallas:
-            for km in sorted(remaining, reverse=True):
-                if km in self._pallas_bad or not remaining[km]:
+            leftover = {km: rows for km, rows in pools.items()
+                        if km in self._pallas_bad}
+            kern = {km: rows for km, rows in pools.items()
+                    if km not in self._pallas_bad}
+            plan = RB.plan_launches(
+                kern, cap=lambda km: self.chunk, lane=PT.C_LANES,
+                overhead=RB.overhead_units(self.n_seq, self.n_words))
+            for L in plan:
+                if L.km in self._pallas_bad:
+                    # a geometry that failed earlier in THIS plan: its
+                    # remaining launches re-pool by each lane's own km
+                    for r, k in zip(L.rows, L.kms):
+                        leftover.setdefault(k, []).append(r)
                     continue
-                mark = len(parts)
-                launches_mark = self.stats["kernel_launches"]
-                km_keys = (f"launches_km{km}", f"width_km{km}",
-                           f"borrowed_km{km}")
-                km_marks = {kk: self.stats.get(kk) for kk in km_keys}
-                undo: List[Tuple[int, int]] = []
                 try:
-                    base = self._dispatch_kernel_bucket(
-                        p1, s1, cands, remaining, km, parts, cols, base,
-                        undo)
+                    base = self._dispatch_kernel_launch(
+                        p1, s1, cands, L, parts, cols, base)
                     used_kernel = True
-                    remaining[km] = []
                 except Exception as exc:  # pragma: no cover - device-specific
-                    # compile/lowering failures surface at the bucket's
-                    # first launch; mark only THIS km bucket bad (other
-                    # buckets keep the kernel) and evaluate it via the
-                    # jnp path, whose prep/width differ from the kernel's.
-                    # The bucket's own candidates are still in its pool;
-                    # borrowed ones return to theirs.
-                    del parts[mark:]
-                    base = sum(p.shape[1] for p in parts)
-                    # discarded launches must not stay in the exported
-                    # per-job stats — neither the global launch count nor
-                    # the per-km fill counters the 3-vs-3d decomposition
-                    # reads (the jnp re-evaluation recounts)
-                    self.stats["kernel_launches"] = launches_mark
-                    for kk, v in km_marks.items():
-                        if v is None:
-                            self.stats.pop(kk, None)
-                        else:
-                            self.stats[kk] = v
-                    for skm, r in undo:
-                        remaining[skm].append(r)
-                    self._pallas_bad.add(km)
-                    self.stats[f"pallas_fallback_km{km}"] = repr(exc)
-        leftover = sorted(km for km, idxs in remaining.items() if idxs)
-        if leftover and self.use_pallas:
-            # jnp buckets while the kernel path is live: both prep pairs
+                    # compile/lowering failures surface at the geometry's
+                    # first launch; mark only THIS km geometry bad (other
+                    # geometries keep the kernel).  Stats are recorded
+                    # only after a successful dispatch, so a failed
+                    # launch leaves nothing to roll back — its lanes
+                    # (own-km and merged alike) re-pool for the jnp path.
+                    self._pallas_bad.add(L.km)
+                    self.stats[f"pallas_fallback_km{L.km}"] = repr(exc)
+                    for r, k in zip(L.rows, L.kms):
+                        leftover.setdefault(k, []).append(r)
+        else:
+            leftover = pools
+        has_leftover = any(leftover.values())
+        if has_leftover and self.use_pallas:
+            # jnp launches while the kernel path is live: both prep pairs
             # stay resident (see _ensure_jnp_downgrade).  The
             # prep-rebuild launch is REAL retained work — exclude it
             # from this handle's discardable launch delta so a later
@@ -594,24 +633,25 @@ class TsrTPU:
             before = self.stats["kernel_launches"]
             self._ensure_jnp_downgrade()
             launches0 += self.stats["kernel_launches"] - before
-        for km in leftover:
+        if has_leftover:
             pj, sj = self._jnp_prep if self._jnp_prep is not None else (p1, s1)
-            fn = self._eval_fn(km)
             cw = self.chunk if not self.use_pallas else self._jnp_chunk
-            c = cw if self._chunk_user else max(32, cw // km)
-            idxs = remaining[km]
-            for lo in range(0, len(idxs), c):
-                hi = min(lo + c, len(idxs))
-                xy = np.full((c, 2, km), -1, np.int32)
-                for j, r in enumerate(idxs[lo:hi]):
-                    x, y = cands[r]
-                    xy[j, 0, :len(x)] = x
-                    xy[j, 1, :len(y)] = y
-                cols[idxs[lo:hi]] = base + np.arange(hi - lo)
-                base += c
+            # per-km memory cap: the jnp evaluator's live temps grow
+            # with km, so the BUDGET-derived width narrows 1/km; the
+            # dispatch-efficiency ceiling cw applies after (a pinned
+            # chunk overrides both — honored as-is)
+            cap = ((lambda km: cw) if self._chunk_user
+                   else (lambda km: max(32, min(cw, self._jnp_raw // km))))
+            for L in RB.plan_launches(
+                    leftover, cap=cap, lane=32,
+                    overhead=RB.overhead_units(self.n_seq, self.n_words)):
+                fn = self._eval_fn(L.km)
+                xy = self._stager.take(L, cands)
+                xy_bufs.append(xy)
+                cols[L.rows] = base + np.arange(len(L.rows))
+                base += L.width
                 parts.append(fn(pj, sj, self._put(xy)))
-                self.stats["kernel_launches"] += 1
-            remaining[km] = []
+                self._count_launch(L)
         self.stats["evaluated"] += n
         out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         try:
@@ -629,7 +669,8 @@ class TsrTPU:
                     if sk.startswith(_KM_STAT_PREFIXES)
                     and self.stats[sk] != km_stats0.get(sk, 0)}
         return (out, cols, used_kernel,
-                self.stats["kernel_launches"] - launches0, km_delta)
+                self.stats["kernel_launches"] - launches0, km_delta,
+                xy_bufs)
 
     def _ensure_jnp_downgrade(self) -> None:
         """Build the engine-layout prep + budget width the jnp evaluator
@@ -655,75 +696,59 @@ class TsrTPU:
             sb //= 2
         return sb
 
-    def _dispatch_kernel_bucket(self, p1k, s1k, cands, remaining, km,
-                                parts, cols, base, undo):
-        """Pallas-path dispatch for one km bucket: full launch width (the
+    def _dispatch_kernel_launch(self, p1k, s1k, cands, L, parts, cols,
+                                base):
+        """Pallas-path dispatch of one planned super-batch launch (the
         kernel streams seq blocks through VMEM — no [chunk, S, W] gather
-        temps to narrow for), candidate count padded to the out-block lane
-        width.  Appends to parts/cols and returns the advanced base.
-
-        Pad BORROWING closes the launch-underfill gap (BENCH_SCALE 3d
-        per_km: 61-78% fill at km>=2): a pad lane streams exactly the
-        same seq blocks as a real lane, so tail-launch pads are filled
-        with candidates from the smaller-km pools (largest km first —
-        each filled lane saves that candidate's lane at its own km for
-        free; a side of length <= skm < km trivially fits the km-wide
-        layout).  ``undo`` records (km, candidate) borrows so a
-        bucket-level compile failure restores the pools."""
-        fn = _kernel_eval_fn(self.mesh, km, self._bucket_seq_block(km),
+        temps to narrow for, so widths run at the engine chunk).  A lane
+        whose own km is below the launch geometry rides with -1 unused
+        slots pointed at the all-ones pad row — the packer's tail merge
+        generalizes the old per-bucket pad borrowing.  Appends to
+        parts/cols and returns the advanced base; stats land only after
+        the dispatch succeeds (a compile failure leaves nothing to roll
+        back)."""
+        fn = _kernel_eval_fn(self.mesh, L.km, self._bucket_seq_block(L.km),
                              self._interpret, self.n_words == 1)
-        c = self.chunk
-        mine = remaining[km]
-        lo = 0
-        while lo < len(mine):
-            rem = len(mine) - lo
-            # Greedy pow2 split instead of one over-padded launch: the
-            # kernel's wall is ~linear in the PADDED width (every lane
-            # streams its km seq blocks).  Take the largest pow2 <=
-            # remaining (capped at chunk) while >= 1024 — 100% fill —
-            # then one padded tail launch.  Widths stay the same pow2
-            # set, so no new kernel compiles.
-            if rem >= 1024:
-                take = min(c, 1 << (rem.bit_length() - 1))
-            else:
-                take = rem
-            rows = list(mine[lo:lo + take])
-            width = max(PT.C_LANES, next_pow2(take))
-            pad = width - len(rows)
-            if pad:
-                for skm in sorted((k for k in remaining if k < km),
-                                  reverse=True):
-                    pool = remaining[skm]
-                    while pad > 0 and pool:
-                        r = pool.pop()
-                        undo.append((skm, r))
-                        rows.append(r)
-                        pad -= 1
-                    if pad == 0:
-                        break
-            xy = np.full((width, 2, km), -1, np.int32)
-            for j, r in enumerate(rows):
-                x, y = cands[r]
-                xy[j, 0, :len(x)] = x
-                xy[j, 1, :len(y)] = y
-            part = fn(p1k, s1k, self._put(xy))
-            self.stats["kernel_launches"] += 1
-            lk = f"launches_km{km}"
-            wk = f"width_km{km}"
-            self.stats[lk] = self.stats.get(lk, 0) + 1
-            self.stats[wk] = self.stats.get(wk, 0) + width
-            if len(rows) > take:
-                bk = f"borrowed_km{km}"
-                self.stats[bk] = self.stats.get(bk, 0) + len(rows) - take
-            cols[rows] = base + np.arange(len(rows))
-            base += width
-            parts.append(part)
-            lo += take
-        return base
+        xy = self._stager.take(L, cands)
+        part = fn(p1k, s1k, self._put(xy))
+        self._xy_bufs.append(xy)
+        self._count_launch(L)
+        cols[L.rows] = base + np.arange(len(L.rows))
+        parts.append(part)
+        return base + L.width
+
+    def _count_launch(self, L) -> None:
+        """Per-launch accounting shared by the kernel and jnp dispatch
+        paths: geometry-keyed fill counters (the 3-vs-3d decomposition),
+        kernel-streamed traffic units, super-batch/borrow counts, and
+        the compiled-geometry registry record (utils/shapes.py) that
+        keeps the launch ladder enumerable by prewarm."""
+        self.stats["kernel_launches"] += 1
+        lk, wk = f"launches_km{L.km}", f"width_km{L.km}"
+        self.stats[lk] = self.stats.get(lk, 0) + 1
+        self.stats[wk] = self.stats.get(wk, 0) + L.width
+        self.stats["traffic_units"] = (
+            self.stats.get("traffic_units", 0) + L.traffic_units)
+        borrowed = L.borrowed
+        if borrowed:
+            bk = f"borrowed_km{L.km}"
+            self.stats[bk] = self.stats.get(bk, 0) + borrowed
+        if L.mixed:
+            self.stats["superbatches"] = (
+                self.stats.get("superbatches", 0) + 1)
+        if self._RECORD_SHAPES:
+            shapes.record(shapes.key_tsr_eval(
+                self.n_seq, self.n_words, L.km, L.width))
 
     def _resolve_eval(self, handle, n: int):
         out, cols = handle[0], handle[1]
         arr = np.asarray(out)
+        # the blocking readback proves the compute consumed its staged
+        # inputs: recycle the dispatch's xy buffers (a FAULTED handle
+        # never reaches this line, so its buffers are never reused while
+        # the device might still reference them)
+        if len(handle) > 5:
+            self._stager.release(handle[5])
         return arr[0, cols].astype(np.int64), arr[1, cols].astype(np.int64)
 
     # --------------------------------------------------------- checkpoints
@@ -737,7 +762,9 @@ class TsrTPU:
         ids = self.vdb.item_ids
         return {
             "algo": "tsr",
-            "stack_format": 2,  # 2 = lazy sibling-chain entries
+            "stack_format": 3,  # 3 = sibling-chain entries + psupx
+            # (antecedent support for right chains — the conf-bound
+            # pruning input); format-2 snapshots restart fresh
             "k": self.k,
             "minconf": float(self.minconf),
             "max_side": self.max_side,
@@ -763,8 +790,8 @@ class TsrTPU:
             "m": int(m),
             "minsup": int(minsup),
             "stack": [[int(-nb), [int(i) for i in x], [int(j) for j in y],
-                       bool(cr), int(side), int(psup)]
-                      for nb, x, y, cr, side, psup in queue
+                       bool(cr), int(side), int(psup), int(psupx)]
+                      for nb, x, y, cr, side, psup, psupx in queue
                       if -nb >= minsup],
             "results_done": 0,
             "results": [[[int(i) for i in x], [int(j) for j in y],
@@ -788,17 +815,21 @@ class TsrTPU:
         results: List[Tuple[int, int, Tuple[int, ...], Tuple[int, ...]]] = []
         minsup = 1
         sup_sorted: List[int] = []  # ascending supports of accepted rules
+        # conf test as exact integer cross-multiply (no per-rule Fraction
+        # construction): sup/supx >= num/den — shared by acceptance AND
+        # the conf-bound pruning below
+        num, den = _conf_frac(self.minconf)
 
         def s_k_threshold() -> int:
             if len(sup_sorted) < self.k:
                 return 1
             return sup_sorted[-self.k]
 
-        # queue: (-bound, X, Y, can_right, side, psup); X/Y are local index
-        # tuples.  No tie-break counter: entries are totally ordered by the
-        # tuples themselves, and the FINAL rule set is pop-order
-        # independent (the end-of-round s_k filter is exact), so tie order
-        # is free to vary.
+        # queue: (-bound, X, Y, can_right, side, psup, psupx); X/Y are
+        # local index tuples.  No tie-break counter: entries are totally
+        # ordered by the tuples themselves, and the FINAL rule set is
+        # pop-order independent (the end-of-round s_k filter is exact),
+        # so tie order is free to vary.
         #
         # Expansion is LAZY ("sibling chains"): a popped entry re-pushes
         # only its next sibling — the same-parent candidate whose variable
@@ -811,6 +842,20 @@ class TsrTPU:
         # minsup kills the whole remaining chain.  Eager expansion pushed
         # (and later bound-pruned) the full O(jcut) range per accepted
         # candidate — the dominant host cost of large mines.
+        #
+        # ``psupx`` is the EXACT antecedent support sup(X) for side-1
+        # (grow-Y) entries — X is fixed along a right chain, so the
+        # parent's evaluated supx stays valid for every sibling — and 0
+        # (unknown) for side-0 entries, whose X varies.  It feeds the
+        # DYNAMIC-THRESHOLD pruning (pop_batch/chain_push): a
+        # right-expansion candidate with bound*den < supx*num can never
+        # pass the confidence floor (sup <= bound), and when its X can
+        # never grow again the whole right-growing subtree shares that
+        # fate — those candidates are never materialized on device.
+        # Conf-dead candidates whose X CAN still grow are evaluated
+        # normally: their exact sup keeps child bounds tight, so the
+        # pruned search explores a subset of the unpruned one, never a
+        # superset.
         sup_l = sup_it.tolist()  # python ints: no np-scalar overhead below
 
         # sup_it is sorted descending, so "items with sup >= minsup" is the
@@ -823,12 +868,16 @@ class TsrTPU:
         queue: list = []
         push = heapq.heappush
 
-        def chain_push(xf, yf, cr, side, psup, start):
+        def chain_push(xf, yf, cr, side, psup, psupx, start):
             """Push the chain entry whose variable item is the first
             admissible index >= start (xf/yf are the FIXED side contents,
             the variable item excluded).  Admissible = not already used in
             the rule and bound >= minsup; bounds are nonincreasing along
-            the chain, so a failing bound ends it for good."""
+            the chain, so a failing bound ends it for good.  When the
+            antecedent can never grow again (max_side reached), a side-1
+            chain whose bound drops below the confidence floor is dead
+            IN FULL — supx is frozen, sup only shrinks along both the
+            chain and every right descendant — so it ends here too."""
             fixed = set(xf) | set(yf)
             c = start
             while True:
@@ -839,12 +888,18 @@ class TsrTPU:
                     b = s_c if s_c < psup else psup
                     if b < minsup:
                         return
+                    if (side == 1 and psupx > 0 and b * den < psupx * num
+                            and self.max_side is not None
+                            and len(xf) >= self.max_side):
+                        self.stats["pruned_conf_chains"] = (
+                            self.stats.get("pruned_conf_chains", 0) + 1)
+                        return
                     break
                 c += 1
             if side == 0:
-                push(queue, (-b, xf + (c,), yf, cr, 0, psup))
+                push(queue, (-b, xf + (c,), yf, cr, 0, psup, 0))
             else:
-                push(queue, (-b, xf, yf + (c,), cr, 1, psup))
+                push(queue, (-b, xf, yf + (c,), cr, 1, psup, psupx))
 
         if resume is not None:
             minsup = int(resume["minsup"])
@@ -853,21 +908,41 @@ class TsrTPU:
             sup_sorted = sorted(r[0] for r in results)
             jcut = item_cut()
             queue = [(-int(b), tuple(x), tuple(y), bool(cr), int(side),
-                      int(psup))
-                     for b, x, y, cr, side, psup in resume["stack"]]
+                      int(psup), int(psupx))
+                     for b, x, y, cr, side, psup, psupx in resume["stack"]]
             heapq.heapify(queue)
             self.stats["resumed_nodes"] = len(queue)
         else:
             # roots: one right-side chain per item i over partners j != i
             # (bound min(sup_i, sup_j) is nonincreasing in j) — m entries
-            # instead of the m^2 of eager enumeration
+            # instead of the m^2 of eager enumeration.  X = {i} is fixed,
+            # so psupx = sup(i) exactly.
             for i in range(m):
-                chain_push((i,), (), True, 1, sup_l[i], 0)
+                chain_push((i,), (), True, 1, sup_l[i], sup_l[i], 0)
+
+        def left_viable(x, y):
+            """Can the antecedent still grow into an above-threshold
+            candidate?  Left expansion adds an admissible index >
+            max(X): below jcut every item clears minsup, and the child
+            bound min(b, sup_c') then clears it too (both terms do), so
+            viability is just 'an unused index remains'.  When this is
+            False it is False for EVERY right descendant as well — the
+            fixed set only grows and jcut only shrinks — which is what
+            makes whole-subtree conf pruning sound."""
+            if self.max_side is not None and len(x) >= self.max_side:
+                return False
+            fixed = set(x) | set(y)
+            c = max(x) + 1
+            while c < jcut:
+                if c not in fixed:
+                    return True
+                c += 1
+            return False
 
         def pop_batch():
             batch = []
             while queue and len(batch) < self.chunk:
-                nb, x, y, cr, side, psup = queue[0]
+                nb, x, y, cr, side, psup, psupx = queue[0]
                 if -nb < minsup:
                     # every remaining entry is bound-pruned, and chain
                     # siblings bound even lower (minsup only rises;
@@ -878,9 +953,26 @@ class TsrTPU:
                 heapq.heappop(queue)
                 # advance this entry's sibling chain before evaluating it
                 if side == 0:
-                    chain_push(x[:-1], y, cr, 0, psup, x[-1] + 1)
+                    chain_push(x[:-1], y, cr, 0, psup, 0, x[-1] + 1)
                 else:
-                    chain_push(x, y[:-1], cr, 1, psup, y[-1] + 1)
+                    chain_push(x, y[:-1], cr, 1, psup, psupx, y[-1] + 1)
+                # dynamic-threshold pruning: side-1 entries carry the
+                # EXACT antecedent support, so sup <= bound < minconf *
+                # supx proves this rule can never be accepted.  If the
+                # antecedent can also never grow again, every right
+                # descendant shares both properties (supx frozen, sup
+                # only shrinks, left growth stays impossible) — the
+                # WHOLE subtree is dead and the candidate is never
+                # materialized on device.  A conf-dead candidate whose X
+                # can still grow is evaluated normally instead: its
+                # exact sup keeps child bounds tight (expanding from
+                # the bound measured 3x the evaluations — looser bounds
+                # compound along right chains).
+                if (side == 1 and psupx > 0
+                        and (-nb) * den < psupx * num
+                        and not left_viable(x, y)):
+                    self.stats["pruned_conf"] += 1
+                    continue
                 batch.append((x, y, cr))
             return batch
 
@@ -920,9 +1012,6 @@ class TsrTPU:
                 handle = self._dispatch_eval(
                     p1, s1, [(x, y) for x, y, _ in batch])
                 sups, supxs = self._resolve_eval(handle, len(batch))
-            # conf test as exact integer cross-multiply (no per-rule
-            # Fraction construction): sup/supx >= num/den
-            num, den = _conf_frac(self.minconf)
             for (x, y, can_right), sup, supx in zip(
                     batch, sups.tolist(), supxs.tolist()):
                 if sup < minsup:
@@ -938,11 +1027,13 @@ class TsrTPU:
                         jcut = item_cut()
                 # expansions: start one left chain (grow X; kills further
                 # right expansion) and one right chain (grow Y) — their
-                # siblings materialize lazily as the chains are popped
+                # siblings materialize lazily as the chains are popped.
+                # The right chain inherits this rule's exact supx (X is
+                # unchanged along it) — the conf-bound pruning input.
                 if self.max_side is None or len(x) < self.max_side:
-                    chain_push(x, y, False, 0, sup, max(x) + 1)
+                    chain_push(x, y, False, 0, sup, 0, max(x) + 1)
                 if can_right and (self.max_side is None or len(y) < self.max_side):
-                    chain_push(x, y, True, 1, sup, max(y) + 1)
+                    chain_push(x, y, True, 1, sup, supx, max(y) + 1)
 
         # Pipeline: keep PIPELINE_DEPTH batches in flight so the blocking
         # readback of batch i overlaps the device work of batch i+1 and the

@@ -107,6 +107,52 @@ def effective_tiles(P: int, n_item_rows: int, W: int,
     return p_tile, i_tile
 
 
+def grid_model(P: int, n_item_rows: int, W: int, S: int, *,
+               s_block: Optional[int] = None,
+               p_tile: Optional[int] = None,
+               i_tile: Optional[int] = None,
+               items_rows: Optional[int] = None) -> dict:
+    """Grid/dispatch-overhead counters for ONE ``pair_supports`` launch —
+    the single definition shared by the KERNELS.json remeasure harness
+    (bench_kernels.py) and anything attributing kernel wall to grid
+    overhead, so the modeled program can never drift from the measured
+    one (tiles resolve through the SAME ``effective_tiles`` the kernel
+    uses, including the ``SPARKFSM_PAIR_P_TILE`` re-measure guard).
+
+    Returns the resolved tiles, the grid-step count (each step pays a
+    fixed Mosaic prologue + block-DMA turnaround — the measurable
+    dispatch-overhead term of the roofline decomposition), the BlockSpec
+    HBM traffic model, the minimum-useful bytes, and the VPU op count
+    (the compute-roofline term)."""
+    sb = s_block if s_block else seq_block(W)
+    ni128 = -(-n_item_rows // 128) * 128
+    if items_rows is None:
+        items_rows = ni128
+    if p_tile is None or i_tile is None:
+        ap, ai = effective_tiles(P, n_item_rows, W, items_rows)
+        p_tile = ap if p_tile is None else p_tile
+        i_tile = ai if i_tile is None else i_tile
+    ni = -(-n_item_rows // i_tile) * i_tile
+    steps = (P // p_tile) * (ni // i_tile) * (S // sb)
+    # a parent block re-reads once per item tile, an item block once per
+    # parent tile; out written once
+    model_bytes = P * ni * S * W * 4 * (1 / i_tile + 1 / p_tile) + 4 * P * ni
+    return {
+        "p_tile": int(p_tile), "i_tile": int(i_tile), "s_block": int(sb),
+        "grid_steps": int(steps),
+        "model_bytes": int(model_bytes),
+        "min_useful_bytes": int((P + ni) * S * W * 4 + 4 * P * ni),
+        "vpu_ops": int(PAIR_VPU_OPS_PER_WORD * P * ni * S * W),
+    }
+
+
+# pair kernel inner loop, per uint32 word element: AND, nonzero compare,
+# int32 cast, lane accumulate — the minimum op sequence the semantics
+# need on a VPU with no fused popcount-accumulate over masks.  (Shared
+# with bench_kernels' compute-roofline model via grid_model above.)
+PAIR_VPU_OPS_PER_WORD = 4
+
+
 def _make_pair_kernel_1w(p_tile: int):
     """Single-word fast path: 2-D blocks.  Kept separate from the general
     kernel because the degenerate [*, 1, S] block shape compiles ~15x

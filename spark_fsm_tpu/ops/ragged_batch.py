@@ -1,0 +1,325 @@
+"""Ragged candidate super-batching: pow2 launch geometries, shared compiles.
+
+The deep TSR path and the late phase of queue mines both produce RAGGED
+work: candidate sets whose per-item width (the km side-size bucket, or
+the live queue frontier) varies freely while every compiled program has
+a static shape.  Before this layer each ragged set dispatched one launch
+per (km bucket x dispatch), so the service-default unlimited-side TSR
+mine paid 371 kernel launches where the max_side=2 mine paid 41
+(BENCH_SCALE 3 vs 3d) — per-launch dispatch latency and per-launch
+underfill, not kernel throughput, were the bill.
+
+This module is the ONE packing policy for that work:
+
+- **pow2 super-batch geometries**: every launch runs at a (km, width)
+  drawn from a finite pow2 ladder (:func:`superbatch_geometries`), so
+  the compiled-program set stays log-sized and enumerable — the prewarm
+  driver (service/prewarm.py) walks the same ladder, which is how the
+  PR-1 zero-fresh-compile guarantee survives super-batching.
+- **mixed-km packing with per-lane km tags** (:func:`plan_launches`):
+  per-km pools first split greedily into FULL pow2 launches at their own
+  km (100% fill, the measured-best policy), then the per-km TAILS merge
+  into shared super-batches at the largest participating km.  A lane of
+  side <= skm < km fits the km-wide xy layout trivially (unused slots
+  are -1 -> the all-ones pad row), so merging is always CORRECT; the
+  cost model below decides when it is also CHEAPER.
+- **a cost model, not a heuristic flag**: kernel wall is ~linear in
+  width x km (every padded lane streams its km prefix+suffix blocks),
+  and every launch pays a fixed dispatch cost.  A merge is taken iff
+  ``merged_width x km_geom <= separate_widths x kms + overhead`` with
+  the dispatch overhead expressed in the same traffic units
+  (:data:`LAUNCH_OVERHEAD_UNITS`) — so a 900-candidate km1 tail is
+  NEVER dragged into a km8 geometry (8x its traffic), while four
+  64-candidate tails collapse into one launch (4 dispatches -> 1).
+- **double-buffered host staging** (:class:`XYStager`): per-geometry
+  reusable xy buffers, ping-ponged so the previous launch's possibly
+  in-flight host->device copy is never overwritten while the next
+  launch packs — candidate build overlaps device eval instead of
+  serializing in front of it.
+- **late-wave geometry for the queue engine** (:func:`late_wave_nb`):
+  the same pow2-ladder idea applied to wave width — when the live
+  frontier drops far below ``nb``, the queue program switches to a
+  narrow wave geometry, merging what would be many underfilled
+  full-width waves into well-filled narrow ones.
+
+The planner is pure host arithmetic (no jax import): models/tsr.py
+drives it for both the Pallas kernel path and the jnp fallback (their
+width caps differ — the jnp evaluator's live-temp footprint narrows
+1/km), and models/spade_queue.py shares :func:`late_wave_nb`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# Fixed per-launch dispatch cost in TRAFFIC UNITS (one unit = one lane
+# streaming one km's prefix+suffix blocks over the sequence axis).  At
+# the headline Kosarak geometry a km1 lane costs ~10.5 us of kernel wall
+# (85.8 ms / 8192 lanes over 990k seqs, KERNELS.json) and a dispatch
+# costs ~5 ms locally (tens of ms tunneled), so ~512 units is the
+# conservative local figure at FULL scale; merges/pads cheaper than this
+# always win, costlier ones never taken.  :func:`overhead_units` scales
+# the figure to the actual sequence-axis size — at dryrun scale a lane
+# costs nanoseconds, so the same 5 ms dispatch is worth ~10^5 lanes of
+# pad and the planner correctly collapses everything it can.
+LAUNCH_OVERHEAD_UNITS = 512
+
+# Measured per-(seq word x lane x km) kernel cost anchoring the unit:
+# 85.8e-3 s / 8192 lanes / 990_000 seqs (KERNELS.json rule_supports).
+LANE_SEC_PER_SEQWORD = 85.8e-3 / 8192 / 990_000
+
+# Conservative per-dispatch fixed cost (local PCIe; a tunneled backend
+# runs ~10x this, which only makes merging MORE right).
+DISPATCH_SEC = 0.005
+
+
+def overhead_units(n_seq: int, n_words: int,
+                   dispatch_s: float = DISPATCH_SEC) -> int:
+    """Per-launch overhead in traffic units for a given sequence-axis
+    size: how many padded lanes one saved dispatch is worth.  Clamped so
+    degenerate geometries cannot zero out either term of the planner's
+    cost model."""
+    lane_s = max(1e-12, n_seq * max(1, n_words) * LANE_SEC_PER_SEQWORD)
+    return max(64, min(1 << 20, int(dispatch_s / lane_s)))
+
+
+# The dispatch quantum the 8192-lane default width encodes: the
+# measured wall of a full-width km1 launch at the full Kosarak sequence
+# axis (KERNELS.json rule_supports).  A launch should cost ~this much
+# device time regardless of S — the lane count that buys it scales
+# inversely with the sequence axis.
+QUANTUM_SEC = 85.8e-3
+
+
+def dispatch_quantum_lanes(n_seq: int, n_words: int,
+                           quantum_s: float = QUANTUM_SEC,
+                           lo: int = 8192, hi: int = 16384) -> int:
+    """Dispatch-efficiency width ceiling in lanes for a given
+    sequence-axis size: the pow2 lane count whose launch costs about
+    ``quantum_s`` of device time.  Equals the measured-best 8192 at the
+    full Kosarak axis (the anchor) and grows as the axis shrinks — a
+    dryrun-scale mine packs the same device time per dispatch instead
+    of paying full-scale dispatch granularity for microseconds of
+    work.  ``hi`` bounds the best-first STALENESS cost: candidates pop
+    with the minsup of dispatch time, so the speculation window (width
+    x pipeline depth) must stay a small multiple of the full-scale
+    window — an unbounded quantum measured 1.9x the evaluations at
+    dryrun scale.  Memory caps (the engine's budget arithmetic) still
+    apply on top; this is only the efficiency term."""
+    lane_s = max(1e-12, n_seq * max(1, n_words) * LANE_SEC_PER_SEQWORD)
+    return max(lo, min(hi, floor_pow2(int(quantum_s / lane_s) + 1)))
+
+# The km side-size ladder enumerated for prewarm.  Rule sides wider than
+# 8 items are possible in principle (unlimited max_side over a rich
+# alphabet) but unobserved in every eval config; a km16 launch would
+# compile live and surface in /admin/shapes drift — a signal, not a bug.
+KM_LADDER = (1, 2, 4, 8)
+
+
+def next_pow2(n: int) -> int:
+    k = 1
+    while k < n:
+        k *= 2
+    return k
+
+
+def floor_pow2(n: int) -> int:
+    return 1 << (int(n).bit_length() - 1) if n >= 1 else 1
+
+
+@dataclasses.dataclass
+class Launch:
+    """One planned super-batch launch.
+
+    ``km``: the launch GEOMETRY (compiled xy minor width) — the max of
+    its lanes' own km buckets.  ``width``: padded pow2 lane count (the
+    compiled candidate axis).  ``rows``: candidate indices, in lane
+    order.  ``kms``: each lane's OWN km bucket (the per-lane km tag —
+    lanes with ``kms[j] < km`` are borrowed/merged lanes riding a wider
+    geometry).
+    """
+
+    km: int
+    width: int
+    rows: List[int]
+    kms: List[int]
+
+    @property
+    def traffic_units(self) -> int:
+        """What the kernel actually streams: width x km (pad lanes and
+        borrowed lanes stream the geometry's km blocks regardless of
+        their own side size)."""
+        return self.width * self.km
+
+    @property
+    def mixed(self) -> bool:
+        """True when lanes from more than one km bucket share the
+        launch (a super-batch in the strict sense)."""
+        return len(set(self.kms)) > 1
+
+    @property
+    def borrowed(self) -> int:
+        """Lanes whose own km is below the launch geometry."""
+        return sum(1 for k in self.kms if k < self.km)
+
+
+def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
+                  lane: int,
+                  overhead: int = LAUNCH_OVERHEAD_UNITS) -> List[Launch]:
+    """Pack per-km candidate pools into pow2 super-batch launches.
+
+    Args:
+      pools: ``{km: [candidate indices]}`` — km keys must be pow2.
+      cap: per-GEOMETRY width ceiling (the jnp evaluator narrows 1/km;
+        the kernel path is flat at the engine chunk).  Floored to
+        ``lane`` and rounded down to pow2.
+      lane: minimum launch width (the kernel's C_LANES out tile; 32 for
+        the jnp path — keeps the compiled-width ladder log-sized).
+      overhead: per-launch fixed cost in traffic units (see module
+        docstring).
+
+    Returns launches in dispatch order: full same-km launches largest km
+    first, then the merged tails.  Every input candidate appears in
+    exactly one launch, exactly once.
+
+    Split rule, per pool: while the remainder exceeds the geometry cap,
+    emit cap-width 100%-fill launches; once it fits, emit a single
+    padded launch IF the pad is cheaper than another dispatch
+    (``(width - n) * km <= overhead``), else peel the largest pow2 as a
+    full launch and re-test.  With the full-scale overhead (~512 units)
+    this reproduces the measured-best greedy pow2 split; with a
+    dryrun-scale overhead (lanes are ~free) it collapses each pool to
+    ceil(n / cap) launches.  At most one non-full piece (the TAIL)
+    survives per pool; tails then merge across km pools.
+    """
+    launches: List[Launch] = []
+    tails: List[Tuple[int, List[int]]] = []
+    for km in sorted(pools, reverse=True):
+        rows = list(pools[km])
+        if not rows:
+            continue
+        cap_km = max(lane, floor_pow2(max(1, int(cap(km)))))
+        i = 0
+        while True:
+            n = len(rows) - i
+            if n == 0:
+                break
+            width = max(lane, next_pow2(n))
+            if n <= cap_km and (width - n) * km <= overhead:
+                tails.append((km, rows[i:]))
+                break
+            take = min(cap_km, floor_pow2(n))
+            if take < lane:
+                # sub-lane remainder with a tiny overhead budget: a
+                # padded lane-width tail is the only legal shape
+                tails.append((km, rows[i:]))
+                break
+            part = rows[i:i + take]
+            launches.append(Launch(km, take, part, [km] * take))
+            i += take
+
+    # cross-km tail merge, largest geometry first: bounds every lane's
+    # own km by the geometry, so -1 slots (the pad row) absorb the
+    # difference — the generalization of per-bucket pad borrowing
+    cur: Tuple[int, List[int], List[int]] | None = None  # (km_geom, rows, kms)
+    for km, rows in tails:
+        if cur is not None:
+            km_g, crows, ckms = cur
+            cap_g = max(lane, floor_pow2(max(1, int(cap(km_g)))))
+            merged_n = len(crows) + len(rows)
+            if merged_n <= cap_g:
+                w_cur = max(lane, next_pow2(len(crows)))
+                w_merged = max(lane, next_pow2(merged_n))
+                w_sep = max(lane, next_pow2(len(rows)))
+                if w_merged * km_g <= w_cur * km_g + w_sep * km + overhead:
+                    crows.extend(rows)
+                    ckms.extend([km] * len(rows))
+                    cur = (km_g, crows, ckms)
+                    continue
+            launches.append(_emit(cur, lane))
+        cur = (km, list(rows), [km] * len(rows))
+    if cur is not None:
+        launches.append(_emit(cur, lane))
+    return launches
+
+
+def _emit(cur: Tuple[int, List[int], List[int]], lane: int) -> Launch:
+    km_g, rows, kms = cur
+    return Launch(km_g, max(lane, next_pow2(len(rows))), rows, kms)
+
+
+def superbatch_geometries(lane: int, hi_width: int,
+                          kms: Sequence[int] = KM_LADDER
+                          ) -> List[Tuple[int, int]]:
+    """The finite (km, width) set :func:`plan_launches` can emit for a
+    given lane floor and width ceiling — the enumeration the prewarm
+    driver walks so no live mine pays a fresh eval compile
+    (utils/shapes.py spells the matching ``tsr-eval`` keys)."""
+    out = []
+    for km in kms:
+        w = max(1, int(lane))
+        hi = max(w, next_pow2(max(1, int(hi_width))))
+        while w <= hi:
+            out.append((int(km), w))
+            w *= 2
+    return out
+
+
+def late_wave_nb(nb: int, tile: int, ratio: int = 8) -> int:
+    """Late-wave geometry for the queue engine: the narrow wave width
+    the mine switches to once the live frontier drops below it — many
+    underfilled ``nb``-wide waves merge into well-filled narrow ones
+    (the wave-axis analog of tail merging).  ``tile``-aligned so
+    ``2 * nb_late`` still tiles the pair kernel's parent axis; returns
+    ``nb`` unchanged (ladder disabled) when the ratio floor reaches it.
+    """
+    nb = int(nb)
+    cand = max(32, nb // int(ratio))
+    cand = -(-cand // int(tile)) * int(tile)
+    return min(nb, cand)
+
+
+class XYStager:
+    """Per-geometry xy staging with explicit buffer lifetime.
+
+    The TSR dispatch loop packs the NEXT launch's [width, 2, km] int32
+    candidate array while earlier launches are still in flight, so the
+    staging buffers are DONATED to each dispatch and only recycled once
+    the dispatch's readback has resolved: :meth:`take` hands out a
+    free-listed (or fresh) buffer, the engine's eval handle carries it,
+    and :meth:`release` returns it after the blocking readback proves
+    the compute consumed its inputs.  A fixed round-robin (ping-pong)
+    would NOT be safe — the CPU backend aliases numpy memory instead of
+    copying at dispatch (observed: reused buffers under a 3-deep
+    pipeline read back garbage supports), and the pipeline depth times
+    launches-per-dispatch is unbounded.  Buffers a faulted handle holds
+    are never released (the device may still reference them); they fall
+    to the GC with the handle.
+    """
+
+    _POOL_CAP = 8  # free buffers kept per geometry
+
+    def __init__(self):
+        self._free: Dict[Tuple[int, int], List[np.ndarray]] = {}
+
+    def take(self, launch: Launch, cands) -> np.ndarray:
+        key = (launch.km, launch.width)
+        pool = self._free.get(key)
+        buf = (pool.pop() if pool
+               else np.empty((launch.width, 2, launch.km), np.int32))
+        buf.fill(-1)
+        for j, r in enumerate(launch.rows):
+            x, y = cands[r]
+            buf[j, 0, :len(x)] = x
+            buf[j, 1, :len(y)] = y
+        return buf
+
+    def release(self, bufs) -> None:
+        for buf in bufs:
+            key = (int(buf.shape[2]), int(buf.shape[0]))
+            pool = self._free.setdefault(key, [])
+            if len(pool) < self._POOL_CAP:
+                pool.append(buf)

@@ -172,20 +172,31 @@ def _warm_queue(t: dict, mesh) -> None:
     _warm_store_builders(eng.store.shape[0], eng.n_seq, eng.n_words, mesh,
                          True, t["n_items"], t["max_tokens"], eng._put)
     if t.get("checkpointed"):
-        # the segmented (resumable) variants: the first-segment program
-        # compiles through a checkpointed mine; the donating
-        # continuation program only runs from segment 2, which a tiny
-        # single-wave mine never reaches — dispatch it directly on a
-        # fresh root carry (the engine is throwaway; donation is fine)
+        # the segmented (resumable) variants: four programs now exist —
+        # (wide, late-wave narrow) x (first segment, donating
+        # continuation) — and a tiny mine reaches at most one of them
+        # (its root count picks wide or narrow, and a single-wave mine
+        # never runs segment 2), so each is dispatched directly on a
+        # fresh root carry.  The donating programs get a THROWAWAY
+        # engine each: donation invalidates the carry's store array,
+        # and carry[0] is the engine's persistent store.
         eng2 = QueueSpadeTPU(vdb, 1, mesh=mesh)
         eng2.mine(checkpoint_cb=lambda s: None, checkpoint_every_s=1e9)
         cap = eng2.caps
-        mkw = (eng2.mesh, eng2.n_words, eng2.ni_pad, eng2.max_its,
-               cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap,
-               cap.i_max, eng2.use_pallas, eng2._s_block,
-               eng2._interpret, True)
-        carry = eng2._root_carry(eng2._roots())
-        _queue_mine_fn(*mkw, True)(*carry, eng2._put(np.int32(1)))
+        nbl = eng2._nb_late
+        widths = [cap.nb] + ([nbl] if nbl < cap.nb else [])
+        for nbw in widths:
+            imax = cap.i_max * (max(1, cap.nb // max(1, nbl))
+                                if nbw == nbl else 1)
+            mkw = (eng2.mesh, eng2.n_words, eng2.ni_pad, eng2.max_its,
+                   nbw, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap,
+                   imax, eng2.use_pallas, eng2._s_block,
+                   eng2._interpret, True)
+            _queue_mine_fn(*mkw, False)(
+                *eng2._root_carry(eng2._roots()), eng2._put(np.int32(1)))
+            eng3 = QueueSpadeTPU(vdb, 1, mesh=mesh)
+            _queue_mine_fn(*mkw, True)(
+                *eng3._root_carry(eng3._roots()), eng3._put(np.int32(1)))
 
 
 def _warm_fused(t: dict, mesh) -> None:
@@ -213,9 +224,39 @@ def _warm_cspade(t: dict, mesh, ekw: dict) -> None:
 
 def _warm_tsr(t: dict, mesh) -> None:
     from spark_fsm_tpu.models.tsr import TsrTPU
+    from spark_fsm_tpu.ops import pallas_tsr as PT
+    from spark_fsm_tpu.ops import ragged_batch as RB
 
     vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
-    TsrTPU(vdb, min(8, t["n_items"]), 0.5, max_side=2, mesh=mesh).mine()
+    eng = TsrTPU(vdb, min(8, t["n_items"]), 0.5, max_side=2, mesh=mesh)
+    eng.mine()
+    # Eval-launch super-batch ladder (ops/ragged_batch.py + the
+    # ``tsr-eval`` keys in utils/shapes.py): compile every (km, width)
+    # launch program the ragged packer can emit, at the first deepening
+    # round's top-m store — the service envelope's dominant geometry
+    # (later rounds' m varies by design and recompiles per round).
+    # All-(-1) candidate slots resolve to the pad rows, so the dispatch
+    # is milliseconds of device work on top of the compile it triggers.
+    m = min(eng.item_cap, vdb.n_items)
+    eng.chunk = eng._round_chunk(m)
+    eng._round_m = m
+    eng._jnp_prep = None
+    p1, s1 = eng._prep(m)
+    pj, sj = (eng._prep_engine(m) if eng.use_pallas else (p1, s1))
+    for km, width in t.get("superbatch", ()):
+        launch = RB.Launch(km, width, [], [])
+        if eng.use_pallas and width >= PT.C_LANES:  # kernel out-tile floor
+            eng._dispatch_kernel_launch(
+                p1, s1, [], launch, [], np.empty(0, np.int64), 0)
+        else:
+            # the jnp program at this geometry: on the CPU backend this
+            # IS the live path; on TPU it is the kernel-failure fallback
+            # plus the sub-C_LANES widths only the jnp planner emits —
+            # cheap insurance either way, and it keeps every enumerated
+            # tsr-eval key recorded on every backend
+            xy = eng._stager.take(launch, [])
+            eng._eval_fn(km)(pj, sj, eng._put(xy))
+            eng._count_launch(launch)
 
 
 def _warm_sweep(t: dict, mesh) -> None:
@@ -368,6 +409,10 @@ def run(spec: shapes.WorkloadSpec, *, mesh=None,
                 _warm_cspade(t, mesh, eng_sub)
             elif t["kind"] == "tsr":
                 _warm_tsr(t, mesh)
+            elif t["kind"] == "tsr_eval":
+                pass  # warmed by the "tsr" entry's ladder walk; the
+                # separate key exists so /admin/shapes drift can name
+                # the exact launch geometry a live mine would compile
             elif t["kind"] == "sweep":
                 _warm_sweep(t, mesh)
         except Exception as exc:  # a failed warm must not take down boot

@@ -38,7 +38,14 @@ Key formats (the geometry axes that decide compiled shapes):
                                             select DIFFERENT compiled
                                             kernels; d: state dtype bits)
   ``tsr:s{S}w{W}``                          models/tsr.py (static part;
-                                            per-km buckets vary by design)
+                                            per-round top-m varies by
+                                            design)
+  ``tsr-eval:s{S}w{W}km{K}c{C}``            models/tsr.py eval launches —
+                                            one per super-batch geometry
+                                            (km bucket x pow2 width, the
+                                            ops/ragged_batch.py ladder);
+                                            recorded per launch at
+                                            dispatch time
   ``sweep:s{S}w{W}r{R}i{NI}``               streaming/incremental.py
                                             batch-store geometry (the
                                             config-5 mid-stream compile)
@@ -78,6 +85,15 @@ def key_cspade(n_seq: int, n_words: int, item_rows: int, pool_slots: int,
 
 def key_tsr(n_seq: int, n_words: int) -> str:
     return f"tsr:s{n_seq}w{n_words}"
+
+
+def key_tsr_eval(n_seq: int, n_words: int, km: int, width: int) -> str:
+    """One TSR eval-launch geometry: the (km side bucket, pow2 candidate
+    width) super-batch the ragged packer emitted (ops/ragged_batch.py).
+    The engine records one per launch; the enumerator lists the full
+    ladder so prewarm can compile every launch program a live mine can
+    dispatch."""
+    return f"tsr-eval:s{n_seq}w{n_words}km{km}c{width}"
 
 
 def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
@@ -217,9 +233,28 @@ def enumerate_shapes(spec: WorkloadSpec, *, mesh=None,
                 n_words=nw, max_tokens=max_tokens,
                 maxgap=maxgap, maxwindow=maxwindow)
         if spec.tsr:
+            from spark_fsm_tpu.ops import ragged_batch as RB
+
             tg = tsr.tsr_geometry(ns, nw, mesh=mesh, use_pallas=use_pallas)
+            # eval-launch super-batch ladder (ops/ragged_batch.py): the
+            # finite (km, pow2 width) set the ragged packer can emit.
+            # Lane floor 32 covers the jnp path (the kernel path's
+            # >=128-lane launches are a subset); the width ceiling is a
+            # pinned tsr_chunk, else the engine's own dispatch quantum
+            # at this sequence axis — the same function the engine's
+            # width caps resolve through, so the ladder cannot under-
+            # enumerate what a live mine dispatches.
+            tsr_chunk = int(ekw.get("tsr_chunk") or 0)
+            hi = tsr_chunk or RB.dispatch_quantum_lanes(tg["n_seq"], nw)
+            ladder = RB.superbatch_geometries(32, hi)
             add(tg["shape_key"], kind="tsr", n_sequences=ns, n_items=ni,
-                n_words=nw)
+                n_words=nw, superbatch=ladder)
+            for km, width in ladder:
+                # one key per geometry so /admin/shapes drift names the
+                # exact launch program a live mine would still compile;
+                # warmed by the single "tsr" entry's ladder walk
+                add(key_tsr_eval(tg["n_seq"], nw, km, width),
+                    kind="tsr_eval", km=km, width=width)
 
     if spec.stream_batch_sequences > 0 and spec.stream_items > 0:
         from spark_fsm_tpu.streaming import incremental

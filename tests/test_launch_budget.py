@@ -1,0 +1,78 @@
+"""Launch-count regression guard (dryrun-scale, tier-1).
+
+BENCH_SCALE runs hours on real hardware, so a batching regression there
+surfaces weeks late.  These tests pin ``kernel_launches``/``evaluated``
+for deterministic dryrun-scale miniatures of the two workloads the
+ragged super-batch layer (ops/ragged_batch.py) exists for:
+
+- a config-3d-shaped TSR mine (Kosarak-shaped data, unlimited rule
+  sides, service-default knobs) — the measured collapse on this
+  miniature is 49 -> 10 launches (4.9x) against the pre-superbatch
+  dispatch policy, with the rule set unchanged;
+- a late-wave queue mine — one dispatch, with the drain running at the
+  narrow late-wave geometry.
+
+The pins are EXACT: the search is deterministic on the CPU backend
+(tier-1 pins JAX_PLATFORMS=cpu), so any drift — up OR down — means the
+dispatch policy changed and the committed expectations (also mirrored
+in scripts/bench_smoke_expect.json) must be re-derived deliberately.
+"""
+
+import numpy as np
+
+from spark_fsm_tpu.data.synth import kosarak_like, synthetic_db
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.models.spade_queue import QueueCaps, QueueSpadeTPU
+from spark_fsm_tpu.models.tsr import TsrTPU
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+
+def test_tsr_3d_shape_launch_budget():
+    # config 3d at dryrun scale: ~2k Kosarak-shaped sequences, 128
+    # items, k=100, minconf=0.5, max_side UNSET (the service default)
+    db = kosarak_like(scale=0.002, fast=True)
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, 100, 0.5, max_side=None)
+    rules = eng.mine()
+    assert len(rules) == 100
+    st = eng.stats
+    # one prep + 9 planned eval launches (pre-superbatch policy: 49)
+    assert st["kernel_launches"] == 10, st
+    assert st["evaluated"] == 136072, st
+    assert st["traffic_units"] == 409600, st
+    # the km mix itself (candidate-generation drift also fails loudly)
+    assert st["evaluated_km1"] == 16256, st
+    assert st["evaluated_km2"] == 67918, st
+    assert st["evaluated_km4"] == 51898, st
+
+
+def test_tsr_3_shape_launch_budget():
+    # the max_side=2 comparison row (config 3 shape): same data, capped
+    # sides — the km1/km2 workload the 3-vs-3d decomposition anchors on
+    db = kosarak_like(scale=0.002, fast=True)
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, 100, 0.5, max_side=2)
+    rules = eng.mine()
+    assert len(rules) == 103  # tie-inclusive top-100
+    st = eng.stats
+    assert st["kernel_launches"] == 7, st
+    assert st["evaluated"] == 86936, st
+    assert st["traffic_units"] == 163840, st
+
+
+def test_queue_late_wave_budget():
+    # late-wave queue miniature: frontier far below nb for most of the
+    # drain — the whole mine stays ONE dispatch and the narrow phase
+    # does the tail work
+    db = synthetic_db(seed=21, n_sequences=300, n_items=60,
+                      mean_itemsets=6.0, mean_itemset_size=1.3)
+    vdb = build_vertical(db, min_item_support=6)
+    eng = QueueSpadeTPU(vdb, 6, caps=QueueCaps())
+    got = eng.mine()
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 6))
+    assert eng.stats["kernel_launches"] == 1
+    assert eng.stats["waves"] > 0
+    assert eng.stats["late_waves"] == eng.stats["waves"]  # all-narrow
+    assert eng.stats["candidates"] > 0

@@ -1,0 +1,253 @@
+"""Ragged super-batch packer (ops/ragged_batch.py) + engine integration.
+
+Three layers:
+
+1. planner units — the pow2 split/merge policy, the cost model's merge
+   decisions, the lane/cap invariants, and exactly-once row coverage;
+2. TSR parity — mixed-km super-batches through the engine's kernel
+   (interpret) and jnp paths must reproduce the brute-force rule set,
+   single-device and on the 8-way CPU mesh;
+3. queue late waves — the narrow-phase drain must keep oracle parity
+   (single-device and mesh) while actually running narrow waves.
+"""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.models.spade_queue import QueueCaps, QueueSpadeTPU
+from spark_fsm_tpu.models.tsr import TsrTPU, brute_force_rules
+from spark_fsm_tpu.ops import ragged_batch as RB
+from spark_fsm_tpu.utils.canonical import patterns_text, rules_text
+from tests.test_oracle import random_db
+
+
+# ------------------------------------------------------------- planner units
+
+
+def _check_exactly_once(pools, launches):
+    want = sorted(r for rows in pools.values() for r in rows)
+    got = sorted(r for L in launches for r in L.rows)
+    assert got == want
+    for L in launches:
+        assert len(L.rows) == len(L.kms) <= L.width
+        assert L.km == max(L.kms)
+        assert L.width & (L.width - 1) == 0  # pow2
+
+
+def test_low_overhead_splits_full_pow2():
+    pools = {1: list(range(5000))}
+    launches = RB.plan_launches(pools, cap=lambda km: 2048, lane=128,
+                                overhead=64)
+    _check_exactly_once(pools, launches)
+    # greedy full-fill splits; only the sub-pad tail stays padded
+    assert [L.width for L in launches] == [2048, 2048, 512, 256, 128, 128]
+    assert [len(L.rows) for L in launches] == [2048, 2048, 512, 256, 128, 8]
+
+
+def test_high_overhead_collapses_to_cap_launches():
+    pools = {1: list(range(5000))}
+    launches = RB.plan_launches(pools, cap=lambda km: 2048, lane=128,
+                                overhead=1 << 20)
+    _check_exactly_once(pools, launches)
+    # pad is free next to a dispatch: ceil(n / cap) launches
+    assert [len(L.rows) for L in launches] == [2048, 2048, 904]
+    assert launches[-1].width == 1024
+
+
+def test_mixed_km_tails_merge_with_lane_tags():
+    pools = {1: list(range(40)), 2: list(range(40, 70)),
+             4: list(range(70, 90)), 8: list(range(90, 100))}
+    launches = RB.plan_launches(pools, cap=lambda km: 8192, lane=128,
+                                overhead=1 << 20)
+    _check_exactly_once(pools, launches)
+    assert len(launches) == 1
+    (L,) = launches
+    assert L.km == 8 and L.width == 128 and L.mixed
+    assert L.borrowed == 90  # every lane below the km8 geometry
+    assert sorted(set(L.kms)) == [1, 2, 4, 8]
+    assert L.traffic_units == 128 * 8
+
+
+def test_cost_model_refuses_expensive_merge():
+    # a 900-candidate km1 tail must NOT ride a km8 geometry (8x its
+    # traffic dwarfs one saved dispatch at full-scale overhead)
+    pools = {1: list(range(900)), 8: list(range(900, 910))}
+    launches = RB.plan_launches(pools, cap=lambda km: 8192, lane=128,
+                                overhead=512)
+    _check_exactly_once(pools, launches)
+    assert len(launches) == 2
+    assert launches[0].km == 8 and launches[0].width == 128
+    assert launches[1].km == 1 and launches[1].width == 1024
+
+
+def test_per_km_caps_respected():
+    pools = {4: list(range(5000)), 1: list(range(5000, 5100))}
+    launches = RB.plan_launches(pools, cap=lambda km: 8192 // km, lane=32,
+                                overhead=1 << 20)
+    _check_exactly_once(pools, launches)
+    for L in launches:
+        assert L.width <= 8192 // L.km
+
+
+def test_overhead_and_quantum_anchors():
+    # full-Kosarak axis: the measured anchors (KERNELS.json)
+    assert 300 <= RB.overhead_units(990_000, 1) <= 700
+    assert RB.dispatch_quantum_lanes(990_000, 1) == 8192
+    # dryrun axis: a dispatch is worth ~10^5 pad lanes, the quantum
+    # widens (clamped by the staleness bound)
+    assert RB.overhead_units(2_000, 1) > 100_000
+    assert RB.dispatch_quantum_lanes(2_000, 1) == 16384
+
+
+def test_late_wave_nb():
+    from spark_fsm_tpu.ops import pallas_support as PS
+
+    assert RB.late_wave_nb(512, PS.P_TILE) == 64
+    assert RB.late_wave_nb(512, PS.P_TILE) % PS.P_TILE == 0
+    # ladder disables itself when the floor reaches nb
+    assert RB.late_wave_nb(32, PS.P_TILE) == 32
+
+
+def test_xy_stager_lifetime_and_fill():
+    st = RB.XYStager()
+    cands = [((1, 2), (3,)), ((4,), (5, 6, 7))]
+    L = RB.Launch(4, 32, [0, 1], [2, 4])
+    buf = st.take(L, cands)
+    assert buf.shape == (32, 2, 4)
+    assert buf[0, 0].tolist() == [1, 2, -1, -1]
+    assert buf[1, 1].tolist() == [5, 6, 7, -1]
+    assert (buf[2:] == -1).all()  # pad lanes
+    buf2 = st.take(L, cands)
+    assert buf2 is not buf  # outstanding buffers are never reissued
+    st.release([buf])
+    assert st.take(L, cands) is buf  # released buffers recycle
+
+
+# ----------------------------------------------------------- TSR integration
+
+
+def assert_rule_parity_eng(db, k, minconf, **kw):
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, k, minconf, **kw)
+    got = eng.mine()
+    n_items = vdb.n_items
+    want = brute_force_rules(db, k, minconf,
+                             max_side=kw.get("max_side") or n_items)
+    assert rules_text(got) == rules_text(want), (
+        f"\n--- got ---\n{rules_text(got)}\n--- want ---\n{rules_text(want)}")
+    return eng
+
+
+def test_superbatch_parity_unlimited_sides_kernel():
+    # unlimited sides exercise mixed-km launches through the Pallas
+    # (interpret) kernel path — the 3d-shaped dispatch pattern
+    rng = np.random.default_rng(31)
+    db = random_db(rng, n_seq=25, n_items=6, max_itemsets=5, max_set=2)
+    eng = assert_rule_parity_eng(db, 8, 0.4, max_side=None,
+                                 use_pallas=True)
+    assert eng.stats["traffic_units"] > 0
+    assert sum(v for k, v in eng.stats.items()
+               if k.startswith("launches_km")) >= 1
+
+
+def test_superbatch_parity_unlimited_sides_jnp():
+    rng = np.random.default_rng(33)
+    db = random_db(rng, n_seq=30, n_items=6, max_itemsets=6, max_set=2)
+    eng = assert_rule_parity_eng(db, 10, 0.3, max_side=None)
+    # the merged-tail path actually ran: mixed-km super-batches exist
+    assert eng.stats.get("superbatches", 0) >= 1
+    assert eng.stats["traffic_units"] > 0
+
+
+def test_superbatch_parity_mesh():
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(len(jax.devices()))
+    rng = np.random.default_rng(35)
+    db = random_db(rng, n_seq=26, n_items=6, max_itemsets=5, max_set=2)
+    assert_rule_parity_eng(db, 8, 0.4, max_side=None, mesh=mesh,
+                           use_pallas=True)
+
+
+def test_conf_pruning_fires_and_keeps_parity():
+    # a capped antecedent plus a high confidence floor makes conf-dead
+    # right chains provably whole-subtree-dead: pruned_conf > 0 while
+    # the rule set stays byte-identical to brute force
+    rng = np.random.default_rng(37)
+    db = random_db(rng, n_seq=40, n_items=8, max_itemsets=5, max_set=2)
+    eng = assert_rule_parity_eng(db, 5, 0.8, max_side=1)
+    assert (eng.stats["pruned_conf"] > 0
+            or eng.stats.get("pruned_conf_chains", 0) > 0), eng.stats
+
+
+# --------------------------------------------------------- queue late waves
+
+
+def test_queue_late_wave_parity_and_counters():
+    # default-caps engine (nb=512, nb_late=64) over a small DB: the
+    # whole mine drains in narrow waves (roots < nb_late skip the wide
+    # phase entirely) with oracle parity and one dispatch
+    db = synthetic_db(seed=21, n_sequences=300, n_items=60,
+                      mean_itemsets=6.0, mean_itemset_size=1.3)
+    vdb = build_vertical(db, min_item_support=6)
+    eng = QueueSpadeTPU(vdb, 6, caps=QueueCaps())
+    assert eng._nb_late == 64
+    got = eng.mine()
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 6))
+    assert eng.stats["kernel_launches"] == 1
+    assert eng.stats["late_waves"] > 0
+    assert eng.stats["late_waves"] <= eng.stats["waves"]
+
+
+def test_queue_wide_then_late_phase():
+    # more roots than nb_late: the wide phase runs first, the narrow
+    # phase drains the tail — both counted, parity preserved
+    db = synthetic_db(seed=13, n_sequences=200, n_items=90,
+                      mean_itemsets=5.0, mean_itemset_size=1.3)
+    vdb = build_vertical(db, min_item_support=2)
+    n_roots = sum(1 for s in vdb.item_supports if int(s) >= 2)
+    caps = QueueCaps(nb=512, ring=16384, c_cap=8192, r_cap=1 << 17)
+    eng = QueueSpadeTPU(vdb, 2, caps=caps)
+    assert n_roots > eng._nb_late, "fixture must exceed the late width"
+    got = eng.mine()
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+    assert 0 < eng.stats["late_waves"] < eng.stats["waves"]
+
+
+def test_queue_late_wave_parity_mesh():
+    import jax
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(len(jax.devices()))
+    db = synthetic_db(seed=21, n_sequences=304, n_items=60,
+                      mean_itemsets=6.0, mean_itemset_size=1.3)
+    vdb = build_vertical(db, min_item_support=6)
+    eng = QueueSpadeTPU(vdb, 6, mesh=mesh, caps=QueueCaps())
+    got = eng.mine()
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 6))
+    assert eng.stats["late_waves"] > 0
+
+
+def test_queue_segmented_late_switch_parity():
+    # the host-side ladder: a checkpointed (segmented) mine switches to
+    # the narrow program when the counters show a drained frontier;
+    # pattern set byte-identical to the one-shot path
+    db = synthetic_db(seed=13, n_sequences=200, n_items=90,
+                      mean_itemsets=5.0, mean_itemset_size=1.3)
+    vdb = build_vertical(db, min_item_support=2)
+    caps = QueueCaps(nb=512, ring=16384, c_cap=8192, r_cap=1 << 17)
+    eng = QueueSpadeTPU(vdb, 2, caps=caps)
+    snaps = []
+    got = eng.mine(checkpoint_cb=snaps.append, checkpoint_every_s=0.0,
+                   seg_waves=4)
+    assert got is not None
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+    assert eng.stats.get("late_waves", 0) > 0
+    assert eng.stats["kernel_launches"] > 1  # actually segmented

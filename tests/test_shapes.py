@@ -53,6 +53,7 @@ def test_key_formats_are_the_engine_spellings():
     assert shapes.key_cspade(128, 1, 12, 64, 32, 256, None, None, 16) == \
         "cspade:s128w1i12p64nb32c256gnxnd16"
     assert shapes.key_sweep(128, 1, 256, 128) == "sweep:s128w1r256i128"
+    assert shapes.key_tsr_eval(128, 1, 4, 256) == "tsr-eval:s128w1km4c256"
 
 
 def test_enumeration_covers_runtime_keys_no_drift():
@@ -161,6 +162,59 @@ def test_prewarm_covers_streaming_pushes(warmed):
     c1 = compile_counts()
     assert c1["count"] - c0["count"] == 0, \
         f"pushes compiled {c1['count'] - c0['count']} fresh programs"
+
+
+def test_tsr_superbatch_keys_through_prewarm():
+    """Super-batch geometry coverage (the ragged-batch ladder): the
+    enumerator lists one ``tsr-eval`` key per (km, pow2 width), the
+    prewarm driver compiles and RECORDS each one, and a post-prewarm
+    engine dispatch at the declared geometry performs zero fresh
+    compiles — the PR-1 guarantee extended to the new launch ladder.
+    A pinned tsr_chunk throttles the ladder so this stays seconds-scale.
+    """
+    from spark_fsm_tpu.models.tsr import TsrTPU
+    from spark_fsm_tpu.ops import ragged_batch as RB
+    from spark_fsm_tpu.service import prewarm
+
+    assert enable_compile_counter()
+    db = _db(seed=81, n=90)
+    vdb = build_vertical(db, min_item_support=1)
+    spec = shapes.WorkloadSpec(n_sequences=len(db), n_items=vdb.n_items,
+                               n_words=vdb.n_words, tsr=True)
+    ekw = {"tsr_chunk": 256}
+    targets = shapes.enumerate_shapes(spec, engine_kwargs=ekw)
+    eval_keys = {k for k, t in targets.items() if t["kind"] == "tsr_eval"}
+    ladder = RB.superbatch_geometries(32, 256)
+    assert eval_keys == {shapes.key_tsr_eval(len(db), vdb.n_words, km, w)
+                        for km, w in ladder}
+    (tsr_t,) = [t for t in targets.values() if t["kind"] == "tsr"]
+    assert tsr_t["superbatch"] == ladder
+
+    shapes.reset_recorded()
+    report = prewarm.run(spec, engine_kwargs=ekw)
+    bad = [r for r in report["keys"] if r.get("error")]
+    assert not bad, bad
+    recorded = shapes.recorded()
+    for key in eval_keys:
+        assert key in recorded, (key, sorted(recorded))
+
+    # zero-fresh-compile through a live dispatch at the warmed geometry:
+    # prep compiles per token count (excluded by snapshotting after it),
+    # but every eval-launch program must already be warm
+    eng = TsrTPU(vdb, 8, 0.5, max_side=None, chunk=256)
+    m = min(eng.item_cap, vdb.n_items)
+    eng.chunk = eng._round_chunk(m)
+    eng._round_m = m
+    p1, s1 = eng._prep(m)
+    c0 = compile_counts()
+    cands = ([((0,), (j,)) for j in range(1, 9)]
+             + [((0, 1), (2, 3)), ((0,), (1, 2, 3))])
+    handle = eng._dispatch_eval(p1, s1, cands)
+    sups, supxs = eng._resolve_eval(handle, len(cands))
+    assert len(sups) == len(cands)
+    c1 = compile_counts()
+    assert c1["count"] - c0["count"] == 0, \
+        f"eval dispatch compiled {c1['count'] - c0['count']} fresh programs"
 
 
 @pytest.fixture()

@@ -172,14 +172,16 @@ def test_no_dense_bitmap_materialization():
 
 def test_launch_width_narrows_with_side_bucket():
     # The eval kernel's live-temp footprint grows with km, so the
-    # adaptive launch width must shrink by 1/km as the side-size bucket
-    # grows — a km=4 launch at the km=1 width OOMs real HBM (v5e: 27G on
-    # a 16G chip; see _dispatch_eval).  A caller-pinned chunk is honored
-    # unchanged.
+    # BUDGET-derived launch width must shrink by 1/km as the side-size
+    # bucket grows — a km=4 launch at the km=1 width OOMs real HBM
+    # (v5e: 27G on a 16G chip; see _dispatch_eval / _round_chunk_jnp).
+    # A caller-pinned chunk is honored unchanged.
     db = synthetic_db(3, n_sequences=40, n_items=12, mean_itemsets=5.0)
     vdb = build_vertical(db, min_item_support=1)
     eng = TsrTPU(vdb, k=5, minconf=0.5)
-    eng.chunk, eng._chunk_user = 512, None
+    # pin the budget-derived width the 1/km memory caps divide
+    eng.chunk = eng._jnp_raw = 512
+    eng._chunk_user = None
     p1, s1 = eng._prep(vdb.n_items)
     cands = [((0,), (i % 3 + 1, 4, 5)) for i in range(512)]  # kmax=3 -> km=4
     before = eng.stats["kernel_launches"]
