@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Chaos smoke — the fault-injection companion to verify_t1.sh and
+# bench_smoke.sh.  Runs the chaos suite (tests/test_chaos.py: every
+# registered fault site injected, each must yield retry/degrade-with-
+# parity or a clean failure — never a hang, a torn-snapshot resume, or
+# a silent wrong answer) with a PINNED injection seed so probability
+# triggers fire identically in CI and on a laptop.  Override the seed
+# with SPARKFSM_CHAOS_SEED to explore new schedules; a failure under a
+# new seed is a real recovery bug, not flake.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu SPARKFSM_CHAOS_SEED="${SPARKFSM_CHAOS_SEED:-1299827}" \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m pytest tests/test_chaos.py -q -p no:cacheprovider "$@"

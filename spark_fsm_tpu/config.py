@@ -11,6 +11,8 @@ carries the per-job vocabulary (``algorithm``, ``support``, ...).
 File format (TOML shown; JSON with the same nesting also accepted):
 
     profile_dir = "traces"          # jax.profiler output root ("" = off)
+    fault_injection = false         # allow /admin/faults (chaos lab) — the
+                                    # endpoint is refused unless true
 
     [service]
     host = "0.0.0.0"
@@ -40,6 +42,10 @@ File format (TOML shown; JSON with the same nesting also accepted):
     item_cap = 256                  # TSR iterative-deepening width
     fused = "auto"                  # SPADE routing: auto / always / never
                                     # / queue / dense (engine pins)
+    watchdog_slack = 20.0           # dispatch watchdog: deadline = max(
+                                    # watchdog_floor_s, estimate x slack);
+                                    # omit to disable (utils/watchdog.py)
+    watchdog_floor_s = 2.0
 
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
@@ -93,6 +99,11 @@ class EngineConfig:
     fused: Optional[str] = None  # SPADE engine routing: "auto" (default) /
     # "always" / "never" / "queue" / "dense" (engine pins) — see
     # models/spade_tpu.mine_spade_tpu
+    watchdog_slack: Optional[float] = None  # dispatch watchdog: deadline =
+    # max(floor, cost-model estimate x slack); None (default) disables —
+    # see utils/watchdog.py (enable on TPU deployments; the estimate is
+    # anchored on TPU kernel walls)
+    watchdog_floor_s: Optional[float] = None  # minimum deadline (default 2.0)
 
 
 @dataclasses.dataclass
@@ -154,6 +165,9 @@ class Config:
         default_factory=DistributedConfig)
     prewarm: PrewarmConfig = dataclasses.field(default_factory=PrewarmConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
+    fault_injection: bool = False  # gate for /admin/faults: arming fault
+    # sites over HTTP is a chaos-lab capability, refused unless the boot
+    # config opts the deployment in explicitly (utils/faults.py)
 
 
 class ConfigError(ValueError):
@@ -175,6 +189,8 @@ def _fill(cls, obj: Dict[str, Any], section: str):
         f = fields[name]
         if f.type in ("int", "Optional[int]") and value is not None:
             value = int(value)
+        elif f.type in ("float", "Optional[float]") and value is not None:
+            value = float(value)
         elif f.type == "str":
             value = str(value)
         kwargs[name] = value
@@ -191,13 +207,15 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "prewarm": (PrewarmConfig, top.pop("prewarm", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
+    fault_injection = bool(top.pop("fault_injection", False))
     if top:
         raise ConfigError(
             f"unknown top-level key(s) {sorted(top)} "
-            f"(valid: {sorted(sections) + ['profile_dir']})")
+            f"(valid: {sorted(sections) + ['fault_injection', 'profile_dir']})")
     parsed = {name: _fill(cls, section_obj, name)
               for name, (cls, section_obj) in sections.items()}
-    cfg = Config(profile_dir=profile_dir, **parsed)
+    cfg = Config(profile_dir=profile_dir, fault_injection=fault_injection,
+                 **parsed)
     if cfg.store.backend not in ("inproc", "redis"):
         raise ConfigError(
             f"store.backend must be 'inproc' or 'redis', "
@@ -248,6 +266,14 @@ def set_config(cfg: Config) -> None:
     with _lock:
         _active = cfg
         _mesh_cache.clear()
+    # the watchdog policy is process-global (engines read it at dispatch
+    # time, no constructor plumbing) — the active config owns it
+    from spark_fsm_tpu.utils import watchdog
+
+    watchdog.configure(
+        slack=cfg.engine.watchdog_slack,
+        floor_s=(2.0 if cfg.engine.watchdog_floor_s is None
+                 else cfg.engine.watchdog_floor_s))
 
 
 def engine_kwargs(*names: str) -> Dict[str, Any]:

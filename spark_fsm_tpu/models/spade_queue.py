@@ -65,7 +65,7 @@ from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
-from spark_fsm_tpu.utils import shapes
+from spark_fsm_tpu.utils import faults, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
 
 
@@ -717,6 +717,7 @@ class QueueSpadeTPU:
         ni = self.ni_pad
         (q_slot, q_smask, q_imask, q_nits, q_rec, records, recsup), \
             n_roots_dev = self._root_init(roots)
+        faults.fault_site("device.dispatch", point="queue_launch")
         fn = _queue_mine_fn(
             self.mesh, self.n_words, ni, self.max_its,
             cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
@@ -726,6 +727,11 @@ class QueueSpadeTPU:
             self.store, q_slot, q_smask, q_imask, q_nits, q_rec,
             n_roots_dev, records, recsup,
             self._put(np.int32(self.minsup)))
+        # watchdog deadline for the whole-mine dispatch: the wave ceiling
+        # times the wave width is the program's own upper bound on lanes
+        # streamed — the same cost-model units the ragged planner uses
+        wd_deadline = watchdog.deadline_s(RB.estimate_seconds(
+            cap.nb * cap.i_max, 1, self.n_seq, self.n_words))
         # Single-roundtrip fast path: prefetch a fixed prefix (counter
         # block + the first PREFETCH records, 64 KB) — most mines fit it,
         # so the counter read and the record read share one device->host
@@ -736,7 +742,15 @@ class QueueSpadeTPU:
             prefix_dev.copy_to_host_async()
         except (AttributeError, NotImplementedError):
             pass  # method unavailable on this backend
-        prefix = np.asarray(prefix_dev)
+
+        def read():
+            faults.fault_site("device.dispatch", point="queue_readback")
+            return np.asarray(prefix_dev)
+
+        # a hung whole-mine dispatch fails the launch (the Miner's
+        # supervision retries the job) instead of wedging the worker
+        prefix = watchdog.run_with_deadline(read, wd_deadline,
+                                            site="queue.readback")
         counters = prefix[0]
         n_rec = int(counters[0])
         self.stats["waves"] = int(counters[2])
@@ -752,7 +766,12 @@ class QueueSpadeTPU:
             packed = prefix[2:2 + n_rec]
         else:
             n_fetch = min(cap.r_cap, next_pow2(n_rec))
-            packed = np.asarray(packed_dev[2:2 + n_fetch])
+            # the big-result second fetch blocks too — same watchdog
+            # deadline as the prefix read (a wedge after the prefix
+            # resolved must still fail the launch, not the worker)
+            packed = watchdog.run_with_deadline(
+                lambda: np.asarray(packed_dev[2:2 + n_fetch]),
+                wd_deadline, site="queue.readback")
         rec, sup = packed[:, :3], packed[:, 3]
         results, _ = self._decode_records(rec, sup, n_rec)
         self.stats["patterns"] = len(results)
@@ -814,13 +833,21 @@ class QueueSpadeTPU:
         # per wave.  One compiled program serves every budget (traced).
         budget = 1 if checkpoint_cb is not None else seg_waves
         while True:
+            faults.fault_site("device.dispatch", point="queue_segment")
+            nbw = nbl if narrow else cap.nb
+            seg_deadline = watchdog.deadline_s(RB.estimate_seconds(
+                nbw * budget, 1, self.n_seq, self.n_words))
             carry, counters_dev = seg_fn(narrow, first)(
                 *carry, self._put(np.int32(budget)))
             budget = min(seg_waves, budget * 4)
             first = False
             self.stats["kernel_launches"] = (
                 self.stats.get("kernel_launches", 0) + 1)
-            counters = np.asarray(counters_dev)
+            # per-segment counter readback under the dispatch watchdog:
+            # the deadline scales with this segment's own wave budget
+            counters = watchdog.run_with_deadline(
+                lambda: np.asarray(counters_dev), seg_deadline,
+                site="queue.segment_readback")
             n_rec, oflow, waves, n_cand, pending, head, tail = (
                 int(x) for x in counters)
             if narrow:

@@ -61,8 +61,21 @@ from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
-from spark_fsm_tpu.utils import shapes
+from spark_fsm_tpu.utils import faults, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
+
+# OOM degradation ladder floor (lanes): a failed launch re-plans at half
+# width recursively down to here (the Pallas out-tile C_LANES, which is
+# also the narrowest compiled geometry prewarm enumerates) before
+# falling back to the jnp path.
+_OOM_FLOOR_LANES = 128
+
+
+def _is_oom(exc: BaseException) -> bool:
+    """Device allocation failure — XLA spells it RESOURCE_EXHAUSTED
+    across backends (and faults.InjectedOom matches on purpose)."""
+    s = repr(exc)
+    return "RESOURCE_EXHAUSTED" in s or "Resource exhausted" in s
 
 
 def tsr_geometry(n_sequences: int, n_words: int, *,
@@ -645,6 +658,8 @@ class TsrTPU:
             for L in RB.plan_launches(
                     leftover, cap=cap, lane=32,
                     overhead=RB.overhead_units(self.n_seq, self.n_words)):
+                faults.fault_site("device.dispatch", point="jnp",
+                                  km=str(L.km), width=str(L.width))
                 fn = self._eval_fn(L.km)
                 xy = self._stager.take(L, cands)
                 xy_bufs.append(xy)
@@ -668,9 +683,16 @@ class TsrTPU:
                     for sk in self.stats
                     if sk.startswith(_KM_STAT_PREFIXES)
                     and self.stats[sk] != km_stats0.get(sk, 0)}
+        # the handle carries the planner's own wall estimate for this
+        # dispatch — the watchdog deadline at readback derives from it
+        est_s = RB.estimate_seconds(
+            self.stats.get("traffic_units", 0)
+            - km_stats0.get("traffic_units", 0),
+            self.stats["kernel_launches"] - launches0,
+            self.n_seq, self.n_words)
         return (out, cols, used_kernel,
                 self.stats["kernel_launches"] - launches0, km_delta,
-                xy_bufs)
+                xy_bufs, est_s)
 
     def _ensure_jnp_downgrade(self) -> None:
         """Build the engine-layout prep + budget width the jnp evaluator
@@ -706,11 +728,44 @@ class TsrTPU:
         generalizes the old per-bucket pad borrowing.  Appends to
         parts/cols and returns the advanced base; stats land only after
         the dispatch succeeds (a compile failure leaves nothing to roll
-        back)."""
-        fn = _kernel_eval_fn(self.mesh, L.km, self._bucket_seq_block(L.km),
-                             self._interpret, self.n_words == 1)
-        xy = self._stager.take(L, cands)
-        part = fn(p1k, s1k, self._put(xy))
+        back).
+
+        RESOURCE_EXHAUSTED gets its own recovery: a device OOM at a new
+        ragged geometry used to kill the whole mine, but the failure is
+        a function of launch WIDTH (the live-temp footprint), so the
+        launch re-plans at HALF width — recursively, floored at
+        ``_OOM_FLOOR_LANES`` — before the generic handler falls it back
+        to the jnp path.  Each halving counts ``degraded_launches``;
+        the sub-launches re-enter this method, so a half-width OOM
+        halves again and stats/cols bookkeeping stays per-sub-launch.
+        """
+        try:
+            faults.fault_site("device.dispatch", point="kernel",
+                              km=str(L.km), width=str(L.width))
+            faults.fault_site("device.oom", point="kernel",
+                              km=str(L.km), width=str(L.width))
+            fn = _kernel_eval_fn(self.mesh, L.km,
+                                 self._bucket_seq_block(L.km),
+                                 self._interpret, self.n_words == 1)
+            xy = self._stager.take(L, cands)
+            part = fn(p1k, s1k, self._put(xy))
+        except Exception as exc:
+            if not _is_oom(exc) or L.width <= _OOM_FLOOR_LANES:
+                raise
+            self.stats["degraded_launches"] = (
+                self.stats.get("degraded_launches", 0) + 1)
+            half = L.width // 2
+            from spark_fsm_tpu.utils.obs import log_event
+            log_event("oom_degraded_launch", km=L.km, width=L.width,
+                      half=half)
+            for lo, hi in ((0, half), (half, len(L.rows))):
+                rows = L.rows[lo:hi]
+                if rows:
+                    base = self._dispatch_kernel_launch(
+                        p1k, s1k, cands,
+                        RB.Launch(L.km, half, rows, L.kms[lo:hi]),
+                        parts, cols, base)
+            return base
         self._xy_bufs.append(xy)
         self._count_launch(L)
         cols[L.rows] = base + np.arange(len(L.rows))
@@ -742,7 +797,20 @@ class TsrTPU:
 
     def _resolve_eval(self, handle, n: int):
         out, cols = handle[0], handle[1]
-        arr = np.asarray(out)
+
+        def read():
+            faults.fault_site("device.dispatch", point="readback")
+            return np.asarray(out)
+
+        # the blocking readback runs under the dispatch watchdog: the
+        # deadline derives from the packer's own cost-model estimate
+        # carried on the handle (x configured slack; disabled = direct
+        # call).  A hung device fails THIS launch (consume()'s fault
+        # handling downgrades or the job supervisor retries) instead of
+        # wedging the Miner worker forever.
+        est_s = handle[6] if len(handle) > 6 else 0.0
+        arr = watchdog.run_with_deadline(
+            read, watchdog.deadline_s(est_s), site="tsr.readback")
         # the blocking readback proves the compute consumed its staged
         # inputs: recycle the dispatch's xy buffers (a FAULTED handle
         # never reaches this line, so its buffers are never reused while
@@ -981,6 +1049,14 @@ class TsrTPU:
             try:
                 sups, supxs = self._resolve_eval(handle, len(batch))
             except Exception as exc:
+                # A WATCHDOG timeout is not a kernel fault: the device
+                # itself is suspect, so re-dispatching here would run
+                # unguarded dispatch-side work on a possibly wedged
+                # backend AND permanently downgrade the mine on what may
+                # be a transient stall.  Fail the launch upward instead —
+                # job supervision (the Miner retry) owns the re-run.
+                if isinstance(exc, watchdog.WatchdogTimeout):
+                    raise
                 # TPU kernel RUNTIME faults surface at readback (compile/
                 # lowering faults were already caught per km bucket at
                 # dispatch).  Gate on whether THIS handle involved the

@@ -112,6 +112,17 @@ def dispatch_quantum_lanes(n_seq: int, n_words: int,
     lane_s = max(1e-12, n_seq * max(1, n_words) * LANE_SEC_PER_SEQWORD)
     return max(lo, min(hi, floor_pow2(int(quantum_s / lane_s) + 1)))
 
+def estimate_seconds(traffic_units: int, n_launches: int, n_seq: int,
+                     n_words: int, dispatch_s: float = DISPATCH_SEC) -> float:
+    """Predicted device wall for a dispatch of ``n_launches`` launches
+    streaming ``traffic_units`` lane-km units — the same KERNELS.json-
+    anchored terms the packer's cost model trades off, exposed so the
+    dispatch watchdog (utils/watchdog.py) can derive a deadline from
+    the planner's OWN arithmetic instead of a guessed constant."""
+    lane_s = n_seq * max(1, n_words) * LANE_SEC_PER_SEQWORD
+    return max(0, traffic_units) * lane_s + max(1, n_launches) * dispatch_s
+
+
 # The km side-size ladder enumerated for prewarm.  Rule sides wider than
 # 8 items are possible in principle (unlimited max_side over a rich
 # alphabet) but unobserved in every eval config; a km16 launch would

@@ -23,7 +23,9 @@ from spark_fsm_tpu import config
 from spark_fsm_tpu.service import model, plugins, sources
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import faults
 from spark_fsm_tpu.utils.obs import log_event, profile_trace
+from spark_fsm_tpu.utils.retry import RetryPolicy
 
 
 def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
@@ -88,26 +90,58 @@ class StoreCheckpoint:
     atomic SET.  A delete-list-then-rewrite scheme would reintroduce the
     torn-snapshot hazard the count check cannot catch — consecutive top-k
     rewrites routinely have the SAME length, so an old meta paired with a
-    newer list would pass ``results_total`` and resume duplicated rules."""
+    newer list would pass ``results_total`` and resume duplicated rules.
+
+    Failure posture (the chaos-suite contract): every store verb runs
+    under the shared bounded-backoff RetryPolicy (utils/retry.py, site
+    ``store.checkpoint``), so a transient store hiccup never fails a
+    save; ``save`` works on a SHALLOW COPY of the caller's state dict,
+    so a save that dies mid-way leaves the engine's state intact and a
+    retried save writes the correct ``results_total``; and ``load``
+    HEALS a kill between the delta ``rpush`` and the meta ``set`` — the
+    meta names the last GOOD snapshot, trailing chunks newer than it
+    (including a retried rpush that had actually landed) are trimmed
+    away, and only a list that cannot be reconciled at a chunk boundary
+    is refused outright."""
 
     def __init__(self, store: ResultStore, uid: str,
-                 every_s: float = 30.0) -> None:
+                 every_s: float = 30.0,
+                 retry: Optional[RetryPolicy] = None) -> None:
         self.store, self.uid, self.every_s = store, uid, every_s
         self._meta_key = f"fsm:frontier:{uid}"
         self._results_key = f"fsm:frontier:results:{uid}"
         self._inline: list = []  # results_done=0 part of the loaded snapshot
+        self._retry = retry if retry is not None else RetryPolicy(seed=0)
+
+    def _io(self, fn, *args):
+        return self._retry.run(fn, *args, site="store.checkpoint")
 
     def load(self) -> Optional[dict]:
-        raw = self.store.get(self._meta_key)
+        raw = self._io(self.store.get, self._meta_key)
         if not raw:
             return None
         state = json.loads(raw)
         inline = state.pop("results_inline", [])
+        total = state.pop("results_total", -1)
+        chunks = self._io(self.store.lrange, self._results_key)
         results = list(inline)
-        for chunk in self.store.lrange(self._results_key):
+        used = 0
+        for chunk in chunks:
+            if len(results) == total:
+                break  # later chunks postdate this meta (torn tail)
             results.extend(json.loads(chunk))
-        if len(results) != state.pop("results_total", -1):
+            used += 1
+        if len(results) != total:
             return None  # torn snapshot (killed mid-save): refuse to resume
+        if used < len(chunks):
+            # a save died between its delta rpush and its meta set: the
+            # meta is the LAST GOOD snapshot and the trailing chunks are
+            # orphans — trim them so resumed append-mode saves stay
+            # consistent with results_total (leaving them would corrupt
+            # the NEXT load: a fresh delta lands after the orphan)
+            self._io(self.store.ltrim, self._results_key, used)
+            log_event("frontier_checkpoint_healed", uid=self.uid,
+                      trimmed_chunks=len(chunks) - used)
         # append-mode saves after this resume must re-embed the inline part
         # (their meta overwrites the one that carried it)
         self._inline = inline
@@ -115,22 +149,38 @@ class StoreCheckpoint:
         return state
 
     def save(self, state: dict) -> None:
+        faults.fault_site("checkpoint.save", uid=self.uid)
+        # NON-DESTRUCTIVE: pop from a shallow copy, never the caller's
+        # dict — a store failure mid-save must leave the engine's state
+        # whole so a retried save recomputes the same results_total
+        state = dict(state)
         delta = state.pop("results")
         done = state.pop("results_done")
         if done == 0:
             # single atomic meta SET; the chunk list (possibly stale from a
             # crashed earlier incarnation) is dropped
-            self.store.delete(self._results_key)
+            self._io(self.store.delete, self._results_key)
             self._inline = delta
             state["results_total"] = len(delta)
         else:
             if delta:
-                self.store.rpush(self._results_key, json.dumps(delta))
+                payload = json.dumps(delta)
+                n0 = self._io(self.store.llen, self._results_key)
+
+                def _push_delta():
+                    # idempotent under retry: an append that LANDED but
+                    # raised (ack lost) must not land twice — one writer
+                    # per uid, so the length check is race-free
+                    if self.store.llen(self._results_key) <= n0:
+                        self.store.rpush(self._results_key, payload)
+
+                self._io(_push_delta)
             state["results_total"] = done + len(delta)
         state["results_inline"] = self._inline
         # meta written LAST: results_total only matches inline+list once
-        # the delta is in, so a kill between writes reads as torn, not valid
-        self.store.set(self._meta_key, json.dumps(state))
+        # the delta is in, so a kill between writes reads as torn (and
+        # load() heals back to THIS meta's snapshot), never as valid
+        self._io(self.store.set, self._meta_key, json.dumps(state))
         log_event("frontier_checkpoint", uid=self.uid,
                   stack=len(state["stack"]), results=state["results_total"])
 

@@ -20,7 +20,13 @@ Endpoints (POST, form- or JSON-encoded parameters):
   /admin/config       — the active boot config;
   /admin/prewarm      — AOT-compile the declared workload envelope NOW
                         (params override the boot [prewarm] section);
-  /admin/shapes       — enumerated vs runtime-recorded shape keys + drift
+  /admin/shapes       — enumerated vs runtime-recorded shape keys + drift;
+  /admin/faults       — chaos lab: arm/disarm/list fault-injection sites
+                        (REFUSED unless the boot config sets
+                        ``fault_injection = true``);
+  /admin/health       — per-subsystem recovery counters: armed faults,
+                        I/O retry/backoff, dispatch watchdog, devcache
+                        circuit breakers, consumer leaked threads
 
 Runs on the stdlib ThreadingHTTPServer: the service layer is deliberately
 dependency-free; heavy lifting happens in the engines (device) behind the
@@ -148,6 +154,43 @@ class FsmHandler(BaseHTTPRequestHandler):
                         "pool_bytes", "node_batch", "pipeline_depth",
                         "chunk", "recompute_chunk"))
                 self._send(200, json.dumps(report))
+            elif task == "faults":
+                # chaos lab: gated on the BOOT config (not a request
+                # param) so a production deployment cannot be armed by
+                # anyone who can reach the admin port
+                from spark_fsm_tpu.utils import faults
+
+                if not cfgmod.get_config().fault_injection:
+                    self._send(403, json.dumps({
+                        "status": "failure",
+                        "error": "fault injection disabled (set "
+                                 "fault_injection = true in the boot "
+                                 "config to open the chaos lab)"}))
+                    return
+                d = data or {}
+                action = d.get("action", "list")
+                if action == "arm":
+                    kw = {}
+                    for name, conv in (("nth", int), ("every", int),
+                                       ("p", float), ("seed", int),
+                                       ("times", int), ("delay_s", float)):
+                        if d.get(name) not in (None, ""):
+                            kw[name] = conv(d[name])
+                    if d.get("exc"):
+                        kw["exc"] = d["exc"]
+                    if d.get("match"):
+                        kw["match"] = d["match"]
+                    faults.arm(d["site"], **kw)
+                elif action == "disarm":
+                    faults.disarm(d.get("site"))
+                elif action != "list":
+                    raise ValueError(f"unknown faults action {action!r} "
+                                     "(arm/disarm/list)")
+                self._send(200, json.dumps({
+                    "armed": faults.armed(),
+                    "counters": faults.counters()}))
+            elif task == "health":
+                self._send(200, json.dumps(health_report(self.master)))
             elif task == "shapes":
                 # enumerated (last prewarm) vs runtime-recorded shape
                 # keys; "drift" lists observed geometries prewarm missed
@@ -207,6 +250,47 @@ def service_stats(master: Master) -> dict:
                     {"keys": report["keys"],
                      "total_wall_s": report["total_wall_s"],
                      "ts": report["ts"]}),
+    }
+
+
+def health_report(master: Master) -> dict:
+    """Per-subsystem recovery counters for ``/admin/health`` — the
+    runbook's one-stop read when a deployment misbehaves: what is armed
+    (should be NOTHING outside a chaos run), what retried, what timed
+    out, which breakers are open, and which stop paths leaked threads."""
+    from spark_fsm_tpu.service.devcache import (
+        cspade_engine_cache, spade_engine_cache, tsr_engine_cache)
+    from spark_fsm_tpu.streaming.consumer import consumer_health
+    from spark_fsm_tpu.utils import faults, watchdog
+    from spark_fsm_tpu.utils.retry import retry_counters
+
+    store = master.store
+    jobs = {}
+    for name in ("jobs_submitted", "jobs_finished", "jobs_failed",
+                 "jobs_retried", "stream_pushes", "stream_failures"):
+        try:
+            jobs[name] = int(store.get(f"fsm:metric:{name}") or 0)
+        except Exception:
+            # health must stay readable DURING a chaos drill: an armed
+            # store.get fault (or a down store) blanks the counter, it
+            # does not take down the one endpoint diagnosing it
+            jobs[name] = None
+    return {
+        "faults": {
+            "enabled": cfgmod.get_config().fault_injection,
+            "armed": faults.armed(),
+            "counters": faults.counters(),
+        },
+        "retry": retry_counters(),
+        "watchdog": {**watchdog.stats(),
+                     "slack": watchdog.configured_slack()},
+        "breakers": {
+            "store_cache": spade_engine_cache.breaker.snapshot(),
+            "cspade_cache": cspade_engine_cache.breaker.snapshot(),
+            "tsr_cache": tsr_engine_cache.breaker.snapshot(),
+        },
+        "consumers": consumer_health(),
+        "jobs": jobs,
     }
 
 

@@ -48,7 +48,10 @@ from typing import List, Optional
 import numpy as np
 
 from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.utils import faults
 from spark_fsm_tpu.utils.canonical import PatternResult
+from spark_fsm_tpu.utils.obs import log_event
+from spark_fsm_tpu.utils.retry import CircuitBreaker
 
 
 def db_fingerprint(db: SequenceDB) -> str:
@@ -86,11 +89,58 @@ class _EngineCacheBase:
     one copy of the checkout/release/insert logic means a race fixed
     here is fixed for both caches."""
 
+    # device-put circuit breaker: this many CONSECUTIVE failures of the
+    # cached device route open it (all mines take the uncached host-path
+    # wrapper), and after the cooldown ONE probe mine re-tries the cache
+    # (half-open) — success closes it, failure re-opens for another
+    # cooldown.  /admin/health surfaces each cache's breaker snapshot.
+    BREAKER_THRESHOLD = 3
+    BREAKER_COOLDOWN_S = 30.0
+
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self.stats = {"hits": 0, "misses": 0, "busy_misses": 0,
-                      "evictions": 0}
+                      "evictions": 0, "breaker_fallbacks": 0}
+        self.breaker = CircuitBreaker(type(self).__name__,
+                                      threshold=self.BREAKER_THRESHOLD,
+                                      cooldown_s=self.BREAKER_COOLDOWN_S)
+
+    def _mine_guarded(self, cached_fn, fallback_fn):
+        """Run the cached device route behind the circuit breaker.
+
+        A failure ANYWHERE in the cached route (fingerprint + checkout +
+        device build/insert — the ``devcache.put`` fault site guards its
+        entry) counts against the breaker and PROPAGATES: job-level
+        supervision (the Miner's retry) owns re-running it, exactly as
+        for an uncached mine — swallowing the error here would also
+        swallow deliberate aborts (a crashing checkpoint callback) and
+        double the device work on every real engine failure.  Once
+        ``BREAKER_THRESHOLD`` consecutive failures open the breaker,
+        every call takes ``fallback_fn`` — the plain uncached host-path
+        wrapper — outright, paying no device-put cost on a failing
+        cache layer, until the post-cooldown half-open probe closes it
+        again."""
+        if not self.breaker.allow():
+            with self._lock:
+                self.stats["breaker_fallbacks"] += 1
+            return fallback_fn()
+        try:
+            faults.fault_site("devcache.put", cache=type(self).__name__)
+            res = cached_fn()
+        except ValueError:
+            # deterministic request/validation errors (the Miner's own
+            # no-retry class): re-running them cannot succeed and they
+            # say nothing about the cache's device seam — one bad job
+            # must not open the breaker for healthy traffic
+            raise
+        except Exception as exc:
+            self.breaker.failure()
+            log_event("devcache_fault", cache=type(self).__name__,
+                      error=f"{type(exc).__name__}: {exc}")
+            raise
+        self.breaker.success()
+        return res
 
     def _checkout(self, key) -> Optional[_Entry]:
         with self._lock:
@@ -235,13 +285,26 @@ class SpadeEngineCache(_HbmBudgetCache):
         """
         from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
 
-        if fused not in ("auto", "queue") or kwargs:
+        def fallback():
             return mine_spade_tpu(
                 db, minsup_abs, mesh=mesh, stats_out=stats_out,
                 max_pattern_itemsets=max_pattern_itemsets,
                 shape_buckets=shape_buckets, fused=fused,
                 checkpoint=checkpoint, **kwargs)
 
+        if fused not in ("auto", "queue") or kwargs:
+            return fallback()
+        return self._mine_guarded(
+            lambda: self._mine_cached(
+                db, minsup_abs, mesh=mesh, stats_out=stats_out,
+                max_pattern_itemsets=max_pattern_itemsets,
+                shape_buckets=shape_buckets, fused=fused,
+                checkpoint=checkpoint),
+            fallback)
+
+    def _mine_cached(self, db, minsup_abs, *, mesh, stats_out,
+                     max_pattern_itemsets, shape_buckets, fused,
+                     checkpoint):
         key = (db_fingerprint(db), int(minsup_abs), mesh,
                max_pattern_itemsets, bool(shape_buckets), fused)
         entry = self._checkout(key)
@@ -400,9 +463,7 @@ class CSpadeEngineCache(_HbmBudgetCache):
              **kwargs) -> List[PatternResult]:
         from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
 
-        if kwargs or checkpoint is not None:
-            # explicit engine knobs the cache does not key, or a
-            # checkpointed job: uncached wrapper
+        def fallback():
             return mine_cspade_tpu(
                 db, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
                 mesh=mesh, stats_out=stats_out,
@@ -410,6 +471,20 @@ class CSpadeEngineCache(_HbmBudgetCache):
                 shape_buckets=shape_buckets, checkpoint=checkpoint,
                 **kwargs)
 
+        if kwargs or checkpoint is not None:
+            # explicit engine knobs the cache does not key, or a
+            # checkpointed job: uncached wrapper
+            return fallback()
+        return self._mine_guarded(
+            lambda: self._mine_cached(
+                db, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
+                mesh=mesh, stats_out=stats_out,
+                max_pattern_itemsets=max_pattern_itemsets,
+                shape_buckets=shape_buckets),
+            fallback)
+
+    def _mine_cached(self, db, minsup_abs, *, maxgap, maxwindow, mesh,
+                     stats_out, max_pattern_itemsets, shape_buckets):
         key = (db_fingerprint(db), int(minsup_abs), maxgap, maxwindow,
                mesh, max_pattern_itemsets, bool(shape_buckets))
         entry = self._checkout(key)
@@ -462,6 +537,18 @@ class TsrEngineCache(_EngineCacheBase):
     def mine(self, db: SequenceDB, k: int, minconf: float, *,
              max_side=None, mesh=None, stats_out: Optional[dict] = None,
              **kwargs) -> List:
+        from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+        return self._mine_guarded(
+            lambda: self._mine_cached(db, k, minconf, max_side=max_side,
+                                      mesh=mesh, stats_out=stats_out,
+                                      **kwargs),
+            lambda: mine_tsr_tpu(db, k, minconf, max_side=max_side,
+                                 mesh=mesh, stats_out=stats_out, **kwargs))
+
+    def _mine_cached(self, db: SequenceDB, k: int, minconf: float, *,
+                     max_side=None, mesh=None,
+                     stats_out: Optional[dict] = None, **kwargs) -> List:
         from spark_fsm_tpu.data.vertical import build_vertical
         from spark_fsm_tpu.models.tsr import TsrTPU
 

@@ -34,7 +34,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from spark_fsm_tpu.utils import shapes
+from spark_fsm_tpu.utils import faults, shapes
 from spark_fsm_tpu.utils.jitcache import compile_counts, enable_compile_counter
 from spark_fsm_tpu.utils.obs import log_event
 
@@ -399,6 +399,11 @@ def run(spec: shapes.WorkloadSpec, *, mesh=None,
         t0 = time.monotonic()
         err = None
         try:
+            # chaos seam: an injected compile failure here proves the
+            # per-key isolation below (one bad key must not take down
+            # boot or the other keys' warms)
+            faults.fault_site("prewarm.compile", shape_key=key,
+                              kind=t["kind"])
             if t["kind"] == "classic":
                 _warm_classic(t, mesh, eng_sub)
             elif t["kind"] == "queue":

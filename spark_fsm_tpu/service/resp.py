@@ -165,6 +165,9 @@ class RespClient:
         assert isinstance(reply, int)
         return reply
 
+    def ltrim(self, key: str, start: int, stop: int) -> None:
+        self.command("LTRIM", key, start, stop)
+
     def delete(self, key: str) -> int:
         reply = self.command("DEL", key)
         assert isinstance(reply, int)

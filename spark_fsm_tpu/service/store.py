@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from spark_fsm_tpu.utils import faults
+
 
 class ResultStore:
     """Thread-safe in-process store with Redis-like key semantics."""
@@ -33,16 +35,23 @@ class ResultStore:
         self._lists: Dict[str, List[str]] = {}
 
     # -- generic ops (Redis GET/SET/RPUSH/LRANGE equivalents) --------------
+    # The three primary I/O verbs carry fault-site guards (utils/faults):
+    # the guard raises BEFORE the mutation, so an injected failure models
+    # an I/O error with nothing applied — the retry policies layered on
+    # top (StoreCheckpoint) re-run the whole verb safely.
 
     def set(self, key: str, value: str) -> None:
+        faults.fault_site("store.set", key=key)
         with self._lock:
             self._kv[key] = value
 
     def get(self, key: str) -> Optional[str]:
+        faults.fault_site("store.get", key=key)
         with self._lock:
             return self._kv.get(key)
 
     def rpush(self, key: str, value: str) -> None:
+        faults.fault_site("store.rpush", key=key)
         with self._lock:
             self._lists.setdefault(key, []).append(value)
 
@@ -58,6 +67,14 @@ class ResultStore:
     def llen(self, key: str) -> int:
         with self._lock:
             return len(self._lists.get(key, ()))
+
+    def ltrim(self, key: str, keep: int) -> None:
+        """Keep only the FIRST ``keep`` entries of a list (Redis LTRIM
+        key 0 keep-1) — the checkpoint torn-tail heal primitive."""
+        with self._lock:
+            lst = self._lists.get(key)
+            if lst is not None:
+                del lst[max(0, keep):]
 
     def delete(self, key: str) -> None:
         with self._lock:
@@ -147,12 +164,15 @@ class RedisResultStore(ResultStore):
         self._r.ping()  # fail fast at boot, not on first job
 
     def set(self, key: str, value: str) -> None:
+        faults.fault_site("store.set", key=key)
         self._r.set(key, value)
 
     def get(self, key: str) -> Optional[str]:
+        faults.fault_site("store.get", key=key)
         return self._r.get(key)
 
     def rpush(self, key: str, value: str) -> None:
+        faults.fault_site("store.rpush", key=key)
         self._r.rpush(key, value)
 
     def lrange(self, key: str) -> List[str]:
@@ -163,6 +183,12 @@ class RedisResultStore(ResultStore):
 
     def llen(self, key: str) -> int:
         return self._r.llen(key)
+
+    def ltrim(self, key: str, keep: int) -> None:
+        if keep <= 0:
+            self._r.delete(key)
+        else:
+            self._r.ltrim(key, 0, keep - 1)
 
     def delete(self, key: str) -> None:
         self._r.delete(key)

@@ -27,11 +27,18 @@ Semantics:
 - ``StopConsumer`` raised by fetch ends the loop cleanly (the
   end-of-partition signal); ``stop()`` ends it from another thread.
 - fetch/sink exceptions do NOT kill the loop by default: they are
-  counted, reported through ``on_error``, and polling continues after
-  the interval — a flaky broker must not tear down the mining service
-  (the reference's supervision contract, SURVEY.md sec 5 failure row).
+  counted, reported through ``on_error``, and polling continues after a
+  BOUNDED EXPONENTIAL BACKOFF with seeded jitter (the shared
+  utils/retry.py policy: ``poll_interval_s`` doubling per consecutive
+  error up to ``max_backoff_s``) — a flaky broker must not tear down
+  the mining service (the reference's supervision contract, SURVEY.md
+  sec 5 failure row) and must not be hammered at full poll rate either.
   ``max_consecutive_errors`` bounds that patience; crossing it stops
   the loop with ``stats["stopped"] = "errors"``.
+- ``stop()`` that fails to join its worker thread counts the leak
+  (``stats["leaked_threads"]`` + the module-wide :func:`consumer_health`
+  counter ``/admin/health`` reports) and logs it, instead of returning
+  silently with a zombie poll loop still attached to the broker.
 """
 
 from __future__ import annotations
@@ -41,8 +48,26 @@ import time
 from typing import Callable, Optional
 
 from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.utils.obs import log_event
+from spark_fsm_tpu.utils.retry import RetryPolicy
 
 FetchFn = Callable[[], Optional[SequenceDB]]
+
+_health_lock = threading.Lock()
+_health = {"leaked_threads": 0}
+
+
+def consumer_health() -> dict:
+    """Process-wide consumer counters for ``/admin/health`` (consumers
+    are free-standing objects, so per-instance stats alone would be
+    invisible to the service's health surface)."""
+    with _health_lock:
+        return dict(_health)
+
+
+def _count_leak() -> None:
+    with _health_lock:
+        _health["leaked_threads"] += 1
 
 
 class StopConsumer(Exception):
@@ -68,6 +93,7 @@ class PollConsumer:
     def __init__(self, fetch: FetchFn, sink: Callable, *,
                  poll_interval_s: float = 1.0,
                  max_consecutive_errors: Optional[int] = None,
+                 max_backoff_s: float = 30.0,
                  on_result: Optional[Callable] = None,
                  on_error: Optional[Callable] = None) -> None:
         if poll_interval_s < 0:
@@ -80,13 +106,20 @@ class PollConsumer:
         self._sink = sink
         self.poll_interval_s = float(poll_interval_s)
         self.max_consecutive_errors = max_consecutive_errors
+        self.max_backoff_s = float(max_backoff_s)
+        # the shared I/O backoff policy, used only for its seeded
+        # delay_s schedule — the retry LOOP here is the poll loop itself
+        self._backoff = RetryPolicy(base_s=self.poll_interval_s,
+                                    max_s=self.max_backoff_s, seed=0)
         self._on_result = on_result
         self._on_error = on_error
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._leak_counted: Optional[threading.Thread] = None
         self._consecutive_errors = 0
         self.stats = {"polls": 0, "idle_polls": 0, "batches": 0,
-                      "sequences": 0, "errors": 0, "stopped": None}
+                      "sequences": 0, "errors": 0, "backoff_waits": 0,
+                      "leaked_threads": 0, "stopped": None}
 
     # ------------------------------------------------------------- polling
 
@@ -164,9 +197,15 @@ class PollConsumer:
                 self.stats["stopped"] = "errors"
                 break
             if not consumed and self.poll_interval_s:
-                # idle or errored: wait out the interval, but wake
+                # idle: wait out the interval; errored: exponential
+                # backoff (interval doubling per consecutive error, up
+                # to max_backoff_s, seeded jitter) — either way waking
                 # immediately on stop()
-                self._stop.wait(self.poll_interval_s)
+                wait = self.poll_interval_s
+                if self._consecutive_errors:
+                    wait = self._backoff.delay_s(self._consecutive_errors)
+                    self.stats["backoff_waits"] += 1
+                self._stop.wait(wait)
         else:
             self.stats["stopped"] = "stop"
         return self.stats
@@ -185,8 +224,21 @@ class PollConsumer:
         return self
 
     def stop(self, join_timeout_s: float = 10.0) -> None:
-        """Signal the loop to end; joins the thread when one is running."""
+        """Signal the loop to end; joins the thread when one is running.
+
+        A worker that outruns the join deadline (a sink wedged in a
+        device call, a fetch stuck in a socket) is counted and logged as
+        a LEAKED thread — the zombie keeps its broker connection and
+        must show up in ``/admin/health``, not vanish silently."""
         self._stop.set()
         t = self._thread
         if t is not None and t.is_alive():
             t.join(join_timeout_s)
+            # count each wedged worker ONCE: a second stop() on the same
+            # still-alive thread must not inflate the zombie count
+            if t.is_alive() and t is not self._leak_counted:
+                self._leak_counted = t
+                self.stats["leaked_threads"] += 1
+                _count_leak()
+                log_event("consumer_thread_leaked", thread=t.name,
+                          join_timeout_s=join_timeout_s)

@@ -34,6 +34,15 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from spark_fsm_tpu.data.spmf import SequenceDB, parse_spmf
+from spark_fsm_tpu.utils import faults
+
+# dead-letter ring: the last N undecodable payloads are kept in stats
+# (truncated, with partition/offset when the record exposes one) so a
+# poisoned topic is DIAGNOSABLE from /admin or the consumer's stats —
+# a bare bad_records count tells an operator something is wrong but not
+# what, which producer, or where to replay from
+DEAD_LETTER_RING = 16
+DEAD_LETTER_PAYLOAD_CHARS = 160
 
 
 class KafkaFetch:
@@ -64,16 +73,35 @@ class KafkaFetch:
         self._decode = decode or (lambda b: b.decode("utf-8"))
         self._parse = parse or parse_spmf
         self.on_bad = on_bad
-        self.stats = {"polls": 0, "records": 0, "bad_records": 0}
+        self.stats = {"polls": 0, "records": 0, "bad_records": 0,
+                      "dead_letters": []}
+
+    def _dead_letter(self, partition, rec, exc: Exception) -> None:
+        """Ring-buffer the undecodable record (both on_bad modes: a
+        raised poison message is just as worth diagnosing as a skipped
+        one).  Payloads are truncated — the ring is for diagnosis, not
+        for replaying multi-MB blobs through a stats endpoint."""
+        payload = repr(getattr(rec, "value", None))
+        if len(payload) > DEAD_LETTER_PAYLOAD_CHARS:
+            payload = payload[:DEAD_LETTER_PAYLOAD_CHARS] + "...(truncated)"
+        ring = self.stats["dead_letters"]
+        ring.append({
+            "partition": str(partition),
+            "offset": getattr(rec, "offset", None),
+            "payload": payload,
+            "error": f"{type(exc).__name__}: {exc}",
+        })
+        del ring[:-DEAD_LETTER_RING]
 
     def __call__(self) -> Optional[SequenceDB]:
         self.stats["polls"] += 1
+        faults.fault_site("kafka.poll", timeout_ms=str(self.timeout_ms))
         recs = self._consumer.poll(timeout_ms=self.timeout_ms)
         if not recs:
             return None
         batch: SequenceDB = []
         n_rec = 0
-        for _, records in recs.items():
+        for partition, records in recs.items():
             for rec in records:
                 n_rec += 1
                 try:
@@ -82,7 +110,8 @@ class KafkaFetch:
                             if isinstance(value, (bytes, bytearray))
                             else value)
                     batch.extend(self._parse(text))
-                except Exception:
+                except Exception as exc:
+                    self._dead_letter(partition, rec, exc)
                     if self.on_bad == "raise":
                         raise
                     self.stats["bad_records"] += 1

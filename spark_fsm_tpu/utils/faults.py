@@ -1,0 +1,231 @@
+"""Deterministic fault injection — the chaos seam every recovery path
+is proven against.
+
+The reference inherits Spark's lineage re-execution and actor
+supervision; this rebuild supplies that layer itself (Miner retry,
+StoreCheckpoint, queue->classic downgrades), and none of it counts as
+*proven* until an injected failure exercises it.  This module is a
+process-global registry of NAMED fault sites — every place the
+framework touches a device, a store, a broker, or a compile pipeline
+declares one — with seeded, scriptable triggers, so a test (or an
+operator via ``/admin/faults``) can make exactly one dispatch hang,
+every third store write fail, or a device launch OOM, deterministically.
+
+Contract:
+
+- ``fault_site(name, **ctx)`` is woven into the REAL call sites
+  (ops/ragged_batch consumers, models/tsr, models/spade_queue,
+  service/{actors,store,devcache,prewarm}, streaming/{kafka,consumer}).
+  With nothing armed it is a single module-global read — the hardening
+  layer costs nothing on the happy path.
+- Sites must come from :data:`KNOWN_SITES`: an unknown name is a typo
+  that would silently never fire, so ``arm`` refuses it.
+- Triggers are deterministic: nth-call, every-k, or seeded probability.
+  ``delay_s`` simulates a HANG (the call sleeps before returning or
+  raising — what the dispatch watchdog exists to bound); ``exc`` picks
+  the raised type (``"oom"`` raises :class:`InjectedOom`, whose text
+  matches the engines' RESOURCE_EXHAUSTED detection; ``"none"`` only
+  delays).
+- ``match`` restricts a spec to calls whose context carries the given
+  substring (e.g. only ``store.set`` calls for ``fsm:frontier:`` keys),
+  so one site guard can serve many callers without collateral damage.
+
+tests/conftest.py asserts the registry is DISARMED at session start and
+end, so injections can never leak between tests or into a live suite.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class FaultInjected(RuntimeError):
+    """Raised by :func:`fault_site` when an armed trigger fires."""
+
+
+class InjectedOom(FaultInjected):
+    """Injected device OOM.  The message carries RESOURCE_EXHAUSTED so
+    the engines' substring-based OOM detection (models/tsr._is_oom)
+    treats it exactly like a real XLA allocation failure."""
+
+    def __init__(self, site: str):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM at fault site "
+            f"{site!r}")
+
+
+# The registered fault sites.  Adding a call-site guard for a NEW name
+# requires listing it here (arm refuses unknowns) — and tests/test_chaos.py
+# asserts it sweeps this exact set, so a new site cannot ship untested.
+KNOWN_SITES = (
+    "device.dispatch",   # device launch/readback (TSR ragged + queue)
+    "device.oom",        # allocation failure on a device launch
+    "store.get",         # result-store reads
+    "store.set",         # result-store writes
+    "store.rpush",       # result-store list appends (checkpoint deltas)
+    "kafka.poll",        # broker poll (streaming/kafka.KafkaFetch)
+    "checkpoint.save",   # whole-snapshot save (service/actors)
+    "prewarm.compile",   # per-shape-key AOT compile (service/prewarm)
+    "devcache.put",      # engine-cache device build/insert (service/devcache)
+)
+
+_EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}
+
+
+class _Spec:
+    __slots__ = ("site", "nth", "every", "p", "seed", "times", "delay_s",
+                 "exc", "match", "rng", "calls", "injected")
+
+    def __init__(self, site, nth, every, p, seed, times, delay_s, exc,
+                 match):
+        self.site = site
+        self.nth = nth
+        self.every = every
+        self.p = p
+        self.seed = seed
+        self.times = times
+        self.delay_s = delay_s
+        self.exc = exc
+        self.match = match
+        self.rng = random.Random(seed)
+        self.calls = 0
+        self.injected = 0
+
+    def describe(self) -> dict:
+        out = {"calls": self.calls, "injected": self.injected,
+               "exc": next((k for k, v in _EXC_BY_NAME.items()
+                            if v is self.exc), getattr(self.exc, "__name__",
+                                                       str(self.exc)))}
+        for k in ("nth", "every", "p", "seed", "times", "delay_s", "match"):
+            v = getattr(self, k)
+            if v not in (None, 0, 0.0):
+                out[k] = v
+        return out
+
+
+_lock = threading.Lock()
+_armed: Dict[str, _Spec] = {}
+# lifetime per-site counters (survive disarm — /admin/health reads them)
+_counters: Dict[str, Dict[str, int]] = {}
+_active = False  # fast-path flag: fault_site returns on one global read
+
+
+def arm(site: str, *, nth: Optional[int] = None, every: Optional[int] = None,
+        p: Optional[float] = None, seed: int = 0,
+        times: Optional[int] = None, delay_s: float = 0.0,
+        exc="fault", match: Optional[str] = None) -> None:
+    """Arm ``site`` with one trigger (re-arming replaces the spec).
+
+    Exactly one of ``nth`` (fire on the nth matching call), ``every``
+    (fire on every k-th matching call), ``p`` (fire with probability p,
+    seeded — deterministic per arm) must be given.  ``times`` bounds the
+    total injections (default unbounded).  ``delay_s`` sleeps before
+    acting (a hang); ``exc`` is "fault"/"oom"/"none" or an Exception
+    subclass.  ``match`` restricts to calls whose context contains it.
+    """
+    global _active
+    if site not in KNOWN_SITES:
+        raise ValueError(f"unknown fault site {site!r} "
+                         f"(known: {sorted(KNOWN_SITES)})")
+    if sum(x is not None for x in (nth, every, p)) != 1:
+        raise ValueError("arm needs exactly one of nth/every/p")
+    if nth is not None and nth < 1:
+        raise ValueError(f"nth must be >= 1 (got {nth}; calls are 1-based)")
+    if every is not None and every < 1:
+        raise ValueError(f"every must be >= 1 (got {every})")
+    if p is not None and not 0.0 < p <= 1.0:
+        raise ValueError(f"p must be in (0, 1] (got {p})")
+    if exc == "fault" and site == "device.oom":
+        exc = "oom"  # the OOM site injects OOM semantics by default
+    if isinstance(exc, str):
+        if exc not in _EXC_BY_NAME:
+            raise ValueError(f"exc must be one of {sorted(_EXC_BY_NAME)} "
+                             f"or an Exception subclass, got {exc!r}")
+        exc = _EXC_BY_NAME[exc]
+    if exc is None and not delay_s:
+        raise ValueError("exc='none' needs delay_s (an injection that "
+                         "neither raises nor delays is a no-op)")
+    with _lock:
+        _armed[site] = _Spec(site, nth, every, p, int(seed), times,
+                             float(delay_s), exc, match)
+        _active = True
+
+
+def disarm(site: Optional[str] = None) -> list:
+    """Disarm one site (or all when None); returns the disarmed names."""
+    global _active
+    with _lock:
+        names = [site] if site is not None else list(_armed)
+        out = [n for n in names if _armed.pop(n, None) is not None]
+        _active = bool(_armed)
+        return out
+
+
+def armed() -> Dict[str, dict]:
+    """Snapshot of armed sites -> spec description (JSON-able)."""
+    with _lock:
+        return {s: spec.describe() for s, spec in _armed.items()}
+
+
+def counters() -> Dict[str, Dict[str, int]]:
+    """Lifetime per-site call/injection counters (survive disarm)."""
+    with _lock:
+        return {s: dict(c) for s, c in _counters.items()}
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counters.clear()
+
+
+def fault_site(site: str, **ctx) -> None:
+    """The guard woven into real call sites; raises/delays when armed.
+
+    Context values are matched as substrings against the spec's
+    ``match`` (all calls match when unset).  Counting happens only while
+    the site is armed — the disarmed path is one global read.
+    """
+    if not _active:
+        return
+    with _lock:
+        spec = _armed.get(site)
+        if spec is None:
+            return
+        if spec.match is not None and not any(
+                spec.match in v for v in ctx.values() if isinstance(v, str)):
+            return
+        spec.calls += 1
+        c = _counters.setdefault(site, {"calls": 0, "injected": 0})
+        c["calls"] += 1
+        fire = ((spec.nth is not None and spec.calls == spec.nth)
+                or (spec.every is not None
+                    and spec.calls % spec.every == 0)
+                or (spec.p is not None and spec.rng.random() < spec.p))
+        if not fire or (spec.times is not None
+                        and spec.injected >= spec.times):
+            return
+        spec.injected += 1
+        c["injected"] += 1
+        delay_s, exc = spec.delay_s, spec.exc
+    # sleep OUTSIDE the lock: a simulated hang must not block every
+    # other site's bookkeeping (or the watchdog's own log path)
+    if delay_s:
+        time.sleep(delay_s)
+    if exc is not None:
+        raise exc(site) if exc is InjectedOom else exc(
+            f"injected fault at site {site!r} (ctx {ctx!r})")
+
+
+@contextmanager
+def injected(site: str, **kwargs):
+    """Scoped arm/disarm for tests: the site is disarmed on exit even
+    when the body raises — the no-leak contract conftest enforces."""
+    arm(site, **kwargs)
+    try:
+        yield
+    finally:
+        disarm(site)

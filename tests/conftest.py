@@ -64,3 +64,25 @@ jax.config.update("jax_platforms", "cpu")
 from spark_fsm_tpu.utils.jitcache import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()  # persistent XLA cache: repeat suite runs skip compiles
+
+
+def _assert_faults_disarmed(when: str) -> None:
+    """The chaos suite's no-leak contract: an injection left armed would
+    silently fail (or flake) every LATER test that touches its site —
+    enforce a disarmed registry at both session edges so a leak names
+    the offending site instead of poisoning unrelated tests."""
+    from spark_fsm_tpu.utils import faults
+
+    leftover = faults.armed()
+    assert not leftover, (
+        f"fault-injection registry armed at session {when}: "
+        f"{sorted(leftover)} — a chaos test leaked its injection "
+        f"(use faults.injected(...) or a try/finally disarm)")
+
+
+def pytest_sessionstart(session):
+    _assert_faults_disarmed("start")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _assert_faults_disarmed("end")

@@ -1,0 +1,599 @@
+"""Chaos suite: injected failure at EVERY registered fault site.
+
+The acceptance contract (ISSUE 3): for each site in
+``faults.KNOWN_SITES``, injection must produce either a clean
+retry/degrade whose results MATCH the fault-free run (parity) or a
+clean ``failure`` status — never a hang (scenarios run under a hard
+deadline via the watchdog itself), never a torn snapshot accepted on
+resume (tests/test_checkpoint.py covers the crash-timing half), never a
+silent wrong answer.  ``test_every_registered_site_is_covered`` pins
+the sweep to the registry, so adding a fault site without a chaos
+scenario fails CI.
+
+Deterministic: nth/every triggers plus the pinned seed
+(``SPARKFSM_CHAOS_SEED``, exported by scripts/chaos_smoke.sh) for
+probability-based specs.  Every scenario disarms via
+``faults.injected`` / the autouse fixture — conftest asserts the
+registry is clean at both session edges.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.data.spmf import format_spmf, parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+from spark_fsm_tpu.models.tsr import TsrTPU
+from spark_fsm_tpu.ops import ragged_batch as RB
+from spark_fsm_tpu.service.actors import Master, StoreCheckpoint
+from spark_fsm_tpu.service.devcache import (
+    SpadeEngineCache, cspade_engine_cache, spade_engine_cache,
+    tsr_engine_cache)
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.streaming.consumer import PollConsumer, consumer_health
+from spark_fsm_tpu.streaming.kafka import KafkaFetch
+from spark_fsm_tpu.utils import faults, watchdog
+from spark_fsm_tpu.utils.canonical import (diff_patterns, patterns_text,
+                                           rules_text)
+from spark_fsm_tpu.utils.retry import (CircuitBreaker, RetryPolicy,
+                                       retry_counters)
+
+CHAOS_SEED = int(os.environ.get("SPARKFSM_CHAOS_SEED", "1299827"))
+SCENARIO_DEADLINE_S = 300.0  # suite-enforced no-hang bound
+
+
+def _bounded(fn):
+    """Run a scenario under a hard deadline: a hang is a FAILURE with a
+    named site, never a wedged CI job (dogfoods the watchdog runner)."""
+    return watchdog.run_with_deadline(fn, SCENARIO_DEADLINE_S,
+                                      site="chaos.suite")
+
+
+# site -> scenario test names; the sweep test pins this to KNOWN_SITES
+COVERED: dict = {}
+
+
+def covers(*sites):
+    def deco(fn):
+        for s in sites:
+            COVERED.setdefault(s, []).append(fn.__name__)
+        return fn
+    return deco
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    """No injection, no watchdog policy, and closed breakers leak in or
+    out of any scenario."""
+    faults.disarm()
+    watchdog.configure(slack=None)
+    for cache in (spade_engine_cache, cspade_engine_cache,
+                  tsr_engine_cache):
+        cache.breaker.success()  # reset consecutive-failure streaks
+    yield
+    faults.disarm()
+    watchdog.configure(slack=None)
+
+
+def _db():
+    return synthetic_db(seed=17, n_sequences=120, n_items=10,
+                        mean_itemsets=3.0, mean_itemset_size=1.3)
+
+
+def _rule_db():
+    return synthetic_db(seed=23, n_sequences=40, n_items=7,
+                        mean_itemsets=3.0, mean_itemset_size=1.2)
+
+
+def _run_train(store, data, timeout=120.0):
+    """Submit one train job through the real Master; returns (uid,
+    terminal status) — polling bounded, so a hung job fails loudly."""
+    master = Master(store=store)
+    try:
+        resp = master.handle(ServiceRequest("fsm", "train", dict(data)))
+        assert resp.status != "failure", resp.data
+        uid = resp.data["uid"]
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = store.status(uid)
+            if st in ("finished", "failure"):
+                return uid, st
+            time.sleep(0.02)
+        raise TimeoutError(f"job {uid} reached no terminal status")
+    finally:
+        master.shutdown()
+
+
+def _stored_patterns(store, uid):
+    from spark_fsm_tpu.service.model import deserialize_patterns
+
+    return deserialize_patterns(store.patterns(uid))
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_every_registered_site_is_covered():
+    """The sweep IS the registry: a new fault site must ship a chaos
+    scenario or this fails."""
+    assert set(COVERED) == set(faults.KNOWN_SITES), (
+        f"uncovered: {set(faults.KNOWN_SITES) - set(COVERED)}, "
+        f"unknown: {set(COVERED) - set(faults.KNOWN_SITES)}")
+
+
+def test_registry_validates_arms():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.arm("store.flush", nth=1)
+    with pytest.raises(ValueError, match="exactly one"):
+        faults.arm("store.set", nth=1, every=2)
+    with pytest.raises(ValueError, match="delay_s"):
+        faults.arm("store.set", nth=1, exc="none")
+    assert faults.armed() == {}
+
+
+def test_trigger_shapes_are_deterministic():
+    calls = []
+    with faults.injected("store.set", every=2, match="chaos-trigger"):
+        for i in range(6):
+            try:
+                faults.fault_site("store.set", key=f"chaos-trigger-{i}")
+                calls.append("ok")
+            except faults.FaultInjected:
+                calls.append("boom")
+    assert calls == ["ok", "boom", "ok", "boom", "ok", "boom"]
+    # seeded probability: two runs with the same seed fire identically
+    outcomes = []
+    for _ in range(2):
+        hits = []
+        with faults.injected("store.set", p=0.5, seed=CHAOS_SEED,
+                             match="chaos-trigger"):
+            for i in range(16):
+                try:
+                    faults.fault_site("store.set", key=f"chaos-trigger-{i}")
+                    hits.append(0)
+                except faults.FaultInjected:
+                    hits.append(1)
+        outcomes.append(hits)
+    assert outcomes[0] == outcomes[1] and sum(outcomes[0]) > 0
+
+
+# ------------------------------------------------------------- store I/O
+
+
+@covers("store.set")
+def test_store_set_fault_retried_during_checkpointed_job():
+    """A transient store failure on a frontier write is absorbed by the
+    checkpoint's bounded-backoff retry — the job finishes with parity,
+    no failure status, and the retry is counted."""
+    db = _db()
+    store = ResultStore()
+    with faults.injected("store.set", nth=1, match="fsm:frontier:"):
+        uid, status = _bounded(lambda: _run_train(store, {
+            "algorithm": "SPADE_TPU", "source": "INLINE",
+            "sequences": format_spmf(db), "support": "0.1",
+            "checkpoint": "1", "checkpoint_every_s": "0"}))
+    assert status == "finished", store.get(f"fsm:error:{uid}")
+    want = mine_spade(db, abs_minsup(0.1, len(db)))
+    got = _stored_patterns(store, uid)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+    assert retry_counters().get("store.checkpoint", {}).get("retries", 0) >= 1
+
+
+@covers("store.rpush")
+def test_store_rpush_fault_retried_mid_mine():
+    """An injected failure on a checkpoint DELTA append retries inside
+    save(); the mine neither fails nor loses results."""
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "chaos-rpush", every_s=0.0)
+    eng = SpadeTPU(build_vertical(db, min_item_support=minsup), minsup,
+                   node_batch=4, pipeline_depth=2, pool_bytes=32 << 20)
+    with faults.injected("store.rpush", nth=1,
+                         match="fsm:frontier:results:chaos-rpush"):
+        got = _bounded(lambda: eng.mine(checkpoint_cb=ckpt.save,
+                                        checkpoint_every_s=0.0))
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+    state = ckpt.load()
+    assert state is not None  # the healed/retried snapshot still loads
+    assert retry_counters()["store.checkpoint"]["retries"] >= 1
+
+
+@covers("store.get")
+def test_store_get_fault_retried_on_resume_load():
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "chaos-get")
+    ckpt.save({"version": 1, "stack": [{"steps": [[0, 1]], "s": [], "i": []}],
+               "results_done": 0, "results": [[[[1]], 3]]})
+    with faults.injected("store.get", nth=1, match="fsm:frontier:chaos-get"):
+        state = StoreCheckpoint(store, "chaos-get").load()
+    assert state is not None and state["results"] == [[[[1]], 3]]
+    assert retry_counters()["store.checkpoint"]["retries"] >= 1
+
+
+# ---------------------------------------------------------- checkpoint.save
+
+
+@covers("checkpoint.save")
+def test_checkpoint_save_fault_job_still_finishes_with_parity():
+    """A whole-save failure aborts that mine attempt; supervision (the
+    devcache host-path fallback or the Miner retry) re-runs it and the
+    job still lands 'finished' with the exact pattern set."""
+    db = _db()
+    store = ResultStore()
+    with faults.injected("checkpoint.save", nth=1):
+        uid, status = _bounded(lambda: _run_train(store, {
+            "algorithm": "SPADE_TPU", "source": "INLINE",
+            "sequences": format_spmf(db), "support": "0.1",
+            "checkpoint": "1", "checkpoint_every_s": "0", "retries": "2"}))
+    assert status == "finished", store.get(f"fsm:error:{uid}")
+    want = mine_spade(db, abs_minsup(0.1, len(db)))
+    got = _stored_patterns(store, uid)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+# -------------------------------------------------------------- kafka.poll
+
+
+@covers("kafka.poll")
+class TestKafkaPollFaults:
+    class _Rec:
+        def __init__(self, value):
+            self.value = value
+
+    class _Fake:
+        def __init__(self, polls):
+            self._polls = list(polls)
+
+        def poll(self, timeout_ms=None):
+            return self._polls.pop(0) if self._polls else {}
+
+    def test_flaky_poll_backs_off_and_loses_nothing(self):
+        dbs = [synthetic_db(seed=s, n_sequences=12, n_items=6,
+                            mean_itemsets=2.0) for s in (1, 2, 3)]
+        polls = [{"tp0": [self._Rec(format_spmf(db).encode())]}
+                 for db in dbs]
+        fetch = KafkaFetch(self._Fake(polls))
+        got = []
+        pc = PollConsumer(fetch, got.append, poll_interval_s=0)
+        with faults.injected("kafka.poll", every=2):
+            stats = _bounded(lambda: pc.run(max_polls=10))
+        # every batch arrived exactly once, in order, despite the faults
+        assert [len(b) for b in got] == [len(db) for db in dbs]
+        assert got == dbs
+        assert stats["errors"] >= 2  # the injected polls were counted
+        assert stats["stopped"] == "max_polls"
+
+
+# ---------------------------------------------------------- device.dispatch
+
+
+@covers("device.dispatch")
+def test_dispatch_fault_degrades_kernel_to_jnp_with_parity():
+    """A failed kernel launch marks only its km geometry bad; the lanes
+    re-pool onto the jnp path and the rule set is byte-identical."""
+    db = _rule_db()
+    want = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                  max_side=2, use_pallas=True).mine()
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                 max_side=2, use_pallas=True)
+    with faults.injected("device.dispatch", nth=1, match="kernel"):
+        got = _bounded(eng.mine)
+    assert rules_text(got) == rules_text(want)
+    assert any(k.startswith("pallas_fallback_km") for k in eng.stats), (
+        eng.stats)
+
+
+@covers("device.dispatch")
+def test_dispatch_hang_fails_launch_via_watchdog():
+    """A HUNG readback (injected delay, no exception) must not wedge the
+    worker: the watchdog deadline — derived from the packer's own cost
+    model x slack — fails the launch with WatchdogTimeout (the device is
+    suspect, so the engine does NOT keep dispatching on it), supervision
+    re-runs the job, and the retry returns the exact rules."""
+    db = _rule_db()
+    want = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                  max_side=2, use_pallas=True).mine()
+    wd0 = watchdog.stats()
+    watchdog.configure(slack=100.0, floor_s=0.5)
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                 max_side=2, use_pallas=True)
+    # the hang is far longer than any legitimate work this mine does,
+    # so the wall bound below proves the watchdog cut it off rather
+    # than waiting it out
+    with faults.injected("device.dispatch", nth=1, match="readback",
+                         delay_s=90.0, exc="none"):
+        t0 = time.monotonic()
+        with pytest.raises(watchdog.WatchdogTimeout):
+            _bounded(eng.mine)
+        wall = time.monotonic() - t0
+    wd = watchdog.stats()
+    assert wd["timeouts"] >= wd0["timeouts"] + 1
+    assert wd["leaked_threads"] >= wd0["leaked_threads"] + 1
+    assert wall < 60.0  # the 90s hang was NOT waited out
+    # the supervised retry (fault spent, watchdog still armed): parity
+    got = _bounded(TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                          max_side=2, use_pallas=True).mine)
+    assert rules_text(got) == rules_text(want)
+
+
+@covers("device.dispatch")
+def test_dispatch_fault_in_queue_mine_is_supervised():
+    """An injected queue-engine dispatch failure surfaces through the
+    service as retry-then-finish (or a clean failure) — never a hang or
+    a wrong pattern set."""
+    db = _db()
+    store = ResultStore()
+    with faults.injected("device.dispatch", nth=1, match="queue_launch"):
+        uid, status = _bounded(lambda: _run_train(store, {
+            "algorithm": "SPADE_TPU", "source": "INLINE",
+            "sequences": format_spmf(db), "support": "0.1",
+            "retries": "2"}))
+    assert status == "finished", store.get(f"fsm:error:{uid}")
+    want = mine_spade(db, abs_minsup(0.1, len(db)))
+    got = _stored_patterns(store, uid)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
+
+
+# --------------------------------------------------------------- device.oom
+
+
+@covers("device.oom")
+def test_oom_degradation_ladder_halves_width():
+    """RESOURCE_EXHAUSTED on a launch re-plans it at half width (floor
+    128 lanes) with identical results — the OOM never reaches the mine.
+    """
+    db = synthetic_db(seed=29, n_sequences=60, n_items=14,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, 10, 0.4, max_side=2, use_pallas=True)
+    m = min(eng.item_cap, vdb.n_items)
+    eng.chunk = eng._round_chunk(m)
+    eng._round_m = m
+    p1, s1 = eng._prep(m)
+    cands = [((i,), (j,)) for i in range(m) for j in range(m) if i != j]
+    assert len(cands) > 128, "need a launch wider than the ladder floor"
+    width = RB.next_pow2(len(cands))
+    launch = RB.Launch(1, width, list(range(len(cands))), [1] * len(cands))
+
+    def dispatch():
+        parts, cols = [], np.empty(len(cands), np.int64)
+        eng._xy_bufs = []
+        base = eng._dispatch_kernel_launch(p1, s1, cands, launch, parts,
+                                           cols, 0)
+        arr = np.asarray(parts[0] if len(parts) == 1
+                         else jnp.concatenate(parts, axis=1))
+        return base, arr[0, cols], arr[1, cols]
+
+    _, sup0, supx0 = dispatch()  # fault-free baseline
+    with faults.injected("device.oom", nth=1):
+        base, sup, supx = _bounded(dispatch)
+    assert eng.stats["degraded_launches"] == 1
+    assert base == 2 * (width // 2)  # two half-width sub-launches
+    np.testing.assert_array_equal(sup, sup0)
+    np.testing.assert_array_equal(supx, supx0)
+
+
+@covers("device.oom")
+def test_oom_mid_mine_keeps_parity():
+    db = _rule_db()
+    want = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                  max_side=2, use_pallas=True).mine()
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 8, 0.4,
+                 max_side=2, use_pallas=True)
+    with faults.injected("device.oom", nth=1):
+        got = _bounded(eng.mine)
+    assert rules_text(got) == rules_text(want)
+    # either the ladder absorbed it (wide launch) or the generic
+    # fallback re-pooled the lanes onto jnp (floor-width launch) —
+    # both are clean degrades, and one of them must have happened
+    assert (eng.stats.get("degraded_launches", 0) >= 1
+            or any(k.startswith("pallas_fallback_km")
+                   for k in eng.stats)), eng.stats
+
+
+# ----------------------------------------------------------- prewarm.compile
+
+
+@covers("prewarm.compile")
+def test_prewarm_compile_fault_is_isolated_per_key():
+    """One failing shape-key warm must not take down boot or the other
+    keys: the report carries the error on exactly the injected key."""
+    from spark_fsm_tpu.service import prewarm
+    from spark_fsm_tpu.utils import shapes
+
+    spec = shapes.WorkloadSpec(n_sequences=8, n_items=2, n_words=1)
+    with faults.injected("prewarm.compile", nth=1):
+        report = _bounded(lambda: prewarm.run(spec))
+    rows = report["keys"]
+    assert len(rows) >= 2
+    errs = [r for r in rows if "error" in r]
+    assert len(errs) == 1 and "injected fault" in errs[0]["error"], rows
+    assert report["total_wall_s"] >= 0  # run() completed normally
+
+
+# -------------------------------------------------------------- devcache.put
+
+
+@covers("devcache.put")
+def test_devcache_breaker_opens_then_half_open_probe_recovers():
+    """Consecutive device-put failures open the breaker; while open,
+    every mine takes the uncached HOST-PATH fallback (full parity, no
+    device-put cost on the failing layer); after the cooldown a
+    half-open probe closes it and caching resumes."""
+    db = _db()
+    minsup = abs_minsup(0.1, len(db))
+    want = mine_spade(db, minsup)
+    cache = SpadeEngineCache()
+    cache.breaker = CircuitBreaker("chaos-devcache", threshold=2,
+                                   cooldown_s=1.0)
+    with faults.injected("devcache.put", every=1):
+        # closed: failures propagate to job supervision and count
+        for _ in range(2):
+            with pytest.raises(faults.FaultInjected):
+                cache.mine(db, minsup, stats_out={})
+        assert cache.breaker.state() == "open"
+        snap = cache.breaker.snapshot()
+        assert snap["opens"] >= 1 and snap["failures"] >= 2
+        # open: the host path serves the mine — parity, fault untouched
+        stats: dict = {}
+        got = _bounded(lambda: cache.mine(db, minsup, stats_out=stats))
+        assert patterns_text(got) == patterns_text(want)
+    assert cache.stats["breaker_fallbacks"] == 1
+    # disarmed + cooled down: the half-open probe re-tries the cache,
+    # succeeds, closes the breaker, and the NEXT mine is a cache hit
+    time.sleep(1.05)
+    stats = {}
+    got = _bounded(lambda: cache.mine(db, minsup, stats_out=stats))
+    assert patterns_text(got) == patterns_text(want)
+    assert cache.breaker.state() == "closed"
+    assert stats["store_cache_hit"] is False  # the probe built the entry
+    stats = {}
+    got = _bounded(lambda: cache.mine(db, minsup, stats_out=stats))
+    assert patterns_text(got) == patterns_text(want)
+    assert stats["store_cache_hit"] is True
+    cache.clear()
+
+
+def test_breaker_probe_expiry_recovers_from_dead_probe():
+    """A half-open probe that never reports back (hung device, killed
+    thread) must not wedge the breaker open forever: after another
+    cooldown a NEW probe is allowed."""
+    t = [0.0]
+    br = CircuitBreaker("chaos-probe", threshold=1, cooldown_s=10.0,
+                        clock=lambda: t[0])
+    br.failure()
+    assert br.state() == "open"
+    t[0] = 10.0
+    assert br.allow() is True    # the probe
+    assert br.allow() is False   # concurrent callers keep falling back
+    # the probe dies silently; one more cooldown re-arms probing
+    t[0] = 20.0
+    assert br.allow() is True
+    br.success()
+    assert br.state() == "closed"
+    assert br.allow() is True
+
+
+# ----------------------------------------------- consumer backoff + leaks
+
+
+def test_consumer_error_backoff_grows_and_is_bounded():
+    def fetch():
+        raise RuntimeError("broker down")
+
+    pc = PollConsumer(fetch, lambda b: None, poll_interval_s=0.01,
+                      max_consecutive_errors=4, max_backoff_s=0.08)
+    waits = []
+    orig_wait = pc._stop.wait
+
+    def spy_wait(t):
+        waits.append(t)
+        return orig_wait(0)
+
+    pc._stop.wait = spy_wait
+    stats = _bounded(lambda: pc.run(max_polls=10))
+    assert stats["stopped"] == "errors" and stats["errors"] == 4
+    # waits after errors 1..3 (error 4 trips the bound before waiting):
+    # exponential growth, jitter only UPWARD (never undercuts the base
+    # interval), hard-capped at max_backoff_s jitter included
+    assert len(waits) == 3 and stats["backoff_waits"] == 3
+    assert waits[0] >= 0.01  # never faster than the idle poll interval
+    assert waits[0] < waits[-1] <= 0.08
+
+
+def test_consumer_stop_counts_leaked_thread():
+    release = threading.Event()
+
+    def sink(batch):
+        release.wait(20)
+
+    pc = PollConsumer(lambda: parse_spmf("1 -2\n"), sink,
+                      poll_interval_s=0)
+    pc.start()
+    deadline = time.time() + 10
+    while pc.stats["polls"] < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    base = consumer_health()["leaked_threads"]
+    pc.stop(join_timeout_s=0.05)
+    try:
+        assert pc.stats["leaked_threads"] == 1
+        assert consumer_health()["leaked_threads"] == base + 1
+        # a second stop() on the SAME wedged thread counts nothing new
+        pc.stop(join_timeout_s=0.05)
+        assert pc.stats["leaked_threads"] == 1
+        assert consumer_health()["leaked_threads"] == base + 1
+    finally:
+        release.set()  # let the wedged sink finish so the thread exits
+
+
+# ------------------------------------------------------- admin endpoints
+
+
+def _post_raw(port, endpoint, **params):
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{endpoint}"
+    try:
+        with urllib.request.urlopen(url, data=data, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read().decode())
+
+
+def test_admin_faults_gated_and_health_reports_subsystems():
+    from spark_fsm_tpu.service.app import serve_background
+
+    cfg0 = cfgmod.get_config()
+    srv = serve_background()
+    port = srv.server_port
+    try:
+        # default boot config: the chaos lab is REFUSED
+        code, body = _post_raw(port, "/admin/faults", action="list")
+        assert code == 403 and "fault injection disabled" in body["error"]
+
+        # /admin/health is always on and names every subsystem
+        code, health = _post_raw(port, "/admin/health")
+        assert code == 200
+        assert set(health) >= {"faults", "retry", "watchdog", "breakers",
+                               "consumers", "jobs"}
+        assert health["faults"]["enabled"] is False
+        assert set(health["breakers"]) == {"store_cache", "cspade_cache",
+                                           "tsr_cache"}
+        assert "leaked_threads" in health["consumers"]
+        assert "jobs_retried" in health["jobs"]
+
+        # opted in at boot: arm/list/disarm round-trips
+        cfg = cfgmod.Config()
+        cfg.fault_injection = True
+        cfgmod.set_config(cfg)
+        code, body = _post_raw(port, "/admin/faults", action="arm",
+                               site="store.get", nth="1",
+                               match="chaos-admin")
+        assert code == 200 and "store.get" in body["armed"]
+        assert body["armed"]["store.get"]["nth"] == 1
+        code, body = _post_raw(port, "/admin/faults", action="disarm",
+                               site="store.get")
+        assert code == 200 and body["armed"] == {}
+        code, body = _post_raw(port, "/admin/faults", action="arm",
+                               site="nope.nope", nth="1")
+        assert code == 500 and "unknown fault site" in body["error"]
+    finally:
+        faults.disarm()
+        cfgmod.set_config(cfg0)
+        srv.master.shutdown()
+        srv.shutdown()

@@ -144,8 +144,20 @@ def test_store_checkpoint_roundtrip_and_job_clear():
     state = ckpt.load()
     assert state["results"] == [[[[1]], 3], [[[1], [2]], 2]]
     assert state["stack"] == []
-    # a torn snapshot (results list diverged from meta) refuses to resume
+    # a trailing chunk the meta never saw (a save killed between its
+    # delta rpush and its meta set) HEALS: load returns the meta's own
+    # — last good — snapshot and trims the orphan tail from the store
     store.rpush("fsm:frontier:results:job1", json.dumps([[[[9]], 1]]))
+    healed = ckpt.load()
+    assert healed["results"] == [[[[1]], 3], [[[1], [2]], 2]]  # no [[9]]
+    assert store.llen("fsm:frontier:results:job1") == 1  # tail trimmed
+    # a list that cannot be reconciled at a chunk boundary is torn
+    # beyond repair and refused outright
+    store.rpush("fsm:frontier:results:job1",
+                json.dumps([[[[8]], 1], [[[7]], 1]]))
+    meta = json.loads(store.get("fsm:frontier:job1"))
+    meta["results_total"] = 3  # mid-chunk divergence: 2 then 4, never 3
+    store.set("fsm:frontier:job1", json.dumps(meta))
     assert ckpt.load() is None
     ckpt.save({"version": 1, "stack": [], "results_done": 0, "results": []})
     assert ckpt.load()["results"] == []
@@ -542,6 +554,100 @@ def test_checkpointed_wrapper_routes_queue():
     assert ck.saves, "no snapshot written despite every_s=0"
     want = mine_spade(db, minsup)
     assert patterns_text(got) == patterns_text(want)
+
+
+def test_save_is_non_destructive_under_store_failure():
+    """Regression (ISSUE 3 satellite): save used to pop results/
+    results_done from the CALLER's dict, so a store failure mid-save
+    mutilated the engine's state and a retried save wrote a wrong
+    results_total.  Now save works on a shallow copy: after an injected
+    store.set failure exhausts the retry budget, the caller's dict is
+    untouched and the re-issued save persists the exact snapshot."""
+    from spark_fsm_tpu.utils import faults
+    from spark_fsm_tpu.utils.retry import RetryPolicy
+
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "nd", retry=RetryPolicy(retries=0))
+    state = {"version": 1, "stack": [{"steps": [[0, 1]], "s": [], "i": []}],
+             "results_done": 0, "results": [[[[1]], 3], [[[2]], 2]]}
+    snapshot = json.loads(json.dumps(state))
+    with faults.injected("store.set", every=1, match="fsm:frontier:nd"):
+        with pytest.raises(faults.FaultInjected):
+            ckpt.save(state)
+    assert state == snapshot, "save mutilated the caller's state dict"
+    ckpt.save(state)  # the retried save (fault gone) writes it all
+    assert state == snapshot
+    loaded = ckpt.load()
+    assert loaded["results"] == snapshot["results"]
+    assert loaded["stack"] == snapshot["stack"]
+    # and a follow-up DELTA save composes on top of the retried one
+    state2 = {"version": 1, "stack": [], "results_done": 2,
+              "results": [[[[3]], 1]]}
+    ckpt.save(state2)
+    assert ckpt.load()["results"] == snapshot["results"] + [[[[3]], 1]]
+
+
+def test_kill_between_rpush_and_meta_set_resumes_previous_snapshot():
+    """Crash-timing on the checkpoint path (ISSUE 3 satellite): a kill
+    AFTER the delta rpush but BEFORE the meta set leaves an orphan chunk
+    the meta never saw.  load() must refuse that torn snapshot — it
+    serves the PREVIOUS good one (the meta's own), trimming the orphan —
+    and a checkpointed retry resumes from it with no duplicated rules."""
+    from spark_fsm_tpu.utils import faults
+    from spark_fsm_tpu.utils.retry import RetryPolicy
+
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "kill", retry=RetryPolicy(retries=0))
+    ckpt.save({"version": 1,
+               "stack": [{"steps": [[0, 1]], "s": [0], "i": []}],
+               "results_done": 0, "results": [[[[1]], 3]]})
+    good = ckpt.load()
+    # the kill: rpush lands (no retry budget, meta set always fails)
+    with faults.injected("store.set", every=1, match="fsm:frontier:kill"):
+        with pytest.raises(faults.FaultInjected):
+            ckpt.save({"version": 1, "stack": [], "results_done": 1,
+                       "results": [[[[2]], 2]]})
+    assert store.llen("fsm:frontier:results:kill") == 1  # orphan chunk
+    fresh = StoreCheckpoint(store, "kill")
+    state = fresh.load()
+    assert state is not None, "previous good snapshot must still resume"
+    assert state["results"] == good["results"]  # NOT the torn delta
+    assert state["stack"] == good["stack"]
+    assert store.llen("fsm:frontier:results:kill") == 0  # healed
+    # the retried save now lands cleanly on the healed store: exactly
+    # one copy of the delta — no duplicated results on the next resume
+    fresh.save({"version": 1, "stack": [], "results_done": 1,
+                "results": [[[[2]], 2]]})
+    assert fresh.load()["results"] == [[[[1]], 3], [[[2]], 2]]
+
+
+def test_mine_killed_mid_save_resumes_with_full_parity():
+    """End-to-end crash timing: a SPADE mine whose SECOND checkpoint
+    save is killed between the delta write and the meta write must
+    resume from the FIRST snapshot and still produce the exact pattern
+    set (no lost, no duplicated patterns)."""
+    from spark_fsm_tpu.utils import faults
+    from spark_fsm_tpu.utils.retry import RetryPolicy
+
+    db = _db()
+    minsup = abs_minsup(0.05, len(db))
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "mkill", retry=RetryPolicy(retries=0))
+    eng = SpadeTPU(build_vertical(db, min_item_support=minsup), minsup,
+                   node_batch=4, pipeline_depth=2, pool_bytes=32 << 20)
+    # fire on the SECOND frontier meta write: save 1 completes, save 2
+    # has rpushed its delta when the meta set "kills the process"
+    with faults.injected("store.set", nth=2, match="fsm:frontier:mkill"):
+        with pytest.raises(faults.FaultInjected):
+            eng.mine(checkpoint_cb=ckpt.save, checkpoint_every_s=0.0)
+    state = StoreCheckpoint(store, "mkill").load()
+    assert state is not None and state["stack"], (
+        "previous good snapshot must resume")
+    eng2 = SpadeTPU(build_vertical(db, min_item_support=minsup), minsup,
+                    node_batch=16, pool_bytes=32 << 20)
+    got = eng2.mine(resume=state)
+    want = mine_spade(db, minsup)
+    assert patterns_text(got) == patterns_text(want), diff_patterns(want, got)
 
 
 def test_checkpointed_queue_overflow_resumes_in_classic(monkeypatch):

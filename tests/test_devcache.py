@@ -158,7 +158,8 @@ def test_tsr_repeat_mine_hits_and_matches():
     cache.mine(db, 11, 0.4, max_side=2, stats_out=s3)
     assert s3["store_cache_hit"] is False
     assert cache.stats == {"hits": 1, "misses": 2, "busy_misses": 0,
-                           "evictions": 0}  # both fit max_entries=2
+                           "evictions": 0,  # both fit max_entries=2
+                           "breaker_fallbacks": 0}
     # a third distinct engine exceeds max_entries: LRU (k=10) drops
     cache.mine(db, 12, 0.4, max_side=2)
     assert cache.stats["evictions"] == 1

@@ -39,7 +39,8 @@ def test_poll_concatenates_partitions_in_order():
     batch = fetch()
     assert batch == parse_spmf("1 -2\n2 -2\n3 -1 4 -2\n")
     assert fake.seen_timeouts == [250]
-    assert fetch.stats == {"polls": 1, "records": 3, "bad_records": 0}
+    assert fetch.stats == {"polls": 1, "records": 3, "bad_records": 0,
+                           "dead_letters": []}
 
 
 def test_empty_poll_and_empty_records_are_idle():
@@ -76,6 +77,53 @@ def test_bad_record_skip_counts_and_keeps_good_ones():
     fetch = KafkaFetch(fake, on_bad="skip")
     assert fetch() == parse_spmf("5 -2\n")
     assert fetch.stats["bad_records"] == 2
+
+
+class _OffsetRec(_Rec):
+    def __init__(self, value, offset):
+        super().__init__(value)
+        self.offset = offset
+
+
+def test_dead_letter_ring_diagnoses_poison_messages():
+    """Undecodable payloads land in a bounded ring (last 16) with
+    partition/offset (when the record exposes one), a TRUNCATED payload
+    repr, and the error — so a poisoned topic names its producer and
+    replay point instead of being a bare counter."""
+    big = b"\xff" + b"x" * 500  # undecodable AND oversized
+    fake = _FakeConsumer([{"tp3": [_OffsetRec(big, 41),
+                                   _Rec(b"5 -2\n"),
+                                   _Rec(b"oops")]}])
+    fetch = KafkaFetch(fake, on_bad="skip")
+    assert fetch() == parse_spmf("5 -2\n")
+    ring = fetch.stats["dead_letters"]
+    assert len(ring) == 2
+    assert ring[0]["partition"] == "tp3" and ring[0]["offset"] == 41
+    assert ring[0]["payload"].endswith("...(truncated)")
+    assert len(ring[0]["payload"]) < 200
+    assert "UnicodeDecodeError" in ring[0]["error"]
+    assert ring[1]["offset"] is None  # record type without offsets
+    assert "oops" in ring[1]["payload"]
+
+
+def test_dead_letter_ring_is_bounded_and_recorded_on_raise():
+    # raise mode records the poison record too (it is the one that took
+    # the poll down — exactly what the operator needs to see)
+    fake = _FakeConsumer([{"tp0": [_Rec(b"garbage")]}])
+    fetch = KafkaFetch(fake)
+    with pytest.raises(ValueError):
+        fetch()
+    assert len(fetch.stats["dead_letters"]) == 1
+
+    # the ring keeps only the LAST 16 across polls
+    polls = [{"tp0": [_Rec(f"bad {i}".encode())]} for i in range(20)]
+    fetch2 = KafkaFetch(_FakeConsumer(polls), on_bad="skip")
+    for _ in range(20):
+        fetch2()
+    ring = fetch2.stats["dead_letters"]
+    assert len(ring) == 16
+    assert "bad 19" in ring[-1]["payload"] and "bad 4" in ring[0]["payload"]
+    assert fetch2.stats["bad_records"] == 20
 
 
 def test_constructor_validation():

@@ -113,6 +113,13 @@ class MiniRedis:
                 return b"$%d\r\n%s\r\n" % (len(vb), vb)
             if cmd == "LLEN":
                 return b":%d\r\n" % len(self.lists.get(rest[0], []))
+            if cmd == "LTRIM":
+                lst = self.lists.get(rest[0])
+                if lst is not None:
+                    start, stop = int(rest[1]), int(rest[2])
+                    stop = len(lst) if stop == -1 else stop + 1
+                    self.lists[rest[0]] = lst[start:stop]
+                return b"+OK\r\n"
             if cmd == "DEL":
                 n = 0
                 for k in rest:
