@@ -34,7 +34,7 @@ COMPARED = ("kernel_launches", "evaluated", "traffic_units",
             "pruned_conf", "superbatches")
 
 
-def smoke_tsr(max_side):
+def smoke_tsr(max_side, trace_id=None):
     from spark_fsm_tpu.data.synth import kosarak_like
     from spark_fsm_tpu.data.vertical import build_vertical
     from spark_fsm_tpu.models.tsr import TsrTPU
@@ -43,7 +43,13 @@ def smoke_tsr(max_side):
     vdb = build_vertical(db, min_item_support=1)
     t0 = time.monotonic()
     eng = TsrTPU(vdb, 100, 0.5, max_side=max_side)
-    rules = eng.mine()
+    if trace_id is not None:
+        from spark_fsm_tpu.utils import obs
+
+        with obs.trace(trace_id, engine="tsr", max_side=max_side):
+            rules = eng.mine()
+    else:
+        rules = eng.mine()
     return {
         "kernel_launches": eng.stats["kernel_launches"],
         "evaluated": eng.stats["evaluated"],
@@ -113,6 +119,48 @@ def main() -> int:
             print("  " + f, file=sys.stderr)
         return 1
     print("bench_smoke: all counters match the committed expectations")
+    return xcheck_trace(rows["3"])
+
+
+def xcheck_trace(untraced_row) -> int:
+    """Cross-check guard: re-run the config-3 miniature WITH tracing and
+    require (a) the launch count derived from flight-recorder spans to
+    equal the engine's dispatch-shape counter (every kernel_launches
+    increment — prep builds + planned launches — opens exactly one
+    tsr.prep/tsr.launch span; silent instrumentation drift on either
+    side breaks the equality), and (b) the traced run's dispatch
+    counters to match the untraced row byte-for-byte (tracing must
+    OBSERVE the dispatch policy, never perturb it)."""
+    from spark_fsm_tpu.utils import obs
+
+    obs.configure_tracing(True, max_spans=1 << 16, max_jobs=4)
+    try:
+        row = smoke_tsr(2, trace_id="bench:xcheck")
+    finally:
+        obs.configure_tracing(False)
+    dump = obs.trace_dump("bench:xcheck")
+    failures = []
+    if dump is None or dump["dropped_spans"]:
+        failures.append(f"trace missing or lossy: {dump and dump['dropped_spans']}")
+    else:
+        span_launches = sum(1 for s in dump["spans"]
+                            if s["site"] in ("tsr.launch", "tsr.prep"))
+        if span_launches != row["kernel_launches"]:
+            failures.append(
+                f"span-derived launch count {span_launches} != engine "
+                f"kernel_launches {row['kernel_launches']}")
+    for key in COMPARED + ("rules",):
+        if row[key] != untraced_row[key]:
+            failures.append(f"traced run perturbed {key}: {row[key]} != "
+                            f"{untraced_row[key]}")
+    if failures:
+        print("bench_smoke: TRACE/COUNTER CROSS-CHECK FAILED:",
+              file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print("bench_smoke: trace-span launch count matches the dispatch "
+          "counters (traced run byte-identical)")
     return 0
 
 

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Observability smoke: boot the service, mine, scrape, cross-check.
+
+The CI companion to verify_t1.sh / bench_smoke.sh / chaos_smoke.sh for
+the observability layer (utils/obs.py): it boots the real HTTP service
+with tracing ON, runs one traced TSR mine end to end, and asserts
+
+- ``GET /metrics`` parses as Prometheus text exposition (every
+  non-comment line is ``name[{labels}] value``, every family has a
+  TYPE line, histogram buckets are cumulative and end at +Inf);
+- NO ORPHAN COUNTERS: every registered fault site (utils/faults
+  KNOWN_SITES) has ``fsm_fault_site_calls_total{site=...}`` and
+  ``fsm_fault_site_injected_total`` series, and every framework retry
+  policy (utils/retry KNOWN_SITES) has ``fsm_retry_attempts_total``
+  series — armed-but-unexported machinery is invisible exactly when a
+  drill needs it, which is the failure mode this guard exists for;
+- the job's ``/admin/trace/{uid}`` dump exists, carries the job root
+  span + mine span, and every tsr launch span has predicted seconds
+  next to its measured wall.
+
+Usage: scripts/obs_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(\S+)$')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal exposition-format parser: {family: {label-string: value}}
+    with TYPE bookkeeping; raises ValueError on any malformed line."""
+    families: dict = {}
+    types: dict = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            types[name] = kind.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"/metrics line {lineno} malformed: {line!r}")
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            fv = float(value)  # accepts exponents, +Inf, NaN
+        except ValueError:
+            raise ValueError(
+                f"/metrics line {lineno}: non-numeric value {value!r}")
+        families.setdefault(name, {})[labels] = fv
+    for fam in families:
+        base = re.sub(r"_(bucket|count|sum)$", "", fam)
+        if fam not in types and base not in types:
+            raise ValueError(f"family {fam} has samples but no # TYPE line")
+    return families
+
+
+def check_histograms(families: dict) -> None:
+    for fam, rows in families.items():
+        if not fam.endswith("_bucket"):
+            continue
+        by_series: dict = {}
+        for labels, value in rows.items():
+            le = re.search(r'le="([^"]*)"', labels)
+            if le is None:
+                raise ValueError(f"{fam}{labels}: bucket without le=")
+            rest = re.sub(r',?le="[^"]*"', "", labels)
+            by_series.setdefault(rest, []).append(
+                (float("inf") if le.group(1) == "+Inf" else float(le.group(1)),
+                 value))
+        for rest, pairs in by_series.items():
+            pairs.sort()
+            if pairs[-1][0] != float("inf"):
+                raise ValueError(f"{fam}{rest}: no +Inf bucket")
+            counts = [v for _, v in pairs]
+            if counts != sorted(counts):
+                raise ValueError(f"{fam}{rest}: buckets not cumulative")
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.service.app import serve_background
+    from spark_fsm_tpu.utils import faults as faultsmod
+    from spark_fsm_tpu.utils import retry as retrymod
+
+    cfgmod.set_config(cfgmod.parse_config(
+        {"observability": {"trace": True}}))
+    srv = serve_background()
+    port = srv.server_port
+
+    def post(ep, **params):
+        data = urllib.parse.urlencode(params).encode()
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{ep}",
+                                    data=data, timeout=120) as r:
+            return r.read().decode()
+
+    failures = []
+    try:
+        db = synthetic_db(seed=11, n_sequences=50, n_items=12,
+                          mean_itemsets=3.0, mean_itemset_size=1.3)
+        resp = json.loads(post(
+            "/train", algorithm="TSR_TPU", source="INLINE",
+            sequences=format_spmf(db), support="0.1", k="10",
+            minconf="0.4", max_side="2", uid="obs-smoke"))
+        uid = resp["data"]["uid"]
+        for _ in range(1200):
+            st = json.loads(post(f"/status/{uid}"))
+            if st["status"] in ("finished", "failure"):
+                break
+            time.sleep(0.1)
+        if st["status"] != "finished":
+            failures.append(f"mine did not finish: {st}")
+
+        text = post("/metrics")
+        families = parse_prometheus(text)
+        check_histograms(families)
+
+        # no orphan counters: every registered fault site + retry policy
+        for fam in ("fsm_fault_site_calls_total",
+                    "fsm_fault_site_injected_total"):
+            got = {re.search(r'site="([^"]*)"', k).group(1)
+                   for k in families.get(fam, {}) if 'site="' in k}
+            missing = set(faultsmod.KNOWN_SITES) - got
+            if missing:
+                failures.append(f"{fam}: no series for fault site(s) "
+                                f"{sorted(missing)}")
+        got = {re.search(r'site="([^"]*)"', k).group(1)
+               for k in families.get("fsm_retry_attempts_total", {})
+               if 'site="' in k}
+        missing = set(retrymod.KNOWN_SITES) - got
+        if missing:
+            failures.append("fsm_retry_attempts_total: no series for retry "
+                            f"policy site(s) {sorted(missing)}")
+        for fam in ("fsm_jobs_finished_total", "fsm_trace_spans_total",
+                    "fsm_planner_launches_total", "fsm_store_op_seconds_count",
+                    "fsm_watchdog_guarded_total", "fsm_breaker_state"):
+            if fam not in families:
+                failures.append(f"expected family missing: {fam}")
+
+        dump = json.loads(post(f"/admin/trace/{uid}"))
+        sites = [s["site"] for s in dump.get("spans", ())]
+        for want in ("job", "job.mine", "tsr.dispatch", "tsr.readback"):
+            if want not in sites:
+                failures.append(f"trace dump missing span site {want!r} "
+                                f"(got {sorted(set(sites))})")
+        for s in dump.get("spans", ()):
+            if s["site"] == "tsr.launch" and (
+                    "predicted_s" not in s.get("attrs", {})
+                    or s.get("duration_s") is None):
+                failures.append(f"launch span without predicted/measured "
+                                f"seconds: {s}")
+    finally:
+        srv.master.shutdown()
+        srv.shutdown()
+    if failures:
+        print("obs_smoke: FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    n = sum(len(v) for v in families.values())
+    print(f"obs_smoke: /metrics parsed ({len(families)} families, "
+          f"{n} samples), no orphan counters, trace dump complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Observability smoke — the /metrics + flight-recorder companion to
+# verify_t1.sh / bench_smoke.sh / chaos_smoke.sh.  Boots the service
+# with tracing on, mines once, then asserts GET /metrics parses as
+# Prometheus text exposition, every registered fault site and retry
+# policy has a matching fsm_* series (no orphan counters), and the
+# job's /admin/trace dump carries the launch spans with predicted-vs-
+# measured seconds.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/obs_smoke.py "$@"

@@ -47,6 +47,12 @@ File format (TOML shown; JSON with the same nesting also accepted):
                                     # omit to disable (utils/watchdog.py)
     watchdog_floor_s = 2.0
 
+    [observability]
+    trace = false                   # per-job flight recorder (utils/obs.py);
+                                    # off = one global read per probe
+    trace_max_spans = 512           # completed-span ring per job
+    trace_jobs = 16                 # job traces kept (oldest evicted)
+
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
     sequences = 77500               # expected dataset scale
@@ -143,6 +149,22 @@ class PrewarmConfig:
 
 
 @dataclasses.dataclass
+class ObservabilityConfig:
+    """Flight-recorder gating (utils/obs.py).  ``trace = false`` (the
+    default) pins the disabled path to one module-global read per
+    probe — the same contract as the fault registry; the metrics
+    registry behind ``GET /metrics`` is always on (registry writes are
+    a lock + dict update, and a scrape must work on any deployment).
+    ``trace_max_spans`` bounds each job's completed-span ring (oldest
+    evicted first); ``trace_jobs`` bounds how many job traces are kept.
+    """
+
+    trace: bool = False
+    trace_max_spans: int = 512
+    trace_jobs: int = 16
+
+
+@dataclasses.dataclass
 class DistributedConfig:
     """Multi-host (jax.distributed) wiring; all-defaults = single host.
 
@@ -164,6 +186,8 @@ class Config:
     distributed: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig)
     prewarm: PrewarmConfig = dataclasses.field(default_factory=PrewarmConfig)
+    observability: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -205,6 +229,8 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "engine": (EngineConfig, top.pop("engine", {})),
         "distributed": (DistributedConfig, top.pop("distributed", {})),
         "prewarm": (PrewarmConfig, top.pop("prewarm", {})),
+        "observability": (ObservabilityConfig,
+                          top.pop("observability", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -222,6 +248,10 @@ def parse_config(obj: Dict[str, Any]) -> Config:
             f"got {cfg.store.backend!r}")
     if cfg.engine.mesh_devices < 0:
         raise ConfigError("engine.mesh_devices must be >= 0")
+    if cfg.observability.trace_max_spans < 1:
+        raise ConfigError("observability.trace_max_spans must be >= 1")
+    if cfg.observability.trace_jobs < 1:
+        raise ConfigError("observability.trace_jobs must be >= 1")
     if cfg.engine.fused not in (None, "auto", "always", "never",
                                 "queue", "dense"):
         raise ConfigError(
@@ -274,6 +304,13 @@ def set_config(cfg: Config) -> None:
         slack=cfg.engine.watchdog_slack,
         floor_s=(2.0 if cfg.engine.watchdog_floor_s is None
                  else cfg.engine.watchdog_floor_s))
+    # the flight recorder is process-global too (engines open spans
+    # with no constructor plumbing) — same ownership as the watchdog
+    from spark_fsm_tpu.utils import obs
+
+    obs.configure_tracing(cfg.observability.trace,
+                          max_spans=cfg.observability.trace_max_spans,
+                          max_jobs=cfg.observability.trace_jobs)
 
 
 def engine_kwargs(*names: str) -> Dict[str, Any]:

@@ -65,7 +65,7 @@ from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
-from spark_fsm_tpu.utils import faults, shapes, watchdog
+from spark_fsm_tpu.utils import faults, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
 
 
@@ -717,21 +717,27 @@ class QueueSpadeTPU:
         ni = self.ni_pad
         (q_slot, q_smask, q_imask, q_nits, q_rec, records, recsup), \
             n_roots_dev = self._root_init(roots)
-        faults.fault_site("device.dispatch", point="queue_launch")
-        fn = _queue_mine_fn(
-            self.mesh, self.n_words, ni, self.max_its,
-            cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
-            self.use_pallas, self._s_block, self._interpret,
-            nb_late=self._nb_late)
-        packed_dev = fn(
-            self.store, q_slot, q_smask, q_imask, q_nits, q_rec,
-            n_roots_dev, records, recsup,
-            self._put(np.int32(self.minsup)))
         # watchdog deadline for the whole-mine dispatch: the wave ceiling
         # times the wave width is the program's own upper bound on lanes
-        # streamed — the same cost-model units the ragged planner uses
-        wd_deadline = watchdog.deadline_s(RB.estimate_seconds(
-            cap.nb * cap.i_max, 1, self.n_seq, self.n_words))
+        # streamed — the same cost-model units the ragged planner uses.
+        # (A CEILING, not a prediction: the span carries it for the
+        # trace, but only TSR dispatches — whose planner predicts actual
+        # traffic — feed the cost-model drift gauge.)
+        bound_s = RB.estimate_seconds(
+            cap.nb * cap.i_max, 1, self.n_seq, self.n_words)
+        wd_deadline = watchdog.deadline_s(bound_s)
+        with obs.span("queue.dispatch", point="oneshot", nb=cap.nb,
+                      bound_s=round(bound_s, 6)):
+            faults.fault_site("device.dispatch", point="queue_launch")
+            fn = _queue_mine_fn(
+                self.mesh, self.n_words, ni, self.max_its,
+                cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
+                self.use_pallas, self._s_block, self._interpret,
+                nb_late=self._nb_late)
+            packed_dev = fn(
+                self.store, q_slot, q_smask, q_imask, q_nits, q_rec,
+                n_roots_dev, records, recsup,
+                self._put(np.int32(self.minsup)))
         # Single-roundtrip fast path: prefetch a fixed prefix (counter
         # block + the first PREFETCH records, 64 KB) — most mines fit it,
         # so the counter read and the record read share one device->host
@@ -749,8 +755,9 @@ class QueueSpadeTPU:
 
         # a hung whole-mine dispatch fails the launch (the Miner's
         # supervision retries the job) instead of wedging the worker
-        prefix = watchdog.run_with_deadline(read, wd_deadline,
-                                            site="queue.readback")
+        with obs.span("queue.readback", bound_s=round(bound_s, 6)):
+            prefix = watchdog.run_with_deadline(read, wd_deadline,
+                                                site="queue.readback")
         counters = prefix[0]
         n_rec = int(counters[0])
         self.stats["waves"] = int(counters[2])
@@ -769,9 +776,11 @@ class QueueSpadeTPU:
             # the big-result second fetch blocks too — same watchdog
             # deadline as the prefix read (a wedge after the prefix
             # resolved must still fail the launch, not the worker)
-            packed = watchdog.run_with_deadline(
-                lambda: np.asarray(packed_dev[2:2 + n_fetch]),
-                wd_deadline, site="queue.readback")
+            with obs.span("queue.readback", point="big_fetch",
+                          n_fetch=n_fetch):
+                packed = watchdog.run_with_deadline(
+                    lambda: np.asarray(packed_dev[2:2 + n_fetch]),
+                    wd_deadline, site="queue.readback")
         rec, sup = packed[:, :3], packed[:, 3]
         results, _ = self._decode_records(rec, sup, n_rec)
         self.stats["patterns"] = len(results)
@@ -833,21 +842,25 @@ class QueueSpadeTPU:
         # per wave.  One compiled program serves every budget (traced).
         budget = 1 if checkpoint_cb is not None else seg_waves
         while True:
-            faults.fault_site("device.dispatch", point="queue_segment")
             nbw = nbl if narrow else cap.nb
-            seg_deadline = watchdog.deadline_s(RB.estimate_seconds(
-                nbw * budget, 1, self.n_seq, self.n_words))
-            carry, counters_dev = seg_fn(narrow, first)(
-                *carry, self._put(np.int32(budget)))
-            budget = min(seg_waves, budget * 4)
-            first = False
-            self.stats["kernel_launches"] = (
-                self.stats.get("kernel_launches", 0) + 1)
-            # per-segment counter readback under the dispatch watchdog:
-            # the deadline scales with this segment's own wave budget
-            counters = watchdog.run_with_deadline(
-                lambda: np.asarray(counters_dev), seg_deadline,
-                site="queue.segment_readback")
+            seg_bound_s = RB.estimate_seconds(
+                nbw * budget, 1, self.n_seq, self.n_words)
+            seg_deadline = watchdog.deadline_s(seg_bound_s)
+            with obs.span("queue.segment", nb=nbw, budget=budget,
+                          narrow=narrow, bound_s=round(seg_bound_s, 6)):
+                faults.fault_site("device.dispatch", point="queue_segment")
+                carry, counters_dev = seg_fn(narrow, first)(
+                    *carry, self._put(np.int32(budget)))
+                budget = min(seg_waves, budget * 4)
+                first = False
+                self.stats["kernel_launches"] = (
+                    self.stats.get("kernel_launches", 0) + 1)
+                # per-segment counter readback under the dispatch
+                # watchdog: the deadline scales with this segment's own
+                # wave budget
+                counters = watchdog.run_with_deadline(
+                    lambda: np.asarray(counters_dev), seg_deadline,
+                    site="queue.segment_readback")
             n_rec, oflow, waves, n_cand, pending, head, tail = (
                 int(x) for x in counters)
             if narrow:

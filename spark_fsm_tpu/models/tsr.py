@@ -61,7 +61,7 @@ from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
-from spark_fsm_tpu.utils import faults, shapes, watchdog
+from spark_fsm_tpu.utils import faults, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
 
 # OOM degradation ladder floor (lanes): a failed launch re-plans at half
@@ -482,6 +482,17 @@ class TsrTPU:
 
     def _prep_engine(self, m: int):
         """Engine-layout ([m, S, W]) prefix/suffix-OR rows."""
+        with self._prep_span(m):
+            return self._prep_engine_inner(m)
+
+    def _prep_span(self, m: int):
+        """One ``tsr.prep`` span per prep launch: every
+        ``kernel_launches`` increment has a matching span, the invariant
+        the bench_smoke cross-check guard pins (span-derived launch
+        count == engine dispatch-shape counter)."""
+        return obs.span("tsr.prep", m=m)
+
+    def _prep_engine_inner(self, m: int):
         if self.mesh is None:
             ti, ts, tw, tm = self._sel_tokens(self._order[:m])
             if self._shape_buckets:
@@ -553,6 +564,21 @@ class TsrTPU:
 
     def _dispatch_eval(self, p1, s1,
                        cands: List[Tuple[Tuple[int, ...], Tuple[int, ...]]]):
+        """Traced wrapper around :meth:`_dispatch_eval_inner`: opens the
+        per-dispatch flight-recorder span (the launch spans the planner
+        emits nest under it) and appends the dispatch-start monotonic
+        clock to the handle so :meth:`_resolve_eval` can put the
+        measured wall next to the planner's prediction.  One global
+        read when tracing is off (utils/obs.span)."""
+        t0 = time.monotonic()
+        with obs.span("tsr.dispatch", candidates=len(cands)) as sp:
+            handle = self._dispatch_eval_inner(p1, s1, cands)
+            sp.set(launches=handle[3], predicted_s=round(handle[6], 6))
+        return handle + (t0,)
+
+    def _dispatch_eval_inner(self, p1, s1,
+                             cands: List[Tuple[Tuple[int, ...],
+                                               Tuple[int, ...]]]):
         """Launch (sup, supx) evaluation for candidate rules (local item
         idx); returns a device handle with the host copy already in
         flight.  ``_resolve_eval`` blocks on it — the split lets the mine
@@ -658,15 +684,20 @@ class TsrTPU:
             for L in RB.plan_launches(
                     leftover, cap=cap, lane=32,
                     overhead=RB.overhead_units(self.n_seq, self.n_words)):
-                faults.fault_site("device.dispatch", point="jnp",
-                                  km=str(L.km), width=str(L.width))
-                fn = self._eval_fn(L.km)
-                xy = self._stager.take(L, cands)
-                xy_bufs.append(xy)
-                cols[L.rows] = base + np.arange(len(L.rows))
-                base += L.width
-                parts.append(fn(pj, sj, self._put(xy)))
-                self._count_launch(L)
+                with obs.span("tsr.launch", point="jnp", km=L.km,
+                              width=L.width, predicted_s=round(
+                                  RB.estimate_seconds(
+                                      L.traffic_units, 1, self.n_seq,
+                                      self.n_words), 6)):
+                    faults.fault_site("device.dispatch", point="jnp",
+                                      km=str(L.km), width=str(L.width))
+                    fn = self._eval_fn(L.km)
+                    xy = self._stager.take(L, cands)
+                    xy_bufs.append(xy)
+                    cols[L.rows] = base + np.arange(len(L.rows))
+                    base += L.width
+                    parts.append(fn(pj, sj, self._put(xy)))
+                    self._count_launch(L)
         self.stats["evaluated"] += n
         out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         try:
@@ -739,38 +770,47 @@ class TsrTPU:
         the sub-launches re-enter this method, so a half-width OOM
         halves again and stats/cols bookkeeping stays per-sub-launch.
         """
-        try:
-            faults.fault_site("device.dispatch", point="kernel",
-                              km=str(L.km), width=str(L.width))
-            faults.fault_site("device.oom", point="kernel",
-                              km=str(L.km), width=str(L.width))
-            fn = _kernel_eval_fn(self.mesh, L.km,
-                                 self._bucket_seq_block(L.km),
-                                 self._interpret, self.n_words == 1)
-            xy = self._stager.take(L, cands)
-            part = fn(p1k, s1k, self._put(xy))
-        except Exception as exc:
-            if not _is_oom(exc) or L.width <= _OOM_FLOOR_LANES:
-                raise
-            self.stats["degraded_launches"] = (
-                self.stats.get("degraded_launches", 0) + 1)
-            half = L.width // 2
-            from spark_fsm_tpu.utils.obs import log_event
-            log_event("oom_degraded_launch", km=L.km, width=L.width,
-                      half=half)
-            for lo, hi in ((0, half), (half, len(L.rows))):
-                rows = L.rows[lo:hi]
-                if rows:
-                    base = self._dispatch_kernel_launch(
-                        p1k, s1k, cands,
-                        RB.Launch(L.km, half, rows, L.kms[lo:hi]),
-                        parts, cols, base)
-            return base
-        self._xy_bufs.append(xy)
-        self._count_launch(L)
-        cols[L.rows] = base + np.arange(len(L.rows))
-        parts.append(part)
-        return base + L.width
+        with obs.span("tsr.launch", point="kernel", km=L.km, width=L.width,
+                      predicted_s=round(RB.estimate_seconds(
+                          L.traffic_units, 1, self.n_seq, self.n_words),
+                          6)) as sp:
+            try:
+                faults.fault_site("device.dispatch", point="kernel",
+                                  km=str(L.km), width=str(L.width))
+                faults.fault_site("device.oom", point="kernel",
+                                  km=str(L.km), width=str(L.width))
+                fn = _kernel_eval_fn(self.mesh, L.km,
+                                     self._bucket_seq_block(L.km),
+                                     self._interpret, self.n_words == 1)
+                xy = self._stager.take(L, cands)
+                part = fn(p1k, s1k, self._put(xy))
+            except Exception as exc:
+                if not _is_oom(exc) or L.width <= _OOM_FLOOR_LANES:
+                    raise
+                self.stats["degraded_launches"] = (
+                    self.stats.get("degraded_launches", 0) + 1)
+                half = L.width // 2
+                obs.log_event("oom_degraded_launch", km=L.km, width=L.width,
+                              half=half)
+                # the RESOURCE_EXHAUSTED lands on THIS launch's span and
+                # the half-width re-plans below nest under it as child
+                # spans — the degradation ladder reads straight off the
+                # trace dump
+                sp.event("resource_exhausted", km=L.km, width=L.width,
+                         half=half, error=f"{type(exc).__name__}: {exc}")
+                for lo, hi in ((0, half), (half, len(L.rows))):
+                    rows = L.rows[lo:hi]
+                    if rows:
+                        base = self._dispatch_kernel_launch(
+                            p1k, s1k, cands,
+                            RB.Launch(L.km, half, rows, L.kms[lo:hi]),
+                            parts, cols, base)
+                return base
+            self._xy_bufs.append(xy)
+            self._count_launch(L)
+            cols[L.rows] = base + np.arange(len(L.rows))
+            parts.append(part)
+            return base + L.width
 
     def _count_launch(self, L) -> None:
         """Per-launch accounting shared by the kernel and jnp dispatch
@@ -809,8 +849,21 @@ class TsrTPU:
         # handling downgrades or the job supervisor retries) instead of
         # wedging the Miner worker forever.
         est_s = handle[6] if len(handle) > 6 else 0.0
-        arr = watchdog.run_with_deadline(
-            read, watchdog.deadline_s(est_s), site="tsr.readback")
+        with obs.span("tsr.readback", predicted_s=round(est_s, 6)) as sp:
+            arr = watchdog.run_with_deadline(
+                read, watchdog.deadline_s(est_s), site="tsr.readback")
+            # measured wall since the DISPATCH opened (the async device
+            # work + queue wait this readback resolved), recorded next
+            # to the planner's prediction — per-dispatch residuals are
+            # the cost-model calibration input.  The EWMA gauge
+            # (fsm_costmodel_drift_ratio) feeds the watchdog-slack
+            # runbook; with a deep pipeline the wait includes earlier
+            # in-flight dispatches, so the ratio is conservative (an
+            # overestimate), which is the safe direction for a deadline.
+            if len(handle) > 7:
+                measured_s = time.monotonic() - handle[7]
+                sp.set(measured_s=round(measured_s, 6))
+                obs.observe_costmodel(est_s, measured_s)
         # the blocking readback proves the compute consumed its staged
         # inputs: recycle the dispatch's xy buffers (a FAULTED handle
         # never reaches this line, so its buffers are never reused while
@@ -1069,6 +1122,8 @@ class TsrTPU:
                     raise
                 self.use_pallas = False
                 self.stats["pallas_fallback"] = repr(exc)
+                obs.trace_event("pallas_fallback", point="readback",
+                                error=f"{type(exc).__name__}: {exc}")
                 self._ensure_jnp_downgrade()
                 if self._chunk_user is None:
                     self.chunk = self._jnp_chunk

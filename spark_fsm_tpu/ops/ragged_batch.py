@@ -55,6 +55,14 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from spark_fsm_tpu.utils import obs  # host-only, keeps the no-jax contract
+
+_PLAN_LAUNCHES = obs.REGISTRY.counter(
+    "fsm_planner_launches_total", "launches emitted by the ragged packer")
+_PLAN_SUPERBATCHES = obs.REGISTRY.counter(
+    "fsm_planner_superbatches_total",
+    "mixed-km launches emitted by the ragged packer")
+
 # Fixed per-launch dispatch cost in TRAFFIC UNITS (one unit = one lane
 # streaming one km's prefix+suffix blocks over the sequence axis).  At
 # the headline Kosarak geometry a km1 lane costs ~10.5 us of kernel wall
@@ -254,6 +262,19 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
         cur = (km, list(rows), [km] * len(rows))
     if cur is not None:
         launches.append(_emit(cur, lane))
+    if launches:
+        mixed = sum(1 for L in launches if L.mixed)
+        _PLAN_LAUNCHES.inc(len(launches))
+        if mixed:
+            _PLAN_SUPERBATCHES.inc(mixed)
+        # the plan itself is a flight-recorder event (one per dispatch):
+        # the per-launch spans the engines open cite geometries, this
+        # cites the packer's whole decision
+        obs.trace_event(
+            "plan_launches",
+            candidates=sum(len(L.rows) for L in launches),
+            launches=len(launches), superbatches=mixed,
+            traffic_units=sum(L.traffic_units for L in launches))
     return launches
 
 

@@ -23,7 +23,7 @@ from spark_fsm_tpu import config
 from spark_fsm_tpu.service import model, plugins, sources
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
-from spark_fsm_tpu.utils import faults
+from spark_fsm_tpu.utils import faults, obs
 from spark_fsm_tpu.utils.obs import log_event, profile_trace
 from spark_fsm_tpu.utils.retry import RetryPolicy
 
@@ -51,6 +51,11 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
     store.delete(f"fsm:frontier:{uid}")
     store.delete(f"fsm:frontier:results:{uid}")
     log_event("job_failed", uid=uid, error=str(exc))
+    # stamp the terminal failure into the job's flight-recorder ring
+    # (explicit trace_id: failures land from threads with no active
+    # trace context — the drain path, the submit-after-shutdown path)
+    with obs.span("job.failed", trace_id=uid, error=str(exc)):
+        pass
 
 
 def _profile_dir(req: ServiceRequest, uid: str) -> str:
@@ -149,6 +154,10 @@ class StoreCheckpoint:
         return state
 
     def save(self, state: dict) -> None:
+        with obs.span("checkpoint.save", trace_id=self.uid):
+            self._save(state)
+
+    def _save(self, state: dict) -> None:
         faults.fault_site("checkpoint.save", uid=self.uid)
         # NON-DESTRUCTIVE: pop from a shallow copy, never the caller's
         # dict — a store failure mid-save must leave the engine's state
@@ -230,6 +239,12 @@ class Miner:
         log_event("job_submitted", uid=req.uid,
                   algorithm=req.param("algorithm", "SPADE_TPU"),
                   source=req.param("source", "FILE"))
+        # the flight-recorder trace opens AT SUBMIT (handler thread):
+        # the queue wait before a worker picks the job up is part of
+        # the job's story under load
+        obs.trace_begin(req.uid,
+                        algorithm=req.param("algorithm", "SPADE_TPU"),
+                        source=req.param("source", "FILE"))
         with self._stop_lock:
             if not self._stopping:
                 # enqueued strictly BEFORE the sentinels (the lock orders
@@ -287,10 +302,22 @@ class Miner:
                     self.store.incr("fsm:metric:jobs_retried")
                     log_event("job_retry", uid=req.uid, attempt=attempt,
                               error=str(exc))
+                    with obs.span("job.retry", trace_id=req.uid,
+                                  attempt=attempt, error=str(exc)):
+                        pass
 
     def _run(self, req: ServiceRequest) -> None:
+        # the job's root flight-recorder span: every engine/planner/IO
+        # span below threads under it via the contextvar — no plumbing
+        with obs.trace(req.uid, site="job",
+                       algorithm=req.param("algorithm", "SPADE_TPU"),
+                       source=req.param("source", "FILE")) as job_sp:
+            self._run_traced(req, job_sp)
+
+    def _run_traced(self, req: ServiceRequest, job_sp) -> None:
         t0 = time.perf_counter()
-        db = sources.get_db(req, self.store)
+        with obs.span("job.dataset"):
+            db = sources.get_db(req, self.store)
         self.store.add_status(req.uid, Status.DATASET)
         plugin = plugins.get_plugin(req)
         stats: Dict[str, object] = {
@@ -298,6 +325,7 @@ class Miner:
             "sequences": len(db),
             "dataset_s": round(time.perf_counter() - t0, 4),
         }
+        job_sp.set(algorithm=plugin.name, sequences=len(db))
         ckpt: Optional[StoreCheckpoint] = None
         if (req.param("checkpoint") or "").lower() not in ("", "0", "false",
                                                            "no", "off"):
@@ -306,7 +334,7 @@ class Miner:
                 every_s=float(req.param("checkpoint_every_s", "30")))
         trace_dir = _profile_dir(req, req.uid)
         t1 = time.perf_counter()
-        with profile_trace(trace_dir):
+        with profile_trace(trace_dir), obs.span("job.mine"):
             results = plugin.extract(req, db, stats, checkpoint=ckpt)
         mine_s = time.perf_counter() - t1
         stats["mine_s"] = round(mine_s, 4)
@@ -314,10 +342,11 @@ class Miner:
         stats["results_per_s"] = round(len(results) / mine_s, 2) if mine_s else 0.0
         if trace_dir:
             stats["profile_trace"] = trace_dir
-        self.store.set(f"fsm:stats:{req.uid}", json.dumps(stats))
-        _sink_results(self.store, req.uid, plugin.kind, results)
-        self.store.add_status(req.uid, Status.TRAINED)
-        self.store.add_status(req.uid, Status.FINISHED)
+        with obs.span("job.sink", results=len(results)):
+            self.store.set(f"fsm:stats:{req.uid}", json.dumps(stats))
+            _sink_results(self.store, req.uid, plugin.kind, results)
+            self.store.add_status(req.uid, Status.TRAINED)
+            self.store.add_status(req.uid, Status.FINISHED)
         if ckpt is not None:
             # only AFTER the results are durable: a sink failure retried
             # mid-way must resume from the final frontier, not re-mine.
@@ -681,7 +710,11 @@ class Streamer:
         uid = f"stream:{topic}"
         miner = state["miner"]
         win_key = f"fsm:stream:window:{topic}"
-        with state["lock"]:
+        # one flight-recorder trace per topic (uid "stream:{topic}"),
+        # a root span per push: the window re-mine's engine spans
+        # thread under it exactly like a batch job's
+        with state["lock"], obs.trace(uid, site="stream.push",
+                                      topic=topic, sequences=len(batch)):
             try:
                 try:
                     results = miner.push(batch)
@@ -714,12 +747,39 @@ class Streamer:
                 results=str(len(results)))
 
 
+def _jobs_collector(store: ResultStore):
+    """Scrape-time bridge from the store's job counters to canonical
+    fsm_* names — the /admin/stats ``jobs`` block keys are aliases of
+    these.  A store that is down (or chaos-armed) skips its rows: the
+    scrape must stay readable during the drill it is diagnosing."""
+    names = ("jobs_submitted", "jobs_finished", "jobs_failed",
+             "jobs_retried", "stream_pushes", "stream_failures")
+
+    def collect():
+        rows = []
+        for n in names:
+            try:
+                # peek, not get: a scrape must never trip (or consume)
+                # an armed store.get injection, or a pinned-seed chaos
+                # drill goes nondeterministic under concurrent scraping
+                v = int(store.peek(f"fsm:metric:{n}") or 0)
+            except Exception:
+                continue
+            rows.append((f"fsm_{n}_total", "counter", "", [({}, v)]))
+        return rows
+
+    return collect
+
+
 class Master:
     """Routes tasks to workers — the reference's FSMMaster."""
 
     def __init__(self, store: Optional[ResultStore] = None,
                  miner_workers: int = 1) -> None:
         self.store = store if store is not None else ResultStore()
+        # the registry keys one "jobs" collector process-wide: the last
+        # Master built owns it (tests build many; the service builds one)
+        obs.REGISTRY.register_collector("jobs", _jobs_collector(self.store))
         self.miner = Miner(self.store, workers=miner_workers)
         self.questor = Questor(self.store)
         self.tracker = Tracker(self.store)

@@ -26,7 +26,13 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         ``fault_injection = true``);
   /admin/health       — per-subsystem recovery counters: armed faults,
                         I/O retry/backoff, dispatch watchdog, devcache
-                        circuit breakers, consumer leaked threads
+                        circuit breakers, consumer leaked threads;
+  /metrics            — the unified registry in Prometheus text
+                        exposition format (GET; utils/obs.REGISTRY —
+                        point a scrape job here);
+  /admin/trace/{job}  — flight-recorder span dump for a job uid (JSON;
+                        requires [observability] trace = true);
+  /admin/trace/last   — the most recently touched trace
 
 Runs on the stdlib ThreadingHTTPServer: the service layer is deliberately
 dependency-free; heavy lifting happens in the engines (device) behind the
@@ -47,6 +53,7 @@ from urllib.parse import parse_qsl, urlsplit
 
 from spark_fsm_tpu import config as cfgmod
 from spark_fsm_tpu.service import plugins
+from spark_fsm_tpu.utils import obs
 from spark_fsm_tpu.service.actors import Master
 from spark_fsm_tpu.service.model import ServiceRequest
 from spark_fsm_tpu.service.store import RedisResultStore, ResultStore
@@ -77,13 +84,25 @@ class FsmHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args) -> None:  # quiet by default
         pass
 
-    def _send(self, code: int, payload: str) -> None:
+    def _send(self, code: int, payload: str,
+              content_type: str = "application/json") -> None:
         body = payload.encode("utf-8")
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _metrics(self) -> None:
+        # Prometheus text exposition of the whole registry (metrics are
+        # ALWAYS on — a scrape must work whether or not tracing is)
+        try:
+            self._send(200, obs.REGISTRY.render_prometheus(),
+                       content_type="text/plain; version=0.0.4; "
+                                    "charset=utf-8")
+        except Exception as exc:
+            self._send(500, json.dumps({"status": "failure",
+                                        "error": str(exc)}))
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib naming)
         try:
@@ -95,6 +114,9 @@ class FsmHandler(BaseHTTPRequestHandler):
             self._send(400, json.dumps({"status": "failure", "error": str(exc)}))
             return
 
+        if head == "metrics":
+            self._metrics()
+            return
         if head == "admin":
             self._admin(tail, data)
             return
@@ -121,7 +143,7 @@ class FsmHandler(BaseHTTPRequestHandler):
         # GET convenience mirrors POST for read-only endpoints.
         url = urlsplit(self.path)
         head, _ = _route(url.path)
-        if head in ("status", "get", "admin"):
+        if head in ("status", "get", "admin", "metrics"):
             self.do_POST()
         else:
             self._send(405, json.dumps({"status": "failure",
@@ -191,6 +213,30 @@ class FsmHandler(BaseHTTPRequestHandler):
                     "counters": faults.counters()}))
             elif task == "health":
                 self._send(200, json.dumps(health_report(self.master)))
+            elif task == "trace" or task.startswith("trace/"):
+                # read-only flight-recorder dumps: /admin/trace/{job_id}
+                # (uid may itself contain slashes — keep the whole tail),
+                # /admin/trace/last, bare /admin/trace lists trace ids
+                _, _, tid = task.partition("/")
+                if not tid:
+                    self._send(200, json.dumps({
+                        "enabled": obs.tracing_enabled(),
+                        "traces": obs.trace_ids(),
+                        "last": obs.last_trace_id(),
+                        **obs.recorder_stats()}))
+                    return
+                if tid == "last":
+                    tid = obs.last_trace_id() or ""
+                dump = obs.trace_dump(tid) if tid else None
+                if dump is None:
+                    self._send(404, json.dumps({
+                        "status": "failure",
+                        "error": (f"no trace for {tid!r}"
+                                  if obs.tracing_enabled() else
+                                  "tracing disabled (set [observability] "
+                                  "trace = true in the boot config)")}))
+                    return
+                self._send(200, json.dumps(dump))
             elif task == "shapes":
                 # enumerated (last prewarm) vs runtime-recorded shape
                 # keys; "drift" lists observed geometries prewarm missed
@@ -250,6 +296,11 @@ def service_stats(master: Master) -> dict:
                     {"keys": report["keys"],
                      "total_wall_s": report["total_wall_s"],
                      "ts": report["ts"]}),
+        # the canonical registry view (utils/obs.REGISTRY — what
+        # GET /metrics exposes): the blocks above are documented ALIASES
+        # of these fsm_* names for one release (docs/OPERATIONS.md
+        # tables the mapping)
+        "metrics": obs.REGISTRY.snapshot(),
     }
 
 
@@ -291,6 +342,18 @@ def health_report(master: Master) -> dict:
         },
         "consumers": consumer_health(),
         "jobs": jobs,
+        "tracing": {"enabled": obs.tracing_enabled(),
+                    **obs.recorder_stats()},
+        # canonical fsm_* registry names; the blocks above stay as
+        # aliases for one release (docs/OPERATIONS.md "Metric names").
+        # The jobs counters are deliberately read twice per response
+        # (direct from THIS master's store above, via the registered
+        # collector here): the collector is process-global and may be
+        # bound to another master's store in multi-master test setups,
+        # so the alias block must not be derived from it — six extra
+        # guard-free peeks per health poll is the price of that
+        # correctness.
+        "metrics": obs.REGISTRY.snapshot(),
     }
 
 

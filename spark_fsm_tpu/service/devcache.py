@@ -48,7 +48,7 @@ from typing import List, Optional
 import numpy as np
 
 from spark_fsm_tpu.data.spmf import SequenceDB
-from spark_fsm_tpu.utils import faults
+from spark_fsm_tpu.utils import faults, obs
 from spark_fsm_tpu.utils.canonical import PatternResult
 from spark_fsm_tpu.utils.obs import log_event
 from spark_fsm_tpu.utils.retry import CircuitBreaker
@@ -124,6 +124,8 @@ class _EngineCacheBase:
         if not self.breaker.allow():
             with self._lock:
                 self.stats["breaker_fallbacks"] += 1
+            obs.trace_event("devcache_breaker_fallback",
+                            cache=type(self).__name__)
             return fallback_fn()
         try:
             faults.fault_site("devcache.put", cache=type(self).__name__)
@@ -149,9 +151,13 @@ class _EngineCacheBase:
                 e.busy = True
                 self._entries.move_to_end(key)
                 self.stats["hits"] += 1
-                return e
-            self.stats["busy_misses" if e is not None else "misses"] += 1
-            return None
+                kind = "hit"
+            else:
+                kind = "busy_miss" if e is not None else "miss"
+                self.stats["busy_misses" if e is not None else "misses"] += 1
+                e = None
+        obs.trace_event("devcache_" + kind, cache=type(self).__name__)
+        return e
 
     def _mine_checked_out(self, entry: _Entry, runner=None):
         """Run a checked-out engine's mine: zero the accumulated numeric
@@ -601,3 +607,33 @@ class TsrEngineCache(_EngineCacheBase):
 spade_engine_cache = SpadeEngineCache()
 cspade_engine_cache = CSpadeEngineCache()
 tsr_engine_cache = TsrEngineCache()
+
+_BREAKER_STATE_CODE = {CircuitBreaker.CLOSED: 0, CircuitBreaker.HALF_OPEN: 1,
+                       CircuitBreaker.OPEN: 2}
+
+
+def _collect_metrics():
+    """fsm_devcache_* / fsm_breaker_* families for the unified registry
+    — the /admin/stats per-cache blocks and /admin/health ``breakers``
+    block are aliases of these (cache labels reuse their JSON key
+    names: store_cache / cspade_cache / tsr_cache)."""
+    caches = (("store_cache", spade_engine_cache),
+              ("cspade_cache", cspade_engine_cache),
+              ("tsr_cache", tsr_engine_cache))
+    fams = []
+    for key in ("hits", "misses", "busy_misses", "evictions",
+                "breaker_fallbacks"):
+        fams.append((f"fsm_devcache_{key}_total", "counter", "",
+                     [({"cache": name}, c.stats.get(key, 0))
+                      for name, c in caches]))
+    snaps = [(name, c.breaker.snapshot()) for name, c in caches]
+    fams.append(("fsm_breaker_state", "gauge",
+                 "0=closed 1=half-open 2=open",
+                 [({"cache": name}, _BREAKER_STATE_CODE[s["state"]])
+                  for name, s in snaps]))
+    fams.append(("fsm_breaker_opens_total", "counter", "",
+                 [({"cache": name}, s["opens"]) for name, s in snaps]))
+    return fams
+
+
+obs.REGISTRY.register_collector("devcache", _collect_metrics)

@@ -174,6 +174,13 @@ def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
 
     k, minconf, max_side = _tsr_params(req)
     kwargs = _tsr_kwargs()
+    # use_pallas: "auto" (default, engine probes the backend) / truthy
+    # (force the kernel path — interpret mode off-TPU; how a chaos
+    # drill exercises the OOM degradation ladder over HTTP on any
+    # backend) / falsy (pin the jnp evaluator)
+    up = (req.param("use_pallas") or "").lower()
+    if up and up != "auto":
+        kwargs["use_pallas"] = up not in ("0", "false", "no", "off")
     if req.task == "stream":  # see _spade_tpu: bucket drifting windows
         kwargs["shape_buckets"] = True
     if checkpoint is None and req.task != "stream":

@@ -34,9 +34,15 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from spark_fsm_tpu.utils import faults, shapes
+from spark_fsm_tpu.utils import faults, obs, shapes
 from spark_fsm_tpu.utils.jitcache import compile_counts, enable_compile_counter
 from spark_fsm_tpu.utils.obs import log_event
+
+_COMPILE_SECONDS = obs.REGISTRY.histogram(
+    "fsm_prewarm_compile_seconds",
+    "per-shape-key AOT prewarm wall (service/prewarm.run)")
+_COMPILE_ERRORS = obs.REGISTRY.counter(
+    "fsm_prewarm_errors_total", "prewarm keys that failed to compile")
 
 _lock = threading.Lock()
 _last_report: Optional[dict] = None
@@ -394,43 +400,12 @@ def run(spec: shapes.WorkloadSpec, *, mesh=None,
                                       engine_kwargs=engine_kwargs)
     rows: List[dict] = []
     t_all = time.monotonic()
-    for key, t in sorted(targets.items()):
-        c0 = compile_counts()
-        t0 = time.monotonic()
-        err = None
-        try:
-            # chaos seam: an injected compile failure here proves the
-            # per-key isolation below (one bad key must not take down
-            # boot or the other keys' warms)
-            faults.fault_site("prewarm.compile", shape_key=key,
-                              kind=t["kind"])
-            if t["kind"] == "classic":
-                _warm_classic(t, mesh, eng_sub)
-            elif t["kind"] == "queue":
-                _warm_queue(t, mesh)
-            elif t["kind"] == "fused":
-                _warm_fused(t, mesh)
-            elif t["kind"] == "cspade":
-                _warm_cspade(t, mesh, eng_sub)
-            elif t["kind"] == "tsr":
-                _warm_tsr(t, mesh)
-            elif t["kind"] == "tsr_eval":
-                pass  # warmed by the "tsr" entry's ladder walk; the
-                # separate key exists so /admin/shapes drift can name
-                # the exact launch geometry a live mine would compile
-            elif t["kind"] == "sweep":
-                _warm_sweep(t, mesh)
-        except Exception as exc:  # a failed warm must not take down boot
-            err = f"{type(exc).__name__}: {exc}"
-        c1 = compile_counts()
-        row = {"shape_key": key, "kind": t["kind"],
-               "wall_s": round(time.monotonic() - t0, 3),
-               "fresh_compiles": c1["count"] - c0["count"],
-               "compile_s": round(c1["seconds"] - c0["seconds"], 3)}
-        if err:
-            row["error"] = err
-        rows.append(row)
-        log_event("prewarm_key", **row)
+    # prewarm owns a trace of its own (uid "prewarm"): boot/admin
+    # compile walls are readable at /admin/trace/prewarm when tracing
+    # is on, one span per shape key
+    ctx = obs.trace("prewarm", site="prewarm", keys=len(targets))
+    with ctx:
+        rows.extend(_run_keys(targets, mesh, eng_sub))
     report = {
         "keys": rows,
         "enumerated": sorted(targets),
@@ -444,6 +419,51 @@ def run(spec: shapes.WorkloadSpec, *, mesh=None,
     log_event("prewarm_done", keys=len(rows),
               total_wall_s=report["total_wall_s"])
     return report
+
+
+def _run_keys(targets, mesh, eng_sub) -> List[dict]:
+    rows: List[dict] = []
+    for key, t in sorted(targets.items()):
+        c0 = compile_counts()
+        t0 = time.monotonic()
+        err = None
+        with obs.span("prewarm.compile", shape_key=key, kind=t["kind"]):
+            try:
+                # chaos seam: an injected compile failure here proves the
+                # per-key isolation below (one bad key must not take down
+                # boot or the other keys' warms)
+                faults.fault_site("prewarm.compile", shape_key=key,
+                                  kind=t["kind"])
+                if t["kind"] == "classic":
+                    _warm_classic(t, mesh, eng_sub)
+                elif t["kind"] == "queue":
+                    _warm_queue(t, mesh)
+                elif t["kind"] == "fused":
+                    _warm_fused(t, mesh)
+                elif t["kind"] == "cspade":
+                    _warm_cspade(t, mesh, eng_sub)
+                elif t["kind"] == "tsr":
+                    _warm_tsr(t, mesh)
+                elif t["kind"] == "tsr_eval":
+                    pass  # warmed by the "tsr" entry's ladder walk; the
+                    # separate key exists so /admin/shapes drift can name
+                    # the exact launch geometry a live mine would compile
+                elif t["kind"] == "sweep":
+                    _warm_sweep(t, mesh)
+            except Exception as exc:  # a failed warm must not take down
+                err = f"{type(exc).__name__}: {exc}"  # boot
+                _COMPILE_ERRORS.inc()
+        _COMPILE_SECONDS.observe(time.monotonic() - t0, kind=t["kind"])
+        c1 = compile_counts()
+        row = {"shape_key": key, "kind": t["kind"],
+               "wall_s": round(time.monotonic() - t0, 3),
+               "fresh_compiles": c1["count"] - c0["count"],
+               "compile_s": round(c1["seconds"] - c0["seconds"], 3)}
+        if err:
+            row["error"] = err
+        rows.append(row)
+        log_event("prewarm_key", **row)
+    return rows
 
 
 def last_report() -> Optional[dict]:

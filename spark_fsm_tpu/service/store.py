@@ -23,7 +23,34 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from spark_fsm_tpu.utils import faults
+from spark_fsm_tpu.utils import faults, obs
+
+# Latency of the three guarded store verbs, labelled by op and backend
+# (inproc latencies are the no-op baseline a Redis deployment's numbers
+# are read against).  Sub-ms buckets dominate; the shared ladder keeps
+# cross-metric comparisons on one set of edges.
+_STORE_OP_SECONDS = obs.REGISTRY.histogram(
+    "fsm_store_op_seconds", "result-store I/O verb latency")
+
+
+class _timed:
+    """Tiny context manager: observe the verb's wall into the shared
+    histogram even when the verb raises (a slow FAILING store is the
+    case the scrape most needs to show)."""
+
+    __slots__ = ("op", "backend", "t0")
+
+    def __init__(self, op: str, backend: str):
+        self.op = op
+        self.backend = backend
+
+    def __enter__(self):
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        _STORE_OP_SECONDS.observe(time.monotonic() - self.t0,
+                                  op=self.op, backend=self.backend)
 
 
 class ResultStore:
@@ -41,19 +68,31 @@ class ResultStore:
     # top (StoreCheckpoint) re-run the whole verb safely.
 
     def set(self, key: str, value: str) -> None:
-        faults.fault_site("store.set", key=key)
-        with self._lock:
-            self._kv[key] = value
+        with _timed("set", "inproc"):
+            faults.fault_site("store.set", key=key)
+            with self._lock:
+                self._kv[key] = value
 
     def get(self, key: str) -> Optional[str]:
-        faults.fault_site("store.get", key=key)
+        with _timed("get", "inproc"):
+            faults.fault_site("store.get", key=key)
+            with self._lock:
+                return self._kv.get(key)
+
+    def peek(self, key: str) -> Optional[str]:
+        """Guard-free read for scrape-time metric collectors: skips the
+        fault-injection site AND the latency histogram, so a /metrics
+        scrape can never advance (or consume) an armed ``store.get``
+        trigger mid-chaos-drill, and collector reads don't pollute the
+        I/O latency distribution they exist to report."""
         with self._lock:
             return self._kv.get(key)
 
     def rpush(self, key: str, value: str) -> None:
-        faults.fault_site("store.rpush", key=key)
-        with self._lock:
-            self._lists.setdefault(key, []).append(value)
+        with _timed("rpush", "inproc"):
+            faults.fault_site("store.rpush", key=key)
+            with self._lock:
+                self._lists.setdefault(key, []).append(value)
 
     def lrange(self, key: str) -> List[str]:
         with self._lock:
@@ -164,16 +203,22 @@ class RedisResultStore(ResultStore):
         self._r.ping()  # fail fast at boot, not on first job
 
     def set(self, key: str, value: str) -> None:
-        faults.fault_site("store.set", key=key)
-        self._r.set(key, value)
+        with _timed("set", "redis"):
+            faults.fault_site("store.set", key=key)
+            self._r.set(key, value)
 
     def get(self, key: str) -> Optional[str]:
-        faults.fault_site("store.get", key=key)
+        with _timed("get", "redis"):
+            faults.fault_site("store.get", key=key)
+            return self._r.get(key)
+
+    def peek(self, key: str) -> Optional[str]:
         return self._r.get(key)
 
     def rpush(self, key: str, value: str) -> None:
-        faults.fault_site("store.rpush", key=key)
-        self._r.rpush(key, value)
+        with _timed("rpush", "redis"):
+            faults.fault_site("store.rpush", key=key)
+            self._r.rpush(key, value)
 
     def lrange(self, key: str) -> List[str]:
         return self._r.lrange(key, 0, -1)

@@ -48,6 +48,7 @@ import time
 from typing import Callable, Optional
 
 from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.utils import obs
 from spark_fsm_tpu.utils.obs import log_event
 from spark_fsm_tpu.utils.retry import RetryPolicy
 
@@ -55,6 +56,37 @@ FetchFn = Callable[[], Optional[SequenceDB]]
 
 _health_lock = threading.Lock()
 _health = {"leaked_threads": 0}
+# consume-side freshness: wall clock of the last poll and the last
+# NON-IDLE poll across every consumer in the process.  The scrape-time
+# gauge fsm_consumer_poll_lag_seconds = now - last consumed batch — the
+# pull-loop notion of consumer lag (a healthy idle topic grows it too,
+# so read it next to fsm_consumer_batches_total; a growing lag WITH
+# busy polls means the sink, not the broker, is behind).
+_last_poll_ts: Optional[float] = None
+_last_batch_ts: Optional[float] = None
+
+_POLL_SECONDS = obs.REGISTRY.histogram(
+    "fsm_consumer_poll_seconds", "fetch() wall per poll")
+_POLLS_TOTAL = obs.REGISTRY.counter("fsm_consumer_polls_total")
+_BATCHES_TOTAL = obs.REGISTRY.counter("fsm_consumer_batches_total")
+_ERRORS_TOTAL = obs.REGISTRY.counter("fsm_consumer_errors_total")
+
+
+def _collect_metrics():
+    fams = [("fsm_consumer_leaked_threads_total", "counter",
+             "poll threads that outran stop()'s join deadline",
+             [({}, consumer_health()["leaked_threads"])])]
+    now = time.monotonic()
+    for name, ts in (("fsm_consumer_poll_age_seconds", _last_poll_ts),
+                     ("fsm_consumer_poll_lag_seconds", _last_batch_ts)):
+        if ts is not None:
+            fams.append((name, "gauge",
+                         "seconds since the last poll / consumed batch",
+                         [({}, round(now - ts, 3))]))
+    return fams
+
+
+obs.REGISTRY.register_collector("consumer", _collect_metrics)
 
 
 def consumer_health() -> dict:
@@ -129,9 +161,18 @@ class PollConsumer:
         Raises StopConsumer through (the run loop turns it into a clean
         stop); other exceptions are absorbed into the error counters.
         """
+        global _last_poll_ts, _last_batch_ts
         self.stats["polls"] += 1
+        _POLLS_TOTAL.inc()
+        t0 = time.monotonic()
         try:
-            batch = self._fetch()
+            try:
+                batch = self._fetch()
+            finally:
+                # poll latency covers the FETCH only (the broker seam);
+                # sink time is the window miner's own story
+                _POLL_SECONDS.observe(time.monotonic() - t0)
+                _last_poll_ts = time.monotonic()
             if not batch:
                 self.stats["idle_polls"] += 1
                 return False
@@ -145,6 +186,8 @@ class PollConsumer:
         self._consecutive_errors = 0
         self.stats["batches"] += 1
         self.stats["sequences"] += len(batch)
+        _BATCHES_TOTAL.inc()
+        _last_batch_ts = time.monotonic()
         if self._on_result is not None:
             try:
                 self._on_result(result)
@@ -160,6 +203,9 @@ class PollConsumer:
         """Count + surface an error; the reporting callback itself must
         never kill the loop."""
         self.stats["errors"] += 1
+        _ERRORS_TOTAL.inc()
+        obs.trace_event("consumer_error",
+                        error=f"{type(exc).__name__}: {exc}")
         if self._on_error is not None:
             try:
                 self._on_error(exc)

@@ -34,7 +34,11 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from spark_fsm_tpu.data.spmf import SequenceDB, parse_spmf
-from spark_fsm_tpu.utils import faults
+from spark_fsm_tpu.utils import faults, obs
+
+_BAD_RECORDS = obs.REGISTRY.counter(
+    "fsm_kafka_bad_records_total",
+    "records that failed to decode/parse (both on_bad modes)")
 
 # dead-letter ring: the last N undecodable payloads are kept in stats
 # (truncated, with partition/offset when the record exposes one) so a
@@ -92,6 +96,10 @@ class KafkaFetch:
             "error": f"{type(exc).__name__}: {exc}",
         })
         del ring[:-DEAD_LETTER_RING]
+        _BAD_RECORDS.inc()
+        obs.trace_event("kafka_dead_letter", partition=str(partition),
+                        offset=getattr(rec, "offset", None),
+                        error=f"{type(exc).__name__}: {exc}")
 
     def __call__(self) -> Optional[SequenceDB]:
         self.stats["polls"] += 1

@@ -42,6 +42,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Optional
 
+from spark_fsm_tpu.utils import obs
+
 
 class FaultInjected(RuntimeError):
     """Raised by :func:`fault_site` when an armed trigger fires."""
@@ -112,6 +114,32 @@ _armed: Dict[str, _Spec] = {}
 # lifetime per-site counters (survive disarm — /admin/health reads them)
 _counters: Dict[str, Dict[str, int]] = {}
 _active = False  # fast-path flag: fault_site returns on one global read
+
+
+def _collect_metrics():
+    """fsm_fault_site_* families for the unified registry.  EVERY
+    registered site emits series (zero-valued until touched): an armed
+    site with no metric would be an orphan counter, which
+    scripts/obs_smoke.sh exists to catch."""
+    with _lock:
+        per_site = {s: dict(c) for s, c in _counters.items()}
+        n_armed = len(_armed)
+    for s in KNOWN_SITES:
+        per_site.setdefault(s, {"calls": 0, "injected": 0})
+    return [
+        ("fsm_fault_site_calls_total", "counter",
+         "guarded calls observed while the site was armed",
+         [({"site": s}, c["calls"]) for s, c in sorted(per_site.items())]),
+        ("fsm_fault_site_injected_total", "counter",
+         "injections actually fired",
+         [({"site": s}, c["injected"]) for s, c in sorted(per_site.items())]),
+        ("fsm_fault_sites_armed", "gauge",
+         "armed fault sites (should be 0 outside a chaos drill)",
+         [({}, n_armed)]),
+    ]
+
+
+obs.REGISTRY.register_collector("faults", _collect_metrics)
 
 
 def arm(site: str, *, nth: Optional[int] = None, every: Optional[int] = None,
@@ -213,6 +241,8 @@ def fault_site(site: str, **ctx) -> None:
         delay_s, exc = spec.delay_s, spec.exc
     # sleep OUTSIDE the lock: a simulated hang must not block every
     # other site's bookkeeping (or the watchdog's own log path)
+    obs.trace_event("fault_injected", site=site,
+                    delay_s=delay_s, raises=exc is not None)
     if delay_s:
         time.sleep(delay_s)
     if exc is not None:

@@ -1,19 +1,61 @@
-"""Observability: structured logging + profiler trace capture.
+"""Observability: unified metrics registry + per-job flight recorder
+(plus the structured-log / jax.profiler seams that predate them).
 
-The reference gets logging from log4j/slf4j and profiling from the Spark
-web UI (SURVEY.md sec 5 tracing + metrics rows).  The rebuild's analogs:
-structured JSON-line logs through stdlib ``logging`` (one object per line
-— grep/jq-able job lifecycle events), and ``jax.profiler`` trace capture
-(XProf/Perfetto-readable) scoped around a mine when a job asks for it.
+The reference gets logging from log4j/slf4j, metrics from the Spark web
+UI and profiling from Spark's event timeline (SURVEY.md sec 5 tracing +
+metrics rows).  The rebuild grew deep machinery those analogs cannot
+see: the ragged planner picks launch geometries from a cost model, the
+watchdog derives deadlines from the same model, and the recovery paths
+(retry/backoff, OOM degradation ladder, devcache breaker) fire with no
+record of WHEN or in what order — lifetime counters cannot show a
+straggler launch or a retry storm.  This module is the one
+zero-dependency substrate for all of it:
+
+- **metrics registry** (:data:`REGISTRY`): process-global counters,
+  gauges, and fixed-bucket latency histograms under ONE naming scheme
+  (``fsm_<subsystem>_<name>``, counters suffixed ``_total``), rendered
+  in Prometheus text exposition format by ``GET /metrics``
+  (service/app.py).  Subsystems that already keep their own counters
+  (utils/retry, utils/watchdog, utils/faults, service/devcache,
+  streaming/consumer, the job counters in the result store) register
+  scrape-time COLLECTORS that read those counters into canonical
+  ``fsm_*`` names — the existing dicts stay the source of truth, the
+  registry is the one window onto them, and ``/admin/stats`` /
+  ``/admin/health`` keep their old JSON keys as aliases (the mapping is
+  tabled in docs/OPERATIONS.md).
+- **flight recorder**: a per-job bounded ring of structured SPANS
+  (``trace_id`` = job uid, site, monotonic t_start/t_end, attrs, and
+  point-in-time EVENTS for fault trips, retry waits, watchdog timeouts,
+  OOM downgrades, breaker transitions).  A trace opens at mine submit
+  (service/actors.Miner) and threads through engine dispatch, ragged-
+  planner launches, device readback, and store/checkpoint/Kafka I/O via
+  a contextvar — no constructor plumbing.  Each launch span carries the
+  planner's PREDICTED seconds next to the measured wall, so cost-model
+  residuals become a first-class gauge (``fsm_costmodel_drift_ratio``)
+  that calibrates the watchdog slack.  ``GET /admin/trace/<job_id>``
+  dumps a trace; ``/admin/trace/last`` the most recent one.
+
+Tracing is config-gated (``[observability] trace``) and the DISABLED
+path costs one module-global read per probe — the same pin as the fault
+registry (scripts/bench_smoke.sh asserts the dispatch-shape counters
+stay byte-identical).  Metrics are always on: registry writes are a
+lock + dict update, and ``/metrics`` must serve even when tracing is
+off.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import contextvars
+import itertools
 import json
 import logging
+import re
 import threading
 import time
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("spark_fsm_tpu")
 
@@ -58,3 +100,636 @@ def profile_trace(trace_dir: str):
 
     with _trace_lock, jax.profiler.trace(trace_dir):
         yield
+
+
+# ===========================================================================
+# Metrics registry
+# ===========================================================================
+
+# One naming scheme for every exported series: fsm_<subsystem>_<name>,
+# counters suffixed _total.  The registry REFUSES other spellings — a
+# metric that drifts off the scheme would silently fork the namespace
+# the Prometheus scrape (and the OPERATIONS.md table) is keyed on.
+_NAME_RE = re.compile(r"^fsm_[a-z][a-z0-9_]*$")
+
+# Default latency bucket edges (seconds): sub-ms store ops through
+# minutes-long prewarm compiles share one ladder so cross-metric
+# comparisons read off the same edges.
+LATENCY_BUCKETS_S = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                     30.0, 60.0)
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    """Base: thread-safe {label-key: value} map."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} violates the fsm_<subsystem>_<name> "
+                "scheme (lowercase, fsm_ prefix)")
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def _set(self, value: float, labels: dict) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def _add(self, n: float, labels: dict) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...], float]]:
+        """[(suffix, label_key, value)] — suffix appended to the family
+        name in exposition ("" for plain counters/gauges)."""
+        with self._lock:
+            return [("", k, v) for k, v in self._values.items()]
+
+    def snapshot(self):
+        """JSON-able value view: scalar for the unlabelled series, else
+        {"k=v,...": value}."""
+        with self._lock:
+            if list(self._values) == [()]:
+                return self._values[()]
+            return {",".join(f"{k}={v}" for k, v in key): val
+                    for key, val in self._values.items()}
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        # seed the unlabelled series at 0: a scrape must distinguish
+        # "zero events" from "metric missing" (the orphan-counter
+        # failure mode the collectors' KNOWN_SITES zero-seeding guards
+        # against, applied to the registry's own counters) — rate()
+        # alerts on never-touched counters read 0, not no-data
+        self._values[()] = 0.0
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({n})")
+        self._add(n, labels)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        self._set(float(value), labels)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket cumulative histogram (Prometheus semantics: bucket
+    edges are INCLUSIVE upper bounds, ``+Inf`` is implicit, ``_sum`` and
+    ``_count`` ride along)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Tuple[float, ...] = LATENCY_BUCKETS_S):
+        super().__init__(name, help)
+        edges = tuple(float(b) for b in buckets)
+        if not edges or list(edges) != sorted(set(edges)):
+            raise ValueError(f"histogram {name}: bucket edges must be a "
+                             f"nonempty strictly increasing tuple ({buckets})")
+        self.buckets = edges
+        # label_key -> [per-edge counts..., +Inf count, sum]
+        self._h: Dict[Tuple[Tuple[str, str], ...], List[float]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        v = float(value)
+        key = _label_key(labels)
+        i = bisect.bisect_left(self.buckets, v)  # first edge >= v
+        with self._lock:
+            row = self._h.get(key)
+            if row is None:
+                row = self._h[key] = [0.0] * (len(self.buckets) + 1) + [0.0]
+            row[min(i, len(self.buckets))] += 1
+            row[-1] += v
+
+    def samples(self):
+        out = []
+        with self._lock:
+            rows = {k: list(v) for k, v in self._h.items()}
+        for key, row in rows.items():
+            cum = 0.0
+            for edge, n in zip(self.buckets, row):
+                cum += n
+                out.append(("_bucket", key + (("le", _fmt(edge)),), cum))
+            cum += row[len(self.buckets)]
+            out.append(("_bucket", key + (("le", "+Inf"),), cum))
+            out.append(("_count", key, cum))
+            out.append(("_sum", key, row[-1]))
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                (",".join(f"{k}={v}" for k, v in key) or "all"): {
+                    "count": sum(row[:-1]), "sum": round(row[-1], 6)}
+                for key, row in self._h.items()}
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(v)
+
+
+class MetricsRegistry:
+    """Process-global metric store + scrape-time collector list.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create (re-requesting
+    a name returns the same object; a kind mismatch is a bug and
+    raises).  ``register_collector(name, fn)`` installs a callable run
+    at scrape time that returns a list of
+    ``(name, kind, help, [(labels_dict, value), ...])`` families —
+    the bridge for subsystems that already keep counters elsewhere
+    (retry/watchdog/faults/devcache/consumer/job counters); registering
+    the same collector name again REPLACES it (tests build many masters).
+    A collector that raises is skipped — ``/metrics`` must stay
+    readable during a chaos drill, same posture as /admin/health.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "OrderedDict[str, _Metric]" = OrderedDict()
+        self._collectors: "OrderedDict[str, Callable]" = OrderedDict()
+
+    def _get_or_make(self, cls, name, help, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif type(m) is not cls:
+                raise ValueError(f"metric {name!r} already registered as "
+                                 f"{m.kind}, not {cls.kind}")
+            elif ("buckets" in kw
+                  and tuple(float(b) for b in kw["buckets"]) != m.buckets):
+                # a silent edge mismatch would bin the second caller's
+                # observations against a ladder it never asked for
+                raise ValueError(
+                    f"histogram {name!r} already registered with buckets "
+                    f"{m.buckets}, requested {tuple(kw['buckets'])}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_make(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Tuple[float, ...] = LATENCY_BUCKETS_S) -> Histogram:
+        return self._get_or_make(Histogram, name, help, buckets=buckets)
+
+    def register_collector(self, name: str, fn: Callable) -> None:
+        with self._lock:
+            self._collectors[name] = fn
+
+    def _collected(self):
+        with self._lock:
+            collectors = list(self._collectors.items())
+        fams = []
+        for cname, fn in collectors:
+            try:
+                fams.extend(fn())
+            except Exception as exc:  # scrape survives a failing subsystem
+                log_event("metrics_collector_failed", collector=cname,
+                          error=f"{type(exc).__name__}: {exc}")
+        return fams
+
+    def render_prometheus(self) -> str:
+        """The full registry + collectors in Prometheus text exposition
+        format (version 0.0.4)."""
+        lines: List[str] = []
+
+        def emit(name, kind, help, samples):
+            if help:
+                lines.append(f"# HELP {name} {help}")
+            lines.append(f"# TYPE {name} {kind}")
+            for suffix, key, value in samples:
+                lbl = ("{" + ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in key) + "}"
+                    if key else "")
+                lines.append(f"{name}{suffix}{lbl} {_fmt(float(value))}")
+
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            emit(m.name, m.kind, m.help, m.samples())
+        for name, kind, help, rows in self._collected():
+            if not _NAME_RE.match(name):
+                continue  # a collector cannot fork the namespace either
+            emit(name, kind, help,
+                 [("", _label_key(labels), value) for labels, value in rows])
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able {canonical name: value} view of the whole registry
+        (collectors included) — what /admin/stats and /admin/health
+        embed so their old JSON keys become documented aliases of these
+        names."""
+        out: dict = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            out[m.name] = m.snapshot()
+        for name, kind, help, rows in self._collected():
+            vals = {(",".join(f"{k}={v}" for k, v in _label_key(labels))):
+                    value for labels, value in rows}
+            out[name] = vals.pop("", None) if list(vals) == [""] else vals
+        return out
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+REGISTRY = MetricsRegistry()
+
+# -- registry-native metrics owned by this module ---------------------------
+
+_SPANS_TOTAL = REGISTRY.counter(
+    "fsm_trace_spans_total", "flight-recorder spans completed")
+_SPANS_DROPPED = REGISTRY.counter(
+    "fsm_trace_spans_dropped_total",
+    "spans evicted from per-job rings (ring full)")
+_COSTMODEL_SAMPLES = REGISTRY.counter(
+    "fsm_costmodel_samples_total",
+    "dispatch walls compared against the ragged planner's estimate")
+_COSTMODEL_DRIFT = REGISTRY.gauge(
+    "fsm_costmodel_drift_ratio",
+    "EWMA of measured/predicted dispatch wall — the watchdog-slack "
+    "calibration input (slack should exceed this with margin)")
+_COSTMODEL_RESIDUAL = REGISTRY.histogram(
+    "fsm_costmodel_residual_ratio",
+    "distribution of measured/predicted dispatch wall",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 64.0, 256.0))
+
+_DRIFT_ALPHA = 0.2  # EWMA weight for the newest residual
+_drift_lock = threading.Lock()
+_drift_ewma: Optional[float] = None
+
+
+def observe_costmodel(predicted_s: float, measured_s: float) -> None:
+    """Feed one (predicted, measured) dispatch-wall pair into the
+    cost-model calibration gauge.  Ratios are measured/predicted, so a
+    drifting gauge reads directly as "the planner underestimates by
+    Nx" — the number ``[engine] watchdog_slack`` must stay above.
+    Pairs with a degenerate prediction are dropped (a zero-traffic
+    dispatch says nothing about the model)."""
+    global _drift_ewma
+    if predicted_s <= 0:
+        return
+    ratio = measured_s / predicted_s
+    _COSTMODEL_SAMPLES.inc()
+    _COSTMODEL_RESIDUAL.observe(ratio)
+    with _drift_lock:
+        _drift_ewma = (ratio if _drift_ewma is None
+                       else _DRIFT_ALPHA * ratio
+                       + (1 - _DRIFT_ALPHA) * _drift_ewma)
+        _COSTMODEL_DRIFT.set(_drift_ewma)
+
+
+def costmodel_drift() -> Optional[float]:
+    """Current measured/predicted EWMA (None until the first sample)."""
+    with _drift_lock:
+        return _drift_ewma
+
+
+# ===========================================================================
+# Flight recorder
+# ===========================================================================
+
+# Fast-path flag: every probe (span(), trace_event(), trace()) returns
+# after ONE module-global read when tracing is off — the same contract
+# as utils/faults._active, and pinned the same way (test_obs.py asserts
+# zero span allocations + bench_smoke asserts byte-identical dispatch
+# counters).
+_trace_on = False
+
+_cfg_lock = threading.Lock()
+_max_spans = 512   # per-job completed-span ring bound
+_max_jobs = 16     # job traces kept (oldest evicted)
+
+_span_ids = itertools.count(1)
+
+# the active trace/span of THIS logical context (worker thread / task):
+# engine internals record into whatever job is mining on their thread
+# without any constructor plumbing
+_cur_trace: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "fsm_trace", default=None)
+_cur_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "fsm_span", default=None)
+
+
+class Span:
+    """One timed unit of work inside a trace.  ``event`` records a
+    point-in-time marker (fault trip, retry wait, OOM downgrade,
+    breaker transition); ``set`` attaches/overrides attrs (e.g. the
+    measured wall next to the predicted one).  Close via the context
+    manager — the span enters its trace's ring only on exit."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "site", "t0", "t1",
+                 "attrs", "events", "error", "_token")
+
+    def __init__(self, trace_id: str, parent_id: Optional[int], site: str,
+                 attrs: dict):
+        self.trace_id = trace_id
+        self.span_id = next(_span_ids)
+        self.parent_id = parent_id
+        self.site = site
+        self.t0 = time.monotonic()
+        self.t1: Optional[float] = None
+        self.attrs = attrs
+        self.events: List[dict] = []
+        self.error: Optional[str] = None
+        self._token = None
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        e = {"name": name, "t": round(time.monotonic() - self.t0, 6)}
+        if attrs:
+            e.update(attrs)
+        self.events.append(e)
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        return None if self.t1 is None else self.t1 - self.t0
+
+    def __enter__(self) -> "Span":
+        self._token = _cur_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _cur_span.reset(self._token)
+            self._token = None
+        self.t1 = time.monotonic()
+        if exc is not None:
+            self.error = f"{type(exc).__name__}: {exc}"
+        _recorder.record(self)
+
+    def to_dict(self) -> dict:
+        d = {"span_id": self.span_id, "parent_id": self.parent_id,
+             "site": self.site, "t_start": round(self.t0, 6),
+             "t_end": None if self.t1 is None else round(self.t1, 6),
+             "duration_s": (None if self.t1 is None
+                            else round(self.t1 - self.t0, 6))}
+        if self.attrs:
+            d["attrs"] = {k: v for k, v in self.attrs.items()}
+        if self.events:
+            d["events"] = list(self.events)
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+class _NoopSpan:
+    """The shared disabled-path span: every method is a no-op and
+    ``span()`` returns THIS SINGLETON when tracing is off — no
+    allocation, no clock read (the disabled-cost pin in test_obs.py)."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Trace:
+    __slots__ = ("trace_id", "spans", "dropped", "started_wall", "attrs")
+
+    def __init__(self, trace_id: str, max_spans: int, attrs: dict):
+        self.trace_id = trace_id
+        self.spans: "deque[Span]" = deque(maxlen=max_spans)
+        self.dropped = 0
+        self.started_wall = time.time()
+        self.attrs = attrs
+
+
+class FlightRecorder:
+    """Bounded ring-of-rings: at most ``_max_jobs`` traces, each a
+    deque of at most ``_max_spans`` COMPLETED spans (completion order;
+    oldest evicted first — the straggler hunt cares about the tail of
+    a job, not its warmup).  Spans record on close, under one lock —
+    concurrent miner workers interleave safely."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, _Trace]" = OrderedDict()
+        self._last: Optional[str] = None
+        self._sinks: List[Callable] = []
+
+    def begin(self, trace_id: str, attrs: dict) -> None:
+        with self._lock:
+            t = self._traces.get(trace_id)
+            if t is None:
+                # a re-run/retried uid keeps ONE ring: the old spans stay
+                # until evicted, so a retry's trace shows the failed
+                # attempt's tail next to the re-run — the order of
+                # recovery events is the point of the recorder
+                t = self._traces[trace_id] = _Trace(trace_id, _max_spans,
+                                                    attrs)
+                while len(self._traces) > _max_jobs:
+                    self._traces.popitem(last=False)
+            else:
+                t.attrs.update(attrs)
+            self._traces.move_to_end(trace_id)
+            self._last = trace_id
+
+    def record(self, span: Span) -> None:
+        sinks = None
+        with self._lock:
+            t = self._traces.get(span.trace_id)
+            if t is not None:
+                if len(t.spans) == t.spans.maxlen:
+                    t.dropped += 1
+                    _SPANS_DROPPED.inc()
+                t.spans.append(span)
+                self._last = span.trace_id
+            if self._sinks:
+                sinks = list(self._sinks)
+        _SPANS_TOTAL.inc()
+        if sinks:
+            for fn in sinks:
+                try:
+                    fn(span)
+                except Exception:
+                    pass  # a reporting sink must never fail the work
+        if logger.isEnabledFor(logging.INFO):  # skip the dumps when quiet
+            log_event("span", trace=span.trace_id, site=span.site,
+                      duration_s=round(span.duration_s or 0.0, 6),
+                      **({"error": span.error} if span.error else {}))
+
+    def dump(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            t = self._traces.get(trace_id)
+            if t is None:
+                return None
+            spans = [s.to_dict() for s in t.spans]
+            return {"trace_id": t.trace_id, "started_ts": t.started_wall,
+                    "attrs": dict(t.attrs), "spans": spans,
+                    "dropped_spans": t.dropped, "n_spans": len(spans)}
+
+    def last_trace_id(self) -> Optional[str]:
+        with self._lock:
+            return self._last
+
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"traces": len(self._traces),
+                    "spans": sum(len(t.spans) for t in
+                                 self._traces.values()),
+                    "dropped": sum(t.dropped for t in
+                                   self._traces.values())}
+
+    def add_sink(self, fn: Callable) -> None:
+        with self._lock:
+            self._sinks.append(fn)
+
+    def remove_sink(self, fn: Callable) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self._last = None
+
+
+_recorder = FlightRecorder()
+
+
+def configure_tracing(enabled: bool, max_spans: Optional[int] = None,
+                      max_jobs: Optional[int] = None) -> None:
+    """Set the process-wide tracing policy (the boot config's
+    ``[observability]`` block owns it via config.set_config; tests may
+    call directly).  Ring bounds apply to traces begun AFTER the call."""
+    global _trace_on, _max_spans, _max_jobs
+    with _cfg_lock:
+        if max_spans is not None:
+            if max_spans < 1:
+                raise ValueError(f"max_spans must be >= 1 (got {max_spans})")
+            _max_spans = int(max_spans)
+        if max_jobs is not None:
+            if max_jobs < 1:
+                raise ValueError(f"max_jobs must be >= 1 (got {max_jobs})")
+            _max_jobs = int(max_jobs)
+        _trace_on = bool(enabled)
+
+
+def tracing_enabled() -> bool:
+    return _trace_on
+
+
+@contextlib.contextmanager
+def trace(trace_id: str, site: str = "job", **attrs):
+    """Activate ``trace_id`` for this context and open its root span.
+    No-op (one global read) when tracing is off."""
+    if not _trace_on:
+        yield _NOOP
+        return
+    _recorder.begin(trace_id, dict(attrs))
+    token = _cur_trace.set(trace_id)
+    try:
+        with Span(trace_id, None, site, dict(attrs)) as sp:
+            yield sp
+    finally:
+        _cur_trace.reset(token)
+
+
+def trace_begin(trace_id: str, **attrs) -> None:
+    """Create the trace ring (idempotent) and stamp a zero-length
+    ``submit`` span — called from the HTTP handler thread at mine
+    submit, before the worker thread opens the job's root span."""
+    if not _trace_on:
+        return
+    _recorder.begin(trace_id, dict(attrs))
+    with Span(trace_id, None, "job.submit", dict(attrs)):
+        pass
+
+
+def span(site: str, trace_id: Optional[str] = None, **attrs):
+    """Open a span under the current trace (or an explicit one).
+    Returns the no-op singleton when tracing is off OR no trace is
+    active — engine code calls this unconditionally and pays one global
+    read outside a traced job."""
+    if not _trace_on:
+        return _NOOP
+    tid = trace_id if trace_id is not None else _cur_trace.get()
+    if tid is None:
+        return _NOOP
+    parent = _cur_span.get()
+    return Span(tid, parent.span_id if parent is not None else None,
+                site, dict(attrs))
+
+
+def trace_event(name: str, **attrs) -> None:
+    """Record a point-in-time event on the current innermost span —
+    the one-liner fault/retry/watchdog/breaker call sites use.  One
+    global read when tracing is off or no span is open."""
+    if not _trace_on:
+        return
+    sp = _cur_span.get()
+    if sp is not None:
+        sp.event(name, **attrs)
+
+
+def trace_dump(trace_id: str) -> Optional[dict]:
+    return _recorder.dump(trace_id)
+
+
+def last_trace_id() -> Optional[str]:
+    return _recorder.last_trace_id()
+
+
+def trace_ids() -> List[str]:
+    return _recorder.trace_ids()
+
+
+def recorder_stats() -> dict:
+    return _recorder.stats()
+
+
+def add_span_sink(fn: Callable) -> None:
+    """Register a callable invoked with every COMPLETED span (tracing
+    on only).  Used by the opt-in test-suite slow-span report
+    (tests/conftest.py, SPARKFSM_TRACE_TESTS=1)."""
+    _recorder.add_sink(fn)
+
+
+def remove_span_sink(fn: Callable) -> None:
+    _recorder.remove_sink(fn)
+
+
+def clear_traces() -> None:
+    """Drop every recorded trace (test isolation helper)."""
+    _recorder.clear()

@@ -21,10 +21,35 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from spark_fsm_tpu.utils import obs
 from spark_fsm_tpu.utils.obs import log_event
 
 _lock = threading.Lock()
 _counters: Dict[str, Dict[str, int]] = {}
+
+# Retry-policy sites wired into the framework itself (callers may add
+# ad-hoc sites; these are the ones scripts/obs_smoke.sh asserts have
+# registry series even before their first use — a policy with no
+# metric would be invisible exactly when it matters).
+KNOWN_SITES = ("store.checkpoint",)
+
+
+def _collect_metrics():
+    """fsm_retry_* families for the unified registry; every KNOWN_SITES
+    policy emits zero-valued series from boot (no orphan counters)."""
+    with _lock:
+        per_site = {s: dict(c) for s, c in _counters.items()}
+    for s in KNOWN_SITES:
+        per_site.setdefault(s, {"attempts": 0, "retries": 0, "gave_up": 0})
+    fams = []
+    for key in ("attempts", "retries", "gave_up"):
+        fams.append((f"fsm_retry_{key}_total", "counter", "",
+                     [({"site": s}, c.get(key, 0))
+                      for s, c in sorted(per_site.items())]))
+    return fams
+
+
+obs.REGISTRY.register_collector("retry", _collect_metrics)
 
 
 def _count(site: str, key: str, n: int = 1) -> None:
@@ -101,9 +126,13 @@ class RetryPolicy:
                     _count(site, "gave_up")
                     raise
                 _count(site, "retries")
+                wait_s = self.delay_s(attempt)
                 log_event("io_retry", site=site, attempt=attempt,
                           error=f"{type(exc).__name__}: {exc}")
-                self._sleep(self.delay_s(attempt))
+                obs.trace_event("io_retry", site=site, attempt=attempt,
+                                wait_s=round(wait_s, 4),
+                                error=f"{type(exc).__name__}: {exc}")
+                self._sleep(wait_s)
 
 
 class CircuitBreaker:
@@ -169,6 +198,7 @@ class CircuitBreaker:
             self._probing = False
             if self._state != self.CLOSED:
                 log_event("breaker_closed", breaker=self.name)
+                obs.trace_event("breaker_closed", breaker=self.name)
             self._state = self.CLOSED
 
     def failure(self) -> None:
@@ -185,6 +215,8 @@ class CircuitBreaker:
                     self._counts["opens"] += 1
                     log_event("breaker_opened", breaker=self.name,
                               consecutive=self._consecutive)
+                    obs.trace_event("breaker_opened", breaker=self.name,
+                                    consecutive=self._consecutive)
 
     def state(self) -> str:
         with self._lock:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from spark_fsm_tpu.utils import obs
 from spark_fsm_tpu.utils.obs import log_event
 
 
@@ -37,6 +38,24 @@ class WatchdogTimeout(TimeoutError):
 _lock = threading.Lock()
 _cfg = {"slack": None, "floor_s": 2.0}
 _stats = {"guarded": 0, "timeouts": 0, "leaked_threads": 0}
+
+
+def _collect_metrics():
+    """Canonical fsm_watchdog_* names for the unified registry — the
+    /admin/health ``watchdog`` block keys are aliases of these
+    (docs/OPERATIONS.md tables the mapping)."""
+    with _lock:
+        st = dict(_stats)
+        slack = _cfg["slack"]
+    fams = [(f"fsm_watchdog_{k}_total", "counter", "", [({}, v)])
+            for k, v in st.items()]
+    fams.append(("fsm_watchdog_slack", "gauge",
+                 "configured deadline slack (0 = watchdog disabled)",
+                 [({}, 0.0 if slack is None else slack)]))
+    return fams
+
+
+obs.REGISTRY.register_collector("watchdog", _collect_metrics)
 
 
 def configure(slack: Optional[float] = None, floor_s: float = 2.0) -> None:
@@ -102,6 +121,8 @@ def run_with_deadline(fn: Callable, deadline: Optional[float],
             _stats["timeouts"] += 1
             _stats["leaked_threads"] += 1
         log_event("watchdog_timeout", site=site, deadline_s=deadline)
+        obs.trace_event("watchdog_timeout", site=site,
+                        deadline_s=round(deadline, 4))
         raise WatchdogTimeout(
             f"dispatch at {site!r} outran its {deadline:.3f}s watchdog "
             f"deadline (reader thread abandoned)")

@@ -80,9 +80,81 @@ def _assert_faults_disarmed(when: str) -> None:
         f"(use faults.injected(...) or a try/finally disarm)")
 
 
+# --------------------------------------------------------------------------
+# Opt-in suite flight recording: SPARKFSM_TRACE_TESTS=1 enables the
+# utils/obs flight recorder for the whole session (each test runs under
+# its own trace via the autouse fixture below) and prints the 10
+# slowest spans at session end — the straggler hunt for tier-1 runtime
+# regressions.  Off by default: tier-1 keeps the one-global-read
+# disabled path and tests that reconfigure tracing stay isolated.
+# --------------------------------------------------------------------------
+
+import heapq  # noqa: E402
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+_TRACE_TESTS = bool(os.environ.get("SPARKFSM_TRACE_TESTS"))
+_slowest: list = []  # min-heap of (duration_s, seq, site, trace_id)
+_slow_seq = 0
+_SLOW_KEEP = 10
+_slow_lock = threading.Lock()
+
+
+def _slow_sink(span) -> None:
+    # spans complete on miner workers, HTTP handler threads, and the
+    # obs thread-safety test's own pool — the shared heap needs a lock
+    # (a corrupted heap would silently wrong the straggler report)
+    global _slow_seq
+    d = span.duration_s
+    if d is None:
+        return
+    with _slow_lock:
+        _slow_seq += 1
+        item = (d, _slow_seq, span.site, span.trace_id)
+        if len(_slowest) < _SLOW_KEEP:
+            heapq.heappush(_slowest, item)
+        else:
+            heapq.heappushpop(_slowest, item)
+
+
+@pytest.fixture(autouse=True)
+def _trace_test(request):
+    """Under SPARKFSM_TRACE_TESTS=1 every test body runs inside its own
+    trace, so engine/service spans land somewhere countable.  A no-op
+    (tracing stays off, zero overhead) otherwise."""
+    if not _TRACE_TESTS:
+        yield
+        return
+    from spark_fsm_tpu.utils import obs
+
+    # re-enable per test: any earlier test that called config.set_config
+    # (whose ObservabilityConfig defaults to trace=False) or toggled
+    # tracing directly disabled the recorder — without this, the
+    # slowest-span report would silently cover only the tests before
+    # the first such call
+    obs.configure_tracing(True, max_spans=256, max_jobs=8)
+    with obs.trace(f"test:{request.node.nodeid}"):
+        yield
+
+
 def pytest_sessionstart(session):
     _assert_faults_disarmed("start")
+    if _TRACE_TESTS:
+        from spark_fsm_tpu.utils import obs
+
+        obs.configure_tracing(True, max_spans=256, max_jobs=8)
+        obs.add_span_sink(_slow_sink)
 
 
 def pytest_sessionfinish(session, exitstatus):
     _assert_faults_disarmed("end")
+    if _TRACE_TESTS:
+        from spark_fsm_tpu.utils import obs
+
+        obs.remove_span_sink(_slow_sink)
+        obs.configure_tracing(False)
+        rep = sorted(_slowest, reverse=True)
+        print("\n-- SPARKFSM_TRACE_TESTS: 10 slowest spans --")
+        for d, _, site, trace_id in rep:
+            print(f"  {d:9.3f}s  {site:<20} {trace_id}")

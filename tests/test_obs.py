@@ -1,0 +1,326 @@
+"""Observability layer: metrics registry + flight recorder (utils/obs).
+
+Covers the tentpole contracts: histogram bucket-edge semantics,
+ring-buffer eviction order, thread-safety under concurrent actor spans,
+the disabled-cost pin (NO span allocation when tracing is off — the
+same one-global-read posture as the fault registry), and the
+acceptance-path trace: a TSR mine under an armed ``device.oom`` fault
+dumps the launch span, its RESOURCE_EXHAUSTED event, the half-width
+re-plan child spans, and predicted-vs-measured seconds per launch.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.models.tsr import TsrTPU
+from spark_fsm_tpu.utils import faults, obs
+
+
+@pytest.fixture(autouse=True)
+def _tracing_reset():
+    """Every test starts from tracing-off defaults and leaves no trace
+    rings behind (the recorder is process-global)."""
+    enabled0 = obs.tracing_enabled()
+    yield
+    obs.configure_tracing(enabled0, max_spans=512, max_jobs=16)
+    obs.clear_traces()
+
+
+# ------------------------------------------------------------ registry
+
+def test_histogram_bucket_edges():
+    """Edges are INCLUSIVE upper bounds (Prometheus le= semantics):
+    a value exactly on an edge lands in that bucket, above the last
+    edge lands only in +Inf, and bucket counts are cumulative."""
+    h = obs.Histogram("fsm_test_edges_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.10001, 1.0, 10.0, 11.0):
+        h.observe(v)
+    by_le = {dict(key)["le"]: val
+             for suffix, key, val in h.samples() if suffix == "_bucket"}
+    assert by_le == {"0.1": 2,    # 0.05, 0.1 (edge inclusive)
+                     "1": 4,      # + 0.10001, 1.0
+                     "10": 5,     # + 10.0
+                     "+Inf": 6}   # + 11.0
+    counts = {s: v for s, key, v in h.samples() if s == "_count"}
+    sums = {s: v for s, key, v in h.samples() if s == "_sum"}
+    assert counts["_count"] == 6
+    assert abs(sums["_sum"] - 22.25001) < 1e-9
+
+
+def test_histogram_rejects_bad_edges():
+    with pytest.raises(ValueError):
+        obs.Histogram("fsm_test_bad_seconds", buckets=(1.0, 1.0))
+    with pytest.raises(ValueError):
+        obs.Histogram("fsm_test_bad2_seconds", buckets=())
+
+
+def test_fresh_counter_emits_zero_sample():
+    """A never-incremented counter must scrape as 0, not as a missing
+    series — 'no data' and 'zero events' are different answers to an
+    alert rule."""
+    c = obs.REGISTRY.counter("fsm_test_untouched_total")
+    assert ("", (), 0.0) in c.samples()
+    assert "fsm_test_untouched_total 0" in obs.REGISTRY.render_prometheus()
+
+
+def test_histogram_bucket_mismatch_raises():
+    obs.REGISTRY.histogram("fsm_test_ladder_seconds", buckets=(0.5, 5.0))
+    # same edges: get-or-create returns the existing instance
+    obs.REGISTRY.histogram("fsm_test_ladder_seconds", buckets=(0.5, 5.0))
+    with pytest.raises(ValueError):
+        obs.REGISTRY.histogram("fsm_test_ladder_seconds", buckets=(1.0, 2.0))
+
+
+def test_registry_enforces_naming_scheme():
+    with pytest.raises(ValueError):
+        obs.Counter("jobs_total")  # missing fsm_ prefix
+    with pytest.raises(ValueError):
+        obs.Counter("fsm_Bad_Case")
+    with pytest.raises(ValueError):
+        obs.REGISTRY.counter("fsm_trace_spans_total").inc(-1)  # decrease
+    # kind mismatch on an existing name is a bug, not a silent re-make
+    with pytest.raises(ValueError):
+        obs.REGISTRY.gauge("fsm_trace_spans_total")
+
+
+def test_collector_failure_does_not_break_scrape():
+    obs.REGISTRY.register_collector("_test_boom",
+                                    lambda: 1 / 0)
+    try:
+        text = obs.REGISTRY.render_prometheus()
+        assert "fsm_trace_spans_total" in text
+    finally:
+        obs.REGISTRY.register_collector("_test_boom", lambda: [])
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_ring_eviction_order():
+    """The per-job ring keeps the LAST max_spans completed spans, in
+    completion order, and counts what it dropped."""
+    obs.configure_tracing(True, max_spans=3, max_jobs=4)
+    with obs.trace("job-ring"):
+        for i in range(6):
+            with obs.span("step", i=i):
+                pass
+    # root span completes LAST, so the ring holds steps 4, 5, root
+    dump = obs.trace_dump("job-ring")
+    assert [s["site"] for s in dump["spans"]] == ["step", "step", "job"]
+    assert [s.get("attrs", {}).get("i") for s in dump["spans"]][:2] == [4, 5]
+    assert dump["dropped_spans"] == 4  # steps 0-3
+    assert dump["n_spans"] == 3
+
+
+def test_job_ring_eviction():
+    obs.configure_tracing(True, max_spans=8, max_jobs=2)
+    for uid in ("j1", "j2", "j3"):
+        with obs.trace(uid):
+            pass
+    assert obs.trace_dump("j1") is None  # oldest evicted
+    assert obs.trace_dump("j2") is not None
+    assert obs.trace_dump("j3") is not None
+    assert obs.last_trace_id() == "j3"
+
+
+def test_thread_safety_concurrent_actor_spans():
+    """N worker threads each trace their own job concurrently (the
+    Miner-pool shape): every trace keeps exactly its own spans and the
+    global counters add up — no lost updates, no cross-talk."""
+    obs.configure_tracing(True, max_spans=200, max_jobs=16)
+    n_threads, n_spans = 8, 50
+    errors = []
+
+    def work(k):
+        try:
+            with obs.trace(f"job-{k}"):
+                for i in range(n_spans):
+                    with obs.span("step", thread=k, i=i) as sp:
+                        sp.event("tick", i=i)
+        except Exception as exc:  # pragma: no cover - the assert is below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=work, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for k in range(n_threads):
+        dump = obs.trace_dump(f"job-{k}")
+        steps = [s for s in dump["spans"] if s["site"] == "step"]
+        assert len(steps) == n_spans
+        assert all(s["attrs"]["thread"] == k for s in steps)
+        assert dump["dropped_spans"] == 0
+
+
+def test_disabled_cost_pin():
+    """Tracing off: span() hands back ONE shared no-op singleton (no
+    allocation, no clock read), trace_event is a no-op, and nothing
+    reaches the recorder — the engine-side cost is a single
+    module-global read, same as the fault registry's pin."""
+    obs.configure_tracing(False)
+    before = obs.recorder_stats()
+    spans_metric0 = obs.REGISTRY.counter("fsm_trace_spans_total").snapshot()
+    s1 = obs.span("tsr.launch", km=1, width=128)
+    s2 = obs.span("tsr.readback")
+    assert s1 is s2  # the singleton: zero per-probe allocation
+    with s1 as sp:
+        sp.event("never_recorded")
+        sp.set(x=1)
+    obs.trace_event("never_recorded")
+    with obs.trace("ghost-job") as root:
+        root.event("nope")
+    assert obs.recorder_stats() == before
+    assert obs.trace_dump("ghost-job") is None
+    assert obs.REGISTRY.counter(
+        "fsm_trace_spans_total").snapshot() == spans_metric0
+
+
+def test_span_without_active_trace_is_noop():
+    obs.configure_tracing(True, max_spans=16, max_jobs=4)
+    # probe from a fresh thread: threads start with an empty context, so
+    # no trace is active there even when the suite itself runs traced
+    # (SPARKFSM_TRACE_TESTS wraps every test body in a trace)
+    box = []
+    t = threading.Thread(
+        target=lambda: box.append(obs.span("orphan") is obs.span("orphan2")))
+    t.start()
+    t.join()
+    assert box == [True]
+    # explicit trace_id records even without a context trace
+    obs._recorder.begin("explicit", {})
+    with obs.span("pinned", trace_id="explicit"):
+        pass
+    assert [s["site"] for s in obs.trace_dump("explicit")["spans"]] \
+        == ["pinned"]
+
+
+def test_scrape_does_not_consume_chaos_triggers():
+    """A /metrics scrape (or the snapshot embedded in /admin/stats and
+    /admin/health) must never advance an armed store.get trigger: the
+    jobs collector reads via the guard-free peek, so a pinned-seed
+    chaos drill stays deterministic under concurrent scraping."""
+    from spark_fsm_tpu.service.actors import Master
+
+    m = Master()
+    try:
+        # delta, not absolute: the per-site counters are LIFETIME (they
+        # survive disarm), so earlier chaos tests legitimately leave
+        # nonzero store.get counts behind
+        before = faults.counters().get("store.get", {"calls": 0,
+                                                     "injected": 0})
+        with faults.injected("store.get", nth=1):
+            obs.REGISTRY.render_prometheus()
+            obs.REGISTRY.snapshot()
+            after = faults.counters().get("store.get", before)
+        assert after.get("calls", 0) == before.get("calls", 0), (before,
+                                                                 after)
+        assert after.get("injected", 0) == before.get("injected", 0)
+    finally:
+        m.shutdown()
+
+
+# ------------------------------------------------- acceptance: OOM trace
+
+def test_oom_ladder_trace_dump():
+    """A traced TSR mine under an armed device.oom fault dumps: the
+    launch span carrying the RESOURCE_EXHAUSTED event, half-width
+    re-plan CHILD spans nested under it, and predicted-vs-measured
+    seconds on every launch span (the acceptance scenario at engine
+    level; scripts/obs_smoke.sh drives the same story over the real
+    /admin/trace HTTP surface)."""
+    db = synthetic_db(seed=29, n_sequences=60, n_items=14,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    obs.configure_tracing(True, max_spans=4096, max_jobs=4)
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 10, 0.4,
+                 max_side=2, use_pallas=True)
+    with faults.injected("device.oom", nth=1):
+        with obs.trace("oom-mine", algorithm="TSR_TPU"):
+            eng.mine()
+    assert eng.stats.get("degraded_launches", 0) >= 1
+    dump = obs.trace_dump("oom-mine")
+    spans = dump["spans"]
+    oom = [s for s in spans
+           for e in s.get("events", ())
+           if e["name"] == "resource_exhausted"]
+    assert oom, f"no RESOURCE_EXHAUSTED event in {sorted({s['site'] for s in spans})}"
+    parent = oom[0]
+    assert parent["site"] == "tsr.launch"
+    assert "RESOURCE_EXHAUSTED" in [
+        e for e in parent["events"] if e["name"] == "resource_exhausted"
+    ][0]["error"]
+    kids = [s for s in spans if s["parent_id"] == parent["span_id"]
+            and s["site"] == "tsr.launch"]
+    assert kids, "no half-width re-plan child spans"
+    assert all(k["attrs"]["width"] == parent["attrs"]["width"] // 2
+               for k in kids)
+    launches = [s for s in spans if s["site"] == "tsr.launch"]
+    assert all("predicted_s" in s["attrs"] and s["duration_s"] is not None
+               for s in launches)
+    readbacks = [s for s in spans if s["site"] == "tsr.readback"]
+    assert readbacks and all("measured_s" in s["attrs"] for s in readbacks)
+    # the residual gauge saw those dispatches
+    assert obs.costmodel_drift() is not None
+
+
+def test_span_launch_count_matches_engine_counter():
+    """The bench_smoke cross-check invariant at test scale: span-derived
+    launch count == the engine's kernel_launches counter, and tracing
+    does not perturb the dispatch-shape counters."""
+    db = synthetic_db(seed=7, n_sequences=50, n_items=12,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    base = TsrTPU(build_vertical(db, min_item_support=1), 10, 0.4,
+                  max_side=2)
+    want = base.mine()
+    obs.configure_tracing(True, max_spans=1 << 14, max_jobs=4)
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 10, 0.4,
+                 max_side=2)
+    with obs.trace("xcheck"):
+        got = eng.mine()
+    assert got == want
+    for key in ("kernel_launches", "evaluated", "traffic_units"):
+        assert eng.stats[key] == base.stats[key]
+    dump = obs.trace_dump("xcheck")
+    n_spans = sum(1 for s in dump["spans"]
+                  if s["site"] in ("tsr.launch", "tsr.prep"))
+    assert n_spans == eng.stats["kernel_launches"]
+    assert dump["dropped_spans"] == 0
+
+
+# ------------------------------------------------------- HTTP endpoints
+
+def test_metrics_endpoint_and_trace_404():
+    """GET /metrics serves the registry regardless of tracing;
+    /admin/trace/{uid} 404s while tracing is off (read-only, never an
+    error path for the service)."""
+    from spark_fsm_tpu.service.app import serve_background
+
+    obs.configure_tracing(False)
+    srv = serve_background()
+    try:
+        port = srv.server_port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "# TYPE fsm_trace_spans_total counter" in text
+        assert "fsm_fault_site_calls_total" in text
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/admin/trace/nope", timeout=30)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+            assert "tracing disabled" in json.loads(
+                exc.read().decode())["error"]
+    finally:
+        srv.master.shutdown()
+        srv.shutdown()
