@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Overload + kill-restart smoke: the ISSUE 5 drills against the REAL
+service across REAL process boundaries.
+
+The CI companion to chaos_smoke.sh for the admission/recovery layer.
+It boots the HTTP service as a subprocess with a TINY admission queue
+(``queue_depth = 2``, one miner worker) over a MiniRedis store (the
+in-process RESP server from tests/test_redis_store.py — the store must
+survive the service's death), then:
+
+1. submits a long CHECKPOINTED mine (the chaos lab arms a per-save
+   delay so the drill job reliably outlives the orchestration below);
+2. floods past capacity: 2 submits queue, 3 more must shed with HTTP
+   429 + a sane integer ``Retry-After``, and ``/metrics`` must report
+   ``fsm_service_sheds_total == 3`` with the queue-depth gauge at 2;
+3. kill -9s the service between frontier saves;
+4. reboots it on the same store and asserts the boot recovery pass
+   resumes the checkpointed job from its journal + frontier (it must
+   reach ``finished`` with results), gives both queued filler jobs a
+   durable "interrupted by restart" failure, and settles every journal
+   intent (the queue-depth gauge reads 0 again).
+
+Usage: scripts/overload_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+BOOT_TIMEOUT_S = 180.0
+DRILL_TIMEOUT_S = 300.0
+
+
+def log(msg):
+    print(f"overload_smoke: {msg}", flush=True)
+
+
+def post(port, endpoint, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{endpoint}"
+    try:
+        with urllib.request.urlopen(url, data=data, timeout=60) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read().decode())
+
+
+def scrape(port, family):
+    """Sum every sample of ``family`` in /metrics (labels collapsed)."""
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=60) as resp:
+        text = resp.read().decode()
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        m = re.match(rf"^{re.escape(family)}(\{{[^}}]*\}})?\s+(\S+)$", line)
+        if m:
+            total += float(m.group(2))
+            seen = True
+    assert seen, f"{family} missing from /metrics"
+    return total
+
+
+def boot_service(cfg_path, env):
+    child = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import sys\n"
+        f"sys.argv = ['app', '--config', {str(cfg_path)!r}]\n"
+        "from spark_fsm_tpu.service.app import main\n"
+        "main()\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    port = None
+    recovery_line = None
+    deadline = time.time() + BOOT_TIMEOUT_S
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"service died at boot (rc={proc.poll()})")
+        if line.startswith("restart recovery:"):
+            recovery_line = line.strip()
+        if "spark_fsm_tpu service on http://" in line:
+            port = int(line.rsplit(":", 1)[1])
+            break
+    assert port is not None, "no boot line within the timeout"
+    return proc, port, recovery_line
+
+
+def main():
+    from test_redis_store import MiniRedis  # noqa: E402 (tests/ on path)
+
+    from spark_fsm_tpu.service.resp import RespClient
+
+    mini = MiniRedis()
+    log(f"MiniRedis on port {mini.port}")
+    client = RespClient(port=mini.port)
+
+    tmp = tempfile.mkdtemp(prefix="overload_smoke_")
+    cfg_path = os.path.join(tmp, "config.json")
+    with open(cfg_path, "w") as fh:
+        json.dump({
+            "fault_injection": True,  # the per-save delay arms via HTTP
+            "service": {"port": 0, "miner_workers": 1, "queue_depth": 2},
+            "store": {"backend": "redis", "host": "127.0.0.1",
+                      "port": mini.port},
+            # pin the queue engine so the checkpointed drill takes the
+            # segmented path (frontier saves at every segment boundary)
+            "engine": {"fused": "queue"},
+        }, fh)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    proc, port, _ = boot_service(cfg_path, env)
+    log(f"service A on port {port} (pid {proc.pid})")
+    try:
+        # slow every frontier save by 1s so the drill job reliably
+        # outlives the flood + kill below (incarnation-local: dies with A)
+        code, _, _ = post(port, "/admin/faults", action="arm",
+                          site="checkpoint.save", every="1",
+                          delay_s="1.0", exc="none")
+        assert code == 200, "chaos lab refused the arm"
+
+        from spark_fsm_tpu.data.spmf import format_spmf
+        from spark_fsm_tpu.data.synth import synthetic_db
+
+        db = synthetic_db(seed=41, n_sequences=200, n_items=12,
+                          mean_itemsets=3.0, mean_itemset_size=1.3)
+        code, _, body = post(port, "/train", uid="drill",
+                             algorithm="SPADE_TPU", source="INLINE",
+                             sequences=format_spmf(db), support="0.05",
+                             checkpoint="1", checkpoint_every_s="0")
+        assert code == 200 and body["status"] == "started", body
+
+        # occupy the queue (depth 2) behind the running drill
+        for uid in ("filler0", "filler1"):
+            code, _, body = post(port, "/train", uid=uid,
+                                 algorithm="SPADE", source="INLINE",
+                                 sequences="1 -1 2 -2\n", support="1.0")
+            assert code == 200 and body["status"] == "started", body
+        assert scrape(port, "fsm_service_queue_depth") == 2
+
+        # flood past capacity: exactly 3 sheds, each 429 + Retry-After
+        for i in range(3):
+            code, headers, body = post(port, "/train", uid=f"shed{i}",
+                                       algorithm="SPADE", source="INLINE",
+                                       sequences="1 -1 2 -2\n",
+                                       support="1.0")
+            assert code == 429, (code, body)
+            retry_after = int(headers["Retry-After"])
+            assert 1 <= retry_after <= 3600, retry_after
+            assert "queue full" in body["data"]["error"], body
+        assert scrape(port, "fsm_service_sheds_total") == 3
+        # a shed left zero trace: the uid is unknown
+        code, _, body = post(port, "/status/shed0")
+        assert body["status"] == "failure", body
+        log("overload drill ok: 3/3 sheds with 429 + Retry-After, "
+            "queue gauge at bound")
+
+        # wait for the first persisted frontier, then kill -9 the
+        # service BETWEEN saves, mid-mine
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            if client.get("fsm:frontier:drill"):
+                break
+            assert proc.poll() is None, "service A died early"
+            time.sleep(0.1)
+        assert client.get("fsm:frontier:drill"), "no frontier save seen"
+        assert client.get("fsm:journal:drill"), "drill journal missing"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(30)
+        log("killed service A mid-mine (frontier + journal persisted)")
+    except BaseException:
+        proc.kill()
+        raise
+
+    # reboot on the SAME store: the boot recovery pass must resume the
+    # drill and durably fail the queued fillers
+    proc, port, recovery_line = boot_service(cfg_path, env)
+    log(f"service B on port {port} (pid {proc.pid}); {recovery_line}")
+    try:
+        assert recovery_line is not None, "no recovery line at reboot"
+        assert "1 resumed" in recovery_line, recovery_line
+        assert "2 failed durably" in recovery_line, recovery_line
+
+        deadline = time.time() + DRILL_TIMEOUT_S
+        status = None
+        while time.time() < deadline:
+            _, _, body = post(port, "/status/drill")
+            status = body["status"]
+            if status in ("finished", "failure"):
+                break
+            time.sleep(0.25)
+        assert status == "finished", (status, body)
+        _, _, body = post(port, "/get/patterns", uid="drill")
+        assert body["status"] == "finished" and body["data"]["patterns"]
+        for uid in ("filler0", "filler1"):
+            _, _, body = post(port, f"/status/{uid}")
+            assert body["status"] == "failure", (uid, body)
+            assert "interrupted by restart" in body["data"]["error"], body
+        # every journal intent settled; the queue gauge reads 0 again
+        assert client.keys("fsm:journal:*") == []
+        assert scrape(port, "fsm_service_queue_depth") == 0
+        log("kill-restart drill ok: drill resumed via journal recovery "
+            "and finished; orphans failed durably; journal settled")
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        mini.close()
+    log("PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Overload + kill-restart smoke — the admission/recovery companion to
+# verify_t1.sh, bench_smoke.sh, chaos_smoke.sh and obs_smoke.sh.  Boots
+# the real service with a tiny [service] queue_depth over a MiniRedis
+# store, floods past capacity (exactly k sheds with 429 + Retry-After,
+# shed counters on /metrics), then kill -9s a checkpointed mine between
+# frontier saves and asserts the rebooted service finishes it via
+# write-ahead-journal recovery while non-checkpointed orphans land in a
+# durable "interrupted by restart" failure.  See scripts/overload_smoke.py
+# for the assertions.
+cd "$(dirname "$0")/.."
+# hard wall-clock bound: a service subprocess that wedges during boot
+# blocks the driver in readline(), so the whole drill runs under timeout
+exec timeout -k 30 840 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/overload_smoke.py "$@"

@@ -20,6 +20,9 @@ File format (TOML shown; JSON with the same nesting also accepted):
     miner_workers = 2
     remote_port = 0                 # actor-protocol TCP entry (0 = off)
     job_retries = 1                 # failed-job re-runs before 'failure'
+    queue_depth = 256               # bounded admission queue: submits past
+                                    # this many queued jobs shed with HTTP
+                                    # 429 + Retry-After (0 = unbounded)
 
     [store]
     backend = "inproc"              # or "redis"
@@ -80,6 +83,9 @@ class ServiceConfig:
     miner_workers: int = 1
     remote_port: int = 0  # actor-protocol TCP entry (0 = disabled)
     job_retries: int = 1  # re-runs of a failed train job before 'failure'
+    queue_depth: int = 256  # admission-queue bound: queued (not yet
+    # running) train jobs past this shed with 429 + Retry-After derived
+    # from the cost model (0 = unbounded — the pre-admission behavior)
 
 
 @dataclasses.dataclass
@@ -248,6 +254,8 @@ def parse_config(obj: Dict[str, Any]) -> Config:
             f"got {cfg.store.backend!r}")
     if cfg.engine.mesh_devices < 0:
         raise ConfigError("engine.mesh_devices must be >= 0")
+    if cfg.service.queue_depth < 0:
+        raise ConfigError("service.queue_depth must be >= 0 (0 = unbounded)")
     if cfg.observability.trace_max_spans < 1:
         raise ConfigError("observability.trace_max_spans must be >= 1")
     if cfg.observability.trace_jobs < 1:

@@ -65,7 +65,7 @@ from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
-from spark_fsm_tpu.utils import faults, obs, shapes, watchdog
+from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
 
 
@@ -714,6 +714,9 @@ class QueueSpadeTPU:
             self.stats["fused_overflow"] = True
             return None  # ring can't hold the root level: classic engine
 
+        # deadline/cancel safe point before committing the whole-mine
+        # dispatch (one global read when no deadline/cancel is live)
+        jobctl.check()
         ni = self.ni_pad
         (q_slot, q_smask, q_imask, q_nits, q_rec, records, recsup), \
             n_roots_dev = self._root_init(roots)
@@ -842,6 +845,9 @@ class QueueSpadeTPU:
         # per wave.  One compiled program serves every budget (traced).
         budget = 1 if checkpoint_cb is not None else seg_waves
         while True:
+            # deadline/cancel safe point between segment dispatches —
+            # the same boundary the watchdog deadline guards
+            jobctl.check()
             nbw = nbl if narrow else cap.nb
             seg_bound_s = RB.estimate_seconds(
                 nbw * budget, 1, self.n_seq, self.n_words)

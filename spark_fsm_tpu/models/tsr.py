@@ -61,7 +61,7 @@ from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
-from spark_fsm_tpu.utils import faults, obs, shapes, watchdog
+from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
 
 # OOM degradation ladder floor (lanes): a failed launch re-plans at half
@@ -1174,6 +1174,10 @@ class TsrTPU:
         inflight: List[Tuple[list, object]] = []
         last_ckpt = time.monotonic()
         while True:
+            # deadline/cancel safe point, next to where the watchdog and
+            # OOM ladder already live: between launches, one module-
+            # global read when no deadline or cancel exists anywhere
+            jobctl.check()
             while queue and len(inflight) < self.PIPELINE_DEPTH:
                 batch = pop_batch()
                 if not batch:

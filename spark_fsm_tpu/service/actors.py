@@ -11,19 +11,22 @@ reference's error contract.
 
 from __future__ import annotations
 
+import collections
 import json
+import math
 import os
-import queue
 import threading
 import time
 import traceback
-from typing import Dict, Optional
+import uuid
+from typing import Deque, Dict, List, Optional
 
 from spark_fsm_tpu import config
+from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.service import model, plugins, sources
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
-from spark_fsm_tpu.utils import faults, obs
+from spark_fsm_tpu.utils import faults, jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event, profile_trace
 from spark_fsm_tpu.utils.retry import RetryPolicy
 
@@ -38,18 +41,30 @@ def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
 
 
 def _record_failure(store: ResultStore, uid: str, exc: Exception,
-                    metric: str = "jobs_failed") -> None:
+                    metric: str = "jobs_failed",
+                    keep_frontier: bool = False) -> None:
     """The supervision contract: error text + traceback under the error
     key, status -> failure (SURVEY.md sec 5 failure-detection row).
     ``metric`` keeps batch-job and stream-push failure counters distinct
-    (jobs_failed must never exceed jobs_submitted)."""
+    (jobs_failed must never exceed jobs_submitted).  ``keep_frontier``
+    preserves the checkpoint keys for failures that do NOT implicate the
+    mine itself (deadline/cancel aborts, shutdown drain, a recovery
+    resubmit that shed): the persisted progress stays resumable by a
+    later checkpointed resubmit instead of being destroyed by an abort
+    the job never asked for."""
     store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
     store.add_status(uid, Status.FAILURE)
     store.incr(f"fsm:metric:{metric}")
-    # a permanently failed job's frontier is unreachable (a resubmit clears
-    # it before running) — drop it rather than leak it
-    store.delete(f"fsm:frontier:{uid}")
-    store.delete(f"fsm:frontier:results:{uid}")
+    if not keep_frontier:
+        # a job that FAILED mid-mine after its retries leaves a frontier
+        # of unknown quality — drop it rather than leak it
+        store.delete(f"fsm:frontier:{uid}")
+        store.delete(f"fsm:frontier:results:{uid}")
+    # failure is TERMINAL: the journal intent is settled (the restart
+    # recovery pass must not resurrect a job that failed durably) and
+    # the job-control entry released (stream uids have neither — no-ops)
+    store.journal_clear(uid)
+    jobctl.release(uid)
     log_event("job_failed", uid=uid, error=str(exc))
     # stamp the terminal failure into the job's flight-recorder ring
     # (explicit trace_id: failures land from threads with no active
@@ -198,6 +213,134 @@ class StoreCheckpoint:
         self.store.delete(self._results_key)
 
 
+class AdmissionShed(RuntimeError):
+    """A submit refused because the admission queue is full — the HTTP
+    layer maps it to 429 with ``Retry-After: retry_after_s``."""
+
+    def __init__(self, uid: str, depth: int, queued: int,
+                 retry_after_s: int):
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"admission queue full ({queued}/{depth} jobs queued); "
+            f"retry in ~{retry_after_s}s")
+
+
+class UidConflict(RuntimeError):
+    """A submit naming a uid that is currently queued or running — the
+    HTTP layer maps it to 409.  Accepting it would wipe the live job's
+    state from under its worker (the old clear-at-submit hazard)."""
+
+    def __init__(self, uid: str):
+        super().__init__(
+            f"uid {uid!r} is live (queued or running); resubmitting would "
+            "wipe its state — wait for a terminal status or use a new uid")
+
+
+PRIORITIES = ("high", "normal", "low")
+
+_QUEUE_DEPTH = obs.REGISTRY.gauge(
+    "fsm_service_queue_depth",
+    "train jobs queued for a miner worker (excludes the running ones)")
+_SHEDS_TOTAL = obs.REGISTRY.counter(
+    "fsm_service_sheds_total",
+    "train submits refused with 429 because the admission queue was full")
+
+
+class AdmissionQueue:
+    """Bounded, priority-classed mailbox replacing the unbounded
+    ``queue.Queue`` — the admission-control half of the overload story.
+
+    Three strict priority classes (``high`` > ``normal`` > ``low``);
+    within a class, FIFO.  ``depth`` bounds the QUEUED jobs (running
+    jobs have already left the queue; 0 = unbounded).  Admission is a
+    two-phase reserve/put so the bound is exact under concurrent
+    submitters even though the store writes between reservation and
+    enqueue take time: ``try_reserve`` atomically claims a slot (or
+    reports the shed), ``put`` converts it, ``abort`` returns it.
+
+    Worker sentinels (shutdown) are counted separately and handed out
+    only once every queued job has been drained — backlog jobs always
+    reach a worker, which gives them their durable drain failure."""
+
+    def __init__(self, depth: int):
+        self.depth = int(depth)
+        self._cond = threading.Condition()
+        self._qs: Dict[str, Deque[ServiceRequest]] = {
+            p: collections.deque() for p in PRIORITIES}
+        self._reserved = 0
+        self._sentinels = 0
+        _QUEUE_DEPTH.set(0)
+
+    def _n_queued(self) -> int:
+        return sum(len(q) for q in self._qs.values())
+
+    def size(self) -> int:
+        with self._cond:
+            return self._n_queued()
+
+    def try_reserve(self):
+        """(admitted, queued_now): claim a queue slot, or report a shed
+        (``admitted=False``) with the depth that refused it."""
+        with self._cond:
+            n = self._n_queued() + self._reserved
+            if self.depth > 0 and n >= self.depth:
+                return False, n
+            self._reserved += 1
+            return True, n
+
+    def abort(self) -> None:
+        with self._cond:
+            self._reserved -= 1
+
+    def put(self, req: ServiceRequest, priority: str) -> None:
+        with self._cond:
+            self._reserved -= 1
+            self._qs[priority].append(req)
+            _QUEUE_DEPTH.set(self._n_queued())
+            self._cond.notify()
+
+    def put_sentinel(self) -> None:
+        with self._cond:
+            self._sentinels += 1
+            self._cond.notify()
+
+    def get(self) -> Optional[ServiceRequest]:
+        """Highest-priority queued request, or None (a sentinel) —
+        sentinels only surface once the backlog is fully drained."""
+        with self._cond:
+            while True:
+                for p in PRIORITIES:
+                    if self._qs[p]:
+                        req = self._qs[p].popleft()
+                        _QUEUE_DEPTH.set(self._n_queued())
+                        return req
+                if self._sentinels:
+                    self._sentinels -= 1
+                    return None
+                self._cond.wait()
+
+    def remove(self, uid: str) -> Optional[ServiceRequest]:
+        """Pull a QUEUED request out by uid (the cancel-while-queued
+        path: its slot must return to the pool NOW, not when a worker
+        eventually dequeues the dead work).  None when no queued request
+        carries the uid — a worker already took it."""
+        with self._cond:
+            for q in self._qs.values():
+                for req in q:
+                    if req.uid == uid:
+                        q.remove(req)
+                        _QUEUE_DEPTH.set(self._n_queued())
+                        return req
+        return None
+
+
+def _checkpoint_requested(req: ServiceRequest) -> bool:
+    """One spelling of the checkpoint-param truthiness (Miner._run_traced
+    and the admission layer's keep-frontier decision must agree)."""
+    return (req.param("checkpoint") or "").lower() not in (
+        "", "0", "false", "no", "off")
+
+
 class Miner:
     """Train worker: source -> dataset -> plugin -> sink, with statuses.
 
@@ -211,16 +354,44 @@ class Miner:
     status lands — the analog of Spark's task re-execution.  With
     ``checkpoint=1`` a retry resumes the mine from the last persisted
     frontier instead of starting over.
+
+    Overload/restart posture (ISSUE 5): the mailbox is a bounded
+    priority-classed :class:`AdmissionQueue` (``[service] queue_depth``;
+    ``priority`` request param) — a full queue sheds the submit with
+    :class:`AdmissionShed` (HTTP 429 + Retry-After from the cost-model
+    estimate of the queued work) BEFORE any store write, so a shed
+    leaves zero trace of the uid.  A ``deadline_s`` request param stamps
+    a budget at submit (queue wait spends it) enforced at the engines'
+    launch-boundary safe points via utils/jobctl; ``/admin/cancel``
+    aborts the same way.  Every admitted job writes a journal intent
+    record (``fsm:journal:{uid}``) cleared only on terminal status —
+    the crash-restart recovery pass (:func:`recover_orphans`) reads it.
     """
 
-    def __init__(self, store: ResultStore, workers: int = 1) -> None:
+    def __init__(self, store: ResultStore, workers: int = 1,
+                 queue_depth: Optional[int] = None) -> None:
         self.store = store
-        self._q: "queue.Queue[Optional[ServiceRequest]]" = queue.Queue()
+        if queue_depth is None:
+            queue_depth = config.get_config().service.queue_depth
+        self._q = AdmissionQueue(queue_depth)
+        # this Miner's incarnation id: journal entries carrying it are
+        # LIVE (409 on resubmit); entries carrying any other id belong
+        # to a dead incarnation and are recovery fodder
+        self.incarnation = uuid.uuid4().hex
         self._stopping = False
         # guards the _stopping check-and-enqueue in submit() against
         # shutdown(): without it a submit could pass the check, lose the
         # CPU, and enqueue BEHIND the sentinels after the workers exited
         self._stop_lock = threading.Lock()
+        # EWMA of measured job walls — the Retry-After estimator's input
+        # once real jobs have run (the cost-model prior seeds it)
+        self._wall_lock = threading.Lock()
+        self._wall_ewma: Optional[float] = None
+        # serializes the conflict-check -> journal-intent window of
+        # submit(): without it two concurrent submits of the SAME uid
+        # both pass the 409 check and both admit — the state-wipe race
+        # the conflict check exists to close
+        self._admit_lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._loop, daemon=True,
                              name=f"fsm-miner-{i}")
@@ -229,36 +400,168 @@ class Miner:
         for t in self._threads:
             t.start()
 
+    # ------------------------------------------------------------ admission
+
+    def queue_size(self) -> int:
+        return self._q.size()
+
+    def settle_cancelled_queued(self, uid: str) -> bool:
+        """Settle a job cancelled while still QUEUED: remove it from the
+        admission queue (freeing its slot for new submits immediately)
+        and record its durable CANCELLED failure here, instead of
+        leaving dead work occupying capacity until a worker gets to it.
+        False when a worker already dequeued it — the worker's own
+        check_entry settles it then (the removal is atomic under the
+        queue lock, so exactly one side ever settles)."""
+        req = self._q.remove(uid)
+        if req is None:
+            return False
+        try:
+            # route through check_entry so the cancel counter and trace
+            # event fire exactly like a worker-side abort
+            jobctl.check_entry(jobctl.get(uid))
+            exc: jobctl.JobAborted = jobctl.JobCancelled(
+                uid, "cancelled while queued")
+        except jobctl.JobAborted as caught:
+            exc = caught
+        _record_failure(self.store, uid, exc, keep_frontier=True)
+        return True
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.depth
+
+    def _observe_wall(self, wall_s: float) -> None:
+        with self._wall_lock:
+            self._wall_ewma = (wall_s if self._wall_ewma is None
+                               else 0.3 * wall_s + 0.7 * self._wall_ewma)
+
+    def _retry_after_s(self, queued: int) -> int:
+        """Seconds until a shed submit plausibly fits: the queued work
+        divided over the workers, priced per job by the EWMA of measured
+        walls — seeded, before any job has finished, by the ragged
+        planner's cost model over the declared prewarm envelope (8
+        full-width launches at the configured sequence scale: the same
+        KERNELS.json-anchored arithmetic the watchdog deadlines use)."""
+        with self._wall_lock:
+            per_job = self._wall_ewma
+        if per_job is None:
+            pw = config.get_config().prewarm
+            n_seq = pw.sequences or 100_000
+            per_job = RB.estimate_seconds(8 * 8192, 8, n_seq,
+                                          max(1, pw.words or 1))
+        est = per_job * (queued + 1) / max(1, len(self._threads))
+        return max(1, min(3600, math.ceil(est)))
+
     def submit(self, req: ServiceRequest) -> None:
-        # A client-supplied uid may collide with a finished/failed job;
-        # clear its stale error and results so /status and /get reflect
-        # THIS job, not the previous one's leftovers.
-        self.store.clear_job(req.uid)
-        self.store.add_status(req.uid, Status.STARTED)
-        self.store.incr("fsm:metric:jobs_submitted")
-        log_event("job_submitted", uid=req.uid,
-                  algorithm=req.param("algorithm", "SPADE_TPU"),
-                  source=req.param("source", "FILE"))
-        # the flight-recorder trace opens AT SUBMIT (handler thread):
-        # the queue wait before a worker picks the job up is part of
-        # the job's story under load
-        obs.trace_begin(req.uid,
-                        algorithm=req.param("algorithm", "SPADE_TPU"),
-                        source=req.param("source", "FILE"))
-        with self._stop_lock:
-            if not self._stopping:
-                # enqueued strictly BEFORE the sentinels (the lock orders
-                # us against shutdown), so a worker will dequeue it: either
-                # it runs, or the drain check gives it a durable failure
-                self._q.put(req)
-                return
+        faults.fault_site("service.admit", uid=req.uid)
+        priority = (req.param("priority") or "normal").lower()
+        if priority not in PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r} "
+                             f"(valid: {'/'.join(PRIORITIES)})")
+        deadline_s = None
+        raw_deadline = req.param("deadline_s")
+        if raw_deadline is not None:
+            deadline_s = float(raw_deadline)  # ValueError -> failure reply
+            # non-finite values pass a naive `<= 0` check: nan compares
+            # False to everything, so the "deadline" would silently never
+            # expire while pinning every safe-point probe onto the slow
+            # path for the job's whole life
+            if not math.isfinite(deadline_s) or deadline_s <= 0:
+                raise ValueError(f"deadline_s must be a finite value > 0 "
+                                 f"(got {raw_deadline!r})")
+        enqueued = False
+        with self._admit_lock:
+            # the conflict check and the journal intent that makes the
+            # uid LIVE must be one atomic step: two racing submits of
+            # the same uid must serialize here so exactly one admits
+            # and the other sees the fresh intent and 409s
+            entry = self.store.journal_get(req.uid)
+            if entry is not None:
+                try:
+                    live = (json.loads(entry).get("incarnation")
+                            == self.incarnation)
+                except ValueError:
+                    live = False  # corrupt record: treat as a dead orphan
+                if live:
+                    raise UidConflict(req.uid)
+            admitted, queued = self._q.try_reserve()
+            if not admitted:
+                _SHEDS_TOTAL.inc(priority=priority)
+                log_event("job_shed", uid=req.uid, queued=queued,
+                          depth=self._q.depth, priority=priority)
+                raise AdmissionShed(req.uid, self._q.depth, queued,
+                                    self._retry_after_s(queued))
+            try:
+                # A client-supplied uid may collide with a finished/
+                # failed job; clear its stale error and results so
+                # /status and /get reflect THIS job.  A checkpointed
+                # submit KEEPS the frontier keys: live uids were
+                # rejected above, so a surviving frontier belongs to a
+                # dead incarnation and resuming it is exactly the
+                # crash-recovery contract (a frontier for different
+                # data fails the fingerprint check and the mine
+                # restarts fresh).
+                self.store.clear_job(
+                    req.uid, keep_frontier=_checkpoint_requested(req))
+                self.store.journal_set(req.uid, json.dumps({
+                    "uid": req.uid,
+                    "incarnation": self.incarnation,
+                    "ts": round(time.time(), 3),
+                    "checkpoint": _checkpoint_requested(req),
+                    "priority": priority,
+                    "request": dict(req.data),
+                }))
+            except BaseException:
+                self._q.abort()  # reservation never became a queued job
+                raise
+        try:
+            jobctl.register(req.uid, deadline_s)
+            self.store.add_status(req.uid, Status.STARTED)
+            self.store.incr("fsm:metric:jobs_submitted")
+            log_event("job_submitted", uid=req.uid,
+                      algorithm=req.param("algorithm", "SPADE_TPU"),
+                      source=req.param("source", "FILE"),
+                      priority=priority)
+            # the flight-recorder trace opens AT SUBMIT (handler thread):
+            # the queue wait before a worker picks the job up is part of
+            # the job's story under load
+            obs.trace_begin(req.uid,
+                            algorithm=req.param("algorithm", "SPADE_TPU"),
+                            source=req.param("source", "FILE"))
+            with self._stop_lock:
+                if not self._stopping:
+                    # enqueued strictly BEFORE the sentinels (the lock
+                    # orders us against shutdown), so a worker will
+                    # dequeue it: either it runs, or the drain check
+                    # gives it a durable failure
+                    self._q.put(req, priority)
+                    enqueued = True
+        except BaseException:
+            # the submit died between its journal intent and its
+            # enqueue: settle the intent (a live-looking record would
+            # 409 every future resubmit of this uid) and drop the
+            # control entry — best-effort, the store may be the thing
+            # that just failed
+            try:
+                self.store.journal_clear(req.uid)
+            except Exception:
+                pass
+            jobctl.release(req.uid)
+            raise
+        finally:
+            if not enqueued:
+                self._q.abort()  # reservation never became a queued job
+        if enqueued:
+            return
         # shutdown() already enqueued the worker sentinels; a request
         # enqueued now would never be dequeued (workers exit on the
         # sentinel) and would sit "started" forever — the exact state
         # the drain exists to prevent.  Record the durable failure
         # here, same status shape as the drained-backlog path.
         _record_failure(self.store, req.uid,
-                        RuntimeError("service shutting down"))
+                        RuntimeError("service shutting down"),
+                        keep_frontier=True)
 
     def _loop(self) -> None:
         while True:
@@ -269,14 +572,27 @@ class Miner:
                 # draining: do NOT start queued backlog jobs — give each a
                 # durable failure status (visible through /status) instead
                 # of leaving it "started" forever or dying with the process
+                # (keep_frontier: a drained checkpointed job's persisted
+                # progress stays resumable after the restart)
                 _record_failure(self.store, req.uid,
-                                RuntimeError("service shutting down"))
+                                RuntimeError("service shutting down"),
+                                keep_frontier=True)
+                continue
+            ctl = jobctl.get(req.uid)
+            try:
+                # a deadline spent ENTIRELY on queue wait (or a cancel
+                # that landed while queued) aborts before any work
+                jobctl.check_entry(ctl)
+            except jobctl.JobAborted as exc:
+                _record_failure(self.store, req.uid, exc,
+                                keep_frontier=True)
                 continue
             # Clear again at run start: with a reused uid, an EARLIER job
             # with the same uid may have written its error/results after
             # submit()'s clear (it was still queued/running then).  The
             # last job to *start* owns the uid's keys from here on.
-            self.store.clear_job(req.uid, keep_status_log=True)
+            self.store.clear_job(req.uid, keep_status_log=True,
+                                 keep_frontier=_checkpoint_requested(req))
             try:
                 retries = int(req.param(
                     "retries",
@@ -287,7 +603,20 @@ class Miner:
             attempt = 0
             while True:
                 try:
-                    self._run(req)
+                    # re-checked between attempts too: a deadline that
+                    # expired during a failed attempt must not buy a
+                    # retry it can never finish
+                    jobctl.check_entry(ctl)
+                    with jobctl.activate(ctl):
+                        self._run(req)
+                    break
+                except jobctl.JobAborted as exc:
+                    # TERMINAL, never retried: durable failure whose
+                    # error text leads with CANCELLED/DEADLINE_EXCEEDED.
+                    # The frontier survives: progress a deadline/cancel
+                    # cut short resumes on a later checkpointed resubmit
+                    _record_failure(self.store, req.uid, exc,
+                                    keep_frontier=True)
                     break
                 except ValueError as exc:  # bad params / bad source: the
                     # failure is deterministic (SourceError included) — a
@@ -318,6 +647,10 @@ class Miner:
         t0 = time.perf_counter()
         with obs.span("job.dataset"):
             db = sources.get_db(req, self.store)
+        # coarse safe point shared by every algorithm: a cancel/deadline
+        # that landed during the dataset build aborts before the mine
+        # (the engines' own launch-boundary checks take over from here)
+        jobctl.check()
         self.store.add_status(req.uid, Status.DATASET)
         plugin = plugins.get_plugin(req)
         stats: Dict[str, object] = {
@@ -327,8 +660,7 @@ class Miner:
         }
         job_sp.set(algorithm=plugin.name, sequences=len(db))
         ckpt: Optional[StoreCheckpoint] = None
-        if (req.param("checkpoint") or "").lower() not in ("", "0", "false",
-                                                           "no", "off"):
+        if _checkpoint_requested(req):
             ckpt = StoreCheckpoint(
                 self.store, req.uid,
                 every_s=float(req.param("checkpoint_every_s", "30")))
@@ -357,7 +689,14 @@ class Miner:
             except Exception as exc:
                 log_event("frontier_clear_failed", uid=req.uid,
                           error=str(exc))
+        # FINISHED is terminal: settle the journal intent and release
+        # the job-control entry (order matters — the terminal status is
+        # already durable, so a crash right here leaves an orphan whose
+        # recovery pass sees 'finished' and just clears the journal)
+        self.store.journal_clear(req.uid)
+        jobctl.release(req.uid)
         self.store.incr("fsm:metric:jobs_finished")
+        self._observe_wall(time.perf_counter() - t0)
         log_event("job_finished", uid=req.uid, **stats)
 
     def shutdown(self, join_timeout_s: float = 30.0) -> None:
@@ -368,11 +707,15 @@ class Miner:
         ``join_timeout_s`` total, not per worker.  A job outrunning the
         deadline is abandoned loudly (logged; daemon threads die with the
         process; a checkpointed job resumes on restart — the
-        torn-snapshot-safe StoreCheckpoint contract)."""
+        torn-snapshot-safe StoreCheckpoint contract).  Backlog jobs are
+        drained BEFORE the sentinels surface (AdmissionQueue.get), so
+        every queued job's durable failure lands and its journal entry
+        clears; submits racing the drain still shed with 429 when the
+        queue is full, or land the durable failure when it is not."""
         with self._stop_lock:
             self._stopping = True
             for _ in self._threads:
-                self._q.put(None)
+                self._q.put_sentinel()
         deadline = time.monotonic() + join_timeout_s
         for t in self._threads:
             t.join(max(0.0, deadline - time.monotonic()))
@@ -775,16 +1118,31 @@ class Master:
     """Routes tasks to workers — the reference's FSMMaster."""
 
     def __init__(self, store: Optional[ResultStore] = None,
-                 miner_workers: int = 1) -> None:
+                 miner_workers: int = 1,
+                 queue_depth: Optional[int] = None) -> None:
         self.store = store if store is not None else ResultStore()
         # the registry keys one "jobs" collector process-wide: the last
         # Master built owns it (tests build many; the service builds one)
         obs.REGISTRY.register_collector("jobs", _jobs_collector(self.store))
-        self.miner = Miner(self.store, workers=miner_workers)
+        self.miner = Miner(self.store, workers=miner_workers,
+                           queue_depth=queue_depth)
         self.questor = Questor(self.store)
         self.tracker = Tracker(self.store)
         self.registrar = Registrar(self.store)
         self.streamer = Streamer(self.store)
+
+    def cancel(self, uid: str) -> Optional[str]:
+        """Cancel a live job (``/admin/cancel/{uid}``): returns what it
+        was doing ("queued"/"running") or None when no live job owns the
+        uid.  A RUNNING job aborts at its next safe point; a QUEUED job
+        is settled immediately — its admission slot returns to the pool
+        now instead of when a worker reaches the dead work."""
+        state = jobctl.cancel(uid)
+        if state is not None:
+            log_event("job_cancel_requested", uid=uid, was=state)
+        if state == "queued":
+            self.miner.settle_cancelled_queued(uid)
+        return state
 
     def handle(self, req: ServiceRequest) -> ServiceResponse:
         task, _, subject = req.task.partition(":")
@@ -796,9 +1154,20 @@ class Master:
                 src = (req.param("source") or "FILE").upper()
                 if src not in sources.SOURCES:
                     raise ValueError(f"unknown source {src!r}")
-            except ValueError as exc:
+                self.miner.submit(req)
+            except AdmissionShed as exc:
+                # overload shed: protocol-mapped to 429 + Retry-After by
+                # the HTTP layer (remote clients read retry_after_s)
+                return model.response(req, Status.FAILURE, error=str(exc),
+                                      http_status="429",
+                                      retry_after_s=str(exc.retry_after_s))
+            except UidConflict as exc:
+                return model.response(req, Status.FAILURE, error=str(exc),
+                                      http_status="409")
+            except (ValueError, faults.FaultInjected) as exc:
+                # bad submit params, or a chaos-armed admission/journal
+                # site: a clean synchronous failure envelope either way
                 return model.response(req, Status.FAILURE, error=str(exc))
-            self.miner.submit(req)
             return model.response(req, Status.STARTED)
         if task == "status":
             status = self.store.status(req.uid)
@@ -825,3 +1194,80 @@ class Master:
 
     def shutdown(self) -> None:
         self.miner.shutdown()
+
+
+_RECOVERY_TOTAL = obs.REGISTRY.counter(
+    "fsm_recovery_jobs_total",
+    "journal orphans handled by the boot recovery pass, by outcome")
+
+
+def recover_orphans(master: Master) -> Dict[str, List[str]]:
+    """Boot-time crash-restart recovery (service/app.py runs this before
+    accepting traffic): heal every journal intent record left by a DEAD
+    incarnation.
+
+    - already-terminal orphan (the crash hit between the terminal status
+      write and the journal clear): settle the journal — ``cleared``;
+    - checkpointed orphan: resubmit the journaled request through the
+      normal admission path; the mine resumes from its persisted
+      frontier (zero duplicated results — the fingerprint check restarts
+      fresh if the data changed) — ``resumed``;
+    - anything else: durable ``failure: interrupted by restart`` so no
+      client ever polls a forever-pending uid — ``failed``.
+
+    SINGLE-WRITER ASSUMPTION: liveness is inferred from the journal's
+    incarnation tag, so exactly ONE service instance may own a store.
+    A second instance sharing the same Redis would treat the sibling's
+    live jobs as dead orphans (duplicate resubmits / bogus failures);
+    scale out with one store per instance until the journal grows a
+    lease/heartbeat (docs/OPERATIONS.md states the same constraint).
+    """
+    store, miner = master.store, master.miner
+    report: Dict[str, List[str]] = {"resumed": [], "failed": [],
+                                    "cleared": []}
+    for uid in store.journal_uids():
+        raw = store.journal_get(uid)
+        if not raw:
+            continue  # settled between the scan and this read
+        try:
+            entry = json.loads(raw)
+        except ValueError:
+            entry = {}  # corrupt record: fall through to the durable failure
+        if entry.get("incarnation") == miner.incarnation:
+            continue  # live in THIS incarnation (a concurrent submit)
+        status = store.status(uid)
+        if status in (Status.FINISHED, Status.FAILURE):
+            store.journal_clear(uid)
+            report["cleared"].append(uid)
+            _RECOVERY_TOTAL.inc(outcome="cleared")
+            continue
+        if entry.get("checkpoint"):
+            req = ServiceRequest("fsm", "train", {
+                str(k): str(v) for k, v in entry.get("request", {}).items()})
+            try:
+                miner.submit(req)
+                report["resumed"].append(uid)
+                _RECOVERY_TOTAL.inc(outcome="resumed")
+                log_event("restart_recovery_resumed", uid=uid)
+                continue
+            except Exception as exc:  # shed (tiny queue at boot) or a
+                # store hiccup: fall through to the durable failure —
+                # recovery must never leave the orphan pending
+                failure = RuntimeError(
+                    f"interrupted by restart; recovery resubmit failed: "
+                    f"{exc}")
+        else:
+            failure = RuntimeError(
+                "interrupted by restart (job was not checkpointed; "
+                "re-submit to re-mine)")
+        # keep_frontier: a recovery resubmit that shed (tiny queue at
+        # boot) must not destroy the very progress it failed to resume
+        _record_failure(store, uid, failure, keep_frontier=True)
+        report["failed"].append(uid)
+        _RECOVERY_TOTAL.inc(outcome="failed")
+    if any(report.values()):
+        log_event("restart_recovery",
+                  resumed=len(report["resumed"]),
+                  failed=len(report["failed"]),
+                  cleared=len(report["cleared"]))
+    return report

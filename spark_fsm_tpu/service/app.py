@@ -2,7 +2,13 @@
 
 Endpoints (POST, form- or JSON-encoded parameters):
 
-  /train              — start a mining job; returns uid + 'started'
+  /train              — start a mining job; returns uid + 'started'.
+                        Admission control: a full [service] queue_depth
+                        sheds with 429 + Retry-After (cost-model
+                        estimate of the queued work); resubmitting a
+                        LIVE uid is 409; 'priority' (high/normal/low)
+                        classes the queue; 'deadline_s' stamps an abort
+                        budget spent by queue wait + mining
   /status/{uid}       — job lifecycle status (also /status?uid=...)
   /get/patterns       — mined patterns for uid (when finished)
   /get/rules          — mined rules, optional antecedent/consequent filter
@@ -32,7 +38,16 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         point a scrape job here);
   /admin/trace/{job}  — flight-recorder span dump for a job uid (JSON;
                         requires [observability] trace = true);
-  /admin/trace/last   — the most recently touched trace
+  /admin/trace/last   — the most recently touched trace;
+  /admin/cancel/{uid} — abort a live (queued or running) train job at
+                        its next safe point; 404 when no live job owns
+                        the uid
+
+At boot, main() runs the crash-restart recovery pass BEFORE accepting
+traffic: journal intent records left by a dead incarnation are healed —
+checkpointed jobs resubmitted (they resume from their persisted
+frontier), everything else marked with a durable "interrupted by
+restart" failure (service/actors.recover_orphans).
 
 Runs on the stdlib ThreadingHTTPServer: the service layer is deliberately
 dependency-free; heavy lifting happens in the engines (device) behind the
@@ -85,11 +100,14 @@ class FsmHandler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, code: int, payload: str,
-              content_type: str = "application/json") -> None:
+              content_type: str = "application/json",
+              headers: Optional[dict] = None) -> None:
         body = payload.encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -137,7 +155,15 @@ class FsmHandler(BaseHTTPRequestHandler):
                 "data": {"uid": req.uid, "error": str(exc)},
                 "status": "failure"}))
             return
-        self._send(200, resp.to_json())
+        # overload/conflict mapping: the Master stamps the HTTP status it
+        # wants (429 shed / 409 live-uid conflict) into the envelope —
+        # popped here so the JSON body stays protocol-neutral; a 429
+        # carries Retry-After from the cost-model estimate of queued work
+        code = int(resp.data.pop("http_status", 200))
+        headers = None
+        if code == 429 and resp.data.get("retry_after_s"):
+            headers = {"Retry-After": resp.data["retry_after_s"]}
+        self._send(code, resp.to_json(), headers=headers)
 
     def do_GET(self) -> None:  # noqa: N802
         # GET convenience mirrors POST for read-only endpoints.
@@ -213,6 +239,26 @@ class FsmHandler(BaseHTTPRequestHandler):
                     "counters": faults.counters()}))
             elif task == "health":
                 self._send(200, json.dumps(health_report(self.master)))
+            elif task == "cancel" or task.startswith("cancel/"):
+                # /admin/cancel/{uid} (uid may contain slashes — keep the
+                # whole tail; /admin/cancel?uid=... works too): flag a
+                # live job for abort at its next safe point
+                _, _, uid = task.partition("/")
+                uid = uid or (data or {}).get("uid", "")
+                if not uid:
+                    self._send(400, json.dumps({
+                        "status": "failure",
+                        "error": "cancel needs a uid: /admin/cancel/{uid}"}))
+                    return
+                was = self.master.cancel(uid)
+                if was is None:
+                    self._send(404, json.dumps({
+                        "status": "failure",
+                        "error": f"no live (queued or running) job owns "
+                                 f"uid {uid!r}"}))
+                    return
+                self._send(200, json.dumps(
+                    {"status": "cancelling", "uid": uid, "was": was}))
             elif task == "trace" or task.startswith("trace/"):
                 # read-only flight-recorder dumps: /admin/trace/{job_id}
                 # (uid may itself contain slashes — keep the whole tail),
@@ -280,6 +326,11 @@ def service_stats(master: Master) -> dict:
     report = prewarm.last_report()
     return {
         "jobs": counters,
+        # admission-control view: live queue occupancy vs its bound
+        # (canonical series: fsm_service_queue_depth / fsm_service_
+        # sheds_total in the metrics block below)
+        "admission": {"queued": master.miner.queue_size(),
+                      "queue_depth": master.miner.queue_depth},
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
         "mesh_devices": mesh_devices,
@@ -326,11 +377,18 @@ def health_report(master: Master) -> dict:
             # store.get fault (or a down store) blanks the counter, it
             # does not take down the one endpoint diagnosing it
             jobs[name] = None
+    from spark_fsm_tpu.utils import jobctl
+
     return {
         "faults": {
             "enabled": cfgmod.get_config().fault_injection,
             "armed": faults.armed(),
             "counters": faults.counters(),
+        },
+        "admission": {
+            "queued": master.miner.queue_size(),
+            "queue_depth": master.miner.queue_depth,
+            "live_jobs": jobctl.live_count(),
         },
         "retry": retry_counters(),
         "watchdog": {**watchdog.stats(),
@@ -443,6 +501,18 @@ def main() -> None:
                   f"{report['total_wall_s']}s", flush=True)
     server = make_server(cfg.service.port, cfg.service.host,
                          miner_workers=cfg.service.miner_workers)
+    # crash-restart recovery BEFORE accepting traffic: journal intents
+    # from a dead incarnation are resubmitted (checkpointed — they
+    # resume from the persisted frontier) or failed durably, so no
+    # client polls a forever-pending uid from before the crash
+    from spark_fsm_tpu.service.actors import recover_orphans
+
+    report = recover_orphans(server.master)  # type: ignore[attr-defined]
+    if any(report.values()):
+        print(f"restart recovery: {len(report['resumed'])} resumed, "
+              f"{len(report['failed'])} failed durably, "
+              f"{len(report['cleared'])} journal entries cleared",
+              flush=True)
     print(f"spark_fsm_tpu service on http://{cfg.service.host}:"
           f"{server.server_port}", flush=True)
     remote = None

@@ -48,7 +48,7 @@ from typing import List, Optional
 import numpy as np
 
 from spark_fsm_tpu.data.spmf import SequenceDB
-from spark_fsm_tpu.utils import faults, obs
+from spark_fsm_tpu.utils import faults, jobctl, obs
 from spark_fsm_tpu.utils.canonical import PatternResult
 from spark_fsm_tpu.utils.obs import log_event
 from spark_fsm_tpu.utils.retry import CircuitBreaker
@@ -135,6 +135,13 @@ class _EngineCacheBase:
             # no-retry class): re-running them cannot succeed and they
             # say nothing about the cache's device seam — one bad job
             # must not open the breaker for healthy traffic
+            raise
+        except jobctl.JobAborted:
+            # deadline/cancel aborts are CLIENT outcomes, not device
+            # failures: a batch of operator cancels (or deadline
+            # expiries under overload — the exact scenario the
+            # admission layer exists for) must not open the breaker
+            # and push healthy mines onto the uncached host path
             raise
         except Exception as exc:
             self.breaker.failure()

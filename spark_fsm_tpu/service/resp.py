@@ -178,5 +178,12 @@ class RespClient:
         assert isinstance(reply, int)
         return reply
 
+    def keys(self, pattern: str) -> List[str]:
+        reply = self.command("KEYS", pattern)
+        if reply is None:
+            return []
+        assert isinstance(reply, list)
+        return [r for r in reply if isinstance(r, str)]
+
     def ping(self) -> bool:
         return self.command("PING") == "PONG"

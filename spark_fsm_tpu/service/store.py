@@ -127,16 +127,52 @@ class ResultStore:
             self._kv[key] = str(value)
             return value
 
-    def clear_job(self, uid: str, *, keep_status_log: bool = False) -> None:
+    def clear_job(self, uid: str, *, keep_status_log: bool = False,
+                  keep_frontier: bool = False) -> None:
         """Remove a job's error/results (and optionally its status log) so a
-        reused uid reports THIS job, not a predecessor's leftovers."""
+        reused uid reports THIS job, not a predecessor's leftovers.
+        ``keep_frontier`` preserves the checkpoint keys: a checkpointed
+        resubmit (the restart-recovery path) must resume from the
+        persisted frontier, not wipe it — the engine's fingerprint check
+        still discards a frontier that doesn't match the new data."""
         keys = [f"fsm:error:{uid}", f"fsm:pattern:{uid}", f"fsm:rule:{uid}",
-                f"fsm:stats:{uid}", f"fsm:frontier:{uid}",
-                f"fsm:frontier:results:{uid}"]
+                f"fsm:stats:{uid}"]
+        if not keep_frontier:
+            keys += [f"fsm:frontier:{uid}", f"fsm:frontier:results:{uid}"]
         if not keep_status_log:
             keys.append(f"fsm:status:log:{uid}")
         for key in keys:
             self.delete(key)
+
+    def keys(self, prefix: str) -> List[str]:
+        """Keys (kv + list) starting with ``prefix`` — the journal's
+        boot-time recovery scan (boot-only: the Redis backend maps this
+        to KEYS, which blocks the server while it scans)."""
+        with self._lock:
+            return sorted({k for k in list(self._kv) + list(self._lists)
+                           if k.startswith(prefix)})
+
+    # -- write-ahead job journal -------------------------------------------
+    # One intent record per live train job (``fsm:journal:{uid}``),
+    # written at submit and cleared on every terminal status.  A record
+    # that survives a process death marks an ORPHAN: the boot recovery
+    # pass (service/actors.recover_orphans) resubmits checkpointed
+    # orphans (they resume from their persisted frontier) and gives the
+    # rest a durable "interrupted by restart" failure, so no client ever
+    # polls a forever-pending uid.
+
+    def journal_set(self, uid: str, payload_json: str) -> None:
+        faults.fault_site("service.journal", key=f"fsm:journal:{uid}")
+        self.set(f"fsm:journal:{uid}", payload_json)
+
+    def journal_get(self, uid: str) -> Optional[str]:
+        return self.get(f"fsm:journal:{uid}")
+
+    def journal_clear(self, uid: str) -> None:
+        self.delete(f"fsm:journal:{uid}")
+
+    def journal_uids(self) -> List[str]:
+        return [k[len("fsm:journal:"):] for k in self.keys("fsm:journal:")]
 
     # -- job status registry (RedisCache.addStatus / status) ---------------
 
@@ -240,3 +276,8 @@ class RedisResultStore(ResultStore):
 
     def incr(self, key: str) -> int:
         return self._r.incr(key)
+
+    def keys(self, prefix: str) -> List[str]:
+        # Redis KEYS is O(keyspace) and blocks the server — acceptable
+        # here because the only caller is the boot-time recovery scan.
+        return sorted(self._r.keys(prefix + "*"))

@@ -39,6 +39,14 @@ Semantics:
   (``stats["leaked_threads"]`` + the module-wide :func:`consumer_health`
   counter ``/admin/health`` reports) and logs it, instead of returning
   silently with a zombie poll loop still attached to the broker.
+- BACKPRESSURE (ISSUE 5): with ``queue_depth_fn``/``pause_at``/
+  ``resume_at`` set, the consumer PAUSES polling when the downstream
+  queue (e.g. ``Miner.queue_size``) reaches the high watermark and
+  resumes once it drains to the low one — windows wait at the broker
+  (which retains them) instead of being submitted into an admission
+  queue that would shed them with 429.  Pause/resume transitions are
+  counted per instance (``stats``) and process-wide
+  (:func:`consumer_health` / ``fsm_consumer_backpressure_pauses_total``).
 """
 
 from __future__ import annotations
@@ -55,7 +63,7 @@ from spark_fsm_tpu.utils.retry import RetryPolicy
 FetchFn = Callable[[], Optional[SequenceDB]]
 
 _health_lock = threading.Lock()
-_health = {"leaked_threads": 0}
+_health = {"leaked_threads": 0, "backpressure_pauses": 0}
 # consume-side freshness: wall clock of the last poll and the last
 # NON-IDLE poll across every consumer in the process.  The scrape-time
 # gauge fsm_consumer_poll_lag_seconds = now - last consumed batch — the
@@ -73,9 +81,13 @@ _ERRORS_TOTAL = obs.REGISTRY.counter("fsm_consumer_errors_total")
 
 
 def _collect_metrics():
+    health = consumer_health()
     fams = [("fsm_consumer_leaked_threads_total", "counter",
              "poll threads that outran stop()'s join deadline",
-             [({}, consumer_health()["leaked_threads"])])]
+             [({}, health["leaked_threads"])]),
+            ("fsm_consumer_backpressure_pauses_total", "counter",
+             "poll loops paused at the downstream-queue high watermark",
+             [({}, health["backpressure_pauses"])])]
     now = time.monotonic()
     for name, ts in (("fsm_consumer_poll_age_seconds", _last_poll_ts),
                      ("fsm_consumer_poll_lag_seconds", _last_batch_ts)):
@@ -100,6 +112,11 @@ def consumer_health() -> dict:
 def _count_leak() -> None:
     with _health_lock:
         _health["leaked_threads"] += 1
+
+
+def _count_pause() -> None:
+    with _health_lock:
+        _health["backpressure_pauses"] += 1
 
 
 class StopConsumer(Exception):
@@ -127,15 +144,33 @@ class PollConsumer:
                  max_consecutive_errors: Optional[int] = None,
                  max_backoff_s: float = 30.0,
                  on_result: Optional[Callable] = None,
-                 on_error: Optional[Callable] = None) -> None:
+                 on_error: Optional[Callable] = None,
+                 queue_depth_fn: Optional[Callable[[], int]] = None,
+                 pause_at: Optional[int] = None,
+                 resume_at: Optional[int] = None) -> None:
         if poll_interval_s < 0:
             raise ValueError(f"poll_interval_s must be >= 0 "
                              f"(got {poll_interval_s})")
         if max_consecutive_errors is not None and max_consecutive_errors < 1:
             raise ValueError(f"max_consecutive_errors must be >= 1 or None "
                              f"(got {max_consecutive_errors})")
+        if queue_depth_fn is not None:
+            if pause_at is None or pause_at < 1:
+                raise ValueError("queue_depth_fn needs pause_at >= 1 "
+                                 f"(got {pause_at})")
+            if resume_at is None:
+                resume_at = pause_at // 2
+            if not 0 <= resume_at < pause_at:
+                raise ValueError(f"resume_at must satisfy 0 <= resume_at < "
+                                 f"pause_at (got {resume_at} vs {pause_at})")
+        elif pause_at is not None or resume_at is not None:
+            raise ValueError("pause_at/resume_at need queue_depth_fn")
         self._fetch = fetch
         self._sink = sink
+        self._depth_fn = queue_depth_fn
+        self.pause_at = pause_at
+        self.resume_at = resume_at
+        self._paused = False
         self.poll_interval_s = float(poll_interval_s)
         self.max_consecutive_errors = max_consecutive_errors
         self.max_backoff_s = float(max_backoff_s)
@@ -151,7 +186,9 @@ class PollConsumer:
         self._consecutive_errors = 0
         self.stats = {"polls": 0, "idle_polls": 0, "batches": 0,
                       "sequences": 0, "errors": 0, "backoff_waits": 0,
-                      "leaked_threads": 0, "stopped": None}
+                      "leaked_threads": 0, "stopped": None,
+                      "backpressure_pauses": 0, "backpressure_resumes": 0,
+                      "paused_polls": 0}
 
     # ------------------------------------------------------------- polling
 
@@ -199,6 +236,47 @@ class PollConsumer:
                 self._report_error(exc)
         return True
 
+    def _backpressure_hold(self) -> bool:
+        """True when this loop iteration was spent paused at the
+        downstream high watermark instead of polling.  The depth probe
+        failing is reported but FAILS OPEN (polling continues): a broken
+        gauge must not silently starve the topic forever."""
+        if self._depth_fn is None:
+            return False
+        try:
+            depth = int(self._depth_fn())
+        except Exception as exc:
+            self._report_error(exc)
+            if self._paused:
+                # failing open FROM a pause is a resume transition: count
+                # + log it, or pause/resume stats diverge and the fail-
+                # open is invisible to an operator pairing them
+                self._paused = False
+                self.stats["backpressure_resumes"] += 1
+                log_event("consumer_resumed", depth=None,
+                          reason="depth probe failed (fail open)")
+            return False
+        if self._paused:
+            if depth <= self.resume_at:
+                self._paused = False
+                self.stats["backpressure_resumes"] += 1
+                log_event("consumer_resumed", depth=depth,
+                          resume_at=self.resume_at)
+                return False
+        elif depth >= self.pause_at:
+            self._paused = True
+            self.stats["backpressure_pauses"] += 1
+            _count_pause()
+            obs.trace_event("consumer_paused", depth=depth,
+                            pause_at=self.pause_at)
+            log_event("consumer_paused", depth=depth, pause_at=self.pause_at)
+        if self._paused:
+            self.stats["paused_polls"] += 1
+            # wake immediately on stop(); poll the gauge at the idle
+            # cadence (floored so interval 0 cannot spin on the gauge)
+            self._stop.wait(self.poll_interval_s or 0.05)
+        return self._paused
+
     def _report_error(self, exc: Exception) -> None:
         """Count + surface an error; the reporting callback itself must
         never kill the loop."""
@@ -232,6 +310,10 @@ class PollConsumer:
                 self.stats["stopped"] = "max_polls"
                 break
             polls += 1
+            # backpressure: a paused iteration burns a poll slot (so
+            # bounded runs stay bounded) but never touches the broker
+            if self._backpressure_hold():
+                continue
             try:
                 consumed = self.poll_once()
             except StopConsumer:

@@ -73,6 +73,8 @@ KNOWN_SITES = (
     "checkpoint.save",   # whole-snapshot save (service/actors)
     "prewarm.compile",   # per-shape-key AOT compile (service/prewarm)
     "devcache.put",      # engine-cache device build/insert (service/devcache)
+    "service.admit",     # train-submit admission (service/actors.Miner.submit)
+    "service.journal",   # write-ahead job-journal intent write (service/store)
 )
 
 _EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}

@@ -1,0 +1,187 @@
+"""Per-job deadlines and cancellation — the admission layer's abort seam.
+
+A train job used to be unstoppable once submitted: no deadline, no
+cancel, and a worker burning device time on a job whose client gave up
+long ago.  This module is the process-global registry of LIVE jobs
+(one :class:`JobControl` per submitted uid, registered by
+``Miner.submit`` and released on every terminal status) carrying the
+two abort signals:
+
+- **deadline**: stamped at submit as an absolute monotonic instant
+  (``now + deadline_s``), so time spent WAITING in the admission queue
+  spends the budget exactly like time spent mining;
+- **cancelled**: flipped by ``POST /admin/cancel/{uid}`` (or
+  :func:`cancel`) at any point of the job's life.
+
+The signals are enforced at the engines' existing safe points — the
+spots between device launches where the dispatch watchdog and the OOM
+degradation ladder already live (models/tsr.py pipeline loop,
+models/spade_queue.py segment loop) plus the Miner's own step
+boundaries — via :func:`check`, which raises :class:`JobCancelled` /
+:class:`JobDeadlineExceeded` (both :class:`JobAborted`).  Job
+supervision treats a JobAborted as TERMINAL: no retry, a durable
+``failure`` status whose error text leads with ``CANCELLED`` /
+``DEADLINE_EXCEEDED``, and a trace event in the flight recorder.
+
+Cost contract (the same pin as utils/faults and the flight recorder):
+with no deadline set and no cancel pending anywhere in the process,
+:func:`check` is ONE module-global read — scripts/bench_smoke.sh's
+byte-identical dispatch counters hold.  The current job rides a
+contextvar (set by ``Miner._loop`` around the run), so engine code
+calls :func:`check` with zero plumbing, exactly like obs spans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from typing import Dict, Optional
+
+from spark_fsm_tpu.utils import obs
+
+_CANCELLED_TOTAL = obs.REGISTRY.counter(
+    "fsm_jobs_cancelled_total",
+    "jobs aborted by /admin/cancel (queued or mid-mine)")
+_DEADLINE_TOTAL = obs.REGISTRY.counter(
+    "fsm_jobs_deadline_exceeded_total",
+    "jobs aborted because their deadline_s budget ran out")
+
+
+class JobAborted(RuntimeError):
+    """Base of the two abort signals.  TERMINAL for supervision: the
+    Miner records a durable failure instead of retrying (a retry would
+    just re-spend a budget the client already exhausted)."""
+
+    code = "ABORTED"
+
+    def __init__(self, uid: str, detail: str):
+        self.uid = uid
+        super().__init__(f"{self.code}: job {uid!r} {detail}")
+
+
+class JobCancelled(JobAborted):
+    code = "CANCELLED"
+
+
+class JobDeadlineExceeded(JobAborted):
+    code = "DEADLINE_EXCEEDED"
+
+
+class JobControl:
+    """The live-job record.  ``cancelled`` is a plain bool flipped under
+    the module lock and read lock-free at check sites (a stale read
+    costs one extra launch, never a missed abort — the next check sees
+    it)."""
+
+    __slots__ = ("uid", "deadline", "cancelled", "running")
+
+    def __init__(self, uid: str, deadline: Optional[float]):
+        self.uid = uid
+        self.deadline = deadline  # absolute time.monotonic(), or None
+        self.cancelled = False
+        self.running = False  # False = still queued (set by activate())
+
+
+_lock = threading.Lock()
+_jobs: Dict[str, JobControl] = {}
+# Fast-path flag: True only while some live job carries a deadline or a
+# pending cancel — check() returns on this one global read otherwise.
+_active = False
+
+# the job whose worker thread this is (None on handler/stream threads)
+_cur: contextvars.ContextVar[Optional[JobControl]] = contextvars.ContextVar(
+    "fsm_jobctl", default=None)
+
+
+def _recompute_active_locked() -> None:
+    global _active
+    _active = any(c.deadline is not None or c.cancelled
+                  for c in _jobs.values())
+
+
+def register(uid: str, deadline_s: Optional[float] = None) -> JobControl:
+    """Register a submitted job; the deadline budget starts NOW (queue
+    wait spends it).  Re-registering a uid replaces the old entry — the
+    admission layer's 409 conflict check guarantees the old incarnation
+    is dead by then."""
+    ctl = JobControl(uid, None if deadline_s is None
+                     else time.monotonic() + float(deadline_s))
+    with _lock:
+        _jobs[uid] = ctl
+        _recompute_active_locked()
+    return ctl
+
+
+def release(uid: str) -> None:
+    """Drop a job's entry on ANY terminal status (idempotent)."""
+    with _lock:
+        _jobs.pop(uid, None)
+        _recompute_active_locked()
+
+
+def get(uid: str) -> Optional[JobControl]:
+    with _lock:
+        return _jobs.get(uid)
+
+
+def cancel(uid: str) -> Optional[str]:
+    """Request cancellation of a live job.  Returns ``"running"`` /
+    ``"queued"`` (what the job was doing when flagged) or None when no
+    live job owns the uid (unknown, or already terminal) — the 404
+    case.  The abort lands at the job's next safe point."""
+    global _active
+    with _lock:
+        ctl = _jobs.get(uid)
+        if ctl is None:
+            return None
+        ctl.cancelled = True
+        _active = True
+        return "running" if ctl.running else "queued"
+
+
+def live_count() -> int:
+    with _lock:
+        return len(_jobs)
+
+
+@contextlib.contextmanager
+def activate(ctl: Optional[JobControl]):
+    """Bind ``ctl`` as the current job for this thread/context (the
+    Miner wraps each run in this), so engine-level :func:`check` calls
+    see it with no plumbing."""
+    if ctl is None:
+        yield
+        return
+    ctl.running = True
+    token = _cur.set(ctl)
+    try:
+        yield
+    finally:
+        _cur.reset(token)
+
+
+def check_entry(ctl: Optional[JobControl]) -> None:
+    """Raise the abort owed by ``ctl``, if any.  Used directly by the
+    Miner on dequeue (the queued-job path, where no context is bound)."""
+    if ctl is None:
+        return
+    if ctl.cancelled:
+        _CANCELLED_TOTAL.inc()
+        obs.trace_event("job_cancelled", uid=ctl.uid)
+        raise JobCancelled(ctl.uid, "cancelled via /admin/cancel")
+    if ctl.deadline is not None and time.monotonic() > ctl.deadline:
+        _DEADLINE_TOTAL.inc()
+        obs.trace_event("job_deadline_exceeded", uid=ctl.uid)
+        raise JobDeadlineExceeded(
+            ctl.uid, "outran its deadline_s budget (includes queue wait)")
+
+
+def check() -> None:
+    """The engine-side safe-point probe: one module-global read when no
+    deadline/cancel exists anywhere; otherwise consult the current
+    job's entry and raise its abort."""
+    if not _active:
+        return
+    check_entry(_cur.get())

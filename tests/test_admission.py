@@ -1,0 +1,501 @@
+"""Overload, deadline/cancel, and crash-restart recovery drills (ISSUE 5).
+
+The acceptance drills run in-process against the real Master/Miner with
+a deterministically BLOCKED worker (sources.get_db monkeypatched to gate
+on an Event), so queue occupancy is exact — no sleep-and-hope:
+
+- overload: flooding ``queue_depth + k`` submits sheds exactly ``k``
+  with AdmissionShed/HTTP 429 + Retry-After, zero store writes for the
+  shed uids, and the queue-depth gauge returns to 0;
+- priority classes drain high -> normal -> low;
+- resubmitting a live uid is a 409 conflict, never a state wipe;
+- a deadline spent entirely on queue wait aborts the job durably
+  (DEADLINE_EXCEEDED) before the dataset is ever built; /admin/cancel
+  aborts a queued or running job the same way (CANCELLED);
+- shutdown drain under a FULL queue: every backlog job gets a durable
+  failure + a cleared journal entry, sheds during the drain still 429;
+- kill-restart: a checkpointed mine killed between frontier saves is
+  resubmitted by the boot recovery pass and finishes with the exact
+  oracle pattern set (zero duplicated results); a non-checkpointed
+  orphan lands in a durable "interrupted by restart" failure.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.service import sources
+from spark_fsm_tpu.service.actors import (AdmissionShed, Master, Miner,
+                                          StoreCheckpoint, UidConflict,
+                                          recover_orphans)
+from spark_fsm_tpu.service.model import ServiceRequest, deserialize_patterns
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import jobctl
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+DRILL_TIMEOUT_S = 120.0
+
+
+def _req(uid, **extra):
+    data = {"algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n", "support": "1.0",
+            "uid": uid}
+    data.update(extra)
+    return ServiceRequest("fsm", "train", data)
+
+
+class _Gate:
+    """Deterministic worker occupancy: get_db blocks for chosen uids
+    until released; every uid that reaches get_db is recorded in order."""
+
+    def __init__(self, monkeypatch, block_uids=()):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self.block_uids = set(block_uids)
+        self.run_order = []
+        real = sources.get_db
+
+        def gated(req, store):
+            self.run_order.append(req.uid)
+            if req.uid in self.block_uids:
+                self.entered.set()
+                assert self.release.wait(DRILL_TIMEOUT_S), "gate never freed"
+            return real(req, store)
+
+        monkeypatch.setattr(sources, "get_db", gated)
+
+
+def _await_terminal(store, uid, timeout=DRILL_TIMEOUT_S):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = store.status(uid)
+        if st in ("finished", "failure"):
+            return st
+        time.sleep(0.01)
+    raise TimeoutError(f"job {uid} reached no terminal status "
+                       f"(now {store.status(uid)!r})")
+
+
+def _gauge(name):
+    return __import__("spark_fsm_tpu.utils.obs",
+                      fromlist=["REGISTRY"]).REGISTRY.snapshot()[name]
+
+
+# ----------------------------------------------------------------- overload
+
+
+def test_flood_sheds_exactly_k_with_retry_after(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"blocker"})
+    miner = Miner(store, workers=1, queue_depth=2)
+    try:
+        miner.submit(_req("blocker"))
+        assert gate.entered.wait(DRILL_TIMEOUT_S)  # worker occupied
+        miner.submit(_req("q1"))
+        miner.submit(_req("q2"))
+        assert miner.queue_size() == 2
+        assert _gauge("fsm_service_queue_depth") == 2
+        sheds = []
+        for i in range(3):
+            with pytest.raises(AdmissionShed) as err:
+                miner.submit(_req(f"shed{i}"))
+            sheds.append(err.value)
+        # Retry-After sanity: a positive bounded integer seconds hint
+        assert all(1 <= s.retry_after_s <= 3600 for s in sheds)
+        # a shed leaves ZERO trace of the uid — no status, no journal
+        for i in range(3):
+            assert store.status(f"shed{i}") is None
+            assert store.journal_get(f"shed{i}") is None
+        gate.release.set()
+        for uid in ("blocker", "q1", "q2"):
+            assert _await_terminal(store, uid) == "finished", \
+                store.get(f"fsm:error:{uid}")
+        # queue drained: gauge back to 0, journals settled
+        assert miner.queue_size() == 0
+        assert _gauge("fsm_service_queue_depth") == 0
+        assert store.journal_uids() == []
+    finally:
+        gate.release.set()
+        miner.shutdown()
+
+
+def test_priority_classes_drain_high_first(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"blocker"})
+    miner = Miner(store, workers=1, queue_depth=16)
+    try:
+        miner.submit(_req("blocker"))
+        assert gate.entered.wait(DRILL_TIMEOUT_S)
+        miner.submit(_req("p-low", priority="low"))
+        miner.submit(_req("p-norm"))  # default normal
+        miner.submit(_req("p-high", priority="high"))
+        gate.release.set()
+        for uid in ("p-low", "p-norm", "p-high"):
+            assert _await_terminal(store, uid) == "finished"
+        assert gate.run_order == ["blocker", "p-high", "p-norm", "p-low"]
+        with pytest.raises(ValueError, match="unknown priority"):
+            miner.submit(_req("bad", priority="urgent"))
+    finally:
+        gate.release.set()
+        miner.shutdown()
+
+
+def test_unbounded_queue_depth_zero_never_sheds(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"blocker"})
+    miner = Miner(store, workers=1, queue_depth=0)
+    try:
+        miner.submit(_req("blocker"))
+        assert gate.entered.wait(DRILL_TIMEOUT_S)
+        for i in range(8):
+            miner.submit(_req(f"j{i}"))  # no AdmissionShed
+        assert miner.queue_size() == 8
+        gate.release.set()
+        for i in range(8):
+            assert _await_terminal(store, f"j{i}") == "finished"
+    finally:
+        gate.release.set()
+        miner.shutdown()
+
+
+# ----------------------------------------------------------- uid conflicts
+
+
+def test_resubmitting_live_uid_is_conflict_not_state_wipe(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"dup"})
+    miner = Miner(store, workers=1, queue_depth=8)
+    try:
+        miner.submit(_req("dup"))
+        assert gate.entered.wait(DRILL_TIMEOUT_S)
+        with pytest.raises(UidConflict):  # running
+            miner.submit(_req("dup"))
+        miner.submit(_req("queued-dup"))
+        with pytest.raises(UidConflict):  # queued
+            miner.submit(_req("queued-dup"))
+        gate.release.set()
+        assert _await_terminal(store, "dup") == "finished"
+        assert _await_terminal(store, "queued-dup") == "finished"
+        # terminal uid: resubmit is allowed again and re-runs cleanly
+        miner.submit(_req("dup"))
+        assert _await_terminal(store, "dup") == "finished"
+    finally:
+        gate.release.set()
+        miner.shutdown()
+
+
+# ------------------------------------------------------ deadlines + cancel
+
+
+def test_deadline_spent_on_queue_wait_aborts_before_running(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"blocker"})
+    miner = Miner(store, workers=1, queue_depth=8)
+    try:
+        miner.submit(_req("blocker"))
+        assert gate.entered.wait(DRILL_TIMEOUT_S)
+        miner.submit(_req("late", deadline_s="0.05"))
+        time.sleep(0.15)  # the budget burns entirely on queue wait
+        gate.release.set()
+        assert _await_terminal(store, "late") == "failure"
+        err = store.get("fsm:error:late") or ""
+        assert err.startswith("DEADLINE_EXCEEDED"), err
+        assert "late" not in gate.run_order  # never built a dataset
+        assert store.journal_get("late") is None
+        assert jobctl.get("late") is None  # control entry released
+    finally:
+        gate.release.set()
+        miner.shutdown()
+
+
+def test_bad_deadline_and_priority_rejected_synchronously():
+    store = ResultStore()
+    miner = Miner(store, workers=1, queue_depth=8)
+    try:
+        with pytest.raises(ValueError, match="deadline_s"):
+            miner.submit(_req("bad1", deadline_s="-3"))
+        with pytest.raises(ValueError):
+            miner.submit(_req("bad2", deadline_s="soon"))
+        # nan parses as float but compares False to everything — it must
+        # be rejected, not armed as a deadline that can never expire
+        with pytest.raises(ValueError, match="finite"):
+            miner.submit(_req("bad3", deadline_s="nan"))
+        with pytest.raises(ValueError, match="finite"):
+            miner.submit(_req("bad4", deadline_s="inf"))
+        # nothing half-submitted
+        for uid in ("bad1", "bad2", "bad3", "bad4"):
+            assert store.status(uid) is None
+    finally:
+        miner.shutdown()
+
+
+def test_cancel_running_and_queued_jobs(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"run1"})
+    miner = Miner(store, workers=1, queue_depth=8)
+    try:
+        miner.submit(_req("run1"))
+        assert gate.entered.wait(DRILL_TIMEOUT_S)
+        miner.submit(_req("q1"))
+        assert jobctl.cancel("run1") == "running"
+        assert jobctl.cancel("q1") == "queued"
+        assert jobctl.cancel("nope") is None
+        gate.release.set()
+        # run1 aborts at the post-dataset safe point; q1 on dequeue
+        assert _await_terminal(store, "run1") == "failure"
+        assert (store.get("fsm:error:run1") or "").startswith("CANCELLED")
+        assert _await_terminal(store, "q1") == "failure"
+        assert (store.get("fsm:error:q1") or "").startswith("CANCELLED")
+        assert "q1" not in gate.run_order  # cancelled before running
+        assert store.journal_uids() == []
+    finally:
+        gate.release.set()
+        miner.shutdown()
+
+
+# --------------------------------------------------------- HTTP code paths
+
+
+def _serve(master):
+    from spark_fsm_tpu.service.app import make_server
+
+    server = make_server(0, master=master)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="fsm-http-admission-test").start()
+    return server
+
+
+def _post_raw(port, endpoint, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{endpoint}"
+    try:
+        with urllib.request.urlopen(url, data=data, timeout=30) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read().decode())
+
+
+def test_http_429_retry_after_409_conflict_and_cancel(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"web-block"})
+    master = Master(store=store, queue_depth=1)
+    server = _serve(master)
+    port = server.server_port
+    try:
+        code, _, body = _post_raw(port, "/train", uid="web-block",
+                                  algorithm="SPADE", source="INLINE",
+                                  sequences="1 -1 2 -2\n", support="1.0")
+        assert code == 200 and body["status"] == "started"
+        assert gate.entered.wait(DRILL_TIMEOUT_S)
+        code, _, body = _post_raw(port, "/train", uid="web-q1",
+                                  algorithm="SPADE", source="INLINE",
+                                  sequences="1 -1 2 -2\n", support="1.0")
+        assert code == 200
+        # queue (depth 1) is now full: shed with 429 + Retry-After
+        code, headers, body = _post_raw(port, "/train", uid="web-shed",
+                                        algorithm="SPADE", source="INLINE",
+                                        sequences="1 -1 2 -2\n",
+                                        support="1.0")
+        assert code == 429, body
+        assert body["status"] == "failure"
+        assert "queue full" in body["data"]["error"]
+        retry_after = int(headers.get("Retry-After"))
+        assert retry_after >= 1
+        assert body["data"]["retry_after_s"] == str(retry_after)
+        # live uid: 409 conflict
+        code, _, body = _post_raw(port, "/train", uid="web-block",
+                                  algorithm="SPADE", source="INLINE",
+                                  sequences="1 -1 2 -2\n", support="1.0")
+        assert code == 409 and "live" in body["data"]["error"]
+        # cancel over HTTP: running job, then unknown -> 404
+        code, _, body = _post_raw(port, "/admin/cancel/web-block")
+        assert code == 200 and body["was"] == "running"
+        code, _, body = _post_raw(port, "/admin/cancel/web-nope")
+        assert code == 404
+        # cancelling the QUEUED job settles it immediately and returns
+        # its admission slot: the next submit admits instead of shedding
+        code, _, body = _post_raw(port, "/admin/cancel/web-q1")
+        assert code == 200 and body["was"] == "queued"
+        assert _await_terminal(store, "web-q1") == "failure"
+        assert (store.get("fsm:error:web-q1") or "").startswith("CANCELLED")
+        code, _, body = _post_raw(port, "/train", uid="web-q2",
+                                  algorithm="SPADE", source="INLINE",
+                                  sequences="1 -1 2 -2\n", support="1.0")
+        assert code == 200 and body["status"] == "started", body
+        gate.release.set()
+        assert _await_terminal(store, "web-block") == "failure"
+        assert (store.get("fsm:error:web-block") or "").startswith(
+            "CANCELLED")
+        assert _await_terminal(store, "web-q2") == "finished"
+    finally:
+        gate.release.set()
+        master.shutdown()
+        server.shutdown()
+
+
+# -------------------------------------------------- shutdown drain (full q)
+
+
+def test_shutdown_drain_under_full_queue_fails_backlog_durably(monkeypatch):
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"blocker"})
+    miner = Miner(store, workers=1, queue_depth=3)
+    miner.submit(_req("blocker"))
+    assert gate.entered.wait(DRILL_TIMEOUT_S)
+    for i in range(3):
+        miner.submit(_req(f"backlog{i}"))
+    done = threading.Event()
+
+    def drain():
+        miner.shutdown(join_timeout_s=DRILL_TIMEOUT_S)
+        done.set()
+
+    threading.Thread(target=drain, daemon=True).start()
+    # wait until the drain is underway (stopping flag set)
+    deadline = time.time() + DRILL_TIMEOUT_S
+    while not miner._stopping and time.time() < deadline:
+        time.sleep(0.01)
+    # sheds DURING the drain still answer 429 (queue is full), no hang
+    with pytest.raises(AdmissionShed):
+        miner.submit(_req("drain-shed"))
+    assert store.status("drain-shed") is None
+    gate.release.set()
+    assert done.wait(DRILL_TIMEOUT_S), "shutdown drain hung"
+    # the running job finished; every queued backlog job got a durable
+    # failure and its journal entry was settled
+    assert store.status("blocker") == "finished"
+    for i in range(3):
+        uid = f"backlog{i}"
+        assert store.status(uid) == "failure"
+        assert "shutting down" in (store.get(f"fsm:error:{uid}") or "")
+        assert store.journal_get(uid) is None
+    assert store.journal_uids() == []
+    assert miner.queue_size() == 0
+
+
+# ----------------------------------------------------- kill-restart drill
+
+
+class _Kill(BaseException):
+    """Simulated hard kill: BaseException so no supervision layer eats
+    it — the store is left exactly as a SIGKILL would leave it."""
+
+
+class _KillingCheckpoint:
+    """StoreCheckpoint wrapper that 'kills the process' right after the
+    first frontier save lands."""
+
+    def __init__(self, inner, after_saves=1):
+        self.inner = inner
+        self.every_s = 0.0
+        self.saves = 0
+        self.after = after_saves
+
+    def load(self):
+        return self.inner.load()
+
+    def save(self, state):
+        self.inner.save(state)
+        self.saves += 1
+        if self.saves >= self.after:
+            raise _Kill
+
+
+def _orphan_checkpointed_job(store, uid, db_text):
+    """Leave the store exactly as a kill -9 mid-mine would: journal
+    intent from a dead incarnation, status 'started', a persisted
+    frontier from the first checkpoint save, NO results."""
+    from spark_fsm_tpu.data.spmf import parse_spmf
+    from spark_fsm_tpu.service import plugins
+
+    req_data = {"algorithm": "SPADE_TPU", "source": "INLINE",
+                "sequences": db_text, "support": "0.1", "checkpoint": "1",
+                "checkpoint_every_s": "0", "uid": uid}
+    store.journal_set(uid, json.dumps({
+        "uid": uid, "incarnation": "dead-incarnation", "ts": 0,
+        "checkpoint": True, "priority": "normal", "request": req_data}))
+    store.add_status(uid, "started")
+    ckpt = _KillingCheckpoint(StoreCheckpoint(store, uid, every_s=0.0))
+    req = ServiceRequest("fsm", "train", dict(req_data))
+    db = parse_spmf(db_text)
+    with pytest.raises(_Kill):
+        plugins.get_plugin(req).extract(req, db, {}, checkpoint=ckpt)
+    assert ckpt.saves >= 1
+    assert store.get(f"fsm:frontier:{uid}") is not None
+    assert store.patterns(uid) is None
+    return req_data
+
+
+def test_kill_restart_drill_resumes_checkpointed_and_fails_orphans():
+    db = synthetic_db(seed=31, n_sequences=120, n_items=10,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    db_text = format_spmf(db)
+    store = ResultStore()
+    _orphan_checkpointed_job(store, "drill", db_text)
+    # a non-checkpointed orphan (queued or mid-mine at the kill)
+    store.journal_set("plain", json.dumps({
+        "uid": "plain", "incarnation": "dead-incarnation", "ts": 0,
+        "checkpoint": False, "priority": "normal",
+        "request": {"algorithm": "SPADE", "source": "INLINE",
+                    "sequences": "1 -1 2 -2\n", "support": "1.0",
+                    "uid": "plain"}}))
+    store.add_status("plain", "started")
+    # an orphan whose crash hit between the terminal write and the
+    # journal clear: already finished, journal just needs settling
+    store.journal_set("settled", json.dumps({
+        "uid": "settled", "incarnation": "dead-incarnation", "ts": 0,
+        "checkpoint": False, "priority": "normal", "request": {}}))
+    store.add_status("settled", "finished")
+
+    master = Master(store=store)  # the rebooted incarnation
+    try:
+        report = recover_orphans(master)
+        assert report["resumed"] == ["drill"]
+        assert report["failed"] == ["plain"]
+        assert report["cleared"] == ["settled"]
+        # the resubmitted checkpointed mine resumes from its persisted
+        # frontier and finishes with the EXACT oracle pattern set —
+        # zero duplicated results
+        assert _await_terminal(store, "drill") == "finished", \
+            store.get("fsm:error:drill")
+        got = deserialize_patterns(store.patterns("drill"))
+        want = mine_spade(db, abs_minsup(0.1, len(db)))
+        assert patterns_text(got) == patterns_text(want)
+        # non-checkpointed orphan: durable, explicit failure
+        assert store.status("plain") == "failure"
+        assert "interrupted by restart" in (store.get("fsm:error:plain")
+                                            or "")
+        assert store.status("settled") == "finished"
+        # every journal intent is settled after the drill
+        assert store.journal_uids() == []
+    finally:
+        master.shutdown()
+
+
+def test_recovery_is_idempotent_and_skips_live_jobs(monkeypatch):
+    """A second recovery pass (double boot, or a sibling process racing)
+    finds nothing: resubmitted jobs are LIVE in the new incarnation."""
+    store = ResultStore()
+    gate = _Gate(monkeypatch, block_uids={"held"})
+    master = Master(store=store)
+    try:
+        master.miner.submit(_req("held"))
+        assert gate.entered.wait(DRILL_TIMEOUT_S)
+        report = recover_orphans(master)
+        assert report == {"resumed": [], "failed": [], "cleared": []}
+        assert store.status("held") == "started"  # untouched
+        gate.release.set()
+        assert _await_terminal(store, "held") == "finished"
+    finally:
+        gate.release.set()
+        master.shutdown()

@@ -538,6 +538,115 @@ def test_consumer_stop_counts_leaked_thread():
         release.set()  # let the wedged sink finish so the thread exits
 
 
+# ------------------------------------------- admission + journal + deadline
+
+
+def _submit_data(uid):
+    return {"algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n", "support": "1.0",
+            "uid": uid}
+
+
+@covers("service.admit")
+def test_admit_fault_is_clean_synchronous_failure():
+    """An injected admission failure surfaces as a clean failure
+    envelope BEFORE any store write — no half-submitted job, no journal
+    entry, no queue-slot leak (the disarmed resubmit runs normally)."""
+    store = ResultStore()
+    master = Master(store=store)
+    try:
+        with faults.injected("service.admit", nth=1):
+            resp = master.handle(ServiceRequest(
+                "fsm", "train", _submit_data("chaos-admit")))
+        assert resp.status == "failure"
+        assert "injected fault" in resp.data["error"]
+        assert store.status("chaos-admit") is None
+        assert store.journal_get("chaos-admit") is None
+        # disarmed: the same submit admits and finishes
+        uid, status = _bounded(lambda: _run_train(
+            store, _submit_data("chaos-admit")))
+        assert status == "finished", store.get(f"fsm:error:{uid}")
+    finally:
+        master.shutdown()
+
+
+@covers("service.journal")
+def test_journal_write_fault_fails_submit_without_slot_leak():
+    """An injected journal-intent write failure rejects the submit
+    cleanly (no stuck 'started' job) and RELEASES the reserved queue
+    slot — proven by filling the queue to its exact bound afterwards."""
+    from spark_fsm_tpu.service.actors import Miner
+
+    store = ResultStore()
+    miner = Miner(store, workers=1, queue_depth=2)
+    try:
+        with faults.injected("service.journal", nth=1):
+            with pytest.raises(faults.FaultInjected):
+                miner.submit(ServiceRequest(
+                    "fsm", "train", _submit_data("chaos-journal")))
+        assert store.status("chaos-journal") is None
+        assert store.journal_get("chaos-journal") is None
+        # the aborted submit must not have leaked its reservation (a
+        # leak would permanently shrink the usable queue depth)
+        assert miner._q._reserved == 0 and miner.queue_size() == 0
+        # and disarmed submits admit + finish normally
+        for i in range(2):
+            miner.submit(ServiceRequest(
+                "fsm", "train", _submit_data(f"chaos-fill{i}")))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(store.status(f"chaos-fill{i}") == "finished"
+                   for i in range(2)):
+                break
+            time.sleep(0.02)
+        for i in range(2):
+            assert store.status(f"chaos-fill{i}") == "finished"
+        # a submit that dies AFTER its journal intent landed (injected
+        # status-write failure) must settle the intent on the way out —
+        # a live-looking record would 409 every resubmit of the uid
+        with faults.injected("store.set", nth=1,
+                             match="fsm:status:chaos-late"):
+            with pytest.raises(faults.FaultInjected):
+                miner.submit(ServiceRequest(
+                    "fsm", "train", _submit_data("chaos-late")))
+        assert store.journal_get("chaos-late") is None
+        miner.submit(ServiceRequest(  # no 409: the uid is free again
+            "fsm", "train", _submit_data("chaos-late")))
+        deadline = time.time() + 60
+        while (store.status("chaos-late") != "finished"
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert store.status("chaos-late") == "finished"
+    finally:
+        miner.shutdown()
+
+
+def test_deadline_expiry_mid_mine_fails_fast_and_durable():
+    """A deadline that expires BETWEEN device launches (the injected
+    per-dispatch delay guarantees the first launch outlives it) aborts
+    the mine at the next safe point: durable DEADLINE_EXCEEDED failure,
+    no retry, the job-control entry released — never device time burned
+    to completion, never a hang."""
+    from spark_fsm_tpu.utils import jobctl
+
+    db = _rule_db()
+    store = ResultStore()
+    with faults.injected("device.dispatch", every=1, delay_s=0.6,
+                         exc="none", match="jnp"):
+        uid, status = _bounded(lambda: _run_train(store, {
+            "algorithm": "TSR_TPU", "source": "INLINE",
+            "sequences": format_spmf(db), "k": "8", "minconf": "0.4",
+            "max_side": "2", "deadline_s": "0.5", "retries": "3"}))
+    assert status == "failure"
+    err = store.get(f"fsm:error:{uid}") or ""
+    assert err.startswith("DEADLINE_EXCEEDED"), err
+    # terminal bookkeeping: journal settled, control entry gone, and the
+    # abort did NOT consume the retry budget (jobs_retried untouched)
+    assert store.journal_get(uid) is None
+    assert jobctl.get(uid) is None
+    assert int(store.get("fsm:metric:jobs_retried") or 0) == 0
+
+
 # ------------------------------------------------------- admin endpoints
 
 

@@ -18,7 +18,9 @@ from spark_fsm_tpu.service.store import RedisResultStore
 
 
 class MiniRedis:
-    """RESP2 server on a loopback socket: SET/GET/RPUSH/LRANGE/DEL/INCR/PING."""
+    """RESP2 server on a loopback socket implementing the command subset
+    the store uses: SET/GET/RPUSH/LRANGE/LPOP/LLEN/LTRIM/DEL/INCR/KEYS/
+    PING."""
 
     def __init__(self):
         self.kv = {}
@@ -130,6 +132,18 @@ class MiniRedis:
                 v = int(self.kv.get(rest[0], "0")) + 1
                 self.kv[rest[0]] = str(v)
                 return b":%d\r\n" % v
+            if cmd == "KEYS":
+                # prefix globs only — all the store's boot-time journal
+                # scan needs
+                assert rest[0].endswith("*"), rest
+                pre = rest[0][:-1]
+                ks = sorted(k for k in list(self.kv) + list(self.lists)
+                            if k.startswith(pre))
+                out = [b"*%d\r\n" % len(ks)]
+                for k in ks:
+                    kb = k.encode()
+                    out.append(b"$%d\r\n%s\r\n" % (len(kb), kb))
+                return b"".join(out)
             return b"-ERR unknown command '%s'\r\n" % cmd.encode()
 
     def close(self):
@@ -224,6 +238,24 @@ def test_store_end_to_end_mine(mini_redis):
         assert mini_redis.kv[f"fsm:pattern:{uid}"] == store.patterns(uid)
     finally:
         master.shutdown()
+
+
+def test_journal_contract_over_wire(mini_redis):
+    """The write-ahead job journal (ISSUE 5) round-trips over RESP: the
+    intent record persists across clients (what restart recovery reads
+    after a kill -9) and the KEYS scan finds exactly the journal keys."""
+    store = RedisResultStore(port=mini_redis.port)
+    store.journal_set("j1", '{"incarnation": "a"}')
+    store.journal_set("j2", '{"incarnation": "b"}')
+    store.set("fsm:status:j1", "started")  # not a journal key
+    assert store.journal_uids() == ["j1", "j2"]
+    assert store.journal_get("j1") == '{"incarnation": "a"}'
+    # a SECOND client (the rebooted incarnation) sees the same intents
+    store2 = RedisResultStore(port=mini_redis.port)
+    assert store2.journal_uids() == ["j1", "j2"]
+    store2.journal_clear("j1")
+    assert store.journal_uids() == ["j2"]
+    assert "KEYS" in mini_redis.commands_seen
 
 
 def test_store_fails_fast_when_down():

@@ -411,6 +411,80 @@ def test_poll_consumer_error_bound_stops_loop():
     assert stats["errors"] == 3
 
 
+def test_poll_consumer_backpressure_pauses_and_resumes():
+    """Watermark backpressure (ISSUE 5): the consumer stops touching the
+    broker once the downstream queue hits the high watermark and resumes
+    only after it drains to the low one — batches wait at the broker
+    instead of being shed by the admission queue."""
+    import queue
+
+    from spark_fsm_tpu.streaming.consumer import (PollConsumer,
+                                                  consumer_health)
+
+    batches = _batches(seed=35, n=3, size=5)
+    q = queue.Queue()
+    for b in batches:
+        q.put(b)
+    # scripted downstream depth: fills to the high watermark, then drains
+    depths = iter([0, 4, 4, 3, 1, 0, 0, 0, 0, 0])
+    wm = WindowMiner(0.5, max_batches=3,
+                     mine=lambda db, ms: mine_spade(db, ms))
+    base = consumer_health()["backpressure_pauses"]
+    pc = PollConsumer(_queue_fetch(q), wm.push, poll_interval_s=0,
+                      queue_depth_fn=lambda: next(depths),
+                      pause_at=4, resume_at=1)
+    stats = pc.run(max_polls=10)
+    # depth 0 -> one batch consumed; depth 4 pauses; depths 4/4/3 hold
+    # the loop; depth 1 resumes; the remaining batches then drain
+    assert stats["batches"] == 3
+    assert stats["backpressure_pauses"] == 1
+    assert stats["backpressure_resumes"] == 1
+    assert stats["paused_polls"] == 3  # depths 4, 4, 3 held the loop
+    assert consumer_health()["backpressure_pauses"] == base + 1
+    # no batch was lost or reordered while paused
+    want = mine_spade(wm.window.sequences(), wm.minsup_abs())
+    assert patterns_text(wm.patterns) == patterns_text(want)
+
+
+def test_poll_consumer_backpressure_depth_probe_fails_open():
+    import queue
+
+    from spark_fsm_tpu.streaming.consumer import PollConsumer
+
+    (batch,) = _batches(seed=36, n=1, size=5)
+    q = queue.Queue()
+    q.put(batch)
+
+    def broken_gauge():
+        raise RuntimeError("stats endpoint down")
+
+    wm = WindowMiner(0.5, max_batches=2,
+                     mine=lambda db, ms: mine_spade(db, ms))
+    errors = []
+    pc = PollConsumer(_queue_fetch(q), wm.push, poll_interval_s=0,
+                      on_error=errors.append,
+                      queue_depth_fn=broken_gauge, pause_at=2, resume_at=0)
+    stats = pc.run(max_polls=2)
+    # the broken gauge is reported but polling continues (fail open):
+    # the batch is consumed, nothing starves
+    assert stats["batches"] == 1
+    assert stats["errors"] >= 1 and errors
+    assert stats["paused_polls"] == 0
+
+
+def test_poll_consumer_backpressure_validation():
+    from spark_fsm_tpu.streaming.consumer import PollConsumer
+
+    with pytest.raises(ValueError, match="pause_at"):
+        PollConsumer(lambda: None, lambda b: None,
+                     queue_depth_fn=lambda: 0)
+    with pytest.raises(ValueError, match="resume_at"):
+        PollConsumer(lambda: None, lambda b: None,
+                     queue_depth_fn=lambda: 0, pause_at=2, resume_at=2)
+    with pytest.raises(ValueError, match="queue_depth_fn"):
+        PollConsumer(lambda: None, lambda b: None, pause_at=2)
+
+
 def test_poll_consumer_background_thread_stop():
     import queue
 
