@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""Service throughput flood: jobs/sec at fixed tail latency, fused vs
+unfused — the ISSUE 6 success metric.
+
+The north star is thousands of SMALL concurrent mines, so the number
+that matters is not single-job wall but how many jobs/sec the service
+sustains and what the p99 submitter sees.  This harness floods an
+in-process ``Master`` (the real admission queue, worker pool, devcache
+and engines — everything but the HTTP framing, which overload_smoke
+already exercises) with N small mixed-priority TSR mines over a pool of
+distinct datasets, twice:
+
+- **unfused**: cross-job fusion off — every job plans and dispatches
+  its own launches (the pre-ISSUE-6 service);
+- **fused**: the service/fusion.py broker on, at the production window
+  defaults — concurrent jobs' candidate waves co-schedule into shared
+  super-batched launches.
+
+and reports jobs/sec, p50/p99 client-observed latency (median of 3
+timed floods per mode — this box is shared, single walls are noise),
+total device launches, and STRICT per-job parity (every fused job's
+rule set must be byte-identical to its unfused run — fusion is a
+scheduling change, not a semantics change).  A third,
+timing-independent phase lines jobs up in a held window and asserts
+the launch actually fused cross-job.
+
+Two speedup numbers, deliberately separate:
+
+- ``speedup_jobs_per_sec``: measured CPU wall ratio.  The CPU backend
+  executes concurrent unfused launches IN PARALLEL across host cores,
+  so launch consolidation is structurally underrewarded here — this
+  number is honest but hardware-pessimistic.
+- ``modeled_device_dispatch``: the broker's actual launches/traffic vs
+  its tallied solo alternative (``alt_solo_*``), priced by the
+  committed KERNELS.json cost model (``estimate_seconds``) where a
+  device launch costs DISPATCH_SEC — the bill a serial accelerator
+  pays.  This is the repo's own EWMA-calibrated arithmetic, the same
+  terms the fusion decision itself trades off.
+
+Wall-clock numbers are REPORTED, never compared (bench_smoke's rule:
+walls are machine truths, not commitments); the committed
+``BENCH_THROUGHPUT.json`` pins the structural expectations — parity,
+cross-job fusion observed, modeled device-dispatch speedup >= 2, no
+degrades/sheds — that must hold on any machine.  ``--update`` rewrites
+it.  ``--jobs N`` / ``--workers K`` override the flood size for
+hardware runs.
+
+Usage: scripts/throughput_smoke.sh [--update]   (pins JAX_PLATFORMS=cpu,
+hard timeout like overload_smoke)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+EXPECT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_THROUGHPUT.json")
+
+# structural fields diffed against the committed expectations (walls and
+# ratios are reported alongside but never compared)
+COMPARED = ("jobs", "parity", "forced_cross_job", "modeled_2x",
+            "degraded", "sheds", "failures")
+
+N_JOBS = int(os.environ.get("SPARKFSM_TP_JOBS", "48"))
+N_WORKERS = int(os.environ.get("SPARKFSM_TP_WORKERS", "8"))
+N_RUNS = int(os.environ.get("SPARKFSM_TP_RUNS", "3"))
+N_SEQ = 90
+N_DATASETS = 8
+PRIORITIES = ("high", "normal", "low")
+DEADLINE_S = 300.0
+
+
+def _datasets():
+    from spark_fsm_tpu.data.synth import synthetic_db
+
+    # one geometry (n_sequences equal -> one fusion shape key), distinct
+    # contents: the flood is many DIFFERENT small mines, not one cached
+    return [synthetic_db(seed=100 + i, n_sequences=N_SEQ, n_items=9,
+                         mean_itemsets=3.0, mean_itemset_size=1.2)
+            for i in range(N_DATASETS)]
+
+
+def _flood(dbs, n_jobs, workers, label):
+    """Submit n_jobs mixed-priority TSR mines and poll them home;
+    returns (rows keyed by uid, summary)."""
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import ServiceRequest
+    from spark_fsm_tpu.service.store import ResultStore
+
+    store = ResultStore()
+    master = Master(store=store, miner_workers=workers)
+    spmf = [format_spmf(db) for db in dbs]
+    try:
+        t0 = time.monotonic()
+        t_submit, done = {}, {}
+        sheds = failures = 0
+        for i in range(n_jobs):
+            req = ServiceRequest("fsm", "train", {
+                "algorithm": "TSR_TPU", "source": "INLINE",
+                "sequences": spmf[i % len(dbs)], "k": "6",
+                "minconf": "0.4", "max_side": "2",
+                # client-supplied uid: uuid4 reads the OS entropy pool,
+                # which on starved container hosts costs ~5 ms a call —
+                # 48 of those serialized at submit time would throttle
+                # the offered load the flood exists to create
+                "uid": f"tp-{label}-{i}",
+                "priority": PRIORITIES[i % len(PRIORITIES)]})
+            resp = master.handle(req)
+            if resp.status == "failure":
+                sheds += 1
+                continue
+            t_submit[resp.data["uid"]] = (time.monotonic(), i % len(dbs))
+        deadline = time.monotonic() + DEADLINE_S
+        while t_submit.keys() - done.keys() and time.monotonic() < deadline:
+            for uid in list(t_submit.keys() - done.keys()):
+                st = store.status(uid)
+                if st in ("finished", "failure"):
+                    done[uid] = (time.monotonic(), st)
+                    if st == "failure":
+                        failures += 1
+            time.sleep(0.002)
+        pending = t_submit.keys() - done.keys()
+        if pending:
+            raise TimeoutError(
+                f"{label}: {len(pending)} jobs never finished")
+        wall = time.monotonic() - t0
+        lats = sorted(done[u][0] - t_submit[u][0] for u in done)
+        q = lambda p: lats[min(len(lats) - 1, int(p * (len(lats) - 1)))]
+        rows = {}
+        for uid, (_, db_i) in t_submit.items():
+            rows[uid] = (db_i, store.rules(uid))
+        summary = {
+            "jobs": len(done), "wall_s": round(wall, 3),
+            "jobs_per_sec": round(len(done) / wall, 2),
+            "p50_s": round(q(0.50), 4), "p99_s": round(q(0.99), 4),
+            "sheds": sheds, "failures": failures,
+        }
+        return rows, summary
+    finally:
+        master.shutdown()
+
+
+def _forced_window(dbs, n_held: int = 4):
+    """Timing-independent fusion proof: ``n_held`` jobs lined up in a
+    HELD window must resolve through at least one shared cross-job
+    launch with per-job parity (the flood above fuses
+    opportunistically, which is the point — but CI needs one
+    deterministic cross-job launch)."""
+    import threading
+
+    from spark_fsm_tpu.data.vertical import build_vertical
+    from spark_fsm_tpu.models.tsr import TsrTPU
+    from spark_fsm_tpu.service import fusion as FZ
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    mk = lambda db: TsrTPU(build_vertical(db, min_item_support=1), 6,
+                           0.4, max_side=2)
+    want = [rules_text(mk(db).mine()) for db in dbs[:n_held]]
+    b = FZ.broker()
+    before = b.stats["cross_job_launches"]
+    b.hold()
+    out = {}
+    ts = [threading.Thread(target=lambda k=k, db=db: out.setdefault(
+        k, mk(db).mine())) for k, db in enumerate(dbs[:n_held])]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 60.0
+    while b.pending() < n_held and time.monotonic() < deadline:
+        time.sleep(0.005)
+    held = b.pending()
+    b.release()
+    for t in ts:
+        t.join(120.0)
+    assert not any(t.is_alive() for t in ts), "forced-window mine wedged"
+    parity = [rules_text(out[k]) == want[k] for k in range(n_held)]
+    return {"held_waves": held, "parity": all(parity),
+            "cross_job_launches": b.stats["cross_job_launches"] - before}
+
+
+def main() -> int:
+    update = "--update" in sys.argv[1:]
+    args = [a for a in sys.argv[1:] if a != "--update"]
+    n_jobs, workers = N_JOBS, N_WORKERS
+    if "--jobs" in args:
+        n_jobs = int(args[args.index("--jobs") + 1])
+    if "--workers" in args:
+        workers = int(args[args.index("--workers") + 1])
+
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.ops import ragged_batch as RB
+    from spark_fsm_tpu.service import fusion as FZ
+
+    # committed cost-model constants: the structural outcome must be
+    # machine-independent (same pin as bench_smoke)
+    RB.set_overhead_calibration(False)
+
+    dbs = _datasets()
+
+    # warm each mode to its COMPILE-STABLE state before timing it: the
+    # flood measures DISPATCH throughput, and a timed phase that pays
+    # fresh XLA compiles measures the compiler instead (exactly the
+    # stall prewarm's solo + fused ladders exist to prevent in the live
+    # service).  Untimed floods repeat until one completes with zero
+    # fresh backend compiles — the jitcache counter is the arbiter, the
+    # same one the prewarm drift test pins.
+    from spark_fsm_tpu.utils import jitcache
+
+    jitcache.enable_compile_counter()
+
+    def warm_to_stable(label: str, cap: int = 8) -> int:
+        for i in range(cap):
+            before = jitcache.compile_counts()["count"]
+            _flood(dbs, n_jobs, workers, f"warm-{label}-{i}")
+            if jitcache.compile_counts()["count"] == before:
+                return i + 1
+        return cap
+
+    def timed(label: str):
+        """N_RUNS floods; the reported row is the jobs/sec MEDIAN run
+        (this box is shared — a single wall is noise), sheds/failures
+        summed across all runs (structural, must be zero regardless)."""
+        rows_all, summaries = {}, []
+        for i in range(N_RUNS):
+            rows, s = _flood(dbs, n_jobs, workers, f"{label}-{i}")
+            rows_all.update(rows)
+            summaries.append(s)
+        ranked = sorted(summaries, key=lambda s: s["jobs_per_sec"])
+        med = dict(ranked[len(ranked) // 2])
+        med["runs_jobs_per_sec"] = [s["jobs_per_sec"] for s in summaries]
+        med["sheds"] = sum(s["sheds"] for s in summaries)
+        med["failures"] = sum(s["failures"] for s in summaries)
+        return rows_all, med
+
+    warm = {"unfused_floods": warm_to_stable("unfused")}
+    rows_u, unfused = timed("unfused")
+
+    FZ.configure(cfgmod.FusionConfig(enabled=True))
+    try:
+        warm["fused_floods"] = warm_to_stable("fused")
+        b0 = dict(FZ.broker().stats)  # modeled-ratio baseline: timed
+        # fused work only, not the warm floods
+        rows_f, fused = timed("fused")
+        # modeled-ratio snapshot BEFORE the forced window: its held
+        # group fuses at the best possible ratio by construction and
+        # must not pad the opportunistic floods' modeled speedup (the
+        # final `broker`/`degraded` report still covers it)
+        b_timed = dict(FZ.broker().stats)
+        forced = _forced_window(dbs)
+        broker = dict(FZ.broker().stats)
+    finally:
+        FZ.configure(None)
+
+    # the broker's device-dispatch accounting, priced by the committed
+    # cost model: what the timed fused work actually launched vs the
+    # tallied per-job alternative.  On a serial accelerator this ratio
+    # IS the device-time saving; on this CPU backend it is a model
+    # (see module docstring).
+    d = {k: b_timed[k] - b0[k] for k in b_timed}
+    modeled_solo_s = RB.estimate_seconds(
+        d["alt_solo_units"], d["alt_solo_launches"], N_SEQ, 1)
+    modeled_fused_s = RB.estimate_seconds(
+        d["traffic_units"], d["launches"], N_SEQ, 1)
+    modeled = {
+        "launches": d["launches"],
+        "alt_solo_launches": d["alt_solo_launches"],
+        "traffic_units": d["traffic_units"],
+        "alt_solo_units": d["alt_solo_units"],
+        "modeled_fused_s": round(modeled_fused_s, 4),
+        "modeled_solo_s": round(modeled_solo_s, 4),
+        "speedup": round(modeled_solo_s / max(1e-9, modeled_fused_s), 2),
+    }
+
+    # strict per-job parity: same dataset -> byte-identical rules, fused
+    # or not (uids differ; compare via each row's dataset index)
+    by_db_u = {}
+    for _, (db_i, rules) in rows_u.items():
+        by_db_u.setdefault(db_i, set()).add(rules)
+    parity = all(len(v) == 1 for v in by_db_u.values())
+    for _, (db_i, rules) in rows_f.items():
+        parity = parity and {rules} == by_db_u[db_i]
+
+    out = {
+        "jobs": n_jobs, "workers": workers, "warm": warm,
+        "unfused": unfused, "fused": fused,
+        "speedup_jobs_per_sec": round(
+            fused["jobs_per_sec"] / max(1e-9, unfused["jobs_per_sec"]), 2),
+        "modeled_device_dispatch": modeled,
+        "modeled_2x": modeled["speedup"] >= 2.0,
+        "parity": parity,
+        "forced_cross_job": forced["cross_job_launches"] >= 1,
+        "forced_window": forced,
+        "broker": broker,
+        "degraded": broker["degraded"],
+        "sheds": unfused["sheds"] + fused["sheds"],
+        "failures": unfused["failures"] + fused["failures"],
+    }
+    print(json.dumps(out, indent=2))
+
+    if update:
+        expect = {k: out[k] for k in COMPARED}
+        with open(EXPECT_PATH, "w") as fh:
+            json.dump(expect, fh, indent=2)
+            fh.write("\n")
+        print(f"bench_throughput: expectations rewritten -> {EXPECT_PATH}")
+        return 0
+    try:
+        with open(EXPECT_PATH) as fh:
+            expect = json.load(fh)
+    except OSError:
+        sys.exit(f"bench_throughput: no committed expectations at "
+                 f"{EXPECT_PATH} (run with --update once)")
+    bad = [k for k in COMPARED if out.get(k) != expect.get(k)]
+    if bad:
+        for k in bad:
+            print(f"bench_throughput: MISMATCH {k}: "
+                  f"got {out.get(k)!r}, expected {expect.get(k)!r}",
+                  file=sys.stderr)
+        return 1
+    print("bench_throughput: structural expectations OK "
+          f"(fused {fused['jobs_per_sec']} jobs/s vs unfused "
+          f"{unfused['jobs_per_sec']} jobs/s, p99 {fused['p99_s']}s vs "
+          f"{unfused['p99_s']}s — walls reported, never compared; "
+          f"modeled device-dispatch speedup {modeled['speedup']}x over "
+          f"{modeled['alt_solo_launches']} solo launches)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,13 @@ def smoke_stream():
 
 def main() -> int:
     update = "--update" in sys.argv[1:]
+    # pin the planner's per-launch overhead to the committed constant:
+    # the live drift recalibration (ops/ragged_batch.drift_factor) is
+    # machine-dependent by design, and these counters must be EXACT on
+    # any machine
+    from spark_fsm_tpu.ops import ragged_batch as RB
+
+    RB.set_overhead_calibration(False)
     rows = {
         "3": smoke_tsr(2),
         "3d": smoke_tsr(None),

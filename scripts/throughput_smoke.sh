@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Throughput smoke — the cross-job fusion companion to verify_t1.sh,
+# bench_smoke.sh, chaos_smoke.sh, obs_smoke.sh and overload_smoke.sh.
+# Floods an in-process Master with N small mixed-priority TSR mines
+# over distinct datasets, twice (fusion off, then on at the production
+# window defaults), reports jobs/sec + p50/p99 fused vs unfused, and
+# diffs the STRUCTURAL outcome — per-job parity, a forced deterministic
+# cross-job launch, zero degrades/sheds/failures — against the
+# committed BENCH_THROUGHPUT.json (walls reported, never compared).
+# Pass --update to rewrite the expectations after a deliberate fusion-
+# policy change; --jobs N / --workers K resize the flood for hardware.
+cd "$(dirname "$0")/.."
+# hard wall-clock bound like overload_smoke: a wedged broker window
+# would otherwise block the poll loop until the 300 s job deadline
+exec timeout -k 30 840 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python bench_throughput.py "$@"

@@ -3,10 +3,26 @@
 # Wraps the ROADMAP.md "Tier-1 verify" command VERBATIM (pipefail,
 # timeout, DOTS_PASSED echo); if the two ever differ, ROADMAP.md wins
 # and this wrapper is the bug.
+#
+# --smokes additionally runs the smoke family after a green pytest run:
+#   bench_smoke.sh       dispatch-shape counters vs committed expectations
+#   chaos_smoke.sh       every fault site injected, pinned seed
+#   obs_smoke.sh         /metrics + trace completeness over a live boot
+#   overload_smoke.sh    429 shedding + kill-restart journal recovery
+#   throughput_smoke.sh  fused-vs-unfused flood, per-job parity
 cd "$(dirname "$0")/.."
 set -o pipefail
+SMOKES=0
+if [ "${1:-}" = "--smokes" ]; then SMOKES=1; shift; fi
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
+    for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
+             throughput_smoke; do
+        echo "== scripts/$s.sh"
+        "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
+    done
+fi
 exit $rc

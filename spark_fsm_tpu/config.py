@@ -56,6 +56,17 @@ File format (TOML shown; JSON with the same nesting also accepted):
     trace_max_spans = 512           # completed-span ring per job
     trace_jobs = 16                 # job traces kept (oldest evicted)
 
+    [fusion]
+    enabled = false                 # cross-job launch fusion broker
+                                    # (service/fusion.py); off = one global
+                                    # read per dispatch probe
+    window_ms = 4.0                 # bounded fusion window: how long a
+                                    # normal/low wave may wait for peers
+    max_jobs = 8                    # waves co-scheduled into one launch
+    max_width = 16384               # fused candidate-lane ceiling (pow2)
+    dispatch_workers = 2            # broker dispatcher threads (matured
+                                    # groups run concurrently)
+
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
     sequences = 77500               # expected dataset scale
@@ -171,6 +182,34 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass
+class FusionConfig:
+    """Cross-job launch fusion broker (service/fusion.py): co-schedule
+    candidate waves from concurrent mines that share a device geometry
+    into one super-batched launch.
+
+    ``enabled``: route eligible engine waves through the broker (the
+    disabled path costs one module-global read per dispatch probe —
+    same pin as the fault registry).  ``window_ms``: the bounded fusion
+    window — how long a normal/low-priority wave may wait for fusion
+    peers before launching anyway (a ``high`` wave never waits: it
+    launches immediately with whatever is already pending).
+    ``max_jobs``: waves fused into one launch; ``max_width``: fused
+    candidate-lane ceiling (the window also closes when pending lanes
+    reach it).  ``dispatch_workers``: broker dispatcher threads —
+    matured window groups with disjoint membership are independent
+    device work, and a single serialized dispatcher would forfeit the
+    concurrency the Miner worker pool feeds the broker (a group
+    blocked in readback must not stall the next matured window).
+    """
+
+    enabled: bool = False
+    window_ms: float = 4.0
+    max_jobs: int = 8
+    max_width: int = 16384
+    dispatch_workers: int = 2
+
+
+@dataclasses.dataclass
 class DistributedConfig:
     """Multi-host (jax.distributed) wiring; all-defaults = single host.
 
@@ -194,6 +233,7 @@ class Config:
     prewarm: PrewarmConfig = dataclasses.field(default_factory=PrewarmConfig)
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig)
+    fusion: FusionConfig = dataclasses.field(default_factory=FusionConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -237,6 +277,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "prewarm": (PrewarmConfig, top.pop("prewarm", {})),
         "observability": (ObservabilityConfig,
                           top.pop("observability", {})),
+        "fusion": (FusionConfig, top.pop("fusion", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -265,6 +306,14 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError(
             f"engine.fused must be 'auto', 'always', 'never', 'queue' "
             f"or 'dense', got {cfg.engine.fused!r}")
+    if cfg.fusion.window_ms < 0:
+        raise ConfigError("fusion.window_ms must be >= 0")
+    if cfg.fusion.max_jobs < 1:
+        raise ConfigError("fusion.max_jobs must be >= 1")
+    if cfg.fusion.max_width < 32:
+        raise ConfigError("fusion.max_width must be >= 32 (one jnp lane)")
+    if cfg.fusion.dispatch_workers < 1:
+        raise ConfigError("fusion.dispatch_workers must be >= 1")
     return cfg
 
 
@@ -319,6 +368,11 @@ def set_config(cfg: Config) -> None:
     obs.configure_tracing(cfg.observability.trace,
                           max_spans=cfg.observability.trace_max_spans,
                           max_jobs=cfg.observability.trace_jobs)
+    # the fusion broker is process-global like the two above (engines
+    # probe it at dispatch time with no constructor plumbing)
+    from spark_fsm_tpu.service import fusion
+
+    fusion.configure(cfg.fusion)
 
 
 def engine_kwargs(*names: str) -> Dict[str, Any]:

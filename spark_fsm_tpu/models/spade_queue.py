@@ -65,6 +65,7 @@ from spark_fsm_tpu.ops import pallas_support as PS
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map
+from spark_fsm_tpu.service import fusion as FZ
 from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import PatternResult, sort_patterns
 
@@ -737,10 +738,18 @@ class QueueSpadeTPU:
                 cap.nb, cap.ring, cap.c_cap, cap.m_cap, cap.r_cap, cap.i_max,
                 self.use_pallas, self._s_block, self._interpret,
                 nb_late=self._nb_late)
-            packed_dev = fn(
-                self.store, q_slot, q_smask, q_imask, q_nits, q_rec,
-                n_roots_dev, records, recsup,
-                self._put(np.int32(self.minsup)))
+            # the whole-mine program carries per-job device carry state,
+            # so it is unfusable by construction — but it IS a device
+            # wave, and every wave routes through the fusion broker's
+            # accounting/fault surface (one global read when the broker
+            # is off; an armed fusion.dispatch fault degrades to this
+            # same direct dispatch)
+            packed_dev = FZ.dispatch_wave(
+                "queue", lambda: fn(
+                    self.store, q_slot, q_smask, q_imask, q_nits, q_rec,
+                    n_roots_dev, records, recsup,
+                    self._put(np.int32(self.minsup))),
+                point="oneshot")
         # Single-roundtrip fast path: prefetch a fixed prefix (counter
         # block + the first PREFETCH records, 64 KB) — most mines fit it,
         # so the counter read and the record read share one device->host
@@ -855,8 +864,13 @@ class QueueSpadeTPU:
             with obs.span("queue.segment", nb=nbw, budget=budget,
                           narrow=narrow, bound_s=round(seg_bound_s, 6)):
                 faults.fault_site("device.dispatch", point="queue_segment")
-                carry, counters_dev = seg_fn(narrow, first)(
-                    *carry, self._put(np.int32(budget)))
+                # unfusable (per-job carry) but broker-accounted, like
+                # the one-shot dispatch above
+                carry, counters_dev = FZ.dispatch_wave(
+                    "queue",
+                    lambda fnf=seg_fn(narrow, first), c=carry: fnf(
+                        *c, self._put(np.int32(budget))),
+                    point="segment")
                 budget = min(seg_waves, budget * 4)
                 first = False
                 self.stats["kernel_launches"] = (

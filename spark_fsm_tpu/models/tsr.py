@@ -61,6 +61,7 @@ from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
+from spark_fsm_tpu.service import fusion as FZ
 from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
 
@@ -76,6 +77,12 @@ def _is_oom(exc: BaseException) -> bool:
     across backends (and faults.InjectedOom matches on purpose)."""
     s = repr(exc)
     return "RESOURCE_EXHAUSTED" in s or "Resource exhausted" in s
+
+
+# initial top-m item restriction for the iterative-deepening outer loop
+# (the TsrTPU constructor default; the shape-key enumerator's fused-
+# ladder m buckets derive from it, so one spelling for both)
+ITEM_CAP_DEFAULT = 256
 
 
 def tsr_geometry(n_sequences: int, n_words: int, *,
@@ -312,7 +319,7 @@ class TsrTPU:
         *,
         mesh: Optional[Mesh] = None,
         chunk: Optional[int] = None,
-        item_cap: int = 256,
+        item_cap: int = ITEM_CAP_DEFAULT,
         max_side: Optional[int] = None,
         eval_budget_bytes: Optional[int] = None,
         use_pallas="auto",
@@ -424,9 +431,17 @@ class TsrTPU:
         0..len(sel)-1 (selection order)."""
         starts, vdb = self._tok_starts, self.vdb
         lens = starts[sel + 1] - starts[sel]
-        idx = np.concatenate(
-            [np.arange(starts[i], starts[i + 1]) for i in sel]
-        ) if len(sel) else np.zeros(0, np.int64)
+        if len(sel):
+            # vectorized ragged arange: each selected item's token range
+            # is its start repeated len times plus 0..len-1 within the
+            # block (the per-item Python arange loop this replaces was
+            # the hottest host line in the service-flood profile — prep
+            # host time is the Amdahl floor every concurrent mine pays)
+            ends = np.cumsum(lens)
+            idx = (np.repeat(starts[sel], lens)
+                   + np.arange(int(ends[-1])) - np.repeat(ends - lens, lens))
+        else:
+            idx = np.zeros(0, np.int64)
         ti = np.repeat(np.arange(len(sel), dtype=np.int32), lens)
         return ti, vdb.tok_seq[idx], vdb.tok_word[idx], vdb.tok_mask[idx]
 
@@ -573,6 +588,13 @@ class TsrTPU:
         t0 = time.monotonic()
         with obs.span("tsr.dispatch", candidates=len(cands)) as sp:
             handle = self._dispatch_eval_inner(p1, s1, cands)
+            if isinstance(handle, FZ.EvalWave):
+                # the wave is in the fusion broker's window: launch
+                # planning, spans and the cost-model observation happen
+                # there — this dispatch's story continues under
+                # fusion.launch/fusion.readback (or fusion.joined)
+                sp.set(fusion=True)
+                return handle
             sp.set(launches=handle[3], predicted_s=round(handle[6], 6))
         return handle + (t0,)
 
@@ -622,6 +644,22 @@ class TsrTPU:
         pools: Dict[int, List[int]] = {}
         for r in range(n):
             pools.setdefault(int(kms[r]), []).append(r)
+        if FZ.eval_enabled() and not self.use_pallas and self.mesh is None:
+            # cross-job launch fusion (service/fusion.py): hand the
+            # whole candidate wave to the broker — concurrent jobs that
+            # share this engine's (n_seq, n_words) geometry co-schedule
+            # into shared super-batched launches, and the readback
+            # demuxes per job by the plan's per-lane job tags.  The
+            # broker runs the SAME packer over the SAME per-km caps, so
+            # a wave that finds no fusion peer dispatches exactly like
+            # the direct path below.  Gated to the single-device jnp
+            # path: fused prep stores concatenate along the item axis,
+            # which the folded kernel layout and sharded meshes don't
+            # support (their waves keep the direct path).
+            ticket = self._submit_fusion_wave(p1, s1, cands, pools)
+            if ticket is not None:
+                self.stats["evaluated"] += n
+                return ticket
         parts = []
         cols = np.empty(n, np.int64)  # candidate r -> column in `out`
         used_kernel = False  # any launch through the Pallas path: a
@@ -724,6 +762,33 @@ class TsrTPU:
         return (out, cols, used_kernel,
                 self.stats["kernel_launches"] - launches0, km_delta,
                 xy_bufs, est_s)
+
+    def _submit_fusion_wave(self, p1, s1, cands, pools):
+        """Hand one dispatch's whole candidate wave to the cross-job
+        fusion broker (service/fusion.py) and return the ticket, or
+        None when the broker declined (shut off between the gate probe
+        and here — the caller then dispatches directly).
+
+        The broker re-runs the SAME planner inputs this engine's direct
+        jnp path would use — per-km width caps (budget-derived 1/km
+        narrowing, or the user-pinned chunk as-is), the jnp lane floor,
+        and the engine's own eval/put functions — so a wave that finds
+        no fusion peer launches exactly what the direct path would
+        have.  ``_resolve_eval`` blocks on the ticket like any other
+        handle, so the mine loop's pipelining is unchanged."""
+        # after a mid-mine kernel->jnp downgrade the caller's p1/s1 are
+        # the FOLDED kernel layout; the broker runs the engine-layout
+        # jnp evaluator, so substitute the downgrade preps exactly like
+        # the direct jnp branch below does
+        if self._jnp_prep is not None:
+            p1, s1 = self._jnp_prep
+        cw = self.chunk
+        cap = ((lambda km: cw) if self._chunk_user is not None
+               else (lambda km: max(32, min(cw, self._jnp_raw // km))))
+        return FZ.submit_eval(
+            cands=cands, pools=pools, p1=p1, s1=s1,
+            eval_fn=self._eval_fn, put=self._put, cap=cap, lane=32,
+            n_seq=self.n_seq, n_words=self.n_words)
 
     def _ensure_jnp_downgrade(self) -> None:
         """Build the engine-layout prep + budget width the jnp evaluator
@@ -836,6 +901,24 @@ class TsrTPU:
                 self.n_seq, self.n_words, L.km, L.width))
 
     def _resolve_eval(self, handle, n: int):
+        if isinstance(handle, FZ.EvalWave):
+            # fusion-broker ticket: the broker planned, launched, traced
+            # and demuxed (or failed) this wave — block on its result.
+            # Broker launches land in fusion_* stats, NOT in this
+            # engine's kernel_launches: a fused launch is SHARED device
+            # work, so charging it to every rider would double-count
+            # the dispatch the fusion existed to save (the broker's own
+            # stats/metrics carry the launch truth).
+            sups, supxs, report = handle.result()
+            self.stats["fusion_waves"] = (
+                self.stats.get("fusion_waves", 0) + 1)
+            if report.get("fused_jobs", 1) > 1:
+                self.stats["fusion_fused_waves"] = (
+                    self.stats.get("fusion_fused_waves", 0) + 1)
+            self.stats["fusion_launches"] = (
+                self.stats.get("fusion_launches", 0)
+                + report.get("launches", 0))
+            return sups, supxs
         out, cols = handle[0], handle[1]
 
         def read():
@@ -1109,6 +1192,13 @@ class TsrTPU:
                 # be a transient stall.  Fail the launch upward instead —
                 # job supervision (the Miner retry) owns the re-run.
                 if isinstance(exc, watchdog.WatchdogTimeout):
+                    raise
+                if isinstance(handle, FZ.EvalWave):
+                    # a broker ticket failing means the wave already
+                    # exhausted the broker's own degrade ladder (fused
+                    # -> per-job solo) on the jnp path — there is no
+                    # kernel state to recount; fail the job upward to
+                    # Miner supervision like any jnp-only handle
                     raise
                 # TPU kernel RUNTIME faults surface at readback (compile/
                 # lowering faults were already caught per km bucket at

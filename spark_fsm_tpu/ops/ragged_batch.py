@@ -51,7 +51,7 @@ width caps differ — the jnp evaluator's live-temp footprint narrows
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -84,12 +84,58 @@ LANE_SEC_PER_SEQWORD = 85.8e-3 / 8192 / 990_000
 DISPATCH_SEC = 0.005
 
 
+# --- live overhead recalibration -------------------------------------------
+# The KERNELS.json-anchored DISPATCH_SEC above is a COMMITTED constant:
+# correct on the machine that measured it, stale anywhere else (a
+# tunneled TPU runs ~10x, a CPU CI box further still).  The flight
+# recorder already measures the truth — fsm_costmodel_drift_ratio is
+# the EWMA of measured/predicted dispatch wall — so plan-time overhead
+# scales DISPATCH_SEC by that live ratio instead of trusting the
+# constant.  The factor is quantized to pow2 steps and clamped [1, 16]:
+# quantization keeps launch plans stable against run-to-run timing
+# noise (an un-quantized factor would make every pinned launch-budget
+# counter nondeterministic), scaling only UP keeps a drifting gauge
+# from ever shrinking the overhead below its measured-anchor floor.
+# ``set_overhead_calibration(False)`` pins the raw constant — the
+# launch-budget tests and bench_smoke pin it so their committed
+# dispatch-shape counters stay exact.
+
+_CALIBRATE = True
+_DRIFT_FACTOR_CAP = 16
+
+
+def set_overhead_calibration(enabled: bool) -> None:
+    global _CALIBRATE
+    _CALIBRATE = bool(enabled)
+
+
+def drift_factor() -> int:
+    """Quantized (pow2) clamp of the live cost-model drift EWMA — the
+    multiplier applied to DISPATCH_SEC at plan time.  1 until the first
+    calibration sample lands (or when calibration is pinned off)."""
+    if not _CALIBRATE:
+        return 1
+    drift = obs.costmodel_drift()
+    if drift is None or drift <= 1.0:
+        return 1
+    return min(_DRIFT_FACTOR_CAP, floor_pow2(int(drift)))
+
+
+def calibrated_dispatch_s() -> float:
+    """DISPATCH_SEC scaled by the live drift EWMA (see above)."""
+    return DISPATCH_SEC * drift_factor()
+
+
 def overhead_units(n_seq: int, n_words: int,
-                   dispatch_s: float = DISPATCH_SEC) -> int:
+                   dispatch_s: Optional[float] = None) -> int:
     """Per-launch overhead in traffic units for a given sequence-axis
     size: how many padded lanes one saved dispatch is worth.  Clamped so
     degenerate geometries cannot zero out either term of the planner's
-    cost model."""
+    cost model.  ``dispatch_s=None`` (the engines' plan-time default)
+    resolves to :func:`calibrated_dispatch_s` — the committed constant
+    recalibrated by the live ``fsm_costmodel_drift_ratio`` EWMA."""
+    if dispatch_s is None:
+        dispatch_s = calibrated_dispatch_s()
     lane_s = max(1e-12, n_seq * max(1, n_words) * LANE_SEC_PER_SEQWORD)
     return max(64, min(1 << 20, int(dispatch_s / lane_s)))
 
@@ -158,13 +204,19 @@ class Launch:
     compiled candidate axis).  ``rows``: candidate indices, in lane
     order.  ``kms``: each lane's OWN km bucket (the per-lane km tag —
     lanes with ``kms[j] < km`` are borrowed/merged lanes riding a wider
-    geometry).
+    geometry).  ``jobs``: each lane's OWN job tag (parallel to ``rows``;
+    None for single-job plans) — the cross-job fusion broker
+    (service/fusion.py) plans launches over candidates pooled from
+    SEVERAL concurrent mines, and the per-lane job tag is what lets its
+    readback demux each lane's (sup, supx) back to the job that owns
+    it.
     """
 
     km: int
     width: int
     rows: List[int]
     kms: List[int]
+    jobs: Optional[List[int]] = None
 
     @property
     def traffic_units(self) -> int:
@@ -184,10 +236,23 @@ class Launch:
         """Lanes whose own km is below the launch geometry."""
         return sum(1 for k in self.kms if k < self.km)
 
+    @property
+    def n_jobs(self) -> int:
+        """Distinct jobs sharing the launch (1 for untagged plans)."""
+        return len(set(self.jobs)) if self.jobs else 1
+
+    @property
+    def cross_job(self) -> bool:
+        """True when lanes from more than one JOB share the launch —
+        the fusion broker's headline event."""
+        return self.n_jobs > 1
+
 
 def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
                   lane: int,
-                  overhead: int = LAUNCH_OVERHEAD_UNITS) -> List[Launch]:
+                  overhead: int = LAUNCH_OVERHEAD_UNITS,
+                  job_of: Optional[Callable[[int], int]] = None,
+                  record: bool = True) -> List[Launch]:
     """Pack per-km candidate pools into pow2 super-batch launches.
 
     Args:
@@ -199,6 +264,15 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
         the jnp path — keeps the compiled-width ladder log-sized).
       overhead: per-launch fixed cost in traffic units (see module
         docstring).
+      job_of: optional candidate-index -> job-tag map.  When given,
+        every emitted launch carries per-lane ``jobs`` tags (parallel to
+        ``rows``) — the cross-job fusion broker pools candidates from
+        several concurrent mines and demuxes readbacks by this tag.
+      record: False for EXPLORATORY plans (the fusion broker plans both
+        the fused and the per-job alternative before choosing) — the
+        planner metrics/trace event must count only plans that actually
+        dispatch, so the caller records the chosen plan via
+        :func:`record_plan`.
 
     Returns launches in dispatch order: full same-km launches largest km
     first, then the merged tails.  Every input candidate appears in
@@ -237,7 +311,9 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
                 tails.append((km, rows[i:]))
                 break
             part = rows[i:i + take]
-            launches.append(Launch(km, take, part, [km] * take))
+            launches.append(Launch(
+                km, take, part, [km] * take,
+                [job_of(r) for r in part] if job_of else None))
             i += take
 
     # cross-km tail merge, largest geometry first: bounds every lane's
@@ -258,29 +334,40 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
                     ckms.extend([km] * len(rows))
                     cur = (km_g, crows, ckms)
                     continue
-            launches.append(_emit(cur, lane))
+            launches.append(_emit(cur, lane, job_of))
         cur = (km, list(rows), [km] * len(rows))
     if cur is not None:
-        launches.append(_emit(cur, lane))
-    if launches:
-        mixed = sum(1 for L in launches if L.mixed)
-        _PLAN_LAUNCHES.inc(len(launches))
-        if mixed:
-            _PLAN_SUPERBATCHES.inc(mixed)
-        # the plan itself is a flight-recorder event (one per dispatch):
-        # the per-launch spans the engines open cite geometries, this
-        # cites the packer's whole decision
-        obs.trace_event(
-            "plan_launches",
-            candidates=sum(len(L.rows) for L in launches),
-            launches=len(launches), superbatches=mixed,
-            traffic_units=sum(L.traffic_units for L in launches))
+        launches.append(_emit(cur, lane, job_of))
+    if record:
+        record_plan(launches)
     return launches
 
 
-def _emit(cur: Tuple[int, List[int], List[int]], lane: int) -> Launch:
+def record_plan(launches: List[Launch]) -> None:
+    """Planner metrics + the per-dispatch trace event for a plan that
+    WILL dispatch (``plan_launches`` does this itself unless the caller
+    opted into exploratory planning with ``record=False``)."""
+    if not launches:
+        return
+    mixed = sum(1 for L in launches if L.mixed)
+    _PLAN_LAUNCHES.inc(len(launches))
+    if mixed:
+        _PLAN_SUPERBATCHES.inc(mixed)
+    # the plan itself is a flight-recorder event (one per dispatch):
+    # the per-launch spans the engines open cite geometries, this
+    # cites the packer's whole decision
+    obs.trace_event(
+        "plan_launches",
+        candidates=sum(len(L.rows) for L in launches),
+        launches=len(launches), superbatches=mixed,
+        traffic_units=sum(L.traffic_units for L in launches))
+
+
+def _emit(cur: Tuple[int, List[int], List[int]], lane: int,
+          job_of: Optional[Callable[[int], int]] = None) -> Launch:
     km_g, rows, kms = cur
-    return Launch(km_g, max(lane, next_pow2(len(rows))), rows, kms)
+    return Launch(km_g, max(lane, next_pow2(len(rows))), rows, kms,
+                  [job_of(r) for r in rows] if job_of else None)
 
 
 def superbatch_geometries(lane: int, hi_width: int,

@@ -278,15 +278,24 @@ class AdmissionQueue:
         with self._cond:
             return self._n_queued()
 
-    def try_reserve(self):
-        """(admitted, queued_now): claim a queue slot, or report a shed
-        (``admitted=False``) with the depth that refused it."""
+    def try_reserve(self, priority: str = "low"):
+        """(admitted, queued_now, queued_ahead): claim a queue slot, or
+        report a shed (``admitted=False``) with the depth that refused
+        it.  ``queued_ahead`` is the shed submit's true queue position —
+        jobs in classes at or above its priority, plus in-flight
+        reservations (class unknown until ``put``, counted ahead
+        conservatively) — the Retry-After estimator's input: a shed
+        ``high`` submit behind 200 ``low`` jobs waits for the running
+        work, not the whole backlog."""
         with self._cond:
             n = self._n_queued() + self._reserved
             if self.depth > 0 and n >= self.depth:
-                return False, n
+                rank = PRIORITIES.index(priority)
+                ahead = sum(len(self._qs[p])
+                            for p in PRIORITIES[:rank + 1])
+                return False, n, ahead + self._reserved
             self._reserved += 1
-            return True, n
+            return True, n, 0
 
     def abort(self) -> None:
         with self._cond:
@@ -436,12 +445,14 @@ class Miner:
             self._wall_ewma = (wall_s if self._wall_ewma is None
                                else 0.3 * wall_s + 0.7 * self._wall_ewma)
 
-    def _retry_after_s(self, queued: int) -> int:
-        """Seconds until a shed submit plausibly fits: the queued work
-        divided over the workers, priced per job by the EWMA of measured
-        walls — seeded, before any job has finished, by the ragged
-        planner's cost model over the declared prewarm envelope (8
-        full-width launches at the configured sequence scale: the same
+    def _retry_after_s(self, queued_ahead: int) -> int:
+        """Seconds until a shed submit plausibly fits: the submit's true
+        QUEUE POSITION (jobs queued at or above its priority class —
+        work below it would be overtaken, not waited for) divided over
+        the workers, priced per job by the EWMA of measured walls —
+        seeded, before any job has finished, by the ragged planner's
+        cost model over the declared prewarm envelope (8 full-width
+        launches at the configured sequence scale: the same
         KERNELS.json-anchored arithmetic the watchdog deadlines use)."""
         with self._wall_lock:
             per_job = self._wall_ewma
@@ -450,7 +461,7 @@ class Miner:
             n_seq = pw.sequences or 100_000
             per_job = RB.estimate_seconds(8 * 8192, 8, n_seq,
                                           max(1, pw.words or 1))
-        est = per_job * (queued + 1) / max(1, len(self._threads))
+        est = per_job * (queued_ahead + 1) / max(1, len(self._threads))
         return max(1, min(3600, math.ceil(est)))
 
     def submit(self, req: ServiceRequest) -> None:
@@ -485,13 +496,14 @@ class Miner:
                     live = False  # corrupt record: treat as a dead orphan
                 if live:
                     raise UidConflict(req.uid)
-            admitted, queued = self._q.try_reserve()
+            admitted, queued, ahead = self._q.try_reserve(priority)
             if not admitted:
                 _SHEDS_TOTAL.inc(priority=priority)
                 log_event("job_shed", uid=req.uid, queued=queued,
-                          depth=self._q.depth, priority=priority)
+                          queued_ahead=ahead, depth=self._q.depth,
+                          priority=priority)
                 raise AdmissionShed(req.uid, self._q.depth, queued,
-                                    self._retry_after_s(queued))
+                                    self._retry_after_s(ahead))
             try:
                 # A client-supplied uid may collide with a finished/
                 # failed job; clear its stale error and results so
@@ -516,7 +528,9 @@ class Miner:
                 self._q.abort()  # reservation never became a queued job
                 raise
         try:
-            jobctl.register(req.uid, deadline_s)
+            # priority rides the control entry so the fusion broker's
+            # window rule sees the admission class at dispatch time
+            jobctl.register(req.uid, deadline_s, priority=priority)
             self.store.add_status(req.uid, Status.STARTED)
             self.store.incr("fsm:metric:jobs_submitted")
             log_event("job_submitted", uid=req.uid,

@@ -306,6 +306,24 @@ class FsmHandler(BaseHTTPRequestHandler):
                 "status": "failure", "error": str(exc)}))
 
 
+def _fusion_stats() -> dict:
+    """The /admin/stats ``fusion`` block: enabled flag + window policy,
+    and the broker's counters once one exists (it is lazily built on
+    the first enabled configure)."""
+    from spark_fsm_tpu.service import fusion
+
+    cfg = cfgmod.get_config().fusion
+    out = {"enabled": fusion.eval_enabled(),
+           "window_ms": cfg.window_ms, "max_jobs": cfg.max_jobs,
+           "max_width": cfg.max_width,
+           "dispatch_workers": cfg.dispatch_workers}
+    b = fusion.broker()
+    if b is not None:
+        out.update(b.stats)
+        out["pending"] = b.pending()
+    return out
+
+
 def service_stats(master: Master) -> dict:
     """Service-wide metrics for /admin/stats (SURVEY.md sec 5 metrics row):
     job counters from the store plus the device/backend the engines see."""
@@ -340,6 +358,9 @@ def service_stats(master: Master) -> dict:
         "store_cache": dict(spade_engine_cache.stats),
         "cspade_cache": dict(cspade_engine_cache.stats),
         "tsr_cache": dict(tsr_engine_cache.stats),
+        # cross-job launch fusion (service/fusion.py): broker counters
+        # plus the live window policy (canonical series: fsm_fusion_*)
+        "fusion": _fusion_stats(),
         # warm-path observability: distinct compiled geometries seen,
         # plus the last prewarm's per-key compile walls (if any ran)
         "shape_keys_recorded": len(shapereg.recorded()),

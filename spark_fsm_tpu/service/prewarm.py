@@ -263,6 +263,27 @@ def _warm_tsr(t: dict, mesh) -> None:
             xy = eng._stager.take(launch, [])
             eng._eval_fn(km)(pj, sj, eng._put(xy))
             eng._count_launch(launch)
+    # Cross-job fused eval ladder (service/fusion.py): the broker's
+    # fused launches run the SAME jnp eval programs at a concatenated
+    # pow2-padded item axis, so the compiled set is the enumerated
+    # ``fused_m`` buckets x the (km, width) ladder.  Zero stores have
+    # the right SHAPE — the only thing a compile keys on — so warming
+    # costs no store build.  The broker is gated to the single-device
+    # jnp path (the folded kernel layout's appended pad row does not
+    # survive an item-axis concat), matching the jnp eval fns warmed
+    # here.
+    import jax.numpy as jnp
+
+    for m_pad in t.get("fused_m", ()):
+        zshape = (m_pad,) + tuple(pj.shape[1:])
+        pf = jnp.zeros(zshape, jnp.uint32)
+        sf = jnp.zeros(zshape, jnp.uint32)
+        for km, width in t.get("superbatch", ()):
+            launch = RB.Launch(km, width, [], [])
+            xy = eng._stager.take(launch, [])
+            eng._eval_fn(km)(pf, sf, eng._put(xy))
+            shapes.record(shapes.key_tsr_fused(
+                eng.n_seq, eng.n_words, m_pad, km, width))
 
 
 def _warm_sweep(t: dict, mesh) -> None:
@@ -444,7 +465,7 @@ def _run_keys(targets, mesh, eng_sub) -> List[dict]:
                     _warm_cspade(t, mesh, eng_sub)
                 elif t["kind"] == "tsr":
                     _warm_tsr(t, mesh)
-                elif t["kind"] == "tsr_eval":
+                elif t["kind"] in ("tsr_eval", "tsr_fused"):
                     pass  # warmed by the "tsr" entry's ladder walk; the
                     # separate key exists so /admin/shapes drift can name
                     # the exact launch geometry a live mine would compile
@@ -483,11 +504,23 @@ def spec_from_config(pc) -> Optional[shapes.WorkloadSpec]:
         n_sequences=int(pc.sequences), n_items=int(pc.items),
         n_words=max(1, int(pc.words)), constraints=constraints,
         tsr=bool(pc.tsr),
+        fusion_jobs=_fusion_jobs_default(),
         stream_batch_sequences=int(pc.stream_batch_sequences),
         stream_items=int(pc.stream_items),
         stream_seq_floor=int(pc.stream_seq_floor),
         checkpointed=bool(pc.checkpointed),
         max_tokens=int(pc.max_tokens))
+
+
+def _fusion_jobs_default() -> int:
+    """The fused-ladder envelope the boot config implies: with the
+    cross-job broker enabled, prewarm must cover groups up to
+    ``[fusion] max_jobs`` or the first real fusion pays a live compile
+    — the exact stall prewarm exists to prevent."""
+    from spark_fsm_tpu import config
+
+    fc = config.get_config().fusion
+    return int(fc.max_jobs) if fc.enabled else 0
 
 
 def spec_from_params(params: Dict[str, str], pc) -> shapes.WorkloadSpec:
@@ -513,6 +546,7 @@ def spec_from_params(params: Dict[str, str], pc) -> shapes.WorkloadSpec:
         n_words=max(1, geti("words", pc.words)),
         constraints=constraints,
         tsr=truthy(params.get("tsr"), pc.tsr),
+        fusion_jobs=geti("fusion_jobs", _fusion_jobs_default()),
         stream_batch_sequences=geti("stream_batch_sequences",
                                     pc.stream_batch_sequences),
         stream_items=geti("stream_items", pc.stream_items),

@@ -75,6 +75,9 @@ KNOWN_SITES = (
     "devcache.put",      # engine-cache device build/insert (service/devcache)
     "service.admit",     # train-submit admission (service/actors.Miner.submit)
     "service.journal",   # write-ahead job-journal intent write (service/store)
+    "fusion.dispatch",   # cross-job fusion broker launch (service/fusion) —
+                         # injection must DEGRADE to unfused per-job
+                         # dispatch, never lose a wave
 )
 
 _EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}

@@ -75,13 +75,17 @@ class JobControl:
     costs one extra launch, never a missed abort — the next check sees
     it)."""
 
-    __slots__ = ("uid", "deadline", "cancelled", "running")
+    __slots__ = ("uid", "deadline", "cancelled", "running", "priority")
 
-    def __init__(self, uid: str, deadline: Optional[float]):
+    def __init__(self, uid: str, deadline: Optional[float],
+                 priority: str = "normal"):
         self.uid = uid
         self.deadline = deadline  # absolute time.monotonic(), or None
         self.cancelled = False
         self.running = False  # False = still queued (set by activate())
+        # admission class ("high"/"normal"/"low") — read by the fusion
+        # broker's window rule (a high job's waves never wait for fill)
+        self.priority = priority
 
 
 _lock = threading.Lock()
@@ -101,13 +105,15 @@ def _recompute_active_locked() -> None:
                   for c in _jobs.values())
 
 
-def register(uid: str, deadline_s: Optional[float] = None) -> JobControl:
+def register(uid: str, deadline_s: Optional[float] = None,
+             priority: str = "normal") -> JobControl:
     """Register a submitted job; the deadline budget starts NOW (queue
     wait spends it).  Re-registering a uid replaces the old entry — the
     admission layer's 409 conflict check guarantees the old incarnation
     is dead by then."""
     ctl = JobControl(uid, None if deadline_s is None
-                     else time.monotonic() + float(deadline_s))
+                     else time.monotonic() + float(deadline_s),
+                     priority=priority)
     with _lock:
         _jobs[uid] = ctl
         _recompute_active_locked()
@@ -185,3 +191,10 @@ def check() -> None:
     if not _active:
         return
     check_entry(_cur.get())
+
+
+def current() -> Optional[JobControl]:
+    """The job bound to this thread/context (None outside a mine run) —
+    how the fusion broker learns a wave's uid and admission class with
+    zero engine plumbing."""
+    return _cur.get()

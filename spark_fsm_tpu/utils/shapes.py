@@ -46,6 +46,11 @@ Key formats (the geometry axes that decide compiled shapes):
                                             ops/ragged_batch.py ladder);
                                             recorded per launch at
                                             dispatch time
+  ``tsr-fused:s{S}w{W}m{M}km{K}c{C}``       service/fusion.py cross-job
+                                            fused eval launches — item
+                                            axis = concat of the fused
+                                            jobs' prep stores padded to
+                                            the pow2 bucket M
   ``sweep:s{S}w{W}r{R}i{NI}``               streaming/incremental.py
                                             batch-store geometry (the
                                             config-5 mid-stream compile)
@@ -94,6 +99,18 @@ def key_tsr_eval(n_seq: int, n_words: int, km: int, width: int) -> str:
     ladder so prewarm can compile every launch program a live mine can
     dispatch."""
     return f"tsr-eval:s{n_seq}w{n_words}km{km}c{width}"
+
+
+def key_tsr_fused(n_seq: int, n_words: int, m_pad: int, km: int,
+                  width: int) -> str:
+    """One CROSS-JOB fused eval-launch geometry (service/fusion.py):
+    the broker concatenates the participating jobs' prep stores along
+    the item axis and pads it to the pow2 bucket ``m_pad``, so the
+    fused launch program compiles per (m bucket, km, width) — a finite
+    ladder the enumerator lists (``fusion_jobs`` on the WorkloadSpec)
+    and prewarm walks, keeping the zero-fresh-compile guarantee across
+    fusion."""
+    return f"tsr-fused:s{n_seq}w{n_words}m{m_pad}km{km}c{width}"
 
 
 def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
@@ -156,6 +173,11 @@ class WorkloadSpec:
     trees up to 8x the item-row bucket).
     ``checkpointed``: prewarm also compiles the segmented (resumable)
     queue programs.
+    ``fusion_jobs``: cross-job launch fusion envelope (service/fusion.py)
+    — enumerate the ``tsr-fused`` eval ladder for groups of up to this
+    many concurrent TSR jobs (their first-round prep stores concatenate
+    along the item axis, pow2-padded; 0 = fusion not served).  The boot
+    spec sets it from ``[fusion] max_jobs`` when fusion is enabled.
     """
 
     n_sequences: int
@@ -163,6 +185,7 @@ class WorkloadSpec:
     n_words: int = 1
     constraints: Tuple[Tuple[Optional[int], Optional[int]], ...] = ()
     tsr: bool = False
+    fusion_jobs: int = 0
     stream_batch_sequences: int = 0
     stream_items: int = 0
     stream_seq_floor: int = 0  # must mirror [prewarm] stream_seq_floor:
@@ -255,6 +278,29 @@ def enumerate_shapes(spec: WorkloadSpec, *, mesh=None,
                 # warmed by the single "tsr" entry's ladder walk
                 add(key_tsr_eval(tg["n_seq"], nw, km, width),
                     kind="tsr_eval", km=km, width=width)
+            if spec.fusion_jobs >= 2 and not use_pallas and mesh is None:
+                # cross-job fused ladder (service/fusion.py): groups of
+                # 2..fusion_jobs first-round prep stores concatenated
+                # along the item axis and pow2-padded — the distinct
+                # m buckets are few because next_pow2 collapses group
+                # sizes.  The (km, width) set is the SAME solo ladder:
+                # the broker's fused caps are minima of per-engine caps,
+                # so fused widths are a subset of solo widths.  Gated to
+                # the broker's own engagement condition (the single-
+                # device jnp path, tsr.py): a pallas/mesh boot can never
+                # dispatch a fused launch, so enumerating the ladder
+                # there would compile phantom programs at boot and list
+                # drift keys no live mine can record.
+                m1 = min(tsr.ITEM_CAP_DEFAULT, ni)
+                fused_m = sorted({RB.next_pow2(j * m1)
+                                  for j in range(2, spec.fusion_jobs + 1)})
+                out[tg["shape_key"]]["fused_m"] = fused_m
+                for m_pad in fused_m:
+                    for km, width in ladder:
+                        add(key_tsr_fused(tg["n_seq"], nw, m_pad, km,
+                                          width),
+                            kind="tsr_fused", m_pad=m_pad, km=km,
+                            width=width)
 
     if spec.stream_batch_sequences > 0 and spec.stream_items > 0:
         from spark_fsm_tpu.streaming import incremental

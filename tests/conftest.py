@@ -119,6 +119,23 @@ def _slow_sink(span) -> None:
 
 
 @pytest.fixture(autouse=True)
+def _pin_overhead_calibration():
+    """Pin the ragged planner's per-launch overhead to the committed
+    constant for every test: the live path recalibrates it from the
+    process-global ``fsm_costmodel_drift_ratio`` EWMA (ops/ragged_batch
+    ``drift_factor``), and on this CPU backend any earlier test's TSR
+    readbacks would push that gauge far above 1 — silently rescaling
+    every later test's launch plans and breaking the pinned launch-
+    budget/bench counters in an order-dependent way.  Tests that cover
+    the calibration itself opt back in around their own body."""
+    from spark_fsm_tpu.ops import ragged_batch as RB
+
+    RB.set_overhead_calibration(False)
+    yield
+    RB.set_overhead_calibration(False)
+
+
+@pytest.fixture(autouse=True)
 def _trace_test(request):
     """Under SPARKFSM_TRACE_TESTS=1 every test body runs inside its own
     trace, so engine/service spans land somewhere countable.  A no-op

@@ -647,6 +647,131 @@ def test_deadline_expiry_mid_mine_fails_fast_and_durable():
     assert int(store.get("fsm:metric:jobs_retried") or 0) == 0
 
 
+# ------------------------------------------------------- fusion.dispatch
+
+
+@covers("fusion.dispatch")
+def test_fusion_dispatch_fault_degrades_group_to_solo_with_parity():
+    """An injected broker failure at the fusion window DEGRADES to
+    unfused per-job dispatch: both jobs finish with byte-identical rule
+    sets and the degraded counter names the event — a wave is never
+    lost (the ISSUE 6 failure posture for the whole broker)."""
+    import threading
+
+    from spark_fsm_tpu.models.tsr import TsrTPU
+    from spark_fsm_tpu.service import fusion as FZ
+
+    db_a, db_b = _rule_db(), synthetic_db(
+        seed=29, n_sequences=40, n_items=7, mean_itemsets=3.0,
+        mean_itemset_size=1.2)
+    mk = lambda db: TsrTPU(build_vertical(db, min_item_support=1), 8,
+                           0.4, max_side=2)
+    want_a, want_b = mk(db_a).mine(), mk(db_b).mine()  # fusion off
+
+    FZ.configure(cfgmod.FusionConfig(enabled=True, window_ms=250.0))
+    b = FZ.broker()
+    degraded0 = b.stats["degraded"]
+    try:
+        b.hold()
+        out = {}
+        ts = [threading.Thread(target=lambda k=k, db=db: out.setdefault(
+            k, mk(db).mine())) for k, db in (("a", db_a), ("b", db_b))]
+        with faults.injected("fusion.dispatch", nth=1, match="window"):
+            for t in ts:
+                t.start()
+            deadline = time.time() + 60.0
+            while b.pending() < 2 and time.time() < deadline:
+                time.sleep(0.005)
+            assert b.pending() >= 2
+            b.release()
+            for t in ts:
+                t.join(120.0)
+                assert not t.is_alive(), "degraded mine wedged"
+    finally:
+        b.release()
+        assert b.drain(10.0)
+        FZ.configure(None)
+    assert rules_text(out["a"]) == rules_text(want_a)
+    assert rules_text(out["b"]) == rules_text(want_b)
+    assert b.stats["degraded"] > degraded0
+
+
+@covers("fusion.dispatch")
+def test_fusion_dispatch_fault_queue_wave_degrades_direct():
+    """The queue engine's whole-mine wave routes through the broker's
+    accounting surface only — an armed fusion.dispatch fault there must
+    fall straight through to the direct dispatch with an identical
+    pattern set (and count the degrade)."""
+    from spark_fsm_tpu.models.spade_queue import QueueSpadeTPU
+    from spark_fsm_tpu.service import fusion as FZ
+
+    db = _db()
+    vdb_want = build_vertical(db, min_item_support=6)
+    want = QueueSpadeTPU(vdb_want, 6).mine()  # fusion off
+    assert want is not None
+
+    FZ.configure(cfgmod.FusionConfig(enabled=True))
+    b = FZ.broker()
+    degraded0 = b.stats["degraded"]
+    try:
+        with faults.injected("fusion.dispatch", nth=1, match="queue"):
+            eng = QueueSpadeTPU(build_vertical(db, min_item_support=6), 6)
+            got = _bounded(eng.mine)
+    finally:
+        FZ.configure(None)
+    assert got is not None
+    assert patterns_text(got) == patterns_text(want)
+    assert b.stats["degraded"] > degraded0
+
+
+@covers("device.dispatch")
+def test_device_dispatch_fault_fires_on_fused_broker_path():
+    """With fusion ON the broker's _execute IS the real jnp dispatch
+    call site, so an armed device.dispatch drill must fire THERE (not
+    vacuously pass because only the engine's direct path is guarded)
+    and degrade to per-job dispatch with byte-identical rules."""
+    import threading
+
+    from spark_fsm_tpu.models.tsr import TsrTPU
+    from spark_fsm_tpu.service import fusion as FZ
+
+    db_a, db_b = _rule_db(), synthetic_db(
+        seed=29, n_sequences=40, n_items=7, mean_itemsets=3.0,
+        mean_itemset_size=1.2)
+    mk = lambda db: TsrTPU(build_vertical(db, min_item_support=1), 8,
+                           0.4, max_side=2)
+    want_a, want_b = mk(db_a).mine(), mk(db_b).mine()  # fusion off
+
+    FZ.configure(cfgmod.FusionConfig(enabled=True, window_ms=250.0))
+    b = FZ.broker()
+    fired0 = faults.counters().get("device.dispatch", {}).get("injected", 0)
+    try:
+        b.hold()
+        out = {}
+        ts = [threading.Thread(target=lambda k=k, db=db: out.setdefault(
+            k, mk(db).mine())) for k, db in (("a", db_a), ("b", db_b))]
+        with faults.injected("device.dispatch", nth=1, match="jnp"):
+            for t in ts:
+                t.start()
+            deadline = time.time() + 60.0
+            while b.pending() < 2 and time.time() < deadline:
+                time.sleep(0.005)
+            assert b.pending() >= 2
+            b.release()
+            for t in ts:
+                t.join(120.0)
+                assert not t.is_alive(), "degraded mine wedged"
+    finally:
+        b.release()
+        assert b.drain(10.0)
+        FZ.configure(None)
+    assert faults.counters().get("device.dispatch", {}).get(
+        "injected", 0) > fired0, \
+        "drill was vacuous: no injection fired on the fused path"
+    assert rules_text(out["a"]) == rules_text(want_a)
+    assert rules_text(out["b"]) == rules_text(want_b)
+
+
 # ------------------------------------------------------- admin endpoints
 
 

@@ -1,0 +1,364 @@
+"""Cross-job launch fusion (service/fusion.py, ISSUE 6).
+
+Covers the tentpole's contracts at three altitudes:
+
+- **broker unit** (synthetic waves, no device): bounded-window policy —
+  a ``high`` wave never waits out the window behind low fill, the
+  window closes on ``max_jobs``/``max_width``, the calibrated cost
+  model refuses unprofitable groups (and the refused group still
+  dispatches per-job, correctly);
+- **engine parity** (real TSR mines): two concurrent jobs lined up in a
+  held window fuse into shared launches and their rule sets are
+  byte-identical to solo (fusion-off) runs AND to the brute-force
+  oracle — the positional-demux correctness claim of docs/DESIGN.md;
+- **service**: two /train jobs through a 2-worker Miner with fusion on
+  finish with cross-job launches recorded in the /admin/stats block,
+  and the DISABLED path is one module-global read (same pin as the
+  fault registry and flight recorder).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import build_vertical
+from spark_fsm_tpu.models.tsr import TsrTPU, brute_force_rules
+from spark_fsm_tpu.service import fusion as FZ
+from spark_fsm_tpu.service.actors import Master
+from spark_fsm_tpu.service.model import ServiceRequest, deserialize_rules
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import jobctl
+from spark_fsm_tpu.utils.canonical import rules_text
+
+DEADLINE_S = 60.0
+
+
+@pytest.fixture(autouse=True)
+def _fusion_hygiene():
+    """No broker policy leaks in or out of any test (the engines probe
+    module globals, so a leaked enable would silently reroute every
+    later TSR dispatch in the session)."""
+    FZ.configure(None)
+    yield
+    b = FZ.broker()
+    if b is not None:
+        b.release()
+        assert b.drain(10.0), "fusion broker still busy at test exit"
+    FZ.configure(None)
+
+
+def _enable(**kw):
+    cfg = cfgmod.FusionConfig(enabled=True, **kw)
+    FZ.configure(cfg)
+    return FZ.broker()
+
+
+# ------------------------------------------------------- synthetic waves
+#
+# Broker-level tests use table-lookup eval fns instead of device
+# programs: p1/s1 are [m, 1] uint32 tables whose rows carry distinctive
+# per-job values, and the eval returns each lane's gathered sums — so a
+# demux error (a lane resolved to the wrong job) changes the numbers,
+# exactly like a real support readback, with zero compile cost.
+
+
+def _table_eval(km):
+    def fn(p1, s1, xy):
+        t = np.asarray(p1)[:, 0].astype(np.int64)
+        s = np.asarray(s1)[:, 0].astype(np.int64)
+        xyn = np.asarray(xy)
+        xs = np.where(xyn[:, 0] >= 0, t[np.maximum(xyn[:, 0], 0)], 0)
+        ys = np.where(xyn[:, 1] >= 0, s[np.maximum(xyn[:, 1], 0)], 0)
+        return np.stack([xs.sum(axis=1), ys.sum(axis=1)])
+    return fn
+
+
+def _wave(uid, *, base, m=8, cands=None, priority="normal", n_seq=64):
+    p1 = (np.arange(m, dtype=np.uint32)[:, None] + np.uint32(base))
+    s1 = p1 + np.uint32(100_000)
+    cands = cands if cands is not None else [((0,), (1,)), ((2, 3), (4,))]
+    pools = {}
+    for r, (x, y) in enumerate(cands):
+        side = max(len(x), len(y))
+        km = 1
+        while km < side:
+            km *= 2
+        pools.setdefault(km, []).append(r)
+    return FZ.EvalWave(uid=uid, priority=priority, cands=cands,
+                       pools=pools, p1=p1, s1=s1, eval_fn=_table_eval,
+                       put=lambda x: x, cap=lambda km: 8192, lane=32,
+                       n_seq=n_seq, n_words=1)
+
+
+def _expect(wave):
+    t = wave.p1[:, 0].astype(np.int64)
+    s = wave.s1[:, 0].astype(np.int64)
+    sups = [sum(int(t[i]) for i in x) for x, _ in wave.cands]
+    supxs = [sum(int(s[j]) for j in y) for _, y in wave.cands]
+    return sups, supxs
+
+
+def _check(wave):
+    sups, supxs, report = wave.result()
+    want_sup, want_supx = _expect(wave)
+    assert sups.tolist() == want_sup
+    assert supxs.tolist() == want_supx
+    return report
+
+
+# ------------------------------------------------------------ broker unit
+
+
+def test_fused_group_demuxes_per_job():
+    # NOTE on windows under hold(): the group's window clock starts at
+    # first submit and keeps ticking while held, so held tests use a
+    # SHORT window — release() then launches at (or just after) expiry
+    b = FZ.FusionBroker(window_s=0.25, max_jobs=8, max_width=16384)
+    b.hold()
+    w1 = _wave("job-a", base=1)
+    w2 = _wave("job-b", base=1000,
+               cands=[((1,), (0,)), ((4,), (2, 5)), ((6, 7), (3,))])
+    b.submit(w1)
+    b.submit(w2)
+    assert b.pending() == 2
+    b.release()
+    r1, r2 = _check(w1), _check(w2)
+    # distinct preps, tiny m: fusing two underfilled waves beats two
+    # dispatches, so the group fused into cross-job launches
+    assert r1["fused_jobs"] == 2 and r2["fused_jobs"] == 2
+    assert r1["cross_job_launches"] >= 1
+    assert b.stats["fused_groups"] == 1
+    assert b.stats["cross_job_launches"] >= 1
+
+
+def test_high_priority_never_waits_out_the_window():
+    b = FZ.FusionBroker(window_s=30.0, max_jobs=8, max_width=16384)
+    lo = _wave("job-lo", base=1, priority="low")
+    b.submit(lo)
+    time.sleep(0.25)
+    assert not lo.done, "a lone low wave must wait for the window"
+    t0 = time.monotonic()
+    hi = _wave("job-hi", base=500, priority="high")
+    b.submit(hi)
+    _check(hi)
+    _check(lo)
+    # the high wave closed the 30 s window immediately — and took the
+    # pending low fill with it instead of leaving it behind
+    assert time.monotonic() - t0 < 10.0
+    assert b.stats["waves"] == 2
+
+
+def test_window_closes_on_max_jobs_and_width():
+    b = FZ.FusionBroker(window_s=30.0, max_jobs=2, max_width=16384)
+    t0 = time.monotonic()
+    b.submit(_wave("a", base=1))
+    w2 = _wave("b", base=100)
+    b.submit(w2)
+    _check(w2)  # 2 waves == max_jobs: due immediately
+    assert time.monotonic() - t0 < 10.0
+
+    b2 = FZ.FusionBroker(window_s=30.0, max_jobs=8, max_width=64)
+    t0 = time.monotonic()
+    wide = _wave("c", base=1, m=256,
+                 cands=[((i,), (i + 1,)) for i in range(0, 128, 2)])
+    b2.submit(wide)
+    _check(wide)  # 64 pending lanes >= max_width 64: due immediately
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_cost_model_rejects_unprofitable_group():
+    # two tiny candidate sets over LARGE distinct preps at the full
+    # Kosarak sequence axis (where a saved dispatch is worth only ~64
+    # lane units): the fused plan saves one dispatch but pays a prep
+    # concat priced far above it — the broker must dispatch per-job
+    # (still inside the window run)
+    b = FZ.FusionBroker(window_s=0.25, max_jobs=8, max_width=16384)
+    b.hold()
+    w1 = _wave("big-a", base=1, m=8192, n_seq=990_000)
+    w2 = _wave("big-b", base=7, m=8192, n_seq=990_000)
+    b.submit(w1)
+    b.submit(w2)
+    b.release()
+    r1, r2 = _check(w1), _check(w2)
+    assert r1["fused_jobs"] == 1 and r2["fused_jobs"] == 1
+    assert b.stats["rejected_groups"] == 1
+    assert b.stats["fused_groups"] == 0
+    assert b.stats["solo_waves"] == 2
+
+
+def test_intra_job_waves_fuse_without_cross_job_label():
+    # one job's pipelined waves share a prep AND a uid: they fuse (free
+    # — no concat), but the launch must NOT read as cross-job
+    b = FZ.FusionBroker(window_s=0.25, max_jobs=8, max_width=16384)
+    b.hold()
+    w1 = _wave("job-a", base=1)
+    w2 = _wave("job-a", base=999,
+               cands=[((5,), (6,))])  # base ignored: same-uid test keeps
+    w2.p1, w2.s1 = w1.p1, w1.s1       # the SHARED prep of a real pipeline
+    b.submit(w1)
+    b.submit(w2)
+    b.release()
+    _check(w1)
+    r2 = _check(w2)
+    assert r2["fused_jobs"] == 2  # two waves co-planned...
+    assert r2["cross_job_launches"] == 0  # ...but one job, one tag
+    assert b.stats["cross_job_launches"] == 0
+
+
+# ---------------------------------------------------------- engine parity
+
+
+def _mk_db(seed):
+    return synthetic_db(seed=seed, n_sequences=60, n_items=8,
+                        mean_itemsets=3.0, mean_itemset_size=1.2)
+
+
+def _mine(db, *, uid=None, stats=None, pipeline=None):
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 6, 0.4,
+                 max_side=2)
+    if pipeline is not None:
+        eng.PIPELINE_DEPTH = pipeline  # instance override (tests only)
+    if uid is None:
+        rules = eng.mine()
+    else:
+        try:
+            with jobctl.activate(jobctl.register(uid)):
+                rules = eng.mine()
+        finally:
+            jobctl.release(uid)
+    if stats is not None:
+        stats.update(eng.stats)
+    return rules
+
+
+def test_cross_job_fused_parity_oracle():
+    """THE tentpole contract: two concurrent jobs lined up in one held
+    window fuse into shared launches, and each job's rule set is
+    byte-identical to its solo run and to the brute-force oracle."""
+    db_a, db_b = _mk_db(31), _mk_db(47)
+    solo_a, solo_b = _mine(db_a), _mine(db_b)
+
+    b = _enable(window_ms=200.0, max_jobs=8, max_width=16384)
+    b.hold()
+    out, stats = {}, {"a": {}, "b": {}}
+    run = lambda k, db: out.setdefault(
+        k, _mine(db, uid=f"job-{k}", stats=stats[k]))
+    ts = [threading.Thread(target=run, args=("a", db_a)),
+          threading.Thread(target=run, args=("b", db_b))]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + DEADLINE_S
+    while b.pending() < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert b.pending() >= 2, "both jobs' first waves should be in window"
+    b.release()
+    for t in ts:
+        t.join(DEADLINE_S)
+        assert not t.is_alive(), "fused mine did not finish"
+
+    assert rules_text(out["a"]) == rules_text(solo_a)
+    assert rules_text(out["b"]) == rules_text(solo_b)
+    assert rules_text(solo_a) == rules_text(
+        brute_force_rules(db_a, 6, 0.4, max_side=2))
+    assert b.stats["cross_job_launches"] >= 1
+    assert stats["a"].get("fusion_waves", 0) >= 1
+    assert stats["b"].get("fusion_waves", 0) >= 1
+    # launches the engines did NOT dispatch themselves: fused mines
+    # count their broker waves, not the shared device launches
+    assert stats["a"].get("fusion_fused_waves", 0) >= 1
+
+
+def test_lone_wave_dispatches_like_direct_path():
+    """A wave with no fusion peer must produce the same rule set and
+    the same launch SHAPES the direct path plans (same packer, same
+    caps) — fusion never penalizes an unfused job's plan."""
+    db = _mk_db(53)
+    stats_direct = {}
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 6, 0.4,
+                 max_side=2)
+    direct = eng.mine()
+    stats_direct = eng.stats
+
+    _enable(window_ms=1.0, max_jobs=8, max_width=16384)
+    stats_fused = {}
+    # pipeline depth 1 so each wave resolves before the next dispatches
+    # — every wave is provably ALONE in its window, the exact "no
+    # fusion peer" case under test
+    fused = _mine(db, uid="lone", stats=stats_fused, pipeline=1)
+    assert rules_text(fused) == rules_text(direct)
+    # every dispatch became one solo broker wave planning the same
+    # launch count the direct path did
+    assert stats_fused["fusion_launches"] == stats_direct[
+        "kernel_launches"] - 1  # minus the direct path's prep launch
+    assert stats_fused.get("fusion_fused_waves", 0) == 0
+
+
+# --------------------------------------------------------------- service
+
+
+def test_service_cross_job_fusion_stats_and_parity():
+    db_a, db_b = _mk_db(61), _mk_db(67)
+    want_a, want_b = _mine(db_a), _mine(db_b)
+    store = ResultStore()
+    b = _enable(window_ms=250.0, max_jobs=8, max_width=16384)
+    master = Master(store=store, miner_workers=2)
+    try:
+        b.hold()
+        uids = {}
+        for k, db in (("a", db_a), ("b", db_b)):
+            resp = master.handle(ServiceRequest("fsm", "train", {
+                "algorithm": "TSR_TPU", "source": "INLINE",
+                "sequences": format_spmf(db), "k": "6", "minconf": "0.4",
+                "max_side": "2", "priority": "normal"}))
+            assert resp.status != "failure", resp.data
+            uids[k] = resp.data["uid"]
+        deadline = time.monotonic() + DEADLINE_S
+        while b.pending() < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b.pending() >= 2
+        b.release()
+        deadline = time.monotonic() + DEADLINE_S
+        while time.monotonic() < deadline:
+            if all(store.status(u) in ("finished", "failure")
+                   for u in uids.values()):
+                break
+            time.sleep(0.02)
+        assert store.status(uids["a"]) == "finished"
+        assert store.status(uids["b"]) == "finished"
+        got_a = deserialize_rules(store.rules(uids["a"]))
+        got_b = deserialize_rules(store.rules(uids["b"]))
+        assert rules_text(got_a) == rules_text(want_a)
+        assert rules_text(got_b) == rules_text(want_b)
+        assert b.stats["cross_job_launches"] >= 1
+        from spark_fsm_tpu.service.app import _fusion_stats
+
+        fs = _fusion_stats()
+        assert fs["enabled"] and fs["cross_job_launches"] >= 1
+    finally:
+        master.shutdown()
+
+
+def test_disabled_path_is_one_global_read():
+    """Fusion off (the default): the engine probes return after one
+    module-global read — no broker, no wave, no counter touched — and
+    dispatch_wave passes the callable straight through."""
+    assert not FZ.eval_enabled()
+    assert FZ.submit_eval(cands=[], pools={}, p1=None, s1=None,
+                          eval_fn=None, put=None, cap=None, lane=32,
+                          n_seq=64, n_words=1) is None
+    b = FZ.broker()
+    before = dict(b.stats) if b is not None else None
+    assert FZ.dispatch_wave("queue", lambda: 41 + 1) == 42
+    if b is not None:
+        assert b.stats == before
+    # and a real mine's stats carry no fusion_* keys at all
+    db = _mk_db(71)
+    eng = TsrTPU(build_vertical(db, min_item_support=1), 6, 0.4,
+                 max_side=2)
+    eng.mine()
+    assert not any(k.startswith("fusion") for k in eng.stats)

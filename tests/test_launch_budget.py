@@ -76,3 +76,53 @@ def test_queue_late_wave_budget():
     assert eng.stats["waves"] > 0
     assert eng.stats["late_waves"] == eng.stats["waves"]  # all-narrow
     assert eng.stats["candidates"] > 0
+
+
+def test_fused_cross_job_launch_budget():
+    """Launch-budget pin for the FUSED path (ISSUE 6): a deterministic
+    two-job window group — 120 ragged candidates from job A, 50 from
+    job B, distinct preps, the committed cost-model constants (the
+    conftest calibration pin) — must collapse into EXACTLY one shared
+    cross-job launch (per-job plans: one launch each), at the pinned
+    geometry.  Any drift means the fusion/packing policy changed and
+    the expectations must be re-derived deliberately, like the solo
+    pins above."""
+    from spark_fsm_tpu.service import fusion as FZ
+    from tests.test_fusion import _check, _wave
+
+    b = FZ.FusionBroker(window_s=0.25, max_jobs=8, max_width=16384)
+    b.hold()
+    wa = _wave("job-a", base=1, m=256, n_seq=2000,
+               cands=[((i % 100,), ((i + 1) % 100,)) for i in range(100)]
+                     + [((i, i + 1), (i + 2,)) for i in range(20)])
+    wb = _wave("job-b", base=5000, m=256, n_seq=2000,
+               cands=[((i % 64,), ((i + 3) % 64,)) for i in range(40)]
+                     + [((i, i + 1, i + 2), (i + 3,)) for i in range(10)])
+    b.submit(wa)
+    b.submit(wb)
+    b.release()
+    ra, rb = _check(wa), _check(wb)
+    assert b.drain(10.0)
+    # the per-job alternative is one launch EACH (the packer merges each
+    # job's tails): fusion halves the dispatch count for this group
+    from spark_fsm_tpu.ops import ragged_batch as RB
+
+    for w in (wa, wb):
+        solo = RB.plan_launches(w.pools, cap=w.cap, lane=w.lane,
+                                overhead=RB.overhead_units(2000, 1),
+                                record=False)
+        assert len(solo) == 1
+    assert ra == rb  # one shared launch: both riders see the same plan
+    assert ra["fused_jobs"] == 2
+    assert ra["launches"] == 1
+    assert ra["cross_job_launches"] == 1
+    assert ra["traffic_units"] == 1024  # km4 geometry x 256 lanes
+    assert ra["m_pad"] == 512  # 2x 256-row preps, pow2 bucket
+    # alt_solo_*: the unfused alternative was 2 launches of 256 lanes
+    # (km1 x 256 and km4 x 64 tails pack to one merged launch each) —
+    # the device-dispatch saving the broker's accounting reports
+    assert b.stats == {
+        "waves": 2, "fused_waves": 2, "solo_waves": 0, "launches": 1,
+        "cross_job_launches": 1, "fused_groups": 1,
+        "rejected_groups": 0, "degraded": 0, "traffic_units": 1024,
+        "alt_solo_launches": 2, "alt_solo_units": 512}

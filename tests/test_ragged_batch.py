@@ -251,3 +251,36 @@ def test_queue_segmented_late_switch_parity():
     assert patterns_text(got) == patterns_text(mine_spade(db, 2))
     assert eng.stats.get("late_waves", 0) > 0
     assert eng.stats["kernel_launches"] > 1  # actually segmented
+
+
+def test_overhead_drift_recalibration(monkeypatch):
+    """Plan-time overhead recalibration (ISSUE 6 satellite): the
+    committed DISPATCH_SEC scales by the live cost-model drift EWMA —
+    quantized to pow2 steps (plan stability), never below 1 (the
+    measured anchor is a floor), clamped at the cap — and the
+    launch-budget/bench pin (set_overhead_calibration(False), the
+    conftest default for every test) restores the raw constant."""
+    from spark_fsm_tpu.utils import obs
+
+    try:
+        RB.set_overhead_calibration(True)
+        for drift, want in ((None, 1), (0.5, 1), (1.0, 1), (1.9, 1),
+                            (2.0, 2), (3.9, 2), (4.0, 4), (7.2, 4),
+                            (999.0, RB._DRIFT_FACTOR_CAP)):
+            monkeypatch.setattr(obs, "costmodel_drift", lambda d=drift: d)
+            assert RB.drift_factor() == want, drift
+            assert RB.calibrated_dispatch_s() == RB.DISPATCH_SEC * want
+            # the planner's default overhead resolves through the
+            # calibrated constant...
+            assert RB.overhead_units(990_000, 1) == RB.overhead_units(
+                990_000, 1, dispatch_s=RB.DISPATCH_SEC * want)
+        # ...and more overhead per launch can only merge MORE: the
+        # drifted plan for two ragged tails never emits more launches
+        pools = {1: list(range(40)), 2: list(range(40, 60))}
+        drifted = RB.plan_launches(pools, cap=lambda km: 4096, lane=32)
+        monkeypatch.setattr(obs, "costmodel_drift", lambda: 1.0)
+        base = RB.plan_launches(pools, cap=lambda km: 4096, lane=32)
+        assert len(drifted) <= len(base)
+    finally:
+        RB.set_overhead_calibration(False)
+    assert RB.drift_factor() == 1  # the pin: raw committed constant
