@@ -17,16 +17,19 @@ Synthetic data uses the vectorized generators (`fast=True`, see
 data/synth.py — a full Kosarak draw takes seconds instead of ~35 min).
 
 Configs: 2 (full MSNBC SPADE, mesh path), 3 (full Kosarak TSR,
-max_side=2), 3d (same but the service DEFAULT — unlimited rule sides),
-4 (full Gazelle cSPADE, maxgap=2/maxwindow=5), 5 (full-scale sliding
-window on the INCREMENTAL service-default route: per-push walls + repair
-counters), 5r (same stream on the re-mine fallback: window-scaled walls
-+ the compiled-shape count that proves shape_buckets bounds recompiles).
+max_side=2), 3d (same but the service DEFAULT — unlimited rule sides,
+routed to the RESIDENT-FRONTIER path since ISSUE 7), 3r (3d with
+resident routing pinned off — the host-loop reference the 3d collapse
+is measured against), 4 (full Gazelle cSPADE, maxgap=2/maxwindow=5),
+5 (full-scale sliding window on the INCREMENTAL service-default route:
+per-push walls + repair counters), 5r (same stream on the re-mine
+fallback: window-scaled walls + the compiled-shape count that proves
+shape_buckets bounds recompiles).
 
-Usage: python bench_scale.py [--parity] [2 3 3d 4 5 5r]   (default: all;
---parity additionally runs the full-size oracle where feasible — configs
-2 and 4, and per-push window oracles for 5 — attesting byte-identical
-pattern sets; 3/3d have no feasible full-size oracle)
+Usage: python bench_scale.py [--parity] [2 3 3d 3r 4 5 5r]  (default:
+all; --parity additionally runs the full-size oracle where feasible —
+configs 2 and 4, and per-push window oracles for 5 — attesting
+byte-identical pattern sets; 3/3d/3r have no feasible full-size oracle)
 """
 
 from __future__ import annotations
@@ -93,7 +96,7 @@ def config2(parity: bool = False) -> dict:
     return out
 
 
-def _tsr(max_side, tag: str, note: str) -> dict:
+def _tsr(max_side, tag: str, note: str, resident: str = "auto") -> dict:
     """TSR top-k over the full Kosarak-shaped DB (990k seqs, 39.6k items)."""
     import jax
 
@@ -106,7 +109,7 @@ def _tsr(max_side, tag: str, note: str) -> dict:
     t1 = time.monotonic()
     vdb = build_vertical(db, min_item_support=1)
     t2 = time.monotonic()
-    eng = TsrTPU(vdb, 100, 0.5, max_side=max_side)
+    eng = TsrTPU(vdb, 100, 0.5, max_side=max_side, resident=resident)
     t3 = time.monotonic()
     rules = eng.mine()
     t4 = time.monotonic()
@@ -138,6 +141,12 @@ def _tsr(max_side, tag: str, note: str) -> dict:
     out["superbatches"] = eng.stats.get("superbatches", 0)
     out["pruned_conf"] = eng.stats.get("pruned_conf", 0)
     out["pruned_conf_chains"] = eng.stats.get("pruned_conf_chains", 0)
+    # resident-frontier counters (ops/resident_frontier.py): present
+    # only when the planner routed (part of) the mine on-device —
+    # the 3d-vs-3r decomposition reads straight off these
+    from spark_fsm_tpu.models.tsr import resident_counters
+
+    out.update(resident_counters(eng.stats))
     return out
 
 
@@ -147,8 +156,20 @@ def config3() -> dict:
 
 def config3d() -> dict:
     # the honest default-path number: the service leaves rule sides
-    # UNCAPPED unless the request sets max_side (docs/OPERATIONS.md knob)
+    # UNCAPPED unless the request sets max_side (docs/OPERATIONS.md
+    # knob); since ISSUE 7 the planner routes this shape to the
+    # RESIDENT-FRONTIER path (whole km-ladders in one dispatch), so
+    # this row carries the resident counters
     return _tsr(None, "3d", "max_side unlimited (service default)")
+
+
+def config3r() -> dict:
+    # the host-loop REFERENCE for 3d: same workload with resident
+    # routing pinned off — the expand/readback/re-plan loop the
+    # resident path replaces, kept runnable so hardware sessions can
+    # measure the 3d-vs-3r collapse side by side
+    return _tsr(None, "3r", "max_side unlimited, resident=never "
+                "(host-loop reference)", resident="never")
 
 
 def config4(parity: bool = False) -> dict:
@@ -344,7 +365,8 @@ def main() -> None:
 
     enable_compile_cache()
     runners = {"2": config2, "3": config3, "3d": config3d,
-               "4": config4, "5": config5, "5r": config5r}
+               "3r": config3r, "4": config4, "5": config5,
+               "5r": config5r}
     parity_capable = {"2", "4", "5"}  # feasible full-size oracles
     args = sys.argv[1:]
     parity = "--parity" in args

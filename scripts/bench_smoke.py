@@ -2,9 +2,12 @@
 """Seconds-scale launch/traffic smoke of the BENCH_SCALE hot configs.
 
 Runs shaped miniatures of configs 3 (full-Kosarak TSR, max_side=2),
-3d (same, unlimited sides — the service default) and 5 (incremental
-streaming) and diffs the DISPATCH-SHAPE counters — ``kernel_launches``,
-``evaluated``, ``traffic_units`` — against the committed expectations in
+3d (same, unlimited sides — the service default, routed to the
+RESIDENT-FRONTIER path since ISSUE 7), 3r (3d with resident routing
+pinned off — the host-loop reference) and 5 (incremental streaming)
+and diffs the DISPATCH-SHAPE counters — ``kernel_launches``,
+``evaluated``, ``traffic_units``, and the 3d row's resident-wave
+counters — against the committed expectations in
 ``scripts/bench_smoke_expect.json``.  Walls are reported but never
 compared: the point is that launch-packing / candidate-generation
 regressions fail in seconds on any machine (CI, laptop) instead of
@@ -31,18 +34,21 @@ EXPECT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "bench_smoke_expect.json")
 
 COMPARED = ("kernel_launches", "evaluated", "traffic_units",
-            "pruned_conf", "superbatches")
+            "pruned_conf", "superbatches", "resident_rounds",
+            "resident_segments", "resident_waves", "resident_deferred",
+            "resident_spills", "resident_handoffs",
+            "resident_fallbacks", "resident_readback_bytes")
 
 
-def smoke_tsr(max_side, trace_id=None):
+def smoke_tsr(max_side, trace_id=None, resident="auto"):
     from spark_fsm_tpu.data.synth import kosarak_like
     from spark_fsm_tpu.data.vertical import build_vertical
-    from spark_fsm_tpu.models.tsr import TsrTPU
+    from spark_fsm_tpu.models.tsr import TsrTPU, resident_counters
 
     db = kosarak_like(scale=0.002, fast=True)
     vdb = build_vertical(db, min_item_support=1)
     t0 = time.monotonic()
-    eng = TsrTPU(vdb, 100, 0.5, max_side=max_side)
+    eng = TsrTPU(vdb, 100, 0.5, max_side=max_side, resident=resident)
     if trace_id is not None:
         from spark_fsm_tpu.utils import obs
 
@@ -50,7 +56,7 @@ def smoke_tsr(max_side, trace_id=None):
             rules = eng.mine()
     else:
         rules = eng.mine()
-    return {
+    out = {
         "kernel_launches": eng.stats["kernel_launches"],
         "evaluated": eng.stats["evaluated"],
         "traffic_units": eng.stats["traffic_units"],
@@ -59,6 +65,8 @@ def smoke_tsr(max_side, trace_id=None):
         "superbatches": eng.stats.get("superbatches", 0),
         "wall_s": round(time.monotonic() - t0, 2),
     }
+    out.update(resident_counters(eng.stats))
+    return out
 
 
 def smoke_stream():
@@ -90,7 +98,8 @@ def main() -> int:
     RB.set_overhead_calibration(False)
     rows = {
         "3": smoke_tsr(2),
-        "3d": smoke_tsr(None),
+        "3d": smoke_tsr(None),  # service default -> resident path
+        "3r": smoke_tsr(None, resident="never"),  # host-loop reference
         "5": smoke_stream(),
     }
     print(json.dumps(rows, indent=2))
@@ -157,9 +166,11 @@ def xcheck_trace(untraced_row) -> int:
                 f"span-derived launch count {span_launches} != engine "
                 f"kernel_launches {row['kernel_launches']}")
     for key in COMPARED + ("rules",):
-        if row[key] != untraced_row[key]:
-            failures.append(f"traced run perturbed {key}: {row[key]} != "
-                            f"{untraced_row[key]}")
+        if key not in untraced_row and key not in row:
+            continue  # e.g. resident_* keys on a host-loop row
+        if row.get(key) != untraced_row.get(key):
+            failures.append(f"traced run perturbed {key}: {row.get(key)} "
+                            f"!= {untraced_row.get(key)}")
     if failures:
         print("bench_smoke: TRACE/COUNTER CROSS-CHECK FAILED:",
               file=sys.stderr)

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Resident-frontier smoke — seconds-scale proof that the service-default
+# 3d miniature routes to the resident path at the committed dispatch
+# shape (launches/waves/deferred pinned) with host-loop parity.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/resident_smoke.py "$@"

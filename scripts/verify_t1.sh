@@ -10,6 +10,8 @@
 #   obs_smoke.sh         /metrics + trace completeness over a live boot
 #   overload_smoke.sh    429 shedding + kill-restart journal recovery
 #   throughput_smoke.sh  fused-vs-unfused flood, per-job parity
+#   resident_smoke.sh    resident-frontier 3d miniature, pinned waves +
+#                        host-path parity
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -20,7 +22,7 @@ rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
-             throughput_smoke; do
+             throughput_smoke resident_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

@@ -59,6 +59,7 @@ from spark_fsm_tpu.ops import bitops_jax as B
 from spark_fsm_tpu.ops import bitops_np as Bnp
 from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.ops import ragged_batch as RB
+from spark_fsm_tpu.ops import resident_frontier as RF
 from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
 from spark_fsm_tpu.service import fusion as FZ
@@ -83,6 +84,29 @@ def _is_oom(exc: BaseException) -> bool:
 # (the TsrTPU constructor default; the shape-key enumerator's fused-
 # ladder m buckets derive from it, so one spelling for both)
 ITEM_CAP_DEFAULT = 256
+
+# transfer-pricing floor (bytes/s) for the resident final-records
+# readback watchdog deadline: tunneled PJRT transports measure
+# ~10-16 MB/s, so 8 MB/s is the conservative healthy-link floor
+_RESIDENT_READBACK_FLOOR_BPS = 8e6
+
+# the resident-frontier counters the bench harnesses export — ONE
+# spelling (bench_scale.py and scripts/bench_smoke.py both serialize
+# through resident_counters, so their row shapes can't drift apart)
+RESIDENT_EXPORT_KEYS = (
+    "resident_rounds", "resident_segments", "resident_waves",
+    "resident_deferred", "resident_spills", "resident_handoffs",
+    "resident_fallbacks", "resident_readback_bytes")
+
+
+def resident_counters(stats: dict) -> dict:
+    """Bench/smoke export of the resident-frontier counters: empty
+    unless the planner routed (part of) the mine on-device, zero-filled
+    otherwise so the same mine serializes the same row shape from every
+    harness."""
+    if not stats.get("resident"):
+        return {}
+    return {k: stats.get(k, 0) for k in RESIDENT_EXPORT_KEYS}
 
 
 def tsr_geometry(n_sequences: int, n_words: int, *,
@@ -311,6 +335,11 @@ class TsrTPU:
     # NumPy TsrCPU subclass opts out — it compiles nothing
     _RECORD_SHAPES = True
 
+    # resident-frontier route capability (ops/resident_frontier.py);
+    # the NumPy TsrCPU subclass opts out — its dispatch is host numpy
+    # and must never initialize the JAX backend
+    _RESIDENT_CAPABLE = True
+
     def __init__(
         self,
         vdb: VerticalDB,
@@ -324,6 +353,7 @@ class TsrTPU:
         eval_budget_bytes: Optional[int] = None,
         use_pallas="auto",
         shape_buckets: bool = False,
+        resident="auto",
     ):
         self.vdb = vdb
         self.k = int(k)
@@ -335,6 +365,18 @@ class TsrTPU:
         self._put = functools.partial(MH.host_to_device, mesh)
         self.item_cap = int(item_cap)
         self.max_side = max_side
+        # resident-frontier routing (ops/resident_frontier.py):
+        # "auto" = the planner heuristic picks it for launch-bound deep
+        # mines; "always"/"never" pin it (structural eligibility —
+        # single device, fitting caps — still applies to "always");
+        # bools accepted for request-param convenience
+        if isinstance(resident, bool):
+            resident = "always" if resident else "never"
+        if resident not in ("auto", "always", "never"):
+            raise ValueError(f"resident must be auto/always/never, "
+                             f"got {resident!r}")
+        self.resident = resident
+        self._resident_caps: Optional[RF.ResidentCaps] = None
         self.stats = {"evaluated": 0, "kernel_launches": 0,
                       "deepening_rounds": 0, "pruned_conf": 0,
                       "traffic_units": 0}
@@ -557,10 +599,7 @@ class TsrTPU:
         engine-layout one."""
         if self._chunk_user is not None:
             return self._chunk_user
-        if self._eval_budget is None:
-            dev = (self.mesh.devices.flat[0] if self.mesh is not None
-                   else jax.devices()[0])
-            self._eval_budget = _auto_eval_budget(dev)
+        self._ensure_budget()
         n_dev = 1 if self.mesh is None else self.mesh.devices.size
         s_local = max(1, self.n_seq // n_dev)
         per_cand = max(1, s_local * self.n_words * 4 * 4)
@@ -576,6 +615,16 @@ class TsrTPU:
         self._jnp_raw = max(128, next_pow2(budget // per_cand + 1) // 2)
         return min(RB.dispatch_quantum_lanes(self.n_seq, self.n_words),
                    self._jnp_raw)
+
+    def _ensure_budget(self) -> int:
+        """Resolve the per-device eval budget lazily (probing the
+        device initializes the JAX backend, which must not happen for
+        engines that never need it)."""
+        if self._eval_budget is None:
+            dev = (self.mesh.devices.flat[0] if self.mesh is not None
+                   else jax.devices()[0])
+            self._eval_budget = _auto_eval_budget(dev)
+        return self._eval_budget
 
     def _dispatch_eval(self, p1, s1,
                        cands: List[Tuple[Tuple[int, ...], Tuple[int, ...]]]):
@@ -1008,12 +1057,429 @@ class TsrTPU:
     def _mine_restricted(self, m: int, resume: Optional[dict] = None,
                          checkpoint_cb=None,
                          every_s: float = 30.0) -> Tuple[List[RuleResult], int]:
-        """Full search over the top-m items; returns (results, s_k)."""
+        """Full search over the top-m items; returns (results, s_k).
+
+        Routes the round: the RESIDENT-FRONTIER path (whole km-ladders
+        expanded on device inside one ``lax.while_loop``,
+        ops/resident_frontier.py) when the planner heuristic predicts
+        launch-bound behavior, else the classic host loop below.  The
+        resident path spills back here on any capacity overflow, so the
+        choice is a performance routing decision, never a correctness
+        one."""
         self.chunk = self._round_chunk(m)
         self._round_m = m
         self._jnp_prep = None  # cleared per round (downgrade state is stale)
+        if self._resident_route(m):
+            return self._mine_resident(m, resume=resume,
+                                       checkpoint_cb=checkpoint_cb,
+                                       every_s=every_s)
+        return self._mine_host_restricted(m, resume=resume,
+                                          checkpoint_cb=checkpoint_cb,
+                                          every_s=every_s)
+
+    def _resident_route(self, m: int) -> bool:
+        """Should this round run on the resident-frontier path?
+
+        Structural eligibility (applies even to ``resident="always"``):
+        single device (the carry is unsharded; fused prep concat and
+        psum demux don't exist here), k within the on-device top-k
+        buffer, exact-conf products within int32, and a frontier/record
+        capacity model that fits the eval budget.  The "auto" heuristic
+        on top: only DEEP mines (unlimited or >2-item sides — the
+        config-3d shape whose host loop is launch-bound) and only when
+        one saved dispatch is worth at least a wave of km-ladder fold
+        padding (``overhead_units >= nb`` — true at dryrun scale and on
+        tunneled/drift-calibrated backends, false on a local full-axis
+        chip where the host loop's dispatches are cheap)."""
+        if not self._RESIDENT_CAPABLE or self.resident == "never":
+            return False
+        if self.mesh is not None or self._multiproc:
+            return False
+        if self.k > RF.K_PAD:
+            return False
+        num, den = _conf_frac(self.minconf)
+        if max(num, den) * (self.n_seq + 1) >= 2 ** 31:
+            return False  # the device conf test multiplies in int32
+        if self.resident != "always" and not (
+                self.max_side is None or self.max_side > 2):
+            return False
+        caps = RF.caps_for(self.n_seq, self.n_words, m,
+                           self._ensure_budget())
+        if caps is None or m > caps.ring:
+            return False
+        if (self.resident != "always"
+                and RB.overhead_units(self.n_seq, self.n_words) < caps.nb):
+            return False
+        self._resident_caps = caps
+        return True
+
+    # ------------------------------------------------- resident route
+
+    def _mine_resident(self, m: int, resume: Optional[dict],
+                       checkpoint_cb, every_s: float,
+                       ) -> Tuple[List[RuleResult], int]:
+        """One deepening round on the resident-frontier path: the
+        frontier, per-candidate antecedent supports and the top-k prune
+        threshold stay in HBM, and whole km-ladders expand inside the
+        compiled while_loop — the host reads back a 9-int counter
+        vector per segment and the packed survivors at the end.
+
+        Failure posture: a capacity overflow (ring/records/km-ladder)
+        commits nothing on device — the intact frontier SPILLS into the
+        host loop's own resume format and the round continues on the
+        classic ragged-batch path.  A dispatch fault falls back the
+        same way (or restarts the round host-side when the device state
+        is unreadable); a watchdog timeout or job abort propagates to
+        supervision like every other engine path.  Resident dispatches
+        route through fusion.dispatch_wave for the one accounting/fault
+        surface but NEVER enter a fusion window — a single long-lived
+        while_loop dispatch must not wait on (or hold up) a fusion
+        group (docs/DESIGN.md)."""
+        caps = self._resident_caps
+        num, den = _conf_frac(self.minconf)
+        max_side_t = self.max_side if self.max_side is not None else 1 << 30
+        sup_l = self._sup_sorted[:m].astype(np.int64).tolist()
+        if resume is not None:
+            minsup = int(resume["minsup"])
+            results0 = [(int(sup), int(supx), tuple(x), tuple(y))
+                        for x, y, sup, supx in resume["results"]]
+            entries = [(int(b), tuple(x), tuple(y), bool(cr), int(side),
+                        int(psup), int(psupx))
+                       for b, x, y, cr, side, psup, psupx
+                       in resume["stack"]]
+            self.stats["resumed_nodes"] = len(entries)
+        else:
+            minsup = 1
+            results0 = []
+            entries = RF.root_entries(sup_l, minsup, num, den,
+                                      self.max_side)
+        state = RF.pack_state(entries, results0, caps)
+        if state is None:
+            # the resumed frontier outgrows the caps (e.g. a host
+            # snapshot with sides past the km ladder): route host
+            return self._mine_host_restricted(
+                m, resume=resume, checkpoint_cb=checkpoint_cb,
+                every_s=every_s)
+        self.stats["resident"] = True
+        self.stats["resident_rounds"] = (
+            self.stats.get("resident_rounds", 0) + 1)
+        if self._RECORD_SHAPES:
+            shapes.record(shapes.key_tsr_resident(
+                self.n_seq, self.n_words, m, caps.km, caps.nb, caps.ring))
+        p1, s1 = self._prep_engine(m)
+        put = self._put
+        sup_items = put(np.asarray(sup_l, np.int32))
+        carry = (
+            put(state["exy"]), put(state["bound"]), put(state["psup"]),
+            put(state["psupx"]), put(state["cr"]), put(state["side"]),
+            put(np.int32(0)), put(np.int32(state["n_entries"])),
+            put(state["rec_xy"]), put(state["rec_sup"]),
+            put(state["rec_supx"]), put(np.int32(state["n_results"])),
+            put(state["topk"]), put(np.int32(state["n_results"])),
+            put(np.int32(minsup)), put(np.bool_(False)),
+            put(np.int32(0)), put(np.int32(0)), put(np.int32(0)),
+            put(state["dxy"]), put(state["dbound"]),
+            put(state["dpsup"]), put(state["dpsupx"]),
+            put(state["dcr"]), put(state["dside"]),
+            put(np.int32(state["n_defer"])))
+        num_d, den_d = put(np.int32(num)), put(np.int32(den))
+        k_d = put(np.int32(self.k))
+        ms_d = put(np.int32(max_side_t))
+
+        narrow = caps.nb_late < caps.nb and state["n_entries"] <= caps.nb_late
+        if narrow and self._RECORD_SHAPES:
+            shapes.record(shapes.key_tsr_resident(
+                self.n_seq, self.n_words, m, caps.km, caps.nb_late,
+                caps.ring))
+        narrow_recorded = narrow
+        # segment budget: fine-grained when checkpointing (first
+        # snapshot lands after wave 1, queue-engine style), coarse
+        # otherwise; geometric growth bounds counter readbacks to
+        # ~log + wall/interval
+        budget = 1 if checkpoint_cb is not None else 256
+        last_ckpt = time.monotonic()
+        waves_done = ev_done = pr_done = 0
+        tr_done = seg_launches = 0
+        while True:
+            # deadline/cancel safe point between segment dispatches
+            jobctl.check()
+            nbw = caps.nb_late if narrow else caps.nb
+            fn = RF.segment_fn(caps, narrow)
+            # watchdog ceiling from the cost model's ladder estimate:
+            # the segment streams at most budget x nbw x km lane-units
+            bound_s = RB.estimate_seconds(
+                budget * nbw * caps.km, 1, self.n_seq, self.n_words)
+            deadline = watchdog.deadline_s(bound_s)
+            try:
+                with obs.span("tsr.resident", point="segment", nb=nbw,
+                              budget=budget, narrow=narrow,
+                              bound_s=round(bound_s, 6)):
+                    faults.fault_site("device.resident", point="segment",
+                                      nb=str(nbw))
+                    wave_end = put(np.int32(waves_done + budget))
+                    # unfusable by construction (per-round device
+                    # carry): dispatch_wave is the broker's accounting/
+                    # fault surface only — the wave never sits in a
+                    # fusion window
+                    carry, counters_dev = FZ.dispatch_wave(
+                        "tsr_resident",
+                        lambda f=fn, c=carry, we=wave_end: f(
+                            p1, s1, sup_items, num_d, den_d, k_d, ms_d,
+                            we, *c),
+                        point="resident_segment")
+                    self.stats["kernel_launches"] += 1
+                    seg_launches += 1
+
+                    def read():
+                        faults.fault_site("device.resident",
+                                          point="readback")
+                        return np.asarray(counters_dev)
+
+                    counters = watchdog.run_with_deadline(
+                        read, deadline, site="tsr.resident")
+            except (watchdog.WatchdogTimeout, jobctl.JobAborted):
+                # a hung device or an aborted job is not a resident
+                # fault: supervision owns the re-run (the same posture
+                # as _resolve_eval's direct path)
+                raise
+            except Exception as exc:
+                # mid-ladder dispatch fault: abandon the round to the
+                # host path (the carry may have been donated into the
+                # failed dispatch, so no device state is assumed
+                # readable here)
+                return self._resident_abandon(
+                    exc, m, resume, checkpoint_cb, every_s,
+                    ev_done, pr_done, tr_done, seg_launches)
+            (n_rec, oflow, waves, head, tail, minsup, evaluated,
+             pruned, _n_acc, n_def) = (int(v) for v in counters)
+            RF.count_segment(waves - waves_done, nbw, caps.km)
+            self.stats["resident_segments"] = (
+                self.stats.get("resident_segments", 0) + 1)
+            self.stats["resident_waves"] = (
+                self.stats.get("resident_waves", 0) + waves - waves_done)
+            seg_traffic = (waves - waves_done) * nbw * caps.km
+            tr_done += seg_traffic
+            self.stats["traffic_units"] = (
+                self.stats.get("traffic_units", 0) + seg_traffic)
+            self.stats["evaluated"] += evaluated - ev_done
+            self.stats["pruned_conf"] += pruned - pr_done
+            waves_done, ev_done, pr_done = waves, evaluated, pruned
+            budget = min(4096, budget * 4)
+            pending = tail > head
+            if oflow or (pending and waves >= caps.i_max):
+                # overflow-to-host spill: the aborted wave committed
+                # nothing, so the ring + records read back as a
+                # consistent frontier the host loop resumes exactly
+                return self._resident_spill(
+                    m, carry, head, tail, n_rec, n_def, minsup,
+                    checkpoint_cb=checkpoint_cb, every_s=every_s,
+                    prep=(p1, s1))
+            if not pending:
+                break
+            if not narrow and caps.nb_late < caps.nb and (
+                    tail - head) <= caps.nb_late:
+                narrow = True  # late-wave switch (never switched back)
+                if not narrow_recorded and self._RECORD_SHAPES:
+                    shapes.record(shapes.key_tsr_resident(
+                        self.n_seq, self.n_words, m, caps.km,
+                        caps.nb_late, caps.ring))
+                    narrow_recorded = True
+            if (checkpoint_cb is not None
+                    and time.monotonic() - last_ckpt >= every_s):
+                checkpoint_cb(self._resident_snapshot(
+                    m, carry, head, tail, n_rec, n_def, minsup))
+                self.stats["checkpoints"] = (
+                    self.stats.get("checkpoints", 0) + 1)
+                last_ckpt = time.monotonic()
+        # final readback: the packed survivors (full arrays — a dynamic
+        # slice would compile per result count).  The watchdog deadline
+        # is sized from the actual buffer volume: RB.estimate_seconds
+        # models compute lane-units, not transfer, and the record caps
+        # reach MBs at full scale — on a tunneled PJRT backend
+        # (~10-16 MB/s) a guessed constant would time out a healthy
+        # pull, so price at a conservative 8 MB/s floor + 1 s latency
+        rec_idx = (8, 9, 10) + ((19, 20, 21, 22, 23, 24) if n_def else ())
+        rb_est_s = 1.0 + (sum(carry[i].nbytes for i in rec_idx)
+                          / _RESIDENT_READBACK_FLOOR_BPS)
+        try:
+            with obs.span("tsr.resident", point="readback", records=n_rec,
+                          deferred=n_def, bound_s=round(rb_est_s, 6)):
+                def read_recs():
+                    faults.fault_site("device.resident", point="records")
+                    return [np.asarray(carry[i]) for i in rec_idx]
+
+                arrs = watchdog.run_with_deadline(
+                    read_recs, watchdog.deadline_s(rb_est_s),
+                    site="tsr.resident")
+        except (watchdog.WatchdogTimeout, jobctl.JobAborted):
+            raise
+        except Exception as exc:
+            # a faulted FINAL readback abandons the round exactly like
+            # a mid-ladder segment fault
+            return self._resident_abandon(
+                exc, m, resume, checkpoint_cb, every_s,
+                ev_done, pr_done, tr_done, seg_launches)
+        nbytes = sum(a.nbytes for a in arrs)
+        RF.count_readback(nbytes)
+        self.stats["resident_readback_bytes"] = (
+            self.stats.get("resident_readback_bytes", 0) + nbytes)
+        results = RF.unpack_results(*arrs[:3], n_rec, minsup)
+        if n_def:
+            # over-ladder children the device deferred: filter against
+            # the FINAL exact top-k threshold — a deferred entry whose
+            # bound still clears it is real deep-side work the host
+            # path finishes (a handoff, not a spill: the in-ladder
+            # search completed on device).  On every eval config the
+            # filter kills them all and the round ends here.
+            RF.count_deferred(n_def)
+            self.stats["resident_deferred"] = (
+                self.stats.get("resident_deferred", 0) + n_def)
+            deep = RF.unpack_entries(*arrs[3:], 0, n_def, minsup)
+            if deep:
+                RF.count_handoff()
+                self.stats["resident_handoffs"] = (
+                    self.stats.get("resident_handoffs", 0) + 1)
+                obs.trace_event("resident_handoff", entries=len(deep),
+                                minsup=minsup)
+                resume = {
+                    "minsup": int(minsup),
+                    "stack": [[b, list(x), list(y), cr, side, psup,
+                               psupx]
+                              for b, x, y, cr, side, psup, psupx
+                              in deep],
+                    "results": [[list(x), list(y), sup, supx]
+                                for sup, supx, x, y in results],
+                }
+                return self._mine_host_restricted(
+                    m, resume=resume, checkpoint_cb=checkpoint_cb,
+                    every_s=every_s,
+                    count_resume=False, prep=(p1, s1))
+        return self._finish_round(m, results)
+
+    def _resident_abandon(self, exc, m: int, resume, checkpoint_cb,
+                          every_s: float, ev_done: int, pr_done: int,
+                          tr_done: int, seg_launches: int,
+                          ) -> Tuple[List[RuleResult], int]:
+        """Abandon a faulted resident round to the host path from its
+        ORIGINAL state: the frontier is never lost (roots/resume
+        regenerate it exactly) and the re-run recomputes with full
+        parity.  Recount, not new work — the abandoned segments'
+        evaluations, prunes, traffic AND launches leave the exported
+        dispatch-shape stats (the same contract as the kernel
+        readback-fault recount in consume()); the resident_* route
+        counters stay, with ``resident_fallbacks`` marking why."""
+        RF.count_fallback()
+        self.stats["resident_fallbacks"] = (
+            self.stats.get("resident_fallbacks", 0) + 1)
+        self.stats["resident_fallback"] = repr(exc)
+        self.stats["evaluated"] -= ev_done
+        self.stats["pruned_conf"] -= pr_done
+        self.stats["kernel_launches"] -= seg_launches
+        self.stats["traffic_units"] = (
+            self.stats.get("traffic_units", 0) - tr_done)
+        obs.trace_event("resident_fallback",
+                        error=f"{type(exc).__name__}: {exc}")
+        return self._mine_host_restricted(
+            m, resume=resume, checkpoint_cb=checkpoint_cb,
+            every_s=every_s)
+
+    def _resident_entries(self, carry, head: int, tail: int, n_rec: int,
+                          n_def: int, minsup: int):
+        """Read the device frontier + records + deferred children back
+        into host tuples (spill and snapshot share this one readback
+        path; deferred entries ride along — they are the same tuple
+        spelling, one item wider)."""
+        arrs = [np.asarray(carry[i]) for i in (0, 1, 2, 3, 4, 5, 8, 9, 10)]
+        darrs = ([np.asarray(carry[i]) for i in (19, 20, 21, 22, 23, 24)]
+                 if n_def else [])
+        nbytes = sum(a.nbytes for a in arrs + darrs)
+        RF.count_readback(nbytes)
+        self.stats["resident_readback_bytes"] = (
+            self.stats.get("resident_readback_bytes", 0) + nbytes)
+        entries = RF.unpack_entries(*arrs[:6], head, tail, minsup)
+        if n_def:
+            entries += RF.unpack_entries(*darrs, 0, n_def, minsup)
+        results = RF.unpack_results(*arrs[6:], n_rec, minsup)
+        return entries, results
+
+    def _resident_spill(self, m: int, carry, head: int, tail: int,
+                        n_rec: int, n_def: int, minsup: int, *,
+                        checkpoint_cb, every_s: float,
+                        prep=None) -> Tuple[List[RuleResult], int]:
+        """Overflow-to-host spill protocol: the intact device frontier
+        becomes the host loop's own resume state — entries are the same
+        sibling-chain tuples, so no candidate is lost or duplicated and
+        the round finishes with exact parity on the ragged-batch path."""
+        entries, results = self._resident_entries(carry, head, tail,
+                                                  n_rec, n_def, minsup)
+        RF.count_spill("capacity")
+        self.stats["resident_spills"] = (
+            self.stats.get("resident_spills", 0) + 1)
+        obs.trace_event("resident_spill", entries=len(entries),
+                        results=len(results), minsup=minsup)
+        resume = {
+            "minsup": int(minsup),
+            "stack": [[b, list(x), list(y), cr, side, psup, psupx]
+                      for b, x, y, cr, side, psup, psupx in entries],
+            "results": [[list(x), list(y), sup, supx]
+                        for sup, supx, x, y in results],
+        }
+        return self._mine_host_restricted(
+            m, resume=resume, checkpoint_cb=checkpoint_cb,
+            every_s=every_s, count_resume=False, prep=prep)
+
+    def _resident_snapshot(self, m: int, carry, head: int, tail: int,
+                           n_rec: int, n_def: int, minsup: int) -> dict:
+        """Segment-boundary frontier snapshot in the ONE checkpoint
+        format (``frontier_state``): a resident snapshot resumes on the
+        host path and vice versa — the kill-restart drill's contract."""
+        entries, results = self._resident_entries(carry, head, tail,
+                                                  n_rec, n_def, minsup)
+        queue = [(-b, x, y, cr, side, psup, psupx)
+                 for b, x, y, cr, side, psup, psupx in entries]
+        res = [(sup, supx, x, y) for sup, supx, x, y in results]
+        return self.frontier_state(queue, res, m, minsup)
+
+    def _finish_round(self, m: int, results: List[tuple],
+                      ) -> Tuple[List[RuleResult], int]:
+        """Exact end-of-round filter shared with the host loop: s_k =
+        k-th largest accepted support, results filtered to >= s_k,
+        local indices mapped to canonical global ids."""
+        sups = sorted((r[0] for r in results), reverse=True)
+        s_k = sups[self.k - 1] if len(sups) >= self.k else 1
+        ids = self.vdb.item_ids[self._order[:m]]
+        out = [
+            (tuple(sorted(int(ids[i]) for i in x)),
+             tuple(sorted(int(ids[i]) for i in y)), sup, supx)
+            for sup, supx, x, y in results if sup >= s_k
+        ]
+        return sort_rules(out), s_k
+
+    # ----------------------------------------------------- host route
+
+    def _mine_host_restricted(self, m: int, resume: Optional[dict] = None,
+                              checkpoint_cb=None, every_s: float = 30.0,
+                              count_resume: bool = True, prep=None,
+                              ) -> Tuple[List[RuleResult], int]:
+        """The classic host-driven round: best-first heap on host,
+        ragged super-batched eval dispatches on device.
+
+        ``count_resume=False``: the resume dict is an INTERNAL
+        continuation (a resident spill or deep handoff), not a
+        persisted checkpoint — ``resumed_nodes`` keeps whatever the
+        real resume (if any) recorded.
+
+        ``prep``: the resident round's live engine-layout preps.
+        Segment dispatches never donate them (resident_frontier only
+        donates the carry), so a spill/handoff continuation reuses
+        them instead of paying the round's scatter-build dispatch
+        again — jnp path only; the pallas route needs the folded
+        kernel layout ``_prep`` builds."""
         sup_it = self._sup_sorted[:m].astype(np.int64)
-        p1, s1 = self._prep(m)
+        if prep is not None and not self.use_pallas:
+            p1, s1 = prep
+        else:
+            p1, s1 = self._prep(m)
         ids = self.vdb.item_ids[self._order[:m]]
 
         results: List[Tuple[int, int, Tuple[int, ...], Tuple[int, ...]]] = []
@@ -1115,7 +1581,8 @@ class TsrTPU:
                       int(psup), int(psupx))
                      for b, x, y, cr, side, psup, psupx in resume["stack"]]
             heapq.heapify(queue)
-            self.stats["resumed_nodes"] = len(queue)
+            if count_resume:
+                self.stats["resumed_nodes"] = len(queue)
         else:
             # roots: one right-side chain per item i over partners j != i
             # (bound min(sup_i, sup_j) is nonincreasing in j) — m entries
@@ -1343,6 +1810,7 @@ class TsrCPU(TsrTPU):
 
     PIPELINE_DEPTH = 1  # dispatch is synchronous — nothing to overlap
     _RECORD_SHAPES = False  # host-only mines compile no device geometry
+    _RESIDENT_CAPABLE = False  # numpy evaluation: no device frontier
 
     def __init__(self, *args, **kwargs):
         # never the device kernel — and never probe the JAX backend

@@ -1,0 +1,636 @@
+"""Resident-frontier TSR: whole km-ladders expanded in ONE dispatch.
+
+BENCH_SCALE config 3d (unlimited ``max_side``, the service default)
+degrades into a host-driven expand/readback/re-plan loop at the deep
+TSR levels: every few thousand candidates the host blocks on a
+readback, re-heaps, re-plans and re-dispatches — 371 launches where the
+capped config 3 pays 41, and each launch is pure dispatch latency the
+ragged packer (ops/ragged_batch.py) cannot amortize because the NEXT
+level's candidates do not exist until the host has seen this level's
+supports.  The queue engine (models/spade_queue.py) already proved the
+cure for SPADE: keep the frontier in HBM and run the whole expansion
+inside a ``lax.while_loop``, reading back only survivors.  This module
+ports that architecture to TSR's best-first rule search:
+
+- **the frontier lives in HBM**: a FIFO ring of fixed-capacity entries
+  — packed (X, Y) item slots (``exy``, km-ladder capacity ``caps.km``
+  per side), the admission bound, the parent support, the EXACT
+  antecedent support ``psupx`` (the conf-bound prune input, PR 2), and
+  the chain flags.  Entries are the host engine's own sibling-chain
+  entries bit-for-bit, so a frontier SPILLS to the host path (and a
+  host checkpoint resumes on device) with no translation layer;
+- **each wave** pops ``nb`` entries, advances their sibling chains,
+  applies the pop-time conf-bound subtree prune, evaluates
+  (sup, supx) with the same masked AND-fold as the jnp evaluator,
+  appends accepted rules to a packed record buffer, maintains the
+  EXACT current top-k support threshold on device (a sorted ``topk``
+  buffer — the dynamically rising ``minsup`` no longer needs a host
+  round trip), and enqueues the left/right child chain heads at the
+  ring tail;
+- **wide-then-narrow**: the carry is wave-width-independent (PR 2's
+  late-wave trick), so the host switches to the narrow ``nb_late``
+  program when the live frontier drains below it — many underfilled
+  wide waves become well-filled narrow ones at zero extra dispatches;
+- **the km ladder ends in a DEFER buffer, not an abort**: a child that
+  needs an item slot past ``caps.km`` is real host work (an unlimited
+  side past the compiled ladder), but it is almost never LIVE work —
+  by round end the exact top-k threshold has risen past nearly every
+  deep candidate's bound.  So over-ladder children are appended to a
+  fixed-capacity defer buffer (``km + 1`` item slots — a deferred
+  child extends a full-ladder side by exactly one item) and the wave
+  continues; at round end the host filters the deferred entries
+  against the FINAL minsup and resumes the classic path only for the
+  survivors.  On every eval config that is zero entries — the round
+  completes entirely on device;
+- **capacity is a routing concern, never correctness**: every wave
+  pre-checks its ring/record/defer capacity and commits NOTHING on
+  overflow — the host reads the intact frontier back and continues on
+  the classic ragged-batch path (the overflow-to-host spill protocol).
+
+Parity argument (why the device search returns the host engine's exact
+rule set): the final set is {expansion-reachable rules with
+conf >= minconf and sup >= s_k}, which models/tsr.py already proves
+pop-order independent — acceptance uses exact (sup, supx), the
+end-of-round s_k filter is exact, and every prune (bound < minsup,
+conf-bound subtree) only discards candidates provably below the final
+threshold.  The device loop uses the SAME expansion scheme and only
+ever prunes against a minsup that is <= the true current k-th largest
+accepted support (the on-device top-k is exact), so it evaluates a
+possibly different sub-threshold candidate set but accepts the same
+final rules.  FIFO pop order (vs the host's best-first heap) only
+changes how fast minsup rises — wasted work at worst, never wrong.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_fsm_tpu.ops import ragged_batch as RB
+from spark_fsm_tpu.utils import obs, shapes
+
+# Exact on-device top-k capacity: the ``topk`` buffer is a static shape
+# shared by every compiled resident program (k itself is TRACED, so one
+# compile serves every request k <= K_PAD; a larger k routes host).
+K_PAD = 1024
+
+_SEGMENTS = obs.REGISTRY.counter(
+    "fsm_tsr_resident_segments_total",
+    "resident-frontier segment dispatches (one compiled while_loop run)")
+_WAVES = obs.REGISTRY.counter(
+    "fsm_tsr_resident_waves_total",
+    "frontier waves executed on device inside resident segments")
+_SPILLS = obs.REGISTRY.counter(
+    "fsm_tsr_resident_spills_total",
+    "resident frontiers spilled back to the host path (capacity overflow)")
+_DEFERRED = obs.REGISTRY.counter(
+    "fsm_tsr_resident_deferred_total",
+    "over-km-ladder children deferred to the host's end-of-round filter")
+_HANDOFFS = obs.REGISTRY.counter(
+    "fsm_tsr_resident_handoffs_total",
+    "rounds whose surviving deferred entries resumed the host path")
+_FALLBACKS = obs.REGISTRY.counter(
+    "fsm_tsr_resident_fallbacks_total",
+    "resident rounds abandoned to the host path after a dispatch fault")
+_READBACK = obs.REGISTRY.counter(
+    "fsm_tsr_resident_readback_bytes_total",
+    "bytes read back from resident device state (records + spills)")
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidentCaps:
+    """Static capacities of the resident program (compile-time shapes).
+
+    ``nb``: frontier entries popped per wave; ``nb_late`` the narrow
+    late-wave width.  ``ring``: live-frontier capacity (FIFO slot
+    reuse, so it bounds ``tail - head``, not the mine's node count).
+    ``r_cap``: accepted-rule records for the whole round (append-only;
+    the host filters to the final s_k).  ``km``: per-side item-slot
+    capacity — the km-ladder depth expanded on device (sides past 4
+    are unobserved in every eval config, same reasoning as
+    ragged_batch.KM_LADDER); children past the ladder land in the
+    DEFER buffer (``d_cap`` entries of ``km + 1`` slots) for the
+    host's end-of-round filter instead of aborting the round.
+    ``i_max``: host-side total-wave runaway guard."""
+
+    nb: int = 512
+    ring: int = 16384
+    r_cap: int = 32768
+    km: int = 4
+    d_cap: int = 4096
+    i_max: int = 1 << 20
+
+    @property
+    def nb_late(self) -> int:
+        return RB.late_wave_nb(self.nb, 32)
+
+
+def working_set_bytes(caps: ResidentCaps, row_bytes: int, m: int) -> int:
+    """Per-device working set of the resident program — shared by
+    :func:`caps_for` (sizing) and the engine's eligibility check so the
+    two cannot disagree.  Counts the prep pair, the carry-doubled ring
+    and record state (a ``while_loop`` carry cannot alias its input on
+    the first iteration), and ~6 live [nb, S, W] eval intermediates
+    (the masked fold's gather/AND chain)."""
+    entry = 2 * caps.km * 4 + 3 * 4 + 2 + 4     # exy + int32x3 + flags
+    rec = 2 * caps.km * 4 + 2 * 4               # rec_xy + sup/supx
+    defer = 2 * (caps.km + 1) * 4 + 3 * 4 + 2 + 4
+    return (2 * m * row_bytes                   # p1/s1 preps
+            + 2 * (caps.ring * entry + caps.r_cap * rec
+                   + caps.d_cap * defer + K_PAD * 4)
+            + 6 * caps.nb * row_bytes)          # wave eval temps
+
+
+def caps_for(n_seq: int, n_words: int, m: int,
+             budget: int) -> Optional[ResidentCaps]:
+    """Capacity model: the largest pow2 ring (and a budget-clamped wave
+    width) whose working set fits the engine's eval budget; None when
+    even the smallest geometry does not fit (the round routes host).
+    Deterministic in (n_seq, n_words, m, budget), so the prewarm
+    enumerator derives the same caps the engine will construct."""
+    row = max(1, n_seq * max(1, n_words) * 4)
+    nb = min(512, max(64, RB.floor_pow2(max(1, budget // (8 * row)))))
+    # FIFO breadth-first residency needs headroom the host's best-first
+    # heap does not: until the top-k threshold starts biting, every
+    # popped entry can push up to three chain heads, so the live
+    # frontier peaks at roughly a BFS level width.  Start the search at
+    # 64k entries (~13 MB of ring state) and shrink to fit the budget.
+    ring = 65536
+    while ring >= 2048:
+        caps = ResidentCaps(nb=nb, ring=ring, r_cap=2 * ring,
+                            d_cap=max(1024, ring // 8))
+        if working_set_bytes(caps, row, m) <= budget:
+            return caps
+        ring //= 2
+    return None
+
+
+def resident_keys(n_seq: int, n_words: int, m: int,
+                  caps: ResidentCaps) -> List[str]:
+    """The shape keys the resident round can compile: the wide program
+    and (when distinct) the narrow late-wave program."""
+    out = [shapes.key_tsr_resident(n_seq, n_words, m, caps.km, caps.nb,
+                                   caps.ring)]
+    if caps.nb_late < caps.nb:
+        out.append(shapes.key_tsr_resident(n_seq, n_words, m, caps.km,
+                                           caps.nb_late, caps.ring))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side frontier packing (entries <-> device carry)
+# ---------------------------------------------------------------------------
+# Entry tuples use the host engine's queue spelling:
+#   (bound, x, y, can_right, side, psup, psupx)
+# — the checkpoint "stack" rows of models/tsr.frontier_state with the
+# bound kept positive.  One spelling for roots, resumes and spills.
+
+
+def root_entries(sup_l: Sequence[int], minsup: int, num: int, den: int,
+                 max_side: Optional[int]) -> List[tuple]:
+    """The round's root chain heads — the device twin of the host
+    loop's root ``chain_push`` calls (one side-1 chain per item i over
+    partners j != i; items are support-sorted so the first admissible
+    partner is index 0, or 1 for item 0)."""
+    m = len(sup_l)
+    out = []
+    for i in range(m):
+        c = 1 if i == 0 else 0
+        if c >= m:
+            continue
+        b = min(sup_l[i], sup_l[c])
+        if b < minsup:
+            continue
+        if (max_side is not None and 1 >= max_side and sup_l[i] > 0
+                and b * den < sup_l[i] * num):
+            continue  # chain_push's side-1 conf kill at max_side=1
+        out.append((b, (i,), (c,), True, 1, sup_l[i], sup_l[i]))
+    return out
+
+
+def pack_state(entries: Sequence[tuple],
+               results: Sequence[tuple],
+               caps: ResidentCaps) -> Optional[dict]:
+    """Numpy arrays for a fresh device carry, or None when the frontier
+    does not fit the caps (the round then routes host: entry count past
+    the ring or defer buffer, a side past the defer width, or too many
+    kept results).  Entries whose sides fit the km ladder land in the
+    ring; one-past-the-ladder entries (a resumed snapshot that already
+    deferred them) land straight in the defer buffer."""
+    ring, km, r_cap = caps.ring, caps.km, caps.r_cap
+    if len(results) > r_cap:
+        return None
+    fit = [e for e in entries if len(e[1]) <= km and len(e[2]) <= km]
+    over = [e for e in entries if len(e[1]) > km or len(e[2]) > km]
+    if len(fit) > ring or len(over) > caps.d_cap:
+        return None
+    exy = np.full((ring, 2, km), -1, np.int32)
+    bound = np.zeros(ring, np.int32)
+    psup = np.zeros(ring, np.int32)
+    psupx = np.zeros(ring, np.int32)
+    cr = np.zeros(ring, bool)
+    side = np.zeros(ring, np.int32)
+    for q, (b, x, y, crq, sd, ps, px) in enumerate(fit):
+        exy[q, 0, :len(x)] = x
+        exy[q, 1, :len(y)] = y
+        bound[q] = b
+        psup[q] = ps
+        psupx[q] = px
+        cr[q] = bool(crq)
+        side[q] = sd
+    dxy = np.full((caps.d_cap, 2, km + 1), -1, np.int32)
+    dbound = np.zeros(caps.d_cap, np.int32)
+    dpsup = np.zeros(caps.d_cap, np.int32)
+    dpsupx = np.zeros(caps.d_cap, np.int32)
+    dcr = np.zeros(caps.d_cap, bool)
+    dside = np.zeros(caps.d_cap, np.int32)
+    for q, (b, x, y, crq, sd, ps, px) in enumerate(over):
+        if len(x) > km + 1 or len(y) > km + 1:
+            return None
+        dxy[q, 0, :len(x)] = x
+        dxy[q, 1, :len(y)] = y
+        dbound[q] = b
+        dpsup[q] = ps
+        dpsupx[q] = px
+        dcr[q] = bool(crq)
+        dside[q] = sd
+    rec_xy = np.full((r_cap, 2, km), -1, np.int32)
+    rec_sup = np.zeros(r_cap, np.int32)
+    rec_supx = np.zeros(r_cap, np.int32)
+    for r, (sup, supx, x, y) in enumerate(results):
+        if len(x) > km or len(y) > km:
+            return None
+        rec_xy[r, 0, :len(x)] = x
+        rec_xy[r, 1, :len(y)] = y
+        rec_sup[r] = sup
+        rec_supx[r] = supx
+    topk = np.zeros(K_PAD, np.int32)
+    sups = sorted((int(r[0]) for r in results), reverse=True)[:K_PAD]
+    topk[:len(sups)] = sups
+    return {"exy": exy, "bound": bound, "psup": psup, "psupx": psupx,
+            "cr": cr, "side": side, "rec_xy": rec_xy, "rec_sup": rec_sup,
+            "rec_supx": rec_supx, "n_entries": len(fit),
+            "n_results": len(results), "topk": topk,
+            "dxy": dxy, "dbound": dbound, "dpsup": dpsup,
+            "dpsupx": dpsupx, "dcr": dcr, "dside": dside,
+            "n_defer": len(over)}
+
+
+def unpack_entries(exy: np.ndarray, bound: np.ndarray, psup: np.ndarray,
+                   psupx: np.ndarray, cr: np.ndarray, side: np.ndarray,
+                   head: int, tail: int, minsup: int) -> List[tuple]:
+    """Live ring entries back into host queue tuples (the spill path and
+    the checkpoint snapshot).  Bound-dead entries (< minsup) are dropped
+    exactly like ``frontier_state`` drops them — pop would discard
+    them anyway."""
+    ring = exy.shape[0]
+    out = []
+    for qid in range(int(head), int(tail)):
+        r = qid % ring
+        b = int(bound[r])
+        if b < minsup:
+            continue
+        x = tuple(int(v) for v in exy[r, 0] if v >= 0)
+        y = tuple(int(v) for v in exy[r, 1] if v >= 0)
+        out.append((b, x, y, bool(cr[r]), int(side[r]), int(psup[r]),
+                    int(psupx[r])))
+    return out
+
+
+def unpack_results(rec_xy: np.ndarray, rec_sup: np.ndarray,
+                   rec_supx: np.ndarray, n_rec: int,
+                   minsup: int) -> List[tuple]:
+    """Accepted records back into (sup, supx, x, y) tuples, filtered to
+    the current minsup — the host engine's progressive results filter,
+    applied once at readback instead of per threshold rise."""
+    out = []
+    for r in range(int(n_rec)):
+        sup = int(rec_sup[r])
+        if sup < minsup:
+            continue
+        x = tuple(int(v) for v in rec_xy[r, 0] if v >= 0)
+        y = tuple(int(v) for v in rec_xy[r, 1] if v >= 0)
+        out.append((sup, int(rec_supx[r]), x, y))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The compiled segment program
+# ---------------------------------------------------------------------------
+
+# Carry layout (width-independent — the wide and narrow programs
+# interchange mid-round, PR 2's late-wave contract):
+#   0 exy       [ring, 2, km] int32   packed X/Y item slots (-1 pad)
+#   1 bound     [ring] int32          admission bound (min over chain)
+#   2 psup      [ring] int32          parent's exact support
+#   3 psupx     [ring] int32          exact antecedent support (side-1)
+#   4 cr        [ring] bool           can_right flag
+#   5 side      [ring] int32          0 = grow-X chain, 1 = grow-Y
+#   6 head      int32                 FIFO head (monotonic qid)
+#   7 tail      int32                 FIFO tail
+#   8 rec_xy    [r_cap, 2, km] int32  accepted-rule slots
+#   9 rec_sup   [r_cap] int32
+#  10 rec_supx  [r_cap] int32
+#  11 rec_count int32
+#  12 topk      [K_PAD] int32         desc-sorted accepted supports
+#  13 n_acc     int32                 accepted rules ever (threshold arm)
+#  14 minsup    int32                 current exact top-k threshold
+#  15 overflow  bool                  capacity spill flag (wave atomic)
+#  16 waves     int32
+#  17 evaluated int32
+#  18 pruned    int32                 conf-bound subtree prunes
+#  19 dxy       [d_cap, 2, km+1]      deferred over-ladder children
+#  20 dbound    [d_cap] int32
+#  21 dpsup     [d_cap] int32
+#  22 dpsupx    [d_cap] int32
+#  23 dcr       [d_cap] bool
+#  24 dside     [d_cap] int32
+#  25 d_count   int32                 deferred entries so far
+N_CARRY = 26
+
+
+@functools.lru_cache(maxsize=32)
+def _resident_fn(nb: int, km: int):
+    """Compiled resident segment: run at most ``wave_budget`` waves (a
+    TRACED argument — one compile serves every segment size) of the
+    frontier expansion at wave width ``nb``.  jax.jit caches per input
+    shape on top of this, so (m, n_seq, n_words, ring, r_cap) are
+    implicit compile keys — exactly the axes of ``key_tsr_resident``.
+    The carry is DONATED: unlike the queue engine, no element aliases
+    engine-persistent state (the prep pair rides outside the carry), so
+    even the first segment donates and the ring never doubles."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_fsm_tpu.ops import bitops_jax as B
+
+    FULL = jnp.uint32(0xFFFFFFFF)
+
+    def run(p1, s1, sup_items, num, den, k, max_side_t, wave_end, *carry):
+        m = p1.shape[0]
+        ring = carry[0].shape[0]
+        r_cap = carry[8].shape[0]
+        d_cap = carry[19].shape[0]
+        i32 = jnp.int32
+        lane = jnp.arange(nb, dtype=i32)
+        item = jnp.arange(m, dtype=i32)
+        pos = jnp.arange(km, dtype=i32)
+
+        def fold(t, idx):
+            acc = None
+            for j in range(km):
+                i = idx[:, j]
+                g = jnp.where((i >= 0)[:, None, None],
+                              t[jnp.maximum(i, 0)], FULL)
+                acc = g if acc is None else acc & g
+            return acc
+
+        def body(c):
+            (exy, bound, psup, psupx, cr, side, head, tail, rec_xy,
+             rec_sup, rec_supx, rec_count, topk, n_acc, minsup, overflow,
+             waves, evaluated, pruned,
+             dxy, dbound, dpsup, dpsupx, dcr, dside, d_count) = c
+
+            qid = head + lane
+            active = qid < tail
+            ridx = jnp.where(active, qid % ring, 0)
+            ex = exy[ridx]                        # [nb, 2, km]
+            b = jnp.where(active, bound[ridx], -1)
+            ps = psup[ridx]
+            px = psupx[ridx]
+            crl = cr[ridx]
+            sd = side[ridx]
+            live = active & (b >= minsup)   # bound-dead lanes drop whole,
+            # like the host's pop_batch queue.clear() at a risen minsup
+
+            xs, ys = ex[:, 0, :], ex[:, 1, :]
+            nx = jnp.sum(xs >= 0, axis=1).astype(i32)
+            ny = jnp.sum(ys >= 0, axis=1).astype(i32)
+            # chain items are appended in ascending order, so the last
+            # valid slot is the side's max item
+            maxx = jnp.take_along_axis(
+                xs, jnp.maximum(nx - 1, 0)[:, None], axis=1)[:, 0]
+            maxy = jnp.where(ny > 0, jnp.take_along_axis(
+                ys, jnp.maximum(ny - 1, 0)[:, None], axis=1)[:, 0], -1)
+            free = ~jnp.any(
+                ex[:, :, :, None] == item[None, None, None, :],
+                axis=(1, 2))                      # [nb, m] not-in-rule
+
+            # ---- sibling advance (before eval, the host pop order) ----
+            lastv = jnp.where(sd == 0, maxx, maxy)
+            sib_adm = free & (item[None, :] > lastv[:, None])
+            has_sib = jnp.any(sib_adm, axis=1)
+            sib_c = jnp.argmax(sib_adm, axis=1).astype(i32)
+            sib_b = jnp.minimum(ps, sup_items[sib_c])
+            sib_kill = ((sd == 1) & (px > 0) & (sib_b * den < px * num)
+                        & (nx >= max_side_t))
+            push_sib = live & has_sib & (sib_b >= minsup) & ~sib_kill
+            slot_j = jnp.maximum(jnp.where(sd == 0, nx, ny) - 1, 0)
+            repl = pos[None, :] == slot_j[:, None]
+            sib_x = jnp.where(((sd == 0)[:, None]) & repl,
+                              sib_c[:, None], xs)
+            sib_y = jnp.where(((sd == 1)[:, None]) & repl,
+                              sib_c[:, None], ys)
+            sib_ex = jnp.stack([sib_x, sib_y], axis=1)
+
+            # ---- pop-time conf-bound subtree prune (exact host test:
+            # side-1, psupx known, bound below the conf floor, and the
+            # antecedent can never grow again) ----
+            lv_adm = (free & (item[None, :] > maxx[:, None])
+                      & (sup_items[None, :] >= minsup))
+            left_viable = (nx < max_side_t) & jnp.any(lv_adm, axis=1)
+            confdead = (live & (sd == 1) & (px > 0)
+                        & (b * den < px * num) & ~left_viable)
+            ev = live & ~confdead
+
+            # ---- evaluate: the jnp evaluator's masked AND-fold ----
+            a = fold(p1, xs)
+            cm = fold(s1, ys)
+            sup = jnp.where(ev, B.support(B.shift_up_one(a) & cm), 0)
+            supx = jnp.where(ev, B.support(a), 0)
+
+            acc_ok = (ev & (sup >= minsup) & (supx > 0)
+                      & (sup * den >= supx * num))
+            n_new = jnp.sum(acc_ok, dtype=i32)
+
+            # ---- exact on-device top-k threshold ----
+            merged = -jnp.sort(-jnp.concatenate(
+                [topk, jnp.where(acc_ok, sup, 0)]))[:K_PAD]
+            n_acc2 = n_acc + n_new
+            thresh = jnp.take(merged, jnp.maximum(k - 1, 0))
+            minsup2 = jnp.maximum(
+                minsup, jnp.where(n_acc2 >= k, thresh, 1))
+
+            # ---- children: left/right chain heads (host consume()) ----
+            expand = ev & (sup >= minsup)
+            l_adm = free & (item[None, :] > maxx[:, None])
+            l_has = jnp.any(l_adm, axis=1)
+            l_c = jnp.argmax(l_adm, axis=1).astype(i32)
+            l_b = jnp.minimum(sup, sup_items[l_c])
+            push_l = (expand & (nx < max_side_t) & l_has
+                      & (l_b >= minsup2))
+            r_adm = free & (item[None, :] > maxy[:, None])
+            r_has = jnp.any(r_adm, axis=1)
+            r_c = jnp.argmax(r_adm, axis=1).astype(i32)
+            r_b = jnp.minimum(sup, sup_items[r_c])
+            r_kill = ((supx > 0) & (r_b * den < supx * num)
+                      & (nx >= max_side_t))
+            push_r = (expand & crl & (ny < max_side_t) & r_has
+                      & (r_b >= minsup2) & ~r_kill)
+            # km-ladder end: a child that needs a slot past km is real
+            # host work (an unlimited side past the compiled ladder) —
+            # but almost never LIVE work, so it lands in the DEFER
+            # buffer for the host's end-of-round filter instead of
+            # aborting the round.  Ring entries hold at most km items
+            # per side, so a deferring side is exactly full (n == km).
+            defer_l = push_l & (nx >= km)
+            defer_r = push_r & (ny >= km)
+            push_l = push_l & (nx < km)
+            push_r = push_r & (ny < km)
+            l_ex = jnp.stack([jnp.where(
+                pos[None, :] == jnp.minimum(nx, km - 1)[:, None],
+                l_c[:, None], xs), ys], axis=1)
+            r_ex = jnp.stack([xs, jnp.where(
+                pos[None, :] == jnp.minimum(ny, km - 1)[:, None],
+                r_c[:, None], ys)], axis=1)
+
+            # ---- capacity pre-check: commit nothing on overflow ----
+            pushes = jnp.concatenate([push_sib, push_l, push_r])
+            n_push = jnp.sum(pushes, dtype=i32)
+            defers = jnp.concatenate([defer_l, defer_r])
+            n_defer = jnp.sum(defers, dtype=i32)
+            new_head = jnp.minimum(head + nb, tail)
+            new_tail = tail + n_push
+            ovf = ((new_tail - new_head > ring)
+                   | (rec_count + n_new > r_cap)
+                   | (d_count + n_defer > d_cap))
+
+            # ---- records ----
+            rpos = rec_count + jnp.cumsum(acc_ok.astype(i32)) - 1
+            rw = jnp.where(acc_ok & ~ovf, rpos, r_cap)
+            rec_xy = rec_xy.at[rw].set(ex, mode="drop")
+            rec_sup = rec_sup.at[rw].set(sup, mode="drop")
+            rec_supx = rec_supx.at[rw].set(supx, mode="drop")
+
+            # ---- defer over-ladder children (km + 1 item slots: the
+            # deferring side is exactly full, so the new item lands in
+            # the one extra slot) ----
+            ncol = jnp.full((nb, 1), -1, i32)
+            dl_ex = jnp.stack([
+                jnp.concatenate([xs, l_c[:, None]], axis=1),
+                jnp.concatenate([ys, ncol], axis=1)], axis=1)
+            dr_ex = jnp.stack([
+                jnp.concatenate([xs, ncol], axis=1),
+                jnp.concatenate([ys, r_c[:, None]], axis=1)], axis=1)
+            dpos = d_count + jnp.cumsum(defers.astype(i32)) - 1
+            dw = jnp.where(defers & ~ovf, dpos, d_cap)
+            dxy = dxy.at[dw].set(
+                jnp.concatenate([dl_ex, dr_ex]), mode="drop")
+            dbound = dbound.at[dw].set(
+                jnp.concatenate([l_b, r_b]), mode="drop")
+            dpsup = dpsup.at[dw].set(
+                jnp.concatenate([sup, sup]), mode="drop")
+            dpsupx = dpsupx.at[dw].set(
+                jnp.concatenate([jnp.zeros(nb, i32), supx]), mode="drop")
+            dcr = dcr.at[dw].set(
+                jnp.concatenate([jnp.zeros(nb, bool),
+                                 jnp.ones(nb, bool)]), mode="drop")
+            dside = dside.at[dw].set(
+                jnp.concatenate([jnp.zeros(nb, i32),
+                                 jnp.ones(nb, i32)]), mode="drop")
+
+            # ---- enqueue at the ring tail (slots of entries popped
+            # THIS wave may be reused — reads precede writes in
+            # dataflow order; new_tail - new_head <= ring guarantees no
+            # still-live slot is overwritten) ----
+            all_ex = jnp.concatenate([sib_ex, l_ex, r_ex])
+            all_b = jnp.concatenate([sib_b, l_b, r_b])
+            all_ps = jnp.concatenate([ps, sup, sup])
+            zero = jnp.zeros(nb, i32)
+            all_px = jnp.concatenate(
+                [jnp.where(sd == 1, px, 0), zero, supx])
+            all_cr = jnp.concatenate(
+                [crl, jnp.zeros(nb, bool), jnp.ones(nb, bool)])
+            all_sd = jnp.concatenate([sd, zero, jnp.ones(nb, i32)])
+            qpos = tail + jnp.cumsum(pushes.astype(i32)) - 1
+            qr = jnp.where(pushes & ~ovf, qpos % ring, ring)
+            exy = exy.at[qr].set(all_ex, mode="drop")
+            bound = bound.at[qr].set(all_b, mode="drop")
+            psup = psup.at[qr].set(all_ps, mode="drop")
+            psupx = psupx.at[qr].set(all_px, mode="drop")
+            cr = cr.at[qr].set(all_cr, mode="drop")
+            side = side.at[qr].set(all_sd, mode="drop")
+
+            keep = lambda old, new: jnp.where(ovf, old, new)
+            return (exy, bound, psup, psupx, cr, side,
+                    keep(head, new_head), keep(tail, new_tail),
+                    rec_xy, rec_sup, rec_supx,
+                    keep(rec_count, rec_count + n_new),
+                    jnp.where(ovf, topk, merged),
+                    keep(n_acc, n_acc2), keep(minsup, minsup2),
+                    overflow | ovf, waves + keep(0, 1),
+                    evaluated + keep(0, jnp.sum(ev, dtype=i32)),
+                    pruned + keep(0, jnp.sum(confdead, dtype=i32)),
+                    dxy, dbound, dpsup, dpsupx, dcr, dside,
+                    keep(d_count, d_count + n_defer))
+
+        def cond(c):
+            head, tail, overflow, waves = c[6], c[7], c[15], c[16]
+            return (tail > head) & (~overflow) & (waves < wave_end)
+
+        out = jax.lax.while_loop(cond, body, carry)
+        counters = jnp.stack([
+            out[11],                               # rec_count
+            out[15].astype(jnp.int32),             # overflow
+            out[16],                               # waves
+            out[6],                                # head
+            out[7],                                # tail
+            out[14],                               # minsup
+            out[17],                               # evaluated
+            out[18],                               # pruned
+            out[13],                               # n_acc
+            out[25],                               # d_count
+        ])
+        return out, counters
+
+    # CPU JAX ignores donation and warns about it; only donate where
+    # the backend can actually alias (the HBM win the donation is for)
+    donate = (tuple(range(8, 8 + N_CARRY))
+              if jax.default_backend() != "cpu" else ())
+    return jax.jit(run, donate_argnums=donate)
+
+
+def segment_fn(caps: ResidentCaps, narrow: bool):
+    """The compiled segment program at the wide or narrow wave width."""
+    return _resident_fn(caps.nb_late if narrow else caps.nb, caps.km)
+
+
+def count_segment(waves: int, nbw: int, km: int) -> None:
+    _SEGMENTS.inc()
+    if waves:
+        _WAVES.inc(waves)
+
+
+def count_spill(reason: str) -> None:
+    _SPILLS.inc(reason=reason)
+
+
+def count_deferred(n: int) -> None:
+    if n > 0:
+        _DEFERRED.inc(n)
+
+
+def count_handoff() -> None:
+    _HANDOFFS.inc()
+
+
+def count_fallback() -> None:
+    _FALLBACKS.inc()
+
+
+def count_readback(nbytes: int) -> None:
+    if nbytes > 0:
+        _READBACK.inc(nbytes)

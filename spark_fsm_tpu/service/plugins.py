@@ -181,6 +181,16 @@ def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
     up = (req.param("use_pallas") or "").lower()
     if up and up != "auto":
         kwargs["use_pallas"] = up not in ("0", "false", "no", "off")
+    # resident: "auto" (default, the planner's launch-bound heuristic) /
+    # "always" (pin the resident-frontier route where structurally
+    # eligible — chaos drills and benches) / "never" (pin the classic
+    # host loop).  Folded into the devcache key via kwargs like every
+    # other engine knob.
+    rp = (req.param("resident") or "").lower()
+    if rp and rp != "auto":
+        kwargs["resident"] = ("always" if rp in ("always", "1", "true",
+                                                 "yes", "on")
+                              else "never")
     if req.task == "stream":  # see _spade_tpu: bucket drifting windows
         kwargs["shape_buckets"] = True
     if checkpoint is None and req.task != "stream":

@@ -286,6 +286,38 @@ def _warm_tsr(t: dict, mesh) -> None:
                 eng.n_seq, eng.n_words, m_pad, km, width))
 
 
+def _warm_resident(t: dict, mesh) -> None:
+    """Compile one resident-frontier segment program (wide or narrow —
+    one enumerated key per wave width, ops/resident_frontier.py) at the
+    declared geometry: a zero-entry carry with wave budget 0 never runs
+    a wave, so the dispatch is the while_loop compile plus microseconds
+    of cond evaluation.  The resident route is single-device by
+    construction (the enumerator emits the ladder only for mesh=None),
+    so no shard_map variant exists to warm."""
+    import jax.numpy as jnp
+
+    from spark_fsm_tpu.ops import resident_frontier as RF
+
+    S, W, m = t["n_seq_pad"], t["n_words"], t["m"]
+    ring, r_cap, d_cap = t["ring"], t["r_cap"], t["d_cap"]
+    km, nb = t["km"], t["nb"]
+    z = lambda *shape, dt=jnp.int32: jnp.zeros(shape, dt)
+    i32 = jnp.int32
+    carry = (jnp.full((ring, 2, km), -1, i32), z(ring), z(ring),
+             z(ring), z(ring, dt=jnp.bool_), z(ring),
+             i32(0), i32(0),
+             jnp.full((r_cap, 2, km), -1, i32), z(r_cap), z(r_cap),
+             i32(0), z(RF.K_PAD), i32(0), i32(1), jnp.bool_(False),
+             i32(0), i32(0), i32(0),
+             jnp.full((d_cap, 2, km + 1), -1, i32), z(d_cap),
+             z(d_cap), z(d_cap), z(d_cap, dt=jnp.bool_), z(d_cap),
+             i32(0))
+    RF._resident_fn(nb, km)(
+        z(m, S, W, dt=jnp.uint32), z(m, S, W, dt=jnp.uint32), z(m),
+        i32(1), i32(2), i32(1), i32(1 << 30), i32(0), *carry)
+    shapes.record(shapes.key_tsr_resident(S, W, m, km, nb, ring))
+
+
 def _warm_sweep(t: dict, mesh) -> None:
     """Compile the incremental sweep chain at one enumerated row bucket:
     rebuild a live batch's store at that bucket, then dispatch the
@@ -469,6 +501,8 @@ def _run_keys(targets, mesh, eng_sub) -> List[dict]:
                     pass  # warmed by the "tsr" entry's ladder walk; the
                     # separate key exists so /admin/shapes drift can name
                     # the exact launch geometry a live mine would compile
+                elif t["kind"] == "tsr_resident":
+                    _warm_resident(t, mesh)
                 elif t["kind"] == "sweep":
                     _warm_sweep(t, mesh)
             except Exception as exc:  # a failed warm must not take down

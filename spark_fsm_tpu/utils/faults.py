@@ -78,6 +78,10 @@ KNOWN_SITES = (
     "fusion.dispatch",   # cross-job fusion broker launch (service/fusion) —
                          # injection must DEGRADE to unfused per-job
                          # dispatch, never lose a wave
+    "device.resident",   # resident-frontier segment dispatch/readback
+                         # (models/tsr._mine_resident) — injection must
+                         # fall back to the host-driven path with full
+                         # parity, never lose the frontier
 )
 
 _EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}

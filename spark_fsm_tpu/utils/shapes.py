@@ -51,6 +51,14 @@ Key formats (the geometry axes that decide compiled shapes):
                                             axis = concat of the fused
                                             jobs' prep stores padded to
                                             the pow2 bucket M
+  ``tsr-resident:s{S}w{W}m{M}km{K}nb{NB}r{RING}``
+                                            ops/resident_frontier.py
+                                            whole-ladder resident
+                                            program — one key per wave
+                                            width (wide + late-wave
+                                            narrow), ring/record caps
+                                            derived from the eval
+                                            budget by caps_for
   ``sweep:s{S}w{W}r{R}i{NI}``               streaming/incremental.py
                                             batch-store geometry (the
                                             config-5 mid-stream compile)
@@ -111,6 +119,17 @@ def key_tsr_fused(n_seq: int, n_words: int, m_pad: int, km: int,
     and prewarm walks, keeping the zero-fresh-compile guarantee across
     fusion."""
     return f"tsr-fused:s{n_seq}w{n_words}m{m_pad}km{km}c{width}"
+
+
+def key_tsr_resident(n_seq: int, n_words: int, m: int, km: int, nb: int,
+                     ring: int) -> str:
+    """One resident-frontier program geometry (ops/resident_frontier.py):
+    the whole-km-ladder ``lax.while_loop`` compiled per (prep item rows
+    m, km-ladder depth, wave width, ring capacity).  The engine records
+    the wide key at resident-round start and the narrow key when the
+    late-wave switch first compiles it; record/topk caps derive from
+    (ring, K_PAD) so they add no axis."""
+    return f"tsr-resident:s{n_seq}w{n_words}m{m}km{km}nb{nb}r{ring}"
 
 
 def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
@@ -278,6 +297,43 @@ def enumerate_shapes(spec: WorkloadSpec, *, mesh=None,
                 # warmed by the single "tsr" entry's ladder walk
                 add(key_tsr_eval(tg["n_seq"], nw, km, width),
                     kind="tsr_eval", km=km, width=width)
+            if mesh is None:
+                # resident-frontier ladder (ops/resident_frontier.py):
+                # the planner routes deep (unlimited-max_side) mines to
+                # the whole-ladder while_loop program on single-device
+                # engines; caps derive from the SAME eval budget the
+                # engine's eligibility check probes, so enumeration and
+                # construction cannot disagree on the compiled shapes.
+                # The m axis walks the ITERATIVE-DEEPENING ladder the
+                # engine's mine() walks (item_cap doubling to n_items):
+                # every round that still fits the caps compiles its own
+                # resident program, and the ladder self-terminates where
+                # caps_for returns None — exactly where the engine's
+                # round routes host instead.
+                from spark_fsm_tpu.models._common import device_hbm_budget
+                from spark_fsm_tpu.ops import resident_frontier as RF
+
+                budget = device_hbm_budget(jax.devices()[0])
+                m_res = min(int(ekw.get("item_cap")
+                                or tsr.ITEM_CAP_DEFAULT), ni)
+                while True:
+                    caps = RF.caps_for(tg["n_seq"], nw, m_res, budget)
+                    if caps is None:
+                        break
+                    widths = [caps.nb] + ([caps.nb_late]
+                                          if caps.nb_late < caps.nb
+                                          else [])
+                    for nb in widths:
+                        add(key_tsr_resident(tg["n_seq"], nw, m_res,
+                                             caps.km, nb, caps.ring),
+                            kind="tsr_resident", n_sequences=ns,
+                            n_items=ni, n_words=nw, m=m_res, nb=nb,
+                            ring=caps.ring, km=caps.km,
+                            r_cap=caps.r_cap, d_cap=caps.d_cap,
+                            n_seq_pad=tg["n_seq"])
+                    if m_res >= ni:
+                        break
+                    m_res = min(m_res * 2, ni)
             if spec.fusion_jobs >= 2 and not use_pallas and mesh is None:
                 # cross-job fused ladder (service/fusion.py): groups of
                 # 2..fusion_jobs first-round prep stores concatenated

@@ -831,3 +831,93 @@ def test_admin_faults_gated_and_health_reports_subsystems():
         cfgmod.set_config(cfg0)
         srv.master.shutdown()
         srv.shutdown()
+
+
+# ---------------------------------------------------------- device.resident
+
+
+def _resident_db():
+    return synthetic_db(seed=29, n_sequences=90, n_items=9,
+                        mean_itemsets=3.0, mean_itemset_size=1.2)
+
+
+@covers("device.resident")
+def test_resident_segment_fault_falls_back_to_host_with_parity():
+    """A dispatch fault mid-km-ladder abandons the resident round to
+    the classic host-driven path from its ORIGINAL state: the frontier
+    regenerates exactly (roots or resume), nothing is lost, the rule
+    set matches the fault-free run, and the fallback is counted."""
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    db = _resident_db()
+    want = mine_tsr_tpu(db, 20, 0.4, max_side=None, resident="never")
+    s = {}
+    with faults.injected("device.resident", nth=1, match="segment"):
+        got = _bounded(lambda: mine_tsr_tpu(
+            db, 20, 0.4, max_side=None, resident="always", stats_out=s))
+    assert rules_text(got) == rules_text(want)
+    assert s.get("resident_fallbacks", 0) == 1, s
+    assert faults.counters()["device.resident"]["injected"] >= 1
+
+
+@covers("device.resident")
+def test_resident_records_readback_fault_falls_back_with_parity():
+    """Same contract at the FINAL records readback: the round falls
+    back to the host path instead of failing the job upward."""
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    db = _resident_db()
+    want = mine_tsr_tpu(db, 20, 0.4, max_side=None, resident="never")
+    s = {}
+    with faults.injected("device.resident", nth=1, match="records"):
+        got = _bounded(lambda: mine_tsr_tpu(
+            db, 20, 0.4, max_side=None, resident="always", stats_out=s))
+    assert rules_text(got) == rules_text(want)
+    assert s.get("resident_fallbacks", 0) == 1, s
+
+
+@covers("device.resident")
+def test_resident_kill_restart_resumes_persisted_frontier():
+    """Kill-restart drill: a checkpointed resident mine persists
+    segment-boundary frontier snapshots into the store; dying mid-round
+    and rebooting a FRESH engine from StoreCheckpoint.load() RESUMES
+    the persisted frontier (resumed_nodes > 0, still on the resident
+    path) and finishes with exact parity — no lost candidates, no
+    duplicated results."""
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    # deep run-shaped DB: several resident segments, so a mid-round
+    # snapshot has a live frontier
+    rng = np.random.default_rng(37)
+    db = [[[int(it)] for it in (list(range(8))
+                                + rng.integers(8, 13, size=3).tolist())]
+          for _ in range(40)]
+    want = mine_tsr_tpu(db, 150, 0.3, max_side=None, resident="never")
+
+    class Killed(Exception):
+        pass
+
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "chaos-resident", every_s=0.0)
+    saves = []
+
+    def cb(state):
+        ckpt.save(state)
+        saves.append(len(state["stack"]))
+        if len(saves) == 2:
+            raise Killed  # simulated process death AFTER persisting
+
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, 150, 0.3, max_side=None, resident="always")
+    with pytest.raises(Killed):
+        _bounded(lambda: eng.mine(checkpoint_cb=cb,
+                                  checkpoint_every_s=0.0))
+    state = StoreCheckpoint(store, "chaos-resident", every_s=0.0).load()
+    assert state is not None and state["stack"]
+
+    eng2 = TsrTPU(build_vertical(db, min_item_support=1), 150, 0.3,
+                  max_side=None, resident="always")
+    got = _bounded(lambda: eng2.mine(resume=state))
+    assert eng2.stats["resumed_nodes"] == len(state["stack"])
+    assert eng2.stats.get("resident_rounds", 0) >= 1, eng2.stats
+    assert rules_text(got) == rules_text(want)

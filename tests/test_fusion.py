@@ -362,3 +362,36 @@ def test_disabled_path_is_one_global_read():
                  max_side=2)
     eng.mine()
     assert not any(k.startswith("fusion") for k in eng.stats)
+
+
+def test_resident_dispatch_bypasses_fusion_window():
+    """Resident-frontier TSR dispatches (ops/resident_frontier.py) route
+    through ``dispatch_wave`` for the broker's accounting/fault surface
+    but must NEVER enter a fusion window: a single long-lived while_loop
+    dispatch waiting for window fill would stall the mine for the whole
+    window (and holding a window open would stall its riders).  With the
+    broker enabled and a LONG window, a resident mine must finish far
+    inside the window wall, count only solo waves (no fused groups),
+    and keep exact parity with the fusion-off run."""
+    db = synthetic_db(seed=61, n_sequences=90, n_items=9,
+                      mean_itemsets=3.0, mean_itemset_size=1.2)
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    want = mine_tsr_tpu(db, 20, 0.4, max_side=None, resident="never")
+    b = _enable(window_ms=30_000.0, max_jobs=8, max_width=16384)
+    # the broker is a process-global singleton whose stats accumulate
+    # across tests: assert DELTAS over this mine only
+    before = dict(b.stats)
+    s = {}
+    t0 = time.monotonic()
+    got = mine_tsr_tpu(db, 20, 0.4, max_side=None, resident="always",
+                       stats_out=s)
+    wall = time.monotonic() - t0
+    delta = {k: b.stats.get(k, 0) - before.get(k, 0)
+             for k in set(b.stats) | set(before)}
+    assert rules_text(got) == rules_text(want)
+    assert s.get("resident") is True, s
+    assert wall < 25.0, f"resident mine waited on the fusion window: {wall}"
+    assert delta["solo_waves"] >= 1, delta
+    assert delta["fused_groups"] == 0, delta
+    assert delta["cross_job_launches"] == 0, delta

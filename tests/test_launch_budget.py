@@ -29,11 +29,14 @@ from spark_fsm_tpu.utils.canonical import patterns_text
 
 
 def test_tsr_3d_shape_launch_budget():
-    # config 3d at dryrun scale: ~2k Kosarak-shaped sequences, 128
-    # items, k=100, minconf=0.5, max_side UNSET (the service default)
+    # config 3d HOST-LOOP reference at dryrun scale: ~2k Kosarak-shaped
+    # sequences, 128 items, k=100, minconf=0.5, max_side UNSET.
+    # resident="never" pins the classic host-driven loop — the pre-
+    # residency reference row the resident pin below is measured
+    # against (the bench_smoke "3r" row)
     db = kosarak_like(scale=0.002, fast=True)
     vdb = build_vertical(db, min_item_support=1)
-    eng = TsrTPU(vdb, 100, 0.5, max_side=None)
+    eng = TsrTPU(vdb, 100, 0.5, max_side=None, resident="never")
     rules = eng.mine()
     assert len(rules) == 100
     st = eng.stats
@@ -45,6 +48,38 @@ def test_tsr_3d_shape_launch_budget():
     assert st["evaluated_km1"] == 16256, st
     assert st["evaluated_km2"] == 67918, st
     assert st["evaluated_km4"] == 51898, st
+    assert "resident" not in st, st
+
+
+def test_tsr_3d_resident_launch_budget():
+    """Resident-frontier pin for the SAME 3d miniature on service-
+    default knobs (resident='auto' must route it): the whole unlimited-
+    side mine collapses to one prep + two while_loop segments — 3
+    launches against the host loop's 10 and the capped config-3 shape's
+    7 (the ISSUE-7 acceptance bound is <= 2x config 3 = 14).  The six
+    over-km-ladder children are deferred on device and all die against
+    the final top-k threshold (no host handoff, no spill), and the
+    device search evaluates FEWER candidates than the host loop: the
+    exact on-device top-k threshold rises wave-by-wave, where the host
+    pipeline dispatches against a stale minsup."""
+    db = kosarak_like(scale=0.002, fast=True)
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, 100, 0.5, max_side=None)  # auto -> resident
+    rules = eng.mine()
+    assert len(rules) == 100
+    st = eng.stats
+    assert st.get("resident") is True, st
+    assert st["kernel_launches"] == 3, st
+    assert st["resident_segments"] == 2, st
+    assert st["resident_waves"] == 283, st
+    assert st["evaluated"] == 119066, st
+    assert st["traffic_units"] == 531200, st
+    assert st["resident_deferred"] == 6, st
+    assert "resident_spills" not in st, st
+    assert "resident_handoffs" not in st, st
+    # oracle parity vs the pinned host loop above
+    eng_h = TsrTPU(vdb, 100, 0.5, max_side=None, resident="never")
+    assert eng_h.mine() == rules
 
 
 def test_tsr_3_shape_launch_budget():

@@ -143,11 +143,13 @@ def assert_rule_parity_eng(db, k, minconf, **kw):
 
 def test_superbatch_parity_unlimited_sides_kernel():
     # unlimited sides exercise mixed-km launches through the Pallas
-    # (interpret) kernel path — the 3d-shaped dispatch pattern
+    # (interpret) kernel path — the 3d-shaped dispatch pattern.
+    # resident="never": this test pins the HOST-loop packer (deep mines
+    # otherwise auto-route to the resident-frontier path, ISSUE 7)
     rng = np.random.default_rng(31)
     db = random_db(rng, n_seq=25, n_items=6, max_itemsets=5, max_set=2)
     eng = assert_rule_parity_eng(db, 8, 0.4, max_side=None,
-                                 use_pallas=True)
+                                 use_pallas=True, resident="never")
     assert eng.stats["traffic_units"] > 0
     assert sum(v for k, v in eng.stats.items()
                if k.startswith("launches_km")) >= 1
@@ -156,7 +158,8 @@ def test_superbatch_parity_unlimited_sides_kernel():
 def test_superbatch_parity_unlimited_sides_jnp():
     rng = np.random.default_rng(33)
     db = random_db(rng, n_seq=30, n_items=6, max_itemsets=6, max_set=2)
-    eng = assert_rule_parity_eng(db, 10, 0.3, max_side=None)
+    eng = assert_rule_parity_eng(db, 10, 0.3, max_side=None,
+                                 resident="never")
     # the merged-tail path actually ran: mixed-km super-batches exist
     assert eng.stats.get("superbatches", 0) >= 1
     assert eng.stats["traffic_units"] > 0

@@ -309,3 +309,142 @@ def test_pallas_readback_fault_recounts_batches(monkeypatch):
     # +1: the downgrade's engine-layout prep rebuild is REAL work that
     # stays counted; the discarded kernel eval launches do not
     assert eng.stats["kernel_launches"] == ref.stats["kernel_launches"] + 1
+
+
+# ------------------------------------------------------ resident frontier
+# (ops/resident_frontier.py: whole km-ladders expanded in one dispatch)
+
+
+def _deep_db(n_seq=50, run=10, extra=6, seed=7):
+    """Every sequence holds the ordered run 0..run-1 plus a few noise
+    items, so rules with run-length sides have FULL support — deep
+    sides survive any top-k threshold, which forces over-km-ladder
+    children that stay LIVE (the defer-buffer handoff path)."""
+    rng = np.random.default_rng(seed)
+    db = []
+    for _ in range(n_seq):
+        items = list(range(run)) + rng.integers(
+            run, run + extra, size=3).tolist()
+        db.append([[int(it)] for it in items])
+    return db
+
+
+def test_resident_param_validation():
+    vdb = build_vertical(ZAKI_DB, min_item_support=1)
+    with pytest.raises(ValueError, match="resident"):
+        TsrTPU(vdb, 5, 0.5, resident="sometimes")
+    assert TsrTPU(vdb, 5, 0.5, resident=True).resident == "always"
+    assert TsrTPU(vdb, 5, 0.5, resident=False).resident == "never"
+
+
+def test_resident_route_heuristic():
+    """The 'auto' planner heuristic routes only DEEP single-device
+    mines whose geometry fits the capacity model; 'never' always wins;
+    structural ineligibility (k past the on-device top-k buffer)
+    overrides even 'always'."""
+    from spark_fsm_tpu.ops import resident_frontier as RF
+
+    db = synthetic_db(seed=5, n_sequences=120, n_items=10,
+                      mean_itemsets=3.0)
+    vdb = build_vertical(db, min_item_support=1)
+    m = vdb.n_items
+    assert TsrTPU(vdb, 8, 0.5, max_side=None)._resident_route(m)
+    assert TsrTPU(vdb, 8, 0.5, max_side=3)._resident_route(m)
+    assert not TsrTPU(vdb, 8, 0.5, max_side=2)._resident_route(m)
+    assert not TsrTPU(vdb, 8, 0.5, max_side=None,
+                      resident="never")._resident_route(m)
+    assert TsrTPU(vdb, 8, 0.5, max_side=2,
+                  resident="always")._resident_route(m)
+    assert not TsrTPU(vdb, RF.K_PAD + 1, 0.5, max_side=None,
+                      resident="always")._resident_route(m)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_resident_oracle_parity_unlimited(seed):
+    """Resident path vs BRUTE FORCE on unlimited-side mines: the tiny
+    alphabet makes full enumeration feasible, so this is true oracle
+    parity for the deep search, not engine-vs-engine."""
+    rng = np.random.default_rng(300 + seed)
+    db = random_db(rng, n_seq=25, n_items=6, max_itemsets=5, max_set=2)
+    want = brute_force_rules(db, 10, 0.4, max_side=6)
+    got = mine_tsr_tpu(db, 10, 0.4, max_side=None, resident="always")
+    assert rules_text(got) == rules_text(want)
+
+
+def test_resident_deep_unlimited_parity_and_handoff():
+    """Deep unlimited-max_side case: rules with sides past the km=4
+    device ladder are LIVE top-k work here (every sequence shares an
+    ordered 10-item run), so the resident round must defer them on
+    device and hand the survivors to the host path — and the handoff
+    must reproduce the host loop's exact rule set."""
+    db = _deep_db()
+    s_h, s_r = {}, {}
+    want = mine_tsr_tpu(db, 300, 0.3, max_side=None, resident="never",
+                        stats_out=s_h)
+    got = mine_tsr_tpu(db, 300, 0.3, max_side=None, resident="always",
+                       stats_out=s_r)
+    assert rules_text(got) == rules_text(want)
+    # the workload is genuinely deep (the host evaluates km8 lanes) and
+    # the resident round genuinely deferred + handed off
+    assert s_h.get("evaluated_km8", 0) > 0, s_h
+    assert s_r.get("resident_deferred", 0) > 0, s_r
+    assert s_r.get("resident_handoffs", 0) >= 1, s_r
+    assert "resident_spills" not in s_r, s_r
+
+
+def test_resident_overflow_spill_parity(monkeypatch):
+    """Capacity-overflow spill protocol: with a deliberately tiny ring
+    the frontier outgrows the device buffers mid-ladder; the wave
+    commits nothing, the intact frontier spills into the host loop's
+    own resume format, and the round finishes with exact parity."""
+    from spark_fsm_tpu.ops import resident_frontier as RF
+
+    db = synthetic_db(seed=42, n_sequences=200, n_items=14,
+                      mean_itemsets=4.0, mean_itemset_size=1.3)
+    want = mine_tsr_tpu(db, 40, 0.4, max_side=None, resident="never")
+    monkeypatch.setattr(
+        RF, "caps_for",
+        lambda *a, **k: RF.ResidentCaps(nb=32, ring=128, r_cap=256,
+                                        d_cap=32))
+    s = {}
+    got = mine_tsr_tpu(db, 40, 0.4, max_side=None, resident="always",
+                       stats_out=s)
+    assert rules_text(got) == rules_text(want)
+    assert s.get("resident_spills", 0) >= 1, s
+
+
+def test_resident_checkpoint_resume_parity():
+    """A resident mine checkpoints at segment boundaries in the ONE
+    frontier_state format; killing it mid-round and resuming a FRESH
+    engine from the snapshot (which may carry deferred over-ladder
+    entries) reproduces the exact rule set, still on the resident
+    path."""
+    db = _deep_db(n_seq=40, run=8, seed=11)
+    want = mine_tsr_tpu(db, 150, 0.3, max_side=None, resident="never")
+
+    class Crash(Exception):
+        pass
+
+    saved = []
+
+    def cb(state):
+        saved.append(state)
+        if len(saved) == 2:
+            raise Crash
+
+    vdb = build_vertical(db, min_item_support=1)
+    eng = TsrTPU(vdb, 150, 0.3, max_side=None, resident="always")
+    with pytest.raises(Crash):
+        eng.mine(checkpoint_cb=cb, checkpoint_every_s=0.0)
+    assert len(saved) == 2
+    import json as _json
+
+    state = _json.loads(_json.dumps(saved[-1]))  # the StoreCheckpoint trip
+    assert state["stack"], "crash happened after the frontier emptied"
+
+    eng2 = TsrTPU(build_vertical(db, min_item_support=1), 150, 0.3,
+                  max_side=None, resident="always")
+    got = eng2.mine(resume=state)
+    assert eng2.stats["resumed_nodes"] == len(state["stack"])
+    assert eng2.stats.get("resident_rounds", 0) >= 1, eng2.stats
+    assert rules_text(got) == rules_text(want)
