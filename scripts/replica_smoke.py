@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Multi-replica failover smoke: the ISSUE 8 drills against TWO real
+service processes sharing one MiniRedis store.
+
+The CI companion to overload_smoke for the lease layer
+(service/lease.py), across REAL process boundaries:
+
+1. boots replicas A and B ([cluster] enabled, lease_ttl_s = 2) on one
+   MiniRedis; asserts they generated distinct replica ids;
+2. submits a long CHECKPOINTED mine to A (the chaos lab arms a per-save
+   delay on A so the drill reliably outlives the orchestration) plus
+   two quick filler jobs that queue behind it;
+3. WORK STEALING: idle B must claim the queued fillers off A's
+   admission namespace and finish them (fsm_steal_* counters on B);
+4. kill -9s A while it holds the checkpointed drill mid-mine
+   (frontier + journal + a live lease persisted in the MiniRedis);
+5. FAILOVER: B's periodic recovery must adopt the drill only after its
+   lease EXPIRES (bounded by ttl + one recovery tick), resume it from
+   the persisted frontier, and finish with the EXACT oracle pattern
+   set — zero duplicated results;
+6. asserts every journal intent and lease is settled and the
+   fsm_lease_*/fsm_steal_* metric families are live on B's /metrics.
+
+The stale-incarnation fencing half of the acceptance (late writes
+REJECTED) cannot be driven by kill -9 — a dead process writes nothing —
+and is pinned in-process by tests/test_lease.py's split-brain drill.
+
+Usage: scripts/replica_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+BOOT_TIMEOUT_S = 180.0
+DRILL_TIMEOUT_S = 300.0
+LEASE_TTL_S = 2.0
+RECOVER_EVERY_S = 0.5
+
+
+def log(msg):
+    print(f"replica_smoke: {msg}", flush=True)
+
+
+def post(port, endpoint, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{endpoint}"
+    try:
+        with urllib.request.urlopen(url, data=data, timeout=60) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read().decode())
+
+
+def scrape(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=60) as resp:
+        return resp.read().decode()
+
+
+def series_sum(text, family, label_filter=""):
+    """Sum samples of ``family`` whose label block contains the filter."""
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        m = re.match(rf"^{re.escape(family)}(\{{[^}}]*\}})?\s+(\S+)$", line)
+        if m and label_filter in (m.group(1) or ""):
+            total += float(m.group(2))
+            seen = True
+    assert seen, f"{family} missing from /metrics"
+    return total
+
+
+def boot_service(cfg_path, env, name):
+    child = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import sys\n"
+        f"sys.argv = ['app', '--config', {str(cfg_path)!r}]\n"
+        "from spark_fsm_tpu.service.app import main\n"
+        "main()\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    port = replica = None
+    deadline = time.time() + BOOT_TIMEOUT_S
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"replica {name} died at boot (rc={proc.poll()})")
+        if line.startswith("cluster replica "):
+            replica = line.split()[2]
+        if "spark_fsm_tpu service on http://" in line:
+            port = int(line.rsplit(":", 1)[1])
+            break
+    assert port is not None, f"no boot line from {name} within the timeout"
+    assert replica is not None, f"no cluster-replica line from {name}"
+    return proc, port, replica
+
+
+def main():
+    from test_redis_store import MiniRedis  # noqa: E402 (tests/ on path)
+
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.service.resp import RespClient
+
+    mini = MiniRedis()
+    log(f"MiniRedis on port {mini.port}")
+    client = RespClient(port=mini.port)
+
+    tmp = tempfile.mkdtemp(prefix="replica_smoke_")
+    cfg_path = os.path.join(tmp, "config.json")
+    with open(cfg_path, "w") as fh:
+        json.dump({
+            "fault_injection": True,  # the per-save delay arms via HTTP
+            "service": {"port": 0, "miner_workers": 1, "queue_depth": 8},
+            "store": {"backend": "redis", "host": "127.0.0.1",
+                      "port": mini.port},
+            "cluster": {"enabled": True, "lease_ttl_s": LEASE_TTL_S,
+                        "recover_every_s": RECOVER_EVERY_S},
+            # pin the queue engine so the checkpointed drill takes the
+            # segmented path (frontier saves at every segment boundary)
+            "engine": {"fused": "queue"},
+        }, fh)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    proc_a, port_a, rep_a = boot_service(cfg_path, env, "A")
+    log(f"replica A {rep_a} on port {port_a} (pid {proc_a.pid})")
+    proc_b, port_b, rep_b = boot_service(cfg_path, env, "B")
+    log(f"replica B {rep_b} on port {port_b} (pid {proc_b.pid})")
+    try:
+        assert rep_a != rep_b, "replica ids must be unique per boot"
+
+        # slow every frontier save on A by 1s so the drill job reliably
+        # outlives the steal + kill phases (armed on A only)
+        code, _, _ = post(port_a, "/admin/faults", action="arm",
+                          site="checkpoint.save", every="1",
+                          delay_s="1.0", exc="none")
+        assert code == 200, "chaos lab refused the arm"
+
+        db = synthetic_db(seed=41, n_sequences=200, n_items=12,
+                          mean_itemsets=3.0, mean_itemset_size=1.3)
+        want = mine_spade(db, abs_minsup(0.05, len(db)))
+        code, _, body = post(port_a, "/train", uid="drill",
+                             algorithm="SPADE_TPU", source="INLINE",
+                             sequences=format_spmf(db), support="0.05",
+                             checkpoint="1", checkpoint_every_s="0")
+        assert code == 200 and body["status"] == "started", body
+
+        # ---- work stealing: fillers queue behind the drill on A; idle
+        # B must claim them off A's admission namespace
+        for uid in ("filler0", "filler1"):
+            code, _, body = post(port_a, "/train", uid=uid,
+                                 algorithm="SPADE", source="INLINE",
+                                 sequences="1 -1 2 -2\n", support="1.0")
+            assert code == 200 and body["status"] == "started", body
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            done = [post(port_b, f"/status/{u}")[2]["status"]
+                    for u in ("filler0", "filler1")]
+            if done == ["finished", "finished"]:
+                break
+            assert proc_a.poll() is None and proc_b.poll() is None
+            time.sleep(0.1)
+        assert done == ["finished", "finished"], done
+        stolen = series_sum(scrape(port_b), "fsm_steal_attempts_total",
+                            'outcome="stolen"')
+        assert stolen >= 2, f"B stole {stolen} jobs, expected both fillers"
+        drops = series_sum(scrape(port_a), "fsm_steal_victim_drops_total")
+        log(f"steal ok: B stole {int(stolen)} queued fillers "
+            f"(A dropped {int(drops)} at dequeue), both finished on B")
+
+        # ---- failover: kill A between frontier saves, mid-mine
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            if client.get("fsm:frontier:drill"):
+                break
+            assert proc_a.poll() is None, "replica A died early"
+            time.sleep(0.1)
+        assert client.get("fsm:frontier:drill"), "no frontier save seen"
+        assert client.get("fsm:journal:drill"), "drill journal missing"
+        assert client.get("fsm:lease:drill"), "drill lease missing"
+        proc_a.send_signal(signal.SIGKILL)
+        proc_a.wait(30)
+        t_kill = time.monotonic()
+        log("killed replica A mid-mine (frontier + journal + live lease "
+            "persisted)")
+
+        # B may adopt only after the lease EXPIRES; bound = TTL + one
+        # recovery tick + scheduling slack
+        t_adopt = None
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            raw = client.get("fsm:journal:drill")
+            if raw is None:  # already adopted AND finished
+                t_adopt = t_adopt or time.monotonic()
+                break
+            if json.loads(raw).get("replica") == rep_b:
+                t_adopt = time.monotonic()
+                break
+            time.sleep(0.05)
+        assert t_adopt is not None, "B never adopted the drill"
+        adopt_wall = t_adopt - t_kill
+        bound = LEASE_TTL_S + RECOVER_EVERY_S + 3.0
+        assert adopt_wall <= bound, \
+            f"adoption took {adopt_wall:.1f}s (bound {bound:.1f}s)"
+        log(f"failover ok: B adopted the drill {adopt_wall:.1f}s after "
+            f"the kill (lease ttl {LEASE_TTL_S}s)")
+
+        status = None
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            _, _, body = post(port_b, "/status/drill")
+            status = body["status"]
+            if status in ("finished", "failure"):
+                break
+            time.sleep(0.25)
+        assert status == "finished", (status, body)
+        _, _, body = post(port_b, "/get/patterns", uid="drill")
+        assert body["status"] == "finished"
+        from spark_fsm_tpu.service.model import deserialize_patterns
+        from spark_fsm_tpu.utils.canonical import (diff_patterns,
+                                                   patterns_text)
+
+        got = deserialize_patterns(body["data"]["patterns"])
+        assert patterns_text(got) == patterns_text(want), \
+            diff_patterns(want, got)
+        log(f"oracle parity ok: {len(got)} patterns, zero duplicated "
+            "results")
+
+        # every journal intent + lease settled; metric families live
+        assert client.keys("fsm:journal:*") == []
+        assert client.get("fsm:lease:drill") is None
+        assert client.keys("fsm:admission:*") == []
+        text = scrape(port_b)
+        for fam in ("fsm_lease_acquired_total", "fsm_lease_held",
+                    "fsm_lease_fence_rejections_total",
+                    "fsm_steal_attempts_total",
+                    "fsm_replica_heartbeats_total"):
+            series_sum(text, fam)
+        resumed = series_sum(text, "fsm_recovery_jobs_total",
+                             'outcome="resumed"')
+        assert resumed >= 1, "B's recovery counter never saw the adoption"
+        log("bookkeeping ok: journals/leases/markers settled, "
+            "fsm_lease_*/fsm_steal_* families live")
+    finally:
+        if proc_a.poll() is None:
+            proc_a.kill()
+        proc_b.send_signal(signal.SIGTERM)
+        try:
+            proc_b.wait(60)
+        except subprocess.TimeoutExpired:
+            proc_b.kill()
+        mini.close()
+    log("PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Multi-replica failover smoke (ISSUE 8): two real service processes on
+# one MiniRedis — work stealing of queued jobs, kill -9 of the replica
+# holding a checkpointed mine, lease-expiry adoption by the survivor
+# with oracle parity, and settled journals/leases afterwards.
+#
+# Runs under a hard timeout: a wedged boot/adoption must fail the smoke,
+# not hang CI.
+cd "$(dirname "$0")/.."
+set -o pipefail
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/replica_smoke.py
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "REPLICA_SMOKE_FAILED rc=$rc"
+fi
+exit $rc

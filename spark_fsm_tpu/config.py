@@ -34,6 +34,22 @@ File format (TOML shown; JSON with the same nesting also accepted):
     coordinator_address = ""        # "" = JAX env vars / cloud auto-detect
     # num_processes / process_id: omit for env-var/cloud auto-detect
 
+    [cluster]
+    enabled = false                 # lease-fenced multi-replica mode: N
+                                    # service replicas safely share ONE
+                                    # Redis namespace (service/lease.py)
+    replica_id = ""                 # "" = generated per boot (REQUIRED
+                                    # unique per replica if set manually)
+    lease_ttl_s = 10.0              # per-job lease TTL; a crashed
+                                    # replica's jobs are adoptable after
+                                    # at most this long
+    heartbeat_s = 0.0               # renewal/heartbeat cadence
+                                    # (0 = lease_ttl_s / 3)
+    steal = true                    # idle replicas claim queued jobs
+                                    # from loaded peers
+    recover_every_s = 0.0           # periodic orphan-recovery cadence
+                                    # (0 = lease_ttl_s)
+
     [engine]
     mesh_devices = 8                # 0 = single chip (no mesh)
     pool_bytes = 2147483648         # HBM slot-pool budget (default: adaptive, 35% of device HBM)
@@ -224,6 +240,33 @@ class DistributedConfig:
 
 
 @dataclasses.dataclass
+class ClusterConfig:
+    """Lease-fenced multi-replica service (service/lease.py): N replicas
+    share one Redis journal namespace; per-job leases with fencing
+    tokens make any replica's crash degrade capacity, never
+    correctness.  ``enabled = false`` (default) keeps the PR 5
+    single-instance posture at zero cost.
+
+    ``replica_id`` must be unique per replica when set; "" generates one
+    per boot.  ``lease_ttl_s`` bounds failover latency (a dead
+    replica's jobs are adoptable after at most one TTL) and bounds how
+    long a stalled replica may still believe it owns a job.
+    ``heartbeat_s`` (0 = ttl/3) is the renewal cadence — /3 so two
+    failed renewals still leave one attempt before the TTL lapses.
+    ``steal`` lets idle replicas claim queued jobs from loaded peers.
+    ``recover_every_s`` (0 = ttl) is the periodic orphan-adoption scan
+    cadence.
+    """
+
+    enabled: bool = False
+    replica_id: str = ""
+    lease_ttl_s: float = 10.0
+    heartbeat_s: float = 0.0
+    steal: bool = True
+    recover_every_s: float = 0.0
+
+
+@dataclasses.dataclass
 class Config:
     service: ServiceConfig = dataclasses.field(default_factory=ServiceConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
@@ -234,6 +277,8 @@ class Config:
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig)
     fusion: FusionConfig = dataclasses.field(default_factory=FusionConfig)
+    cluster: ClusterConfig = dataclasses.field(
+        default_factory=ClusterConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -278,6 +323,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "observability": (ObservabilityConfig,
                           top.pop("observability", {})),
         "fusion": (FusionConfig, top.pop("fusion", {})),
+        "cluster": (ClusterConfig, top.pop("cluster", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -314,6 +360,17 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("fusion.max_width must be >= 32 (one jnp lane)")
     if cfg.fusion.dispatch_workers < 1:
         raise ConfigError("fusion.dispatch_workers must be >= 1")
+    if cfg.cluster.lease_ttl_s <= 0:
+        raise ConfigError("cluster.lease_ttl_s must be > 0")
+    if cfg.cluster.heartbeat_s < 0:
+        raise ConfigError("cluster.heartbeat_s must be >= 0 (0 = ttl/3)")
+    if (cfg.cluster.heartbeat_s
+            and cfg.cluster.heartbeat_s >= cfg.cluster.lease_ttl_s):
+        raise ConfigError(
+            "cluster.heartbeat_s must be < cluster.lease_ttl_s (a lease "
+            "renewed slower than it expires is permanently flapping)")
+    if cfg.cluster.recover_every_s < 0:
+        raise ConfigError("cluster.recover_every_s must be >= 0 (0 = ttl)")
     return cfg
 
 

@@ -23,7 +23,7 @@ from typing import Deque, Dict, List, Optional
 
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
-from spark_fsm_tpu.service import model, plugins, sources
+from spark_fsm_tpu.service import lease, model, plugins, sources
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 from spark_fsm_tpu.utils import faults, jobctl, obs
@@ -42,7 +42,8 @@ def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
 
 def _record_failure(store: ResultStore, uid: str, exc: Exception,
                     metric: str = "jobs_failed",
-                    keep_frontier: bool = False) -> None:
+                    keep_frontier: bool = False,
+                    lease_mgr: Optional[lease.LeaseManager] = None) -> None:
     """The supervision contract: error text + traceback under the error
     key, status -> failure (SURVEY.md sec 5 failure-detection row).
     ``metric`` keeps batch-job and stream-push failure counters distinct
@@ -51,7 +52,27 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
     mine itself (deadline/cancel aborts, shutdown drain, a recovery
     resubmit that shed): the persisted progress stays resumable by a
     later checkpointed resubmit instead of being destroyed by an abort
-    the job never asked for."""
+    the job never asked for.
+
+    With a lease manager, the durable write is FENCED: a replica whose
+    lease on ``uid`` was superseded (the adopting peer owns the uid's
+    keys now) records nothing in the store — its failure stays local
+    (log + counters) instead of clobbering the adopter's run.  The
+    settle check is one atomic NX reacquire when the lease merely
+    expired unclaimed, so the no-adopter case still lands its durable
+    failure."""
+    if lease_mgr is not None and not lease_mgr.settle_for_failure(uid):
+        # release OUR control object by identity: the adopter (possibly
+        # in this very process, in test topologies) may have
+        # re-registered the uid and its live entry must keep its
+        # deadline/cancel/fence signals
+        ctl = lease_mgr.attached_ctl(uid)
+        lease_mgr.forget(uid)
+        jobctl.release_entry(ctl)
+        log_event("job_failed_fenced", uid=uid, error=str(exc))
+        with obs.span("job.failed_fenced", trace_id=uid, error=str(exc)):
+            pass
+        return
     store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
     store.add_status(uid, Status.FAILURE)
     store.incr(f"fsm:metric:{metric}")
@@ -65,6 +86,8 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
     # the job-control entry released (stream uids have neither — no-ops)
     store.journal_clear(uid)
     jobctl.release(uid)
+    if lease_mgr is not None:
+        lease_mgr.release(uid)
     log_event("job_failed", uid=uid, error=str(exc))
     # stamp the terminal failure into the job's flight-recorder ring
     # (explicit trace_id: failures land from threads with no active
@@ -126,12 +149,17 @@ class StoreCheckpoint:
 
     def __init__(self, store: ResultStore, uid: str,
                  every_s: float = 30.0,
-                 retry: Optional[RetryPolicy] = None) -> None:
+                 retry: Optional[RetryPolicy] = None,
+                 lease_mgr: Optional[lease.LeaseManager] = None) -> None:
         self.store, self.uid, self.every_s = store, uid, every_s
         self._meta_key = f"fsm:frontier:{uid}"
         self._results_key = f"fsm:frontier:results:{uid}"
         self._inline: list = []  # results_done=0 part of the loaded snapshot
         self._retry = retry if retry is not None else RetryPolicy(seed=0)
+        # multi-replica fence: every save re-proves lease ownership
+        # BEFORE writing — a stale holder's snapshot must never land
+        # over the adopting replica's (service/lease.py)
+        self._lease = lease_mgr
 
     def _io(self, fn, *args):
         return self._retry.run(fn, *args, site="store.checkpoint")
@@ -173,6 +201,8 @@ class StoreCheckpoint:
             self._save(state)
 
     def _save(self, state: dict) -> None:
+        if self._lease is not None:
+            self._lease.fence(self.uid)  # raises JobLeaseLost when stale
         faults.fault_site("checkpoint.save", uid=self.uid)
         # NON-DESTRUCTIVE: pop from a shallow copy, never the caller's
         # dict — a store failure mid-save must leave the engine's state
@@ -378,11 +408,20 @@ class Miner:
     """
 
     def __init__(self, store: ResultStore, workers: int = 1,
-                 queue_depth: Optional[int] = None) -> None:
+                 queue_depth: Optional[int] = None,
+                 lease_mgr: Optional[lease.LeaseManager] = None) -> None:
         self.store = store
         if queue_depth is None:
             queue_depth = config.get_config().service.queue_depth
         self._q = AdmissionQueue(queue_depth)
+        # multi-replica lease layer (ISSUE 8): explicit manager, or
+        # built from the boot [cluster] section.  None (the default
+        # single-replica deployment) keeps every guard below at one
+        # ``is None`` read.
+        if lease_mgr is None and config.get_config().cluster.enabled:
+            lease_mgr = lease.LeaseManager.from_config(
+                store, config.get_config().cluster)
+        self._lease = lease_mgr
         # this Miner's incarnation id: journal entries carrying it are
         # LIVE (409 on resubmit); entries carrying any other id belong
         # to a dead incarnation and are recovery fodder
@@ -401,6 +440,10 @@ class Miner:
         # both pass the 409 check and both admit — the state-wipe race
         # the conflict check exists to close
         self._admit_lock = threading.Lock()
+        # running-job count (distinct from queue depth): what the lease
+        # heartbeat advertises and the steal scan's idle check reads
+        self._running = 0
+        self._running_lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._loop, daemon=True,
                              name=f"fsm-miner-{i}")
@@ -408,11 +451,29 @@ class Miner:
         ]
         for t in self._threads:
             t.start()
+        if self._lease is not None:
+            # heartbeat starts with the workers; Master re-wires the
+            # periodic-recovery callback after it exists (start() is
+            # idempotent on the thread, updates the callback)
+            self._lease.start(self)
 
     # ------------------------------------------------------------ admission
 
     def queue_size(self) -> int:
         return self._q.size()
+
+    def worker_count(self) -> int:
+        return len(self._threads)
+
+    def running_count(self) -> int:
+        with self._running_lock:
+            return self._running
+
+    def idle_capacity(self) -> int:
+        """Worker slots covered by neither running nor queued work — the
+        steal scan's budget (and the heartbeat's ``free`` field)."""
+        return max(0, self.worker_count() - self.running_count()
+                   - self.queue_size())
 
     def settle_cancelled_queued(self, uid: str) -> bool:
         """Settle a job cancelled while still QUEUED: remove it from the
@@ -425,6 +486,15 @@ class Miner:
         req = self._q.remove(uid)
         if req is None:
             return False
+        if self._lease is not None and not self._lease.retract_admission(uid):
+            # a peer stole the job between the cancel request and this
+            # settle: it runs there now — local cancel state is moot.
+            # Release OUR control object by identity, never the uid (a
+            # same-process thief may have re-registered it already).
+            ctl = self._lease.attached_ctl(uid)
+            self._lease.stolen_from_us(uid)
+            jobctl.release_entry(ctl)
+            return True
         try:
             # route through check_entry so the cancel counter and trace
             # event fire exactly like a worker-side abort
@@ -433,7 +503,8 @@ class Miner:
                 uid, "cancelled while queued")
         except jobctl.JobAborted as caught:
             exc = caught
-        _record_failure(self.store, uid, exc, keep_frontier=True)
+        _record_failure(self.store, uid, exc, keep_frontier=True,
+                        lease_mgr=self._lease)
         return True
 
     @property
@@ -453,7 +524,16 @@ class Miner:
         seeded, before any job has finished, by the ragged planner's
         cost model over the declared prewarm envelope (8 full-width
         launches at the configured sequence scale: the same
-        KERNELS.json-anchored arithmetic the watchdog deadlines use)."""
+        KERNELS.json-anchored arithmetic the watchdog deadlines use).
+
+        CLUSTER OVERRIDE: when peers advertise free capacity in their
+        heartbeat records, the shed submit's fastest path is the STEAL
+        path — an idle peer claims our queued backlog within a
+        heartbeat or two, so the local-EWMA pessimum would overstate
+        the wait by orders of magnitude.  Point the client at roughly
+        two heartbeats instead."""
+        if self._lease is not None and self._lease.peer_free_total() > 0:
+            return max(1, math.ceil(2 * self._lease.heartbeat_s))
         with self._wall_lock:
             per_job = self._wall_ewma
         if per_job is None:
@@ -496,8 +576,27 @@ class Miner:
                     live = False  # corrupt record: treat as a dead orphan
                 if live:
                     raise UidConflict(req.uid)
+            fresh_lease = False
+            if self._lease is not None:
+                # cluster-wide liveness: the lease generalizes the
+                # incarnation check across replicas.  Held by a peer ->
+                # the job is live THERE (409); protocol failure -> 503
+                # with zero store trace of the uid (LeaseUnavailable
+                # propagates).  Acquisition happens BEFORE the journal
+                # intent so a refused submit leaves nothing behind.
+                # A PRE-HELD lease (adoption/steal resubmit) is kept on
+                # failure paths below: the caller settles the failure
+                # under it, journal-first, so no adopt-vs-settle window
+                # opens between a release and the durable record.
+                fresh_lease = self._lease.token_of(req.uid) is None
+                try:
+                    self._lease.acquire(req.uid)
+                except lease.LeaseHeld as exc:
+                    raise UidConflict(req.uid) from exc
             admitted, queued, ahead = self._q.try_reserve(priority)
             if not admitted:
+                if self._lease is not None and fresh_lease:
+                    self._lease.release(req.uid)
                 _SHEDS_TOTAL.inc(priority=priority)
                 log_event("job_shed", uid=req.uid, queued=queued,
                           queued_ahead=ahead, depth=self._q.depth,
@@ -519,18 +618,47 @@ class Miner:
                 self.store.journal_set(req.uid, json.dumps({
                     "uid": req.uid,
                     "incarnation": self.incarnation,
+                    "replica": (self._lease.replica_id
+                                if self._lease is not None else None),
                     "ts": round(time.time(), 3),
                     "checkpoint": _checkpoint_requested(req),
                     "priority": priority,
                     "request": dict(req.data),
                 }))
+                if self._lease is not None:
+                    # mirror the queued job into this replica's admission
+                    # namespace — the steal scan's menu; retracted (by us
+                    # OR a thief, exclusively) at dequeue
+                    self._lease.publish_admission(req.uid)
             except BaseException:
                 self._q.abort()  # reservation never became a queued job
+                try:
+                    # OUR journal intent may have landed before the
+                    # failure (e.g. the admission-marker write died): a
+                    # surviving live-looking record would 409 every
+                    # future resubmit.  Clear ONLY a record carrying
+                    # this incarnation — when journal_set itself failed,
+                    # the surviving record is a PREDECESSOR's (a dead
+                    # replica's checkpointed orphan, a stolen victim's
+                    # intent) and destroying it would destroy the very
+                    # recoverability the journal exists for.
+                    raw = self.store.journal_get(req.uid)
+                    if raw is not None and json.loads(raw).get(
+                            "incarnation") == self.incarnation:
+                        self.store.journal_clear(req.uid)
+                except Exception:
+                    pass
+                if self._lease is not None and fresh_lease:
+                    self._lease.release(req.uid)
                 raise
         try:
             # priority rides the control entry so the fusion broker's
             # window rule sees the admission class at dispatch time
-            jobctl.register(req.uid, deadline_s, priority=priority)
+            ctl = jobctl.register(req.uid, deadline_s, priority=priority)
+            if self._lease is not None:
+                # heartbeat-detected lease loss self-fences the job at
+                # its next safe point via this control entry
+                self._lease.attach(req.uid, ctl)
             self.store.add_status(req.uid, Status.STARTED)
             self.store.incr("fsm:metric:jobs_submitted")
             log_event("job_submitted", uid=req.uid,
@@ -561,6 +689,12 @@ class Miner:
                 self.store.journal_clear(req.uid)
             except Exception:
                 pass
+            if self._lease is not None:
+                try:
+                    self._lease.retract_admission(req.uid)
+                except Exception:
+                    pass
+                self._lease.release(req.uid)
             jobctl.release(req.uid)
             raise
         finally:
@@ -573,15 +707,32 @@ class Miner:
         # sentinel) and would sit "started" forever — the exact state
         # the drain exists to prevent.  Record the durable failure
         # here, same status shape as the drained-backlog path.
+        if self._lease is not None:
+            try:
+                self._lease.retract_admission(req.uid)
+            except Exception:
+                pass
         _record_failure(self.store, req.uid,
                         RuntimeError("service shutting down"),
-                        keep_frontier=True)
+                        keep_frontier=True, lease_mgr=self._lease)
 
     def _loop(self) -> None:
         while True:
             req = self._q.get()
             if req is None:
                 return
+            if self._lease is not None and \
+                    not self._lease.retract_admission(req.uid):
+                # the admission marker is GONE: an idle peer won the
+                # atomic DEL claim and owns the job (lease + journal)
+                # now — drop it silently; running it here would be the
+                # double-execution the two-phase claim exists to prevent
+                # (release OUR control object by identity — the uid may
+                # already map to the thief's live entry in-process)
+                ctl = self._lease.attached_ctl(req.uid)
+                self._lease.stolen_from_us(req.uid)
+                jobctl.release_entry(ctl)
+                continue
             if self._stopping:
                 # draining: do NOT start queued backlog jobs — give each a
                 # durable failure status (visible through /status) instead
@@ -590,7 +741,7 @@ class Miner:
                 # progress stays resumable after the restart)
                 _record_failure(self.store, req.uid,
                                 RuntimeError("service shutting down"),
-                                keep_frontier=True)
+                                keep_frontier=True, lease_mgr=self._lease)
                 continue
             ctl = jobctl.get(req.uid)
             try:
@@ -599,7 +750,7 @@ class Miner:
                 jobctl.check_entry(ctl)
             except jobctl.JobAborted as exc:
                 _record_failure(self.store, req.uid, exc,
-                                keep_frontier=True)
+                                keep_frontier=True, lease_mgr=self._lease)
                 continue
             # Clear again at run start: with a reused uid, an EARLIER job
             # with the same uid may have written its error/results after
@@ -612,42 +763,57 @@ class Miner:
                     "retries",
                     str(config.get_config().service.job_retries)))
             except ValueError as exc:  # malformed param: fail like any
-                _record_failure(self.store, req.uid, exc)  # other bad param
+                _record_failure(self.store, req.uid, exc,  # other bad param
+                                lease_mgr=self._lease)
                 continue
-            attempt = 0
-            while True:
-                try:
-                    # re-checked between attempts too: a deadline that
-                    # expired during a failed attempt must not buy a
-                    # retry it can never finish
-                    jobctl.check_entry(ctl)
-                    with jobctl.activate(ctl):
-                        self._run(req)
-                    break
-                except jobctl.JobAborted as exc:
-                    # TERMINAL, never retried: durable failure whose
-                    # error text leads with CANCELLED/DEADLINE_EXCEEDED.
-                    # The frontier survives: progress a deadline/cancel
-                    # cut short resumes on a later checkpointed resubmit
+            with self._running_lock:
+                self._running += 1
+            try:
+                self._attempts(req, ctl, retries)
+            finally:
+                with self._running_lock:
+                    self._running -= 1
+
+    def _attempts(self, req: ServiceRequest, ctl, retries: int) -> None:
+        attempt = 0
+        while True:
+            try:
+                # re-checked between attempts too: a deadline that
+                # expired during a failed attempt must not buy a
+                # retry it can never finish
+                jobctl.check_entry(ctl)
+                with jobctl.activate(ctl):
+                    self._run(req)
+                break
+            except jobctl.JobAborted as exc:
+                # TERMINAL, never retried: durable failure whose error
+                # text leads with CANCELLED/DEADLINE_EXCEEDED/
+                # LEASE_LOST.  The frontier survives: progress an abort
+                # cut short resumes on a later checkpointed resubmit
+                # (for LEASE_LOST the adopting replica is already
+                # resuming it — the fenced _record_failure writes
+                # nothing there)
+                _record_failure(self.store, req.uid, exc,
+                                keep_frontier=True, lease_mgr=self._lease)
+                break
+            except ValueError as exc:  # bad params / bad source: the
+                # failure is deterministic (SourceError included) — a
+                # re-run would just repeat it, so fail immediately
+                _record_failure(self.store, req.uid, exc,
+                                lease_mgr=self._lease)
+                break
+            except Exception as exc:  # supervision: retry, then failure
+                attempt += 1
+                if attempt > max(0, retries):
                     _record_failure(self.store, req.uid, exc,
-                                    keep_frontier=True)
+                                    lease_mgr=self._lease)
                     break
-                except ValueError as exc:  # bad params / bad source: the
-                    # failure is deterministic (SourceError included) — a
-                    # re-run would just repeat it, so fail immediately
-                    _record_failure(self.store, req.uid, exc)
-                    break
-                except Exception as exc:  # supervision: retry, then failure
-                    attempt += 1
-                    if attempt > max(0, retries):
-                        _record_failure(self.store, req.uid, exc)
-                        break
-                    self.store.incr("fsm:metric:jobs_retried")
-                    log_event("job_retry", uid=req.uid, attempt=attempt,
-                              error=str(exc))
-                    with obs.span("job.retry", trace_id=req.uid,
-                                  attempt=attempt, error=str(exc)):
-                        pass
+                self.store.incr("fsm:metric:jobs_retried")
+                log_event("job_retry", uid=req.uid, attempt=attempt,
+                          error=str(exc))
+                with obs.span("job.retry", trace_id=req.uid,
+                              attempt=attempt, error=str(exc)):
+                    pass
 
     def _run(self, req: ServiceRequest) -> None:
         # the job's root flight-recorder span: every engine/planner/IO
@@ -663,8 +829,12 @@ class Miner:
             db = sources.get_db(req, self.store)
         # coarse safe point shared by every algorithm: a cancel/deadline
         # that landed during the dataset build aborts before the mine
-        # (the engines' own launch-boundary checks take over from here)
+        # (the engines' own launch-boundary checks take over from here);
+        # the lease fence rides the same boundary — a job whose lease
+        # lapsed during a long dataset build self-fences before mining
         jobctl.check()
+        if self._lease is not None:
+            self._lease.fence(req.uid)
         self.store.add_status(req.uid, Status.DATASET)
         plugin = plugins.get_plugin(req)
         stats: Dict[str, object] = {
@@ -677,7 +847,8 @@ class Miner:
         if _checkpoint_requested(req):
             ckpt = StoreCheckpoint(
                 self.store, req.uid,
-                every_s=float(req.param("checkpoint_every_s", "30")))
+                every_s=float(req.param("checkpoint_every_s", "30")),
+                lease_mgr=self._lease)
         trace_dir = _profile_dir(req, req.uid)
         t1 = time.perf_counter()
         with profile_trace(trace_dir), obs.span("job.mine"):
@@ -689,6 +860,12 @@ class Miner:
         if trace_dir:
             stats["profile_trace"] = trace_dir
         with obs.span("job.sink", results=len(results)):
+            if self._lease is not None:
+                # the split-brain gate: a stale holder that somehow
+                # mined to completion (expired mid-run, adopter already
+                # re-running) must NOT commit its result set over the
+                # adopter's — raises JobLeaseLost, terminal, fenced
+                self._lease.fence(req.uid)
             self.store.set(f"fsm:stats:{req.uid}", json.dumps(stats))
             _sink_results(self.store, req.uid, plugin.kind, results)
             self.store.add_status(req.uid, Status.TRAINED)
@@ -709,6 +886,8 @@ class Miner:
         # recovery pass sees 'finished' and just clears the journal)
         self.store.journal_clear(req.uid)
         jobctl.release(req.uid)
+        if self._lease is not None:
+            self._lease.release(req.uid)
         self.store.incr("fsm:metric:jobs_finished")
         self._observe_wall(time.perf_counter() - t0)
         log_event("job_finished", uid=req.uid, **stats)
@@ -726,6 +905,12 @@ class Miner:
         every queued job's durable failure lands and its journal entry
         clears; submits racing the drain still shed with 429 when the
         queue is full, or land the durable failure when it is not."""
+        if self._lease is not None:
+            # BEFORE the drain: no new work may be pulled in (a steal
+            # or periodic adoption landing now would meet the drain and
+            # get a bogus durable failure); renewals keep running so
+            # the draining jobs stay fenced-safe to their end
+            self._lease.quiesce()
         with self._stop_lock:
             self._stopping = True
             for _ in self._threads:
@@ -735,6 +920,11 @@ class Miner:
             t.join(max(0.0, deadline - time.monotonic()))
             if t.is_alive():
                 log_event("shutdown_abandoned_worker", thread=t.name)
+        if self._lease is not None:
+            # after the drain: every backlog job has settled (and
+            # released its lease); stop the heartbeat and retract the
+            # replica record so peers adopt anything left promptly
+            self._lease.stop()
 
 
 class Questor:
@@ -1133,13 +1323,21 @@ class Master:
 
     def __init__(self, store: Optional[ResultStore] = None,
                  miner_workers: int = 1,
-                 queue_depth: Optional[int] = None) -> None:
+                 queue_depth: Optional[int] = None,
+                 lease_mgr: Optional[lease.LeaseManager] = None) -> None:
         self.store = store if store is not None else ResultStore()
         # the registry keys one "jobs" collector process-wide: the last
         # Master built owns it (tests build many; the service builds one)
         obs.REGISTRY.register_collector("jobs", _jobs_collector(self.store))
         self.miner = Miner(self.store, workers=miner_workers,
-                           queue_depth=queue_depth)
+                           queue_depth=queue_depth, lease_mgr=lease_mgr)
+        if self.miner._lease is not None:
+            # upgrade the heartbeat with the PERIODIC recovery pass:
+            # a peer's crash is healed within ~one lease TTL without
+            # waiting for anyone to reboot (start() is idempotent on
+            # the thread; this call only installs the callback)
+            self.miner._lease.start(self.miner,
+                                    recover=lambda: recover_orphans(self))
         self.questor = Questor(self.store)
         self.tracker = Tracker(self.store)
         self.registrar = Registrar(self.store)
@@ -1178,6 +1376,12 @@ class Master:
             except UidConflict as exc:
                 return model.response(req, Status.FAILURE, error=str(exc),
                                       http_status="409")
+            except lease.LeaseUnavailable as exc:
+                # the lease protocol itself failed (store down, injected
+                # lease.acquire fault): the submit cannot be made safe —
+                # clean 503 with zero store trace of the uid
+                return model.response(req, Status.FAILURE, error=str(exc),
+                                      http_status="503")
             except (ValueError, faults.FaultInjected) as exc:
                 # bad submit params, or a chaos-armed admission/journal
                 # site: a clean synchronous failure envelope either way
@@ -1229,14 +1433,18 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
     - anything else: durable ``failure: interrupted by restart`` so no
       client ever polls a forever-pending uid — ``failed``.
 
-    SINGLE-WRITER ASSUMPTION: liveness is inferred from the journal's
-    incarnation tag, so exactly ONE service instance may own a store.
-    A second instance sharing the same Redis would treat the sibling's
-    live jobs as dead orphans (duplicate resubmits / bogus failures);
-    scale out with one store per instance until the journal grows a
-    lease/heartbeat (docs/OPERATIONS.md states the same constraint).
+    MULTI-REPLICA (``[cluster] enabled``): liveness is proven by the
+    JOB LEASE, not inferred from the incarnation tag — a foreign
+    journal entry is an orphan ONLY once its lease has expired, and
+    adoption itself is an atomic NX re-acquisition, so N replicas may
+    run this pass concurrently (boot + periodic) and each orphan is
+    adopted exactly once.  Without the lease layer the PR 5
+    single-writer assumption still holds: exactly ONE service instance
+    may own a store, because a sibling's live jobs would read as dead
+    orphans here (docs/OPERATIONS.md states the same constraint).
     """
     store, miner = master.store, master.miner
+    mgr = miner._lease
     report: Dict[str, List[str]] = {"resumed": [], "failed": [],
                                     "cleared": []}
     for uid in store.journal_uids():
@@ -1249,9 +1457,25 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
             entry = {}  # corrupt record: fall through to the durable failure
         if entry.get("incarnation") == miner.incarnation:
             continue  # live in THIS incarnation (a concurrent submit)
+        if mgr is not None and not mgr.adopt_expired(uid):
+            continue  # lease still live on a replica (the job is merely
+            # running/queued elsewhere), or a sibling recovery pass won
+            # the adoption race — either way: not ours to touch
+        if mgr is not None and entry.get("replica"):
+            # reap the dead replica's admission marker for this uid —
+            # markers have no TTL (a TTL'd marker would make the
+            # victim's dequeue misread an expiry as a steal), so
+            # adoption is where a crashed replica's markers get
+            # collected instead of leaking forever
+            try:
+                store.delete(f"fsm:admission:{entry['replica']}:{uid}")
+            except Exception:
+                pass
         status = store.status(uid)
         if status in (Status.FINISHED, Status.FAILURE):
             store.journal_clear(uid)
+            if mgr is not None:
+                mgr.release(uid)
             report["cleared"].append(uid)
             _RECOVERY_TOTAL.inc(outcome="cleared")
             continue
@@ -1276,7 +1500,8 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
                 "re-submit to re-mine)")
         # keep_frontier: a recovery resubmit that shed (tiny queue at
         # boot) must not destroy the very progress it failed to resume
-        _record_failure(store, uid, failure, keep_frontier=True)
+        _record_failure(store, uid, failure, keep_frontier=True,
+                        lease_mgr=mgr)
         report["failed"].append(uid)
         _RECOVERY_TOTAL.inc(outcome="failed")
     if any(report.values()):

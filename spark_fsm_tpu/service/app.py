@@ -349,6 +349,10 @@ def service_stats(master: Master) -> dict:
         # sheds_total in the metrics block below)
         "admission": {"queued": master.miner.queue_size(),
                       "queue_depth": master.miner.queue_depth},
+        # multi-replica lease layer (service/lease.py): replica id, held
+        # leases, live peers (None = single-replica deployment)
+        "cluster": (None if master.miner._lease is None
+                    else master.miner._lease.stats()),
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
         "mesh_devices": mesh_devices,
@@ -411,6 +415,8 @@ def health_report(master: Master) -> dict:
             "queue_depth": master.miner.queue_depth,
             "live_jobs": jobctl.live_count(),
         },
+        "cluster": (None if master.miner._lease is None
+                    else master.miner._lease.stats()),
         "retry": retry_counters(),
         "watchdog": {**watchdog.stats(),
                      "slack": watchdog.configured_slack()},
@@ -534,6 +540,14 @@ def main() -> None:
               f"{len(report['failed'])} failed durably, "
               f"{len(report['cleared'])} journal entries cleared",
               flush=True)
+    mgr = server.master.miner._lease  # type: ignore[attr-defined]
+    if mgr is not None:
+        # multi-replica mode: peers identify this instance by replica id
+        # in lease/heartbeat keys and /admin/stats
+        print(f"cluster replica {mgr.replica_id} "
+              f"(lease ttl {mgr.lease_ttl_s}s, "
+              f"heartbeat {round(mgr.heartbeat_s, 3)}s, "
+              f"steal {'on' if mgr.steal_enabled else 'off'})", flush=True)
     print(f"spark_fsm_tpu service on http://{cfg.service.host}:"
           f"{server.server_port}", flush=True)
     remote = None

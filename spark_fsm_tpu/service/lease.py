@@ -1,0 +1,759 @@
+"""Lease-fenced multi-replica job ownership — the scale-out unlock.
+
+PR 5's journal recovery documented its own ceiling: liveness was
+inferred from a process-local incarnation id, so exactly ONE service
+instance could own a store ("one store per instance until a
+lease/heartbeat exists").  This module is that lease.  N replicas share
+one Redis namespace safely; the failure of any replica degrades
+CAPACITY (its jobs are adopted after a bounded TTL) instead of
+CORRECTNESS (no double-commit, ever) — the reference's actor-routed
+orchestration generalized across processes, the partitioned-worker
+shape of DIMSpan/the parallel-SPM survey applied to job ownership.
+
+The protocol, in store verbs the MiniRedis test server also speaks:
+
+- **Acquire** (admission): ``SET fsm:lease:{uid} {replica,token} PX ttl
+  NX``.  The FENCING TOKEN comes from ``INCR fsm:lease:token`` — one
+  monotonic sequence per store, so any later acquisition of the same
+  uid (adoption after expiry, work steal) holds a STRICTLY larger
+  token than every earlier one.
+- **Renew**: a per-replica heartbeat thread re-arms every held lease
+  with ``PEXPIRE`` at ``lease_ttl/3``.  Why /3: two full renewal
+  attempts can fail outright before the TTL lapses, so a single slow
+  store round-trip never costs a healthy replica its leases.
+- **Fence**: every journal/checkpoint/result write path consults the
+  local lease record first (one dict read while the TTL is provably
+  live — the adopter must outwait STORE expiry, which postdates our
+  conservative local deadline) and verifies against the store once the
+  local record lapses.  A superseded holder raises
+  :class:`~spark_fsm_tpu.utils.jobctl.JobLeaseLost` and its writes are
+  REFUSED — a replica that wakes from a GC pause/SIGSTOP after its TTL
+  cannot double-commit against the adopting replica's run.
+- **Release** (terminal): compare-and-delete — GET, compare our token,
+  DEL.  The GET→DEL window is the classic CAD caveat; it is bounded by
+  one round-trip against a TTL thousands of times longer, and the
+  fencing token backstops the residual race (a wrongly deleted lease
+  only ever ACCELERATES adoption, never permits double-commit).
+- **Steal** (two-phase claim): each replica mirrors its QUEUED jobs as
+  ``fsm:admission:{replica}:{uid}`` markers.  An idle replica claims a
+  loaded peer's marker with ``DEL`` — the store's atomic "exactly one
+  caller sees 1" arbiter — then takes the lease over with a fresh
+  (larger) token and resubmits the journaled request through its own
+  admission path.  The victim's worker runs the SAME ``DEL`` at
+  dequeue: whoever wins the delete owns the job, the loser walks away,
+  so a queued job is never run twice.  A thief that dies between claim
+  and resubmit leaves a journal orphan whose lease expires — the
+  periodic recovery pass (below) re-adopts it; nothing is ever lost.
+- **Adopt** (boot + periodic recovery): ``recover_orphans`` treats a
+  foreign journal entry as dead ONLY once its lease has expired, and
+  adoption itself is an NX acquire — two replicas booting into the same
+  wreckage race the atomic SET, exactly one adopts each orphan.
+
+Fault sites: ``lease.acquire`` / ``lease.renew`` / ``lease.steal``
+(utils/faults KNOWN_SITES) wrap the protocol's store round-trips;
+the lease layer reads raw keys via ``store.peek`` so chaos drills on
+``store.get`` never alias onto lease verification.
+
+Disabled (``[cluster] enabled = false``, the default) costs the
+single-replica deployment nothing: no manager is built and every guard
+in the Miner is one ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from spark_fsm_tpu.utils import faults, jobctl, obs
+from spark_fsm_tpu.utils.obs import log_event
+
+_HELD = obs.REGISTRY.gauge(
+    "fsm_lease_held", "job leases this replica currently holds")
+_PEERS = obs.REGISTRY.gauge(
+    "fsm_replica_peers", "peer replicas with a live heartbeat record")
+_ACQUIRE_TOTAL = (obs.REGISTRY.counter(
+    "fsm_lease_acquired_total", "lease acquisition attempts, by outcome")
+    .seed(outcome="ok").seed(outcome="held").seed(outcome="error"))
+_RENEW_TOTAL = (obs.REGISTRY.counter(
+    "fsm_lease_renewals_total", "heartbeat lease renewals, by outcome")
+    .seed(outcome="ok").seed(outcome="lost").seed(outcome="error"))
+_REACQUIRED_TOTAL = obs.REGISTRY.counter(
+    "fsm_lease_reacquired_total",
+    "expired-but-unclaimed leases seamlessly reacquired by their holder")
+_LOST_TOTAL = obs.REGISTRY.counter(
+    "fsm_lease_lost_total",
+    "leases this replica lost (expired unrecoverably or superseded)")
+_FENCE_REJECTED_TOTAL = obs.REGISTRY.counter(
+    "fsm_lease_fence_rejections_total",
+    "store writes refused because the writer's lease was superseded — "
+    "each one is a double-commit that did NOT happen")
+_STEAL_TOTAL = (obs.REGISTRY.counter(
+    "fsm_steal_attempts_total", "work-steal claims on peers' queued "
+    "jobs, by outcome").seed(outcome="stolen").seed(outcome="lost_race")
+    .seed(outcome="error"))
+_VICTIM_DROPS_TOTAL = obs.REGISTRY.counter(
+    "fsm_steal_victim_drops_total",
+    "queued jobs this replica dropped at dequeue because a peer had "
+    "already claimed them (the victim side of a successful steal)")
+_HEARTBEATS_TOTAL = obs.REGISTRY.counter(
+    "fsm_replica_heartbeats_total",
+    "heartbeat records published by this replica")
+
+_TOKEN_KEY = "fsm:lease:token"
+
+
+class LeaseHeld(RuntimeError):
+    """Acquisition refused: another replica holds a live lease on the
+    uid.  The admission layer maps it to the same 409 surface as a
+    process-local live-uid conflict — the job IS live, just elsewhere."""
+
+    def __init__(self, uid: str, holder: Optional[str]):
+        self.holder = holder
+        super().__init__(
+            f"uid {uid!r} is leased by replica {holder or 'unknown'!r}; "
+            "resubmitting would race a live job — wait for a terminal "
+            "status or use a new uid")
+
+
+class LeaseUnavailable(RuntimeError):
+    """The lease protocol itself failed (store down, injected fault):
+    the submit cannot be made safe, so it is refused with HTTP 503
+    BEFORE any store trace of the uid exists."""
+
+
+class _Held:
+    """This replica's record of one held lease.  ``expires`` is a LOCAL
+    monotonic deadline computed from the instant just before the store
+    round-trip, so it is always <= the store's own expiry — while
+    ``clock() < expires`` no adopter can exist yet and the fence is one
+    dict read."""
+
+    __slots__ = ("uid", "token", "expires", "ctl", "lost")
+
+    def __init__(self, uid: str, token: int, expires: float):
+        self.uid = uid
+        self.token = token
+        self.expires = expires
+        self.ctl: Optional[jobctl.JobControl] = None
+        self.lost = False
+
+
+class LeaseManager:
+    """One per service replica: owns the replica id, the held-lease
+    table, and the heartbeat thread (renewal + heartbeat record +
+    steal scan + periodic orphan recovery)."""
+
+    def __init__(self, store, replica_id: Optional[str] = None,
+                 lease_ttl_s: float = 10.0,
+                 heartbeat_s: Optional[float] = None,
+                 steal: bool = True,
+                 recover_every_s: Optional[float] = None,
+                 clock=time.monotonic) -> None:
+        if lease_ttl_s <= 0:
+            raise ValueError(f"lease_ttl_s must be > 0 (got {lease_ttl_s})")
+        self._store = store
+        self.replica_id = replica_id or uuid.uuid4().hex[:12]
+        self.lease_ttl_s = float(lease_ttl_s)
+        self._ttl_ms = max(1, int(self.lease_ttl_s * 1000))
+        # ttl/3 so two consecutive renewal failures still leave one
+        # attempt before the TTL lapses (DESIGN.md "Lease protocol").
+        # None = the default cadence; 0 = MANUAL-TICK mode (no thread —
+        # tests drive tick()/renew_all() deterministically)
+        self.heartbeat_s = (self.lease_ttl_s / 3.0 if heartbeat_s is None
+                            else float(heartbeat_s))
+        self.steal_enabled = bool(steal)
+        self.recover_every_s = (float(recover_every_s) if recover_every_s
+                                else self.lease_ttl_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # serializes _verify: the heartbeat's renew_all and a worker's
+        # stale fence() may race the expired-unclaimed NX reacquire —
+        # unserialized, the loser of the replica's OWN two-thread race
+        # would read "claimed by someone" and spuriously self-fence
+        self._verify_lock = threading.Lock()
+        # set during shutdown drain: stop pulling NEW work (steal,
+        # periodic adoption) while held leases keep renewing so the
+        # draining jobs stay fenced-safe to their end
+        self._quiesced = False
+        # peers cache refreshed on the heartbeat cadence: peer_free_total
+        # sits on the 429 shed path, and a shed storm must not turn into
+        # a KEYS storm against the shared store
+        self._peers_cache: tuple = (-1e18, [])
+        self._held: Dict[str, _Held] = {}
+        self._miner = None  # set by start(); duck-typed (Miner)
+        self._recover: Optional[Callable[[], object]] = None
+        self._next_recover = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_config(cls, store, ccfg) -> "LeaseManager":
+        return cls(store,
+                   replica_id=ccfg.replica_id or None,
+                   lease_ttl_s=ccfg.lease_ttl_s,
+                   heartbeat_s=ccfg.heartbeat_s or None,
+                   steal=ccfg.steal,
+                   recover_every_s=ccfg.recover_every_s or None)
+
+    # ------------------------------------------------------------- keys
+
+    @staticmethod
+    def _lease_key(uid: str) -> str:
+        return f"fsm:lease:{uid}"
+
+    def _adm_key(self, uid: str) -> str:
+        return f"fsm:admission:{self.replica_id}:{uid}"
+
+    @property
+    def _hb_key(self) -> str:
+        return f"fsm:replica:{self.replica_id}"
+
+    def _payload(self, token: int) -> str:
+        return json.dumps({"replica": self.replica_id, "token": token})
+
+    @staticmethod
+    def _parse(raw: Optional[str]) -> dict:
+        if not raw:
+            return {}
+        try:
+            out = json.loads(raw)
+            return out if isinstance(out, dict) else {}
+        except ValueError:
+            return {}
+
+    def _journal_ours(self, uid: str) -> bool:
+        """Does the journal intent still name THIS replica?  The
+        reacquire gate: a lease that expired *unclaimed* may be
+        re-taken only while the intent is ours — an adopter/thief
+        rewrites the journal under its own replica id at resubmit, and
+        every terminal path clears it BEFORE releasing the lease, so a
+        stale holder that slept through the entire adopted run (lease
+        long released again) still cannot reacquire and double-commit."""
+        entry = self._parse(self._store.peek(f"fsm:journal:{uid}"))
+        return entry.get("replica") == self.replica_id
+
+    def _set_held(self, uid: str, token: int, expires: float) -> _Held:
+        with self._lock:
+            h = self._held.get(uid)
+            if h is None:
+                h = self._held[uid] = _Held(uid, token, expires)
+            else:
+                h.token, h.expires, h.lost = token, expires, False
+            _HELD.set(len(self._held))
+            return h
+
+    def _mark_lost(self, h: _Held, why: str) -> None:
+        if h.lost:
+            return
+        h.lost = True
+        _LOST_TOTAL.inc()
+        jobctl.fence_lost(h.ctl)
+        log_event("lease_lost", uid=h.uid, token=h.token, why=why,
+                  replica=self.replica_id)
+        # explicit trace id: the heartbeat thread carries no span context
+        with obs.span("lease.lost", trace_id=h.uid, token=h.token, why=why):
+            pass
+
+    # --------------------------------------------------------- protocol
+
+    def acquire(self, uid: str) -> int:
+        """Acquire (or re-enter) the lease for ``uid``; returns the
+        fencing token.  Raises :class:`LeaseHeld` when a peer holds a
+        live lease (the 409 surface) and :class:`LeaseUnavailable` when
+        the protocol itself failed (the 503 surface — zero store trace
+        of the uid exists yet)."""
+        h = self._held.get(uid)
+        if h is not None and not h.lost:
+            # re-entrant: adoption/steal acquired before the resubmit
+            if self._clock() < h.expires:
+                return h.token
+            try:
+                if self._verify(h):
+                    return h.token
+            except Exception:
+                pass  # fall through to a fresh acquisition
+        try:
+            faults.fault_site("lease.acquire", uid=uid)
+            t0 = self._clock()
+            token = int(self._store.incr(_TOKEN_KEY))
+            key = self._lease_key(uid)
+            ok = self._store.set_px(key, self._payload(token), self._ttl_ms,
+                                    nx=True)
+            holder = None
+            if not ok:
+                raw = self._store.peek(key)
+                if raw is None:  # expired between the NX and this read
+                    ok = self._store.set_px(key, self._payload(token),
+                                            self._ttl_ms, nx=True)
+                else:
+                    holder = self._parse(raw).get("replica")
+        except Exception as exc:
+            _ACQUIRE_TOTAL.inc(outcome="error")
+            raise LeaseUnavailable(
+                f"lease acquisition for uid {uid!r} failed: {exc}") from exc
+        if not ok:
+            _ACQUIRE_TOTAL.inc(outcome="held")
+            raise LeaseHeld(uid, holder)
+        _ACQUIRE_TOTAL.inc(outcome="ok")
+        self._set_held(uid, token, t0 + self.lease_ttl_s)
+        return token
+
+    def attach(self, uid: str, ctl: Optional[jobctl.JobControl]) -> None:
+        """Bind the job's control entry so a heartbeat-detected loss
+        self-fences the job at its next safe point.  Binds the OBJECT,
+        not the uid: in multi-replica tests two miners in one process
+        may register the same uid and the flag must land on the
+        incarnation that lost its lease."""
+        h = self._held.get(uid)
+        if h is not None:
+            h.ctl = ctl
+
+    def _verify(self, h: _Held) -> bool:
+        """One store round-trip re-proving ownership of ``h`` and
+        re-arming its TTL.  False = lost (marked, control entry
+        fenced).  Raises on store failure — the caller decides whether
+        an UNVERIFIABLE lease is survivable (heartbeat: yes, until the
+        TTL lapses) or not (a stale fence check: no)."""
+        with self._verify_lock:
+            return self._verify_locked(h)
+
+    def _verify_locked(self, h: _Held) -> bool:
+        faults.fault_site("lease.renew", uid=h.uid)
+        key = self._lease_key(h.uid)
+        t0 = self._clock()
+        raw = self._store.peek(key)
+        if raw is not None:
+            if int(self._parse(raw).get("token", -1)) == h.token:
+                if self._store.pexpire(key, self._ttl_ms):
+                    h.expires = t0 + self.lease_ttl_s
+                    return True
+                raw = None  # expired between the read and the renew
+            else:
+                self._mark_lost(h, "superseded")
+                return False
+        if raw is None:
+            # expired but UNCLAIMED: one atomic NX reacquire decides
+            # between seamless continuation and self-fencing — gated on
+            # the journal intent still being OURS (an absent/foreign
+            # intent means the job was adopted, and possibly already
+            # finished, elsewhere; "the lease key is free again" is NOT
+            # proof nobody superseded us in between)
+            if self._journal_ours(h.uid):
+                token = int(self._store.incr(_TOKEN_KEY))
+                if self._store.set_px(key, self._payload(token),
+                                      self._ttl_ms, nx=True):
+                    h.token = token
+                    h.expires = t0 + self.lease_ttl_s
+                    h.lost = False
+                    _REACQUIRED_TOTAL.inc()
+                    log_event("lease_reacquired", uid=h.uid, token=token)
+                    return True
+                self._mark_lost(h, "expired_and_claimed")
+                return False
+            self._mark_lost(h, "expired_and_disowned")
+            return False
+        self._mark_lost(h, "superseded")
+        return False
+
+    def fence(self, uid: str) -> None:
+        """The write-path guard: raise
+        :class:`~spark_fsm_tpu.utils.jobctl.JobLeaseLost` unless this
+        replica can prove it still owns ``uid``.  One dict read while
+        the local TTL is live; a store verification once it lapses.
+        Uids never leased here (stream pushes) pass untouched."""
+        h = self._held.get(uid)
+        if h is None:
+            return
+        if not h.lost and self._clock() < h.expires:
+            return
+        if not h.lost:
+            try:
+                if self._verify(h):
+                    return
+            except Exception as exc:
+                # unverifiable at a point where the TTL may already have
+                # lapsed: refusing the write is the only safe answer
+                self._mark_lost(h, f"unverifiable: {exc}")
+        _FENCE_REJECTED_TOTAL.inc()
+        raise jobctl.JobLeaseLost(
+            uid, "its replica lease expired or was superseded; refusing "
+                 "the write to avoid double-commit")
+
+    def renew_all(self) -> None:
+        """Heartbeat renewal of every held lease.  A renewal FAILURE is
+        survivable until the TTL lapses (the job keeps running); past
+        it the job is fenced at its next safe point."""
+        for h in list(self._held.values()):
+            if h.lost:
+                continue
+            try:
+                if self._verify(h):
+                    _RENEW_TOTAL.inc(outcome="ok")
+                else:
+                    _RENEW_TOTAL.inc(outcome="lost")
+            except Exception as exc:
+                _RENEW_TOTAL.inc(outcome="error")
+                if self._clock() >= h.expires:
+                    self._mark_lost(h, f"renewal failed past TTL: {exc}")
+
+    def settle_for_failure(self, uid: str) -> bool:
+        """May this replica durably record ``uid``'s failure?  True for
+        never-leased uids and live leases.  For a lost/expired lease,
+        ONE atomic NX reacquire decides: success means nobody adopted
+        (safe to settle durably — a client polling the uid deserves the
+        terminal status); refusal means the adopter owns the uid's keys
+        and this replica's failure must stay local."""
+        h = self._held.get(uid)
+        if h is None:
+            return True
+        if not h.lost and self._clock() < h.expires:
+            return True
+        key = self._lease_key(uid)
+        try:
+            raw = self._store.peek(key)
+            if raw is not None:
+                if int(self._parse(raw).get("token", -1)) == h.token:
+                    return True
+                _FENCE_REJECTED_TOTAL.inc()
+                log_event("lease_failure_write_fenced", uid=uid,
+                          replica=self.replica_id)
+                return False
+            # same reacquire gate as _verify: only settle an expired
+            # lease while the journal intent is still OURS — otherwise
+            # an adopter ran (and may have finished + released) and the
+            # uid's keys are its, not ours
+            if self._journal_ours(uid):
+                t0 = self._clock()
+                token = int(self._store.incr(_TOKEN_KEY))
+                if self._store.set_px(key, self._payload(token),
+                                      self._ttl_ms, nx=True):
+                    self._set_held(uid, token, t0 + self.lease_ttl_s)
+                    return True
+        except Exception as exc:
+            log_event("lease_settle_unverifiable", uid=uid, error=str(exc))
+        _FENCE_REJECTED_TOTAL.inc()
+        return False
+
+    def release(self, uid: str) -> None:
+        """Terminal-status release: compare-and-delete (best effort —
+        the TTL reaps anything this misses, and the fencing token keeps
+        even a misdelete harmless)."""
+        with self._lock:
+            h = self._held.pop(uid, None)
+            _HELD.set(len(self._held))
+        if h is None:
+            return
+        key = self._lease_key(uid)
+        try:
+            if int(self._parse(self._store.peek(key)).get("token", -1)) \
+                    == h.token:
+                self._store.delete(key)
+        except Exception as exc:
+            log_event("lease_release_failed", uid=uid, error=str(exc))
+
+    def forget(self, uid: str) -> None:
+        """Drop the local record WITHOUT touching the store — the victim
+        side of a steal (the thief owns the store lease now)."""
+        with self._lock:
+            self._held.pop(uid, None)
+            _HELD.set(len(self._held))
+
+    def attached_ctl(self, uid: str) -> Optional[jobctl.JobControl]:
+        """The control object bound at attach time — the victim-drop
+        paths release THIS object (jobctl.release_entry), never the
+        uid, which in an in-process multi-replica topology may already
+        map to the thief's live entry."""
+        h = self._held.get(uid)
+        return None if h is None else h.ctl
+
+    def held_uids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._held)
+
+    def token_of(self, uid: str) -> Optional[int]:
+        h = self._held.get(uid)
+        return None if h is None else h.token
+
+    # ------------------------------------------------- adoption (recovery)
+
+    def adopt_expired(self, uid: str) -> bool:
+        """Boot/periodic recovery's adoption gate: True only when the
+        orphan's lease has EXPIRED and this replica won the atomic NX
+        re-acquisition.  A live lease means the job is merely running on
+        a peer — PR 5's recovery would have called it dead and
+        double-submitted it; this check is the multi-replica fix."""
+        key = self._lease_key(uid)
+        try:
+            if self._store.peek(key) is not None:
+                return False  # live on some replica (possibly us)
+            t0 = self._clock()
+            token = int(self._store.incr(_TOKEN_KEY))
+            if not self._store.set_px(key, self._payload(token),
+                                      self._ttl_ms, nx=True):
+                return False  # another recovering replica won the race
+        except Exception as exc:
+            log_event("lease_adopt_failed", uid=uid, error=str(exc))
+            return False
+        self._set_held(uid, token, t0 + self.lease_ttl_s)
+        log_event("lease_adopted", uid=uid, token=token,
+                  replica=self.replica_id)
+        return True
+
+    # ------------------------------------------------------ work stealing
+
+    def publish_admission(self, uid: str) -> None:
+        """Mirror a QUEUED job into this replica's admission namespace —
+        the steal scan's menu."""
+        self._store.set(self._adm_key(uid), "1")
+
+    def retract_admission(self, uid: str) -> bool:
+        """Atomically claim the queued job for LOCAL execution (the
+        worker's dequeue step).  False = a thief already claimed it."""
+        return self._store.delete(self._adm_key(uid)) >= 1
+
+    def stolen_from_us(self, uid: str) -> None:
+        """Victim-side bookkeeping when retract_admission lost the DEL
+        race: drop local state, count, leave the thief's journal/lease
+        untouched."""
+        self.forget(uid)
+        _VICTIM_DROPS_TOTAL.inc()
+        log_event("job_stolen_from_us", uid=uid, replica=self.replica_id)
+        with obs.span("lease.stolen", trace_id=uid,
+                      replica=self.replica_id):
+            pass
+
+    def publish_heartbeat(self) -> None:
+        """Advertise this replica's load (PX = lease TTL, so a dead
+        replica's record vanishes with its leases).  ``free`` — worker
+        slots not covered by running or queued work — is what peers'
+        Retry-After estimators and steal scans read."""
+        m = self._miner
+        self._store.set_px(self._hb_key, json.dumps({
+            "replica": self.replica_id,
+            "queued": m.queue_size() if m is not None else 0,
+            "running": m.running_count() if m is not None else 0,
+            "workers": m.worker_count() if m is not None else 0,
+            # the ONE derivation of free capacity — also the steal
+            # scan's budget (Miner.idle_capacity)
+            "free": m.idle_capacity() if m is not None else 0,
+            # whether this replica WILL actually steal: peers' 429
+            # Retry-After hints must not point at a steal path that is
+            # disabled or quiescing for shutdown
+            "steal": bool(self.steal_enabled and not self._quiesced),
+            "ts": round(time.time(), 3)}), self._ttl_ms)
+        _HEARTBEATS_TOTAL.inc()
+
+    def peers(self, max_age_s: Optional[float] = None) -> List[dict]:
+        """Live peer heartbeat records.  ``max_age_s`` serves a cached
+        scan no older than that — the KEYS walk must stay OFF hot paths
+        (the 429 shed estimator); None forces a fresh scan (the
+        heartbeat tick / steal path)."""
+        if max_age_s is not None:
+            ts, cached = self._peers_cache
+            if self._clock() - ts < max_age_s:
+                return cached
+        out = []
+        for key in self._store.keys("fsm:replica:"):
+            rid = key[len("fsm:replica:"):]
+            if rid == self.replica_id:
+                continue
+            p = self._parse(self._store.peek(key))
+            if p:
+                out.append(p)
+        _PEERS.set(len(out))
+        self._peers_cache = (self._clock(), out)
+        return out
+
+    def peer_free_total(self) -> int:
+        """Cluster-wide advertised free capacity — the Retry-After
+        estimator's steal-path signal (0 on any store hiccup: fail
+        toward the conservative local estimate).  Served from the
+        heartbeat-cadence peer cache: a shed storm must not become a
+        KEYS storm."""
+        try:
+            return sum(max(0, int(p.get("free", 0) or 0))
+                       for p in self.peers(
+                           max_age_s=max(self.heartbeat_s, 1.0))
+                       if p.get("steal"))
+        except Exception:
+            return 0
+
+    def steal_once(self) -> int:
+        """One steal scan: when this replica is idle, claim queued jobs
+        from the most loaded peer's admission namespace, up to our idle
+        capacity.  Returns how many were stolen."""
+        m = self._miner
+        if m is None or not self.steal_enabled or self._quiesced:
+            return 0
+        budget = m.idle_capacity()
+        if budget <= 0 or m.queue_size() > 0:
+            return 0
+        try:
+            peers = self.peers()
+        except Exception:
+            return 0
+        stolen = 0
+        for p in sorted(peers,
+                        key=lambda q: -int(q.get("queued", 0) or 0)):
+            if stolen >= budget or int(p.get("queued", 0) or 0) <= 0:
+                continue
+            prefix = f"fsm:admission:{p.get('replica', '')}:"
+            try:
+                marker_keys = self._store.keys(prefix)
+            except Exception:
+                continue
+            for key in marker_keys:
+                if stolen >= budget:
+                    break
+                uid = key[len(prefix):]
+                try:
+                    if self._steal_one(key, uid, p.get("replica", "")):
+                        stolen += 1
+                except Exception as exc:
+                    _STEAL_TOTAL.inc(outcome="error")
+                    log_event("job_steal_failed", uid=uid, error=str(exc))
+        return stolen
+
+    def _steal_one(self, marker_key: str, uid: str, victim: str) -> bool:
+        """The two-phase claim.  Phase 1: win the marker DEL (exclusive
+        against the victim's dequeue AND other thieves).  Phase 2: take
+        the lease over with a fresh, larger fencing token and resubmit
+        the journaled request through our own admission path.  A failure
+        after phase 1 releases the lease and leaves a journal orphan the
+        periodic recovery pass re-adopts — loud, slow, never lost."""
+        from spark_fsm_tpu.service.model import ServiceRequest
+
+        faults.fault_site("lease.steal", uid=uid, victim=victim)
+        if self._store.delete(marker_key) < 1:
+            _STEAL_TOTAL.inc(outcome="lost_race")
+            return False
+        raw = self._store.peek(f"fsm:journal:{uid}")
+        entry = self._parse(raw)
+        if not entry.get("request"):
+            _STEAL_TOTAL.inc(outcome="lost_race")  # settled under us
+            return False
+        t0 = self._clock()
+        token = int(self._store.incr(_TOKEN_KEY))
+        # unconditional overwrite: the victim's queued-job lease is live,
+        # but the marker DEL above already guarantees it will DROP the
+        # job at dequeue — and our larger token fences any interleaving
+        self._store.set_px(self._lease_key(uid), self._payload(token),
+                           self._ttl_ms)
+        self._set_held(uid, token, t0 + self.lease_ttl_s)
+        req = ServiceRequest("fsm", "train", {
+            str(k): str(v) for k, v in entry["request"].items()})
+        try:
+            self._miner.submit(req)
+        except Exception as exc:
+            # we could not admit it after all (filled up between the
+            # idle check and here, uid conflict, store hiccup): UNDO the
+            # claim so nothing is lost — restore the victim's journal
+            # intent verbatim and its admission marker, then release our
+            # lease.  If the victim's worker has not reached the uid
+            # yet, it wins the restored marker at dequeue and simply
+            # runs the job (the heartbeat's journal-gated NX reacquire
+            # re-owns the lease seamlessly); if it already dropped it,
+            # marker+journal form an orphan the next steal scan or
+            # recovery pass picks up.  Either way: slower, never lost.
+            try:
+                self._store.set(f"fsm:journal:{uid}", raw)
+                self._store.set(marker_key, "1")
+            except Exception as restore_exc:
+                log_event("job_steal_restore_failed", uid=uid,
+                          error=str(restore_exc))
+            self.release(uid)
+            _STEAL_TOTAL.inc(outcome="error")
+            log_event("job_steal_resubmit_failed", uid=uid, victim=victim,
+                      error=str(exc))
+            return False
+        _STEAL_TOTAL.inc(outcome="stolen")
+        log_event("job_stolen", uid=uid, victim=victim,
+                  replica=self.replica_id)
+        with obs.span("lease.steal", trace_id=uid, victim=victim,
+                      replica=self.replica_id):
+            pass
+        return True
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self, miner, recover: Optional[Callable[[], object]] = None
+              ) -> None:
+        """Wire the manager to its Miner and start the heartbeat thread
+        (``heartbeat_s`` <= 0 means manual ticks — tests drive
+        :meth:`tick` directly for determinism)."""
+        self._miner = miner
+        self._recover = recover
+        if self.heartbeat_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"fsm-lease-{self.replica_id[:8]}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            self.tick()
+
+    def tick(self) -> None:
+        """One heartbeat: publish load, renew held leases, and (on
+        cadence) steal + recover.  Each phase is isolated — a store
+        hiccup in one must not starve the others, and the thread must
+        never die."""
+        try:
+            self.publish_heartbeat()
+        except Exception as exc:
+            log_event("lease_heartbeat_failed", error=str(exc))
+        try:
+            self.renew_all()
+        except Exception as exc:
+            log_event("lease_renew_pass_failed", error=str(exc))
+        try:
+            self.steal_once()
+        except Exception as exc:
+            log_event("lease_steal_pass_failed", error=str(exc))
+        if self._recover is not None and not self._quiesced:
+            now = self._clock()
+            if now >= self._next_recover:
+                self._next_recover = now + self.recover_every_s
+                try:
+                    self._recover()
+                except Exception as exc:
+                    log_event("lease_periodic_recovery_failed",
+                              error=str(exc))
+
+    def quiesce(self) -> None:
+        """Stop pulling NEW work (steal scans, periodic adoption) while
+        renewals continue — called at the START of the shutdown drain.
+        Without it, a draining replica could steal a healthy peer's
+        queued job only to give it a durable 'service shutting down'
+        failure the client never deserved."""
+        self._quiesced = True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(max(2.0, 2 * self.heartbeat_s))
+            self._thread = None
+        try:  # retract the heartbeat record so peers stop seeing us
+            self._store.delete(self._hb_key)
+        except Exception:
+            pass
+
+    def stats(self) -> dict:
+        """The /admin/stats ``cluster`` block.  Peers come from the
+        heartbeat-cadence cache — a stats poller must not drive KEYS
+        scans against the shared store."""
+        try:
+            n_peers = len(self.peers(
+                max_age_s=max(self.heartbeat_s, 1.0)))
+        except Exception:
+            n_peers = None
+        return {"replica": self.replica_id,
+                "lease_ttl_s": self.lease_ttl_s,
+                "heartbeat_s": self.heartbeat_s,
+                "steal": self.steal_enabled,
+                "held": len(self._held),
+                "peers": n_peers}

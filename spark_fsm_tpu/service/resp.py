@@ -138,6 +138,26 @@ class RespClient:
     def set(self, key: str, value: str) -> None:
         self.command("SET", key, value)
 
+    def set_px(self, key: str, value: str, px_ms: int,
+               nx: bool = False) -> bool:
+        """``SET key value PX px_ms [NX]`` — the lease-acquisition
+        primitive.  Redis replies +OK on success and Null when NX
+        refused the write; True/False respectively."""
+        args = ["SET", key, value, "PX", int(px_ms)]
+        if nx:
+            args.append("NX")
+        return self.command(*args) == "OK"
+
+    def pexpire(self, key: str, px_ms: int) -> bool:
+        """PEXPIRE — lease heartbeat renewal; False = key gone (lost)."""
+        return self.command("PEXPIRE", key, int(px_ms)) == 1
+
+    def pttl(self, key: str) -> int:
+        """PTTL in ms; -1 = no expiry, -2 = no such key."""
+        reply = self.command("PTTL", key)
+        assert isinstance(reply, int)
+        return reply
+
     def get(self, key: str) -> Optional[str]:
         reply = self.command("GET", key)
         assert reply is None or isinstance(reply, str)

@@ -54,12 +54,32 @@ class _timed:
 
 
 class ResultStore:
-    """Thread-safe in-process store with Redis-like key semantics."""
+    """Thread-safe in-process store with Redis-like key semantics.
 
-    def __init__(self) -> None:
+    ``clock`` (default ``time.monotonic``) drives key EXPIRY — the lease
+    layer's substrate (service/lease.py).  Injectable so lease tests run
+    hermetically against a virtual clock instead of sleeping out TTLs.
+    Expiry is lazy (Redis-style): an expired key is purged the next time
+    any verb touches it or a ``keys`` scan walks past it.
+    """
+
+    def __init__(self, clock=None) -> None:
         self._lock = threading.RLock()
         self._kv: Dict[str, str] = {}
         self._lists: Dict[str, List[str]] = {}
+        self._expiry: Dict[str, float] = {}  # key -> clock() deadline
+        self._clock = clock if clock is not None else time.monotonic
+
+    def _alive(self, key: str) -> bool:
+        """Purge ``key`` if its TTL lapsed; True while it (still) lives.
+        Callers hold ``self._lock``."""
+        deadline = self._expiry.get(key)
+        if deadline is not None and self._clock() >= deadline:
+            self._expiry.pop(key, None)
+            self._kv.pop(key, None)
+            self._lists.pop(key, None)
+            return False
+        return key in self._kv or key in self._lists
 
     # -- generic ops (Redis GET/SET/RPUSH/LRANGE equivalents) --------------
     # The three primary I/O verbs carry fault-site guards (utils/faults):
@@ -71,22 +91,67 @@ class ResultStore:
         with _timed("set", "inproc"):
             faults.fault_site("store.set", key=key)
             with self._lock:
+                # Redis SET semantics: a plain SET clears any TTL
+                self._expiry.pop(key, None)
                 self._kv[key] = value
 
     def get(self, key: str) -> Optional[str]:
         with _timed("get", "inproc"):
             faults.fault_site("store.get", key=key)
             with self._lock:
+                self._alive(key)
                 return self._kv.get(key)
 
     def peek(self, key: str) -> Optional[str]:
-        """Guard-free read for scrape-time metric collectors: skips the
-        fault-injection site AND the latency histogram, so a /metrics
-        scrape can never advance (or consume) an armed ``store.get``
-        trigger mid-chaos-drill, and collector reads don't pollute the
-        I/O latency distribution they exist to report."""
+        """Guard-free read for scrape-time metric collectors AND the
+        lease layer: skips the fault-injection site AND the latency
+        histogram, so a /metrics scrape can never advance (or consume)
+        an armed ``store.get`` trigger mid-chaos-drill, collector reads
+        don't pollute the I/O latency distribution, and lease
+        verification carries its OWN fault sites (``lease.*``) instead
+        of riding the store's."""
         with self._lock:
+            self._alive(key)
             return self._kv.get(key)
+
+    # -- key expiry (the lease layer's substrate) --------------------------
+    # Mirrors the Redis verbs the lease protocol needs: atomic
+    # SET..PX[..NX] for acquisition, PEXPIRE for heartbeat renewal, PTTL
+    # for observation.  Deliberately NOT guarded by the store.* fault
+    # sites — service/lease.py wraps these in its own ``lease.acquire``/
+    # ``lease.renew``/``lease.steal`` sites so chaos drills target the
+    # lease protocol without collateral damage to unrelated store drills.
+
+    def set_px(self, key: str, value: str, px_ms: int,
+               nx: bool = False) -> bool:
+        """Redis ``SET key value PX px_ms [NX]``: write with a TTL;
+        with ``nx`` only when the key does not (or no longer) exists.
+        Returns False when NX refused the write."""
+        with self._lock:
+            if nx and self._alive(key):
+                return False
+            self._kv[key] = value
+            self._expiry[key] = self._clock() + px_ms / 1000.0
+            return True
+
+    def pexpire(self, key: str, px_ms: int) -> bool:
+        """Redis PEXPIRE: re-arm a live key's TTL; False if the key is
+        missing/expired (the lease-renewal race signal)."""
+        with self._lock:
+            if not self._alive(key):
+                return False
+            self._expiry[key] = self._clock() + px_ms / 1000.0
+            return True
+
+    def pttl(self, key: str) -> int:
+        """Redis PTTL: remaining TTL in ms; -1 = no expiry, -2 = no key."""
+        with self._lock:
+            if not self._alive(key):
+                return -2
+            deadline = self._expiry.get(key)
+            if deadline is None:
+                return -1
+            return max(0, int((deadline - self._clock()) * 1000))
 
     def rpush(self, key: str, value: str) -> None:
         with _timed("rpush", "inproc"):
@@ -115,14 +180,22 @@ class ResultStore:
             if lst is not None:
                 del lst[max(0, keep):]
 
-    def delete(self, key: str) -> None:
+    def delete(self, key: str) -> int:
+        """Redis DEL: returns how many keys were removed (0 or 1) — the
+        atomic ownership arbiter the work-stealing claim rides on
+        (exactly ONE caller ever observes 1 for a given live key)."""
         with self._lock:
+            alive = self._alive(key)
+            self._expiry.pop(key, None)
             self._kv.pop(key, None)
             self._lists.pop(key, None)
+            return 1 if alive else 0
 
     def incr(self, key: str) -> int:
-        """Redis INCR: atomic counter (service metrics live on these)."""
+        """Redis INCR: atomic counter (service metrics and the lease
+        fencing-token sequence live on these)."""
         with self._lock:
+            self._alive(key)
             value = int(self._kv.get(key, "0")) + 1
             self._kv[key] = str(value)
             return value
@@ -150,7 +223,7 @@ class ResultStore:
         to KEYS, which blocks the server while it scans)."""
         with self._lock:
             return sorted({k for k in list(self._kv) + list(self._lists)
-                           if k.startswith(prefix)})
+                           if k.startswith(prefix) and self._alive(k)})
 
     # -- write-ahead job journal -------------------------------------------
     # One intent record per live train job (``fsm:journal:{uid}``),
@@ -251,6 +324,16 @@ class RedisResultStore(ResultStore):
     def peek(self, key: str) -> Optional[str]:
         return self._r.get(key)
 
+    def set_px(self, key: str, value: str, px_ms: int,
+               nx: bool = False) -> bool:
+        return self._r.set_px(key, value, px_ms, nx=nx)
+
+    def pexpire(self, key: str, px_ms: int) -> bool:
+        return self._r.pexpire(key, px_ms)
+
+    def pttl(self, key: str) -> int:
+        return self._r.pttl(key)
+
     def rpush(self, key: str, value: str) -> None:
         with _timed("rpush", "redis"):
             faults.fault_site("store.rpush", key=key)
@@ -271,8 +354,8 @@ class RedisResultStore(ResultStore):
         else:
             self._r.ltrim(key, 0, keep - 1)
 
-    def delete(self, key: str) -> None:
-        self._r.delete(key)
+    def delete(self, key: str) -> int:
+        return self._r.delete(key)
 
     def incr(self, key: str) -> int:
         return self._r.incr(key)

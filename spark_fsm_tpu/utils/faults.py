@@ -82,6 +82,16 @@ KNOWN_SITES = (
                          # (models/tsr._mine_resident) — injection must
                          # fall back to the host-driven path with full
                          # parity, never lose the frontier
+    "lease.acquire",     # per-job lease acquisition at admission
+                         # (service/lease.py) — injection must be a clean
+                         # synchronous 503 with ZERO journal/store trace
+    "lease.renew",       # heartbeat renewal + stale-fence verification —
+                         # injection lets the job keep running until its
+                         # TTL lapses, then it self-fences at the next
+                         # safe point (terminal LEASE_LOST, no retry)
+    "lease.steal",       # work-steal claim on a peer's queued job —
+                         # injection must abort the steal cleanly: the
+                         # job stays with (and finishes on) the victim
 )
 
 _EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}

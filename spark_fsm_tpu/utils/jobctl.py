@@ -47,6 +47,10 @@ _CANCELLED_TOTAL = obs.REGISTRY.counter(
 _DEADLINE_TOTAL = obs.REGISTRY.counter(
     "fsm_jobs_deadline_exceeded_total",
     "jobs aborted because their deadline_s budget ran out")
+_LEASE_LOST_TOTAL = obs.REGISTRY.counter(
+    "fsm_jobs_lease_lost_total",
+    "jobs self-fenced because their replica lease expired or was "
+    "superseded (service/lease.py)")
 
 
 class JobAborted(RuntimeError):
@@ -69,13 +73,25 @@ class JobDeadlineExceeded(JobAborted):
     code = "DEADLINE_EXCEEDED"
 
 
+class JobLeaseLost(JobAborted):
+    """The multi-replica fence signal (service/lease.py): this replica's
+    lease on the job expired or was superseded by a peer, so continuing
+    to mine — and above all continuing to WRITE — risks double-commit
+    against the adopting replica's run.  Terminal like every JobAborted;
+    the failure-settling path additionally refuses the store writes when
+    the lease is confirmed superseded."""
+
+    code = "LEASE_LOST"
+
+
 class JobControl:
     """The live-job record.  ``cancelled`` is a plain bool flipped under
     the module lock and read lock-free at check sites (a stale read
     costs one extra launch, never a missed abort — the next check sees
     it)."""
 
-    __slots__ = ("uid", "deadline", "cancelled", "running", "priority")
+    __slots__ = ("uid", "deadline", "cancelled", "running", "priority",
+                 "lease_lost")
 
     def __init__(self, uid: str, deadline: Optional[float],
                  priority: str = "normal"):
@@ -86,6 +102,11 @@ class JobControl:
         # admission class ("high"/"normal"/"low") — read by the fusion
         # broker's window rule (a high job's waves never wait for fill)
         self.priority = priority
+        # flipped by the lease heartbeat (service/lease.py) when this
+        # replica can no longer prove it owns the job — same read
+        # discipline as ``cancelled``: lock-free at check sites, a stale
+        # read costs one extra launch, never a missed fence
+        self.lease_lost = False
 
 
 _lock = threading.Lock()
@@ -101,7 +122,7 @@ _cur: contextvars.ContextVar[Optional[JobControl]] = contextvars.ContextVar(
 
 def _recompute_active_locked() -> None:
     global _active
-    _active = any(c.deadline is not None or c.cancelled
+    _active = any(c.deadline is not None or c.cancelled or c.lease_lost
                   for c in _jobs.values())
 
 
@@ -127,6 +148,21 @@ def release(uid: str) -> None:
         _recompute_active_locked()
 
 
+def release_entry(ctl: Optional[JobControl]) -> None:
+    """Drop a job's entry ONLY if the registry still maps its uid to
+    THIS control object.  The victim side of a work steal must use
+    this: in a multi-replica-in-one-process topology the thief's
+    re-register has replaced the uid's entry, and a release-by-uid from
+    the victim would strip the thief's live job of its deadline/cancel/
+    fence signals."""
+    if ctl is None:
+        return
+    with _lock:
+        if _jobs.get(ctl.uid) is ctl:
+            _jobs.pop(ctl.uid, None)
+            _recompute_active_locked()
+
+
 def get(uid: str) -> Optional[JobControl]:
     with _lock:
         return _jobs.get(uid)
@@ -145,6 +181,20 @@ def cancel(uid: str) -> Optional[str]:
         ctl.cancelled = True
         _active = True
         return "running" if ctl.running else "queued"
+
+
+def fence_lost(ctl: Optional[JobControl]) -> None:
+    """Flip a job's lease-lost flag (lease heartbeat / fence checks call
+    this on the CONTROL OBJECT they captured at attach time, never by
+    uid lookup: in multi-replica-in-one-process tests two miners may
+    register the same uid, and the flag must land on the incarnation
+    that actually lost its lease)."""
+    global _active
+    if ctl is None:
+        return
+    with _lock:
+        ctl.lease_lost = True
+        _active = True
 
 
 def live_count() -> int:
@@ -177,6 +227,12 @@ def check_entry(ctl: Optional[JobControl]) -> None:
         _CANCELLED_TOTAL.inc()
         obs.trace_event("job_cancelled", uid=ctl.uid)
         raise JobCancelled(ctl.uid, "cancelled via /admin/cancel")
+    if ctl.lease_lost:
+        _LEASE_LOST_TOTAL.inc()
+        obs.trace_event("job_lease_lost", uid=ctl.uid)
+        raise JobLeaseLost(
+            ctl.uid, "lost its replica lease (expired or superseded); "
+                     "self-fencing instead of risking a double-commit")
     if ctl.deadline is not None and time.monotonic() > ctl.deadline:
         _DEADLINE_TOTAL.inc()
         obs.trace_event("job_deadline_exceeded", uid=ctl.uid)

@@ -180,6 +180,19 @@ class Counter(_Metric):
             raise ValueError(f"counter {self.name} cannot decrease ({n})")
         self._add(n, labels)
 
+    def seed(self, **labels) -> "Counter":
+        """Zero-seed one LABELLED series (idempotent; never clobbers a
+        live count).  The labelled analog of the unlabelled seed above:
+        a subsystem with a known outcome vocabulary (lease acquire
+        ok/held/error, steal stolen/lost_race/error) seeds every outcome
+        at registration so a scrape reads 0, not no-data, for outcomes
+        that simply have not happened yet — the same orphan-series
+        posture as the fault registry's KNOWN_SITES zero-seeding."""
+        key = _label_key(labels)
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+        return self
+
 
 class Gauge(_Metric):
     kind = "gauge"

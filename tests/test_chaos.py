@@ -833,6 +833,162 @@ def test_admin_faults_gated_and_health_reports_subsystems():
         srv.shutdown()
 
 
+# ------------------------------------------------------------- lease.*
+
+
+def _lease_miner(store, rid, ttl=5.0, heartbeat_s=0.0, depth=8):
+    from spark_fsm_tpu.service.actors import Miner
+    from spark_fsm_tpu.service.lease import LeaseManager
+
+    mgr = LeaseManager(store, replica_id=rid, lease_ttl_s=ttl,
+                       heartbeat_s=heartbeat_s)
+    return Miner(store, workers=1, queue_depth=depth, lease_mgr=mgr), mgr
+
+
+@covers("lease.acquire")
+def test_lease_acquire_fault_is_clean_503_with_zero_trace():
+    """An injected lease-acquisition failure refuses the submit with a
+    clean 503 envelope BEFORE any store write: no status, no journal,
+    no lease key — and the disarmed resubmit admits and finishes."""
+    from spark_fsm_tpu.service.lease import LeaseManager
+
+    store = ResultStore()
+    mgr = LeaseManager(store, replica_id="chaos-acq", lease_ttl_s=5.0,
+                       heartbeat_s=0)
+    master = Master(store=store, lease_mgr=mgr)
+    try:
+        with faults.injected("lease.acquire", nth=1):
+            resp = master.handle(ServiceRequest(
+                "fsm", "train", _submit_data("chaos-lease")))
+        assert resp.status == "failure"
+        assert resp.data["http_status"] == "503"
+        assert "lease acquisition" in resp.data["error"]
+        assert store.status("chaos-lease") is None
+        assert store.journal_get("chaos-lease") is None
+        assert store.peek("fsm:lease:chaos-lease") is None
+        # no admission-slot leak, and the disarmed resubmit runs clean
+        assert master.miner._q._reserved == 0
+        resp = master.handle(ServiceRequest(
+            "fsm", "train", _submit_data("chaos-lease")))
+        assert resp.status == "started", resp.data
+        deadline = time.time() + 60
+        while (store.status("chaos-lease") != "finished"
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert store.status("chaos-lease") == "finished"
+    finally:
+        master.shutdown()
+
+
+@covers("lease.renew")
+def test_lease_renew_fault_job_runs_until_ttl_then_self_fences():
+    """Renewal failures are survivable while the TTL lives — the job
+    KEEPS RUNNING — but once the TTL lapses un-renewed the heartbeat
+    fences the job's control entry and it aborts at its next safe point
+    with a durable terminal ``LEASE_LOST:`` failure (no retry, frontier
+    kept, journal settled)."""
+    from spark_fsm_tpu.service import sources
+    from spark_fsm_tpu.utils import jobctl
+
+    store = ResultStore()
+    # REAL heartbeat cadence (ttl/3): the thread is the renewal path
+    # under drill
+    miner, mgr = _lease_miner(store, "chaos-renew", ttl=0.9,
+                              heartbeat_s=None)
+    gate = threading.Event()
+    entered = threading.Event()
+    real = sources.get_db
+
+    def gated(req, store_):
+        if req.uid == "chaos-held":
+            entered.set()
+            assert gate.wait(60), "gate never freed"
+        return real(req, store_)
+
+    sources.get_db = gated
+    try:
+        with faults.injected("lease.renew", every=1):
+            miner.submit(ServiceRequest(
+                "fsm", "train", _submit_data("chaos-held")))
+            assert entered.wait(60)
+            # the job RUNS while its renewals fail; once the TTL lapses
+            # the heartbeat marks the control entry fenced
+            ctl = jobctl.get("chaos-held")
+            deadline = time.time() + 30
+            while not ctl.lease_lost and time.time() < deadline:
+                time.sleep(0.02)
+            assert ctl.lease_lost, "heartbeat never fenced past-TTL job"
+            gate.set()
+            deadline = time.time() + 60
+            while (store.status("chaos-held") != "failure"
+                   and time.time() < deadline):
+                time.sleep(0.02)
+        assert store.status("chaos-held") == "failure"
+        err = store.get("fsm:error:chaos-held") or ""
+        assert err.startswith("LEASE_LOST"), err
+        # terminal bookkeeping: settled durably (nobody adopted — the
+        # settle path's atomic NX reacquire proved it), no retry burned
+        assert store.journal_get("chaos-held") is None
+        assert jobctl.get("chaos-held") is None
+        assert int(store.get("fsm:metric:jobs_retried") or 0) == 0
+        assert faults.counters()["lease.renew"]["injected"] >= 1
+    finally:
+        sources.get_db = real
+        gate.set()
+        miner.shutdown()
+
+
+@covers("lease.steal")
+def test_lease_steal_fault_leaves_job_with_victim():
+    """An injected steal-claim failure aborts the theft cleanly: the
+    admission marker and the victim's lease are untouched, the steal is
+    counted as an error, and the job finishes ON THE VICTIM."""
+    from spark_fsm_tpu.service import sources
+
+    store = ResultStore()
+    miner_a, mgr_a = _lease_miner(store, "chaos-victim", ttl=30.0)
+    miner_b, mgr_b = _lease_miner(store, "chaos-thief", ttl=30.0)
+    gate = threading.Event()
+    entered = threading.Event()
+    real = sources.get_db
+
+    def gated(req, store_):
+        if req.uid == "chaos-blocker":
+            entered.set()
+            assert gate.wait(60), "gate never freed"
+        return real(req, store_)
+
+    sources.get_db = gated
+    try:
+        miner_a.submit(ServiceRequest(
+            "fsm", "train", _submit_data("chaos-blocker")))
+        assert entered.wait(60)
+        miner_a.submit(ServiceRequest(
+            "fsm", "train", _submit_data("chaos-q1")))
+        mgr_a.publish_heartbeat()
+        with faults.injected("lease.steal", every=1):
+            assert mgr_b.steal_once() == 0
+        # nothing moved: marker intact, lease still the victim's, and
+        # the failed attempt is visible in the counters
+        assert store.keys("fsm:admission:chaos-victim:") == \
+            ["fsm:admission:chaos-victim:chaos-q1"]
+        assert json.loads(
+            store.peek("fsm:lease:chaos-q1"))["replica"] == "chaos-victim"
+        assert faults.counters()["lease.steal"]["injected"] >= 1
+        gate.set()
+        deadline = time.time() + 60
+        while (store.status("chaos-q1") != "finished"
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert store.status("chaos-q1") == "finished"  # victim ran it
+        assert store.journal_uids() == []
+    finally:
+        sources.get_db = real
+        gate.set()
+        miner_a.shutdown()
+        miner_b.shutdown()
+
+
 # ---------------------------------------------------------- device.resident
 
 

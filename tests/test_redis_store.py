@@ -19,12 +19,19 @@ from spark_fsm_tpu.service.store import RedisResultStore
 
 class MiniRedis:
     """RESP2 server on a loopback socket implementing the command subset
-    the store uses: SET/GET/RPUSH/LRANGE/LPOP/LLEN/LTRIM/DEL/INCR/KEYS/
-    PING."""
+    the store uses: SET[ PX ms][ NX]/GET/RPUSH/LRANGE/LPOP/LLEN/LTRIM/
+    DEL/INCR/KEYS/PEXPIRE/PTTL/TTL/PING.
 
-    def __init__(self):
+    Key expiry (the lease layer's substrate) runs on ``self.clock``
+    (default ``time.monotonic``) with Redis-style lazy purge, so lease
+    tests can drive a virtual clock instead of sleeping out TTLs."""
+
+    def __init__(self, clock=None):
         self.kv = {}
         self.lists = {}
+        self.expiry = {}  # key -> clock() deadline
+        self.clock = clock if clock is not None else \
+            __import__("time").monotonic
         self.lock = threading.Lock()
         self.srv = socket.socket()
         self.srv.bind(("127.0.0.1", 0))
@@ -79,6 +86,16 @@ class MiniRedis:
         except (ConnectionError, OSError):
             conn.close()
 
+    def _alive(self, key):
+        """Lazy expiry purge (callers hold the lock)."""
+        deadline = self.expiry.get(key)
+        if deadline is not None and self.clock() >= deadline:
+            self.expiry.pop(key, None)
+            self.kv.pop(key, None)
+            self.lists.pop(key, None)
+            return False
+        return key in self.kv or key in self.lists
+
     def _dispatch(self, args):
         cmd, rest = args[0].upper(), args[1:]
         self.commands_seen.append(cmd)
@@ -86,14 +103,47 @@ class MiniRedis:
             if cmd == "PING":
                 return b"+PONG\r\n"
             if cmd == "SET":
+                px, nx = None, False
+                opts = [o.upper() for o in rest[2:]]
+                i = 0
+                while i < len(opts):
+                    if opts[i] == "PX":
+                        px = int(rest[3 + i])
+                        i += 2
+                    elif opts[i] == "NX":
+                        nx = True
+                        i += 1
+                    else:
+                        return b"-ERR syntax error\r\n"
+                if nx and self._alive(rest[0]):
+                    return b"$-1\r\n"  # NX refused: Null reply
                 self.kv[rest[0]] = rest[1]
+                if px is not None:
+                    self.expiry[rest[0]] = self.clock() + px / 1000.0
+                else:
+                    self.expiry.pop(rest[0], None)  # plain SET clears TTL
                 return b"+OK\r\n"
             if cmd == "GET":
+                self._alive(rest[0])
                 v = self.kv.get(rest[0])
                 if v is None:
                     return b"$-1\r\n"
                 vb = v.encode()
                 return b"$%d\r\n%s\r\n" % (len(vb), vb)
+            if cmd == "PEXPIRE":
+                if not self._alive(rest[0]):
+                    return b":0\r\n"
+                self.expiry[rest[0]] = self.clock() + int(rest[1]) / 1000.0
+                return b":1\r\n"
+            if cmd in ("PTTL", "TTL"):
+                if not self._alive(rest[0]):
+                    return b":-2\r\n"
+                deadline = self.expiry.get(rest[0])
+                if deadline is None:
+                    return b":-1\r\n"
+                left = max(0.0, deadline - self.clock())
+                return b":%d\r\n" % int(left * 1000 if cmd == "PTTL"
+                                        else round(left))
             if cmd == "RPUSH":
                 lst = self.lists.setdefault(rest[0], [])
                 lst.extend(rest[1:])
@@ -125,20 +175,24 @@ class MiniRedis:
             if cmd == "DEL":
                 n = 0
                 for k in rest:
-                    n += (self.kv.pop(k, None) is not None) + \
-                         (self.lists.pop(k, None) is not None)
+                    alive = self._alive(k)
+                    self.expiry.pop(k, None)
+                    n += ((self.kv.pop(k, None) is not None) +
+                          (self.lists.pop(k, None) is not None)) if alive \
+                        else 0
                 return b":%d\r\n" % n
             if cmd == "INCR":
+                self._alive(rest[0])
                 v = int(self.kv.get(rest[0], "0")) + 1
                 self.kv[rest[0]] = str(v)
                 return b":%d\r\n" % v
             if cmd == "KEYS":
-                # prefix globs only — all the store's boot-time journal
-                # scan needs
+                # prefix globs only — all the store's journal/lease
+                # scans need
                 assert rest[0].endswith("*"), rest
                 pre = rest[0][:-1]
                 ks = sorted(k for k in list(self.kv) + list(self.lists)
-                            if k.startswith(pre))
+                            if k.startswith(pre) and self._alive(k))
                 out = [b"*%d\r\n" % len(ks)]
                 for k in ks:
                     kb = k.encode()
@@ -256,6 +310,78 @@ def test_journal_contract_over_wire(mini_redis):
     store2.journal_clear("j1")
     assert store.journal_uids() == ["j2"]
     assert "KEYS" in mini_redis.commands_seen
+
+
+def test_key_expiry_over_wire_with_virtual_clock():
+    """The lease-layer verbs (SET PX NX / PEXPIRE / PTTL) round-trip over
+    RESP against a VIRTUAL monotonic clock — hermetic: no sleeps, no real
+    Redis, exactly the bytes a production Redis would see."""
+    t = [0.0]
+    server = MiniRedis(clock=lambda: t[0])
+    try:
+        c = RespClient(port=server.port)
+        # NX acquisition: first writer wins, second is refused
+        assert c.set_px("lease", "holder-a", 5000, nx=True) is True
+        assert c.set_px("lease", "holder-b", 5000, nx=True) is False
+        assert c.get("lease") == "holder-a"
+        assert 0 < c.pttl("lease") <= 5000
+        # renewal re-arms the TTL
+        t[0] = 4.0
+        assert c.pexpire("lease", 5000) is True
+        t[0] = 8.0  # would be past the ORIGINAL deadline
+        assert c.get("lease") == "holder-a"
+        # expiry: the key lazily purges and NX succeeds again
+        t[0] = 9.5
+        assert c.get("lease") is None
+        assert c.pttl("lease") == -2
+        assert c.pexpire("lease", 1000) is False
+        assert c.set_px("lease", "holder-b", 5000, nx=True) is True
+        # plain SET clears the TTL (Redis semantics)
+        c.set("lease", "holder-b2")
+        assert c.pttl("lease") == -1
+        t[0] = 100.0
+        assert c.get("lease") == "holder-b2"
+        # DEL reports whether the key was still alive — the exclusive
+        # claim arbiter the steal protocol rides on
+        assert c.set_px("claim", "x", 1000) is True
+        assert c.delete("claim") == 1
+        assert c.delete("claim") == 0
+        c.close()
+    finally:
+        server.close()
+
+
+def test_inproc_store_expiry_matches_wire_semantics():
+    """The in-process ResultStore implements the same expiry contract
+    (virtual clock), so lease tests are backend-agnostic."""
+    from spark_fsm_tpu.service.store import ResultStore
+
+    t = [0.0]
+    s = ResultStore(clock=lambda: t[0])
+    assert s.set_px("lease", "a", 2000, nx=True) is True
+    assert s.set_px("lease", "b", 2000, nx=True) is False
+    assert 0 < s.pttl("lease") <= 2000
+    t[0] = 1.5
+    assert s.pexpire("lease", 2000) is True
+    t[0] = 3.0
+    assert s.get("lease") == "a"  # renewed past the original deadline
+    t[0] = 3.6
+    assert s.get("lease") is None
+    assert s.pttl("lease") == -2
+    assert s.pexpire("lease", 500) is False
+    assert s.set_px("lease", "b", 1000, nx=True) is True
+    # expired keys drop out of prefix scans (heartbeat/lease liveness
+    # reads go through keys())
+    assert s.keys("lease") == ["lease"]
+    t[0] = 5.0
+    assert s.keys("lease") == []
+    # plain SET clears a TTL; DEL arbitrates exclusively
+    s.set_px("claim", "x", 1000)
+    s.set("claim", "y")
+    t[0] = 50.0
+    assert s.get("claim") == "y"
+    assert s.delete("claim") == 1
+    assert s.delete("claim") == 0
 
 
 def test_store_fails_fast_when_down():
