@@ -16,7 +16,15 @@ with tracing ON, runs one traced TSR mine end to end, and asserts
   drill needs it, which is the failure mode this guard exists for;
 - the job's ``/admin/trace/{uid}`` dump exists, carries the job root
   span + mine span, and every tsr launch span has predicted seconds
-  next to its measured wall.
+  next to its measured wall;
+- CLUSTER OBSERVABILITY (ISSUE 9; the service boots with ``[cluster]``
+  enabled on the in-proc store): the dump is the MERGED timeline with
+  lifecycle marks (admitted/started/settled) from the durable spine,
+  ``/admin/cluster`` aggregates the heartbeat snapshots,
+  ``/admin/slo`` reports per-priority latency quantiles for the mine
+  that just ran, and the new ``fsm_cluster_*`` / ``fsm_job_*`` /
+  ``fsm_trace_spine_*`` families are present with their label
+  vocabularies zero-seeded (no orphan series).
 
 Usage: scripts/obs_smoke.sh   (pins JAX_PLATFORMS=cpu)
 """
@@ -103,7 +111,9 @@ def main() -> int:
     from spark_fsm_tpu.utils import retry as retrymod
 
     cfgmod.set_config(cfgmod.parse_config(
-        {"observability": {"trace": True}}))
+        {"observability": {"trace": True, "spine_flush_spans": 8},
+         "cluster": {"enabled": True, "replica_id": "obs-smoke",
+                     "lease_ttl_s": 5.0}}))
     srv = serve_background()
     port = srv.server_port
 
@@ -152,22 +162,83 @@ def main() -> int:
                             f"policy site(s) {sorted(missing)}")
         for fam in ("fsm_jobs_finished_total", "fsm_trace_spans_total",
                     "fsm_planner_launches_total", "fsm_store_op_seconds_count",
-                    "fsm_watchdog_guarded_total", "fsm_breaker_state"):
+                    "fsm_watchdog_guarded_total", "fsm_breaker_state",
+                    # ISSUE 9 families: cluster plane, SLO layer, spine
+                    "fsm_cluster_replicas", "fsm_cluster_queue_depth",
+                    "fsm_cluster_in_flight", "fsm_cluster_leases_held",
+                    "fsm_cluster_lease_churn",
+                    "fsm_job_e2e_seconds_count",
+                    "fsm_job_queue_wait_seconds_count",
+                    "fsm_job_exec_seconds_count",
+                    "fsm_job_time_to_adoption_seconds_count",
+                    "fsm_job_steal_latency_seconds_count",
+                    "fsm_trace_spine_writes_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
+        # no orphan LABEL series either: the new vocabularies are
+        # zero-seeded, so a fresh scrape shows every priority class and
+        # every spine-write outcome at 0 instead of no-data
+        for fam, label, want in (
+                ("fsm_job_e2e_seconds_count", "priority",
+                 {"high", "normal", "low"}),
+                ("fsm_job_queue_wait_seconds_count", "priority",
+                 {"high", "normal", "low"}),
+                ("fsm_service_sheds_total", "priority",
+                 {"high", "normal", "low"}),
+                ("fsm_trace_spine_writes_total", "outcome",
+                 {"ok", "fenced", "error"})):
+            got = {m.group(1) for k in families.get(fam, {})
+                   for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
+            missing = want - got
+            if missing:
+                failures.append(f"{fam}: label vocabulary not seeded "
+                                f"({label}={sorted(missing)})")
+
         dump = json.loads(post(f"/admin/trace/{uid}"))
         sites = [s["site"] for s in dump.get("spans", ())]
-        for want in ("job", "job.mine", "tsr.dispatch", "tsr.readback"):
+        for want in ("job", "job.mine", "tsr.dispatch", "tsr.readback",
+                     # lifecycle marks ride the merged spine timeline
+                     "lifecycle.admitted", "lifecycle.started",
+                     "lifecycle.settled"):
             if want not in sites:
                 failures.append(f"trace dump missing span site {want!r} "
                                 f"(got {sorted(set(sites))})")
+        if not dump.get("merged"):
+            failures.append("cluster-mode trace dump is not the merged "
+                            "spine timeline")
         for s in dump.get("spans", ()):
             if s["site"] == "tsr.launch" and (
                     "predicted_s" not in s.get("attrs", {})
                     or s.get("duration_s") is None):
                 failures.append(f"launch span without predicted/measured "
                                 f"seconds: {s}")
+
+        # ---- /admin/cluster: aggregated heartbeat view from any replica
+        cluster = json.loads(post("/admin/cluster"))
+        if not cluster.get("enabled"):
+            failures.append(f"/admin/cluster reports disabled: {cluster}")
+        totals = cluster.get("totals", {})
+        if totals.get("replicas", 0) < 1:
+            failures.append(f"/admin/cluster sees no live replicas: "
+                            f"{totals}")
+        for key in ("queued", "running", "free", "held", "sheds",
+                    "lease_churn"):
+            if key not in totals:
+                failures.append(f"/admin/cluster totals missing {key!r}")
+
+        # ---- /admin/slo: the finished mine must appear in its
+        # priority's sliding window with a full quantile row
+        slo = json.loads(post("/admin/slo"))
+        row = slo.get("priorities", {}).get("normal", {})
+        e2e = row.get("e2e", {})
+        if e2e.get("count", 0) < 1:
+            failures.append(f"/admin/slo saw no finished job: {slo}")
+        elif not all(k in e2e for k in ("p50", "p95", "p99")):
+            failures.append(f"/admin/slo e2e row incomplete: {e2e}")
+        qw = row.get("queue_wait", {})
+        if qw.get("count", 0) < 1:
+            failures.append(f"/admin/slo queue_wait missing: {row}")
     finally:
         srv.master.shutdown()
         srv.shutdown()

@@ -15,11 +15,20 @@ The CI companion to overload_smoke for the lease layer
 4. kill -9s A while it holds the checkpointed drill mid-mine
    (frontier + journal + a live lease persisted in the MiniRedis);
 5. FAILOVER: B's periodic recovery must adopt the drill only after its
-   lease EXPIRES (bounded by ttl + one recovery tick), resume it from
-   the persisted frontier, and finish with the EXACT oracle pattern
-   set — zero duplicated results;
-6. asserts every journal intent and lease is settled and the
-   fsm_lease_*/fsm_steal_* metric families are live on B's /metrics.
+   lease EXPIRES, resume it from the persisted frontier, and finish
+   with the EXACT oracle pattern set — zero duplicated results.  The
+   failover bound is read from the SERVICE's own
+   ``fsm_job_time_to_adoption_seconds`` histogram (ISSUE 9) and
+   asserted against the lease-TTL-derived bound — not from shell
+   wall-clock;
+6. CLUSTER FLIGHT RECORDER (ISSUE 9): ``/admin/trace/drill`` served by
+   the SURVIVOR must return one merged timeline whose spans come from
+   BOTH replicas — admission + mine progress flushed by dead A through
+   the fenced spine, adoption + completion from B — ordered by wall
+   time; ``/admin/cluster`` aggregates both replicas while both live;
+7. asserts every journal intent and lease is settled and the
+   fsm_lease_*/fsm_steal_*/fsm_job_* metric families are live on B's
+   /metrics.
 
 The stale-incarnation fencing half of the acceptance (late writes
 REJECTED) cannot be driven by kill -9 — a dead process writes nothing —
@@ -137,6 +146,10 @@ def main():
                       "port": mini.port},
             "cluster": {"enabled": True, "lease_ttl_s": LEASE_TTL_S,
                         "recover_every_s": RECOVER_EVERY_S},
+            # cluster flight recorder: traced jobs flush their spans to
+            # the durable spine (small threshold so A's mine progress
+            # lands between checkpoints too)
+            "observability": {"trace": True, "spine_flush_spans": 8},
             # pin the queue engine so the checkpointed drill takes the
             # segmented path (frontier saves at every segment boundary)
             "engine": {"fused": "queue"},
@@ -184,12 +197,28 @@ def main():
             assert proc_a.poll() is None and proc_b.poll() is None
             time.sleep(0.1)
         assert done == ["finished", "finished"], done
-        stolen = series_sum(scrape(port_b), "fsm_steal_attempts_total",
+        text_b = scrape(port_b)
+        stolen = series_sum(text_b, "fsm_steal_attempts_total",
                             'outcome="stolen"')
         assert stolen >= 2, f"B stole {stolen} jobs, expected both fillers"
         drops = series_sum(scrape(port_a), "fsm_steal_victim_drops_total")
+        # the thief's steal-latency histogram observed both claims
+        steal_lat_n = series_sum(text_b,
+                                 "fsm_job_steal_latency_seconds_count")
+        assert steal_lat_n >= 2, \
+            f"steal latency histogram saw {steal_lat_n} claims"
         log(f"steal ok: B stole {int(stolen)} queued fillers "
-            f"(A dropped {int(drops)} at dequeue), both finished on B")
+            f"(A dropped {int(drops)} at dequeue), both finished on B; "
+            f"fsm_job_steal_latency_seconds observed {int(steal_lat_n)}")
+
+        # ---- cluster plane: while BOTH replicas live, either serves
+        # the aggregated heartbeat view
+        code, _, cluster = post(port_b, "/admin/cluster")
+        assert code == 200 and cluster.get("enabled"), cluster
+        assert cluster["totals"]["replicas"] == 2, cluster["totals"]
+        log(f"cluster view ok: /admin/cluster on B sees "
+            f"{cluster['totals']['replicas']} replicas "
+            f"(totals {cluster['totals']})")
 
         # ---- failover: kill A between frontier saves, mid-mine
         deadline = time.time() + DRILL_TIMEOUT_S
@@ -221,12 +250,35 @@ def main():
                 break
             time.sleep(0.05)
         assert t_adopt is not None, "B never adopted the drill"
-        adopt_wall = t_adopt - t_kill
-        bound = LEASE_TTL_S + RECOVER_EVERY_S + 3.0
-        assert adopt_wall <= bound, \
-            f"adoption took {adopt_wall:.1f}s (bound {bound:.1f}s)"
+        adopt_wall = t_adopt - t_kill  # informational only — the
+        # asserted number is the service's own histogram below
+        # (ISSUE 9: time-to-adoption is OBSERVABLE, not shell-derived).
+        # The histogram's reference point is A's last durable spine
+        # flush (its last checkpoint), which predates the kill by up to
+        # one slowed save — the bound allows for it.
+        # the histogram is observed just AFTER the adoption resubmit
+        # rewrites the journal (the signal the loop above watched) —
+        # poll briefly rather than racing a single scrape against it
+        n = s = 0.0
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            text = scrape(port_b)
+            n = series_sum(text, "fsm_job_time_to_adoption_seconds_count")
+            if n >= 1:
+                s = series_sum(text,
+                               "fsm_job_time_to_adoption_seconds_sum")
+                break
+            time.sleep(0.1)
+        assert n >= 1, "B never observed fsm_job_time_to_adoption_seconds"
+        observed = s / n
+        bound = LEASE_TTL_S + RECOVER_EVERY_S + 5.0
+        assert 0.0 < observed <= bound, \
+            (f"histogram time-to-adoption {observed:.1f}s outside the "
+             f"TTL-derived bound {bound:.1f}s")
         log(f"failover ok: B adopted the drill {adopt_wall:.1f}s after "
-            f"the kill (lease ttl {LEASE_TTL_S}s)")
+            f"the kill; fsm_job_time_to_adoption_seconds observed "
+            f"{observed:.1f}s (bound {bound:.1f}s, lease ttl "
+            f"{LEASE_TTL_S}s)")
 
         status = None
         deadline = time.time() + DRILL_TIMEOUT_S
@@ -249,6 +301,35 @@ def main():
         log(f"oracle parity ok: {len(got)} patterns, zero duplicated "
             "results")
 
+        # ---- cluster flight recorder: the SURVIVOR serves one merged
+        # timeline holding the dead owner's admission/mine spans next
+        # to its own adoption/completion spans, ordered by wall time
+        code, _, merged = post(port_b, "/admin/trace/drill")
+        assert code == 200, merged
+        assert merged.get("merged"), "B served a local-only trace dump"
+        spans = merged["spans"]
+        reps = {s.get("replica") for s in spans}
+        assert rep_a in reps and rep_b in reps, \
+            f"merged timeline missing a replica: {reps}"
+        sites_a = {s["site"] for s in spans if s.get("replica") == rep_a}
+        sites_b = {s["site"] for s in spans if s.get("replica") == rep_b}
+        assert "lifecycle.admitted" in sites_a, \
+            f"no admission span from dead A (A sites: {sorted(sites_a)})"
+        mine_sites = {"job.dataset", "queue.dispatch", "queue.segment",
+                      "queue.readback", "checkpoint.save",
+                      "lifecycle.checkpointed"}
+        assert sites_a & mine_sites, \
+            f"no mine-progress spans from dead A: {sorted(sites_a)}"
+        assert "lifecycle.adopted" in sites_b, \
+            f"no adoption span from B: {sorted(sites_b)}"
+        assert {"lifecycle.settled", "job"} & sites_b, \
+            f"no completion span from B: {sorted(sites_b)}"
+        ts = [s.get("ts") or 0 for s in spans]
+        assert ts == sorted(ts), "merged timeline not wall-monotonic"
+        log(f"merged timeline ok: {len(spans)} spans from "
+            f"{sorted(reps)} ({len(sites_a)} sites from dead A, "
+            f"{len(sites_b)} from B), wall-ordered")
+
         # every journal intent + lease settled; metric families live
         assert client.keys("fsm:journal:*") == []
         assert client.get("fsm:lease:drill") is None
@@ -257,7 +338,10 @@ def main():
         for fam in ("fsm_lease_acquired_total", "fsm_lease_held",
                     "fsm_lease_fence_rejections_total",
                     "fsm_steal_attempts_total",
-                    "fsm_replica_heartbeats_total"):
+                    "fsm_replica_heartbeats_total",
+                    "fsm_trace_spine_writes_total",
+                    "fsm_job_e2e_seconds_count",
+                    "fsm_cluster_replicas"):
             series_sum(text, fam)
         resumed = series_sum(text, "fsm_recovery_jobs_total",
                              'outcome="resumed"')

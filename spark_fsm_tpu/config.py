@@ -71,6 +71,13 @@ File format (TOML shown; JSON with the same nesting also accepted):
                                     # off = one global read per probe
     trace_max_spans = 512           # completed-span ring per job
     trace_jobs = 16                 # job traces kept (oldest evicted)
+    spine_flush_spans = 32          # spans buffered per trace before an
+                                    # automatic durable-spine flush
+                                    # (cluster mode; terminal paths and
+                                    # checkpoint saves always flush)
+    spine_max_chunks = 256          # fsm:trace:{uid} retention: newest
+                                    # N chunks kept (0 = unbounded)
+    slo_window_s = 300.0            # /admin/slo sliding window
 
     [fusion]
     enabled = false                 # cross-job launch fusion broker
@@ -190,11 +197,21 @@ class ObservabilityConfig:
     a lock + dict update, and a scrape must work on any deployment).
     ``trace_max_spans`` bounds each job's completed-span ring (oldest
     evicted first); ``trace_jobs`` bounds how many job traces are kept.
+
+    Cluster observability plane (ISSUE 9, service/obsplane.py):
+    ``spine_flush_spans`` is how many completed spans buffer per trace
+    before an automatic flush to the durable spine (``fsm:trace:{uid}``;
+    checkpoint saves and terminal paths flush regardless);
+    ``spine_max_chunks`` bounds each uid's spine list (newest kept,
+    0 = unbounded); ``slo_window_s`` is the /admin/slo sliding window.
     """
 
     trace: bool = False
     trace_max_spans: int = 512
     trace_jobs: int = 16
+    spine_flush_spans: int = 32
+    spine_max_chunks: int = 256
+    slo_window_s: float = 300.0
 
 
 @dataclasses.dataclass
@@ -347,6 +364,13 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("observability.trace_max_spans must be >= 1")
     if cfg.observability.trace_jobs < 1:
         raise ConfigError("observability.trace_jobs must be >= 1")
+    if cfg.observability.spine_flush_spans < 1:
+        raise ConfigError("observability.spine_flush_spans must be >= 1")
+    if cfg.observability.spine_max_chunks < 0:
+        raise ConfigError(
+            "observability.spine_max_chunks must be >= 0 (0 = unbounded)")
+    if cfg.observability.slo_window_s <= 0:
+        raise ConfigError("observability.slo_window_s must be > 0")
     if cfg.engine.fused not in (None, "auto", "always", "never",
                                 "queue", "dense"):
         raise ConfigError(
@@ -430,6 +454,11 @@ def set_config(cfg: Config) -> None:
     from spark_fsm_tpu.service import fusion
 
     fusion.configure(cfg.fusion)
+    # cluster observability plane knobs (spine flush/retention, SLO
+    # window) — same process-global ownership as the three above
+    from spark_fsm_tpu.service import obsplane
+
+    obsplane.configure(cfg.observability)
 
 
 def engine_kwargs(*names: str) -> Dict[str, Any]:

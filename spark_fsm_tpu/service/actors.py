@@ -23,7 +23,7 @@ from typing import Deque, Dict, List, Optional
 
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
-from spark_fsm_tpu.service import lease, model, plugins, sources
+from spark_fsm_tpu.service import lease, model, obsplane, plugins, sources
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 from spark_fsm_tpu.utils import faults, jobctl, obs
@@ -69,9 +69,14 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
         ctl = lease_mgr.attached_ctl(uid)
         lease_mgr.forget(uid)
         jobctl.release_entry(ctl)
+        # the fenced epoch's buffered spans must not reach the adopter's
+        # spine either: tombstone first, then drain the buffer through
+        # the (now refusing) flush so the rejection is COUNTED
+        obsplane.mark_fenced(uid)
         log_event("job_failed_fenced", uid=uid, error=str(exc))
         with obs.span("job.failed_fenced", trace_id=uid, error=str(exc)):
             pass
+        obs.flush_trace(uid)
         return
     store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
     store.add_status(uid, Status.FAILURE)
@@ -86,14 +91,19 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
     # the job-control entry released (stream uids have neither — no-ops)
     store.journal_clear(uid)
     jobctl.release(uid)
-    if lease_mgr is not None:
-        lease_mgr.release(uid)
     log_event("job_failed", uid=uid, error=str(exc))
     # stamp the terminal failure into the job's flight-recorder ring
     # (explicit trace_id: failures land from threads with no active
-    # trace context — the drain path, the submit-after-shutdown path)
+    # trace context — the drain path, the submit-after-shutdown path),
+    # then flush the spine BEFORE releasing the lease so the final
+    # chunk still rides the fenced write path
     with obs.span("job.failed", trace_id=uid, error=str(exc)):
         pass
+    obs.lifecycle(uid, "settled", outcome="failure",
+                  code=getattr(exc, "code", type(exc).__name__))
+    obs.flush_trace(uid)
+    if lease_mgr is not None:
+        lease_mgr.release(uid)
 
 
 def _profile_dir(req: ServiceRequest, uid: str) -> str:
@@ -199,6 +209,12 @@ class StoreCheckpoint:
     def save(self, state: dict) -> None:
         with obs.span("checkpoint.save", trace_id=self.uid):
             self._save(state)
+        # a successful save is a durable milestone: mark it and flush
+        # the trace spine so a kill -9 loses at most the spans since
+        # the last checkpoint — exactly the window the frontier itself
+        # bounds (the replica_smoke failover timeline reads off this)
+        obs.lifecycle(self.uid, "checkpointed")
+        obs.flush_trace(self.uid)
 
     def _save(self, state: dict) -> None:
         if self._lease is not None:
@@ -266,7 +282,9 @@ class UidConflict(RuntimeError):
             "wipe its state — wait for a terminal status or use a new uid")
 
 
-PRIORITIES = ("high", "normal", "low")
+# the ONE priority vocabulary (admission classes, SLO label seeding)
+# lives in obsplane — actors imports it so the two can never drift
+PRIORITIES = obsplane.PRIORITIES
 
 _QUEUE_DEPTH = obs.REGISTRY.gauge(
     "fsm_service_queue_depth",
@@ -274,6 +292,8 @@ _QUEUE_DEPTH = obs.REGISTRY.gauge(
 _SHEDS_TOTAL = obs.REGISTRY.counter(
     "fsm_service_sheds_total",
     "train submits refused with 429 because the admission queue was full")
+for _p in PRIORITIES:
+    _SHEDS_TOTAL.seed(priority=_p)
 
 
 class AdmissionQueue:
@@ -456,6 +476,12 @@ class Miner:
             # periodic-recovery callback after it exists (start() is
             # idempotent on the thread, updates the callback)
             self._lease.start(self)
+            # cluster observability plane (ISSUE 9): durable trace
+            # spine through the fenced write path + fsm_cluster_*
+            # collector.  Last Miner wins, like the jobs collector;
+            # solo deployments install nothing and the recorder's
+            # spine probe stays one module-global read.
+            obsplane.install(self.store, self._lease)
 
     # ------------------------------------------------------------ admission
 
@@ -474,6 +500,17 @@ class Miner:
         steal scan's budget (and the heartbeat's ``free`` field)."""
         return max(0, self.worker_count() - self.running_count()
                    - self.queue_size())
+
+    def sheds_total(self) -> float:
+        """Lifetime 429 sheds (all priorities) — piggybacked on the
+        lease heartbeat's metric snapshot."""
+        return _SHEDS_TOTAL.total()
+
+    def wall_ewma(self) -> Optional[float]:
+        """EWMA of measured job walls (None before the first finish) —
+        the heartbeat snapshot's load-cost hint."""
+        with self._wall_lock:
+            return self._wall_ewma
 
     def settle_cancelled_queued(self, uid: str) -> bool:
         """Settle a job cancelled while still QUEUED: remove it from the
@@ -667,10 +704,17 @@ class Miner:
                       priority=priority)
             # the flight-recorder trace opens AT SUBMIT (handler thread):
             # the queue wait before a worker picks the job up is part of
-            # the job's story under load
+            # the job's story under load.  The admission lifecycle mark
+            # flushes to the durable spine immediately — admission is
+            # the one event a failover timeline cannot reconstruct from
+            # anywhere else once the admitting replica is dead.
             obs.trace_begin(req.uid,
                             algorithm=req.param("algorithm", "SPADE_TPU"),
                             source=req.param("source", "FILE"))
+            obs.lifecycle(req.uid, "admitted", priority=priority,
+                          replica=(self._lease.replica_id
+                                   if self._lease is not None else None))
+            obs.flush_trace(req.uid)
             with self._stop_lock:
                 if not self._stopping:
                     # enqueued strictly BEFORE the sentinels (the lock
@@ -818,13 +862,27 @@ class Miner:
     def _run(self, req: ServiceRequest) -> None:
         # the job's root flight-recorder span: every engine/planner/IO
         # span below threads under it via the contextvar — no plumbing
-        with obs.trace(req.uid, site="job",
-                       algorithm=req.param("algorithm", "SPADE_TPU"),
-                       source=req.param("source", "FILE")) as job_sp:
-            self._run_traced(req, job_sp)
+        try:
+            with obs.trace(req.uid, site="job",
+                           algorithm=req.param("algorithm", "SPADE_TPU"),
+                           source=req.param("source", "FILE")) as job_sp:
+                self._run_traced(req, job_sp)
+        finally:
+            # the root span closes on trace exit, AFTER the terminal
+            # flush inside — push it too, so the spine's last chunk
+            # carries the job's whole-wall span (post-release, so it
+            # lands unfenced: the uid was settled by this replica)
+            obs.flush_trace(req.uid)
 
     def _run_traced(self, req: ServiceRequest, job_sp) -> None:
         t0 = time.perf_counter()
+        ctl = jobctl.current()
+        # first-pickup lifecycle mark with the measured queue wait —
+        # the observation point the per-priority SLO split reads
+        obs.lifecycle(req.uid, "started",
+                      queue_wait_s=(
+                          None if ctl is None or ctl.started_t is None
+                          else round(ctl.started_t - ctl.submitted_t, 6)))
         with obs.span("job.dataset"):
             db = sources.get_db(req, self.store)
         # coarse safe point shared by every algorithm: a cancel/deadline
@@ -886,6 +944,18 @@ class Miner:
         # recovery pass sees 'finished' and just clears the journal)
         self.store.journal_clear(req.uid)
         jobctl.release(req.uid)
+        # SLO accounting (submit -> durable result, per priority) + the
+        # settled lifecycle mark, flushed to the spine while the lease
+        # is STILL HELD so the final chunk rides the fenced write path
+        if ctl is not None:
+            now_m = time.monotonic()
+            e2e_s = now_m - ctl.submitted_t
+            queue_wait_s = max(0.0, (ctl.started_t or now_m)
+                               - ctl.submitted_t)
+            obsplane.observe_job(ctl.priority, e2e_s, queue_wait_s,
+                                 max(0.0, e2e_s - queue_wait_s))
+        obs.lifecycle(req.uid, "settled", outcome="finished")
+        obs.flush_trace(req.uid)
         if self._lease is not None:
             self._lease.release(req.uid)
         self.store.incr("fsm:metric:jobs_finished")
@@ -1369,10 +1439,22 @@ class Master:
                 self.miner.submit(req)
             except AdmissionShed as exc:
                 # overload shed: protocol-mapped to 429 + Retry-After by
-                # the HTTP layer (remote clients read retry_after_s)
+                # the HTTP layer (remote clients read retry_after_s).
+                # In cluster mode the body carries the same cached peer
+                # view the Retry-After hint consulted, so the client can
+                # see whether the hint means "steal path" or "local
+                # EWMA" (docs/OPERATIONS.md).
+                extra: Dict[str, str] = {}
+                if self.miner._lease is not None:
+                    try:
+                        extra["cluster"] = json.dumps(
+                            self.miner._lease.shed_view())
+                    except Exception:
+                        pass
                 return model.response(req, Status.FAILURE, error=str(exc),
                                       http_status="429",
-                                      retry_after_s=str(exc.retry_after_s))
+                                      retry_after_s=str(exc.retry_after_s),
+                                      **extra)
             except UidConflict as exc:
                 return model.response(req, Status.FAILURE, error=str(exc),
                                       http_status="409")
@@ -1479,6 +1561,25 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
             report["cleared"].append(uid)
             _RECOVERY_TOTAL.inc(outcome="cleared")
             continue
+        # failover latency candidate, measured BEFORE the resubmit (the
+        # resubmit's own spine flush would reset the reference): the
+        # dead owner's last provable sign of life (its final spine
+        # flush; journal intent ts when it never flushed) to now.
+        # Bounded by lease_ttl_s + recover_every_s (+ the owner's flush
+        # cadence) on a healthy cluster — replica_smoke asserts it.
+        # Observed into the histogram only on a SUCCESSFUL adoption
+        # resume below: an orphan settled as a durable failure was not
+        # adopted in the sense the metric's alert contract promises.
+        adoption_s = None
+        if mgr is not None:
+            ref_ts = obsplane.last_activity_ts(store, uid)
+            if ref_ts is None:
+                try:
+                    ref_ts = float(entry.get("ts") or 0) or None
+                except (TypeError, ValueError):
+                    ref_ts = None
+            if ref_ts is not None:
+                adoption_s = max(0.0, time.time() - ref_ts)
         if entry.get("checkpoint"):
             req = ServiceRequest("fsm", "train", {
                 str(k): str(v) for k, v in entry.get("request", {}).items()})
@@ -1487,6 +1588,18 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
                 report["resumed"].append(uid)
                 _RECOVERY_TOTAL.inc(outcome="resumed")
                 log_event("restart_recovery_resumed", uid=uid)
+                if mgr is not None:
+                    if adoption_s is not None:
+                        obsplane.observe_adoption(adoption_s)
+                    # the resubmit re-opened the trace ring: stamp the
+                    # adoption onto the spine so the merged timeline
+                    # shows owner-death -> adoption in one place
+                    obs.lifecycle(
+                        uid, "adopted", replica=mgr.replica_id,
+                        time_to_adoption_s=(
+                            None if adoption_s is None
+                            else round(adoption_s, 3)))
+                    obs.flush_trace(uid)
                 continue
             except Exception as exc:  # shed (tiny queue at boot) or a
                 # store hiccup: fall through to the durable failure —

@@ -37,8 +37,25 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         exposition format (GET; utils/obs.REGISTRY —
                         point a scrape job here);
   /admin/trace/{job}  — flight-recorder span dump for a job uid (JSON;
-                        requires [observability] trace = true);
+                        requires [observability] trace = true).  In
+                        cluster mode the response is the MERGED
+                        cross-replica timeline: the durable trace spine
+                        (fsm:trace:{uid}, written through the fenced
+                        path) plus this replica's local ring, ordered
+                        by wall time — after a failover the survivor
+                        serves admission-on-A → adoption-on-B end to
+                        end (service/obsplane.py);
   /admin/trace/last   — the most recently touched trace;
+  /admin/cluster      — aggregated cluster view from the lease
+                        heartbeats' piggybacked metric snapshots:
+                        per-replica rows + totals (queued, in-flight,
+                        free, leases held, sheds, lease churn) — same
+                        answer from ANY replica;
+  /admin/slo          — per-priority p50/p95/p99 of end-to-end job
+                        latency (submit → durable result) with
+                        queue-wait/execution split, over a sliding
+                        window ([observability] slo_window_s) — the
+                        service-side counterpart of bench_throughput;
   /admin/cancel/{uid} — abort a live (queued or running) train job at
                         its next safe point; 404 when no live job owns
                         the uid
@@ -263,6 +280,8 @@ class FsmHandler(BaseHTTPRequestHandler):
                 # read-only flight-recorder dumps: /admin/trace/{job_id}
                 # (uid may itself contain slashes — keep the whole tail),
                 # /admin/trace/last, bare /admin/trace lists trace ids
+                from spark_fsm_tpu.service import obsplane
+
                 _, _, tid = task.partition("/")
                 if not tid:
                     self._send(200, json.dumps({
@@ -274,6 +293,18 @@ class FsmHandler(BaseHTTPRequestHandler):
                 if tid == "last":
                     tid = obs.last_trace_id() or ""
                 dump = obs.trace_dump(tid) if tid else None
+                mgr = self.master.miner._lease
+                if mgr is not None and tid:
+                    # cluster mode: merge the durable spine with the
+                    # local ring — after a failover THIS replica can
+                    # serve the dead owner's spans too
+                    p = obsplane.plane()
+                    merged = obsplane.merged_timeline(
+                        self.master.store, tid, dump,
+                        replica_id=mgr.replica_id,
+                        boot_id=p.boot_id if p is not None else None)
+                    if merged is not None and (merged["spans"] or dump):
+                        dump = merged
                 if dump is None:
                     self._send(404, json.dumps({
                         "status": "failure",
@@ -283,6 +314,21 @@ class FsmHandler(BaseHTTPRequestHandler):
                                   "trace = true in the boot config)")}))
                     return
                 self._send(200, json.dumps(dump))
+            elif task == "cluster":
+                # aggregated cluster view from the heartbeat records'
+                # piggybacked snapshots (served from the heartbeat-
+                # cadence peer cache — polling this cannot become a
+                # store scan storm)
+                mgr = self.master.miner._lease
+                if mgr is None:
+                    self._send(200, json.dumps({"enabled": False}))
+                else:
+                    self._send(200, json.dumps(
+                        {"enabled": True, **mgr.cluster_view()}))
+            elif task == "slo":
+                from spark_fsm_tpu.service import obsplane
+
+                self._send(200, json.dumps(obsplane.slo_snapshot()))
             elif task == "shapes":
                 # enumerated (last prewarm) vs runtime-recorded shape
                 # keys; "drift" lists observed geometries prewarm missed

@@ -67,6 +67,7 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional
 
+from spark_fsm_tpu.service import obsplane
 from spark_fsm_tpu.utils import faults, jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event
 
@@ -251,10 +252,14 @@ class LeaseManager:
         h.lost = True
         _LOST_TOTAL.inc()
         jobctl.fence_lost(h.ctl)
+        # tombstone the uid on the trace spine too: a stale holder's
+        # buffered spans must never flush onto the adopter's timeline
+        obsplane.mark_fenced(h.uid)
         log_event("lease_lost", uid=h.uid, token=h.token, why=why,
                   replica=self.replica_id)
         # explicit trace id: the heartbeat thread carries no span context
-        with obs.span("lease.lost", trace_id=h.uid, token=h.token, why=why):
+        with obs.span("lifecycle.fenced", trace_id=h.uid, token=h.token,
+                      why=why, replica=self.replica_id):
             pass
 
     # --------------------------------------------------------- protocol
@@ -477,6 +482,13 @@ class LeaseManager:
         h = self._held.get(uid)
         return None if h is None else h.token
 
+    def is_lost(self, uid: str) -> bool:
+        """True while the local record says the uid's lease was lost —
+        the trace spine's cheap pre-check (one dict read) before it
+        even builds a chunk."""
+        h = self._held.get(uid)
+        return h is not None and h.lost
+
     # ------------------------------------------------- adoption (recovery)
 
     def adopt_expired(self, uid: str) -> bool:
@@ -521,7 +533,7 @@ class LeaseManager:
         self.forget(uid)
         _VICTIM_DROPS_TOTAL.inc()
         log_event("job_stolen_from_us", uid=uid, replica=self.replica_id)
-        with obs.span("lease.stolen", trace_id=uid,
+        with obs.span("lifecycle.stolen", trace_id=uid, side="victim",
                       replica=self.replica_id):
             pass
 
@@ -529,7 +541,11 @@ class LeaseManager:
         """Advertise this replica's load (PX = lease TTL, so a dead
         replica's record vanishes with its leases).  ``free`` — worker
         slots not covered by running or queued work — is what peers'
-        Retry-After estimators and steal scans read."""
+        Retry-After estimators and steal scans read.  The record also
+        piggybacks a COMPACT metric snapshot (held leases, lifetime
+        sheds/acquire/loss counters, EWMA job wall) so any replica can
+        serve the aggregated cluster view (/admin/cluster,
+        fsm_cluster_*) without touching its peers directly."""
         m = self._miner
         self._store.set_px(self._hb_key, json.dumps({
             "replica": self.replica_id,
@@ -543,20 +559,30 @@ class LeaseManager:
             # Retry-After hints must not point at a steal path that is
             # disabled or quiescing for shutdown
             "steal": bool(self.steal_enabled and not self._quiesced),
+            # metric snapshot (ISSUE 9): lifetime counters are summed
+            # by readers; a dead replica's contribution vanishes with
+            # its record — the aggregate view is of LIVE replicas
+            "held": len(self._held),
+            "sheds": int(m.sheds_total()) if m is not None else 0,
+            "ewma_s": (round(m.wall_ewma(), 4)
+                       if m is not None and m.wall_ewma() is not None
+                       else None),
+            "acq": int(_ACQUIRE_TOTAL.total()),
+            "lost": int(_LOST_TOTAL.total()),
             "ts": round(time.time(), 3)}), self._ttl_ms)
         _HEARTBEATS_TOTAL.inc()
 
     def peers(self, max_age_s: Optional[float] = None) -> List[dict]:
         """Live peer heartbeat records.  ``max_age_s`` serves a cached
-        scan no older than that — the KEYS walk must stay OFF hot paths
-        (the 429 shed estimator); None forces a fresh scan (the
-        heartbeat tick / steal path)."""
+        scan no older than that — the store walk must stay OFF hot
+        paths (the 429 shed estimator, scrape-time collectors); None
+        forces a fresh cursor scan (the heartbeat tick / steal path)."""
         if max_age_s is not None:
             ts, cached = self._peers_cache
             if self._clock() - ts < max_age_s:
                 return cached
         out = []
-        for key in self._store.keys("fsm:replica:"):
+        for key in self._store.scan_iter("fsm:replica:", count=256):
             rid = key[len("fsm:replica:"):]
             if rid == self.replica_id:
                 continue
@@ -566,6 +592,64 @@ class LeaseManager:
         _PEERS.set(len(out))
         self._peers_cache = (self._clock(), out)
         return out
+
+    def cluster_view(self, max_age_s: Optional[float] = None) -> dict:
+        """The /admin/cluster body (and the fsm_cluster_* collector's
+        input): this replica's live row + every un-expired peer
+        heartbeat, with cluster totals.  Peers come from the heartbeat-
+        cadence cache by default — any replica can serve this under a
+        scrape storm without driving store scans."""
+        m = self._miner
+        self_row = {
+            "replica": self.replica_id, "self": True,
+            "queued": m.queue_size() if m is not None else 0,
+            "running": m.running_count() if m is not None else 0,
+            "workers": m.worker_count() if m is not None else 0,
+            "free": m.idle_capacity() if m is not None else 0,
+            "steal": bool(self.steal_enabled and not self._quiesced),
+            "held": len(self._held),
+            "sheds": int(m.sheds_total()) if m is not None else 0,
+            "ewma_s": (round(m.wall_ewma(), 4)
+                       if m is not None and m.wall_ewma() is not None
+                       else None),
+            "acq": int(_ACQUIRE_TOTAL.total()),
+            "lost": int(_LOST_TOTAL.total()),
+        }
+        try:
+            peers = self.peers(
+                max_age_s=(max_age_s if max_age_s is not None
+                           else max(self.heartbeat_s, 1.0)))
+        except Exception:
+            peers = []
+        rows = [self_row] + [dict(p) for p in peers]
+
+        def tot(key: str) -> int:
+            return sum(int(r.get(key) or 0) for r in rows)
+
+        totals = {"replicas": len(rows), "queued": tot("queued"),
+                  "running": tot("running"), "workers": tot("workers"),
+                  "free": tot("free"), "held": tot("held"),
+                  "sheds": tot("sheds"),
+                  "lease_churn": tot("acq") + tot("lost")}
+        return {"replica": self.replica_id, "lease_ttl_s": self.lease_ttl_s,
+                "heartbeat_s": self.heartbeat_s, "totals": totals,
+                "replicas": rows, "ts": round(time.time(), 3)}
+
+    def shed_view(self) -> dict:
+        """Compact cluster context for 429 bodies — the same cached
+        peer data the Retry-After hint consults, so a shed client can
+        see WHY the hint says what it says (peers with free capacity =
+        the steal path will likely pick the job up)."""
+        try:
+            peers = self.peers(max_age_s=max(self.heartbeat_s, 1.0))
+        except Exception:
+            peers = []
+        return {"replica": self.replica_id,
+                "replicas": 1 + len(peers),
+                "peer_free": sum(max(0, int(p.get("free", 0) or 0))
+                                 for p in peers if p.get("steal")),
+                "peer_queued": sum(max(0, int(p.get("queued", 0) or 0))
+                                   for p in peers)}
 
     def peer_free_total(self) -> int:
         """Cluster-wide advertised free capacity — the Retry-After
@@ -602,19 +686,28 @@ class LeaseManager:
                 continue
             prefix = f"fsm:admission:{p.get('replica', '')}:"
             try:
-                marker_keys = self._store.keys(prefix)
-            except Exception:
+                # cursor scan, early-terminated at the budget: the walk
+                # reads at most one extra batch past what it can claim.
+                # The scan's wire round-trips happen lazily INSIDE this
+                # loop, so the whole iteration sits in the try — a
+                # store hiccup walking one peer's namespace moves on to
+                # the next peer instead of aborting the pass
+                for key in self._store.scan_iter(prefix, count=64):
+                    if stolen >= budget:
+                        break
+                    uid = key[len(prefix):]
+                    try:
+                        if self._steal_one(key, uid,
+                                           p.get("replica", "")):
+                            stolen += 1
+                    except Exception as exc:
+                        _STEAL_TOTAL.inc(outcome="error")
+                        log_event("job_steal_failed", uid=uid,
+                                  error=str(exc))
+            except Exception as exc:
+                log_event("job_steal_scan_failed",
+                          victim=p.get("replica", ""), error=str(exc))
                 continue
-            for key in marker_keys:
-                if stolen >= budget:
-                    break
-                uid = key[len(prefix):]
-                try:
-                    if self._steal_one(key, uid, p.get("replica", "")):
-                        stolen += 1
-                except Exception as exc:
-                    _STEAL_TOTAL.inc(outcome="error")
-                    log_event("job_steal_failed", uid=uid, error=str(exc))
         return stolen
 
     def _steal_one(self, marker_key: str, uid: str, victim: str) -> bool:
@@ -670,11 +763,20 @@ class LeaseManager:
                       error=str(exc))
             return False
         _STEAL_TOTAL.inc(outcome="stolen")
+        # steal latency: victim's admission (journal intent ts) to this
+        # successful claim + resubmit — the histogram the ROADMAP's
+        # "jobs/sec at fixed p99" story reads load-balancing lag from
+        try:
+            ts0 = float(entry.get("ts") or 0)
+            if ts0 > 0:
+                obsplane.observe_steal_latency(time.time() - ts0)
+        except (TypeError, ValueError):
+            pass
         log_event("job_stolen", uid=uid, victim=victim,
                   replica=self.replica_id)
-        with obs.span("lease.steal", trace_id=uid, victim=victim,
-                      replica=self.replica_id):
-            pass
+        obs.lifecycle(uid, "stolen", side="thief", victim=victim,
+                      replica=self.replica_id)
+        obs.flush_trace(uid)
         return True
 
     # ---------------------------------------------------------- lifecycle

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import List, Optional, Union
+from typing import List, Optional, Tuple, Union
 
 
 class RespError(RuntimeError):
@@ -204,6 +204,27 @@ class RespClient:
             return []
         assert isinstance(reply, list)
         return [r for r in reply if isinstance(r, str)]
+
+    def scan(self, cursor: str = "0", match: Optional[str] = None,
+             count: Optional[int] = None) -> "Tuple[str, List[str]]":
+        """One SCAN step: ``SCAN cursor [MATCH pat] [COUNT n]`` →
+        ``(next_cursor, keys)``.  The cursor is treated as an OPAQUE
+        string round-tripped verbatim (real Redis hands back decimal
+        bucket cursors, MiniRedis hands back the last key) — "0" starts
+        and terminates the iteration in both."""
+        args: List[Union[str, bytes, int]] = ["SCAN", cursor]
+        if match is not None:
+            args += ["MATCH", match]
+        if count is not None:
+            args += ["COUNT", int(count)]
+        reply = self.command(*args)
+        assert isinstance(reply, list) and len(reply) == 2, reply
+        nxt, batch = reply
+        assert isinstance(nxt, str)
+        if batch is None:
+            batch = []
+        assert isinstance(batch, list)
+        return nxt, [k for k in batch if isinstance(k, str)]
 
     def ping(self) -> bool:
         return self.command("PING") == "PONG"

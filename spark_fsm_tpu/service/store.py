@@ -19,6 +19,7 @@ Key layout follows the reference's convention: ``fsm:status:<uid>``,
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -218,12 +219,51 @@ class ResultStore:
             self.delete(key)
 
     def keys(self, prefix: str) -> List[str]:
-        """Keys (kv + list) starting with ``prefix`` — the journal's
-        boot-time recovery scan (boot-only: the Redis backend maps this
-        to KEYS, which blocks the server while it scans)."""
+        """Keys (kv + list) starting with ``prefix``.  The Redis backend
+        maps this to KEYS, which blocks the server while it scans — the
+        recurring walks (heartbeat peers, steal scan, journal recovery)
+        use :meth:`scan_iter` instead; this stays for tests and one-off
+        admin reads."""
         with self._lock:
             return sorted({k for k in list(self._kv) + list(self._lists)
                            if k.startswith(prefix) and self._alive(k)})
+
+    # -- cursor-based key scan (Redis SCAN) --------------------------------
+    # The lease layer's steal/heartbeat/recovery walks repeat on every
+    # heartbeat tick; at thousands of replicas sharing one store a KEYS
+    # walk per tick would serialize the server on each scan (the ROADMAP
+    # item 1 follow-up).  SCAN iterates in bounded batches.  Cursors are
+    # OPAQUE strings (exactly the Redis contract): "0" starts AND ends an
+    # iteration; any other value is backend-defined.  The in-process
+    # backend (and MiniRedis) use the last key returned, so keys alive
+    # for the whole iteration are seen exactly once; real Redis may
+    # return duplicates across rehashes — every caller here is
+    # idempotent per key (peer parse, atomic DEL claim, journal heal).
+
+    def scan_keys(self, prefix: str, cursor: str = "0",
+                  count: int = 512) -> Tuple[str, List[str]]:
+        """One SCAN step: up to ``count`` live keys with ``prefix``
+        after ``cursor``; returns ``(next_cursor, keys)`` with
+        next_cursor == "0" when the iteration is complete."""
+        with self._lock:
+            keys = sorted({k for k in list(self._kv) + list(self._lists)
+                           if k.startswith(prefix) and self._alive(k)})
+        if cursor != "0":
+            keys = keys[bisect.bisect_right(keys, cursor):]
+        batch = keys[:max(1, int(count))]
+        nxt = "0" if len(keys) <= len(batch) else batch[-1]
+        return nxt, batch
+
+    def scan_iter(self, prefix: str, count: int = 512):
+        """Generator over :meth:`scan_keys` — the one spelling every
+        recurring walk uses (lease peers/steal, journal recovery)."""
+        cursor = "0"
+        while True:
+            cursor, batch = self.scan_keys(prefix, cursor, count)
+            for key in batch:
+                yield key
+            if cursor == "0":
+                return
 
     # -- write-ahead job journal -------------------------------------------
     # One intent record per live train job (``fsm:journal:{uid}``),
@@ -245,7 +285,36 @@ class ResultStore:
         self.delete(f"fsm:journal:{uid}")
 
     def journal_uids(self) -> List[str]:
-        return [k[len("fsm:journal:"):] for k in self.keys("fsm:journal:")]
+        # cursor-based: the recovery pass runs on every heartbeat tick
+        # in cluster mode, not just at boot — a KEYS walk here would
+        # block the shared server once per replica per tick
+        return [k[len("fsm:journal:"):]
+                for k in self.scan_iter("fsm:journal:")]
+
+    # -- durable trace spine (service/obsplane.py) -------------------------
+    # Append-only list of span-chunk JSON per job.  Deliberately
+    # guard-free (like ``peek``): spine writes are observability riding
+    # the job's threads — an armed ``store.rpush`` chaos drill targets
+    # checkpoint deltas, and trace flushes consuming its trigger counts
+    # would make pinned-seed drills nondeterministic.  Fencing lives a
+    # layer up (obsplane.TraceSpine), not in the store verb.
+
+    def spine_append(self, uid: str, chunk_json: str) -> None:
+        with self._lock:
+            self._lists.setdefault(f"fsm:trace:{uid}", []).append(chunk_json)
+
+    def spine_chunks(self, uid: str) -> List[str]:
+        with self._lock:
+            return list(self._lists.get(f"fsm:trace:{uid}", ()))
+
+    def spine_trim(self, uid: str, keep_last: int) -> None:
+        """Retention bound: keep only the NEWEST ``keep_last`` chunks
+        (the opposite end from ltrim — old warmup chunks are the ones a
+        straggler hunt can spare)."""
+        with self._lock:
+            lst = self._lists.get(f"fsm:trace:{uid}")
+            if lst is not None and len(lst) > max(0, keep_last):
+                del lst[:len(lst) - max(0, keep_last)]
 
     # -- job status registry (RedisCache.addStatus / status) ---------------
 
@@ -361,6 +430,26 @@ class RedisResultStore(ResultStore):
         return self._r.incr(key)
 
     def keys(self, prefix: str) -> List[str]:
-        # Redis KEYS is O(keyspace) and blocks the server — acceptable
-        # here because the only caller is the boot-time recovery scan.
+        # Redis KEYS is O(keyspace) and blocks the server — kept for
+        # tests/one-off admin reads only; every recurring walk goes
+        # through scan_keys/scan_iter below.
         return sorted(self._r.keys(prefix + "*"))
+
+    def scan_keys(self, prefix: str, cursor: str = "0",
+                  count: int = 512) -> Tuple[str, List[str]]:
+        nxt, batch = self._r.scan(cursor, match=prefix + "*", count=count)
+        # MATCH already filters server-side; re-filter defensively so a
+        # backend returning unmatched keys cannot leak them upward
+        return nxt, [k for k in batch if k.startswith(prefix)]
+
+    def spine_append(self, uid: str, chunk_json: str) -> None:
+        self._r.rpush(f"fsm:trace:{uid}", chunk_json)
+
+    def spine_chunks(self, uid: str) -> List[str]:
+        return self._r.lrange(f"fsm:trace:{uid}", 0, -1)
+
+    def spine_trim(self, uid: str, keep_last: int) -> None:
+        if keep_last <= 0:
+            self._r.delete(f"fsm:trace:{uid}")
+        else:  # LTRIM key -N -1: keep the newest N entries
+            self._r.ltrim(f"fsm:trace:{uid}", -keep_last, -1)

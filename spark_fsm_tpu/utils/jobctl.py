@@ -91,7 +91,7 @@ class JobControl:
     it)."""
 
     __slots__ = ("uid", "deadline", "cancelled", "running", "priority",
-                 "lease_lost")
+                 "lease_lost", "submitted_t", "started_t")
 
     def __init__(self, uid: str, deadline: Optional[float],
                  priority: str = "normal"):
@@ -107,6 +107,12 @@ class JobControl:
         # discipline as ``cancelled``: lock-free at check sites, a stale
         # read costs one extra launch, never a missed fence
         self.lease_lost = False
+        # SLO accounting stamps (service/obsplane.py): submit instant
+        # and FIRST worker pickup — e2e = terminal - submitted_t,
+        # queue wait = started_t - submitted_t (retries re-activate but
+        # keep the first pickup; the client waited once)
+        self.submitted_t = time.monotonic()
+        self.started_t: Optional[float] = None
 
 
 _lock = threading.Lock()
@@ -211,6 +217,8 @@ def activate(ctl: Optional[JobControl]):
         yield
         return
     ctl.running = True
+    if ctl.started_t is None:
+        ctl.started_t = time.monotonic()
     token = _cur.set(ctl)
     try:
         yield

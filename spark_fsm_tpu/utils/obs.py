@@ -24,16 +24,30 @@ zero-dependency substrate for all of it:
   ``/admin/health`` keep their old JSON keys as aliases (the mapping is
   tabled in docs/OPERATIONS.md).
 - **flight recorder**: a per-job bounded ring of structured SPANS
-  (``trace_id`` = job uid, site, monotonic t_start/t_end, attrs, and
-  point-in-time EVENTS for fault trips, retry waits, watchdog timeouts,
-  OOM downgrades, breaker transitions).  A trace opens at mine submit
-  (service/actors.Miner) and threads through engine dispatch, ragged-
-  planner launches, device readback, and store/checkpoint/Kafka I/O via
-  a contextvar — no constructor plumbing.  Each launch span carries the
-  planner's PREDICTED seconds next to the measured wall, so cost-model
-  residuals become a first-class gauge (``fsm_costmodel_drift_ratio``)
-  that calibrates the watchdog slack.  ``GET /admin/trace/<job_id>``
-  dumps a trace; ``/admin/trace/last`` the most recent one.
+  (``trace_id`` = job uid, site, monotonic t_start/t_end, a wall-clock
+  ``ts`` for cross-process merging, attrs, and point-in-time EVENTS for
+  fault trips, retry waits, watchdog timeouts, OOM downgrades, breaker
+  transitions).  A trace opens at mine submit (service/actors.Miner)
+  and threads through engine dispatch, ragged-planner launches, device
+  readback, and store/checkpoint/Kafka I/O via a contextvar — no
+  constructor plumbing.  Each launch span carries the planner's
+  PREDICTED seconds next to the measured wall, so cost-model residuals
+  become a first-class gauge (``fsm_costmodel_drift_ratio``) that
+  calibrates the watchdog slack.  ``GET /admin/trace/<job_id>`` dumps a
+  trace; ``/admin/trace/last`` the most recent one.
+- **trace spine hook** (ISSUE 9): when a SPINE SINK is installed
+  (:func:`set_spine` — service/obsplane.py wires it to the result
+  store through the lease-fenced write path), completed spans also
+  buffer per trace and flush to the sink in batches: at the configured
+  span count, at every :func:`flush_trace` call (checkpoint saves and
+  terminal paths), and on trace eviction.  The recorder stays the
+  in-memory truth; the spine is the durable, cross-replica copy that
+  survives a kill -9.  No sink installed (the solo default) costs one
+  module-global read per probe.
+- **sliding-window quantiles** (:class:`SlidingQuantiles`): bounded
+  (wall-ts, value) samples per label set with exact quantiles over a
+  trailing window — the /admin/slo substrate (fixed-bucket histograms
+  cannot answer "p99 over the last five minutes").
 
 Tracing is config-gated (``[observability] trace``) and the DISABLED
 path costs one module-global read per probe — the same pin as the fault
@@ -193,6 +207,12 @@ class Counter(_Metric):
             self._values.setdefault(key, 0.0)
         return self
 
+    def total(self) -> float:
+        """Sum over every series of this counter — what the lease
+        heartbeat piggybacks into its compact metric snapshot."""
+        with self._lock:
+            return sum(self._values.values())
+
 
 class Gauge(_Metric):
     kind = "gauge"
@@ -229,6 +249,17 @@ class Histogram(_Metric):
                 row = self._h[key] = [0.0] * (len(self.buckets) + 1) + [0.0]
             row[min(i, len(self.buckets))] += 1
             row[-1] += v
+
+    def seed(self, **labels) -> "Histogram":
+        """Zero-seed one series (all-zero buckets, count 0) — the
+        histogram analog of :meth:`Counter.seed`, so a fresh scrape
+        shows ``_count 0`` for a label vocabulary (e.g. every priority
+        class) instead of no data."""
+        key = _label_key(labels)
+        with self._lock:
+            if key not in self._h:
+                self._h[key] = [0.0] * (len(self.buckets) + 1) + [0.0]
+        return self
 
     def samples(self):
         out = []
@@ -452,8 +483,8 @@ class Span:
     measured wall next to the predicted one).  Close via the context
     manager — the span enters its trace's ring only on exit."""
 
-    __slots__ = ("trace_id", "span_id", "parent_id", "site", "t0", "t1",
-                 "attrs", "events", "error", "_token")
+    __slots__ = ("trace_id", "span_id", "parent_id", "site", "t0", "t0w",
+                 "t1", "attrs", "events", "error", "_token")
 
     def __init__(self, trace_id: str, parent_id: Optional[int], site: str,
                  attrs: dict):
@@ -462,6 +493,10 @@ class Span:
         self.parent_id = parent_id
         self.site = site
         self.t0 = time.monotonic()
+        # wall-clock twin of t0: monotonic clocks are PER-PROCESS, so
+        # the cross-replica merged timeline (service/obsplane.py) can
+        # only order spans from different replicas by wall time
+        self.t0w = time.time()
         self.t1: Optional[float] = None
         self.attrs = attrs
         self.events: List[dict] = []
@@ -497,6 +532,7 @@ class Span:
     def to_dict(self) -> dict:
         d = {"span_id": self.span_id, "parent_id": self.parent_id,
              "site": self.site, "t_start": round(self.t0, 6),
+             "ts": round(self.t0w, 6),
              "t_end": None if self.t1 is None else round(self.t1, 6),
              "duration_s": (None if self.t1 is None
                             else round(self.t1 - self.t0, 6))}
@@ -531,9 +567,51 @@ class _NoopSpan:
 
 _NOOP = _NoopSpan()
 
+# -- trace spine hook (ISSUE 9) ---------------------------------------------
+# The sink is a callable ``fn(trace_id, [span_dict, ...])`` installed by
+# service/obsplane.py when the cluster observability plane is active; it
+# owns durability, fencing and failure handling (a sink error must never
+# fail the recorded work).  None (the default) keeps every probe at one
+# module-global read — the same disabled-cost pin as ``_trace_on``.
+_spine: Optional[Callable[[str, List[dict]], None]] = None
+_spine_flush_spans = 32
+
+
+def set_spine(sink: Optional[Callable[[str, List[dict]], None]],
+              flush_spans: Optional[int] = None) -> None:
+    """Install (or remove, with None) the process-wide spine sink.
+    ``flush_spans`` sets how many completed spans buffer per trace
+    before an automatic flush."""
+    global _spine, _spine_flush_spans
+    with _cfg_lock:
+        if flush_spans is not None:
+            if flush_spans < 1:
+                raise ValueError(
+                    f"flush_spans must be >= 1 (got {flush_spans})")
+            _spine_flush_spans = int(flush_spans)
+        _spine = sink
+
+
+def set_spine_flush(flush_spans: int) -> None:
+    """Adjust the per-trace flush threshold without touching the sink
+    (the boot config's ``[observability] spine_flush_spans`` knob)."""
+    set_spine(_spine, flush_spans=flush_spans)
+
+
+def _spine_send(trace_id: str, batch: List[dict]) -> None:
+    sink = _spine
+    if sink is None or not batch:
+        return
+    try:
+        sink(trace_id, batch)
+    except Exception as exc:  # the sink must never fail the work
+        log_event("trace_spine_sink_failed", trace=trace_id,
+                  error=f"{type(exc).__name__}: {exc}")
+
 
 class _Trace:
-    __slots__ = ("trace_id", "spans", "dropped", "started_wall", "attrs")
+    __slots__ = ("trace_id", "spans", "dropped", "started_wall", "attrs",
+                 "pending")
 
     def __init__(self, trace_id: str, max_spans: int, attrs: dict):
         self.trace_id = trace_id
@@ -541,6 +619,9 @@ class _Trace:
         self.dropped = 0
         self.started_wall = time.time()
         self.attrs = attrs
+        # spans completed since the last spine flush (only populated
+        # while a spine sink is installed — see set_spine)
+        self.pending: List[dict] = []
 
 
 class FlightRecorder:
@@ -557,6 +638,7 @@ class FlightRecorder:
         self._sinks: List[Callable] = []
 
     def begin(self, trace_id: str, attrs: dict) -> None:
+        evicted: List[_Trace] = []
         with self._lock:
             t = self._traces.get(trace_id)
             if t is None:
@@ -567,14 +649,18 @@ class FlightRecorder:
                 t = self._traces[trace_id] = _Trace(trace_id, _max_spans,
                                                     attrs)
                 while len(self._traces) > _max_jobs:
-                    self._traces.popitem(last=False)
+                    evicted.append(self._traces.popitem(last=False)[1])
             else:
                 t.attrs.update(attrs)
             self._traces.move_to_end(trace_id)
             self._last = trace_id
+        for old in evicted:  # outside the lock: the sink does store I/O
+            if old.pending:
+                _spine_send(old.trace_id, old.pending)
 
     def record(self, span: Span) -> None:
         sinks = None
+        flush: Optional[List[dict]] = None
         with self._lock:
             t = self._traces.get(span.trace_id)
             if t is not None:
@@ -583,9 +669,17 @@ class FlightRecorder:
                     _SPANS_DROPPED.inc()
                 t.spans.append(span)
                 self._last = span.trace_id
+                if _spine is not None:
+                    # buffer for the durable spine; flush in batches so
+                    # the store pays one append per N spans, not per span
+                    t.pending.append(span.to_dict())
+                    if len(t.pending) >= _spine_flush_spans:
+                        flush, t.pending = t.pending, []
             if self._sinks:
                 sinks = list(self._sinks)
         _SPANS_TOTAL.inc()
+        if flush is not None:
+            _spine_send(span.trace_id, flush)
         if sinks:
             for fn in sinks:
                 try:
@@ -596,6 +690,16 @@ class FlightRecorder:
             log_event("span", trace=span.trace_id, site=span.site,
                       duration_s=round(span.duration_s or 0.0, 6),
                       **({"error": span.error} if span.error else {}))
+
+    def take_pending(self, trace_id: str) -> List[dict]:
+        """Pop the trace's un-flushed spine batch (empty when no spine
+        is installed or nothing accumulated)."""
+        with self._lock:
+            t = self._traces.get(trace_id)
+            if t is None or not t.pending:
+                return []
+            batch, t.pending = t.pending, []
+            return batch
 
     def dump(self, trace_id: str) -> Optional[dict]:
         with self._lock:
@@ -716,6 +820,31 @@ def trace_event(name: str, **attrs) -> None:
         sp.event(name, **attrs)
 
 
+def lifecycle(trace_id: str, event: str, **attrs) -> None:
+    """Record a first-class job lifecycle event (admitted / started /
+    checkpointed / stolen / adopted / fenced / settled) as a zero-length
+    ``lifecycle.{event}`` span on the job's trace — and therefore on the
+    durable spine, where these markers are the observation points for
+    the failover/steal latency histograms.  One global read when
+    tracing is off."""
+    if not _trace_on:
+        return
+    with span(f"lifecycle.{event}", trace_id=trace_id, **attrs):
+        pass
+
+
+def flush_trace(trace_id: str) -> None:
+    """Flush the trace's buffered spans to the spine sink NOW — called
+    at the durable milestones (admission, checkpoint saves, terminal
+    paths) so a kill -9 loses at most the spans since the last
+    milestone.  One module-global read when no spine is installed."""
+    if _spine is None:
+        return
+    batch = _recorder.take_pending(trace_id)
+    if batch:
+        _spine_send(trace_id, batch)
+
+
 def trace_dump(trace_id: str) -> Optional[dict]:
     return _recorder.dump(trace_id)
 
@@ -746,3 +875,80 @@ def remove_span_sink(fn: Callable) -> None:
 def clear_traces() -> None:
     """Drop every recorded trace (test isolation helper)."""
     _recorder.clear()
+
+
+# ===========================================================================
+# Sliding-window quantiles (the /admin/slo substrate)
+# ===========================================================================
+
+class SlidingQuantiles:
+    """Exact quantiles over a trailing wall-clock window, per label set.
+
+    A fixed-bucket histogram answers "how many ever fell under 1 s";
+    an SLO report needs "what was p99 over the last five minutes".
+    This keeps a bounded deque of ``(wall_ts, value)`` per label key —
+    at most ``max_samples``, pruned to ``window_s`` on every observe and
+    snapshot — and sorts on demand (snapshot-time cost, bounded by
+    ``max_samples``; /admin/slo is an operator poll, not a hot path).
+    ``clock`` is injectable (tests drive a virtual clock)."""
+
+    def __init__(self, window_s: float = 300.0, max_samples: int = 2048,
+                 clock=time.time):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0 (got {window_s})")
+        if max_samples < 1:
+            raise ValueError(f"max_samples must be >= 1 (got {max_samples})")
+        self.window_s = float(window_s)
+        self.max_samples = int(max_samples)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: Dict[Tuple[Tuple[str, str], ...],
+                            "deque[Tuple[float, float]]"] = {}
+
+    def set_window(self, window_s: float) -> None:
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0 (got {window_s})")
+        with self._lock:
+            self.window_s = float(window_s)
+
+    def _prune(self, dq, now: float) -> None:
+        horizon = now - self.window_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        now = self._clock()
+        with self._lock:
+            dq = self._samples.get(key)
+            if dq is None:
+                dq = self._samples[key] = deque(maxlen=self.max_samples)
+            dq.append((now, float(value)))
+            self._prune(dq, now)
+
+    def stats(self, quantiles: Tuple[float, ...] = (0.5, 0.95, 0.99),
+              **labels) -> dict:
+        """{"count": n, "p50": ..., "p95": ..., "p99": ..., "max": ...}
+        over the live window ({"count": 0} when it is empty)."""
+        key = _label_key(labels)
+        now = self._clock()
+        with self._lock:
+            dq = self._samples.get(key)
+            if dq is not None:
+                self._prune(dq, now)
+            values = sorted(v for _, v in dq) if dq else []
+        if not values:
+            return {"count": 0}
+        out = {"count": len(values), "max": round(values[-1], 6)}
+        for q in quantiles:
+            idx = min(len(values) - 1, int(q * (len(values) - 1) + 0.5))
+            out[f"p{int(q * 100)}"] = round(values[idx], 6)
+        return out
+
+    def label_keys(self) -> List[Tuple[Tuple[str, str], ...]]:
+        with self._lock:
+            return list(self._samples)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()

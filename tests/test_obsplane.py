@@ -1,0 +1,379 @@
+"""Cluster observability plane (ISSUE 9): fenced trace spine, merged
+cross-replica timelines, SLO accounting, cluster metric aggregation.
+
+The satellite acceptance pins live here:
+
+- FENCED TRACE WRITES: a split-brain stale holder's spine appends are
+  refused, counted in ``fsm_lease_fence_rejections_total`` next to the
+  prevented result double-commits, and the adopter's merged timeline
+  contains no spans from the fenced epoch (tombstones block even
+  post-settle stragglers);
+- the merged timeline de-duplicates (replica, span_id) and orders by
+  wall ts;
+- SLO sliding-window quantiles are exact over a virtual clock;
+- the cluster view aggregates heartbeat snapshots and the
+  ``fsm_cluster_*`` collector exposes them as gauges.
+"""
+
+import json
+import time
+
+import pytest
+
+from spark_fsm_tpu.service import obsplane
+from spark_fsm_tpu.service.lease import LeaseManager
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import obs
+
+DRILL_TIMEOUT_S = 120.0
+
+
+@pytest.fixture(autouse=True)
+def _plane_reset():
+    """Leave no process-global plane/tracing/SLO state behind (the
+    recorder, spine sink and sliding windows are all process-global)."""
+    enabled0 = obs.tracing_enabled()
+    yield
+    obs.configure_tracing(enabled0, max_spans=512, max_jobs=16)
+    obs.clear_traces()
+    obsplane.uninstall()
+    obsplane.clear_slo()
+
+
+def _counter(name):
+    snap = obs.REGISTRY.snapshot()[name]
+    return sum(snap.values()) if isinstance(snap, dict) else snap
+
+
+def _rig(ttl=10.0):
+    t = [0.0]
+    store = ResultStore(clock=lambda: t[0])
+    mk = lambda rid: LeaseManager(store, replica_id=rid, lease_ttl_s=ttl,
+                                  heartbeat_s=0, clock=lambda: t[0])
+    return t, store, mk
+
+
+def test_priority_vocabulary_matches_actors():
+    from spark_fsm_tpu.service.actors import PRIORITIES
+
+    assert obsplane.PRIORITIES == PRIORITIES
+
+
+# ------------------------------------------------- fenced spine writes
+
+
+def test_split_brain_spine_appends_are_fenced(tmp_path=None):
+    """The satellite drill, hermetic: holder A flushes while live; B
+    adopts after A's TTL; A's later flushes are REFUSED and counted;
+    the merged timeline holds A's pre-fence spans + B's spans and
+    NOTHING from A's fenced epoch."""
+    t, store, mk = _rig(ttl=10.0)
+    a, b = mk("rep-a"), mk("rep-b")
+    plane_a = obsplane.TraceSpine(store, a)
+    plane_b = obsplane.TraceSpine(store, b)
+
+    a.acquire("drill")
+    store.journal_set("drill", json.dumps({"replica": "rep-a"}))
+    rejected0 = _counter("fsm_lease_fence_rejections_total")
+
+    # live holder: the flush lands, tagged with A's token
+    assert plane_a.flush("drill", [
+        {"span_id": 1, "site": "lifecycle.admitted", "ts": 100.0},
+        {"span_id": 2, "site": "queue.dispatch", "ts": 101.0}]) == "ok"
+
+    # A sleeps through its TTL; B adopts (journal rewritten = adoption
+    # semantics: the intent is B's now, so A cannot NX-reacquire)
+    t[0] = 30.0
+    store.journal_set("drill", json.dumps({"replica": "rep-b"}))
+    assert b.adopt_expired("drill") is True
+
+    # the stale epoch: A wakes and flushes — refused, counted, nothing
+    # appended
+    n_chunks = len(store.spine_chunks("drill"))
+    assert plane_a.flush("drill", [
+        {"span_id": 3, "site": "stale.mine", "ts": 130.0}]) == "fenced"
+    assert len(store.spine_chunks("drill")) == n_chunks
+    assert _counter("fsm_lease_fence_rejections_total") > rejected0
+
+    # even after A's local settle forgets the lease, the tombstone
+    # blocks the post-settle straggler flush
+    a.forget("drill")
+    assert plane_a.flush("drill", [
+        {"span_id": 4, "site": "stale.settled", "ts": 131.0}]) == "fenced"
+
+    # the adopter's flushes land under its (larger) token
+    assert plane_b.flush("drill", [
+        {"span_id": 1, "site": "lifecycle.adopted", "ts": 140.0},
+        {"span_id": 2, "site": "job", "ts": 141.0}]) == "ok"
+
+    merged = obsplane.merged_timeline(store, "drill")
+    sites = [s["site"] for s in merged["spans"]]
+    assert "lifecycle.admitted" in sites and "queue.dispatch" in sites
+    assert "lifecycle.adopted" in sites and "job" in sites
+    assert "stale.mine" not in sites and "stale.settled" not in sites
+    assert merged["replicas"] == ["rep-a", "rep-b"]
+    # ordered by wall ts, monotone
+    ts = [s["ts"] for s in merged["spans"]]
+    assert ts == sorted(ts)
+    # B's spans carry B's strictly larger fencing token
+    tok = {s["replica"]: s["token"] for s in merged["spans"]}
+    assert tok["rep-b"] > tok["rep-a"]
+    spine_counts = obs.REGISTRY.snapshot()["fsm_trace_spine_writes_total"]
+    assert spine_counts["outcome=fenced"] >= 2
+    assert spine_counts["outcome=ok"] >= 2
+
+
+def test_spine_unleased_uid_writes_with_null_token():
+    """Stream pushes and solo jobs never hold a lease: their flushes
+    land with token null instead of being refused."""
+    _, store, mk = _rig()
+    plane = obsplane.TraceSpine(store, mk("rep-a"))
+    assert plane.flush("stream:topic", [
+        {"span_id": 9, "site": "stream.push", "ts": 1.0}]) == "ok"
+    chunk = json.loads(store.spine_chunks("stream:topic")[0])
+    assert chunk["token"] is None and chunk["replica"] == "rep-a"
+
+
+def test_spine_retention_keeps_newest_chunks():
+    _, store, mk = _rig()
+    plane = obsplane.TraceSpine(store, mk("rep-a"), max_chunks=3)
+    for i in range(7):
+        assert plane.flush("u", [{"span_id": i, "site": "s",
+                                  "ts": float(i)}]) == "ok"
+    chunks = obsplane.spine_chunks(store, "u")
+    assert len(chunks) == 3
+    assert [c["spans"][0]["span_id"] for c in chunks] == [4, 5, 6]
+
+
+def test_merged_timeline_dedupes_local_ring_against_spine():
+    """The serving replica's local ring spans were themselves flushed:
+    the merge must not show them twice."""
+    _, store, mk = _rig()
+    a = mk("rep-a")
+    plane = obsplane.TraceSpine(store, a)
+    spans = [{"span_id": 1, "site": "job.submit", "ts": 10.0},
+             {"span_id": 2, "site": "job", "ts": 11.0}]
+    assert plane.flush("u", spans) == "ok"
+    local = {"trace_id": "u", "attrs": {"algorithm": "SPADE"},
+             "dropped_spans": 0,
+             "spans": spans + [{"span_id": 3, "site": "job.sink",
+                                "ts": 12.0}]}
+    merged = obsplane.merged_timeline(store, "u", local,
+                                      replica_id="rep-a",
+                                      boot_id=plane.boot_id)
+    assert merged["n_spans"] == 3
+    assert [s["span_id"] for s in merged["spans"]] == [1, 2, 3]
+    assert merged["attrs"] == {"algorithm": "SPADE"}
+    # a crash-RESTARTED incarnation re-counts span_ids from 1 under the
+    # same (pinned) replica id: its distinct boot nonce keeps the merge
+    # from swallowing the resumed spans as duplicates
+    plane2 = obsplane.TraceSpine(store, a)  # fresh boot, same replica
+    assert plane2.boot_id != plane.boot_id
+    assert plane2.flush("u", [{"span_id": 1, "site": "job.resumed",
+                               "ts": 20.0}]) == "ok"
+    merged2 = obsplane.merged_timeline(store, "u")
+    assert merged2["n_spans"] == 3  # 1,2 from boot 1 + 1 from boot 2
+    assert "job.resumed" in [s["site"] for s in merged2["spans"]]
+
+
+# ------------------------------------------------------------- SLO layer
+
+
+def test_sliding_quantiles_window_and_exactness():
+    t = [1000.0]
+    sq = obs.SlidingQuantiles(window_s=60.0, max_samples=512,
+                              clock=lambda: t[0])
+    for i in range(100):
+        sq.observe(i / 100.0, priority="high")
+    s = sq.stats(priority="high")
+    assert s["count"] == 100
+    assert abs(s["p50"] - 0.5) < 0.02
+    assert abs(s["p99"] - 0.98) < 0.02
+    assert s["max"] == 0.99
+    # outside the window everything ages out
+    t[0] += 120.0
+    assert sq.stats(priority="high") == {"count": 0}
+    # a fresh burst only sees itself
+    sq.observe(5.0, priority="high")
+    assert sq.stats(priority="high")["count"] == 1
+    assert sq.stats(priority="low") == {"count": 0}
+    with pytest.raises(ValueError):
+        obs.SlidingQuantiles(window_s=0)
+
+
+def test_observe_job_feeds_histograms_and_slo_snapshot():
+    obsplane.clear_slo()
+    h0 = obs.REGISTRY.snapshot()["fsm_job_e2e_seconds"]
+    obsplane.observe_job("high", 2.0, 0.5, 1.5)
+    obsplane.observe_job("high", 4.0, 1.0, 3.0)
+    snap = obsplane.slo_snapshot()
+    row = snap["priorities"]["high"]
+    assert row["e2e"]["count"] == 2 and row["e2e"]["p99"] == 4.0
+    assert row["queue_wait"]["p50"] in (0.5, 1.0)
+    assert row["exec"]["count"] == 2
+    assert snap["priorities"]["low"]["e2e"] == {"count": 0}
+    h1 = obs.REGISTRY.snapshot()["fsm_job_e2e_seconds"]
+    assert h1["priority=high"]["count"] == h0["priority=high"]["count"] + 2
+    # the label vocabulary is zero-seeded: 'low' scrapes as count 0,
+    # not no-data (the no-orphan-series posture)
+    assert "priority=low" in h1
+    text = obs.REGISTRY.render_prometheus()
+    assert 'fsm_job_time_to_adoption_seconds_count 0' in text \
+        or 'fsm_job_time_to_adoption_seconds_count' in text
+
+
+def test_adoption_and_steal_histograms_seeded_and_observable():
+    before = obs.REGISTRY.snapshot()["fsm_job_time_to_adoption_seconds"]
+    obsplane.observe_adoption(2.5)
+    obsplane.observe_steal_latency(0.4)
+    after = obs.REGISTRY.snapshot()
+    assert after["fsm_job_time_to_adoption_seconds"]["all"]["count"] \
+        == before["all"]["count"] + 1
+    assert after["fsm_job_steal_latency_seconds"]["all"]["count"] >= 1
+
+
+# ------------------------------------------------------- cluster plane
+
+
+class _FakeMiner:
+    def __init__(self, queued=0, running=0, workers=2, sheds=0.0,
+                 ewma=None):
+        self._q, self._r, self._w = queued, running, workers
+        self._sheds, self._ewma = sheds, ewma
+
+    def queue_size(self):
+        return self._q
+
+    def running_count(self):
+        return self._r
+
+    def worker_count(self):
+        return self._w
+
+    def idle_capacity(self):
+        return max(0, self._w - self._r - self._q)
+
+    def sheds_total(self):
+        return self._sheds
+
+    def wall_ewma(self):
+        return self._ewma
+
+
+def test_cluster_view_aggregates_heartbeat_snapshots():
+    t, store, mk = _rig(ttl=10.0)
+    a, b = mk("rep-a"), mk("rep-b")
+    a._miner = _FakeMiner(queued=3, running=1, workers=2, sheds=5,
+                          ewma=0.8)
+    b._miner = _FakeMiner(queued=0, running=0, workers=4)
+    b.acquire("held-job")
+    a.publish_heartbeat()
+    b.publish_heartbeat()
+    view = a.cluster_view(max_age_s=0)  # 0 = always fresh scan
+    assert view["totals"]["replicas"] == 2
+    assert view["totals"]["queued"] == 3
+    assert view["totals"]["running"] == 1
+    assert view["totals"]["free"] == 4  # B's 4 idle workers
+    assert view["totals"]["held"] == 1
+    assert view["totals"]["sheds"] == 5
+    assert view["totals"]["lease_churn"] >= 1  # B's acquire
+    rows = {r["replica"]: r for r in view["replicas"]}
+    assert rows["rep-a"]["self"] is True
+    assert rows["rep-b"]["held"] == 1
+    # the collector exposes the same totals as gauges
+    fams = {name: rows_ for name, kind, help, rows_
+            in obsplane._cluster_collector(a)()}
+    assert fams["fsm_cluster_replicas"][0][1] == 2.0
+    assert fams["fsm_cluster_queue_depth"][0][1] == 3.0
+    assert fams["fsm_cluster_in_flight"][0][1] == 1.0
+    assert fams["fsm_cluster_leases_held"][0][1] == 1.0
+    # a dead replica's row ages out with its heartbeat record
+    t[0] = 30.0
+    view = b.cluster_view(max_age_s=0)
+    assert view["totals"]["replicas"] == 1
+    # shed_view: the compact 429 body
+    sv = b.shed_view()
+    assert sv["replicas"] == 1 and "peer_free" in sv
+
+
+def test_shed_view_reports_peer_free_capacity():
+    t, store, mk = _rig()
+    a, b = mk("rep-a"), mk("rep-b")
+    b._miner = _FakeMiner(workers=4)
+    b.publish_heartbeat()
+    a._peers_cache = (-1e18, [])  # force a fresh scan through the cache
+    sv = a.shed_view()
+    assert sv == {"replica": "rep-a", "replicas": 2, "peer_free": 4,
+                  "peer_queued": 0}
+
+
+# --------------------------------------------- end-to-end (solo cluster)
+
+
+def test_miner_writes_lifecycle_spine_and_slo_end_to_end():
+    """A cluster-mode Miner with tracing on: the job's lifecycle marks
+    land on the durable spine through the fenced path, the merged
+    timeline de-duplicates ring vs spine, and the SLO layer observes
+    the finish — the obs_smoke story at test scale."""
+    from spark_fsm_tpu.service.actors import Miner
+
+    obs.configure_tracing(True, max_spans=512, max_jobs=8)
+    obsplane.clear_slo()
+    store = ResultStore()
+    mgr = LeaseManager(store, replica_id="solo1", lease_ttl_s=30,
+                       heartbeat_s=0)
+    miner = Miner(store, workers=1, queue_depth=8, lease_mgr=mgr)
+    try:
+        miner.submit(ServiceRequest("fsm", "train", {
+            "algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n", "support": "1.0",
+            "uid": "solo-job", "priority": "high"}))
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            if store.status("solo-job") in ("finished", "failure"):
+                break
+            time.sleep(0.01)
+        assert store.status("solo-job") == "finished", \
+            store.get("fsm:error:solo-job")
+        # give the post-release root-span flush a beat
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            chunks = obsplane.spine_chunks(store, "solo-job")
+            sites = {s["site"] for c in chunks for s in c["spans"]}
+            if "job" in sites:
+                break
+            time.sleep(0.01)
+        assert chunks, "no spine chunks written"
+        for want in ("job.submit", "lifecycle.admitted",
+                     "lifecycle.started", "lifecycle.settled", "job"):
+            assert want in sites, (want, sorted(sites))
+        # every non-final chunk was written under the held lease's token
+        tokens = [json.loads(raw)["token"]
+                  for raw in store.spine_chunks("solo-job")]
+        assert tokens[0] is not None
+        merged = obsplane.merged_timeline(
+            store, "solo-job", obs.trace_dump("solo-job"),
+            replica_id="solo1", boot_id=obsplane.plane().boot_id)
+        ids = [(s["replica"], s["span_id"]) for s in merged["spans"]]
+        assert len(ids) == len(set(ids)), "merge duplicated spans"
+        ts = [s["ts"] for s in merged["spans"]]
+        assert ts == sorted(ts)
+        snap = obsplane.slo_snapshot()["priorities"]["high"]
+        assert snap["e2e"]["count"] >= 1
+        assert snap["queue_wait"]["count"] >= 1
+    finally:
+        miner.shutdown()
+
+
+def test_no_spine_flush_without_install():
+    """Solo default: no plane installed — tracing works, nothing is
+    buffered for a spine, flush_trace is a no-op global read."""
+    obsplane.uninstall()
+    obs.configure_tracing(True, max_spans=16, max_jobs=4)
+    with obs.trace("plain-job"):
+        with obs.span("step"):
+            pass
+    obs.flush_trace("plain-job")
+    assert obs._recorder.take_pending("plain-job") == []
+    assert obs.trace_dump("plain-job")["n_spans"] == 2

@@ -7,6 +7,7 @@ commands the store uses — so the exact bytes the store would send to
 production Redis are what's exercised here.
 """
 
+import json
 import socket
 import threading
 
@@ -20,7 +21,7 @@ from spark_fsm_tpu.service.store import RedisResultStore
 class MiniRedis:
     """RESP2 server on a loopback socket implementing the command subset
     the store uses: SET[ PX ms][ NX]/GET/RPUSH/LRANGE/LPOP/LLEN/LTRIM/
-    DEL/INCR/KEYS/PEXPIRE/PTTL/TTL/PING.
+    DEL/INCR/KEYS/SCAN/PEXPIRE/PTTL/TTL/PING.
 
     Key expiry (the lease layer's substrate) runs on ``self.clock``
     (default ``time.monotonic``) with Redis-style lazy purge, so lease
@@ -198,6 +199,43 @@ class MiniRedis:
                     kb = k.encode()
                     out.append(b"$%d\r\n%s\r\n" % (len(kb), kb))
                 return b"".join(out)
+            if cmd == "SCAN":
+                # cursor iteration: the cursor is OPAQUE to clients
+                # (real Redis returns decimal bucket cursors; here it is
+                # the last key of the previous batch — "0" starts AND
+                # terminates in both, which is all RespClient.scan
+                # relies on).  Keys alive for the whole iteration are
+                # returned exactly once.
+                cursor, match, count = rest[0], None, 10
+                i = 1
+                while i < len(rest):
+                    opt = rest[i].upper()
+                    if opt == "MATCH":
+                        match = rest[i + 1]
+                        i += 2
+                    elif opt == "COUNT":
+                        count = int(rest[i + 1])
+                        i += 2
+                    else:
+                        return b"-ERR syntax error\r\n"
+                pre = ""
+                if match is not None:
+                    assert match.endswith("*"), match  # prefix globs only
+                    pre = match[:-1]
+                ks = sorted(k for k in list(self.kv) + list(self.lists)
+                            if k.startswith(pre) and self._alive(k))
+                if cursor != "0":
+                    import bisect
+                    ks = ks[bisect.bisect_right(ks, cursor):]
+                batch = ks[:max(1, count)]
+                nxt = "0" if len(ks) <= len(batch) else batch[-1]
+                nb = nxt.encode()
+                out = [b"*2\r\n", b"$%d\r\n%s\r\n" % (len(nb), nb),
+                       b"*%d\r\n" % len(batch)]
+                for k in batch:
+                    kb = k.encode()
+                    out.append(b"$%d\r\n%s\r\n" % (len(kb), kb))
+                return b"".join(out)
             return b"-ERR unknown command '%s'\r\n" % cmd.encode()
 
     def close(self):
@@ -309,7 +347,10 @@ def test_journal_contract_over_wire(mini_redis):
     assert store2.journal_uids() == ["j1", "j2"]
     store2.journal_clear("j1")
     assert store.journal_uids() == ["j2"]
-    assert "KEYS" in mini_redis.commands_seen
+    # the journal walk is cursor-based now (ISSUE 9 satellite): SCAN on
+    # the wire, never the server-blocking KEYS
+    assert "SCAN" in mini_redis.commands_seen
+    assert "KEYS" not in mini_redis.commands_seen
 
 
 def test_key_expiry_over_wire_with_virtual_clock():
@@ -382,6 +423,100 @@ def test_inproc_store_expiry_matches_wire_semantics():
     assert s.get("claim") == "y"
     assert s.delete("claim") == 1
     assert s.delete("claim") == 0
+
+
+def test_scan_walks_large_keyspace_incrementally(mini_redis):
+    """The KEYS→SCAN satellite (ISSUE 9 / ROADMAP item 1 follow-up):
+    a large synthetic keyspace is walked in bounded cursor batches —
+    several SCAN round-trips, complete coverage, no KEYS command — and
+    expired keys drop out mid-iteration like any other read."""
+    store = RedisResultStore(port=mini_redis.port)
+    want = {f"fsm:journal:j{i:05d}" for i in range(1200)}
+    for k in sorted(want):
+        store.set(k, "{}")
+    store.set("fsm:status:unrelated", "x")
+    mini_redis.commands_seen.clear()
+    got = list(store.scan_iter("fsm:journal:", count=100))
+    assert set(got) == want
+    assert len(got) == len(want)  # stable keyspace: exactly-once
+    n_scans = mini_redis.commands_seen.count("SCAN")
+    assert n_scans >= 12, f"expected incremental batches, got {n_scans}"
+    assert "KEYS" not in mini_redis.commands_seen
+    # one bounded step caps its reply at COUNT
+    cur, batch = store.scan_keys("fsm:journal:", "0", count=50)
+    assert len(batch) == 50 and cur != "0"
+    cur2, batch2 = store.scan_keys("fsm:journal:", cur, count=50)
+    assert batch2[0] > batch[-1]  # resumes strictly after the cursor
+    # journal_uids (the recovery pass) rides the same cursor walk
+    mini_redis.commands_seen.clear()
+    uids = store.journal_uids()
+    assert len(uids) == 1200 and "SCAN" in mini_redis.commands_seen
+    assert "KEYS" not in mini_redis.commands_seen
+
+
+def test_inproc_scan_matches_wire_semantics():
+    """The in-process store implements the same SCAN contract (opaque
+    cursor, "0" terminates, lazy expiry drops keys) so lease tests are
+    backend-agnostic."""
+    from spark_fsm_tpu.service.store import ResultStore
+
+    t = [0.0]
+    s = ResultStore(clock=lambda: t[0])
+    for i in range(25):
+        s.set(f"fsm:replica:r{i:02d}", "{}")
+    s.set_px("fsm:replica:dying", "{}", 1000)
+    seen = []
+    cursor = "0"
+    steps = 0
+    while True:
+        cursor, batch = s.scan_keys("fsm:replica:", cursor, count=7)
+        seen.extend(batch)
+        steps += 1
+        if cursor == "0":
+            break
+    assert steps >= 4
+    assert len(seen) == len(set(seen)) == 26
+    t[0] = 2.0  # the PX key expires: later scans skip it
+    assert "fsm:replica:dying" not in list(s.scan_iter("fsm:replica:"))
+
+
+def test_lease_walks_use_scan_not_keys(mini_redis):
+    """The steal/heartbeat/recovery walks (service/lease.py) must drive
+    SCAN over the wire — KEYS blocks the shared server once per replica
+    per heartbeat tick, the exact storm the satellite retires."""
+    from spark_fsm_tpu.service.lease import LeaseManager
+
+    store = RedisResultStore(port=mini_redis.port)
+    mgr = LeaseManager(store, replica_id="scan-a", lease_ttl_s=30,
+                       heartbeat_s=0)
+    peer = LeaseManager(store, replica_id="scan-b", lease_ttl_s=30,
+                        heartbeat_s=0)
+    class _IdleMiner:  # just enough Miner surface for the steal scan
+        def idle_capacity(self):
+            return 1
+
+        def queue_size(self):
+            return 0
+
+    mgr._miner = _IdleMiner()
+    peer._miner = None
+
+    def fake_peer_record():
+        peer.publish_heartbeat()
+        # overwrite with a loaded-looking record so the steal scan
+        # actually walks scan-b's admission namespace
+        raw = json.loads(store.peek("fsm:replica:scan-b"))
+        raw.update({"queued": 1, "steal": True})
+        store.set_px("fsm:replica:scan-b", json.dumps(raw), 30000)
+
+    fake_peer_record()
+    store.set("fsm:admission:scan-b:job1", "1")
+    mini_redis.commands_seen.clear()
+    peers = mgr.peers()
+    assert [p["replica"] for p in peers] == ["scan-b"]
+    mgr.steal_once()  # walks the peer's admission namespace
+    assert "SCAN" in mini_redis.commands_seen
+    assert "KEYS" not in mini_redis.commands_seen
 
 
 def test_store_fails_fast_when_down():
