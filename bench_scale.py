@@ -360,7 +360,96 @@ def config5r() -> dict:
     }
 
 
+def mesh_sweep() -> list:
+    """Scaling-curve row set over 1/2/4/8 virtual CPU devices
+    (``--mesh-sweep``): config-2/3 miniatures per device count, the TSR
+    rows routed through the equivalence-class PARTITIONED 2-D mesh
+    (parallel/partition.py) where the device count allows an outer
+    axis.  Exports the partition counters — class imbalance ratio,
+    threshold-exchange rounds, cross-partition bytes — so the curve
+    shows the partitioned regime's collectives scaling with ROUNDS
+    while the data-parallel psum path scales with launches.  Rows merge
+    into BENCH_SCALE.json by config key like every other config; walls
+    on virtual devices are shape checks, not performance claims (all
+    eight "devices" timeshare this host's cores)."""
+    import jax
+
+    from spark_fsm_tpu.data.synth import kosarak_like, msnbc_like
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    # outer-axis split per device count: d=2 is partition-only (one
+    # device per row = the engines' single-device path), d=4/8 are true
+    # 2-D parts x seq arrangements
+    parts_of = {1: 1, 2: 2, 4: 2, 8: 4}
+    db2 = msnbc_like(scale=0.002, fast=True)
+    ms = abs_minsup(0.005, len(db2))
+    db3 = kosarak_like(scale=0.002, fast=True)
+    rows = []
+    ref_rules = None
+    for d in (1, 2, 4, 8):
+        if d > len(jax.devices()):
+            break
+        mesh = make_mesh(d) if d > 1 else None
+        sstats: dict = {}
+        t0 = time.monotonic()
+        pats = mine_spade_tpu(db2, ms, mesh=mesh, stats_out=sstats)
+        rows.append({
+            "config": f"m2-d{d}", "devices": d,
+            "metric": "mesh-sweep SPADE msnbc-miniature (data-parallel "
+                      "seq shard, per-wave psum)",
+            "patterns": len(pats), "route": _route(sstats),
+            "wall_s": round(time.monotonic() - t0, 2),
+            "platform": jax.default_backend(),
+        })
+        parts = parts_of[d]
+        tstats: dict = {}
+        t0 = time.monotonic()
+        rules = mine_tsr_tpu(db3, 100, 0.5, max_side=2, mesh=mesh,
+                             partition_parts=parts if parts > 1 else 0,
+                             stats_out=tstats)
+        if ref_rules is None:
+            ref_rules = rules_text(rules)
+        row = {
+            "config": f"m3-d{d}", "devices": d, "parts": parts,
+            "inner_devices": d // parts,
+            "metric": "mesh-sweep TSR kosarak-miniature (equivalence-"
+                      "class partitioned 2-D mesh)",
+            "rules": len(rules),
+            "parity_vs_d1": rules_text(rules) == ref_rules,
+            "wall_s": round(time.monotonic() - t0, 2),
+            "kernel_launches": tstats.get("kernel_launches"),
+            "evaluated": tstats.get("evaluated"),
+            "traffic_units": tstats.get("traffic_units"),
+            "partition_imbalance": tstats.get("partition_imbalance"),
+            "partition_exchanges": tstats.get("partition_exchanges", 0),
+            "partition_cross_bytes": tstats.get("partition_cross_bytes",
+                                                0),
+            "deepening_rounds": tstats.get("deepening_rounds"),
+            "platform": jax.default_backend(),
+        }
+        rows.append(row)
+    return rows
+
+
 def main() -> None:
+    args = sys.argv[1:]
+    if "--mesh-sweep" in args:
+        # the sweep needs the 8 virtual CPU devices BEFORE the first
+        # backend init; jax.config.update pins the platform past the
+        # sandbox's ambient plugin env (see tests/conftest.py)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     from spark_fsm_tpu.utils.jitcache import enable_compile_cache
 
     enable_compile_cache()
@@ -368,9 +457,22 @@ def main() -> None:
                "3r": config3r, "4": config4, "5": config5,
                "5r": config5r}
     parity_capable = {"2", "4", "5"}  # feasible full-size oracles
-    args = sys.argv[1:]
     parity = "--parity" in args
-    which = [a for a in args if a != "--parity"]
+    sweep = "--mesh-sweep" in args
+    which = [a for a in args if a not in ("--parity", "--mesh-sweep")]
+    if sweep:
+        if which or parity:
+            # refusing beats silently skipping: an operator combining
+            # --mesh-sweep with config names would believe those rows
+            # were re-measured when the sweep branch never ran them
+            sys.exit("--mesh-sweep runs its own fixed row set and "
+                     "cannot be combined with config names or --parity "
+                     f"(got {sys.argv[1:]})")
+        rows = mesh_sweep()
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        _write_rows(rows)
+        return
     if not which:
         which = list(runners)
     if not set(which) <= set(runners):
@@ -387,6 +489,10 @@ def main() -> None:
         row = runners[n](**kwargs)
         rows.append(row)
         print(json.dumps(row), flush=True)
+    _write_rows(rows)
+
+
+def _write_rows(rows) -> None:
     if os.environ.get("BENCH_SCALE_OUT") != "0":
         import jax
 

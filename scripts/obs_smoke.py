@@ -172,7 +172,15 @@ def main() -> int:
                     "fsm_job_exec_seconds_count",
                     "fsm_job_time_to_adoption_seconds_count",
                     "fsm_job_steal_latency_seconds_count",
-                    "fsm_trace_spine_writes_total"):
+                    "fsm_trace_spine_writes_total",
+                    # ISSUE 10 families: equivalence-class partitioned
+                    # mining (parallel/partition.py) — present (zero)
+                    # even on an unpartitioned boot
+                    "fsm_partition_plans_total",
+                    "fsm_partition_exchange_rounds_total",
+                    "fsm_partition_cross_bytes_total",
+                    "fsm_partition_imbalance_ratio",
+                    "fsm_partition_mines_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -187,7 +195,9 @@ def main() -> int:
                 ("fsm_service_sheds_total", "priority",
                  {"high", "normal", "low"}),
                 ("fsm_trace_spine_writes_total", "outcome",
-                 {"ok", "fenced", "error"})):
+                 {"ok", "fenced", "error"}),
+                ("fsm_partition_mines_total", "algo",
+                 {"tsr", "spade", "cspade"})):
             got = {m.group(1) for k in families.get(fam, {})
                    for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
             missing = want - got

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Partitioned-mining smoke — the equivalence-class 2-D mesh companion
+# to verify_t1.sh (parallel/partition.py).  Pinned 8-virtual-device
+# partitioned kosarak miniature: byte parity with the single-device
+# route, exchanges-per-round collectives pin, live fsm_partition_*
+# metric families.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/partition_smoke.py "$@"

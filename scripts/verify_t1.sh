@@ -12,6 +12,10 @@
 #   throughput_smoke.sh  fused-vs-unfused flood, per-job parity
 #   resident_smoke.sh    resident-frontier 3d miniature, pinned waves +
 #                        host-path parity
+#   partition_smoke.sh   equivalence-class partitioned mine on the
+#                        8-virtual-device 2-D mesh: byte parity with
+#                        the single-device route + exchanges-per-round
+#                        collectives pin + live fsm_partition_* families
 #   replica_smoke.sh     2 replicas on one MiniRedis: work stealing,
 #                        kill -9 failover with lease-expiry adoption +
 #                        oracle parity
@@ -25,7 +29,8 @@ rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
-             throughput_smoke resident_smoke replica_smoke; do
+             throughput_smoke resident_smoke partition_smoke \
+             replica_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

@@ -90,6 +90,18 @@ File format (TOML shown; JSON with the same nesting also accepted):
     dispatch_workers = 2            # broker dispatcher threads (matured
                                     # groups run concurrently)
 
+    [partition]
+    enabled = false                 # equivalence-class partitioned mining
+                                    # (parallel/partition.py): split the
+                                    # candidate frontier over the outer
+                                    # axis of a 2-D parts x seq mesh
+    parts = 0                       # partitions (0 = auto: one per
+                                    # process in a multi-controller run,
+                                    # else 2 when the mesh has >= 2
+                                    # devices, else off)
+    classes = 64                    # km-prefix hash buckets balanced
+                                    # over the partitions
+
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
     sequences = 77500               # expected dataset scale
@@ -243,6 +255,30 @@ class FusionConfig:
 
 
 @dataclasses.dataclass
+class PartitionConfig:
+    """Equivalence-class partitioned mining (parallel/partition.py +
+    models/tsr.TsrPartitioned): the candidate frontier splits by
+    km-prefix class over the outer axis of a 2-D ``parts x seq`` mesh,
+    each partition keeps the inner seq-axis shard + psum, and the only
+    cross-partition traffic is one small exchange per round.  Output is
+    byte-identical to the unpartitioned route (docs/DESIGN.md).
+
+    ``parts = 0`` resolves at request time: one partition per process
+    in a multi-controller run, else 2 when the boot mesh splits evenly,
+    else partitioning stays off.  An explicit ``parts`` that cannot
+    split the topology degrades to unpartitioned with a
+    ``partition_config_invalid`` log line (a config typo must not fail
+    every train request).  ``classes`` is the
+    class-hash granularity (must comfortably exceed ``parts`` for the
+    LPT balance to bite; 64 is plenty up to ~16 partitions).
+    """
+
+    enabled: bool = False
+    parts: int = 0
+    classes: int = 64
+
+
+@dataclasses.dataclass
 class DistributedConfig:
     """Multi-host (jax.distributed) wiring; all-defaults = single host.
 
@@ -294,6 +330,8 @@ class Config:
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig)
     fusion: FusionConfig = dataclasses.field(default_factory=FusionConfig)
+    partition: PartitionConfig = dataclasses.field(
+        default_factory=PartitionConfig)
     cluster: ClusterConfig = dataclasses.field(
         default_factory=ClusterConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
@@ -340,6 +378,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "observability": (ObservabilityConfig,
                           top.pop("observability", {})),
         "fusion": (FusionConfig, top.pop("fusion", {})),
+        "partition": (PartitionConfig, top.pop("partition", {})),
         "cluster": (ClusterConfig, top.pop("cluster", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
@@ -384,6 +423,15 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("fusion.max_width must be >= 32 (one jnp lane)")
     if cfg.fusion.dispatch_workers < 1:
         raise ConfigError("fusion.dispatch_workers must be >= 1")
+    if cfg.partition.parts < 0:
+        raise ConfigError("partition.parts must be >= 0 (0 = auto)")
+    if cfg.partition.classes < 1:
+        raise ConfigError("partition.classes must be >= 1")
+    if (cfg.partition.parts > 1
+            and cfg.partition.classes < cfg.partition.parts):
+        raise ConfigError(
+            "partition.classes must be >= partition.parts (each "
+            "partition needs at least one equivalence class to own)")
     if cfg.cluster.lease_ttl_s <= 0:
         raise ConfigError("cluster.lease_ttl_s must be > 0")
     if cfg.cluster.heartbeat_s < 0:

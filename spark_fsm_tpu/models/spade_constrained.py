@@ -202,9 +202,15 @@ class ConstrainedSpadeTPU:
         pool_bytes: Optional[int] = None,
         max_pattern_itemsets: Optional[int] = None,
         shape_buckets: bool = False,
+        partition=None,
     ):
         self.vdb = vdb
         self.minsup = int(minsup_abs)
+        # equivalence-class partition slice (parallel/partition.py):
+        # seed only the owned classes' roots; candidate lists stay
+        # full-width (under maxgap the s-side is ALL frequent roots,
+        # which must not shrink with the slice)
+        self._partition = partition
         self.maxgap = maxgap
         self.maxwindow = maxwindow
         self.mesh = mesh
@@ -411,7 +417,14 @@ class ConstrainedSpadeTPU:
                 resume, self.frontier_fingerprint(), _Node)
             self.stats["resumed_nodes"] = len(stack)
         else:
+            seed = set(root_items)
+            if self._partition is not None:
+                plan, pidx = self._partition
+                seed = set(plan.owned_slice(root_items,
+                                            self.vdb.item_ids, pidx))
             for i in reversed(root_items):
+                if i not in seed:
+                    continue  # another partition's class slice
                 results.append((self._pattern_of(((i, True),)),
                                 int(self.vdb.item_supports[i])))
                 stack.append(_Node(((i, True),), None, root_items,
@@ -535,14 +548,25 @@ def mine_cspade_tpu(
     max_pattern_itemsets: Optional[int] = None,
     stats_out: Optional[dict] = None,
     checkpoint=None,
+    partition_parts: int = 0,
+    partition_classes: int = 64,
     **kwargs,
 ) -> List[PatternResult]:
     """DB -> vertical build -> constrained mine; ``checkpoint`` follows the
     same load/save/every_s contract as mine_spade_tpu (stale snapshots are
-    ignored, the mine restarts fresh)."""
+    ignored, the mine restarts fresh).  ``partition_parts >= 2`` routes
+    through the equivalence-class partitioned slices
+    (parallel/partition.py), byte-identical union."""
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
         return []
+    if partition_parts and int(partition_parts) > 1:
+        return _mine_cspade_partitioned(
+            vdb, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
+            mesh=mesh, parts=int(partition_parts),
+            classes=int(partition_classes),
+            max_pattern_itemsets=max_pattern_itemsets,
+            stats_out=stats_out, checkpoint=checkpoint, **kwargs)
     eng = ConstrainedSpadeTPU(vdb, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
                               mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
                               **kwargs)
@@ -552,4 +576,73 @@ def mine_cspade_tpu(
                        checkpoint_every_s=every_s)
     if stats_out is not None:
         stats_out.update(eng.stats)
+    return results
+
+
+def _mine_cspade_partitioned(
+    vdb: VerticalDB,
+    minsup_abs: int,
+    *,
+    maxgap: Optional[int],
+    maxwindow: Optional[int],
+    mesh: Optional[Mesh],
+    parts: int,
+    classes: int,
+    max_pattern_itemsets: Optional[int],
+    stats_out: Optional[dict],
+    checkpoint,
+    **kwargs,
+) -> List[PatternResult]:
+    """Equivalence-class partitioned cSPADE: same independent-slice
+    regime as plain SPADE (fixed minsup; a pattern's class is its first
+    item, so slices are disjoint and union exactly) — the gap/window
+    constraints change support counting, not the class structure."""
+    from spark_fsm_tpu.parallel import partition as PN
+
+    plan = PN.plan_partitions(vdb.item_ids, vdb.item_supports, parts,
+                              classes)
+    meshes = PN.submeshes(mesh, parts)
+    ids = vdb.item_ids
+    # fingerprint built WITHOUT a probe engine: the constrained
+    # constructor eagerly builds its device stores, and in a
+    # multi-controller run meshes[0] is another process's row — same
+    # dict ConstrainedSpadeTPU.frontier_fingerprint returns
+    fingerprint = {
+        "minsup": int(minsup_abs),
+        "maxgap": maxgap,
+        "maxwindow": maxwindow,
+        "n_items": int(vdb.n_items),
+        "n_sequences": int(vdb.n_sequences),
+        "max_itemsets": max_pattern_itemsets,
+        "item_ids_head": [int(i) for i in ids[:8]],
+        "item_ids_sum": int(ids.astype(np.int64).sum()),
+        "partition": plan.fingerprint(),
+    }
+    resume, save_cb, every_s = load_checkpoint(checkpoint, fingerprint)
+    stats: dict = {
+        "partition_parts": int(parts),
+        "partition_classes": int(classes),
+        "partition_imbalance": round(plan.imbalance_ratio, 4),
+    }
+    PN.count_mine("cspade")
+
+    def mine_part(p, inner_mesh, resume_state, part_cb):
+        eng = ConstrainedSpadeTPU(
+            vdb, minsup_abs, maxgap=maxgap, maxwindow=maxwindow,
+            mesh=inner_mesh,
+            max_pattern_itemsets=max_pattern_itemsets,
+            partition=(plan, p), **kwargs)
+        res = eng.mine(resume=resume_state, checkpoint_cb=part_cb,
+                       checkpoint_every_s=every_s)
+        PN.fold_numeric_stats(stats, eng.stats)
+        return PN.encode_patterns(res)
+
+    rows = PN.mine_partitioned_slices(
+        plan=plan, meshes=meshes, fingerprint=fingerprint,
+        mine_part=mine_part, resume=resume, checkpoint_cb=save_cb,
+        stats=stats)
+    results = sort_patterns(PN.decode_patterns(rows))
+    stats["patterns"] = len(results)
+    if stats_out is not None:
+        stats_out.update(stats)
     return results

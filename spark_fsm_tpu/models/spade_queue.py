@@ -573,11 +573,20 @@ class QueueSpadeTPU:
         caps: Optional[QueueCaps] = None,
         use_pallas="auto",
         shape_buckets: bool = False,
+        partition=None,
     ):
         self.vdb = vdb
         self.minsup = int(minsup_abs)
         self.mesh = mesh
         self.max_its = max_pattern_itemsets
+        # equivalence-class partition slice (parallel/partition.py):
+        # (PartitionPlan, part_idx) seeds ONLY the owned classes' roots
+        # — a pattern's class is its first item (the DFS root; itemset
+        # extensions add larger items only), so the owned slices are
+        # disjoint and their union is the full pattern set.  Candidate
+        # MASKS stay full-width: extensions draw from every frequent
+        # item regardless of who owns the root.
+        self._partition = partition
         self._put = functools.partial(MH.host_to_device, mesh)
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
@@ -650,12 +659,25 @@ class QueueSpadeTPU:
         return [i for i in range(self.n_items)
                 if int(self.vdb.item_supports[i]) >= self.minsup]
 
+    def _seed_roots(self) -> List[int]:
+        """The roots THIS engine seeds: every frequent item, or only
+        the owned classes' items under a partition slice (the shared-F1
+        split — ownership hashes the GLOBAL item id, so every process
+        computes the same slice with no coordination)."""
+        roots = self._roots()
+        if self._partition is None:
+            return roots
+        plan, pidx = self._partition
+        return plan.owned_slice(roots, self.vdb.item_ids, pidx)
+
     def _root_init(self, roots: List[int]):
         """Device-side queue init from the root level (shared by both
         mine paths; uploads only ~KBs of root data + one counter)."""
         cap, ni = self.caps, self.ni_pad
         root_mask = np.zeros(ni, bool)
-        root_mask[roots] = True
+        # the mask is the EXTENSION universe — always every frequent
+        # item, even when a partition slice seeds only its own roots
+        root_mask[self._roots()] = True
         root_ids = np.zeros(cap.ring, np.int32)
         root_sups = np.zeros(cap.ring, np.int32)
         for k, i in enumerate(roots):
@@ -707,7 +729,7 @@ class QueueSpadeTPU:
 
     def _mine_oneshot(self) -> Optional[List[PatternResult]]:
         vdb, cap = self.vdb, self.caps
-        roots = self._roots()
+        roots = self._seed_roots()
         n_roots = len(roots)
         if n_roots == 0:
             return []
@@ -817,7 +839,7 @@ class QueueSpadeTPU:
             ckpt_done = len(results)
             pending_n = len(nodes)
         else:
-            roots = self._roots()
+            roots = self._seed_roots()
             if not roots:
                 return []
             if len(roots) > min(cap.ring, cap.r_cap):

@@ -311,10 +311,17 @@ class SpadeTPU:
         max_pattern_itemsets: Optional[int] = None,
         use_pallas="auto",
         shape_buckets: bool = False,
+        partition=None,
     ):
         self.vdb = vdb
         self.minsup = int(minsup_abs)
         self.mesh = mesh
+        # equivalence-class partition slice (parallel/partition.py):
+        # seed only the owned classes' ROOTS — candidate lists stay
+        # full-width (extensions draw from every frequent item), so the
+        # owned subtrees are exactly the patterns whose first item this
+        # partition owns, and the slices union to the full set
+        self._partition = partition
         # Multi-host mesh (jax.distributed): host-side inputs must become
         # global replicated arrays; see parallel/multihost.py.
         self._multiproc = MH.is_multihost(mesh)
@@ -697,7 +704,14 @@ class SpadeTPU:
             results = []
             root_items = [i for i in range(self.n_items)
                           if int(self.vdb.item_supports[i]) >= minsup]
+            seed = set(root_items)
+            if self._partition is not None:
+                plan, pidx = self._partition
+                seed = set(plan.owned_slice(root_items,
+                                            self.vdb.item_ids, pidx))
             for i in reversed(root_items):
+                if i not in seed:
+                    continue  # another partition's class slice
                 results.append((self._pattern_of(((i, True),)),
                                 int(self.vdb.item_supports[i])))
                 stack.append(_Node(((i, True),), i, root_items,
@@ -739,6 +753,8 @@ def mine_spade_tpu(
     stats_out: Optional[dict] = None,
     checkpoint=None,
     fused: str = "auto",
+    partition_parts: int = 0,
+    partition_classes: int = 64,
     **kwargs,
 ) -> List[PatternResult]:
     """Convenience wrapper: DB -> vertical build -> TPU mine.
@@ -770,6 +786,39 @@ def mine_spade_tpu(
     if fused not in ("auto", "always", "never", "queue", "dense"):
         raise ValueError(f"fused must be 'auto', 'always', 'never', "
                          f"'queue' or 'dense', got {fused!r}")
+    if partition_parts and int(partition_parts) > 1:
+        # equivalence-class partitioned route (parallel/partition.py):
+        # independent class slices over the 2-D parts x seq mesh, one
+        # exchange at the end, byte-identical union
+        return _mine_spade_partitioned(
+            vdb, minsup_abs, mesh=mesh, parts=int(partition_parts),
+            classes=int(partition_classes),
+            max_pattern_itemsets=max_pattern_itemsets,
+            stats_out=stats_out, checkpoint=checkpoint, fused=fused,
+            **kwargs)
+    return _route_spade(
+        vdb, minsup_abs, mesh=mesh,
+        max_pattern_itemsets=max_pattern_itemsets, stats_out=stats_out,
+        checkpoint=checkpoint, fused=fused, **kwargs)
+
+
+def _route_spade(
+    vdb: VerticalDB,
+    minsup_abs: int,
+    *,
+    mesh: Optional[Mesh] = None,
+    max_pattern_itemsets: Optional[int] = None,
+    stats_out: Optional[dict] = None,
+    checkpoint=None,
+    fused: str = "auto",
+    partition=None,
+    **kwargs,
+) -> List[PatternResult]:
+    """The engine-routing body shared by the plain and partitioned
+    entries: queue -> dense -> classic, with ``partition`` (a
+    (PartitionPlan, part_idx) slice) threaded into the engines that
+    support root slices — the dense whole-mine engine does not, so the
+    partitioned caller remaps its routing away from it."""
     shape_buckets = kwargs.get("shape_buckets", False)
     ekw = dict(mesh=mesh, max_pattern_itemsets=max_pattern_itemsets,
                use_pallas=kwargs.get("use_pallas", "auto"),
@@ -779,7 +828,8 @@ def mine_spade_tpu(
             QueueSpadeTPU, queue_eligible)
         if fused in ("always", "queue") or queue_eligible(
                 vdb, mesh=mesh, shape_buckets=shape_buckets):
-            qeng = QueueSpadeTPU(vdb, minsup_abs, **ekw)
+            qeng = QueueSpadeTPU(vdb, minsup_abs, partition=partition,
+                                 **ekw)
             q_resume, q_save, q_every = load_checkpoint(
                 checkpoint, qeng.frontier_fingerprint())
             res = qeng.mine(resume=q_resume, checkpoint_cb=q_save,
@@ -809,11 +859,14 @@ def mine_spade_tpu(
             if fused in ("always", "dense") or fused_eligible(
                     vdb, mesh=mesh, shape_buckets=shape_buckets):
                 stats_out["fused_skipped"] = "checkpoint"
-    if checkpoint is None and fused in ("always", "dense", "auto"):
+    if checkpoint is None and partition is None \
+            and fused in ("always", "dense", "auto"):
         # dense engine: pinned, or "auto"/"always"'s second try — reached
         # when the queue engine was ineligible OR overflowed its caps
         # (a queue success returned above), so an overflowing workload
-        # still gets the one-readback path where the dense engine fits
+        # still gets the one-readback path where the dense engine fits.
+        # Gated off under a partition slice: the whole-mine dense
+        # program has no root slice to restrict
         from spark_fsm_tpu.models.spade_fused import (
             FusedSpadeTPU, fused_eligible)
         if fused in ("always", "dense") or fused_eligible(
@@ -828,7 +881,8 @@ def mine_spade_tpu(
                 stats_out["fused_overflow"] = True
                 stats_out["fused_levels"] = feng.stats.get("levels", 0)
     eng = SpadeTPU(vdb, minsup_abs, mesh=mesh,
-                   max_pattern_itemsets=max_pattern_itemsets, **kwargs)
+                   max_pattern_itemsets=max_pattern_itemsets,
+                   partition=partition, **kwargs)
     resume, save_cb, every_s = load_checkpoint(
         checkpoint, eng.frontier_fingerprint())
     results = eng.mine(resume=resume, checkpoint_cb=save_cb,
@@ -839,4 +893,97 @@ def mine_spade_tpu(
         # `route` field, streaming diagnostics) distinguish "routed
         # classic" from "no routing exists" by this key's presence
         stats_out.setdefault("fused", False)
+    return results
+
+
+class _SliceCheckpoint:
+    """Adapter handing a partition slice its resumed state and snapshot
+    callback through the engines' standard checkpoint contract."""
+
+    def __init__(self, state, save, every_s: float):
+        self._state = state
+        self.save = save
+        self.every_s = every_s
+
+    def load(self):
+        return self._state
+
+
+def _mine_spade_partitioned(
+    vdb: VerticalDB,
+    minsup_abs: int,
+    *,
+    mesh: Optional[Mesh],
+    parts: int,
+    classes: int,
+    max_pattern_itemsets: Optional[int],
+    stats_out: Optional[dict],
+    checkpoint,
+    fused: str,
+    **kwargs,
+) -> List[PatternResult]:
+    """Equivalence-class partitioned SPADE: each partition mines the
+    patterns rooted at its owned classes as an INDEPENDENT slice (fixed
+    minsup — no dynamic threshold, so the slices share nothing beyond
+    the replicated F1 seed already inside ``vdb``), and the union of
+    slices IS the exact pattern set: a pattern's class is its first
+    item, so every pattern belongs to exactly one slice.
+
+    Routing per slice is the normal queue -> classic ladder with the
+    DENSE engine remapped away (its whole-mine device program has no
+    root slice).  Checkpoints are composite — merged patterns at top
+    level plus the active slice's frontier in the engines' existing
+    ``frontier_state`` format (parallel/partition.py
+    ``mine_partitioned_slices``)."""
+    from spark_fsm_tpu.parallel import partition as PN
+
+    plan = PN.plan_partitions(vdb.item_ids, vdb.item_supports, parts,
+                              classes)
+    meshes = PN.submeshes(mesh, parts)
+    # dense has no root slice: "always"/"dense" remap to "auto" — the
+    # eligibility-gated queue-first ladder (forcing "queue" would
+    # bypass queue_eligible's alphabet/memory bounds and OOM exactly
+    # the large-alphabet mines partitioning targets); _route_spade
+    # additionally gates its dense branch off under a partition slice
+    fused_p = fused if fused in ("never", "queue", "auto") else "auto"
+    ids = vdb.item_ids
+    fingerprint = {
+        "minsup": int(minsup_abs),
+        "n_items": int(vdb.n_items),
+        "n_sequences": int(vdb.n_sequences),
+        "max_itemsets": max_pattern_itemsets,
+        "item_ids_head": [int(i) for i in ids[:8]],
+        "item_ids_sum": int(ids.astype(np.int64).sum()),
+        "partition": plan.fingerprint(),
+    }
+    resume, save_cb, every_s = load_checkpoint(checkpoint, fingerprint)
+    stats: dict = {
+        "partition_parts": int(parts),
+        "partition_classes": int(classes),
+        "partition_imbalance": round(plan.imbalance_ratio, 4),
+    }
+    PN.count_mine("spade")
+
+    def mine_part(p, inner_mesh, resume_state, part_cb):
+        part_stats: dict = {}
+        ckpt = None
+        if resume_state is not None or part_cb is not None:
+            ckpt = _SliceCheckpoint(resume_state, part_cb, every_s)
+        res = _route_spade(
+            vdb, minsup_abs, mesh=inner_mesh,
+            max_pattern_itemsets=max_pattern_itemsets,
+            stats_out=part_stats, checkpoint=ckpt, fused=fused_p,
+            partition=(plan, p), **kwargs)
+        PN.fold_numeric_stats(stats, part_stats)
+        return PN.encode_patterns(res)
+
+    rows = PN.mine_partitioned_slices(
+        plan=plan, meshes=meshes, fingerprint=fingerprint,
+        mine_part=mine_part, resume=resume, checkpoint_cb=save_cb,
+        stats=stats)
+    results = sort_patterns(PN.decode_patterns(rows))
+    stats["patterns"] = len(results)
+    stats["fused"] = "partitioned"
+    if stats_out is not None:
+        stats_out.update(stats)
     return results

@@ -61,6 +61,7 @@ from spark_fsm_tpu.ops import pallas_tsr as PT
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.ops import resident_frontier as RF
 from spark_fsm_tpu.parallel import multihost as MH
+from spark_fsm_tpu.parallel import partition as PN
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
 from spark_fsm_tpu.service import fusion as FZ
 from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
@@ -354,11 +355,25 @@ class TsrTPU:
         use_pallas="auto",
         shape_buckets: bool = False,
         resident="auto",
+        partition=None,
     ):
         self.vdb = vdb
         self.k = int(k)
         self.minconf = float(minconf)
         self.mesh = mesh
+        # equivalence-class partition slice (parallel/partition.py):
+        # (PartitionPlan, part_idx) restricts candidate GENERATION to
+        # the roots whose class this partition owns — a candidate's
+        # class is min(X), invariant under both expansion directions,
+        # so the owned subtrees are exactly the owned classes.  None
+        # (the default) is the classic whole-frontier engine.
+        if partition is not None:
+            plan, pidx = partition
+            if not (0 <= int(pidx) < plan.n_parts):
+                raise ValueError(f"partition index {pidx} out of range "
+                                 f"for {plan.n_parts} partitions")
+            partition = (plan, int(pidx))
+        self._partition = partition
         # Multi-host mesh: host-side inputs must become global replicated
         # arrays (see parallel/multihost.py)
         self._multiproc = MH.is_multihost(mesh)
@@ -465,6 +480,23 @@ class TsrTPU:
         order = np.lexsort((vdb.item_ids, -vdb.item_supports))
         self._order = order
         self._sup_sorted = vdb.item_supports[order]
+        if self._partition is not None:
+            self.stats["partition"] = self._partition[1]
+
+    def _part_idx(self) -> Optional[int]:
+        return None if self._partition is None else self._partition[1]
+
+    def _owned_mask(self, m: int) -> Optional[np.ndarray]:
+        """Boolean mask over the round's local root indices 0..m-1: True
+        where this partition owns the root's equivalence class (hash of
+        the GLOBAL item id, parallel/partition.py — stable across
+        deepening rounds and identical on every process).  None when the
+        engine is unpartitioned (the classic whole-frontier search)."""
+        if self._partition is None:
+            return None
+        plan, pidx = self._partition
+        ids = self.vdb.item_ids[self._order[:m]]
+        return plan.owner_of(ids) == pidx
 
     # ------------------------------------------------------------- kernels
 
@@ -724,7 +756,8 @@ class TsrTPU:
                     if km not in self._pallas_bad}
             plan = RB.plan_launches(
                 kern, cap=lambda km: self.chunk, lane=PT.C_LANES,
-                overhead=RB.overhead_units(self.n_seq, self.n_words))
+                overhead=RB.overhead_units(self.n_seq, self.n_words),
+                part=self._part_idx())
             for L in plan:
                 if L.km in self._pallas_bad:
                     # a geometry that failed earlier in THIS plan: its
@@ -770,7 +803,8 @@ class TsrTPU:
                    else (lambda km: max(32, min(cw, self._jnp_raw // km))))
             for L in RB.plan_launches(
                     leftover, cap=cap, lane=32,
-                    overhead=RB.overhead_units(self.n_seq, self.n_words)):
+                    overhead=RB.overhead_units(self.n_seq, self.n_words),
+                    part=self._part_idx()):
                 with obs.span("tsr.launch", point="jnp", km=L.km,
                               width=L.width, predicted_s=round(
                                   RB.estimate_seconds(
@@ -915,9 +949,14 @@ class TsrTPU:
                 for lo, hi in ((0, half), (half, len(L.rows))):
                     rows = L.rows[lo:hi]
                     if rows:
+                        # the half re-plans keep the parent's part tag:
+                        # per-partition accounting must hold
+                        # sum(launches_part*) == kernel_launches even
+                        # under the degradation ladder
                         base = self._dispatch_kernel_launch(
                             p1k, s1k, cands,
-                            RB.Launch(L.km, half, rows, L.kms[lo:hi]),
+                            RB.Launch(L.km, half, rows, L.kms[lo:hi],
+                                      None, L.part),
                             parts, cols, base)
                 return base
             self._xy_bufs.append(xy)
@@ -945,6 +984,11 @@ class TsrTPU:
         if L.mixed:
             self.stats["superbatches"] = (
                 self.stats.get("superbatches", 0) + 1)
+        if L.part is not None:
+            # per-partition dispatch accounting (parallel/partition.py):
+            # the scaling bench reads the partition split off these
+            pk = f"launches_part{L.part}"
+            self.stats[pk] = self.stats.get(pk, 0) + 1
         if self._RECORD_SHAPES:
             shapes.record(shapes.key_tsr_eval(
                 self.n_seq, self.n_words, L.km, L.width))
@@ -1056,7 +1100,8 @@ class TsrTPU:
 
     def _mine_restricted(self, m: int, resume: Optional[dict] = None,
                          checkpoint_cb=None,
-                         every_s: float = 30.0) -> Tuple[List[RuleResult], int]:
+                         every_s: float = 30.0,
+                         floor: int = 1) -> Tuple[List[RuleResult], int]:
         """Full search over the top-m items; returns (results, s_k).
 
         Routes the round: the RESIDENT-FRONTIER path (whole km-ladders
@@ -1065,17 +1110,24 @@ class TsrTPU:
         launch-bound behavior, else the classic host loop below.  The
         resident path spills back here on any capacity overflow, so the
         choice is a performance routing decision, never a correctness
-        one."""
+        one.
+
+        ``floor``: initial minsup — the partitioned route's conservative
+        global top-k floor (parallel/partition.py ThresholdBoard).  It
+        is a LOWER bound on the global s_k by construction, so starting
+        the dynamic threshold there prunes only candidates that can
+        never enter the global top-k; 1 (the default) is the classic
+        whole-frontier behavior."""
         self.chunk = self._round_chunk(m)
         self._round_m = m
         self._jnp_prep = None  # cleared per round (downgrade state is stale)
         if self._resident_route(m):
             return self._mine_resident(m, resume=resume,
                                        checkpoint_cb=checkpoint_cb,
-                                       every_s=every_s)
+                                       every_s=every_s, floor=floor)
         return self._mine_host_restricted(m, resume=resume,
                                           checkpoint_cb=checkpoint_cb,
-                                          every_s=every_s)
+                                          every_s=every_s, floor=floor)
 
     def _resident_route(self, m: int) -> bool:
         """Should this round run on the resident-frontier path?
@@ -1116,7 +1168,7 @@ class TsrTPU:
     # ------------------------------------------------- resident route
 
     def _mine_resident(self, m: int, resume: Optional[dict],
-                       checkpoint_cb, every_s: float,
+                       checkpoint_cb, every_s: float, floor: int = 1,
                        ) -> Tuple[List[RuleResult], int]:
         """One deepening round on the resident-frontier path: the
         frontier, per-candidate antecedent supports and the top-k prune
@@ -1140,26 +1192,33 @@ class TsrTPU:
         max_side_t = self.max_side if self.max_side is not None else 1 << 30
         sup_l = self._sup_sorted[:m].astype(np.int64).tolist()
         if resume is not None:
-            minsup = int(resume["minsup"])
+            minsup = max(int(resume["minsup"]), int(floor))
             results0 = [(int(sup), int(supx), tuple(x), tuple(y))
-                        for x, y, sup, supx in resume["results"]]
+                        for x, y, sup, supx in resume["results"]
+                        if int(sup) >= minsup]
             entries = [(int(b), tuple(x), tuple(y), bool(cr), int(side),
                         int(psup), int(psupx))
                        for b, x, y, cr, side, psup, psupx
                        in resume["stack"]]
             self.stats["resumed_nodes"] = len(entries)
         else:
-            minsup = 1
+            minsup = max(1, int(floor))
             results0 = []
             entries = RF.root_entries(sup_l, minsup, num, den,
                                       self.max_side)
+            own = self._owned_mask(m)
+            if own is not None:
+                # partition-aware candidate generation: seed only the
+                # owned classes' root chains — every descendant keeps
+                # min(X) = the root, so the whole slice stays owned
+                entries = [e for e in entries if own[e[1][0]]]
         state = RF.pack_state(entries, results0, caps)
         if state is None:
             # the resumed frontier outgrows the caps (e.g. a host
             # snapshot with sides past the km ladder): route host
             return self._mine_host_restricted(
                 m, resume=resume, checkpoint_cb=checkpoint_cb,
-                every_s=every_s)
+                every_s=every_s, floor=floor)
         self.stats["resident"] = True
         self.stats["resident_rounds"] = (
             self.stats.get("resident_rounds", 0) + 1)
@@ -1249,7 +1308,7 @@ class TsrTPU:
                 # readable here)
                 return self._resident_abandon(
                     exc, m, resume, checkpoint_cb, every_s,
-                    ev_done, pr_done, tr_done, seg_launches)
+                    ev_done, pr_done, tr_done, seg_launches, floor)
             (n_rec, oflow, waves, head, tail, minsup, evaluated,
              pruned, _n_acc, n_def) = (int(v) for v in counters)
             RF.count_segment(waves - waves_done, nbw, caps.km)
@@ -1318,7 +1377,7 @@ class TsrTPU:
             # a mid-ladder segment fault
             return self._resident_abandon(
                 exc, m, resume, checkpoint_cb, every_s,
-                ev_done, pr_done, tr_done, seg_launches)
+                ev_done, pr_done, tr_done, seg_launches, floor)
         nbytes = sum(a.nbytes for a in arrs)
         RF.count_readback(nbytes)
         self.stats["resident_readback_bytes"] = (
@@ -1358,7 +1417,7 @@ class TsrTPU:
 
     def _resident_abandon(self, exc, m: int, resume, checkpoint_cb,
                           every_s: float, ev_done: int, pr_done: int,
-                          tr_done: int, seg_launches: int,
+                          tr_done: int, seg_launches: int, floor: int = 1,
                           ) -> Tuple[List[RuleResult], int]:
         """Abandon a faulted resident round to the host path from its
         ORIGINAL state: the frontier is never lost (roots/resume
@@ -1381,7 +1440,7 @@ class TsrTPU:
                         error=f"{type(exc).__name__}: {exc}")
         return self._mine_host_restricted(
             m, resume=resume, checkpoint_cb=checkpoint_cb,
-            every_s=every_s)
+            every_s=every_s, floor=floor)
 
     def _resident_entries(self, carry, head: int, tail: int, n_rec: int,
                           n_def: int, minsup: int):
@@ -1460,6 +1519,7 @@ class TsrTPU:
     def _mine_host_restricted(self, m: int, resume: Optional[dict] = None,
                               checkpoint_cb=None, every_s: float = 30.0,
                               count_resume: bool = True, prep=None,
+                              floor: int = 1,
                               ) -> Tuple[List[RuleResult], int]:
         """The classic host-driven round: best-first heap on host,
         ragged super-batched eval dispatches on device.
@@ -1483,7 +1543,12 @@ class TsrTPU:
         ids = self.vdb.item_ids[self._order[:m]]
 
         results: List[Tuple[int, int, Tuple[int, ...], Tuple[int, ...]]] = []
-        minsup = 1
+        # the partitioned route's conservative global floor is a sound
+        # initial threshold (parallel/partition.py: floor <= global s_k
+        # always, so nothing prunable here can enter the global top-k);
+        # 1 in the classic whole-frontier search
+        floor = max(1, int(floor))
+        minsup = floor
         sup_sorted: List[int] = []  # ascending supports of accepted rules
         # conf test as exact integer cross-multiply (no per-rule Fraction
         # construction): sup/supx >= num/den — shared by acceptance AND
@@ -1492,7 +1557,7 @@ class TsrTPU:
 
         def s_k_threshold() -> int:
             if len(sup_sorted) < self.k:
-                return 1
+                return floor
             return sup_sorted[-self.k]
 
         # queue: (-bound, X, Y, can_right, side, psup, psupx); X/Y are
@@ -1572,9 +1637,10 @@ class TsrTPU:
                 push(queue, (-b, xf, yf + (c,), cr, 1, psup, psupx))
 
         if resume is not None:
-            minsup = int(resume["minsup"])
+            minsup = max(int(resume["minsup"]), floor)
             results = [(int(sup), int(supx), tuple(x), tuple(y))
-                       for x, y, sup, supx in resume["results"]]
+                       for x, y, sup, supx in resume["results"]
+                       if int(sup) >= minsup]
             sup_sorted = sorted(r[0] for r in results)
             jcut = item_cut()
             queue = [(-int(b), tuple(x), tuple(y), bool(cr), int(side),
@@ -1587,8 +1653,14 @@ class TsrTPU:
             # roots: one right-side chain per item i over partners j != i
             # (bound min(sup_i, sup_j) is nonincreasing in j) — m entries
             # instead of the m^2 of eager enumeration.  X = {i} is fixed,
-            # so psupx = sup(i) exactly.
+            # so psupx = sup(i) exactly.  A partitioned engine seeds only
+            # its OWNED classes' roots (partition-aware candidate
+            # generation: min(X) never changes, so the slice is closed
+            # under both expansion directions).
+            own = self._owned_mask(m)
             for i in range(m):
+                if own is not None and not own[i]:
+                    continue
                 chain_push((i,), (), True, 1, sup_l[i], sup_l[i], 0)
 
         def left_viable(x, y):
@@ -1847,17 +1919,241 @@ class TsrCPU(TsrTPU):
         return handle
 
 
+class TsrPartitioned:
+    """Equivalence-class partitioned TSR over a 2-D ``hosts x seq`` mesh.
+
+    The scaling regime the single engine cannot reach: the candidate
+    frontier splits by km-prefix equivalence class (a rule's class is
+    ``min(X)``, invariant under both expansion directions) across the
+    OUTER partition axis, while each partition keeps the classic
+    seq-axis shard + ICI ``psum`` on its INNER submesh row.  Each
+    partition enumerates ONLY its owned classes — the host-side DFS that
+    was duplicated SPMD on every process finally scales with hosts — and
+    the only cross-partition traffic is ONE small exchange per deepening
+    round (threshold floor + result slices), not a per-wave full-mesh
+    ``psum``.
+
+    Exactness (docs/DESIGN.md "Partitioned mining"): each partition's
+    dynamic threshold starts at the board's conservative global floor —
+    a lower bound on the global s_k, since the global k-th-largest is
+    taken over a superset of any partition's results — so per-partition
+    pruning removes only candidates that can never enter the global
+    top-k; the final merge recomputes the exact global s_k over the
+    union and filters, restoring BYTE-IDENTICAL output to the
+    single-route mine.  The floor only ever tightens (within a round via
+    the sequential in-process schedule, across rounds via the exchanged
+    global s_k).  The honest trade: partition-local thresholds rise more
+    slowly than the global one, so the partitioned route EVALUATES MORE
+    candidates than the classic route at equal output (~2x on the
+    kosarak miniature at 2 parts; docs/DESIGN.md) — the floor exchange
+    bounds the overspend, and the win is each partition running on its
+    own silicon, not fewer evaluations.
+
+    Checkpoints: one composite snapshot per save, carrying the merged
+    results (rewrite mode, like the engine's own) plus each partition's
+    frontier in the engines' EXISTING ``frontier_state`` format — a
+    resumed composite feeds every part exactly the snapshot its engine
+    would have written solo.  The fingerprint binds the partition layout
+    (plan fingerprint), so a changed parts/classes config restarts
+    fresh instead of resuming another layout's slices.
+    """
+
+    def __init__(self, vdb: VerticalDB, k: int, minconf: float, *,
+                 mesh: Optional[Mesh] = None, parts: int,
+                 classes: int = 64, record_metrics: bool = True,
+                 **engine_kwargs):
+        self.vdb = vdb
+        self.k = int(k)
+        self.minconf = float(minconf)
+        # record_metrics=False (prewarm's synthetic warm mine): the
+        # fsm_partition_* business families must not report mines that
+        # never happened, nor the warm plan's imbalance
+        self._record_metrics = bool(record_metrics)
+        self.plan = PN.plan_partitions(vdb.item_ids, vdb.item_supports,
+                                       parts, classes,
+                                       record=self._record_metrics)
+        self.meshes = PN.submeshes(mesh, parts)
+        self.owned = PN.owned_parts(self.plan)
+        self.item_cap = int(engine_kwargs.get("item_cap",
+                                              ITEM_CAP_DEFAULT))
+        self.engines: Dict[int, TsrTPU] = {
+            p: TsrTPU(vdb, k, minconf, mesh=self.meshes[p],
+                      partition=(self.plan, p), **engine_kwargs)
+            for p in self.owned}
+        first = self.engines[self.owned[0]]
+        self.stats: dict = {
+            "partition_parts": int(parts),
+            "partition_classes": int(classes),
+            "partition_owned": list(self.owned),
+            "partition_imbalance": round(self.plan.imbalance_ratio, 4),
+            "partition_exchanges": 0,
+            "partition_cross_bytes": 0,
+            "deepening_rounds": 0,
+            "shape_key": shapes.key_tsr_part(
+                int(parts), first.n_seq, vdb.n_words),
+        }
+        if first._RECORD_SHAPES:
+            shapes.record(self.stats["shape_key"])
+        if self._record_metrics:
+            PN.count_mine("tsr")
+
+    def frontier_fingerprint(self) -> dict:
+        fp = self.engines[self.owned[0]].frontier_fingerprint()
+        fp["partition"] = self.plan.fingerprint()
+        return fp
+
+    def _composite(self, m: int, floor: int, done: dict,
+                   active_part, active_state) -> dict:
+        """One checkpoint for the whole partitioned mine: the shared
+        composite schema (parallel/partition.py ``composite_state`` —
+        ONE owner for the crash-recovery format) extended with the TSR
+        round's (m, floor) so a resume re-enters the right deepening
+        round at the right threshold."""
+        return PN.composite_state(
+            self.frontier_fingerprint(), done, active_part,
+            active_state, m=int(m), minsup=int(floor))
+
+    def _mine_round(self, m: int, floor: int, resume: Optional[dict],
+                    checkpoint_cb, every_s: float):
+        """One deepening round: every owned partition mines its class
+        slice (sequentially in-process — the schedule that makes the
+        in-round floor tightening free), then ONE cross-partition
+        exchange merges result slices and thresholds globally."""
+        board = PN.ThresholdBoard(self.k, floor)
+        done, active_resume = PN.decode_composite(
+            resume, self.frontier_fingerprint())
+        for rows_p in done.values():
+            board.merge(int(r[2]) for r in rows_p)
+        for p in self.owned:
+            if p in done:
+                continue  # completed before the resumed snapshot
+            eng = self.engines[p]
+            cb = None
+            if checkpoint_cb is not None:
+                def cb(fs, p=p):
+                    checkpoint_cb(self._composite(
+                        m, board.floor(), done, p, fs))
+            res_p, _s_k_p = eng._mine_restricted(
+                m, resume=active_resume.get(p), checkpoint_cb=cb,
+                every_s=every_s, floor=board.floor())
+            done[p] = [[list(x), list(y), int(sup), int(supx)]
+                       for x, y, sup, supx in res_p]
+            board.merge(r[2] for r in done[p])
+            if checkpoint_cb is not None:
+                # part boundary: the next crash resumes past this slice
+                checkpoint_cb(self._composite(m, board.floor(), done,
+                                              None, None))
+        # contribute ONLY owned parts (see partition.py
+        # mine_partitioned_slices: a resumed shared composite carries
+        # other processes' slices — re-contributing them would
+        # duplicate supports and inflate the merged s_k)
+        own = set(self.owned)
+        payload = {"floor": board.floor(),
+                   "rows": [r for p in sorted(done) if p in own
+                            for r in done[p]]}
+        gathered = PN.exchange_objects(payload, stats=self.stats,
+                                       record=self._record_metrics)
+        rows_all = [r for g in gathered for r in g["rows"]]
+        # post-exchange floor from a FRESH board over the merged rows:
+        # re-merging our own slice into the in-round board would insert
+        # every support twice and inflate the "k-th largest" past the
+        # true global s_k — an unsound floor that silently prunes real
+        # top-k rules in later rounds.  Peer floors are valid lower
+        # bounds too (each is a k-th largest over a subset), so fold
+        # them in via max.
+        out = PN.ThresholdBoard(
+            self.k, max([board.floor()]
+                        + [int(g.get("floor", 1)) for g in gathered]))
+        out.merge(int(r[2]) for r in rows_all)
+        return rows_all, out.floor()
+
+    def _merge(self, rows: list) -> Tuple[List[RuleResult], int]:
+        """Exact global top-k filter over the union of class slices —
+        the step that restores byte-identical output: global s_k is the
+        k-th largest support over ALL qualifying rules (each partition's
+        floor never exceeded it, so none of them pruned a survivor)."""
+        qual = [(tuple(int(i) for i in x), tuple(int(j) for j in y),
+                 int(sup), int(supx)) for x, y, sup, supx in rows]
+        sups = sorted((r[2] for r in qual), reverse=True)
+        s_k = sups[self.k - 1] if len(sups) >= self.k else 1
+        return sort_rules([r for r in qual if r[2] >= s_k]), s_k
+
+    def mine(self, *, resume: Optional[dict] = None, checkpoint_cb=None,
+             checkpoint_every_s: float = 30.0) -> List[RuleResult]:
+        if resume is not None:
+            fp = resume.get("fingerprint")
+            if fp != self.frontier_fingerprint():
+                raise ValueError(
+                    "partitioned frontier checkpoint does not match this "
+                    f"layout; checkpointed {fp}, engine "
+                    f"{self.frontier_fingerprint()}")
+        n_total = self.vdb.n_items
+        if resume is not None:
+            m = max(1, min(int(resume["m"]), n_total))
+            floor = max(1, int(resume.get("minsup", 1)))
+        else:
+            m = max(1, min(self.item_cap, n_total))
+            floor = 1
+        first = self.engines[self.owned[0]]
+        while True:
+            self.stats["deepening_rounds"] += 1
+            rows, floor = self._mine_round(m, floor, resume,
+                                           checkpoint_cb,
+                                           checkpoint_every_s)
+            resume = None  # only the first (snapshot's) round resumes
+            results, s_k = self._merge(rows)
+            if m >= n_total:
+                break
+            # the deepening decision runs on MERGED global state, so
+            # every process walks the identical m ladder (the exchange
+            # made rows identical everywhere)
+            next_item_sup = int(first._sup_sorted[m])
+            if len(results) >= self.k and next_item_sup < s_k:
+                break
+            if len(results) >= self.k:
+                # the exact global s_k of round m lower-bounds round
+                # 2m's (more items only ADD qualifying rules) — carry it
+                # as the next round's floor (monotone tightening)
+                floor = max(floor, s_k)
+            m = min(m * 2, n_total)
+        self._fold_stats()
+        return results
+
+    def _fold_stats(self) -> None:
+        """Aggregate the per-part engines' numeric counters (launches,
+        evaluated, traffic, per-km and per-part families) into the
+        orchestrator's stats for the bench/smoke exports."""
+        for eng in self.engines.values():
+            PN.fold_numeric_stats(
+                self.stats, {k: v for k, v in eng.stats.items()
+                             if k not in ("shape_key", "partition")})
+
+
 def mine_tsr_tpu(db: SequenceDB, k: int, minconf: float, *,
                  mesh: Optional[Mesh] = None,
                  stats_out: Optional[dict] = None,
-                 checkpoint=None, **kwargs) -> List[RuleResult]:
+                 checkpoint=None, partition_parts: int = 0,
+                 partition_classes: int = 64,
+                 **kwargs) -> List[RuleResult]:
     """``checkpoint`` (optional): an object with ``load() -> Optional[dict]``,
     ``save(state)``, and ``every_s`` — a stale/mismatched snapshot is
-    ignored (the mine restarts fresh), same contract as mine_spade_tpu."""
+    ignored (the mine restarts fresh), same contract as mine_spade_tpu.
+
+    ``partition_parts >= 2`` routes the mine through the
+    equivalence-class partitioned orchestrator (:class:`TsrPartitioned`;
+    ``partition_classes`` sets the class-hash granularity): the mesh
+    splits into a 2-D ``parts x seq`` arrangement and candidate work
+    scales over the outer axis with byte-identical output.  0/1 (the
+    default) is the classic whole-frontier engine, untouched."""
     vdb = build_vertical(db, min_item_support=1)
     if vdb.n_items == 0:
         return []
-    eng = TsrTPU(vdb, k, minconf, mesh=mesh, **kwargs)
+    if partition_parts and int(partition_parts) > 1:
+        eng = TsrPartitioned(vdb, k, minconf, mesh=mesh,
+                             parts=int(partition_parts),
+                             classes=int(partition_classes), **kwargs)
+    else:
+        eng = TsrTPU(vdb, k, minconf, mesh=mesh, **kwargs)
     resume, save_cb, every_s = load_checkpoint(
         checkpoint, eng.frontier_fingerprint())
     results = eng.mine(resume=resume, checkpoint_cb=save_cb,

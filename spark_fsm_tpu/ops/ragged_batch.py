@@ -209,7 +209,11 @@ class Launch:
     (service/fusion.py) plans launches over candidates pooled from
     SEVERAL concurrent mines, and the per-lane job tag is what lets its
     readback demux each lane's (sup, supx) back to the job that owns
-    it.
+    it.  ``part``: the equivalence-class partition the launch belongs
+    to (parallel/partition.py; None outside partitioned mines) — a
+    partitioned mine's launches are planned per partition under that
+    partition's own caps, and the tag keys the per-partition dispatch
+    accounting the scaling bench exports.
     """
 
     km: int
@@ -217,6 +221,7 @@ class Launch:
     rows: List[int]
     kms: List[int]
     jobs: Optional[List[int]] = None
+    part: Optional[int] = None
 
     @property
     def traffic_units(self) -> int:
@@ -252,7 +257,8 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
                   lane: int,
                   overhead: int = LAUNCH_OVERHEAD_UNITS,
                   job_of: Optional[Callable[[int], int]] = None,
-                  record: bool = True) -> List[Launch]:
+                  record: bool = True,
+                  part: Optional[int] = None) -> List[Launch]:
     """Pack per-km candidate pools into pow2 super-batch launches.
 
     Args:
@@ -273,6 +279,10 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
         planner metrics/trace event must count only plans that actually
         dispatch, so the caller records the chosen plan via
         :func:`record_plan`.
+      part: equivalence-class partition tag stamped on every emitted
+        launch (parallel/partition.py) — partitioned mines plan each
+        partition's pools separately (their candidate sets are disjoint
+        by class), and the tag keys per-partition dispatch accounting.
 
     Returns launches in dispatch order: full same-km launches largest km
     first, then the merged tails.  Every input candidate appears in
@@ -310,10 +320,10 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
                 # padded lane-width tail is the only legal shape
                 tails.append((km, rows[i:]))
                 break
-            part = rows[i:i + take]
+            piece = rows[i:i + take]
             launches.append(Launch(
-                km, take, part, [km] * take,
-                [job_of(r) for r in part] if job_of else None))
+                km, take, piece, [km] * take,
+                [job_of(r) for r in piece] if job_of else None, part))
             i += take
 
     # cross-km tail merge, largest geometry first: bounds every lane's
@@ -334,10 +344,10 @@ def plan_launches(pools: Dict[int, Sequence[int]], cap: Callable[[int], int],
                     ckms.extend([km] * len(rows))
                     cur = (km_g, crows, ckms)
                     continue
-            launches.append(_emit(cur, lane, job_of))
+            launches.append(_emit(cur, lane, job_of, part))
         cur = (km, list(rows), [km] * len(rows))
     if cur is not None:
-        launches.append(_emit(cur, lane, job_of))
+        launches.append(_emit(cur, lane, job_of, part))
     if record:
         record_plan(launches)
     return launches
@@ -364,10 +374,11 @@ def record_plan(launches: List[Launch]) -> None:
 
 
 def _emit(cur: Tuple[int, List[int], List[int]], lane: int,
-          job_of: Optional[Callable[[int], int]] = None) -> Launch:
+          job_of: Optional[Callable[[int], int]] = None,
+          part: Optional[int] = None) -> Launch:
     km_g, rows, kms = cur
     return Launch(km_g, max(lane, next_pow2(len(rows))), rows, kms,
-                  [job_of(r) for r in rows] if job_of else None)
+                  [job_of(r) for r in rows] if job_of else None, part)
 
 
 def superbatch_geometries(lane: int, hi_width: int,

@@ -20,6 +20,7 @@ import os
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -82,8 +83,21 @@ def is_multiprocess() -> bool:
 
 
 def is_multihost(mesh) -> bool:
-    """True when ``mesh`` spans more than one controller process."""
-    return mesh is not None and jax.process_count() > 1
+    """True when ``mesh`` spans more than one controller process.
+
+    Checked against the mesh's OWN devices, not just the runtime's
+    process count: a partitioned mine (parallel/partition.py) runs
+    engines over process-LOCAL inner submeshes inside a multi-controller
+    runtime, and those must take the plain local-device paths — a local
+    mesh has no cross-process collective to feed.  The process-count
+    fast path keeps single-controller callers (every ``_put`` on the
+    engine hot paths goes through here) at one int compare instead of a
+    device scan."""
+    if mesh is None or jax.process_count() == 1:
+        return False
+    it = iter(mesh.devices.flat)
+    first = next(it).process_index
+    return any(d.process_index != first for d in it)
 
 
 def host_to_device(mesh, x) -> jax.Array:
@@ -91,11 +105,11 @@ def host_to_device(mesh, x) -> jax.Array:
     mesh fns: plain ``jnp.asarray`` single-controller, a global replicated
     array otherwise (SPMD host loops keep per-process copies identical).
     The single shared implementation behind every engine's ``_put``.
-    """
+    ``jnp`` is imported at module scope — this is the single-controller
+    HOT path (one call per staged candidate buffer), and a function-local
+    import re-enters the import lock on every call."""
     if is_multihost(mesh):
         return replicate(mesh, x)
-    import jax.numpy as jnp
-
     return jnp.asarray(x)
 
 

@@ -1,0 +1,471 @@
+"""Equivalence-class candidate partitioning over the outer mesh axis.
+
+The mesh path before this layer was PURE data parallelism: the sequence
+axis shards over devices, every shard evaluates the SAME replicated
+candidate set, a ``psum`` crosses the full mesh (and hence DCN on a
+pod) at every wave, and the host-side DFS enumeration runs duplicated
+SPMD on every process.  That is one end of the trade-off mapped by
+RDD-Eclat (arxiv 1912.06415) and the parallel-SPM survey (arxiv
+1805.10515): *shard the data, replicate the candidates*.  This module
+adds the other axis — *partition the candidates, replicate (or
+inner-shard) the data* — and composes the two into a 2-D ``hosts x
+seq`` mesh:
+
+- the mining frontier splits by EQUIVALENCE CLASS over the outer
+  ``part`` axis.  A candidate's class is decided by its km-prefix — for
+  TSR the root item ``min(X)`` (invariant under both left and right
+  expansion: X grows only by larger indices, Y never touches it), for
+  SPADE/cSPADE the pattern's first item (the DFS root; itemset
+  extensions only add larger items, so every pattern has exactly one
+  root).  Classes hash from GLOBAL item ids (:func:`class_of`), so
+  ownership is stable across iterative-deepening rounds and identical
+  on every process with zero coordination;
+- classes balance across partitions by the committed cost model's
+  per-class lane estimates (:func:`plan_partitions`): a root's subtree
+  dispatches candidate lanes roughly proportional to its item support
+  (support bounds how deep its sibling chains survive the rising
+  threshold), so per-class cost = sum of owned item supports, assigned
+  LPT (longest-processing-time first).  The achieved balance is
+  exported as ``fsm_partition_imbalance_ratio``;
+- each partition keeps today's INNER seq-axis shard + ICI ``psum``
+  (:func:`submeshes` splits a flat device mesh into per-partition rows),
+  so cross-partition traffic drops from a per-wave full-mesh ``psum``
+  to a small per-round exchange (:func:`exchange_objects`): TSR
+  partitions all-reduce a conservative top-k floor and the final exact
+  merge; SPADE partitions exchange only the final pattern slices.
+
+Partition-aware candidate generation means each process enumerates
+ONLY its owned classes — the replicated-DFS host work finally scales
+with hosts instead of being duplicated on every one of them.
+
+Everything here is host arithmetic except :func:`exchange_objects`,
+which uses a device collective only in multi-controller runs (one tiny
+all-gather per exchange round — the DCN bill is per ROUND, not per
+wave; counted in ``fsm_partition_cross_bytes_total``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from spark_fsm_tpu.utils import obs  # host-only (no jax import here)
+
+PART_AXIS = "part"
+
+# --------------------------------------------------------------- metrics
+
+_PLANS = obs.REGISTRY.counter(
+    "fsm_partition_plans_total",
+    "equivalence-class partition plans built (parallel/partition.py)")
+_EXCHANGES = obs.REGISTRY.counter(
+    "fsm_partition_exchange_rounds_total",
+    "cross-partition exchange rounds (threshold floor + result merge); "
+    "the partitioned path's ONLY cross-partition collective — scales "
+    "with rounds, never with launches")
+_CROSS_BYTES = obs.REGISTRY.counter(
+    "fsm_partition_cross_bytes_total",
+    "bytes exchanged across partitions (payload size; host-local in "
+    "single-controller runs, a DCN all-gather in multi-controller ones)")
+_IMBALANCE = obs.REGISTRY.gauge(
+    "fsm_partition_imbalance_ratio",
+    "max/mean per-partition cost of the latest plan (1.0 = perfect)")
+# known algo vocabulary zero-seeded (the obs_smoke no-orphan contract)
+_MINES = obs.REGISTRY.counter(
+    "fsm_partition_mines_total",
+    "partitioned mines run, by algorithm")
+for _algo in ("tsr", "spade", "cspade"):
+    _MINES.seed(algo=_algo)
+_IMBALANCE.set(0.0)
+
+
+# ------------------------------------------------------------ class hash
+
+# splitmix64 finalizer constants — a fixed, seedless avalanche over the
+# GLOBAL item id so every process computes the identical class map with
+# zero coordination (and the map survives restarts / deepening rounds)
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+
+
+def class_of(item_ids, n_classes: int) -> np.ndarray:
+    """Equivalence-class index (km-prefix hash) for global item ids.
+
+    Vectorized splitmix64 finalizer: classes must be uncorrelated with
+    id magnitude (real alphabets cluster hot items at low ids) yet
+    identical everywhere — a seeded or process-local hash would break
+    the zero-coordination ownership contract."""
+    x = np.asarray(item_ids, dtype=np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * _C1
+    x = (x ^ (x >> np.uint64(27))) * _C2
+    x = x ^ (x >> np.uint64(31))
+    return (x % np.uint64(int(n_classes))).astype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionPlan:
+    """A committed class->partition assignment.
+
+    ``owner[c]`` is the partition owning class ``c``; ``part_costs`` is
+    the modeled lane cost each partition carries.  The plan is a pure
+    function of (item ids, item supports, n_parts, n_classes), so every
+    process building it from the same (replicated) vertical DB owns the
+    same classes — candidate generation needs no ownership messages.
+    """
+
+    n_parts: int
+    n_classes: int
+    owner: np.ndarray  # [n_classes] int32
+    part_costs: np.ndarray  # [n_parts] float64
+
+    @property
+    def imbalance_ratio(self) -> float:
+        mean = float(self.part_costs.mean()) if self.n_parts else 0.0
+        if mean <= 0:
+            return 1.0
+        return float(self.part_costs.max()) / mean
+
+    def owner_of(self, item_ids) -> np.ndarray:
+        """Partition index owning each item's class (vectorized)."""
+        return self.owner[class_of(item_ids, self.n_classes)]
+
+    def owned_slice(self, roots: Sequence[int], item_ids,
+                    part: int) -> List[int]:
+        """Filter LOCAL root indices to those whose class ``part``
+        owns (``item_ids[r]`` maps a local index to its global id) —
+        the ONE spelling of the seed filter every engine's
+        partition-aware root seeding goes through, so ownership
+        semantics cannot drift between engines."""
+        roots = list(roots)
+        if not roots:
+            return roots
+        own = self.owner_of(
+            np.asarray(item_ids)[np.asarray(roots, np.int64)]
+        ) == int(part)
+        return [r for r, o in zip(roots, own) if o]
+
+    def fingerprint(self) -> dict:
+        """What a partitioned checkpoint binds to: a changed layout must
+        restart fresh, never resume another layout's class slices."""
+        return {"parts": int(self.n_parts), "classes": int(self.n_classes),
+                "owner_sum": int(self.owner.astype(np.int64).sum())}
+
+
+def plan_partitions(item_ids, item_supports, n_parts: int,
+                    n_classes: int = 64, *,
+                    record: bool = True) -> PartitionPlan:
+    """Balance equivalence classes over ``n_parts`` partitions.
+
+    Per-class cost is the committed cost model's lane estimate: a root
+    item's subtree dispatches candidate lanes roughly proportional to
+    its support (items are support-sorted and sibling-chain bounds are
+    ``min(psup, sup_j)``, so higher-support roots keep more of their
+    chains above the rising threshold) — the same units
+    (candidate-lanes) the ragged packer's cost model prices.  Classes
+    are assigned LPT (largest class first to the least-loaded
+    partition), the classic 4/3-approximation, which is exact enough
+    here because the class count (default 64) is much larger than the
+    partition count.
+    """
+    n_parts = int(n_parts)
+    n_classes = int(n_classes)
+    if n_parts < 1:
+        raise ValueError(f"n_parts must be >= 1, got {n_parts}")
+    if n_classes < n_parts:
+        raise ValueError(
+            f"n_classes ({n_classes}) must be >= n_parts ({n_parts})")
+    cls = class_of(item_ids, n_classes)
+    costs = np.bincount(cls, weights=np.asarray(item_supports,
+                                                np.float64),
+                        minlength=n_classes)
+    owner = np.zeros(n_classes, np.int32)
+    load = np.zeros(n_parts, np.float64)
+    # LPT: stable sort keeps the plan deterministic across numpy versions
+    for c in np.argsort(-costs, kind="stable"):
+        p = int(np.argmin(load))
+        owner[int(c)] = p
+        load[p] += costs[int(c)]
+    plan = PartitionPlan(n_parts, n_classes, owner, load)
+    if record:
+        _PLANS.inc()
+        _IMBALANCE.set(plan.imbalance_ratio)
+    return plan
+
+
+# ------------------------------------------------------------- 2-D mesh
+
+
+def submeshes(mesh, n_parts: int) -> List[Optional[object]]:
+    """Split a flat device mesh into per-partition INNER seq meshes —
+    the rows of the ``hosts x seq`` 2-D arrangement.
+
+    Single controller: the first ``n_parts * inner`` devices reshape to
+    ``(n_parts, inner)`` and each row becomes a 1-D seq mesh — a
+    one-device row still gets a one-device MESH, not ``None``: the mesh
+    is what pins each partition's dispatches to its OWN device (a None
+    row would land every partition on the default device and idle the
+    rest — trading the resident-frontier/fusion eligibility of the bare
+    single-device path for actual silicon is the point of partitioning
+    a real multi-device mesh).  ``mesh=None`` maps every partition onto
+    the one local device (there is no silicon to spread — partitioning
+    there is a routing/correctness regime, and the bare single-device
+    path keeps its resident/fusion eligibility).
+
+    Multi controller: each partition's row must be PROCESS-LOCAL (the
+    whole point — no per-wave collective may cross partitions), so
+    ``n_parts`` must equal the process count and partition ``p`` gets
+    process ``p``'s local devices.  A one-LOCAL-device process keeps
+    ``None`` (its default device IS its row).  Equal-geometry rows
+    produce equal shape keys, so the compiled ladder stays enumerable.
+    """
+    n_parts = int(n_parts)
+    if n_parts <= 1:
+        return [mesh]
+    if mesh is None:
+        return [None] * n_parts
+    from jax.sharding import Mesh
+
+    from spark_fsm_tpu.parallel.mesh import SEQ_AXIS
+
+    devs = list(mesh.devices.flat)
+    by_proc: dict = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d)
+    if len(by_proc) > 1:
+        if n_parts != len(by_proc):
+            raise ValueError(
+                f"multi-controller partitioning needs one partition per "
+                f"process (got parts={n_parts}, processes={len(by_proc)}): "
+                f"a partition row spanning processes would reintroduce "
+                f"the per-wave DCN collective this layer removes")
+        rows = [by_proc[pi] for pi in sorted(by_proc)]
+        # a process with one local device runs its slice on its default
+        # device already — keep the engines' bare single-device path
+        return [None if len(row) == 1
+                else Mesh(np.asarray(row), (SEQ_AXIS,)) for row in rows]
+    if len(devs) % n_parts:
+        raise ValueError(
+            f"mesh of {len(devs)} devices does not split into "
+            f"{n_parts} equal partition rows")
+    inner = len(devs) // n_parts
+    rows = [devs[p * inner:(p + 1) * inner] for p in range(n_parts)]
+    return [Mesh(np.asarray(row), (SEQ_AXIS,)) for row in rows]
+
+
+def owned_parts(plan: PartitionPlan) -> List[int]:
+    """The partitions THIS process enumerates.  Single controller owns
+    all of them (and runs them sequentially over its submesh rows);
+    in a multi-controller run partition ``p`` belongs to process ``p``
+    (the :func:`submeshes` row contract)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return list(range(plan.n_parts))
+    return [jax.process_index()]
+
+
+# ------------------------------------------------------------- exchange
+
+
+def exchange_objects(payload, *, stats: Optional[dict] = None,
+                     record: bool = True) -> list:
+    """One cross-partition exchange round: every process contributes
+    ``payload`` (any JSON-able object) and receives the list of all
+    processes' payloads, in process order.
+
+    Single controller: the calling orchestrator already holds every
+    partition's data, so the exchange is a host-local no-op returning
+    ``[payload]`` — but it still counts an exchange round and the
+    payload bytes, so the scaling-curve counters mean the same thing at
+    every scale (what WOULD cross the partition axis).
+
+    Multi controller: a padded ``uint8`` all-gather over the global
+    device set (jax.experimental.multihost_utils), i.e. ONE tiny DCN
+    collective per round — the whole point of the partitioned regime is
+    that this, not the per-wave support ``psum``, is the only traffic
+    that crosses hosts.
+
+    ``stats``: an engine stats dict to mirror the round/byte counters
+    into (``partition_exchanges`` / ``partition_cross_bytes``) next to
+    the process-global registry families; ``record=False`` (warm runs)
+    skips the registry families but still fills ``stats``.
+    """
+    import json
+
+    import jax
+
+    blob = json.dumps(payload).encode("utf-8")
+    if jax.process_count() == 1:
+        nbytes = len(blob)
+        merged = [payload]
+    else:
+        from jax.experimental import multihost_utils
+
+        lens = np.asarray(
+            multihost_utils.process_allgather(np.int64(len(blob))),
+            np.int64).reshape(-1)
+        width = int(lens.max())
+        buf = np.zeros(width, np.uint8)
+        buf[:len(blob)] = np.frombuffer(blob, np.uint8)
+        rows = np.asarray(multihost_utils.process_allgather(buf))
+        rows = rows.reshape(len(lens), width)
+        nbytes = int(lens.sum())
+        merged = [
+            json.loads(rows[i, :int(lens[i])].tobytes().decode("utf-8"))
+            for i in range(len(lens))]
+    if record:
+        _EXCHANGES.inc()
+        _CROSS_BYTES.inc(nbytes)
+    if stats is not None:
+        stats["partition_exchanges"] = (
+            stats.get("partition_exchanges", 0) + 1)
+        stats["partition_cross_bytes"] = (
+            stats.get("partition_cross_bytes", 0) + nbytes)
+    return merged
+
+
+class ThresholdBoard:
+    """Conservative global top-k floor, monotonically tightening.
+
+    Partitions publish the supports of their accepted rules; the floor
+    is the k-th largest support seen so far across ALL published
+    results — a LOWER bound on the global top-k threshold (the global
+    threshold is the k-th largest over a superset), so a partition that
+    starts its search with ``minsup = floor`` prunes only candidates
+    that can never enter the global top-k.  ``merge`` only ever raises
+    the floor (docs/DESIGN.md states the exactness argument)."""
+
+    def __init__(self, k: int, floor: int = 1):
+        self.k = int(k)
+        self._floor = max(1, int(floor))
+        self._sups: List[int] = []  # top-k supports seen, ascending
+
+    def floor(self) -> int:
+        return self._floor
+
+    def merge(self, supports: Sequence[int]) -> int:
+        for s in supports:
+            s = int(s)
+            if len(self._sups) < self.k:
+                bisect.insort(self._sups, s)
+            elif s > self._sups[0]:
+                self._sups.pop(0)
+                bisect.insort(self._sups, s)
+        if len(self._sups) >= self.k and self._sups[0] > self._floor:
+            self._floor = self._sups[0]
+        return self._floor
+
+
+def count_mine(algo: str) -> None:
+    _MINES.inc(algo=algo)
+
+
+def fold_numeric_stats(dst: dict, src: dict) -> None:
+    """Additively fold one engine's numeric counters into an
+    orchestrator stats dict — the ONE spelling of the partitioned
+    stats merge (strings/bools/containers skipped), so the bench and
+    smoke exports cannot drift between the TSR and SPADE routes."""
+    for key, v in src.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        dst[key] = dst.get(key, 0) + v
+
+
+def encode_patterns(results) -> list:
+    """(pattern, support) results -> JSON rows for the exchange; the
+    inverse of :func:`decode_patterns`."""
+    return [[[list(its) for its in pat], int(sup)]
+            for pat, sup in results]
+
+
+def decode_patterns(rows) -> list:
+    return [(tuple(tuple(int(i) for i in its) for its in pat), int(sup))
+            for pat, sup in rows]
+
+
+def composite_state(fingerprint: dict, done: dict, active_part,
+                    active_state, **extra) -> dict:
+    """The ONE spelling of the partitioned composite checkpoint:
+    merged rows at top level in rewrite mode (StoreCheckpoint's
+    ``results_done=0`` contract) plus each partition's frontier
+    UNCHANGED in the engines' own ``frontier_state`` format.  Both
+    orchestrators (TSR rounds, SPADE/cSPADE slices) build and decode
+    through here so the crash-recovery schema has a single owner."""
+    return {
+        "version": 1,
+        "fingerprint": fingerprint,
+        "stack": [],
+        "results": [r for p in sorted(done) for r in done[p]],
+        "results_done": 0,
+        "partition": {
+            "done": {str(p): done[p] for p in sorted(done)},
+            "active_part": active_part,
+            "active_state": active_state,
+        },
+        **extra,
+    }
+
+
+def decode_composite(resume: Optional[dict], fingerprint: dict):
+    """(done, active_resume) from a composite snapshot; empty when the
+    snapshot is missing or bound to another layout."""
+    done: dict = {}
+    active_resume: dict = {}
+    if resume is not None and resume.get("fingerprint") == fingerprint:
+        pr = resume.get("partition", {})
+        for p_s, rows_p in pr.get("done", {}).items():
+            done[int(p_s)] = [list(r) for r in rows_p]
+        ap = pr.get("active_part")
+        if ap is not None and pr.get("active_state") is not None:
+            active_resume[int(ap)] = pr["active_state"]
+    return done, active_resume
+
+
+def mine_partitioned_slices(*, plan: PartitionPlan, meshes: list,
+                            fingerprint: dict, mine_part,
+                            resume: Optional[dict] = None,
+                            checkpoint_cb=None,
+                            stats: Optional[dict] = None) -> list:
+    """Run fully-independent class slices (the SPADE/cSPADE regime:
+    fixed minsup, no dynamic threshold — partitions share only the F1
+    seed already present in the replicated vertical DB) and exchange
+    the result slices once at the end.
+
+    ``mine_part(p, inner_mesh, resume_state, part_cb)`` mines partition
+    ``p``'s slice and returns its results as JSON-able rows; it
+    receives the part's resumed ``frontier_state`` (or None) and a
+    callback to forward the engine's own frontier snapshots through.
+    Checkpoints are composite: merged rows at top level (rewrite mode)
+    plus the active part's frontier UNCHANGED in the engine's own
+    ``frontier_state`` format, fingerprint-bound to the partition
+    layout.  Returns the union of every partition's rows (across
+    processes too — one exchange round)."""
+    done, active_resume = decode_composite(resume, fingerprint)
+
+    def composite(active_part, active_state):
+        return composite_state(fingerprint, done, active_part,
+                               active_state)
+
+    for p in owned_parts(plan):
+        if p in done:
+            continue
+        part_cb = None
+        if checkpoint_cb is not None:
+            def part_cb(fs, p=p):
+                checkpoint_cb(composite(p, fs))
+        done[p] = list(mine_part(p, meshes[p], active_resume.get(p),
+                                 part_cb))
+        if checkpoint_cb is not None:
+            checkpoint_cb(composite(None, None))
+    # contribute ONLY owned parts to the exchange: a resumed composite
+    # from a shared checkpoint can carry other processes' completed
+    # slices, and re-contributing them would duplicate rows in the
+    # merged union (every live process contributes its own)
+    own = set(owned_parts(plan))
+    gathered = exchange_objects(
+        {"rows": [r for p in sorted(done) if p in own
+                  for r in done[p]]}, stats=stats)
+    return [r for g in gathered for r in g["rows"]]

@@ -61,6 +61,77 @@ def _constraints(req: ServiceRequest) -> Tuple[Optional[int], Optional[int]]:
             int(mw) if mw is not None else None)
 
 
+def resolved_partition_parts() -> int:
+    """The partition count the boot config implies — ONE resolver
+    shared by request routing and the prewarm envelope so the warmed
+    and served 2-D layouts cannot drift.  0 = partitioning off.
+
+    ``[partition] parts = 0`` auto-resolves: one partition per process
+    in a multi-controller run (the hosts x seq contract), else 2 when
+    the boot mesh splits evenly into two rows, else off (a single local
+    device has no outer axis to scale over, an odd mesh no even split).
+    An explicit parts that cannot split the topology degrades LOUDLY to
+    unpartitioned (``partition_config_invalid`` log) instead of failing
+    every train request at ``submeshes``."""
+    pc = config.get_config().partition
+    if not pc.enabled:
+        return 0
+    import jax
+
+    n_procs = jax.process_count()
+    mesh = config.get_mesh()
+    if pc.parts:
+        # an explicit parts that cannot split the boot topology must
+        # not 500 every train request (or abort boot inside prewarm's
+        # enumerate): degrade to unpartitioned, loudly — the log line +
+        # fsm_partition_plans_total flatlining at 0 are the operator
+        # signals (OPERATIONS.md)
+        parts = int(pc.parts)
+        bad = None
+        if n_procs > 1 and parts != n_procs:
+            bad = (f"parts={parts} != process_count={n_procs} "
+                   "(multi-controller needs one partition per process)")
+        elif n_procs == 1 and mesh is not None and parts > 1 \
+                and mesh.devices.size % parts:
+            bad = (f"parts={parts} does not divide the "
+                   f"{mesh.devices.size}-device mesh")
+        if bad:
+            from spark_fsm_tpu.utils.obs import log_event
+
+            log_event("partition_config_invalid", reason=bad)
+            return 0
+        return parts if _classes_cover(parts, pc.classes) else 0
+    if n_procs > 1:
+        return n_procs if _classes_cover(n_procs, pc.classes) else 0
+    if mesh is not None and mesh.devices.size >= 2 \
+            and mesh.devices.size % 2 == 0:
+        return 2 if _classes_cover(2, pc.classes) else 0
+    return 0
+
+
+def _classes_cover(parts: int, classes: int) -> bool:
+    """classes >= parts or the LPT plan cannot give every partition a
+    class; config validation only covers EXPLICIT parts, so the
+    auto-resolved count (the process count on a big pod) must re-check
+    here — and degrade loudly rather than let plan_partitions raise on
+    every train request."""
+    if classes >= parts:
+        return True
+    from spark_fsm_tpu.utils.obs import log_event
+
+    log_event("partition_config_invalid",
+              reason=f"classes={classes} < resolved parts={parts}")
+    return False
+
+
+def _partition_kwargs() -> dict:
+    parts = resolved_partition_parts()
+    if parts < 2:
+        return {}
+    return {"partition_parts": parts,
+            "partition_classes": config.get_config().partition.classes}
+
+
 def _checkpoint_unsupported(checkpoint, name: str,
                             stats: Optional[dict]) -> None:
     """A requested checkpoint the selected engine cannot honor must be
@@ -112,6 +183,15 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
         # fused routing is a plain-SPADE knob (the constrained engine has
         # no fused counterpart), so it must not reach mine_cspade_tpu
         fused_kw = config.engine_kwargs("fused")
+        part_kw = _partition_kwargs()
+        if part_kw and req.task != "stream":
+            # partitioned mines bypass the engine cache: the route
+            # builds one engine per partition row, which the single-
+            # engine cache cannot hold (streaming pushes keep the plain
+            # route — their windows re-mine batch-sized slices)
+            return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
+                                  checkpoint=checkpoint, **part_kw,
+                                  **fused_kw, **kwargs)
         if req.task != "stream":
             # repeat mines over identical data reuse the HBM store +
             # compiled engine (service/devcache.py) — checkpointed jobs
@@ -128,6 +208,12 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
         return mine_spade_tpu(db, minsup, mesh=mesh, stats_out=stats,
                               checkpoint=checkpoint,
                               **fused_kw, **kwargs)
+    part_kw = _partition_kwargs()
+    if part_kw and req.task != "stream":
+        return mine_cspade_tpu(db, minsup, maxgap=maxgap,
+                               maxwindow=maxwindow, mesh=mesh,
+                               stats_out=stats, checkpoint=checkpoint,
+                               **part_kw, **kwargs)
     if checkpoint is None and req.task != "stream":
         # repeat cSPADE mines reuse the constrained engine (item store +
         # max-start pool); the cache key folds maxgap/maxwindow — they
@@ -193,6 +279,14 @@ def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
                               else "never")
     if req.task == "stream":  # see _spade_tpu: bucket drifting windows
         kwargs["shape_buckets"] = True
+    part_kw = _partition_kwargs()
+    if part_kw and req.task != "stream":
+        # the partitioned orchestrator builds one engine per submesh
+        # row — bypass the single-engine devcache (same reasoning as
+        # the SPADE route above)
+        return mine_tsr_tpu(db, k, minconf, max_side=max_side,
+                            mesh=config.get_mesh(), stats_out=stats,
+                            checkpoint=checkpoint, **part_kw, **kwargs)
     if checkpoint is None and req.task != "stream":
         # repeat TSR mines over identical data reuse the built engine
         # (vertical build + token indexing are the fixed ~7s cost of the

@@ -228,9 +228,41 @@ def _warm_cspade(t: dict, mesh, ekw: dict) -> None:
                          False, t["n_items"], t["max_tokens"], eng._put)
 
 
+def _walk_eval_ladder(eng, superbatch):
+    """Dispatch one launch per (km, width) eval geometry on ``eng`` —
+    the ONE warm walk behind the solo AND partitioned TSR ladders (the
+    chunk/_round_m/prep setup and the kernel-vs-jnp dispatch must not
+    drift between them).  All-(-1) candidate slots resolve to the pad
+    rows, so each dispatch is milliseconds of device work on top of the
+    compile it triggers.  The jnp program compiles even on
+    kernel-capable backends: it is the kernel-failure fallback, plus
+    the sub-C_LANES widths only the jnp planner emits — cheap
+    insurance, and it keeps every enumerated tsr-eval key recorded on
+    every backend.  Returns the engine-layout preps for callers that
+    warm further programs at the same geometry."""
+    from spark_fsm_tpu.ops import pallas_tsr as PT
+    from spark_fsm_tpu.ops import ragged_batch as RB
+
+    m = min(eng.item_cap, eng.vdb.n_items)
+    eng.chunk = eng._round_chunk(m)
+    eng._round_m = m
+    eng._jnp_prep = None
+    p1, s1 = eng._prep(m)
+    pj, sj = (eng._prep_engine(m) if eng.use_pallas else (p1, s1))
+    for km, width in superbatch:
+        launch = RB.Launch(km, width, [], [])
+        if eng.use_pallas and width >= PT.C_LANES:  # kernel out-tile floor
+            eng._dispatch_kernel_launch(
+                p1, s1, [], launch, [], np.empty(0, np.int64), 0)
+        else:
+            xy = eng._stager.take(launch, [])
+            eng._eval_fn(km)(pj, sj, eng._put(xy))
+            eng._count_launch(launch)
+    return pj, sj
+
+
 def _warm_tsr(t: dict, mesh) -> None:
     from spark_fsm_tpu.models.tsr import TsrTPU
-    from spark_fsm_tpu.ops import pallas_tsr as PT
     from spark_fsm_tpu.ops import ragged_batch as RB
 
     vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
@@ -241,28 +273,7 @@ def _warm_tsr(t: dict, mesh) -> None:
     # launch program the ragged packer can emit, at the first deepening
     # round's top-m store — the service envelope's dominant geometry
     # (later rounds' m varies by design and recompiles per round).
-    # All-(-1) candidate slots resolve to the pad rows, so the dispatch
-    # is milliseconds of device work on top of the compile it triggers.
-    m = min(eng.item_cap, vdb.n_items)
-    eng.chunk = eng._round_chunk(m)
-    eng._round_m = m
-    eng._jnp_prep = None
-    p1, s1 = eng._prep(m)
-    pj, sj = (eng._prep_engine(m) if eng.use_pallas else (p1, s1))
-    for km, width in t.get("superbatch", ()):
-        launch = RB.Launch(km, width, [], [])
-        if eng.use_pallas and width >= PT.C_LANES:  # kernel out-tile floor
-            eng._dispatch_kernel_launch(
-                p1, s1, [], launch, [], np.empty(0, np.int64), 0)
-        else:
-            # the jnp program at this geometry: on the CPU backend this
-            # IS the live path; on TPU it is the kernel-failure fallback
-            # plus the sub-C_LANES widths only the jnp planner emits —
-            # cheap insurance either way, and it keeps every enumerated
-            # tsr-eval key recorded on every backend
-            xy = eng._stager.take(launch, [])
-            eng._eval_fn(km)(pj, sj, eng._put(xy))
-            eng._count_launch(launch)
+    pj, sj = _walk_eval_ladder(eng, t.get("superbatch", ()))
     # Cross-job fused eval ladder (service/fusion.py): the broker's
     # fused launches run the SAME jnp eval programs at a concatenated
     # pow2-padded item axis, so the compiled set is the enumerated
@@ -284,6 +295,27 @@ def _warm_tsr(t: dict, mesh) -> None:
             eng._eval_fn(km)(pf, sf, eng._put(xy))
             shapes.record(shapes.key_tsr_fused(
                 eng.n_seq, eng.n_words, m_pad, km, width))
+
+
+def _warm_tsr_part(t: dict, mesh) -> None:
+    """Compile the equivalence-class partitioned TSR ladder
+    (parallel/partition.py + models/tsr.TsrPartitioned): a tiny
+    partitioned mine covers the orchestrator's own programs, then EVERY
+    part engine walks the (km, width) eval ladder at the inner submesh
+    geometry.  Every row is walked, not just the first — compiled
+    executables bind their device assignment, so row 0's compile does
+    not warm row 1's devices even though the shape keys are equal."""
+    from spark_fsm_tpu.models.tsr import TsrPartitioned
+
+    vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
+    # record_metrics=False: a boot warm must not make fsm_partition_*
+    # report mines that never happened or clobber the imbalance gauge
+    orch = TsrPartitioned(vdb, min(8, t["n_items"]), 0.5, mesh=mesh,
+                          parts=t["parts"], max_side=2,
+                          record_metrics=False)
+    orch.mine()
+    for eng in orch.engines.values():
+        _walk_eval_ladder(eng, t.get("superbatch", ()))
 
 
 def _warm_resident(t: dict, mesh) -> None:
@@ -497,10 +529,13 @@ def _run_keys(targets, mesh, eng_sub) -> List[dict]:
                     _warm_cspade(t, mesh, eng_sub)
                 elif t["kind"] == "tsr":
                     _warm_tsr(t, mesh)
-                elif t["kind"] in ("tsr_eval", "tsr_fused"):
-                    pass  # warmed by the "tsr" entry's ladder walk; the
-                    # separate key exists so /admin/shapes drift can name
-                    # the exact launch geometry a live mine would compile
+                elif t["kind"] in ("tsr_eval", "tsr_fused", "tsr_inner"):
+                    pass  # warmed by the "tsr"/"tsr_part" entries'
+                    # ladder walks; the separate key exists so
+                    # /admin/shapes drift can name the exact launch
+                    # geometry a live mine would compile
+                elif t["kind"] == "tsr_part":
+                    _warm_tsr_part(t, mesh)
                 elif t["kind"] == "tsr_resident":
                     _warm_resident(t, mesh)
                 elif t["kind"] == "sweep":
@@ -539,11 +574,24 @@ def spec_from_config(pc) -> Optional[shapes.WorkloadSpec]:
         n_words=max(1, int(pc.words)), constraints=constraints,
         tsr=bool(pc.tsr),
         fusion_jobs=_fusion_jobs_default(),
+        partition_parts=_partition_parts_default(),
         stream_batch_sequences=int(pc.stream_batch_sequences),
         stream_items=int(pc.stream_items),
         stream_seq_floor=int(pc.stream_seq_floor),
         checkpointed=bool(pc.checkpointed),
         max_tokens=int(pc.max_tokens))
+
+
+def _partition_parts_default() -> int:
+    """The partitioned-ladder envelope the boot config implies: with
+    equivalence-class partitioning enabled, prewarm must cover the 2-D
+    parts x seq ladder or the first partitioned mine pays a live
+    compile per submesh row (service/plugins.py resolves the same
+    number at request time — ONE resolver so the warmed and served
+    layouts cannot drift)."""
+    from spark_fsm_tpu.service.plugins import resolved_partition_parts
+
+    return resolved_partition_parts()
 
 
 def _fusion_jobs_default() -> int:
@@ -581,6 +629,8 @@ def spec_from_params(params: Dict[str, str], pc) -> shapes.WorkloadSpec:
         constraints=constraints,
         tsr=truthy(params.get("tsr"), pc.tsr),
         fusion_jobs=geti("fusion_jobs", _fusion_jobs_default()),
+        partition_parts=geti("partition_parts",
+                             _partition_parts_default()),
         stream_batch_sequences=geti("stream_batch_sequences",
                                     pc.stream_batch_sequences),
         stream_items=geti("stream_items", pc.stream_items),

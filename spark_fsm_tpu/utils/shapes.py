@@ -62,6 +62,16 @@ Key formats (the geometry axes that decide compiled shapes):
   ``sweep:s{S}w{W}r{R}i{NI}``               streaming/incremental.py
                                             batch-store geometry (the
                                             config-5 mid-stream compile)
+  ``tsr-part:p{P}s{S}w{W}``                 models/tsr.py TsrPartitioned
+                                            (parallel/partition.py): the
+                                            2-D parts x seq arrangement —
+                                            S is the INNER (per-row)
+                                            padded seq axis; the per-part
+                                            engines additionally record
+                                            the inner ``tsr:*`` /
+                                            ``tsr-eval:*`` keys, which
+                                            the enumerator lists at the
+                                            inner geometry
 """
 
 from __future__ import annotations
@@ -136,6 +146,19 @@ def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
     return f"sweep:s{n_seq}w{n_words}r{n_rows}i{ni_rows}"
 
 
+def key_tsr_part(n_parts: int, n_seq_inner: int, n_words: int) -> str:
+    """The partitioned-TSR umbrella key (models/tsr.py TsrPartitioned):
+    the 2-D ``parts x seq`` arrangement over the inner per-row padded
+    sequence axis.  The per-part engines record the inner ``tsr:*`` and
+    per-launch ``tsr-eval:*`` keys themselves; this key identifies the
+    orchestration geometry so /admin/shapes can see that a partitioned
+    ladder was (or was not) enumerated and warmed."""
+    return f"tsr-part:p{n_parts}s{n_seq_inner}w{n_words}"
+
+
+_PARTITION_SKIP = object()  # sentinel: invalid partition override
+
+
 # ---------------------------------------------------------------- registry
 
 _lock = threading.Lock()
@@ -197,6 +220,11 @@ class WorkloadSpec:
     many concurrent TSR jobs (their first-round prep stores concatenate
     along the item axis, pow2-padded; 0 = fusion not served).  The boot
     spec sets it from ``[fusion] max_jobs`` when fusion is enabled.
+    ``partition_parts``: equivalence-class partitioned mining envelope
+    (parallel/partition.py; >= 2 = enumerate the ``tsr-part`` 2-D
+    arrangement plus the per-part INNER ``tsr``/``tsr-eval`` ladder at
+    the submesh-row geometry).  The boot spec sets it from
+    ``[partition] parts`` when partitioning is enabled.
     """
 
     n_sequences: int
@@ -205,6 +233,7 @@ class WorkloadSpec:
     constraints: Tuple[Tuple[Optional[int], Optional[int]], ...] = ()
     tsr: bool = False
     fusion_jobs: int = 0
+    partition_parts: int = 0
     stream_batch_sequences: int = 0
     stream_items: int = 0
     stream_seq_floor: int = 0  # must mirror [prewarm] stream_seq_floor:
@@ -334,6 +363,50 @@ def enumerate_shapes(spec: WorkloadSpec, *, mesh=None,
                     if m_res >= ni:
                         break
                     m_res = min(m_res * 2, ni)
+            if spec.partition_parts >= 2:
+                # equivalence-class partitioned ladder (parallel/
+                # partition.py + models/tsr.TsrPartitioned): the 2-D
+                # parts x seq arrangement re-derives the TSR geometry at
+                # the INNER submesh-row axis — per-part engines compile
+                # the same programs a solo engine over one row would, so
+                # the enumeration is the inner ladder plus the umbrella
+                # key the orchestrator records.  Enumerating through
+                # partition.submeshes (not arithmetic on device counts)
+                # keeps enumeration and construction on one code path.
+                from spark_fsm_tpu.parallel import partition as PN
+
+                try:
+                    inner = PN.submeshes(mesh, spec.partition_parts)[0]
+                except ValueError as exc:
+                    # an /admin/prewarm override that cannot split this
+                    # topology must not fail the whole prewarm request
+                    # — same degrade-loudly posture as the request
+                    # router (plugins.resolved_partition_parts)
+                    from spark_fsm_tpu.utils.obs import log_event
+
+                    log_event("partition_config_invalid",
+                              reason=str(exc), at="enumerate_shapes")
+                    inner = _PARTITION_SKIP
+                if inner is not _PARTITION_SKIP:
+                    tgp = tsr.tsr_geometry(ns, nw, mesh=inner,
+                                           use_pallas=use_pallas)
+                    hi_p = tsr_chunk or RB.dispatch_quantum_lanes(
+                        tgp["n_seq"], nw)
+                    ladder_p = RB.superbatch_geometries(32, hi_p)
+                    add(key_tsr_part(spec.partition_parts, tgp["n_seq"],
+                                     nw),
+                        kind="tsr_part", n_sequences=ns, n_items=ni,
+                        n_words=nw, parts=int(spec.partition_parts),
+                        superbatch=ladder_p)
+                    # the inner per-part geometry: dedup'd against the
+                    # solo entries when the inner row equals the outer
+                    # mesh; the tsr_part walk warms them (every ROW,
+                    # not just row 0 — compiled executables bind
+                    # device assignments)
+                    add(tgp["shape_key"], kind="tsr_inner")
+                    for km, width in ladder_p:
+                        add(key_tsr_eval(tgp["n_seq"], nw, km, width),
+                            kind="tsr_eval", km=km, width=width)
             if spec.fusion_jobs >= 2 and not use_pallas and mesh is None:
                 # cross-job fused ladder (service/fusion.py): groups of
                 # 2..fusion_jobs first-round prep stores concatenated

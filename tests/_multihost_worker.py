@@ -102,11 +102,28 @@ def main() -> None:
         wwant = mine_spade(wm.window.sequences(), wm.minsup_abs())
         ok_s &= patterns_text(wm.patterns) == patterns_text(wwant)
 
+    # PARTITIONED route across the REAL process boundary: the 2-D
+    # hosts x seq regime — each process enumerates ONLY its own
+    # equivalence classes over its process-LOCAL 4-device inner row
+    # (no per-wave collective crosses DCN), and the per-round exchange
+    # (one tiny all-gather) restores the byte-identical global top-k.
+    # This is the partition layer's actual deployment shape; the
+    # single-process 8-device tier-1 coverage (tests/test_partition.py)
+    # proves routing/balance/threshold logic, THIS proves the DCN seam.
+    pstats = {}
+    pgot = mine_tsr_tpu(db, 15, 0.5, max_side=2, mesh=mesh,
+                        partition_parts=2, stats_out=pstats)
+    ok_p = rules_text(pgot) == rwant
+    ok_p = ok_p and pstats.get("partition_exchanges", 0) >= 1
+    # each process mined exactly its one owned partition
+    ok_p = ok_p and pstats.get("partition_owned") == [pid]
+
     print(f"MULTIHOST_OK pid={pid} patterns={len(got)} parity={ok} "
           f"pallas_parity={ok_k} cspade_parity={ok_c} tsr_parity={ok_r} "
-          f"fused_parity={ok_f} stream_parity={ok_s}",
+          f"fused_parity={ok_f} stream_parity={ok_s} "
+          f"partition_parity={ok_p}",
           flush=True)
-    assert ok and ok_k and ok_c and ok_r and ok_f and ok_s
+    assert ok and ok_k and ok_c and ok_r and ok_f and ok_s and ok_p
     shutdown_distributed()
 
 

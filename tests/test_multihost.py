@@ -62,3 +62,8 @@ def test_two_process_mesh_parity():
         assert "cspade_parity=True" in out and "tsr_parity=True" in out, out
         assert "fused_parity=True" in out, out
         assert "stream_parity=True" in out, out
+        # equivalence-class partitioned route across the real process
+        # boundary (parallel/partition.py): each worker enumerates only
+        # its own classes over its local inner row, one exchange per
+        # round merges the byte-identical top-k
+        assert "partition_parity=True" in out, out

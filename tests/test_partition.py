@@ -1,0 +1,460 @@
+"""Equivalence-class partitioned mining (parallel/partition.py) — the
+2-D ``hosts x seq`` mesh route, exercised ON the forced-host 8-device
+CPU mesh in ONE process (the conftest pins
+``--xla_force_host_platform_device_count=8``).
+
+The contracts under test, none of which may hide behind the
+multiprocess-collectives skip (tests/test_multihost.py covers the real
+DCN boundary as a ride-along):
+
+- partition ROUTING: class hash stable and process-independent, LPT
+  balance bounded, submesh rows disjoint;
+- partition-aware candidate generation: every class enumerated by
+  exactly one partition, zero-root partitions degrade to empty slices;
+- THRESHOLD EXCHANGE: the conservative floor only tightens, stays a
+  lower bound on the global s_k, and the cross-partition collective
+  count scales with ROUNDS, never with launches (the per-wave
+  full-mesh psum is gone from the partitioned path by construction —
+  every engine's mesh is its own inner row);
+- PARITY: byte-identical rules/patterns to the single-device route for
+  the config-3/3d-shaped miniatures and the SPADE/cSPADE engines;
+- CHECKPOINTS: composite snapshots carry per-partition frontiers in
+  the engines' existing ``frontier_state`` format, resume through the
+  real StoreCheckpoint, and a changed layout restarts fresh.
+"""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.synth import kosarak_like, synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models.oracle import mine_cspade, mine_spade
+from spark_fsm_tpu.parallel import partition as PN
+from spark_fsm_tpu.parallel.mesh import make_mesh
+from spark_fsm_tpu.utils.canonical import patterns_text, rules_text
+
+
+def _db(seed=33, n=300, items=40):
+    return synthetic_db(seed=seed, n_sequences=n, n_items=items,
+                        mean_itemsets=5.0, mean_itemset_size=1.4)
+
+
+# ------------------------------------------------------------ plan layer
+
+
+def test_class_hash_stable_and_complete():
+    ids = np.arange(1, 2000, 7)
+    a = PN.class_of(ids, 64)
+    b = PN.class_of(ids, 64)
+    assert (a == b).all()  # deterministic, seedless
+    assert a.min() >= 0 and a.max() < 64
+    # avalanche: consecutive ids must not cluster in one class
+    assert len(np.unique(PN.class_of(np.arange(64), 64))) > 16
+
+
+def test_plan_partitions_balance_and_ownership():
+    rng = np.random.default_rng(7)
+    ids = rng.choice(100000, size=500, replace=False)
+    sups = rng.integers(1, 1000, size=500)
+    plan = PN.plan_partitions(ids, sups, 4, 64)
+    # every class owned exactly once, every partition index valid
+    assert plan.owner.shape == (64,)
+    assert set(np.unique(plan.owner)) <= set(range(4))
+    # each item maps to exactly one partition; the map is a pure
+    # function of the global id (process-independent ownership)
+    own = plan.owner_of(ids)
+    assert ((0 <= own) & (own < 4)).all()
+    # LPT over 64 classes / 4 parts: imbalance well under the trivial
+    # bound (a degenerate assignment would be ~4.0)
+    assert 1.0 <= plan.imbalance_ratio < 1.5, plan.part_costs
+    with pytest.raises(ValueError):
+        PN.plan_partitions(ids, sups, 8, 4)  # classes < parts
+
+
+def test_submeshes_rows_disjoint_2d():
+    mesh = make_mesh(8)
+    rows = PN.submeshes(mesh, 2)
+    assert len(rows) == 2
+    d0 = {d.id for d in rows[0].devices.flat}
+    d1 = {d.id for d in rows[1].devices.flat}
+    assert len(d0) == len(d1) == 4 and not (d0 & d1)
+    # one-device rows of a REAL mesh stay one-device MESHES — the mesh
+    # is what pins each partition's work to its own device (a None row
+    # would land every partition on the default device)
+    rows8 = PN.submeshes(mesh, 8)
+    assert all(r is not None and r.devices.size == 1 for r in rows8)
+    assert len({r.devices.flat[0].id for r in rows8}) == 8
+    # no mesh = one local device: nothing to spread, bare path kept
+    assert PN.submeshes(None, 4) == [None] * 4
+    assert PN.submeshes(mesh, 1) == [mesh]
+    with pytest.raises(ValueError):
+        PN.submeshes(make_mesh(6), 4)  # 6 devices / 4 rows
+
+
+def test_threshold_board_monotone_and_conservative():
+    board = PN.ThresholdBoard(3, floor=1)
+    assert board.floor() == 1
+    board.merge([5, 9, 2])
+    assert board.floor() == 2  # 3rd largest of {5,9,2}
+    board.merge([7])
+    assert board.floor() == 5  # {9,7,5}
+    prev = board.floor()
+    board.merge([1, 1, 1])  # below-floor merges never loosen it
+    assert board.floor() == prev
+    # conservative: always <= the true k-th largest over everything seen
+    assert board.floor() <= sorted([5, 9, 2, 7, 1, 1, 1])[-3]
+
+
+# -------------------------------------------------- TSR partitioned route
+
+
+def test_tsr_partitioned_parity_and_collectives_config3():
+    """Acceptance pin: on the 8-virtual-device CPU mesh the partitioned
+    route (2 partitions x 4-device inner seq rows) produces
+    byte-identical rules to the single-device route for the config-3
+    miniature, and cross-partition collectives scale with ROUNDS, not
+    launches."""
+    db = kosarak_like(scale=0.002, fast=True)
+    want = rules_text(_mine_tsr(db, max_side=2))
+    stats: dict = {}
+    got = _mine_tsr(db, max_side=2, mesh=make_mesh(8), partition_parts=2,
+                    stats_out=stats)
+    assert rules_text(got) == want
+    # launch-budget-style pin: the ONLY cross-partition collective is
+    # the per-round exchange — one per deepening round — while the
+    # dispatch count is an order of magnitude beyond it (the per-wave
+    # full-mesh psum would have been one PER LAUNCH)
+    assert stats["partition_exchanges"] == stats["deepening_rounds"] == 1
+    assert stats["kernel_launches"] > 4 * stats["partition_exchanges"]
+    assert stats["partition_cross_bytes"] > 0
+    assert stats["partition_parts"] == 2
+    assert 1.0 <= stats["partition_imbalance"] < 2.0
+
+
+def test_tsr_partitioned_parity_config3d():
+    """Same acceptance pin for the 3d shape (unlimited rule sides, the
+    service default)."""
+    db = kosarak_like(scale=0.002, fast=True)
+    want = rules_text(_mine_tsr(db, max_side=None))
+    stats: dict = {}
+    got = _mine_tsr(db, max_side=None, mesh=make_mesh(8),
+                    partition_parts=2, stats_out=stats)
+    assert rules_text(got) == want
+    assert stats["partition_exchanges"] == stats["deepening_rounds"]
+
+
+def test_tsr_partitioned_no_cross_partition_mesh():
+    """Structural guarantee behind the collectives pin: every partition
+    engine's mesh is its OWN inner row (or None) — no shard_map/psum in
+    the partitioned path can span partitions, so per-wave traffic
+    cannot cross the outer axis even by accident."""
+    from spark_fsm_tpu.models.tsr import TsrPartitioned
+
+    db = _db()
+    vdb = build_vertical(db, min_item_support=1)
+    mesh = make_mesh(8)
+    orch = TsrPartitioned(vdb, 10, 0.4, mesh=mesh, parts=2, max_side=2)
+    rows = PN.submeshes(mesh, 2)
+    for p, eng in orch.engines.items():
+        assert eng.mesh is not None
+        got_ids = {d.id for d in eng.mesh.devices.flat}
+        want_ids = {d.id for d in rows[p].devices.flat}
+        assert got_ids == want_ids and len(got_ids) == 4
+
+
+def test_tsr_partitioned_deepening_floor_exact():
+    """Multi-round mine (item_cap far below the alphabet): the floor
+    carries across rounds, exchanges stay one per round, and the merged
+    output is byte-identical — the conservative-floor exactness
+    argument exercised end to end."""
+    db = _db()
+    want = rules_text(_mine_tsr(db, k=10, minconf=0.4, max_side=2,
+                                item_cap=8))
+    stats: dict = {}
+    got = _mine_tsr(db, k=10, minconf=0.4, max_side=2, item_cap=8,
+                    partition_parts=2, stats_out=stats)
+    assert rules_text(got) == want
+    assert stats["deepening_rounds"] >= 2
+    assert stats["partition_exchanges"] == stats["deepening_rounds"]
+
+
+def test_tsr_partitioned_resident_eligible_rows():
+    """parts == devices (inner row = one device -> mesh None): the
+    per-part engines keep the single-device path's eligibility —
+    unlimited-side parts may route RESIDENT — with exact parity."""
+    db = _db(seed=34)
+    want = rules_text(_mine_tsr(db, k=12, minconf=0.4, max_side=None))
+    stats: dict = {}
+    got = _mine_tsr(db, k=12, minconf=0.4, max_side=None,
+                    partition_parts=4, stats_out=stats)
+    assert rules_text(got) == want
+    assert stats["partition_parts"] == 4
+
+
+def test_tsr_partitioned_one_device_rows_pin_devices():
+    """parts == devices over a REAL mesh: every partition runs on its
+    OWN one-device mesh row (distinct devices — the fix for all
+    partitions landing on the default device), with exact parity."""
+    from spark_fsm_tpu.models.tsr import TsrPartitioned
+
+    db = _db(seed=21, n=203, items=12)
+    vdb = build_vertical(db, min_item_support=1)
+    mesh = make_mesh(4)
+    orch = TsrPartitioned(vdb, 15, 0.5, mesh=mesh, parts=4, max_side=2)
+    dev_ids = set()
+    for eng in orch.engines.values():
+        assert eng.mesh is not None and eng.mesh.devices.size == 1
+        dev_ids.add(eng.mesh.devices.flat[0].id)
+    assert len(dev_ids) == 4
+    got = orch.mine()
+    want = _mine_tsr(db, k=15, minconf=0.5, max_side=2)
+    assert rules_text(got) == rules_text(want)
+
+
+def test_tsr_partition_owns_all_classes_once():
+    """Candidate-generation completeness: over all partitions, every
+    root is seeded exactly once (the union/disjointness the parity
+    tests rely on, asserted directly)."""
+    from spark_fsm_tpu.models.tsr import TsrTPU
+
+    db = _db()
+    vdb = build_vertical(db, min_item_support=1)
+    plan = PN.plan_partitions(vdb.item_ids, vdb.item_supports, 3, 64)
+    m = vdb.n_items
+    masks = [TsrTPU(vdb, 5, 0.5, partition=(plan, p))._owned_mask(m)
+             for p in range(3)]
+    total = np.zeros(m, int)
+    for mk in masks:
+        total += mk.astype(int)
+    assert (total == 1).all()
+
+
+def test_tsr_partitioned_checkpoint_resume_and_layout_binding():
+    """Composite checkpoints through the REAL StoreCheckpoint: resume
+    from an early snapshot is byte-identical, per-part frontiers ride
+    the engines' existing frontier_state format, and a changed
+    partition layout restarts fresh instead of resuming another
+    layout's slices."""
+    from spark_fsm_tpu.service.actors import StoreCheckpoint
+    from spark_fsm_tpu.service.store import ResultStore
+
+    db = _db()
+    want = rules_text(_mine_tsr(db, k=10, minconf=0.4, max_side=2,
+                                item_cap=8))
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "part-ckpt", every_s=0.0)
+    full = _mine_tsr(db, k=10, minconf=0.4, max_side=2, item_cap=8,
+                     partition_parts=2, checkpoint=ckpt)
+    assert rules_text(full) == want
+    saved = ckpt.load()
+    assert saved is not None
+    part = saved["partition"]
+    assert set(part) == {"done", "active_part", "active_state"}
+    for rows in part["done"].values():
+        for x, y, sup, supx in rows:
+            assert sup >= 1 and supx >= sup
+    # truncate to an EARLY composite: keep only part 0's slice and
+    # verify the resumed mine still matches byte-for-byte
+    early = dict(saved)
+    early["partition"] = {
+        "done": {k: v for k, v in part["done"].items() if k == "0"},
+        "active_part": None, "active_state": None}
+    early["results"] = [r for r in part["done"].get("0", [])]
+    ckpt.save(dict(early, results=list(early["results"]),
+                   results_done=0))
+    res = _mine_tsr(db, k=10, minconf=0.4, max_side=2, item_cap=8,
+                    partition_parts=2, checkpoint=ckpt)
+    assert rules_text(res) == want
+    # layout change: classes differ -> fingerprint mismatch -> fresh
+    res2 = _mine_tsr(db, k=10, minconf=0.4, max_side=2, item_cap=8,
+                     partition_parts=2, partition_classes=32,
+                     checkpoint=ckpt)
+    assert rules_text(res2) == want
+
+
+def test_tsr_partitioned_mid_part_frontier_resume():
+    """A mid-part composite (active_part + engine frontier_state)
+    resumes the ACTIVE part from its frontier, not from scratch."""
+    saves = []
+
+    class Cap:
+        every_s = 0.0
+
+        def load(self):
+            return None
+
+        def save(self, s):
+            saves.append(s)
+
+    db = _db()
+    want = rules_text(_mine_tsr(db, k=10, minconf=0.4, max_side=2,
+                                item_cap=8))
+    _mine_tsr(db, k=10, minconf=0.4, max_side=2, item_cap=8,
+              partition_parts=2, checkpoint=Cap())
+    mids = [s for s in saves
+            if s["partition"]["active_part"] is not None
+            and s["partition"]["active_state"] is not None]
+    assert mids, "no mid-part composite was ever saved"
+    mid = mids[0]
+    fs = mid["partition"]["active_state"]
+    assert {"fingerprint", "m", "minsup", "stack",
+            "results"} <= set(fs)  # the engines' OWN snapshot format
+
+    class Fixed:
+        every_s = 1e9
+
+        def load(self):
+            return mid
+
+        def save(self, s):
+            pass
+
+    res = _mine_tsr(db, k=10, minconf=0.4, max_side=2, item_cap=8,
+                    partition_parts=2, checkpoint=Fixed())
+    assert rules_text(res) == want
+
+
+def test_tsr_partition_zero_root_slice():
+    """A partition owning no frequent class degrades to an empty slice
+    (tiny alphabet over many partitions) — the union is still exact."""
+    db = synthetic_db(seed=5, n_sequences=80, n_items=4,
+                      mean_itemsets=3.0, mean_itemset_size=1.2)
+    want = rules_text(_mine_tsr(db, k=5, minconf=0.3, max_side=2))
+    got = _mine_tsr(db, k=5, minconf=0.3, max_side=2, partition_parts=4)
+    assert rules_text(got) == want
+
+
+def _mine_tsr(db, k=100, minconf=0.5, **kwargs):
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    return mine_tsr_tpu(db, k, minconf, **kwargs)
+
+
+# ------------------------------------------------ SPADE / cSPADE slices
+
+
+def test_spade_partitioned_parity_queue_and_classic():
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+
+    db = _db(seed=21, n=203, items=12)
+    ms = abs_minsup(0.06, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    for fused in ("auto", "never"):
+        stats: dict = {}
+        got = mine_spade_tpu(db, ms, partition_parts=2, fused=fused,
+                             stats_out=stats)
+        assert patterns_text(got) == want, fused
+        assert stats["fused"] == "partitioned"
+        assert stats["partition_exchanges"] == 1
+    # 2-D: partition rows over the 8-device mesh
+    stats2: dict = {}
+    got2 = mine_spade_tpu(db, ms, mesh=make_mesh(8), partition_parts=2,
+                          stats_out=stats2)
+    assert patterns_text(got2) == want
+
+
+def test_spade_partitioned_checkpoint_composite():
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+
+    saves = []
+
+    class Cap:
+        every_s = 0.0
+
+        def load(self):
+            return None
+
+        def save(self, s):
+            saves.append(s)
+
+    db = _db(seed=21, n=203, items=12)
+    ms = abs_minsup(0.06, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    got = mine_spade_tpu(db, ms, partition_parts=2, checkpoint=Cap())
+    assert patterns_text(got) == want
+    assert saves and "partition" in saves[-1]
+    last = saves[-1]
+
+    class Fixed:
+        every_s = 1e9
+
+        def load(self):
+            return last
+
+        def save(self, s):
+            pass
+
+    res = mine_spade_tpu(db, ms, partition_parts=2, checkpoint=Fixed())
+    assert patterns_text(res) == want
+
+
+def test_cspade_partitioned_parity():
+    from spark_fsm_tpu.models.spade_constrained import mine_cspade_tpu
+
+    db = _db(seed=21, n=203, items=12)
+    ms = abs_minsup(0.06, len(db))
+    want = patterns_text(mine_cspade(db, ms, maxgap=2, maxwindow=5))
+    stats: dict = {}
+    got = mine_cspade_tpu(db, ms, maxgap=2, maxwindow=5,
+                          partition_parts=2, chunk=64, node_batch=8,
+                          pool_bytes=1 << 20, stats_out=stats)
+    assert patterns_text(got) == want
+    assert stats["partition_parts"] == 2
+
+
+# ------------------------------------------------------- metrics hygiene
+
+
+def test_partition_metric_families_zero_seeded():
+    """Every fsm_partition_* family renders on a fresh scrape with its
+    label vocabulary seeded (the obs_smoke no-orphan contract applied
+    to the new names)."""
+    from spark_fsm_tpu.utils import obs
+
+    text = obs.REGISTRY.render_prometheus()
+    for fam in ("fsm_partition_plans_total",
+                "fsm_partition_exchange_rounds_total",
+                "fsm_partition_cross_bytes_total",
+                "fsm_partition_imbalance_ratio",
+                "fsm_partition_mines_total"):
+        assert fam in text, f"family missing from scrape: {fam}"
+    for algo in ("tsr", "spade", "cspade"):
+        assert f'fsm_partition_mines_total{{algo="{algo}"}}' in text
+
+
+def test_partition_config_resolution():
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.config import ConfigError, parse_config
+    from spark_fsm_tpu.service.plugins import resolved_partition_parts
+
+    old = cfgmod.get_config()
+    try:
+        cfgmod.set_config(parse_config({}))
+        assert resolved_partition_parts() == 0  # disabled by default
+        cfgmod.set_config(parse_config(
+            {"partition": {"enabled": True, "parts": 4}}))
+        assert resolved_partition_parts() == 4
+        cfgmod.set_config(parse_config(
+            {"partition": {"enabled": True},
+             "engine": {"mesh_devices": 8}}))
+        assert resolved_partition_parts() == 2  # auto: mesh >= 2 devs
+        cfgmod.set_config(parse_config({"partition": {"enabled": True}}))
+        assert resolved_partition_parts() == 0  # no mesh, one process
+        # auto on an odd mesh: no even split exists — stay off rather
+        # than 500 every request at submeshes()
+        cfgmod.set_config(parse_config(
+            {"partition": {"enabled": True},
+             "engine": {"mesh_devices": 3}}))
+        assert resolved_partition_parts() == 0
+        # explicit parts that cannot split the topology degrade to
+        # unpartitioned (logged) instead of failing every train
+        cfgmod.set_config(parse_config(
+            {"partition": {"enabled": True, "parts": 3},
+             "engine": {"mesh_devices": 8}}))
+        assert resolved_partition_parts() == 0
+        with pytest.raises(ConfigError):
+            parse_config({"partition": {"parts": -1}})
+        with pytest.raises(ConfigError):
+            parse_config({"partition": {"parts": 8, "classes": 4}})
+    finally:
+        cfgmod.set_config(old)

@@ -54,6 +54,7 @@ def test_key_formats_are_the_engine_spellings():
         "cspade:s128w1i12p64nb32c256gnxnd16"
     assert shapes.key_sweep(128, 1, 256, 128) == "sweep:s128w1r256i128"
     assert shapes.key_tsr_eval(128, 1, 4, 256) == "tsr-eval:s128w1km4c256"
+    assert shapes.key_tsr_part(2, 128, 1) == "tsr-part:p2s128w1"
 
 
 def test_enumeration_covers_runtime_keys_no_drift():
@@ -324,3 +325,70 @@ def test_tsr_resident_keys_through_prewarm():
     assert eng.stats.get("resident_segments", 0) >= 1
     assert c1["count"] - c0["count"] == 0, \
         f"resident round compiled {c1['count'] - c0['count']} fresh programs"
+
+
+def test_tsr_partition_keys_through_prewarm():
+    """Partitioned-ladder coverage (the ISSUE-10 tentpole's warm-path
+    contract): the enumerator lists the ``tsr-part`` umbrella key plus
+    the per-part INNER ``tsr``/``tsr-eval`` ladder at the submesh-row
+    geometry, the prewarm driver walks EVERY row (compiled executables
+    bind device assignments), and a post-prewarm partitioned dispatch
+    at the warmed geometry performs zero fresh compiles."""
+    from spark_fsm_tpu.models import tsr as tsr_mod
+    from spark_fsm_tpu.models.tsr import TsrPartitioned
+    from spark_fsm_tpu.parallel import partition as PN
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    from spark_fsm_tpu.service import prewarm
+
+    assert enable_compile_counter()
+    db = _db(seed=82, n=96)
+    vdb = build_vertical(db, min_item_support=1)
+    mesh = make_mesh(8)
+    spec = shapes.WorkloadSpec(n_sequences=len(db), n_items=vdb.n_items,
+                               n_words=vdb.n_words, tsr=True,
+                               partition_parts=2)
+    ekw = {"tsr_chunk": 256}
+    targets = shapes.enumerate_shapes(spec, mesh=mesh, engine_kwargs=ekw)
+    part_t = {k: t for k, t in targets.items() if t["kind"] == "tsr_part"}
+    assert part_t, "no tsr-part key enumerated"
+    inner = PN.submeshes(mesh, 2)[0]
+    tgp = tsr_mod.tsr_geometry(len(db), vdb.n_words, mesh=inner)
+    assert shapes.key_tsr_part(2, tgp["n_seq"], vdb.n_words) in part_t
+    # the inner eval ladder is enumerated at the INNER padded seq axis
+    assert shapes.key_tsr_eval(tgp["n_seq"], vdb.n_words, 1, 32) in targets
+
+    shapes.reset_recorded()
+    mines_before = PN._MINES.total()
+    plans_before = PN._PLANS.total()
+    report = prewarm.run(spec, mesh=mesh, engine_kwargs=ekw)
+    bad = [r for r in report["keys"] if r.get("error")]
+    assert not bad, bad
+    recorded = shapes.recorded()
+    assert shapes.key_tsr_part(2, tgp["n_seq"], vdb.n_words) in recorded
+    # the warm mine must not masquerade as traffic: fsm_partition_*
+    # business families stay untouched by prewarm (record_metrics=False)
+    assert PN._MINES.total() == mines_before
+    assert PN._PLANS.total() == plans_before
+
+    # zero-fresh-compile through a live partitioned dispatch on BOTH
+    # rows at the warmed geometry (prep snapshotted first, like the
+    # superbatch pin — its scatter build keys on token counts)
+    orch = TsrPartitioned(vdb, 8, 0.5, mesh=mesh, parts=2,
+                          max_side=None, chunk=256)
+    assert orch.stats["shape_key"] in shapes.recorded()
+    for eng in orch.engines.values():
+        m = min(eng.item_cap, vdb.n_items)
+        eng.chunk = eng._round_chunk(m)
+        eng._round_m = m
+        eng._jnp_prep = None
+        p1, s1 = eng._prep(m)
+        c0 = compile_counts()
+        cands = ([((0,), (j,)) for j in range(1, 9)]
+                 + [((0, 1), (2, 3)), ((0,), (1, 2, 3))])
+        handle = eng._dispatch_eval(p1, s1, cands)
+        sups, _supxs = eng._resolve_eval(handle, len(cands))
+        assert len(sups) == len(cands)
+        c1 = compile_counts()
+        assert c1["count"] - c0["count"] == 0, (
+            f"partitioned eval dispatch compiled "
+            f"{c1['count'] - c0['count']} fresh programs")
