@@ -68,6 +68,17 @@ EXPECT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 COMPARED = ("jobs", "parity", "forced_cross_job", "modeled_2x",
             "degraded", "sheds", "failures", "slo_consistent")
 
+# --mix zipf (ISSUE 12): the result-reuse tier's success metric — a
+# realistic zipf-distributed request mix (hot datasets + dominated
+# parameter variants), cold vs cached, with structural guards: per-
+# request parity against the cold baseline, cache-hit ratio >= 0.5,
+# served-jobs/s speedup >= 2x, and NO cold-mine p99 regression (cold
+# requests in cached mode stay within a generous 3x envelope of the
+# baseline p99 — walls are noisy on shared boxes, the guard catches
+# order-of-magnitude admission-path regressions, not jitter).
+ZIPF_COMPARED = ("zipf_jobs", "zipf_parity", "zipf_hit_ratio_ok",
+                 "zipf_speedup_2x", "no_p99_regression_cold")
+
 N_JOBS = int(os.environ.get("SPARKFSM_TP_JOBS", "48"))
 N_WORKERS = int(os.environ.get("SPARKFSM_TP_WORKERS", "8"))
 N_RUNS = int(os.environ.get("SPARKFSM_TP_RUNS", "3"))
@@ -185,14 +196,240 @@ def _forced_window(dbs, n_held: int = 4):
             "cross_job_launches": b.stats["cross_job_launches"] - before}
 
 
+ZIPF_JOBS = int(os.environ.get("SPARKFSM_TP_ZIPF_JOBS", "64"))
+
+
+def _zipf_stream(n_jobs, n_datasets, seed=7):
+    """Deterministic zipf-distributed request stream: dataset i drawn
+    with weight 1/(i+1) (hot heads, long tail), parameters drawn from a
+    variant pool where the base (k=8) dominates the rest — repeats of
+    the base coalesce or exact-hit, the weaker variants serve
+    dominated once the base has run."""
+    import random
+
+    rng = random.Random(seed)
+    weights = [1.0 / (i + 1) for i in range(n_datasets)]
+    variants = [(8, "0.4"), (8, "0.4"), (5, "0.4"), (3, "0.5")]
+    return [(rng.choices(range(n_datasets), weights)[0],
+             *rng.choice(variants)) for _ in range(n_jobs)]
+
+
+def _zipf_flood(dbs, stream, workers, label):
+    """Run the request stream through a fresh Master; returns
+    (per-request rows, summary).  Rows carry the request key, the
+    canonical rules text, and how the request was satisfied (cold /
+    exact / dominated / coalesced)."""
+    import json as _json
+
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import (ServiceRequest,
+                                             deserialize_rules)
+    from spark_fsm_tpu.service.store import ResultStore
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    store = ResultStore()
+    master = Master(store=store, miner_workers=workers)
+    spmf = [format_spmf(db) for db in dbs]
+    try:
+        t0 = time.monotonic()
+        t_submit, done = {}, {}
+        keys = {}
+        for i, (db_i, k, minconf) in enumerate(stream):
+            uid = f"zp-{label}-{i}"
+            resp = master.handle(ServiceRequest("fsm", "train", {
+                "algorithm": "TSR_TPU", "source": "INLINE",
+                "sequences": spmf[db_i], "k": str(k),
+                "minconf": minconf, "max_side": "2", "uid": uid}))
+            if resp.status == "failure":
+                raise RuntimeError(f"zipf submit failed: {resp.data}")
+            t_submit[uid] = time.monotonic()
+            keys[uid] = (db_i, k, minconf)
+        deadline = time.monotonic() + DEADLINE_S
+        while t_submit.keys() - done.keys() and time.monotonic() < deadline:
+            for uid in list(t_submit.keys() - done.keys()):
+                st = store.status(uid)
+                if st in ("finished", "failure"):
+                    done[uid] = (time.monotonic(), st)
+            time.sleep(0.002)
+        pending = t_submit.keys() - done.keys()
+        if pending:
+            raise TimeoutError(f"zipf-{label}: {len(pending)} jobs "
+                               f"never finished")
+        failures = sum(1 for _, st in done.values() if st == "failure")
+        wall = time.monotonic() - t0
+        rows = {}
+        cold_lats, all_lats = [], []
+        served = coalesced = 0
+        for uid in done:
+            stats = _json.loads(store.get(f"fsm:stats:{uid}") or "{}")
+            if stats.get("coalesced_into"):
+                how = "coalesced"
+                coalesced += 1
+                served += 1
+            elif stats.get("served_from_cache"):
+                how = stats["served_from_cache"]
+                served += 1
+            else:
+                how = "cold"
+            lat = done[uid][0] - t_submit[uid]
+            all_lats.append(lat)
+            if how == "cold":
+                cold_lats.append(lat)
+            rows[uid] = (keys[uid],
+                         rules_text(deserialize_rules(store.rules(uid))),
+                         how)
+        q = lambda xs, p: sorted(xs)[
+            min(len(xs) - 1, int(p * (len(xs) - 1)))] if xs else None
+        summary = {
+            "jobs": len(done), "wall_s": round(wall, 3),
+            "jobs_per_sec": round(len(done) / wall, 2),
+            "p99_s": round(q(all_lats, 0.99), 4),
+            "cold_jobs": len(cold_lats),
+            "p99_cold_s": (None if not cold_lats
+                           else round(q(cold_lats, 0.99), 4)),
+            "served": served, "coalesced": coalesced,
+            "failures": failures,
+        }
+        return rows, summary
+    finally:
+        master.shutdown()
+
+
+def main_zipf(update: bool, n_jobs: int, workers: int) -> int:
+    """--mix zipf: the result-reuse success metric (ROADMAP item 2)."""
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.ops import ragged_batch as RB
+    from spark_fsm_tpu.utils import jitcache
+
+    RB.set_overhead_calibration(False)
+    jitcache.enable_compile_counter()
+    dbs = _datasets()
+    stream = _zipf_stream(n_jobs, len(dbs))
+
+    # compile-warm the cold path (same arbiter as the fusion flood)
+    for i in range(6):
+        before = jitcache.compile_counts()["count"]
+        _zipf_flood(dbs, stream, workers, f"warm-{i}")
+        if jitcache.compile_counts()["count"] == before:
+            break
+
+    def med(runs, key):
+        vals = sorted(r[key] for r in runs)
+        return vals[len(vals) // 2]
+
+    cold_runs, cold_rows = [], {}
+    for i in range(N_RUNS):
+        rows, s = _zipf_flood(dbs, stream, workers, f"cold-{i}")
+        cold_rows.update(rows)
+        cold_runs.append(s)
+
+    old_cfg = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"rescache": {"enabled": True}}))
+    try:
+        cached_runs, cached_rows = [], {}
+        for i in range(N_RUNS):
+            rows, s = _zipf_flood(dbs, stream, workers, f"cached-{i}")
+            cached_rows.update(rows)
+            cached_runs.append(s)
+    finally:
+        cfgmod.set_config(old_cfg)
+
+    # per-request parity: every cached/coalesced/dominated/cold answer
+    # must be byte-identical (canonical text) to the cold baseline's
+    # answer for the same (dataset, k, minconf)
+    want = {}
+    for key, text, _ in cold_rows.values():
+        want.setdefault(key, text)
+    parity = all(len({t for k2, t, _ in cold_rows.values() if k2 == key})
+                 == 1 for key in want)
+    for key, text, _ in cached_rows.values():
+        parity = parity and want.get(key) == text
+
+    cold_jps = med(cold_runs, "jobs_per_sec")
+    cached_jps = med(cached_runs, "jobs_per_sec")
+    total = sum(r["jobs"] for r in cached_runs)
+    served = sum(r["served"] for r in cached_runs)
+    coalesced = sum(r["coalesced"] for r in cached_runs)
+    hit_ratio = round(served / max(1, total), 3)
+    coalesce_ratio = round(coalesced / max(1, total), 3)
+    p99_cold_base = med(cold_runs, "p99_s")
+    cold_p99s = [r["p99_cold_s"] for r in cached_runs
+                 if r["p99_cold_s"] is not None]
+    p99_cold_cached = (sorted(cold_p99s)[len(cold_p99s) // 2]
+                      if cold_p99s else None)
+    no_regress = (p99_cold_cached is None
+                  or p99_cold_cached <= 3.0 * p99_cold_base + 0.05)
+    speedup = round(cached_jps / max(1e-9, cold_jps), 2)
+
+    out = {
+        "zipf_jobs": n_jobs, "workers": workers,
+        "zipf_parity": parity,
+        "zipf_hit_ratio_ok": hit_ratio >= 0.5,
+        "zipf_speedup_2x": speedup >= 2.0,
+        "no_p99_regression_cold": bool(no_regress),
+        "zipf": {
+            "cold": {"jobs_per_sec": cold_jps,
+                     "p99_s": p99_cold_base,
+                     "runs": [r["jobs_per_sec"] for r in cold_runs]},
+            "cached": {"jobs_per_sec": cached_jps,
+                       "p99_cold_s": p99_cold_cached,
+                       "runs": [r["jobs_per_sec"] for r in cached_runs],
+                       "failures": sum(r["failures"]
+                                       for r in cached_runs)},
+            "speedup_jobs_per_sec": speedup,
+            "cache_hit_ratio": hit_ratio,
+            "coalesce_ratio": coalesce_ratio,
+            "served": served, "coalesced": coalesced, "total": total,
+        },
+    }
+    print(json.dumps(out, indent=2))
+
+    try:
+        with open(EXPECT_PATH) as fh:
+            expect = json.load(fh)
+    except OSError:
+        expect = {}
+    if update:
+        expect.update({k: out[k] for k in ZIPF_COMPARED})
+        with open(EXPECT_PATH, "w") as fh:
+            json.dump(expect, fh, indent=2)
+            fh.write("\n")
+        print(f"bench_throughput: zipf expectations written -> "
+              f"{EXPECT_PATH}")
+        return 0
+    bad = [k for k in ZIPF_COMPARED if out.get(k) != expect.get(k)]
+    if bad:
+        for k in bad:
+            print(f"bench_throughput[zipf]: MISMATCH {k}: got "
+                  f"{out.get(k)!r}, expected {expect.get(k)!r}",
+                  file=sys.stderr)
+        return 1
+    print(f"bench_throughput[zipf]: OK (cached {cached_jps} jobs/s vs "
+          f"cold {cold_jps} jobs/s, hit ratio {hit_ratio}, coalesce "
+          f"ratio {coalesce_ratio}, cold p99 {p99_cold_cached}s vs "
+          f"baseline {p99_cold_base}s — walls reported, guards "
+          f"structural)")
+    return 0
+
+
 def main() -> int:
     update = "--update" in sys.argv[1:]
     args = [a for a in sys.argv[1:] if a != "--update"]
+    mix = None
+    if "--mix" in args:
+        mix = args[args.index("--mix") + 1]
+        if mix != "zipf":
+            sys.exit(f"unknown --mix {mix!r} (have: zipf)")
     n_jobs, workers = N_JOBS, N_WORKERS
     if "--jobs" in args:
         n_jobs = int(args[args.index("--jobs") + 1])
     if "--workers" in args:
         workers = int(args[args.index("--workers") + 1])
+    if mix == "zipf":
+        return main_zipf(update,
+                         ZIPF_JOBS if "--jobs" not in args else n_jobs,
+                         workers)
 
     from spark_fsm_tpu import config as cfgmod
     from spark_fsm_tpu.ops import ragged_batch as RB

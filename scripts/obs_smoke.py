@@ -180,7 +180,18 @@ def main() -> int:
                     "fsm_partition_exchange_rounds_total",
                     "fsm_partition_cross_bytes_total",
                     "fsm_partition_imbalance_ratio",
-                    "fsm_partition_mines_total"):
+                    "fsm_partition_mines_total",
+                    # ISSUE 12 families: result-reuse tier
+                    # (service/resultcache.py) — present (zero) even
+                    # on a boot with [rescache] disabled
+                    "fsm_rescache_hits_total",
+                    "fsm_rescache_misses_total",
+                    "fsm_rescache_coalesced_total",
+                    "fsm_rescache_dominated_serves_total",
+                    "fsm_rescache_evictions_total",
+                    "fsm_rescache_bytes_total",
+                    "fsm_rescache_bytes",
+                    "fsm_rescache_errors_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -197,7 +208,9 @@ def main() -> int:
                 ("fsm_trace_spine_writes_total", "outcome",
                  {"ok", "fenced", "error"}),
                 ("fsm_partition_mines_total", "algo",
-                 {"tsr", "spade", "cspade"})):
+                 {"tsr", "spade", "cspade"}),
+                ("fsm_rescache_errors_total", "op",
+                 {"lookup", "store", "serve", "coalesce", "fanout"})):
             got = {m.group(1) for k in families.get(fam, {})
                    for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
             missing = want - got

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Result-reuse smoke: boot with ``[rescache] enabled``, drive a hit, a
+coalesce, and a dominated serve over HTTP, assert parity + live metrics.
+
+The CI companion to obs_smoke/chaos_smoke for the result-reuse tier
+(ISSUE 12, service/resultcache.py): it boots the real HTTP service with
+the tier on and ONE miner worker, then
+
+- mines a base TSR job cold (the first mine also pays the compile, so
+  it reliably occupies the single worker);
+- submits an identical pair while the worker is busy: the first queues
+  as a coalescing LEADER, the second attaches as a FOLLOWER and is
+  delivered by fan-out — byte-identical rules, its own stats/status;
+- repeats the request after completion: an EXACT cache hit;
+- requests a strictly weaker variant (smaller k): a DOMINATED serve,
+  checked byte-identical (canonical text) against a local cold oracle
+  (models/tsr.mine_tsr_cpu);
+- asserts the fsm_rescache_* metric families are live on /metrics with
+  nonzero hit/coalesce/dominated counters, /admin/rescache reports the
+  resident entry, and the journal namespace drained (no stuck uids).
+
+Usage: scripts/rescache_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu
+    from spark_fsm_tpu.service.app import serve_background
+    from spark_fsm_tpu.service.model import deserialize_rules
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    cfgmod.set_config(cfgmod.parse_config({"rescache": {"enabled": True}}))
+    srv = serve_background()
+    port = srv.server_port
+
+    def post(ep, **params):
+        data = urllib.parse.urlencode(params).encode()
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{ep}",
+                                    data=data, timeout=120) as r:
+            return r.read().decode()
+
+    def train(uid, text, **params):
+        d = {"algorithm": "TSR_TPU", "source": "INLINE",
+             "sequences": text, "k": "8", "minconf": "0.4",
+             "max_side": "2", "uid": uid}
+        d.update(params)
+        resp = json.loads(post("/train", **d))
+        assert resp["status"] != "failure", resp
+        return resp
+
+    def wait(uid, timeout=240.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = json.loads(post(f"/status/{uid}"))
+            if st["status"] in ("finished", "failure"):
+                return st
+            time.sleep(0.05)
+        raise TimeoutError(f"job {uid} never finished")
+
+    def stats_of(st):
+        return json.loads(st.get("data", {}).get("stats", "{}"))
+
+    failures = []
+    try:
+        db_a = synthetic_db(seed=71, n_sequences=80, n_items=10,
+                            mean_itemsets=3.0, mean_itemset_size=1.3)
+        db_b = synthetic_db(seed=72, n_sequences=80, n_items=10,
+                            mean_itemsets=3.0, mean_itemset_size=1.3)
+        text_a, text_b = format_spmf(db_a), format_spmf(db_b)
+
+        # the blocker pins the single worker (first mine pays the
+        # compile); leader + follower land while it runs
+        train("rc-blk", text_a)
+        train("rc-lead", text_b)
+        train("rc-follow", text_b)
+        for uid in ("rc-blk", "rc-lead", "rc-follow"):
+            st = wait(uid)
+            if st["status"] != "finished":
+                failures.append(f"{uid} did not finish: {st}")
+        st_follow = wait("rc-follow")
+        if stats_of(st_follow).get("coalesced_into") != "rc-lead":
+            failures.append(
+                f"follower was not coalesced onto rc-lead: "
+                f"{stats_of(st_follow)}")
+        rules_lead = json.loads(post("/get/rules", uid="rc-lead"))
+        rules_follow = json.loads(post("/get/rules", uid="rc-follow"))
+        if rules_lead["data"].get("rules") != \
+                rules_follow["data"].get("rules"):
+            failures.append("follower rules differ from leader rules")
+
+        # exact hit after completion
+        train("rc-hit", text_b)
+        st = wait("rc-hit")
+        if stats_of(st).get("served_from_cache") != "exact":
+            failures.append(f"repeat request not an exact hit: "
+                            f"{stats_of(st)}")
+        rules_hit = json.loads(post("/get/rules", uid="rc-hit"))
+        if rules_hit["data"].get("rules") != \
+                rules_lead["data"].get("rules"):
+            failures.append("exact-hit rules differ from the cold run")
+
+        # dominated serve: smaller k, parity vs a local cold oracle
+        train("rc-dom", text_b, k="4")
+        st = wait("rc-dom")
+        if stats_of(st).get("served_from_cache") != "dominated":
+            failures.append(f"smaller-k request not served dominated: "
+                            f"{stats_of(st)}")
+        got = rules_text(deserialize_rules(
+            json.loads(post("/get/rules", uid="rc-dom"))["data"]["rules"]))
+        want = rules_text(mine_tsr_cpu(db_b, 4, 0.4, max_side=2))
+        if got != want:
+            failures.append("dominated serve is NOT byte-identical to "
+                            "the cold oracle at k=4")
+
+        # live metric families with the drill's counts
+        text = post("/metrics")
+        for fam, floor in (("fsm_rescache_hits_total", 1),
+                           ("fsm_rescache_coalesced_total", 1),
+                           ("fsm_rescache_dominated_serves_total", 1),
+                           ("fsm_rescache_misses_total", 1),
+                           ("fsm_rescache_errors_total", 0),
+                           ("fsm_rescache_bytes", 1)):
+            vals = [float(line.rsplit(" ", 1)[1])
+                    for line in text.splitlines()
+                    if line.startswith(fam + " ")
+                    or line.startswith(fam + "{")]
+            if not vals:
+                failures.append(f"/metrics missing family {fam}")
+            elif sum(vals) < floor:
+                failures.append(f"{fam} = {sum(vals)} < {floor}")
+
+        admin = json.loads(post("/admin/rescache"))
+        if not admin.get("enabled") or not admin.get("entries"):
+            failures.append(f"/admin/rescache incomplete: {admin}")
+
+        # zero stuck uids: every journal intent settled
+        leftover = srv.master.store.keys("fsm:journal:")
+        if leftover:
+            failures.append(f"journal intents leaked: {leftover}")
+    finally:
+        srv.master.shutdown()
+        srv.shutdown()
+    if failures:
+        print("rescache_smoke: FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print("rescache_smoke: hit + coalesce + dominated-serve over HTTP "
+          "all parity-checked, metric families live, journal drained")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

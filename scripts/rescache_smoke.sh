@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Result-reuse smoke — the ISSUE 12 companion to obs_smoke.sh and
+# chaos_smoke.sh.  Boots the service with [rescache] enabled and one
+# miner worker, drives a cache hit, an in-flight coalesce, and a
+# dominated serve over HTTP, asserts byte-identical parity against a
+# cold oracle, live fsm_rescache_* metric families, and a drained
+# journal namespace (no stuck follower uids).
+cd "$(dirname "$0")/.."
+exec timeout -k 30 600 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/rescache_smoke.py "$@"

@@ -12,6 +12,11 @@
 cd "$(dirname "$0")/.."
 # hard wall-clock bound like overload_smoke: a wedged broker window
 # would otherwise block the poll loop until the 300 s job deadline
+timeout -k 30 840 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python bench_throughput.py "$@" || exit 1
+# the ISSUE 12 zipf mix: result-reuse tier cold-vs-cached with the
+# pinned hit-ratio / speedup / no-cold-p99-regression guards
 exec timeout -k 30 840 env JAX_PLATFORMS=cpu \
     PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
-    python bench_throughput.py "$@"
+    python bench_throughput.py --mix zipf "$@"

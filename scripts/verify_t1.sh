@@ -19,6 +19,9 @@
 #   replica_smoke.sh     2 replicas on one MiniRedis: work stealing,
 #                        kill -9 failover with lease-expiry adoption +
 #                        oracle parity
+#   rescache_smoke.sh    result-reuse tier over HTTP: cache hit +
+#                        in-flight coalesce + dominated serve, parity
+#                        vs cold oracle, live fsm_rescache_* families
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -30,7 +33,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
              throughput_smoke resident_smoke partition_smoke \
-             replica_smoke; do
+             replica_smoke rescache_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

@@ -102,6 +102,20 @@ File format (TOML shown; JSON with the same nesting also accepted):
     classes = 64                    # km-prefix hash buckets balanced
                                     # over the partitions
 
+    [rescache]
+    enabled = false                 # result-reuse tier above admission
+                                    # (service/resultcache.py): content-
+                                    # addressed dataset fingerprints,
+                                    # in-flight request coalescing, and
+                                    # dominance-based cache serving; off
+                                    # = one attribute read per submit
+    max_bytes = 67108864            # LRU byte budget for cached result
+                                    # entries (0 = unbounded)
+    coalesce = true                 # attach identical in-flight requests
+                                    # as followers of one execution
+    dominance = true                # serve dominated requests by host-
+                                    # side filtering of cached results
+
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
     sequences = 77500               # expected dataset scale
@@ -279,6 +293,30 @@ class PartitionConfig:
 
 
 @dataclasses.dataclass
+class RescacheConfig:
+    """Result-reuse tier above admission (service/resultcache.py):
+    content-addressed dataset fingerprints, in-flight request
+    coalescing (identical requests attach as followers of one
+    execution with fan-out delivery), and dominance-based serving
+    (a completed cached result answers strictly weaker requests by
+    host-side filtering — zero device work).  The dominance predicates
+    are proven conservative in docs/DESIGN.md.
+
+    ``enabled = false`` (default) keeps the pre-rescache admission path
+    byte-identical: the Miner holds no cache instance and every submit
+    pays one attribute read.  ``max_bytes`` bounds the cached result
+    entries with LRU eviction over a cursor SCAN (0 = unbounded).
+    ``coalesce`` / ``dominance`` gate the two serving layers
+    independently (fingerprinting stays on for both).
+    """
+
+    enabled: bool = False
+    max_bytes: int = 67108864  # 64 MiB
+    coalesce: bool = True
+    dominance: bool = True
+
+
+@dataclasses.dataclass
 class DistributedConfig:
     """Multi-host (jax.distributed) wiring; all-defaults = single host.
 
@@ -334,6 +372,8 @@ class Config:
         default_factory=PartitionConfig)
     cluster: ClusterConfig = dataclasses.field(
         default_factory=ClusterConfig)
+    rescache: RescacheConfig = dataclasses.field(
+        default_factory=RescacheConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -380,6 +420,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "fusion": (FusionConfig, top.pop("fusion", {})),
         "partition": (PartitionConfig, top.pop("partition", {})),
         "cluster": (ClusterConfig, top.pop("cluster", {})),
+        "rescache": (RescacheConfig, top.pop("rescache", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -443,6 +484,8 @@ def parse_config(obj: Dict[str, Any]) -> Config:
             "renewed slower than it expires is permanently flapping)")
     if cfg.cluster.recover_every_s < 0:
         raise ConfigError("cluster.recover_every_s must be >= 0 (0 = ttl)")
+    if cfg.rescache.max_bytes < 0:
+        raise ConfigError("rescache.max_bytes must be >= 0 (0 = unbounded)")
     return cfg
 
 

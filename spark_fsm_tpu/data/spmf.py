@@ -15,11 +15,36 @@ so downstream bitmap construction and i-extension ordering are well-defined).
 
 from __future__ import annotations
 
+import hashlib
 from typing import Iterable, List, Tuple
 
 Itemset = Tuple[int, ...]
 Sequence = Tuple[Itemset, ...]
 SequenceDB = List[Sequence]
+
+
+def fingerprint_db(db: Iterable[Sequence]) -> str:
+    """Content-addressed dataset fingerprint: a streaming sha256 over the
+    canonical in-memory form (itemsets deduped + sorted by the parser),
+    one sequence at a time — never materializing the whole text.
+
+    Deliberately hashes CONTENT ONLY, not the source spelling: a FILE
+    path, an INLINE payload, and a SYNTH generator that resolve to the
+    same sequences produce the SAME fingerprint, which is exactly what
+    lets the result-reuse tier (service/resultcache.py) serve one
+    cached mine to every spelling of the data.  The checkpoint layer's
+    engine fingerprints cover engine state; this covers the dataset
+    dimension.
+    """
+    h = hashlib.sha256(b"fsm-db-v1\n")
+    for seq in db:
+        parts: List[str] = []
+        for itemset in seq:
+            parts.extend(str(i) for i in itemset)
+            parts.append("-1")
+        parts.append("-2\n")
+        h.update(" ".join(parts).encode("ascii"))
+    return h.hexdigest()
 
 
 def parse_spmf(text: str) -> SequenceDB:

@@ -23,7 +23,8 @@ from typing import Deque, Dict, List, Optional
 
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
-from spark_fsm_tpu.service import lease, model, obsplane, plugins, sources
+from spark_fsm_tpu.service import (lease, model, obsplane, plugins,
+                                   resultcache, sources)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 from spark_fsm_tpu.utils import faults, jobctl, obs
@@ -43,7 +44,8 @@ def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
 def _record_failure(store: ResultStore, uid: str, exc: Exception,
                     metric: str = "jobs_failed",
                     keep_frontier: bool = False,
-                    lease_mgr: Optional[lease.LeaseManager] = None) -> None:
+                    lease_mgr: Optional[lease.LeaseManager] = None,
+                    rescache=None) -> None:
     """The supervision contract: error text + traceback under the error
     key, status -> failure (SURVEY.md sec 5 failure-detection row).
     ``metric`` keeps batch-job and stream-push failure counters distinct
@@ -77,6 +79,10 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
         with obs.span("job.failed_fenced", trace_id=uid, error=str(exc)):
             pass
         obs.flush_trace(uid)
+        if rescache is not None:
+            # the adopter finishes the job elsewhere — coalesced
+            # followers waiting HERE re-dispatch as cold mines
+            rescache.on_leader_terminal(uid)
         return
     store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
     store.add_status(uid, Status.FAILURE)
@@ -104,6 +110,10 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
     obs.flush_trace(uid)
     if lease_mgr is not None:
         lease_mgr.release(uid)
+    if rescache is not None:
+        # a leader's abort is its client's decision, not the followers':
+        # re-dispatch any coalesced followers through normal admission
+        rescache.on_leader_terminal(uid)
 
 
 def _profile_dir(req: ServiceRequest, uid: str) -> str:
@@ -442,6 +452,11 @@ class Miner:
             lease_mgr = lease.LeaseManager.from_config(
                 store, config.get_config().cluster)
         self._lease = lease_mgr
+        # result-reuse tier (ISSUE 12, service/resultcache.py): dataset
+        # fingerprints + in-flight coalescing + dominance serving above
+        # admission.  None (the default) keeps submit at ONE attribute
+        # read — bench_smoke's dispatch counters stay byte-identical.
+        self._rescache = resultcache.build_for(self)
         # this Miner's incarnation id: journal entries carrying it are
         # LIVE (409 on resubmit); entries carrying any other id belong
         # to a dead incarnation and are recovery fodder
@@ -531,6 +546,10 @@ class Miner:
             ctl = self._lease.attached_ctl(uid)
             self._lease.stolen_from_us(uid)
             jobctl.release_entry(ctl)
+            if self._rescache is not None:
+                # the thief runs (and fans out) elsewhere: local
+                # followers re-dispatch as cold mines
+                self._rescache.on_leader_terminal(uid)
             return True
         try:
             # route through check_entry so the cancel counter and trace
@@ -541,7 +560,7 @@ class Miner:
         except jobctl.JobAborted as caught:
             exc = caught
         _record_failure(self.store, uid, exc, keep_frontier=True,
-                        lease_mgr=self._lease)
+                        lease_mgr=self._lease, rescache=self._rescache)
         return True
 
     @property
@@ -598,6 +617,48 @@ class Miner:
             if not math.isfinite(deadline_s) or deadline_s <= 0:
                 raise ValueError(f"deadline_s must be a finite value > 0 "
                                  f"(got {raw_deadline!r})")
+        rc = self._rescache
+        if rc is not None:
+            # result-reuse tier (service/resultcache.py): a request
+            # served from a completed cache entry, or coalesced onto an
+            # identical in-flight job, never reaches the queue; a miss
+            # registers it as a prospective coalescing leader and falls
+            # through to normal cold admission
+            if rc.intercept(req, priority, deadline_s) is not None:
+                return
+        enqueued = False
+        try:
+            enqueued = self._admit(req, priority, deadline_s)
+        finally:
+            if rc is not None and not enqueued:
+                # the prospective-leader registration from intercept()
+                # must die with the failed admission, or later identical
+                # requests would attach to a uid that never runs
+                rc.admit_aborted(req.uid)
+        if enqueued:
+            return
+        # shutdown() already enqueued the worker sentinels; a request
+        # enqueued now would never be dequeued (workers exit on the
+        # sentinel) and would sit "started" forever — the exact state
+        # the drain exists to prevent.  Record the durable failure
+        # here, same status shape as the drained-backlog path.
+        if self._lease is not None:
+            try:
+                self._lease.retract_admission(req.uid)
+            except Exception:
+                pass
+        _record_failure(self.store, req.uid,
+                        RuntimeError("service shutting down"),
+                        keep_frontier=True, lease_mgr=self._lease,
+                        rescache=rc)
+
+    def _admit(self, req: ServiceRequest, priority: str,
+               deadline_s: Optional[float]) -> bool:
+        """The cold admission path (conflict check → lease → queue slot
+        → journal intent → enqueue), split out of :meth:`submit` so the
+        result-reuse bookkeeping wraps it in one try/finally.  Returns
+        whether the request was enqueued (False only while shutting
+        down)."""
         enqueued = False
         with self._admit_lock:
             # the conflict check and the journal intent that makes the
@@ -717,6 +778,13 @@ class Miner:
             obs.flush_trace(req.uid)
             with self._stop_lock:
                 if not self._stopping:
+                    if self._rescache is not None:
+                        # promote the prospective coalescing leader
+                        # strictly BEFORE the enqueue: a follower may
+                        # attach the instant the key is visible, and
+                        # the worker that will run this request is
+                        # guaranteed to fan out (or re-dispatch) it
+                        self._rescache.leader_admitted(req.uid)
                     # enqueued strictly BEFORE the sentinels (the lock
                     # orders us against shutdown), so a worker will
                     # dequeue it: either it runs, or the drain check
@@ -744,21 +812,7 @@ class Miner:
         finally:
             if not enqueued:
                 self._q.abort()  # reservation never became a queued job
-        if enqueued:
-            return
-        # shutdown() already enqueued the worker sentinels; a request
-        # enqueued now would never be dequeued (workers exit on the
-        # sentinel) and would sit "started" forever — the exact state
-        # the drain exists to prevent.  Record the durable failure
-        # here, same status shape as the drained-backlog path.
-        if self._lease is not None:
-            try:
-                self._lease.retract_admission(req.uid)
-            except Exception:
-                pass
-        _record_failure(self.store, req.uid,
-                        RuntimeError("service shutting down"),
-                        keep_frontier=True, lease_mgr=self._lease)
+        return enqueued
 
     def _loop(self) -> None:
         while True:
@@ -776,6 +830,10 @@ class Miner:
                 ctl = self._lease.attached_ctl(req.uid)
                 self._lease.stolen_from_us(req.uid)
                 jobctl.release_entry(ctl)
+                if self._rescache is not None:
+                    # the thief runs (and fans out) elsewhere: local
+                    # followers re-dispatch as cold mines
+                    self._rescache.on_leader_terminal(req.uid)
                 continue
             if self._stopping:
                 # draining: do NOT start queued backlog jobs — give each a
@@ -785,7 +843,8 @@ class Miner:
                 # progress stays resumable after the restart)
                 _record_failure(self.store, req.uid,
                                 RuntimeError("service shutting down"),
-                                keep_frontier=True, lease_mgr=self._lease)
+                                keep_frontier=True, lease_mgr=self._lease,
+                                rescache=self._rescache)
                 continue
             ctl = jobctl.get(req.uid)
             try:
@@ -794,7 +853,8 @@ class Miner:
                 jobctl.check_entry(ctl)
             except jobctl.JobAborted as exc:
                 _record_failure(self.store, req.uid, exc,
-                                keep_frontier=True, lease_mgr=self._lease)
+                                keep_frontier=True, lease_mgr=self._lease,
+                                rescache=self._rescache)
                 continue
             # Clear again at run start: with a reused uid, an EARLIER job
             # with the same uid may have written its error/results after
@@ -808,7 +868,8 @@ class Miner:
                     str(config.get_config().service.job_retries)))
             except ValueError as exc:  # malformed param: fail like any
                 _record_failure(self.store, req.uid, exc,  # other bad param
-                                lease_mgr=self._lease)
+                                lease_mgr=self._lease,
+                                rescache=self._rescache)
                 continue
             with self._running_lock:
                 self._running += 1
@@ -838,19 +899,22 @@ class Miner:
                 # resuming it — the fenced _record_failure writes
                 # nothing there)
                 _record_failure(self.store, req.uid, exc,
-                                keep_frontier=True, lease_mgr=self._lease)
+                                keep_frontier=True, lease_mgr=self._lease,
+                                rescache=self._rescache)
                 break
             except ValueError as exc:  # bad params / bad source: the
                 # failure is deterministic (SourceError included) — a
                 # re-run would just repeat it, so fail immediately
                 _record_failure(self.store, req.uid, exc,
-                                lease_mgr=self._lease)
+                                lease_mgr=self._lease,
+                                rescache=self._rescache)
                 break
             except Exception as exc:  # supervision: retry, then failure
                 attempt += 1
                 if attempt > max(0, retries):
                     _record_failure(self.store, req.uid, exc,
-                                    lease_mgr=self._lease)
+                                    lease_mgr=self._lease,
+                                    rescache=self._rescache)
                     break
                 self.store.incr("fsm:metric:jobs_retried")
                 log_event("job_retry", uid=req.uid, attempt=attempt,
@@ -893,6 +957,11 @@ class Miner:
         jobctl.check()
         if self._lease is not None:
             self._lease.fence(req.uid)
+        if self._rescache is not None:
+            # content-addressed dataset fingerprint, once per load:
+            # stamped on the control entry (the cache-entry key) and
+            # learned into the stable-source map (never raises)
+            self._rescache.note_dataset(req, db, ctl)
         self.store.add_status(req.uid, Status.DATASET)
         plugin = plugins.get_plugin(req)
         stats: Dict[str, object] = {
@@ -928,6 +997,12 @@ class Miner:
             _sink_results(self.store, req.uid, plugin.kind, results)
             self.store.add_status(req.uid, Status.TRAINED)
             self.store.add_status(req.uid, Status.FINISHED)
+        if self._rescache is not None:
+            # result-reuse tier: publish the cache entry and fan the
+            # durable result out to coalesced followers — while the
+            # leader's lease is STILL HELD, so both ride the fenced
+            # write path; never raises (the job is already green)
+            self._rescache.on_finished(req, ctl, plugin, results, stats)
         if ckpt is not None:
             # only AFTER the results are durable: a sink failure retried
             # mid-way must resume from the final frontier, not re-mine.
@@ -1614,7 +1689,7 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
         # keep_frontier: a recovery resubmit that shed (tiny queue at
         # boot) must not destroy the very progress it failed to resume
         _record_failure(store, uid, failure, keep_frontier=True,
-                        lease_mgr=mgr)
+                        lease_mgr=mgr, rescache=miner._rescache)
         report["failed"].append(uid)
         _RECOVERY_TOTAL.inc(outcome="failed")
     if any(report.values()):

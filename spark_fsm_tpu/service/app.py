@@ -56,6 +56,9 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         queue-wait/execution split, over a sliding
                         window ([observability] slo_window_s) — the
                         service-side counterpart of bench_throughput;
+  /admin/rescache     — result-reuse tier stats (service/resultcache.py):
+                        hit/coalesce/dominated-serve counters, resident
+                        cache bytes, in-flight coalescing registry;
   /admin/cancel/{uid} — abort a live (queued or running) train job at
                         its next safe point; 404 when no live job owns
                         the uid
@@ -329,6 +332,14 @@ class FsmHandler(BaseHTTPRequestHandler):
                 from spark_fsm_tpu.service import obsplane
 
                 self._send(200, json.dumps(obsplane.slo_snapshot()))
+            elif task == "rescache":
+                # result-reuse tier stats (service/resultcache.py):
+                # counters, resident entries/bytes, in-flight
+                # coalescing registry — {"enabled": false} when the
+                # boot config leaves the tier off
+                rc = self.master.miner._rescache
+                self._send(200, json.dumps(
+                    {"enabled": False} if rc is None else rc.stats()))
             elif task == "shapes":
                 # enumerated (last prewarm) vs runtime-recorded shape
                 # keys; "drift" lists observed geometries prewarm missed
@@ -411,6 +422,11 @@ def service_stats(master: Master) -> dict:
         # cross-job launch fusion (service/fusion.py): broker counters
         # plus the live window policy (canonical series: fsm_fusion_*)
         "fusion": _fusion_stats(),
+        # result-reuse tier (service/resultcache.py): hit/coalesce/
+        # dominated-serve counters + resident bytes (canonical series:
+        # fsm_rescache_*); None when [rescache] is off
+        "rescache": (None if master.miner._rescache is None
+                     else master.miner._rescache.stats()),
         # warm-path observability: distinct compiled geometries seen,
         # plus the last prewarm's per-key compile walls (if any ran)
         "shape_keys_recorded": len(shapereg.recorded()),

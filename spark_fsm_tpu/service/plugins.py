@@ -315,3 +315,44 @@ def get_plugin(req: ServiceRequest) -> AlgorithmPlugin:
         raise ValueError(f"unknown algorithm {name!r} "
                          f"(have {sorted(ALGORITHMS)})")
     return ALGORITHMS[name]
+
+
+def effective_params(req: ServiceRequest,
+                     n_sequences: Optional[int] = None) -> dict:
+    """The request's RESULT-AFFECTING parameters, normalized — the one
+    vocabulary the result-reuse tier (service/resultcache.py) keys
+    coalescing identity and dominance predicates on.  Two requests with
+    equal dicts here (and equal dataset fingerprints) provably mine the
+    same result set; engine-routing knobs (fused/resident/use_pallas),
+    supervision knobs (retries/deadline_s/priority/checkpoint) and the
+    uid are deliberately EXCLUDED — they change scheduling, never
+    output (the engines' parity contract).
+
+    Pattern algorithms (SPADE/SPADE_TPU): ``support`` as given (float),
+    plus ``minsup_abs`` resolved to the absolute count when the value
+    is already absolute (>= 1) or ``n_sequences`` is known — the
+    comparable form dominance needs.  Rule algorithms (TSR/TSR_TPU):
+    ``k``, ``minconf`` (float; compared exactly via Fraction at serve
+    time), ``max_side``.  Raises ValueError on malformed params, same
+    as the plugins themselves would.
+    """
+    plugin = get_plugin(req)
+    if plugin.kind == "rules":
+        k, minconf, max_side = _tsr_params(req)
+        if k < 1:
+            raise ValueError(f"k must be >= 1 (got {k})")
+        return {"algo": plugin.name, "kind": plugin.kind, "k": k,
+                "minconf": minconf, "max_side": max_side}
+    support = req.param("support")
+    if support is None:
+        raise ValueError("train request needs a 'support' parameter")
+    rel = float(support)
+    minsup_abs: Optional[int] = None
+    if rel >= 1.0:
+        minsup_abs = int(rel)
+    elif n_sequences is not None:
+        minsup_abs = abs_minsup(rel, n_sequences)
+    maxgap, maxwindow = _constraints(req)
+    return {"algo": plugin.name, "kind": plugin.kind, "support": rel,
+            "minsup_abs": minsup_abs, "maxgap": maxgap,
+            "maxwindow": maxwindow}

@@ -92,6 +92,14 @@ KNOWN_SITES = (
     "lease.steal",       # work-steal claim on a peer's queued job —
                          # injection must abort the steal cleanly: the
                          # job stays with (and finishes on) the victim
+    "rescache.lookup",   # result-reuse lookup at admission
+                         # (service/resultcache.py) — injection must
+                         # degrade the request to a plain cold mine
+                         # with oracle parity, never fail the submit
+    "rescache.store",    # cache-entry store / fingerprint learn after a
+                         # finished mine — injection must leave the job
+                         # green (results already durable); only the
+                         # reuse entry is lost
 )
 
 _EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}

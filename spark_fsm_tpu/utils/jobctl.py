@@ -91,7 +91,8 @@ class JobControl:
     it)."""
 
     __slots__ = ("uid", "deadline", "cancelled", "running", "priority",
-                 "lease_lost", "submitted_t", "started_t")
+                 "lease_lost", "submitted_t", "started_t", "dataset_fp",
+                 "follower_of")
 
     def __init__(self, uid: str, deadline: Optional[float],
                  priority: str = "normal"):
@@ -99,6 +100,14 @@ class JobControl:
         self.deadline = deadline  # absolute time.monotonic(), or None
         self.cancelled = False
         self.running = False  # False = still queued (set by activate())
+        # result-reuse tier (service/resultcache.py): the content-
+        # addressed fingerprint of the job's resolved dataset, stamped
+        # once at dataset load (None until then / when the tier is off)
+        self.dataset_fp: Optional[str] = None
+        # follower linkage: set to the leader uid when this entry is a
+        # coalesced follower awaiting fan-out instead of a queued job —
+        # its deadline/cancel signals are honored at fan-out time
+        self.follower_of: Optional[str] = None
         # admission class ("high"/"normal"/"low") — read by the fusion
         # broker's window rule (a high job's waves never wait for fill)
         self.priority = priority

@@ -1077,3 +1077,66 @@ def test_resident_kill_restart_resumes_persisted_frontier():
     assert eng2.stats["resumed_nodes"] == len(state["stack"])
     assert eng2.stats.get("resident_rounds", 0) >= 1, eng2.stats
     assert rules_text(got) == rules_text(want)
+
+
+# -------------------------------------------- result-reuse tier (ISSUE 12)
+
+
+@covers("rescache.lookup")
+def test_rescache_lookup_fault_degrades_to_cold_mine():
+    """An injected failure in the reuse lookup must cost only the
+    reuse: the request mines COLD with oracle parity, the submit never
+    fails, and no uid is left live (zero stuck followers)."""
+    old_cfg = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"rescache": {"enabled": True}}))
+    try:
+        db = _rule_db()
+        data = {"algorithm": "TSR", "source": "INLINE",
+                "sequences": format_spmf(db), "k": "5", "minconf": "0.4"}
+        store = ResultStore()
+        # prime the cache with one clean mine
+        _, st = _bounded(lambda: _run_train(
+            store, dict(data, uid="rcl-prime")))
+        assert st == "finished"
+        assert store.get("fsm:stats:rcl-prime") is not None
+        with faults.injected("rescache.lookup", every=1):
+            _, st = _bounded(lambda: _run_train(
+                store, dict(data, uid="rcl-cold")))
+        assert st == "finished"
+        stats = json.loads(store.get("fsm:stats:rcl-cold"))
+        # the lookup died, so the identical request mined cold ...
+        assert "served_from_cache" not in stats
+        # ... with byte-identical results and nothing left live
+        assert store.rules("rcl-cold") == store.rules("rcl-prime")
+        assert store.keys("fsm:journal:") == []
+    finally:
+        cfgmod.set_config(old_cfg)
+
+
+@covers("rescache.store")
+def test_rescache_store_fault_keeps_job_green():
+    """An injected failure storing the cache entry (or learning the
+    fingerprint) must leave the producing job GREEN — its results were
+    already durable; only the reuse entry is lost, so the next
+    identical request mines cold with parity."""
+    old_cfg = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"rescache": {"enabled": True}}))
+    try:
+        db = _rule_db()
+        data = {"algorithm": "TSR", "source": "INLINE",
+                "sequences": format_spmf(db), "k": "5", "minconf": "0.4"}
+        store = ResultStore()
+        with faults.injected("rescache.store", every=1):
+            _, st = _bounded(lambda: _run_train(
+                store, dict(data, uid="rcs-a")))
+        assert st == "finished"
+        # the entry never landed: no rescache keys, and the repeat
+        # request misses (mines cold) with identical output
+        assert store.keys("fsm:rescache:") == []
+        _, st = _bounded(lambda: _run_train(
+            store, dict(data, uid="rcs-b")))
+        assert st == "finished"
+        assert store.rules("rcs-b") == store.rules("rcs-a")
+        assert store.keys("fsm:journal:") == []
+    finally:
+        cfgmod.set_config(old_cfg)

@@ -1,0 +1,467 @@
+"""Result-reuse tier (ISSUE 12, service/resultcache.py): fingerprints,
+dominance-serve parity, coalescing fan-out, recovery, eviction.
+
+The acceptance contract: every cached / coalesced / dominated response
+must be byte-identical (over the canonical text form, utils/canonical)
+to a cold mine at the request's own parameters; deliberately
+NON-dominated requests must MISS and mine cold; a killed leader leaves
+follower journal entries the boot recovery pass settles — never a
+stuck uid.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.data.spmf import fingerprint_db, format_spmf, parse_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.models.oracle import mine_cspade, mine_spade
+from spark_fsm_tpu.models.tsr import mine_tsr_cpu
+from spark_fsm_tpu.service import resultcache, sources
+from spark_fsm_tpu.service.actors import Master, recover_orphans
+from spark_fsm_tpu.service.model import (ServiceRequest,
+                                         deserialize_patterns,
+                                         deserialize_rules)
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils.canonical import patterns_text, rules_text
+
+
+@pytest.fixture
+def rescache_on():
+    """Boot config with the result-reuse tier enabled; restored after."""
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"rescache": {"enabled": True}}))
+    yield cfgmod.get_config()
+    cfgmod.set_config(old)
+
+
+@pytest.fixture
+def blocky_source():
+    """A registered source that blocks dataset load on an Event — the
+    deterministic way to hold a leader in flight while followers
+    attach."""
+    gate = threading.Event()
+
+    def blocky(req, store):
+        assert gate.wait(60), "blocky gate never opened"
+        return parse_spmf(req.param("sequences"))
+
+    sources.register("BLOCKY", blocky)
+    yield gate
+    gate.set()
+    sources.SOURCES.pop("BLOCKY", None)
+
+
+def _db(seed=5, n=60):
+    return synthetic_db(seed=seed, n_sequences=n, n_items=9,
+                        mean_itemsets=3.0, mean_itemset_size=1.2)
+
+
+def _submit(master, uid, text, **params):
+    d = {"algorithm": "TSR_TPU", "source": "INLINE", "sequences": text,
+         "k": "8", "minconf": "0.4", "max_side": "2", "uid": uid}
+    d.update({k: str(v) for k, v in params.items()})
+    resp = master.handle(ServiceRequest("fsm", "train", d))
+    assert resp.status != "failure", resp.data
+    return resp
+
+
+def _wait(store, uid, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = store.status(uid)
+        if st in ("finished", "failure"):
+            return st
+        time.sleep(0.01)
+    raise TimeoutError(f"job {uid} reached no terminal status")
+
+
+def _stats(store, uid):
+    return json.loads(store.get(f"fsm:stats:{uid}") or "{}")
+
+
+# ------------------------------------------------------------- fingerprints
+
+
+def test_fingerprint_canonical_across_spellings():
+    # itemsets dedup + sort in the parser, so spelling variants of the
+    # same content converge on one fingerprint
+    a = parse_spmf("1 3 -1 2 -1 2 4 -2\n5 -1 6 -2\n")
+    b = parse_spmf("3 1 3 -1 2 -1 4 2 -2\n5 -1 6 -1 -2\n")
+    assert fingerprint_db(a) == fingerprint_db(b)
+    c = parse_spmf("1 3 -1 2 -1 2 4 -2\n5 -1 7 -2\n")
+    assert fingerprint_db(a) != fingerprint_db(c)
+    # itemset boundaries matter: <{1,2}> is not <{1},{2}>
+    assert fingerprint_db(parse_spmf("1 2 -2\n")) != \
+        fingerprint_db(parse_spmf("1 -1 2 -2\n"))
+
+
+def test_disabled_by_default_no_instance():
+    master = Master(store=ResultStore())
+    try:
+        assert master.miner._rescache is None
+    finally:
+        master.shutdown()
+
+
+# ------------------------------------------------------- serving + parity
+
+
+def test_exact_hit_and_dominated_tsr_parity(rescache_on):
+    db = _db(seed=31)
+    text = format_spmf(db)
+    store = ResultStore()
+    master = Master(store=store)
+    try:
+        _submit(master, "cold", text)
+        assert _wait(store, "cold") == "finished"
+        assert "served_from_cache" not in _stats(store, "cold")
+
+        # identical request: exact hit, byte-identical canonical text
+        _submit(master, "hit", text)
+        assert _wait(store, "hit") == "finished"
+        assert _stats(store, "hit")["served_from_cache"] == "exact"
+        assert rules_text(deserialize_rules(store.rules("hit"))) == \
+            rules_text(deserialize_rules(store.rules("cold")))
+
+        # dominated: smaller k — must equal a cold mine at k=4
+        _submit(master, "domk", text, k=4)
+        assert _wait(store, "domk") == "finished"
+        assert _stats(store, "domk")["served_from_cache"] == "dominated"
+        oracle = rules_text(mine_tsr_cpu(db, 4, 0.4, max_side=2))
+        assert rules_text(deserialize_rules(store.rules("domk"))) == oracle
+
+        # stricter max_side at FULL k: the conservative predicate may
+        # refuse (the side-filtered top-k could need support-pruned
+        # rules) — served or cold, the answer must match the oracle
+        _submit(master, "doms", text, k=8, max_side=1)
+        assert _wait(store, "doms") == "finished"
+        assert _stats(store, "doms").get("served_from_cache") in (
+            None, "dominated")
+        oracle = rules_text(mine_tsr_cpu(db, 8, 0.4, max_side=1))
+        assert rules_text(deserialize_rules(store.rules("doms"))) == oracle
+
+        # NON-dominated: larger k must MISS (mine cold) and still agree
+        # with the oracle at k=12
+        _submit(master, "bigk", text, k=12)
+        assert _wait(store, "bigk") == "finished"
+        assert "served_from_cache" not in _stats(store, "bigk")
+        oracle = rules_text(mine_tsr_cpu(db, 12, 0.4, max_side=2))
+        assert rules_text(deserialize_rules(store.rules("bigk"))) == oracle
+    finally:
+        master.shutdown()
+
+
+def test_dominated_spade_minsup_parity_and_misses(rescache_on):
+    db = _db(seed=37, n=80)
+    text = format_spmf(db)
+    store = ResultStore()
+    master = Master(store=store)
+    try:
+        _submit(master, "cold", text, algorithm="SPADE_TPU", support=4,
+                k="", minconf="", max_side="")
+        assert _wait(store, "cold") == "finished"
+
+        # higher minsup: filter of the cached set == cold mine
+        _submit(master, "dom", text, algorithm="SPADE_TPU", support=8,
+                k="", minconf="", max_side="")
+        assert _wait(store, "dom") == "finished"
+        assert _stats(store, "dom")["served_from_cache"] == "dominated"
+        oracle = patterns_text(mine_spade(db, 8))
+        assert patterns_text(
+            deserialize_patterns(store.patterns("dom"))) == oracle
+
+        # relative support resolving to a dominated absolute count
+        _submit(master, "domrel", text, algorithm="SPADE_TPU",
+                support=0.1, k="", minconf="", max_side="")
+        assert _wait(store, "domrel") == "finished"
+        assert _stats(store, "domrel")["served_from_cache"] == "dominated"
+        oracle = patterns_text(mine_spade(db, 8))  # ceil(0.1*80) = 8
+        assert patterns_text(
+            deserialize_patterns(store.patterns("domrel"))) == oracle
+
+        # NON-dominated: LOWER minsup must miss (cached run pruned)
+        _submit(master, "low", text, algorithm="SPADE_TPU", support=2,
+                k="", minconf="", max_side="")
+        assert _wait(store, "low") == "finished"
+        assert "served_from_cache" not in _stats(store, "low")
+        assert patterns_text(
+            deserialize_patterns(store.patterns("low"))) == \
+            patterns_text(mine_spade(db, 2))
+
+        # NON-dominated: stricter maxgap must miss — supports change
+        # under constraints, filtering cannot reproduce them
+        _submit(master, "gap", text, algorithm="SPADE_TPU", support=4,
+                maxgap=1, k="", minconf="", max_side="")
+        assert _wait(store, "gap") == "finished"
+        assert "served_from_cache" not in _stats(store, "gap")
+        assert patterns_text(
+            deserialize_patterns(store.patterns("gap"))) == \
+            patterns_text(mine_cspade(db, 4, maxgap=1, maxwindow=None))
+    finally:
+        master.shutdown()
+
+
+def test_rules_dominance_threshold_guard_unit():
+    """The TSR predicate's conservative core: a higher minconf is served
+    only when the re-derived tie-inclusive threshold stays >= the
+    cached run's own s_k — otherwise support-pruned rules could enter
+    the weaker top-k and the serve must refuse."""
+    ent = {
+        "algo": "TSR_TPU", "kind": "rules",
+        "params": {"algo": "TSR_TPU", "kind": "rules", "k": 2,
+                   "minconf": 0.4, "max_side": None},
+        "n_sequences": 20, "uid": "u",
+        # A(sup 10, conf .5), B(sup 9, conf .5) — cached top-2 at .4;
+        # an unseen rule C(sup 8, conf .9) was support-pruned (s_k0=9)
+        "payload": json.dumps([
+            {"antecedent": [1], "consequent": [2], "support": 10,
+             "antecedent_support": 20},
+            {"antecedent": [3], "consequent": [4], "support": 9,
+             "antecedent_support": 18},
+        ]),
+    }
+
+    def want(k, minconf, max_side=None):
+        return {"algo": "TSR_TPU", "kind": "rules", "k": k,
+                "minconf": minconf, "max_side": max_side}
+
+    # same k, higher minconf: filtered set is empty at .8 — but the
+    # cached run was NOT exhaustive (len == k), so the full qualifying
+    # set at .8 was never materialized: REFUSE
+    assert resultcache._servable(ent, want(2, 0.8)) is None
+    # k=1 at the same minconf: s_k1 = 10 >= s_k0 = 9 — servable
+    payload, mode, n = resultcache._servable(ent, want(1, 0.4))
+    assert mode == "dominated" and n == 1
+    assert deserialize_rules(payload)[0][2] == 10
+    # k=1 at minconf .5: both rules qualify, s_k1 = 10 >= 9 — servable
+    payload, mode, n = resultcache._servable(ent, want(1, 0.5))
+    assert mode == "dominated" and n == 1
+    # exact match serves verbatim
+    payload, mode, n = resultcache._servable(ent, want(2, 0.4))
+    assert mode == "exact" and payload == ent["payload"]
+    # larger k always misses
+    assert resultcache._servable(ent, want(3, 0.4)) is None
+    # lower minconf always misses
+    assert resultcache._servable(ent, want(2, 0.3)) is None
+
+    # EXHAUSTIVE cached run (found < k rules): any smaller-or-equal k
+    # and higher minconf is servable — nothing was support-pruned
+    ent_ex = dict(ent)
+    ent_ex["params"] = dict(ent["params"], k=5)
+    payload, mode, n = resultcache._servable(ent_ex, want(5, 0.5))
+    assert mode == "dominated" and n == 2
+    payload, mode, n = resultcache._servable(ent_ex, want(2, 0.8))
+    assert mode == "dominated" and n == 0
+
+    # stricter max_side: servable when the side-filtered set still
+    # clears the cached threshold (both cached rules have singleton
+    # sides, so the filter drops nothing and s_k1 = s_k0)
+    payload, mode, n = resultcache._servable(ent, want(2, 0.4,
+                                                       max_side=1))
+    assert mode == "dominated" and n == 2
+    # looser side bound than cached always misses (unexplored rules)
+    ent_side = dict(ent)
+    ent_side["params"] = dict(ent["params"], max_side=1)
+    assert resultcache._servable(ent_side, want(1, 0.4)) is None
+    assert resultcache._servable(ent_side, want(1, 0.4,
+                                                max_side=2)) is None
+
+
+# ------------------------------------------------------------- coalescing
+
+
+def test_coalescing_fanout(rescache_on, blocky_source):
+    db = _db(seed=41)
+    text = format_spmf(db)
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        # the blocker pins the single worker inside its dataset load,
+        # so the leader stays QUEUED while followers attach
+        _submit(master, "blk", format_spmf(_db(seed=42)),
+                source="BLOCKY")
+        _submit(master, "L", text)
+        _submit(master, "F1", text)
+        _submit(master, "F2", text)
+        st = master.miner._rescache.stats()
+        assert st["inflight_followers"] == 2, st
+        # each follower is journaled while in flight (crash recovery)
+        for uid in ("F1", "F2"):
+            entry = json.loads(store.journal_get(uid))
+            assert entry["coalesced_into"] == "L"
+        blocky_source.set()
+        for uid in ("blk", "L", "F1", "F2"):
+            assert _wait(store, uid) == "finished", uid
+        # fan-out delivery: byte-identical payloads, own stats/journal
+        assert store.rules("F1") == store.rules("L")
+        assert store.rules("F2") == store.rules("L")
+        for uid in ("F1", "F2"):
+            assert _stats(store, uid)["coalesced_into"] == "L"
+            assert store.journal_get(uid) is None
+    finally:
+        master.shutdown()
+
+
+def test_leader_cancel_redispatches_followers(rescache_on, blocky_source):
+    db = _db(seed=43)
+    text = format_spmf(db)
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        _submit(master, "blk", format_spmf(_db(seed=44)),
+                source="BLOCKY")
+        _submit(master, "L", text)
+        _submit(master, "F", text)
+        assert master.miner._rescache.stats()["inflight_followers"] == 1
+        # cancel the LEADER while queued: its client's abort must not
+        # take the follower down — F re-dispatches as a cold mine
+        assert master.cancel("L") == "queued"
+        blocky_source.set()
+        assert _wait(store, "blk") == "finished"
+        assert _wait(store, "L") == "failure"
+        assert "CANCELLED" in store.get("fsm:error:L")
+        assert _wait(store, "F") == "finished"
+        oracle = rules_text(mine_tsr_cpu(db, 8, 0.4, max_side=2))
+        assert rules_text(deserialize_rules(store.rules("F"))) == oracle
+        assert store.journal_get("F") is None
+    finally:
+        master.shutdown()
+
+
+def test_cancelled_follower_not_revived_by_leader_teardown(
+        rescache_on, blocky_source):
+    """A follower whose OWN cancel was acknowledged must settle as
+    CANCELLED when its leader aborts — the cold re-dispatch path must
+    not resurrect it with a fresh control entry."""
+    db = _db(seed=47)
+    text = format_spmf(db)
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        _submit(master, "blk", format_spmf(_db(seed=48)),
+                source="BLOCKY")
+        _submit(master, "L", text)
+        _submit(master, "F", text)
+        assert master.cancel("F") == "queued"  # follower's own cancel
+        assert master.cancel("L") == "queued"  # then the leader aborts
+        blocky_source.set()
+        assert _wait(store, "blk") == "finished"
+        assert _wait(store, "L") == "failure"
+        assert _wait(store, "F") == "failure"
+        assert "CANCELLED" in store.get("fsm:error:F")
+        assert store.journal_get("F") is None
+    finally:
+        master.shutdown()
+
+
+def test_follower_recovery_after_kill():
+    """kill -9 of the process mid-coalesce: the follower's journal
+    entry (written at attach) is all recovery needs — the boot pass
+    settles it durably, never a stuck uid."""
+    store = ResultStore()
+    req = {"algorithm": "TSR_TPU", "source": "INLINE",
+           "sequences": "1 -1 2 -2\n", "k": "4", "minconf": "0.4"}
+    for uid, extra in (("dead-L", {}),
+                       ("dead-F", {"coalesced_into": "dead-L"})):
+        store.journal_set(uid, json.dumps({
+            "uid": uid, "incarnation": "dead-incarnation",
+            "replica": None, "ts": time.time(), "checkpoint": False,
+            "priority": "normal", "request": dict(req, uid=uid),
+            **extra}))
+        store.add_status(uid, "started")
+    master = Master(store=store)
+    try:
+        report = recover_orphans(master)
+        assert set(report["failed"]) == {"dead-L", "dead-F"}
+        for uid in ("dead-L", "dead-F"):
+            assert store.status(uid) == "failure"
+            assert "interrupted by restart" in store.get(f"fsm:error:{uid}")
+            assert store.journal_get(uid) is None
+    finally:
+        master.shutdown()
+
+
+# ------------------------------------------------------- knobs + eviction
+
+
+def test_lru_eviction_by_byte_budget():
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config(
+        {"rescache": {"enabled": True, "max_bytes": 1}}))
+    try:
+        store = ResultStore()
+        master = Master(store=store)
+        try:
+            before = resultcache._EVICTIONS.total()
+            _submit(master, "a", format_spmf(_db(seed=51, n=30)), k=4)
+            assert _wait(store, "a") == "finished"
+            # a 1-byte budget evicts every entry it stores
+            assert store.keys("fsm:rescache:") == []
+            assert resultcache._EVICTIONS.total() > before
+            # and the SAME request now misses — mines cold, still green
+            _submit(master, "b", format_spmf(_db(seed=51, n=30)), k=4)
+            assert _wait(store, "b") == "finished"
+            assert "served_from_cache" not in _stats(store, "b")
+        finally:
+            master.shutdown()
+    finally:
+        cfgmod.set_config(old)
+
+
+def test_dominance_and_coalesce_flags_off():
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config(
+        {"rescache": {"enabled": True, "dominance": False,
+                      "coalesce": False}}))
+    try:
+        store = ResultStore()
+        master = Master(store=store)
+        try:
+            text = format_spmf(_db(seed=53, n=30))
+            _submit(master, "a", text, k=4)
+            assert _wait(store, "a") == "finished"
+            _submit(master, "b", text, k=4)
+            assert _wait(store, "b") == "finished"
+            # both layers off: identical request mines cold
+            assert "served_from_cache" not in _stats(store, "b")
+            assert store.rules("a") == store.rules("b")
+        finally:
+            master.shutdown()
+    finally:
+        cfgmod.set_config(old)
+
+
+def test_cluster_mode_serve_and_coalesce(rescache_on, blocky_source):
+    """Followers and serves hold their own fenced leases in cluster
+    mode; everything still settles and the journal namespace drains."""
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({
+        "rescache": {"enabled": True},
+        "cluster": {"enabled": True, "replica_id": "rc-test",
+                    "lease_ttl_s": 30.0}}))
+    try:
+        store = ResultStore()
+        master = Master(store=store, miner_workers=1)
+        try:
+            text = format_spmf(_db(seed=61, n=40))
+            _submit(master, "blk", format_spmf(_db(seed=62, n=40)),
+                    source="BLOCKY")
+            _submit(master, "L", text)
+            _submit(master, "F", text)
+            blocky_source.set()
+            for uid in ("blk", "L", "F"):
+                assert _wait(store, uid) == "finished", uid
+            assert _stats(store, "F")["coalesced_into"] == "L"
+            _submit(master, "hit", text)
+            assert _wait(store, "hit") == "finished"
+            assert _stats(store, "hit")["served_from_cache"] == "exact"
+            assert store.keys("fsm:journal:") == []
+            assert master.miner._lease.held_uids() == []
+        finally:
+            master.shutdown()
+    finally:
+        cfgmod.set_config(old)
