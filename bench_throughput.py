@@ -68,6 +68,18 @@ EXPECT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 COMPARED = ("jobs", "parity", "forced_cross_job", "modeled_2x",
             "degraded", "sheds", "failures", "slo_consistent")
 
+# --mix tenants (ISSUE 13): the elastic-control-plane success metric —
+# a 2-replica fleet with weighted-fair admission, one flooding tenant
+# and two background tenants (equal weights).  Structural guards: each
+# background tenant's served-jobs/s >= 0.5x its weight-fair share of
+# the fleet's throughput AND its p99 within 2x of its solo run (+0.25s
+# additive slack — walls are noisy, the guard catches starvation, not
+# jitter); a forced scale-down mid-flood drains one replica with ZERO
+# lost or duplicated jobs and byte-exact per-dataset parity.
+TENANTS_COMPARED = ("tenants_jobs", "tenants_parity",
+                    "tenants_fair_share_ok", "tenants_p99_ok",
+                    "tenants_drain_ok")
+
 # --mix zipf (ISSUE 12): the result-reuse tier's success metric — a
 # realistic zipf-distributed request mix (hot datasets + dominated
 # parameter variants), cold vs cached, with structural guards: per-
@@ -413,14 +425,254 @@ def main_zipf(update: bool, n_jobs: int, workers: int) -> int:
     return 0
 
 
+TEN_WORKERS = int(os.environ.get("SPARKFSM_TP_TEN_WORKERS", "2"))
+TEN_FLOOD = int(os.environ.get("SPARKFSM_TP_TEN_FLOOD", "36"))
+TEN_BG = int(os.environ.get("SPARKFSM_TP_TEN_BG", "8"))
+
+
+def _tenant_fleet(workers):
+    """2-replica in-process fleet on one shared store: real lease
+    managers with REAL heartbeat threads (steal is the transport the
+    drain phase rides), fairness from the active process config."""
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.lease import LeaseManager
+    from spark_fsm_tpu.service.store import ResultStore
+
+    store = ResultStore()
+    mgrs = [LeaseManager(store, replica_id=f"bench-{i}",
+                         lease_ttl_s=6.0, heartbeat_s=0.25)
+            for i in range(2)]
+    masters = [Master(store=store, miner_workers=workers, lease_mgr=m)
+               for m in mgrs]
+    return store, masters
+
+
+def _tenant_run(dbs, plan, workers, label, drain_b_after_submit=False):
+    """Run a (tenant, db_i) submission plan through a fresh 2-replica
+    fleet; returns (rows, summary).  ``drain_b_after_submit`` drives
+    the forced scale-down: replica B drains right after the submits
+    land and its backlog must finish on A via the steal protocol."""
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.service.model import ServiceRequest
+
+    store, masters = _tenant_fleet(workers)
+    spmf = [format_spmf(db) for db in dbs]
+    drain_report = {}
+    try:
+        t0 = time.monotonic()
+        t_submit, done, meta = {}, {}, {}
+        sheds = 0
+        for i, (tenant, db_i) in enumerate(plan):
+            uid = f"tn-{label}-{i}"
+            target = masters[1] if drain_b_after_submit \
+                else masters[i % 2]
+            resp = target.handle(ServiceRequest("fsm", "train", {
+                "algorithm": "TSR_TPU", "source": "INLINE",
+                "sequences": spmf[db_i], "k": "6", "minconf": "0.4",
+                "max_side": "2", "uid": uid, "tenant": tenant}))
+            if resp.status == "failure":
+                sheds += 1
+                continue
+            t_submit[uid] = time.monotonic()
+            meta[uid] = (tenant, db_i)
+        if drain_b_after_submit:
+            drain_report = masters[1].miner.drain(
+                timeout_s=120.0, reason="bench forced scale-down")
+        deadline = time.monotonic() + DEADLINE_S
+        failures = 0
+        while t_submit.keys() - done.keys() \
+                and time.monotonic() < deadline:
+            for uid in list(t_submit.keys() - done.keys()):
+                st = store.status(uid)
+                if st in ("finished", "failure"):
+                    done[uid] = (time.monotonic(), st)
+                    if st == "failure":
+                        failures += 1
+            time.sleep(0.002)
+        pending = t_submit.keys() - done.keys()
+        if pending:
+            raise TimeoutError(
+                f"tenants-{label}: {len(pending)} jobs never finished")
+        wall = time.monotonic() - t0
+        rows, by_tenant = {}, {}
+        for uid, (tenant, db_i) in meta.items():
+            rows[uid] = (db_i, store.rules(uid))
+            by_tenant.setdefault(tenant, []).append(
+                (t_submit[uid], done[uid][0]))
+        q = lambda xs, p: sorted(xs)[
+            min(len(xs) - 1, int(p * (len(xs) - 1)))]
+        tenants = {}
+        for tenant, spans in by_tenant.items():
+            lats = [d - s for s, d in spans]
+            # the tenant's goodput window: first submit to ITS last
+            # finish — the rate the fair-share guard compares
+            span_wall = max(d for _, d in spans) - min(
+                s for s, _ in spans)
+            tenants[tenant] = {
+                "jobs": len(spans),
+                "jobs_per_sec": round(
+                    len(spans) / max(1e-9, span_wall), 3),
+                "p50_s": round(q(lats, 0.50), 4),
+                "p99_s": round(q(lats, 0.99), 4)}
+        summary = {"jobs": len(done), "wall_s": round(wall, 3),
+                   "jobs_per_sec": round(len(done) / wall, 2),
+                   "tenants": tenants, "sheds": sheds,
+                   "failures": failures}
+        if drain_report:
+            summary["drain"] = drain_report
+        return rows, summary
+    finally:
+        for m in masters:
+            m.shutdown()
+
+
+def main_tenants(update: bool, workers: int) -> int:
+    """--mix tenants: the ISSUE 13 fairness + scale-down metric."""
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.ops import ragged_batch as RB
+    from spark_fsm_tpu.utils import jitcache
+
+    RB.set_overhead_calibration(False)
+    jitcache.enable_compile_counter()
+    dbs = _datasets()
+
+    bg_plan = [(t, (i * 2 + k) % N_DATASETS)
+               for i in range(TEN_BG)
+               for k, t in enumerate(("bg1", "bg2"))]
+    flood_plan = [("flood", i % N_DATASETS) for i in range(TEN_FLOOD)]
+    mixed_plan = flood_plan + bg_plan  # flood lands FIRST: FIFO would
+    # queue every background job behind the whole flood
+
+    old_cfg = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config(
+        {"fairness": {"enabled": True}}))
+    try:
+        # compile-warm to stability (the same arbiter as the other
+        # mixes: a timed phase must not pay fresh XLA compiles)
+        for i in range(6):
+            before = jitcache.compile_counts()["count"]
+            _tenant_run(dbs, mixed_plan, workers, f"warm-{i}")
+            if jitcache.compile_counts()["count"] == before:
+                break
+
+        def med(runs, pick):
+            vals = sorted(pick(r) for r in runs)
+            return vals[len(vals) // 2]
+
+        solo_runs, mixed_runs = [], []
+        rows_all = {}
+        for i in range(N_RUNS):
+            rows, s = _tenant_run(dbs, bg_plan, workers, f"solo-{i}")
+            rows_all.update(rows)
+            solo_runs.append(s)
+        for i in range(N_RUNS):
+            rows, s = _tenant_run(dbs, mixed_plan, workers,
+                                  f"mixed-{i}")
+            rows_all.update(rows)
+            mixed_runs.append(s)
+
+        # forced scale-down: everything lands on B, B drains at once,
+        # A must steal the backlog — zero lost, zero duplicated
+        drain_rows, drain_sum = _tenant_run(
+            dbs, mixed_plan[:12], workers, "drain",
+            drain_b_after_submit=True)
+        rows_all.update(drain_rows)
+
+        # per-dataset parity across every phase/tenant/replica: one
+        # byte-exact rule set per dataset index
+        by_db = {}
+        for db_i, rules in rows_all.values():
+            by_db.setdefault(db_i, set()).add(rules)
+        parity = all(len(v) == 1 for v in by_db.values())
+
+        total_jps = med(mixed_runs, lambda r: r["jobs_per_sec"])
+        fair_ok, p99_ok = True, True
+        bg_report = {}
+        for t in ("bg1", "bg2"):
+            mixed_jps = med(mixed_runs,
+                            lambda r: r["tenants"][t]["jobs_per_sec"])
+            solo_p99 = med(solo_runs,
+                           lambda r: r["tenants"][t]["p99_s"])
+            mixed_p99 = med(mixed_runs,
+                            lambda r: r["tenants"][t]["p99_s"])
+            # equal weights, three backlogged tenants: fair share is a
+            # third of the fleet's served rate
+            fair_share = total_jps / 3.0
+            fair_ok = fair_ok and mixed_jps >= 0.5 * fair_share
+            p99_ok = p99_ok and mixed_p99 <= 2.0 * solo_p99 + 0.25
+            bg_report[t] = {
+                "mixed_jobs_per_sec": mixed_jps,
+                "fair_share_jobs_per_sec": round(fair_share, 3),
+                "solo_p99_s": solo_p99, "mixed_p99_s": mixed_p99}
+
+        drain = drain_sum.get("drain", {})
+        drain_ok = (drain_sum["failures"] == 0
+                    and drain_sum["sheds"] == 0
+                    and drain_sum["jobs"] == 12
+                    and drain.get("left_for_recovery", 1) == 0
+                    and drain.get("stolen_by_peers", 0) >= 1
+                    and parity)
+
+        out = {
+            "tenants_jobs": len(mixed_plan), "workers": workers,
+            "tenants_parity": parity,
+            "tenants_fair_share_ok": bool(fair_ok),
+            "tenants_p99_ok": bool(p99_ok),
+            "tenants_drain_ok": bool(drain_ok),
+            "tenants": {
+                "total_jobs_per_sec": total_jps,
+                "background": bg_report,
+                "flood_p99_s": med(
+                    mixed_runs,
+                    lambda r: r["tenants"]["flood"]["p99_s"]),
+                "mixed_runs_jobs_per_sec": [
+                    r["jobs_per_sec"] for r in mixed_runs],
+                "drain": {**drain,
+                          "jobs": drain_sum["jobs"],
+                          "failures": drain_sum["failures"]},
+            },
+        }
+    finally:
+        cfgmod.set_config(old_cfg)
+    print(json.dumps(out, indent=2))
+
+    try:
+        with open(EXPECT_PATH) as fh:
+            expect = json.load(fh)
+    except OSError:
+        expect = {}
+    if update:
+        expect.update({k: out[k] for k in TENANTS_COMPARED})
+        with open(EXPECT_PATH, "w") as fh:
+            json.dump(expect, fh, indent=2)
+            fh.write("\n")
+        print(f"bench_throughput: tenants expectations written -> "
+              f"{EXPECT_PATH}")
+        return 0
+    bad = [k for k in TENANTS_COMPARED if out.get(k) != expect.get(k)]
+    if bad:
+        for k in bad:
+            print(f"bench_throughput[tenants]: MISMATCH {k}: got "
+                  f"{out.get(k)!r}, expected {expect.get(k)!r}",
+                  file=sys.stderr)
+        return 1
+    print(f"bench_throughput[tenants]: OK (fleet "
+          f"{out['tenants']['total_jobs_per_sec']} jobs/s; background "
+          f"tenants at >= 0.5x fair share with p99 within 2x of solo; "
+          f"forced scale-down stole "
+          f"{drain.get('stolen_by_peers')} jobs with zero "
+          f"lost/duplicated — walls reported, guards structural)")
+    return 0
+
+
 def main() -> int:
     update = "--update" in sys.argv[1:]
     args = [a for a in sys.argv[1:] if a != "--update"]
     mix = None
     if "--mix" in args:
         mix = args[args.index("--mix") + 1]
-        if mix != "zipf":
-            sys.exit(f"unknown --mix {mix!r} (have: zipf)")
+        if mix not in ("zipf", "tenants"):
+            sys.exit(f"unknown --mix {mix!r} (have: zipf, tenants)")
     n_jobs, workers = N_JOBS, N_WORKERS
     if "--jobs" in args:
         n_jobs = int(args[args.index("--jobs") + 1])
@@ -430,6 +682,10 @@ def main() -> int:
         return main_zipf(update,
                          ZIPF_JOBS if "--jobs" not in args else n_jobs,
                          workers)
+    if mix == "tenants":
+        return main_tenants(
+            update,
+            TEN_WORKERS if "--workers" not in args else workers)
 
     from spark_fsm_tpu import config as cfgmod
     from spark_fsm_tpu.ops import ragged_batch as RB

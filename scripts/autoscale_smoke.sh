@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Elastic control plane smoke (ISSUE 13): three real service processes
+# on one MiniRedis — tenant-fair shedding, a leader-published scale-up
+# decision under sustained backlog, and a forced scale-down whose
+# victim drains (queue stolen by the survivors, oracle parity) and
+# exits cleanly.
+#
+# Runs under a hard timeout: a wedged boot/drain must fail the smoke,
+# not hang CI.
+cd "$(dirname "$0")/.."
+set -o pipefail
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/autoscale_smoke.py
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "AUTOSCALE_SMOKE_FAILED rc=$rc"
+fi
+exit $rc

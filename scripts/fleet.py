@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Fleet supervisor — the operator hook that ACTS on the autoscaler's
+decisions (ISSUE 13, service/autoscale.py).
+
+The control plane deliberately splits deciding from supplying: the
+leader-elected controller inside the service publishes a
+desired-replica-count record (``fsm:autoscale:desired``) and drain
+directives; SOMETHING in the environment has to boot and reap
+processes.  In production that something is a k8s HPA-style controller
+or systemd template units (docs/OPERATIONS.md maps the records to
+both); this script is the self-contained reference implementation —
+enough to run an elastic fleet on one box:
+
+- boots ``--initial`` replicas from one boot config (store must be
+  ``redis`` — the shared journal/lease namespace IS the fleet bus);
+- polls ``fsm:autoscale:desired`` and spawns replicas while the live
+  count is below the published desired (bounded by ``--max``);
+- reaps exited children: a scale-down victim drains and exits on its
+  own (the drain directive is between the leader and the victim — the
+  supervisor never kills anything), and an exited replica below the
+  desired count is replaced (crash supervision for free);
+- SIGTERM/SIGINT forwards a clean drain-style stop to every child.
+
+Usage:
+    python scripts/fleet.py --config fleet.toml [--initial 2]
+                            [--max 8] [--poll 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def log(msg):
+    print(f"fleet: {msg}", flush=True)
+
+
+def boot_replica(cfg_path: str, n: int) -> subprocess.Popen:
+    child = (
+        "import sys\n"
+        f"sys.argv = ['app', '--config', {str(cfg_path)!r}]\n"
+        "from spark_fsm_tpu.service.app import main\n"
+        "main()\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", child])
+    log(f"booted replica #{n} (pid {proc.pid})")
+    return proc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="spark_fsm_tpu fleet "
+                                             "supervisor")
+    ap.add_argument("--config", required=True,
+                    help="replica boot config (.toml/.json); needs "
+                         "[store] backend=redis and [cluster]/"
+                         "[autoscale] enabled")
+    ap.add_argument("--initial", type=int, default=None,
+                    help="replicas to boot at start (default: "
+                         "[autoscale] min_replicas)")
+    ap.add_argument("--max", type=int, default=None,
+                    help="hard replica ceiling (default: [autoscale] "
+                         "max_replicas)")
+    ap.add_argument("--poll", type=float, default=1.0)
+    args = ap.parse_args()
+
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.service.resp import RespClient
+
+    cfg = cfgmod.load_config(args.config)
+    if cfg.store.backend != "redis":
+        sys.exit("fleet: the boot config must use [store] backend = "
+                 "'redis' (the shared store is the fleet bus)")
+    initial = args.initial if args.initial is not None \
+        else max(1, cfg.autoscale.min_replicas)
+    ceiling = args.max if args.max is not None \
+        else max(initial, cfg.autoscale.max_replicas)
+    client = RespClient(host=cfg.store.host, port=cfg.store.port)
+
+    children: list = []
+    seq = 0
+    stopping = []
+
+    def _term(signum, frame):
+        stopping.append(True)
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    for _ in range(initial):
+        seq += 1
+        children.append(boot_replica(args.config, seq))
+    desired = initial
+    log(f"supervising {initial} replicas (ceiling {ceiling}), acting "
+        f"on fsm:autoscale:desired")
+    try:
+        while not stopping:
+            time.sleep(args.poll)
+            # reap exits (drained victims leave on their own)
+            for proc in list(children):
+                rc = proc.poll()
+                if rc is not None:
+                    log(f"replica pid {proc.pid} exited rc={rc}")
+                    children.remove(proc)
+            try:
+                raw = client.get("fsm:autoscale:desired")
+                if raw:
+                    rec = json.loads(raw)
+                    want = int(rec.get("desired") or desired)
+                    if want != desired:
+                        log(f"desired-replica record: {want} "
+                            f"(reason: {rec.get('reason')!r}, "
+                            f"leader {rec.get('leader')!r})")
+                    desired = want
+            except Exception as exc:
+                log(f"desired-record read failed: {exc}")
+            # supply up to the published desired count; scale-DOWN is
+            # the leader's drain directive + the victim's own exit —
+            # never a supervisor kill
+            while len(children) < min(desired, ceiling):
+                seq += 1
+                children.append(boot_replica(args.config, seq))
+    finally:
+        log("stopping fleet")
+        for proc in children:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + 60.0
+        for proc in children:
+            try:
+                proc.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -191,7 +191,22 @@ def main() -> int:
                     "fsm_rescache_evictions_total",
                     "fsm_rescache_bytes_total",
                     "fsm_rescache_bytes",
-                    "fsm_rescache_errors_total"):
+                    "fsm_rescache_errors_total",
+                    # ISSUE 13 families: elastic control plane
+                    # (service/autoscale.py) + weighted-fair admission
+                    # (service/fairness.py) — present (zero) even on a
+                    # boot with [autoscale]/[fairness] disabled
+                    "fsm_autoscale_leader",
+                    "fsm_autoscale_desired_replicas",
+                    "fsm_autoscale_evals_total",
+                    "fsm_autoscale_decisions_total",
+                    "fsm_autoscale_drain_directives_total",
+                    "fsm_replica_drains_total",
+                    "fsm_tenant_queue_depth",
+                    "fsm_tenant_admitted_total",
+                    "fsm_tenant_sheds_total",
+                    "fsm_tenant_dequeued_total",
+                    "fsm_rescache_peer_hints_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 

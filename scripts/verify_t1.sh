@@ -22,6 +22,10 @@
 #   rescache_smoke.sh    result-reuse tier over HTTP: cache hit +
 #                        in-flight coalesce + dominated serve, parity
 #                        vs cold oracle, live fsm_rescache_* families
+#   autoscale_smoke.sh   elastic control plane: 3 replicas on one
+#                        MiniRedis — tenant-fair 429s, a leader
+#                        scale-up decision, forced scale-down drain
+#                        with steal + parity and a clean victim exit
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -33,7 +37,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
              throughput_smoke resident_smoke partition_smoke \
-             replica_smoke rescache_smoke; do
+             replica_smoke rescache_smoke autoscale_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

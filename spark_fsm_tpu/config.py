@@ -116,6 +116,31 @@ File format (TOML shown; JSON with the same nesting also accepted):
     dominance = true                # serve dominated requests by host-
                                     # side filtering of cached results
 
+    [fairness]
+    enabled = false                 # weighted-fair multi-tenant admission
+                                    # (service/fairness.py): DRR across
+                                    # tenants within each priority class
+    tenant_depth = 64               # per-tenant queued-job cap (0 = none)
+    max_tenants = 64                # bounded live tenant vocabulary
+    default_weight = 1.0            # weight for tenants not listed below
+    [fairness.weights]              # tenant -> relative weight
+    # gold = 4.0
+    # free = 1.0
+
+    [autoscale]
+    enabled = false                 # elastic control plane (service/
+                                    # autoscale.py); requires [cluster]
+    min_replicas = 1
+    max_replicas = 8
+    up_queue_per_worker = 2.0       # scale up past this queued/worker
+    up_p99_s = 0.0                  # scale up past this SLO p99 (0 = off)
+    down_free_frac = 0.5            # scale down past this idle fraction
+    hold_s = 10.0                   # signal must persist (hysteresis)
+    cooldown_s = 30.0               # min gap between decisions
+    decide_every_s = 0.0            # controller cadence (0 = ttl/3)
+    leader_ttl_s = 3.0              # fsm:autoscale:leader lease TTL
+    drain_timeout_s = 60.0          # drain wait before exiting anyway
+
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
     sequences = 77500               # expected dataset scale
@@ -317,6 +342,78 @@ class RescacheConfig:
 
 
 @dataclasses.dataclass
+class FairnessConfig:
+    """Weighted-fair multi-tenant admission (service/fairness.py):
+    per-tenant token buckets layered UNDER the strict priority classes —
+    within each class, queued jobs are served deficit-weighted
+    round-robin across tenants, and each tenant's queue occupancy is
+    capped, so one flooding tenant sheds 429s (with a Retry-After
+    derived from its OWN bucket refill) while every other tenant's
+    goodput holds at its weight-fair share.
+
+    ``enabled = false`` (default) keeps the admission queue exactly as
+    before — plain FIFO within each priority class, tenant param
+    accepted but ignored (bench_smoke's dispatch counters stay
+    byte-identical).  ``tenant_depth`` is each tenant's queued-job cap
+    (its bucket size; 0 = no per-tenant cap — the global queue_depth
+    still binds).  ``max_tenants`` bounds the live tenant vocabulary
+    (tenant names label fsm_tenant_* series — unbounded cardinality is
+    an operator hazard); a NEW tenant past the bound is refused with a
+    failure envelope.  ``weights`` maps tenant name -> relative weight
+    (``[fairness.weights]`` table in TOML); unlisted tenants get
+    ``default_weight``.
+    """
+
+    enabled: bool = False
+    tenant_depth: int = 64
+    max_tenants: int = 64
+    default_weight: float = 1.0
+    weights: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Elastic control plane (service/autoscale.py): a per-replica
+    controller, leader-elected through a short-TTL ``fsm:autoscale:
+    leader`` lease on the shared store, watches cluster queue depth,
+    free capacity and the /admin/slo p99 and emits scale decisions —
+    scale-UP publishes a desired-replica-count record
+    (``fsm:autoscale:desired``) an operator hook or scripts/fleet.py
+    acts on; scale-DOWN writes a drain directive for the least-loaded
+    replica, which stops admitting, lets peers steal its queue,
+    releases its leases and exits (the PR 8 protocol).
+
+    Requires ``[cluster] enabled`` (the lease substrate IS the control
+    plane's transport).  ``up_queue_per_worker``: queued jobs per
+    fleet worker above which the fleet is under-provisioned.
+    ``up_p99_s``: scale up when the /admin/slo e2e p99 exceeds this
+    (0 = ignore the latency signal).  ``down_free_frac``: fraction of
+    fleet workers idle (with an empty queue) above which the fleet is
+    over-provisioned.  ``hold_s``: a signal must persist this long
+    before it becomes a decision (hysteresis — load oscillating inside
+    the band produces ZERO decisions); ``cooldown_s``: minimum gap
+    between decisions.  ``decide_every_s`` (0 = leader_ttl_s / 3) is
+    the controller cadence; ``leader_ttl_s`` bounds how long a dead
+    leader stalls the loop.  ``drain_timeout_s``: how long a draining
+    replica waits for peers to steal its queue before exiting anyway
+    (leftovers become journal orphans the survivors' periodic recovery
+    adopts — slower, never lost).
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 8
+    up_queue_per_worker: float = 2.0
+    up_p99_s: float = 0.0
+    down_free_frac: float = 0.5
+    hold_s: float = 10.0
+    cooldown_s: float = 30.0
+    decide_every_s: float = 0.0
+    leader_ttl_s: float = 3.0
+    drain_timeout_s: float = 60.0
+
+
+@dataclasses.dataclass
 class DistributedConfig:
     """Multi-host (jax.distributed) wiring; all-defaults = single host.
 
@@ -374,6 +471,10 @@ class Config:
         default_factory=ClusterConfig)
     rescache: RescacheConfig = dataclasses.field(
         default_factory=RescacheConfig)
+    fairness: FairnessConfig = dataclasses.field(
+        default_factory=FairnessConfig)
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -421,6 +522,8 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "partition": (PartitionConfig, top.pop("partition", {})),
         "cluster": (ClusterConfig, top.pop("cluster", {})),
         "rescache": (RescacheConfig, top.pop("rescache", {})),
+        "fairness": (FairnessConfig, top.pop("fairness", {})),
+        "autoscale": (AutoscaleConfig, top.pop("autoscale", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -486,6 +589,55 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("cluster.recover_every_s must be >= 0 (0 = ttl)")
     if cfg.rescache.max_bytes < 0:
         raise ConfigError("rescache.max_bytes must be >= 0 (0 = unbounded)")
+    if cfg.fairness.tenant_depth < 0:
+        raise ConfigError(
+            "fairness.tenant_depth must be >= 0 (0 = no per-tenant cap)")
+    if cfg.fairness.max_tenants < 1:
+        raise ConfigError("fairness.max_tenants must be >= 1")
+    if cfg.fairness.default_weight <= 0:
+        raise ConfigError("fairness.default_weight must be > 0")
+    if not isinstance(cfg.fairness.weights, dict):
+        raise ConfigError("[fairness.weights] must be a table of "
+                          "tenant -> weight")
+    weights = {}
+    for name, w in cfg.fairness.weights.items():
+        try:
+            w = float(w)
+        except (TypeError, ValueError):
+            raise ConfigError(
+                f"fairness weight for tenant {name!r} must be a number, "
+                f"got {w!r}")
+        if w <= 0:
+            raise ConfigError(
+                f"fairness weight for tenant {name!r} must be > 0")
+        weights[str(name)] = w
+    cfg.fairness.weights = weights
+    if cfg.autoscale.enabled and not cfg.cluster.enabled:
+        raise ConfigError(
+            "autoscale.enabled requires cluster.enabled (the autoscaler "
+            "leader-elects and observes the fleet through the lease "
+            "substrate)")
+    if cfg.autoscale.min_replicas < 1:
+        raise ConfigError("autoscale.min_replicas must be >= 1")
+    if cfg.autoscale.max_replicas < cfg.autoscale.min_replicas:
+        raise ConfigError(
+            "autoscale.max_replicas must be >= autoscale.min_replicas")
+    if cfg.autoscale.up_queue_per_worker <= 0:
+        raise ConfigError("autoscale.up_queue_per_worker must be > 0")
+    if cfg.autoscale.up_p99_s < 0:
+        raise ConfigError("autoscale.up_p99_s must be >= 0 (0 = ignore)")
+    if not 0 < cfg.autoscale.down_free_frac <= 1:
+        raise ConfigError("autoscale.down_free_frac must be in (0, 1]")
+    if cfg.autoscale.hold_s < 0 or cfg.autoscale.cooldown_s < 0:
+        raise ConfigError(
+            "autoscale.hold_s / cooldown_s must be >= 0")
+    if cfg.autoscale.decide_every_s < 0:
+        raise ConfigError(
+            "autoscale.decide_every_s must be >= 0 (0 = leader_ttl_s / 3)")
+    if cfg.autoscale.leader_ttl_s <= 0:
+        raise ConfigError("autoscale.leader_ttl_s must be > 0")
+    if cfg.autoscale.drain_timeout_s <= 0:
+        raise ConfigError("autoscale.drain_timeout_s must be > 0")
     return cfg
 
 

@@ -19,12 +19,13 @@ import threading
 import time
 import traceback
 import uuid
-from typing import Deque, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
-from spark_fsm_tpu.service import (lease, model, obsplane, plugins,
-                                   resultcache, sources)
+from spark_fsm_tpu.service import (autoscale, fairness, lease, model,
+                                   obsplane, plugins, resultcache,
+                                   sources)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 from spark_fsm_tpu.utils import faults, jobctl, obs
@@ -270,15 +271,17 @@ class StoreCheckpoint:
 
 
 class AdmissionShed(RuntimeError):
-    """A submit refused because the admission queue is full — the HTTP
-    layer maps it to 429 with ``Retry-After: retry_after_s``."""
+    """A submit refused with HTTP 429 + ``Retry-After: retry_after_s``.
+    Default message = the global-queue-full case; ``why`` overrides it
+    for the other shed scopes (a tenant over its fairness cap, a
+    draining replica, a dataset already in flight on a peer)."""
 
     def __init__(self, uid: str, depth: int, queued: int,
-                 retry_after_s: int):
+                 retry_after_s: int, why: Optional[str] = None):
         self.retry_after_s = retry_after_s
         super().__init__(
-            f"admission queue full ({queued}/{depth} jobs queued); "
-            f"retry in ~{retry_after_s}s")
+            why or f"admission queue full ({queued}/{depth} jobs "
+                   f"queued); retry in ~{retry_after_s}s")
 
 
 class UidConflict(RuntimeError):
@@ -304,6 +307,12 @@ _SHEDS_TOTAL = obs.REGISTRY.counter(
     "train submits refused with 429 because the admission queue was full")
 for _p in PRIORITIES:
     _SHEDS_TOTAL.seed(priority=_p)
+_DRAINS_TOTAL = (obs.REGISTRY.counter(
+    "fsm_replica_drains_total",
+    "scale-down drains of this replica, by outcome (clean = queue fully "
+    "stolen/finished before the timeout; timeout = leftovers handed to "
+    "the peers' recovery protocol)")
+    .seed(outcome="clean").seed(outcome="timeout"))
 
 
 class AdmissionQueue:
@@ -311,24 +320,40 @@ class AdmissionQueue:
     ``queue.Queue`` — the admission-control half of the overload story.
 
     Three strict priority classes (``high`` > ``normal`` > ``low``);
-    within a class, FIFO.  ``depth`` bounds the QUEUED jobs (running
-    jobs have already left the queue; 0 = unbounded).  Admission is a
-    two-phase reserve/put so the bound is exact under concurrent
-    submitters even though the store writes between reservation and
-    enqueue take time: ``try_reserve`` atomically claims a slot (or
-    reports the shed), ``put`` converts it, ``abort`` returns it.
+    within a class, FIFO — or, with a fairness scheduler installed
+    (``[fairness] enabled``, service/fairness.py), deficit-weighted
+    round-robin across tenants with per-tenant occupancy caps; the
+    classes stay strict ABOVE fairness either way.  ``depth`` bounds
+    the QUEUED jobs (running jobs have already left the queue; 0 =
+    unbounded).  Admission is a two-phase reserve/put so the bound is
+    exact under concurrent submitters even though the store writes
+    between reservation and enqueue take time: ``try_reserve``
+    atomically claims a slot (or reports the shed), ``put`` converts
+    it, ``abort`` returns it.
 
     Worker sentinels (shutdown) are counted separately and handed out
     only once every queued job has been drained — backlog jobs always
-    reach a worker, which gives them their durable drain failure."""
+    reach a worker, which gives them their durable drain failure.
+    ``pause`` (the scale-down drain) stops workers from picking up
+    QUEUED work while sentinels still surface, so a drained replica's
+    backlog is left for peers to steal instead of being started
+    locally."""
 
-    def __init__(self, depth: int):
+    def __init__(self, depth: int,
+                 fair: Optional[fairness.TenantScheduler] = None):
         self.depth = int(depth)
+        self._fair = fair
         self._cond = threading.Condition()
-        self._qs: Dict[str, Deque[ServiceRequest]] = {
-            p: collections.deque() for p in PRIORITIES}
+        if fair is None:
+            self._qs: Dict[str, object] = {
+                p: collections.deque() for p in PRIORITIES}
+        else:
+            self._qs = {p: fairness.FairClass(fair) for p in PRIORITIES}
         self._reserved = 0
+        self._tenant_reserved: Dict[str, int] = {}
+        self._tenant_queued: Dict[str, int] = {}
         self._sentinels = 0
+        self._paused = False
         _QUEUE_DEPTH.set(0)
 
     def _n_queued(self) -> int:
@@ -338,33 +363,67 @@ class AdmissionQueue:
         with self._cond:
             return self._n_queued()
 
-    def try_reserve(self, priority: str = "low"):
-        """(admitted, queued_now, queued_ahead): claim a queue slot, or
-        report a shed (``admitted=False``) with the depth that refused
-        it.  ``queued_ahead`` is the shed submit's true queue position —
-        jobs in classes at or above its priority, plus in-flight
-        reservations (class unknown until ``put``, counted ahead
-        conservatively) — the Retry-After estimator's input: a shed
-        ``high`` submit behind 200 ``low`` jobs waits for the running
-        work, not the whole backlog."""
+    def _tenant_total(self, tenant: str) -> int:
+        return (self._tenant_queued.get(tenant, 0)
+                + self._tenant_reserved.get(tenant, 0))
+
+    def try_reserve(self, priority: str = "low",
+                    tenant: str = fairness.DEFAULT_TENANT):
+        """(admitted, queued_now, queued_ahead, scope): claim a queue
+        slot, or report a shed (``admitted=False``) naming what refused
+        it — ``"queue"`` (the global depth; ``queued_now``/``ahead``
+        are the global counts) or ``"tenant"`` (the tenant's own
+        occupancy cap; both counts are the TENANT's).  ``queued_ahead``
+        is the shed submit's true queue position — jobs in classes at
+        or above its priority, plus in-flight reservations (class
+        unknown until ``put``, counted ahead conservatively) — the
+        Retry-After estimator's input: a shed ``high`` submit behind
+        200 ``low`` jobs waits for the running work, not the whole
+        backlog."""
         with self._cond:
+            if self._fair is not None and self._fair.tenant_depth > 0:
+                # the tenant's token bucket: one token per queued slot,
+                # consumed here, returned at dequeue/abort.  Checked
+                # BEFORE the global bound so a flooding tenant sheds
+                # with ITS OWN counts while the fleet still has room.
+                tn = self._tenant_total(tenant)
+                if tn >= self._fair.tenant_depth:
+                    return False, tn, tn, "tenant"
             n = self._n_queued() + self._reserved
             if self.depth > 0 and n >= self.depth:
                 rank = PRIORITIES.index(priority)
                 ahead = sum(len(self._qs[p])
                             for p in PRIORITIES[:rank + 1])
-                return False, n, ahead + self._reserved
+                return False, n, ahead + self._reserved, "queue"
             self._reserved += 1
-            return True, n, 0
+            if self._fair is not None:
+                self._tenant_reserved[tenant] = \
+                    self._tenant_reserved.get(tenant, 0) + 1
+            return True, n, 0, ""
 
-    def abort(self) -> None:
+    def abort(self, tenant: str = fairness.DEFAULT_TENANT) -> None:
         with self._cond:
             self._reserved -= 1
+            if self._fair is not None:
+                self._tenant_reserved[tenant] = max(
+                    0, self._tenant_reserved.get(tenant, 0) - 1)
 
-    def put(self, req: ServiceRequest, priority: str) -> None:
+    def _set_tenant_queued(self, tenant: str, delta: int) -> None:
+        n = max(0, self._tenant_queued.get(tenant, 0) + delta)
+        self._tenant_queued[tenant] = n
+        fairness.set_depth(tenant, n)
+
+    def put(self, req: ServiceRequest, priority: str,
+            tenant: str = fairness.DEFAULT_TENANT) -> None:
         with self._cond:
             self._reserved -= 1
-            self._qs[priority].append(req)
+            if self._fair is not None:
+                self._tenant_reserved[tenant] = max(
+                    0, self._tenant_reserved.get(tenant, 0) - 1)
+                self._qs[priority].append(req, tenant)
+                self._set_tenant_queued(tenant, +1)
+            else:
+                self._qs[priority].append(req)
             _QUEUE_DEPTH.set(self._n_queued())
             self._cond.notify()
 
@@ -375,14 +434,22 @@ class AdmissionQueue:
 
     def get(self) -> Optional[ServiceRequest]:
         """Highest-priority queued request, or None (a sentinel) —
-        sentinels only surface once the backlog is fully drained."""
+        sentinels only surface once the backlog is fully drained.
+        While PAUSED (scale-down drain) queued work is invisible but
+        sentinels still surface, so shutdown after a drain completes."""
         with self._cond:
             while True:
-                for p in PRIORITIES:
-                    if self._qs[p]:
-                        req = self._qs[p].popleft()
-                        _QUEUE_DEPTH.set(self._n_queued())
-                        return req
+                if not self._paused:
+                    for p in PRIORITIES:
+                        if self._qs[p]:
+                            if self._fair is not None:
+                                req, tenant = self._qs[p].popleft()
+                                self._set_tenant_queued(tenant, -1)
+                                fairness.note_dequeued(tenant)
+                            else:
+                                req = self._qs[p].popleft()
+                            _QUEUE_DEPTH.set(self._n_queued())
+                            return req
                 if self._sentinels:
                     self._sentinels -= 1
                     return None
@@ -394,6 +461,15 @@ class AdmissionQueue:
         eventually dequeues the dead work).  None when no queued request
         carries the uid — a worker already took it."""
         with self._cond:
+            if self._fair is not None:
+                for q in self._qs.values():
+                    hit = q.remove_uid(uid)
+                    if hit is not None:
+                        req, tenant = hit
+                        self._set_tenant_queued(tenant, -1)
+                        _QUEUE_DEPTH.set(self._n_queued())
+                        return req
+                return None
             for q in self._qs.values():
                 for req in q:
                     if req.uid == uid:
@@ -401,6 +477,51 @@ class AdmissionQueue:
                         _QUEUE_DEPTH.set(self._n_queued())
                         return req
         return None
+
+    # ------------------------------------------------- scale-down drain
+
+    def pause(self) -> None:
+        """Stop handing QUEUED work to workers (they finish their
+        current job only) — the drain protocol's first step.  Sentinels
+        still surface, so a later shutdown() completes normally."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def queued_uids(self) -> List[str]:
+        """Snapshot of the queued uids (the drain loop's steal-reap
+        input)."""
+        with self._cond:
+            if self._fair is not None:
+                return [u for q in self._qs.values() for u in q.uids()]
+            return [req.uid for q in self._qs.values() for req in q]
+
+    def pop_all(self) -> List[ServiceRequest]:
+        """Empty every class (the drain-timeout leftovers: jobs the
+        peers did not steal in time, handed to the recovery protocol by
+        the caller)."""
+        with self._cond:
+            out: List[ServiceRequest] = []
+            for q in self._qs.values():
+                if self._fair is not None:
+                    for req, tenant in q.pop_all():
+                        self._set_tenant_queued(tenant, -1)
+                        out.append(req)
+                else:
+                    out.extend(q)
+                    q.clear()
+            _QUEUE_DEPTH.set(0)
+            return out
+
+    def tenant_depths(self) -> Dict[str, int]:
+        """Per-tenant queued counts (empty without a fairness
+        scheduler) — piggybacked on the lease heartbeat snapshot."""
+        with self._cond:
+            return {t: n for t, n in self._tenant_queued.items() if n > 0}
 
 
 def _checkpoint_requested(req: ServiceRequest) -> bool:
@@ -443,7 +564,16 @@ class Miner:
         self.store = store
         if queue_depth is None:
             queue_depth = config.get_config().service.queue_depth
-        self._q = AdmissionQueue(queue_depth)
+        # weighted-fair multi-tenant admission (ISSUE 13,
+        # service/fairness.py): None (the default) keeps the queue's
+        # plain per-class deques and the tenant param ignored
+        self._fair = fairness.build_scheduler()
+        self._q = AdmissionQueue(queue_depth, fair=self._fair)
+        # scale-down drain state (ISSUE 13): set by drain() — submits
+        # shed with 429 pointing at the peers, workers stop picking up
+        # queued work, and the backlog leaves via the steal/recovery
+        # protocol instead of running here
+        self._draining = False
         # multi-replica lease layer (ISSUE 8): explicit manager, or
         # built from the boot [cluster] section.  None (the default
         # single-replica deployment) keeps every guard below at one
@@ -527,6 +657,148 @@ class Miner:
         with self._wall_lock:
             return self._wall_ewma
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def tenant_depths(self) -> Dict[str, int]:
+        """Per-tenant queued counts (empty without fairness) — the
+        heartbeat snapshot's multi-tenant load view."""
+        return self._q.tenant_depths() if self._fair is not None else {}
+
+    def inflight_fps(self) -> List[str]:
+        """Dataset fingerprints of in-flight coalescing leaders (empty
+        without the result-reuse tier) — the heartbeat snapshot's
+        cross-replica coalesce hint (ROADMAP 2c)."""
+        rc = self._rescache
+        return rc.inflight_fps() if rc is not None else []
+
+    def drain(self, timeout_s: Optional[float] = None,
+              reason: str = "scale-down") -> dict:
+        """The scale-down drain protocol (ISSUE 13), on the substrate
+        PR 8 already built:
+
+        1. stop admitting — submits shed with 429 whose Retry-After is
+           the steal path (~2 heartbeats);
+        2. stop STARTING queued work (workers finish their current job
+           only; the queue pauses) and advertise ``draining`` with zero
+           free capacity, so idle peers steal the queued backlog off
+           our admission namespace exactly as they would off a loaded
+           healthy replica;
+        3. wait until the queue has been stolen empty and the running
+           jobs finished, or ``timeout_s`` elapses;
+        4. leftovers (peers too busy to steal in time) keep their
+           journal intent + admission marker but have their LEASE
+           released, so the survivors' steal scans and periodic
+           recovery adopt them immediately — slower than a steal,
+           never lost, never run twice.
+
+        The caller (service/autoscale.py directive, /admin/drain, or
+        an operator) shuts the process down afterwards; this method
+        only guarantees that by its return every job this replica ever
+        admitted is finished, stolen, adoptable, or durably settled.
+        Lifecycle ``draining``/``drained`` spans land on the durable
+        trace spine under ``replica:{id}`` so the fleet timeline shows
+        the drain even after the process exits."""
+        with self._stop_lock:
+            if self._draining:
+                return {"state": "already-draining"}
+            self._draining = True
+        if timeout_s is None:
+            timeout_s = config.get_config().autoscale.drain_timeout_s
+        rid = (self._lease.replica_id if self._lease is not None
+               else "solo")
+        trace_id = f"replica:{rid}"
+        t0 = time.monotonic()
+        queued0, running0 = self.queue_size(), self.running_count()
+        log_event("replica_draining", replica=rid, queued=queued0,
+                  running=running0, reason=reason)
+        with obs.span("lifecycle.draining", trace_id=trace_id,
+                      replica=rid, reason=reason, queued=queued0,
+                      running=running0):
+            pass
+        obs.flush_trace(trace_id)
+        self._q.pause()
+        if self._lease is not None:
+            # heartbeat flips to draining/free=0/steal=false and
+            # publishes immediately: peers must stop counting on us
+            # (and start stealing from us) within one heartbeat
+            self._lease.set_draining(True)
+        deadline = t0 + max(0.1, float(timeout_s))
+        stolen = 0
+        while time.monotonic() < deadline:
+            stolen += self._reap_stolen()
+            if self.queue_size() == 0 and self.running_count() == 0:
+                break
+            time.sleep(0.02)
+        stolen += self._reap_stolen()
+        leftovers = self._q.pop_all()
+        for req in leftovers:
+            if self._lease is not None:
+                # journal intent + admission marker stay (the survivors'
+                # steal scan or periodic recovery picks each up exactly
+                # once); releasing the lease makes adoption IMMEDIATE
+                # instead of a TTL wait.  Local control state dies here.
+                ctl = self._lease.attached_ctl(req.uid)
+                self._lease.release(req.uid)
+                jobctl.release_entry(ctl)
+                if self._rescache is not None:
+                    # local followers cannot wait for a fan-out that
+                    # will now happen on the adopting replica
+                    self._rescache.on_leader_terminal(req.uid)
+            else:
+                # solo deployment: nobody can adopt — settle durably,
+                # keep_frontier so a checkpointed resubmit resumes
+                _record_failure(self.store, req.uid,
+                                RuntimeError("replica draining"),
+                                keep_frontier=True, lease_mgr=None,
+                                rescache=self._rescache)
+        running_left = self.running_count()
+        outcome = ("clean" if not leftovers and running_left == 0
+                   else "timeout")
+        _DRAINS_TOTAL.inc(outcome=outcome)
+        report = {"outcome": outcome, "reason": reason,
+                  "replica": rid, "waited_s": round(
+                      time.monotonic() - t0, 3),
+                  "queued_at_start": queued0,
+                  "running_at_start": running0,
+                  "stolen_by_peers": stolen,
+                  "left_for_recovery": len(leftovers),
+                  "running_left": running_left}
+        log_event("replica_drained", **report)
+        with obs.span("lifecycle.drained", trace_id=trace_id,
+                      replica=rid, outcome=outcome,
+                      left_for_recovery=len(leftovers)):
+            pass
+        obs.flush_trace(trace_id)
+        return report
+
+    def _reap_stolen(self) -> int:
+        """Drain-loop victim bookkeeping: with the queue PAUSED the
+        worker-side drop (retract_admission at dequeue) never runs, so
+        the drain polls the admission markers itself — a marker a
+        thief claimed means the job runs on the thief now and leaves
+        our queue here.  Returns how many entries were reaped."""
+        if self._lease is None:
+            return 0
+        reaped = 0
+        for uid in self._q.queued_uids():
+            try:
+                if not self._lease.admission_claimed(uid):
+                    continue
+            except Exception:
+                continue  # store hiccup: the next poll retries
+            req = self._q.remove(uid)
+            if req is None:
+                continue
+            ctl = self._lease.attached_ctl(uid)
+            self._lease.stolen_from_us(uid)
+            jobctl.release_entry(ctl)
+            if self._rescache is not None:
+                self._rescache.on_leader_terminal(uid)
+            reaped += 1
+        return reaped
+
     def settle_cancelled_queued(self, uid: str) -> bool:
         """Settle a job cancelled while still QUEUED: remove it from the
         admission queue (freeing its slot for new submits immediately)
@@ -572,15 +844,34 @@ class Miner:
             self._wall_ewma = (wall_s if self._wall_ewma is None
                                else 0.3 * wall_s + 0.7 * self._wall_ewma)
 
+    def _per_job_s(self) -> float:
+        """One job's estimated wall: the EWMA of measured walls, seeded
+        — before any job has finished — by the ragged planner's cost
+        model over the declared prewarm envelope (8 full-width launches
+        at the configured sequence scale: the same KERNELS.json-
+        anchored arithmetic the watchdog deadlines use)."""
+        with self._wall_lock:
+            per_job = self._wall_ewma
+        if per_job is None:
+            pw = config.get_config().prewarm
+            n_seq = pw.sequences or 100_000
+            per_job = RB.estimate_seconds(8 * 8192, 8, n_seq,
+                                          max(1, pw.words or 1))
+        return per_job
+
+    def _steal_path_retry_s(self) -> int:
+        """~Two heartbeats: the time for an idle peer's steal scan to
+        pick a queued job up — the Retry-After whenever the fastest
+        path to service is a PEER (free capacity advertised, or this
+        replica draining)."""
+        hb = self._lease.heartbeat_s if self._lease is not None else 1.0
+        return max(1, math.ceil(2 * max(hb, 0.5)))
+
     def _retry_after_s(self, queued_ahead: int) -> int:
         """Seconds until a shed submit plausibly fits: the submit's true
         QUEUE POSITION (jobs queued at or above its priority class —
         work below it would be overtaken, not waited for) divided over
-        the workers, priced per job by the EWMA of measured walls —
-        seeded, before any job has finished, by the ragged planner's
-        cost model over the declared prewarm envelope (8 full-width
-        launches at the configured sequence scale: the same
-        KERNELS.json-anchored arithmetic the watchdog deadlines use).
+        the workers, priced per job by :meth:`_per_job_s`.
 
         CLUSTER OVERRIDE: when peers advertise free capacity in their
         heartbeat records, the shed submit's fastest path is the STEAL
@@ -589,15 +880,9 @@ class Miner:
         the wait by orders of magnitude.  Point the client at roughly
         two heartbeats instead."""
         if self._lease is not None and self._lease.peer_free_total() > 0:
-            return max(1, math.ceil(2 * self._lease.heartbeat_s))
-        with self._wall_lock:
-            per_job = self._wall_ewma
-        if per_job is None:
-            pw = config.get_config().prewarm
-            n_seq = pw.sequences or 100_000
-            per_job = RB.estimate_seconds(8 * 8192, 8, n_seq,
-                                          max(1, pw.words or 1))
-        est = per_job * (queued_ahead + 1) / max(1, len(self._threads))
+            return self._steal_path_retry_s()
+        est = self._per_job_s() * (queued_ahead + 1) \
+            / max(1, len(self._threads))
         return max(1, min(3600, math.ceil(est)))
 
     def submit(self, req: ServiceRequest) -> None:
@@ -606,6 +891,12 @@ class Miner:
         if priority not in PRIORITIES:
             raise ValueError(f"unknown priority {priority!r} "
                              f"(valid: {'/'.join(PRIORITIES)})")
+        # multi-tenant identity (service/fairness.py): validated +
+        # registered against the bounded vocabulary when fairness is
+        # on; accepted-but-ignored otherwise (the queue stays FIFO)
+        tenant = fairness.DEFAULT_TENANT
+        if self._fair is not None:
+            tenant = self._fair.resolve(req.param("tenant"))
         deadline_s = None
         raw_deadline = req.param("deadline_s")
         if raw_deadline is not None:
@@ -617,6 +908,19 @@ class Miner:
             if not math.isfinite(deadline_s) or deadline_s <= 0:
                 raise ValueError(f"deadline_s must be a finite value > 0 "
                                  f"(got {raw_deadline!r})")
+        if self._draining:
+            # scale-down drain: this replica is leaving the fleet — no
+            # new work, and the honest Retry-After is the steal path
+            # (peers will have adopted our backlog by then too)
+            retry = self._steal_path_retry_s()
+            _SHEDS_TOTAL.inc(priority=priority)
+            if self._fair is not None:
+                fairness.note_shed(tenant)
+            log_event("job_shed_draining", uid=req.uid, priority=priority)
+            raise AdmissionShed(
+                req.uid, self._q.depth, self._q.size(), retry,
+                why=f"replica is draining for scale-down; peers serve "
+                    f"new work — retry in ~{retry}s")
         rc = self._rescache
         if rc is not None:
             # result-reuse tier (service/resultcache.py): a request
@@ -624,11 +928,28 @@ class Miner:
             # identical in-flight job, never reaches the queue; a miss
             # registers it as a prospective coalescing leader and falls
             # through to normal cold admission
-            if rc.intercept(req, priority, deadline_s) is not None:
+            out = rc.intercept(req, priority, deadline_s)
+            if out == "peer-inflight":
+                # cross-replica coalesce HINT (ROADMAP 2c): an identical
+                # dataset fingerprint is in flight on a peer — point the
+                # client at the cache entry that peer is about to
+                # publish instead of admitting a duplicate cold mine.
+                # Hint only: replica-local coalescing semantics are
+                # unchanged, and any error upstream degraded to a miss.
+                retry = self._steal_path_retry_s()
+                _SHEDS_TOTAL.inc(priority=priority)
+                if self._fair is not None:
+                    fairness.note_shed(tenant)
+                raise AdmissionShed(
+                    req.uid, self._q.depth, self._q.size(), retry,
+                    why=f"an identical dataset mine is in flight on a "
+                        f"peer replica; retry in ~{retry}s to hit the "
+                        f"shared result cache")
+            if out is not None:
                 return
         enqueued = False
         try:
-            enqueued = self._admit(req, priority, deadline_s)
+            enqueued = self._admit(req, priority, deadline_s, tenant)
         finally:
             if rc is not None and not enqueued:
                 # the prospective-leader registration from intercept()
@@ -653,7 +974,8 @@ class Miner:
                         rescache=rc)
 
     def _admit(self, req: ServiceRequest, priority: str,
-               deadline_s: Optional[float]) -> bool:
+               deadline_s: Optional[float],
+               tenant: str = fairness.DEFAULT_TENANT) -> bool:
         """The cold admission path (conflict check → lease → queue slot
         → journal intent → enqueue), split out of :meth:`submit` so the
         result-reuse bookkeeping wraps it in one try/finally.  Returns
@@ -691,14 +1013,31 @@ class Miner:
                     self._lease.acquire(req.uid)
                 except lease.LeaseHeld as exc:
                     raise UidConflict(req.uid) from exc
-            admitted, queued, ahead = self._q.try_reserve(priority)
+            admitted, queued, ahead, scope = self._q.try_reserve(
+                priority, tenant)
             if not admitted:
                 if self._lease is not None and fresh_lease:
                     self._lease.release(req.uid)
                 _SHEDS_TOTAL.inc(priority=priority)
+                if self._fair is not None:
+                    fairness.note_shed(tenant)
                 log_event("job_shed", uid=req.uid, queued=queued,
                           queued_ahead=ahead, depth=self._q.depth,
-                          priority=priority)
+                          priority=priority, tenant=tenant, scope=scope)
+                if scope == "tenant":
+                    # the tenant's own bucket refused the slot: the
+                    # Retry-After is how long ITS backlog takes at ITS
+                    # weight-fair share of the service rate, not the
+                    # global estimate (service/fairness.py)
+                    cap = self._fair.tenant_depth
+                    retry = self._fair.retry_after_s(
+                        tenant, queued, self._per_job_s(),
+                        len(self._threads))
+                    raise AdmissionShed(
+                        req.uid, cap, queued, retry,
+                        why=f"tenant {tenant!r} queue cap reached "
+                            f"({queued}/{cap} jobs queued); retry in "
+                            f"~{retry}s")
                 raise AdmissionShed(req.uid, self._q.depth, queued,
                                     self._retry_after_s(ahead))
             try:
@@ -729,7 +1068,7 @@ class Miner:
                     # OR a thief, exclusively) at dequeue
                     self._lease.publish_admission(req.uid)
             except BaseException:
-                self._q.abort()  # reservation never became a queued job
+                self._q.abort(tenant)  # reservation never became queued
                 try:
                     # OUR journal intent may have landed before the
                     # failure (e.g. the admission-marker write died): a
@@ -789,7 +1128,9 @@ class Miner:
                     # orders us against shutdown), so a worker will
                     # dequeue it: either it runs, or the drain check
                     # gives it a durable failure
-                    self._q.put(req, priority)
+                    self._q.put(req, priority, tenant)
+                    if self._fair is not None:
+                        fairness.note_admitted(tenant)
                     enqueued = True
         except BaseException:
             # the submit died between its journal intent and its
@@ -811,7 +1152,7 @@ class Miner:
             raise
         finally:
             if not enqueued:
-                self._q.abort()  # reservation never became a queued job
+                self._q.abort(tenant)  # reservation never became queued
         return enqueued
 
     def _loop(self) -> None:
@@ -1487,6 +1828,12 @@ class Master:
         self.tracker = Tracker(self.store)
         self.registrar = Registrar(self.store)
         self.streamer = Streamer(self.store)
+        # elastic control plane (ISSUE 13, service/autoscale.py): one
+        # controller per replica, leader-elected over the store; None
+        # unless [autoscale] enabled (config requires [cluster] too)
+        self.autoscaler = autoscale.build_for(self.miner)
+        if self.autoscaler is not None:
+            self.autoscaler.start()
 
     def cancel(self, uid: str) -> Optional[str]:
         """Cancel a live job (``/admin/cancel/{uid}``): returns what it
@@ -1568,6 +1915,8 @@ class Master:
                               error=f"unknown task {req.task!r}")
 
     def shutdown(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.miner.shutdown()
 
 

@@ -59,6 +59,15 @@ Endpoints (POST, form- or JSON-encoded parameters):
   /admin/rescache     — result-reuse tier stats (service/resultcache.py):
                         hit/coalesce/dominated-serve counters, resident
                         cache bytes, in-flight coalescing registry;
+  /admin/autoscale    — elastic control plane (service/autoscale.py):
+                        leader, last evaluation signals, the published
+                        desired-replica record and decision log;
+                        {"enabled": false} when [autoscale] is off;
+  /admin/drain        — drive the scale-down drain protocol NOW (stop
+                        admitting → peers steal the queue → leases
+                        released); ``exit=1`` also stops the server
+                        once the drain completes — the forced-scale-
+                        down lever the autoscale smoke uses;
   /admin/cancel/{uid} — abort a live (queued or running) train job at
                         its next safe point; 404 when no live job owns
                         the uid
@@ -340,6 +349,39 @@ class FsmHandler(BaseHTTPRequestHandler):
                 rc = self.master.miner._rescache
                 self._send(200, json.dumps(
                     {"enabled": False} if rc is None else rc.stats()))
+            elif task == "autoscale":
+                a = self.master.autoscaler
+                self._send(200, json.dumps(
+                    {"enabled": False} if a is None else a.stats()))
+            elif task == "drain":
+                # forced scale-down (operator lever / autoscale smoke):
+                # run the drain protocol on a background thread and
+                # return immediately — poll /admin/autoscale (or the
+                # heartbeat's draining flag via /admin/cluster) for
+                # progress.  exit=1 stops the HTTP server after the
+                # drain, handing control to main()'s teardown.
+                miner = self.master.miner
+                if miner.draining:
+                    self._send(200, json.dumps(
+                        {"status": "already-draining"}))
+                    return
+                want_exit = (data or {}).get("exit", "0").lower() \
+                    not in ("", "0", "false", "no", "off")
+                server = self.server
+
+                def _drain():
+                    miner.drain(reason="/admin/drain")
+                    if want_exit:
+                        threading.Thread(target=server.shutdown,
+                                         daemon=True).start()
+
+                threading.Thread(target=_drain, daemon=True,
+                                 name="fsm-admin-drain").start()
+                self._send(200, json.dumps(
+                    {"status": "draining",
+                     "queued": miner.queue_size(),
+                     "running": miner.running_count(),
+                     "exit": want_exit}))
             elif task == "shapes":
                 # enumerated (last prewarm) vs runtime-recorded shape
                 # keys; "drift" lists observed geometries prewarm missed
@@ -427,6 +469,17 @@ def service_stats(master: Master) -> dict:
         # fsm_rescache_*); None when [rescache] is off
         "rescache": (None if master.miner._rescache is None
                      else master.miner._rescache.stats()),
+        # weighted-fair multi-tenant admission (service/fairness.py):
+        # tenant vocabulary, weights, live per-tenant queue depths
+        # (canonical series: fsm_tenant_*); None when [fairness] is off
+        "fairness": (None if master.miner._fair is None
+                     else {**master.miner._fair.stats(),
+                           "queued": master.miner.tenant_depths()}),
+        # elastic control plane (service/autoscale.py): leader, last
+        # evaluation, desired record (canonical series:
+        # fsm_autoscale_*); None when [autoscale] is off
+        "autoscale": (None if master.autoscaler is None
+                      else master.autoscaler.stats()),
         # warm-path observability: distinct compiled geometries seen,
         # plus the last prewarm's per-key compile walls (if any ran)
         "shape_keys_recorded": len(shapereg.recorded()),
@@ -602,6 +655,16 @@ def main() -> None:
               f"{len(report['failed'])} failed durably, "
               f"{len(report['cleared'])} journal entries cleared",
               flush=True)
+    scaler = server.master.autoscaler  # type: ignore[attr-defined]
+    if scaler is not None:
+        # a drain directive (scale-down victim) exits this process once
+        # the queue has been stolen/adopted: stopping the serve loop
+        # hands control to the teardown below, same as SIGTERM
+        scaler.on_drained = lambda report: threading.Thread(
+            target=server.shutdown, daemon=True).start()
+        print(f"autoscale controller on (bounds "
+              f"[{scaler.min_replicas}, {scaler.max_replicas}], "
+              f"cadence {round(scaler.decide_every_s, 3)}s)", flush=True)
     mgr = server.master.miner._lease  # type: ignore[attr-defined]
     if mgr is not None:
         # multi-replica mode: peers identify this instance by replica id

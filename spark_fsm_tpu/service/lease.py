@@ -179,6 +179,9 @@ class LeaseManager:
         # periodic adoption) while held leases keep renewing so the
         # draining jobs stay fenced-safe to their end
         self._quiesced = False
+        # scale-down drain (ISSUE 13): advertised in the heartbeat so
+        # peers steal our backlog and stop counting our capacity
+        self._draining = False
         # peers cache refreshed on the heartbeat cadence: peer_free_total
         # sits on the 429 shed path, and a shed storm must not turn into
         # a KEYS storm against the shared store
@@ -526,6 +529,13 @@ class LeaseManager:
         worker's dequeue step).  False = a thief already claimed it."""
         return self._store.delete(self._adm_key(uid)) >= 1
 
+    def admission_claimed(self, uid: str) -> bool:
+        """Has a thief already claimed this queued job's marker?  The
+        DRAIN loop's poll: with the queue paused, the worker-side
+        victim drop never runs, so the drain reaps stolen entries
+        itself.  Read-only (peek) — the atomic arbiter stays the DEL."""
+        return self._store.peek(self._adm_key(uid)) is None
+
     def stolen_from_us(self, uid: str) -> None:
         """Victim-side bookkeeping when retract_admission lost the DEL
         race: drop local state, count, leave the thief's journal/lease
@@ -553,12 +563,28 @@ class LeaseManager:
             "running": m.running_count() if m is not None else 0,
             "workers": m.worker_count() if m is not None else 0,
             # the ONE derivation of free capacity — also the steal
-            # scan's budget (Miner.idle_capacity)
-            "free": m.idle_capacity() if m is not None else 0,
+            # scan's budget (Miner.idle_capacity).  A DRAINING replica
+            # advertises zero: its slots are leaving the fleet.
+            "free": (0 if self._draining else
+                     m.idle_capacity() if m is not None else 0),
             # whether this replica WILL actually steal: peers' 429
             # Retry-After hints must not point at a steal path that is
             # disabled or quiescing for shutdown
             "steal": bool(self.steal_enabled and not self._quiesced),
+            # scale-down drain state (ISSUE 13): peers steal a draining
+            # replica's queue and the autoscaler excludes it from the
+            # fleet's capacity arithmetic
+            "draining": bool(self._draining),
+            # per-tenant queued depths (fairness scheduler; {} without
+            # one) — the /admin/cluster multi-tenant load view
+            "tenants": (getattr(m, "tenant_depths", dict)()
+                        if m is not None else {}),
+            # in-flight coalescing-leader dataset fingerprints (ROADMAP
+            # 2c; [] without the result-reuse tier): peers consult this
+            # before admitting a duplicate cold mine, bounded so the
+            # heartbeat record stays compact
+            "fps": (list(getattr(m, "inflight_fps", list)())[:32]
+                    if m is not None else []),
             # metric snapshot (ISSUE 9): lifetime counters are summed
             # by readers; a dead replica's contribution vanishes with
             # its record — the aggregate view is of LIVE replicas
@@ -605,8 +631,12 @@ class LeaseManager:
             "queued": m.queue_size() if m is not None else 0,
             "running": m.running_count() if m is not None else 0,
             "workers": m.worker_count() if m is not None else 0,
-            "free": m.idle_capacity() if m is not None else 0,
+            "free": (0 if self._draining else
+                     m.idle_capacity() if m is not None else 0),
             "steal": bool(self.steal_enabled and not self._quiesced),
+            "draining": bool(self._draining),
+            "tenants": (getattr(m, "tenant_depths", dict)()
+                        if m is not None else {}),
             "held": len(self._held),
             "sheds": int(m.sheds_total()) if m is not None else 0,
             "ewma_s": (round(m.wall_ewma(), 4)
@@ -630,6 +660,7 @@ class LeaseManager:
                   "running": tot("running"), "workers": tot("workers"),
                   "free": tot("free"), "held": tot("held"),
                   "sheds": tot("sheds"),
+                  "draining": sum(1 for r in rows if r.get("draining")),
                   "lease_churn": tot("acq") + tot("lost")}
         return {"replica": self.replica_id, "lease_ttl_s": self.lease_ttl_s,
                 "heartbeat_s": self.heartbeat_s, "totals": totals,
@@ -833,6 +864,39 @@ class LeaseManager:
         queued job only to give it a durable 'service shutting down'
         failure the client never deserved."""
         self._quiesced = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def set_draining(self, flag: bool = True) -> None:
+        """Flip the scale-down drain state (Miner.drain): the heartbeat
+        advertises ``draining`` with zero free capacity and the steal/
+        adoption pulls stop — a departing replica must shed load, not
+        attract it.  Publishes a fresh heartbeat immediately (best
+        effort) so peers see the transition within one round-trip, not
+        one heartbeat period."""
+        self._draining = bool(flag)
+        if flag:
+            self._quiesced = True
+        try:
+            self.publish_heartbeat()
+        except Exception as exc:
+            log_event("lease_drain_heartbeat_failed", error=str(exc))
+
+    def peer_inflight_fp(self, fp: str) -> bool:
+        """Is ``fp`` (a dataset fingerprint) currently in flight as a
+        coalescing leader on some peer?  Served from the heartbeat-
+        cadence peer cache (the submit hot path must not scan the
+        store); False on any error — the hint only ever costs a
+        duplicate mine, never correctness."""
+        try:
+            for p in self.peers(max_age_s=max(self.heartbeat_s, 1.0)):
+                if fp in (p.get("fps") or ()):
+                    return True
+        except Exception:
+            pass
+        return False
 
     def stop(self) -> None:
         self._stop.set()

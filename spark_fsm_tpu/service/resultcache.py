@@ -14,11 +14,20 @@ redundant work off the device entirely, in three cooperating layers:
   spelled the source.  INLINE payloads hash at admission (the request
   carries the content); SYNTH specs are deterministic generators whose
   spec→fingerprint mapping is learned at first load
-  (``fsm:rescache-src:{srckey}``); mutable sources (FILE/TRACKED/JDBC/
-  ELASTIC/PIWIK) never resolve a fingerprint at admission — their
-  content can change under the same spelling, so they only coalesce
-  (identical in-flight spec) and populate entries for OTHER spellings
-  (an INLINE request for the same bytes still hits).
+  (``fsm:rescache-src:{srckey}``); FILE paths resolve through the SAME
+  learned mapping gated on an immutability validator (mtime + size +
+  content sample, data/spmf.file_validator — ISSUE 13 / ROADMAP 2b):
+  an untouched artifact fp-resolves at admission and unlocks dominance
+  serving for the FILE spelling, any mismatch falls back to the
+  mutable path; truly mutable sources (TRACKED/JDBC/ELASTIC/PIWIK)
+  never resolve a fingerprint at admission — their content can change
+  under the same spelling, so they only coalesce (identical in-flight
+  spec) and populate entries for OTHER spellings (an INLINE request
+  for the same bytes still hits).  In CLUSTER mode each replica's
+  heartbeat piggybacks its in-flight leaders' fingerprints; a local
+  miss whose fp is in flight on a peer sheds with a ~2-heartbeat
+  Retry-After instead of admitting a duplicate cold mine (ROADMAP 2c
+  — a hint: replica-local coalescing semantics are unchanged).
 
 - **In-flight coalescing**: an identical request (same dataset
   identity, algorithm, and effective result-affecting parameters —
@@ -135,9 +144,22 @@ _NON_SOURCE_PARAMS = frozenset({
 })
 
 # sources whose content can change under the same request spelling —
-# never fingerprint-resolvable at admission (see module docstring)
+# never fingerprint-resolvable at admission (see module docstring).
+# FILE left this set in ISSUE 13 (ROADMAP 2b): an mtime+size+content-
+# sample validator (data/spmf.file_validator) now witnesses that a
+# path still names the bytes it named at the last load, so IMMUTABLE
+# file artifacts fp-resolve at admission and unlock dominance serving;
+# any validator mismatch falls back to this mutable (coalesce-only)
+# path.
 _MUTABLE_SOURCES = frozenset(
-    {"FILE", "TRACKED", "JDBC", "ELASTIC", "PIWIK"})
+    {"TRACKED", "JDBC", "ELASTIC", "PIWIK"})
+
+_PEER_HINTS = obs.REGISTRY.counter(
+    "fsm_rescache_peer_hints_total",
+    "submits shed with a peer-aware Retry-After because an identical "
+    "dataset fingerprint was in flight on a peer replica (ROADMAP 2c: "
+    "the cross-replica coalesce hint — the retry hits the cache entry "
+    "the peer publishes)")
 
 
 def entry_key(fp: str, algo: str) -> str:
@@ -164,18 +186,23 @@ def _conf_frac(minconf: float) -> Tuple[int, int]:
 
 class _Identity:
     """A request's reuse identity: source key (hash of the source
-    spelling), optional content fingerprint, and the normalized
-    result-affecting params (plugins.effective_params)."""
+    spelling), optional content fingerprint, the normalized
+    result-affecting params (plugins.effective_params), and — for FILE
+    spellings — the immutability validator that gates the learned
+    path→fingerprint mapping."""
 
-    __slots__ = ("source", "srckey", "stable", "fp", "params")
+    __slots__ = ("source", "srckey", "stable", "fp", "params",
+                 "validator")
 
     def __init__(self, source: str, srckey: str, stable: bool,
-                 fp: Optional[str], params: dict):
+                 fp: Optional[str], params: dict,
+                 validator: Optional[dict] = None):
         self.source = source
         self.srckey = srckey
         self.stable = stable
         self.fp = fp
         self.params = params
+        self.validator = validator
 
 
 class _Follower:
@@ -224,6 +251,18 @@ class ResultCache:
         # uids intercepted as prospective leaders, awaiting the admit
         # outcome (promoted just before enqueue, dropped on any abort)
         self._pending: Dict[str, str] = {}
+        # prospective leaders' resolved dataset fingerprints — becomes
+        # the heartbeat's in-flight hint (ROADMAP 2c) once promoted
+        self._pending_fp: Dict[str, str] = {}
+        # FILE requests' ADMISSION-time validators, keyed by uid: the
+        # learned path→fp mapping is stored only when the load-time
+        # validator equals this one, proving the file did not change
+        # between admission and the load whose parse produced the
+        # fingerprint (without the check, a rewrite racing a slow load
+        # would bind the OLD content's fp to the NEW file's validator
+        # and serve stale results).  Size-capped: a dropped entry only
+        # loses one job's reuse, never correctness.
+        self._admit_validator: Dict[str, dict] = {}
 
     # ------------------------------------------------------------ identity
 
@@ -250,6 +289,23 @@ class ResultCache:
             spec = {"source": source,
                     "dataset": req.param("dataset", "bms_webview1"),
                     "scale": repr(float(req.param("scale", "0.01")))}
+        elif source == "FILE":
+            # FILE artifacts (ROADMAP 2b): the path names the content
+            # only while the immutability validator holds — computed
+            # here (one stat + a bounded head/tail sample read, far
+            # cheaper than the parse the worker pays anyway) and
+            # compared against the learned mapping in _resolve_fp.
+            # None (unreadable path) degrades to the mutable path; the
+            # cold mine surfaces the real error.
+            from spark_fsm_tpu.data.spmf import file_validator
+
+            path = req.param("path") or ""
+            spec = {"source": source, "path": path}
+            validator = file_validator(path) if path else None
+            srckey = hashlib.sha256(
+                json.dumps(spec, sort_keys=True).encode()).hexdigest()
+            return _Identity(source, srckey, False, None, params,
+                             validator=validator)
         else:
             # every non-control param is source-naming (path, db, url,
             # query, topic, ... and for custom sources even an inline
@@ -266,19 +322,31 @@ class ResultCache:
 
     def _resolve_fp(self, ident: _Identity) -> Optional[str]:
         """Admission-time fingerprint: direct for INLINE, learned map
-        for SYNTH, None for mutable sources (their spelling does not
+        for SYNTH, validator-gated learned map for FILE (the mapping
+        is trusted only while the immutability witness still matches
+        the one recorded at load — a touched/rewritten file misses and
+        mines cold), None for mutable sources (their spelling does not
         pin their content)."""
         if ident.fp is not None:
             return ident.fp
-        if not ident.stable:
+        if not ident.stable and ident.validator is None:
             return None
         raw = self.store.peek(_src_key(ident.srckey))
         if not raw:
             return None
         try:
-            return json.loads(raw).get("fp") or None
+            ent = json.loads(raw)
         except ValueError:
             return None
+        if not isinstance(ent, dict):
+            return None
+        if ident.stable:
+            return ent.get("fp") or None
+        # FILE: the learned fingerprint holds only under an EXACT
+        # validator match (mtime_ns + size + content sample)
+        if ent.get("validator") == ident.validator:
+            return ent.get("fp") or None
+        return None
 
     def _ckey(self, fp: Optional[str], ident: _Identity) -> str:
         """Coalescing identity: dataset (fingerprint when resolvable,
@@ -317,8 +385,29 @@ class ResultCache:
                 ckey = self._ckey(fp, ident)
                 if self._try_follow(req, ckey, priority, deadline_s):
                     return "coalesced"
+                if (fp is not None and self.mgr is not None
+                        and self.mgr.peer_inflight_fp(fp)):
+                    # cross-replica coalesce HINT (ROADMAP 2c): the
+                    # fingerprint is in flight on a peer — tell the
+                    # submit layer to shed with a ~2-heartbeat
+                    # Retry-After instead of admitting a duplicate
+                    # cold mine.  Hint only: nothing here attaches
+                    # across replicas, and the retry either hits the
+                    # entry the peer published or mines cold.
+                    _PEER_HINTS.inc()
+                    log_event("rescache_peer_hint", uid=req.uid,
+                              fp=fp[:16])
+                    return "peer-inflight"
                 with self._lock:
                     self._pending[req.uid] = ckey
+                    if fp is not None:
+                        self._pending_fp[req.uid] = fp
+            if ident.validator is not None:
+                with self._lock:
+                    self._admit_validator[req.uid] = ident.validator
+                    while len(self._admit_validator) > 1024:
+                        self._admit_validator.pop(
+                            next(iter(self._admit_validator)))
             _MISSES.inc()
             return None
         except Exception as exc:
@@ -327,6 +416,7 @@ class ResultCache:
                       error=str(exc))
             with self._lock:
                 self._pending.pop(req.uid, None)
+                self._pending_fp.pop(req.uid, None)
             return None
 
     def leader_admitted(self, uid: str) -> None:
@@ -336,16 +426,29 @@ class ResultCache:
         already settled."""
         with self._lock:
             ckey = self._pending.pop(uid, None)
+            fp = self._pending_fp.pop(uid, None)
             if ckey is None or ckey in self._leaders:
                 return  # two same-key admits raced: first one leads
             self._leaders[ckey] = uid
-            self._by_leader[uid] = {"ckey": ckey, "followers": []}
+            self._by_leader[uid] = {"ckey": ckey, "followers": [],
+                                    "fp": fp}
 
     def admit_aborted(self, uid: str) -> None:
         """Drop a prospective leader whose admission never enqueued
         (shed, conflict, journal failure, shutdown)."""
         with self._lock:
             self._pending.pop(uid, None)
+            self._pending_fp.pop(uid, None)
+            self._admit_validator.pop(uid, None)
+
+    def inflight_fps(self) -> List[str]:
+        """Dataset fingerprints of live coalescing leaders — the
+        heartbeat snapshot's cross-replica hint payload (bounded by
+        the caller; a leader whose fp is still unknown contributes
+        nothing)."""
+        with self._lock:
+            return sorted({s["fp"] for s in self._by_leader.values()
+                           if s.get("fp")})
 
     # ---------------------------------------------------------- coalescing
 
@@ -475,12 +578,34 @@ class ResultCache:
             if ctl is not None:
                 ctl.dataset_fp = fp
             ident = self._identity(req)
-            if ident.stable and ident.fp is None:
+            learnable = ident.stable
+            if ident.validator is not None:
+                # FILE: the mapping may only bind this validator to
+                # this fingerprint if the file provably did NOT change
+                # between admission and now — the admission-time
+                # validator must equal the one just recomputed.  A
+                # rewrite racing the (possibly seconds-long) load
+                # would otherwise pair the OLD content's fp with the
+                # NEW file's validator and serve stale results; on any
+                # mismatch (or an unknown admission validator) we skip
+                # learning and the next untouched-run stores it.
+                with self._lock:
+                    v_admit = self._admit_validator.pop(req.uid, None)
+                learnable = v_admit == ident.validator
+            if ident.fp is None and learnable:
                 # SYNTH: the deterministic generator spec now provably
-                # names this content — admission can resolve it next time
-                self.store.set(_src_key(ident.srckey),
-                               json.dumps({"fp": fp, "source":
-                                           ident.source}))
+                # names this content — admission can resolve it next
+                # time.  FILE: witnessed-unchanged across the load.
+                self.store.set(_src_key(ident.srckey), json.dumps(
+                    {"fp": fp, "source": ident.source,
+                     "validator": ident.validator}))
+            # in-flight hint upkeep (ROADMAP 2c): a leader whose fp was
+            # unknown at admission (first FILE mine of a path) becomes
+            # visible to peers once the dataset is loaded
+            with self._lock:
+                state = self._by_leader.get(req.uid)
+                if state is not None:
+                    state["fp"] = fp
             return fp
         except Exception as exc:
             _ERRORS.inc(op="store")

@@ -1,0 +1,445 @@
+"""Elastic control plane drills (ISSUE 13, service/autoscale.py +
+Miner.drain).
+
+Two kinds of test, the PR 8 pattern:
+
+- HERMETIC controller tests: autoscalers + lease managers + an
+  in-process store share one VIRTUAL monotonic clock, so leader
+  election, hysteresis, cooldown and expiry are exact — no sleeps.
+- END-TO-END drain drills: real ``Miner``s ("replicas") share one
+  store; the drain protocol runs against real worker threads and the
+  real steal/recovery machinery, driven by manual heartbeat ticks.
+
+The acceptance pins: sustained load → ONE scale-up decision record;
+load oscillating inside the hysteresis band → ZERO decisions; scale-
+down picks the least-loaded replica and the victim drains — queue
+stolen by peers, zero lost jobs, oracle parity; a thief dying mid-
+drain heals via periodic recovery."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.service import autoscale as AS
+from spark_fsm_tpu.service import sources
+from spark_fsm_tpu.service.actors import Master
+from spark_fsm_tpu.service.lease import LeaseManager
+from spark_fsm_tpu.service.model import ServiceRequest, \
+    deserialize_patterns
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import obs
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+DRILL_TIMEOUT_S = 120.0
+
+
+def _acfg(**kw):
+    base = {"min_replicas": 1, "max_replicas": 8,
+            "up_queue_per_worker": 2.0, "down_free_frac": 0.5,
+            "hold_s": 10.0, "cooldown_s": 30.0, "leader_ttl_s": 3.0,
+            "drain_timeout_s": 60.0}
+    base.update(kw)
+    return cfgmod.parse_config(
+        {"autoscale": {"enabled": True, **base},
+         "cluster": {"enabled": True}}).autoscale
+
+
+class FakeMiner:
+    """Duck-typed load source for controller-only tests."""
+
+    def __init__(self, workers=2):
+        self.q = 0
+        self.r = 0
+        self.w = workers
+        self.draining = False
+        self.drained_with = None
+
+    def queue_size(self):
+        return self.q
+
+    def running_count(self):
+        return self.r
+
+    def worker_count(self):
+        return self.w
+
+    def idle_capacity(self):
+        return max(0, self.w - self.r - self.q)
+
+    def sheds_total(self):
+        return 0
+
+    def wall_ewma(self):
+        return None
+
+    def tenant_depths(self):
+        return {}
+
+    def inflight_fps(self):
+        return []
+
+    def drain(self, timeout_s=None, reason=""):
+        self.draining = True
+        self.drained_with = {"timeout_s": timeout_s, "reason": reason}
+        return {"outcome": "clean", "reason": reason,
+                "left_for_recovery": 0}
+
+
+def _rig(n=2, **acfg_kw):
+    """n (scaler, fake-miner, mgr) triples on one virtual-clock store."""
+    t = [0.0]
+    store = ResultStore(clock=lambda: t[0])
+    out = []
+    cfg = _acfg(**acfg_kw)
+    for i in range(n):
+        mgr = LeaseManager(store, replica_id=f"as-{i}",
+                           lease_ttl_s=30.0, heartbeat_s=0,
+                           clock=lambda: t[0])
+        m = FakeMiner()
+        mgr.start(m)
+        sc = AS.Autoscaler(m, mgr, acfg=cfg, decide_every_s=0,
+                           clock=lambda: t[0])
+        out.append((sc, m, mgr))
+    return t, store, out
+
+
+def _decisions():
+    fam = obs.REGISTRY.snapshot().get("fsm_autoscale_decisions_total", {})
+    fam = fam if isinstance(fam, dict) else {}
+    return {"up": fam.get("dir=up", 0), "down": fam.get("dir=down", 0)}
+
+
+# ---------------------------------------------------------------- election
+
+
+def test_exactly_one_leader_and_failover_after_ttl():
+    t, store, rigs = _rig(2)
+    (sc_a, _, _), (sc_b, _, _) = rigs
+    sc_a.tick()
+    sc_b.tick()
+    rec = json.loads(store.peek(AS.LEADER_KEY))
+    assert rec["replica"] == "as-0"
+    assert sc_a.stats()["is_leader"] and not sc_b.stats()["is_leader"]
+    # the leader dies (stops ticking); its lease expires on the store
+    # clock and the survivor takes over with a larger token
+    tok0 = rec["token"]
+    t[0] = 10.0  # > leader_ttl_s
+    sc_b.tick()
+    rec = json.loads(store.peek(AS.LEADER_KEY))
+    assert rec["replica"] == "as-1"
+    assert rec["token"] > tok0
+
+
+# --------------------------------------------------------------- decisions
+
+
+def test_sustained_load_scales_up_once_after_hold():
+    t, store, rigs = _rig(1, hold_s=10.0, cooldown_s=100.0)
+    sc, m, mgr = rigs[0]
+    d0 = _decisions()
+    m.q = 10  # load 5.0/worker > 2.0
+    sc.tick()  # signal starts holding at t=0
+    assert store.peek(AS.DESIRED_KEY) is None  # hysteresis: not yet
+    t[0] = 5.0
+    sc.tick()
+    assert store.peek(AS.DESIRED_KEY) is None
+    t[0] = 10.0
+    sc.tick()  # held for hold_s: decision fires
+    rec = json.loads(store.peek(AS.DESIRED_KEY))
+    assert rec["dir"] == "up" and rec["desired"] == 2 \
+        and rec["replicas"] == 1
+    assert rec["leader"] == "as-0" and rec["seq"] > 0
+    assert "queued/worker" in rec["reason"]
+    d1 = _decisions()
+    assert d1["up"] == d0["up"] + 1
+    # the decision log ring recorded it
+    assert sc.decision_log()[-1]["seq"] == rec["seq"]
+    # still loaded, but inside the cooldown: no second decision
+    t[0] = 25.0
+    sc.tick()
+    assert _decisions()["up"] == d1["up"]
+
+
+def test_oscillating_load_inside_the_band_never_decides():
+    """The flap pin: load alternating above/below the up threshold
+    faster than hold_s accumulates no hold time — zero decisions over
+    many ticks."""
+    t, store, rigs = _rig(1, hold_s=10.0)
+    sc, m, mgr = rigs[0]
+    d0 = _decisions()
+    for i in range(40):
+        m.q = 10 if i % 2 == 0 else 1  # load 5.0 / 0.5, band is 2.0
+        t[0] += 4.0  # < hold_s between flips
+        sc.tick()
+    assert _decisions() == d0
+    assert store.peek(AS.DESIRED_KEY) is None
+
+
+def test_p99_signal_scales_up():
+    from spark_fsm_tpu.service import obsplane
+
+    t, store, rigs = _rig(1, up_p99_s=1.0, hold_s=0.0)
+    sc, m, mgr = rigs[0]
+    d0 = _decisions()
+    obsplane.clear_slo()
+    try:
+        for _ in range(20):
+            obsplane.observe_job("normal", 5.0, 1.0, 4.0)
+        t[0] = 1.0
+        sc.tick()
+        rec = json.loads(store.peek(AS.DESIRED_KEY))
+        assert rec["dir"] == "up" and "p99" in rec["reason"]
+        assert _decisions()["up"] == d0["up"] + 1
+    finally:
+        obsplane.clear_slo()
+
+
+def test_scale_down_targets_least_loaded_and_respects_min():
+    t, store, rigs = _rig(2, hold_s=5.0, min_replicas=1,
+                          down_free_frac=0.5)
+    (sc_a, m_a, mgr_a), (sc_b, m_b, mgr_b) = rigs
+    # both replicas idle; B advertises itself via heartbeat so the
+    # leader's cluster view sees two live rows
+    m_a.r, m_b.r = 1, 0  # A busier: the victim must be B
+    mgr_b.publish_heartbeat()
+    d0 = _decisions()
+    sc_a.tick()  # leader + signal start
+    t[0] = 5.0
+    mgr_b.publish_heartbeat()
+    sc_a.tick()
+    rec = json.loads(store.peek(AS.DESIRED_KEY))
+    assert rec["dir"] == "down" and rec["desired"] == 1
+    assert rec["victim"] == "as-1"
+    assert _decisions()["down"] == d0["down"] + 1
+    assert store.peek(AS.drain_key("as-1")) is not None
+    # min_replicas floor: with one live replica left no further down
+    # decision is possible (B claims its directive + reports drained)
+    sc_b.tick()
+    deadline = time.time() + 10.0
+    while time.time() < deadline and not m_b.draining:
+        time.sleep(0.01)
+    assert m_b.draining
+    assert m_b.drained_with["reason"]
+    deadline = time.time() + 10.0
+    while time.time() < deadline and \
+            store.peek(AS.drained_key("as-1")) is None:
+        time.sleep(0.01)
+    assert store.peek(AS.drained_key("as-1")) is not None
+    assert store.peek(AS.drain_key("as-1")) is None  # claimed via DEL
+
+
+def test_no_scale_down_at_min_replicas():
+    t, store, rigs = _rig(1, hold_s=0.0, min_replicas=1)
+    sc, m, mgr = rigs[0]
+    d0 = _decisions()
+    t[0] = 100.0
+    sc.tick()  # idle single replica: down signal blocked by the floor
+    assert _decisions() == d0
+
+
+def test_draining_replica_stops_evaluating():
+    t, store, rigs = _rig(1)
+    sc, m, mgr = rigs[0]
+    m.draining = True
+    m.q = 100
+    t[0] = 100.0
+    sc.tick()
+    sc.tick()
+    assert store.peek(AS.LEADER_KEY) is None  # never even ran election
+
+
+def test_autoscale_config_validation():
+    with pytest.raises(cfgmod.ConfigError, match="cluster"):
+        cfgmod.parse_config({"autoscale": {"enabled": True}})
+    with pytest.raises(cfgmod.ConfigError, match="max_replicas"):
+        cfgmod.parse_config({"autoscale": {
+            "min_replicas": 4, "max_replicas": 2}})
+    with pytest.raises(cfgmod.ConfigError, match="down_free_frac"):
+        cfgmod.parse_config({"autoscale": {"down_free_frac": 1.5}})
+    with pytest.raises(cfgmod.ConfigError, match="leader_ttl_s"):
+        cfgmod.parse_config({"autoscale": {"leader_ttl_s": 0}})
+    with pytest.raises(cfgmod.ConfigError, match="up_queue_per_worker"):
+        cfgmod.parse_config({"autoscale": {"up_queue_per_worker": 0}})
+
+
+# ------------------------------------------------------------ drain drills
+
+
+def _req(uid, **extra):
+    data = {"algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n", "support": "1.0",
+            "uid": uid}
+    data.update({k: str(v) for k, v in extra.items()})
+    return ServiceRequest("fsm", "train", data)
+
+
+def _await_terminal(store, uid, timeout=DRILL_TIMEOUT_S):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = store.status(uid)
+        if st in ("finished", "failure"):
+            return st
+        time.sleep(0.01)
+    raise TimeoutError(f"job {uid} reached no terminal status "
+                       f"(now {store.status(uid)!r})")
+
+
+def test_drain_under_full_queue_peers_steal_everything(monkeypatch):
+    """The ISSUE 13 drain drill: replica A drains while holding one
+    RUNNING job and four QUEUED ones.  Idle peer B steals the entire
+    queue off A's admission namespace (the drain loop reaps the
+    claimed markers — the paused queue cannot shrink itself), the
+    running job finishes on A, the drain reports clean, and every job
+    lands finished with oracle parity — zero lost, zero duplicated."""
+    store = ResultStore()
+    mk = lambda rid: LeaseManager(store, replica_id=rid,
+                                  lease_ttl_s=30.0, heartbeat_s=0)
+    mgr_a, mgr_b = mk("rep-a"), mk("rep-b")
+    master_a = Master(store=store, miner_workers=1, lease_mgr=mgr_a)
+    master_b = Master(store=store, miner_workers=1, lease_mgr=mgr_b)
+    gate = threading.Event()
+    entered = threading.Event()
+    real = sources.get_db
+
+    def gated(req, store_):
+        if req.uid == "hold" and not entered.is_set():
+            entered.set()
+            assert gate.wait(DRILL_TIMEOUT_S)
+        return real(req, store_)
+
+    monkeypatch.setattr(sources, "get_db", gated)
+    db = synthetic_db(seed=61, n_sequences=80, n_items=10,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    want = mine_spade(db, abs_minsup(0.1, len(db)))
+    uids = [f"steal-me-{i}" for i in range(4)]
+    drops0 = obs.REGISTRY.snapshot()["fsm_steal_victim_drops_total"]
+    try:
+        master_a.miner.submit(_req("hold"))
+        assert entered.wait(DRILL_TIMEOUT_S)
+        for uid in uids:
+            master_a.miner.submit(_req(
+                uid, algorithm="SPADE_TPU", sequences=format_spmf(db),
+                support="0.1"))
+        assert master_a.miner.queue_size() == 4
+        report = {}
+        th = threading.Thread(
+            target=lambda: report.update(
+                master_a.miner.drain(timeout_s=DRILL_TIMEOUT_S,
+                                     reason="drill")))
+        th.start()
+        # B's heartbeat ticks: sees draining A with 4 queued, steals
+        # one per tick as its single worker frees up
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline and master_a.miner.queue_size():
+            mgr_b.tick()
+            time.sleep(0.05)
+        assert master_a.miner.queue_size() == 0, "B never emptied A"
+        gate.set()  # the running job finishes on A
+        th.join(DRILL_TIMEOUT_S)
+        assert not th.is_alive(), "drain never returned"
+        assert report["outcome"] == "clean", report
+        assert report["stolen_by_peers"] == 4, report
+        assert report["left_for_recovery"] == 0
+        # zero lost: every job terminal-finished; stolen ones with
+        # byte-exact oracle parity (zero duplicated results)
+        for uid in uids + ["hold"]:
+            assert _await_terminal(store, uid) == "finished"
+        for uid in uids:
+            got = deserialize_patterns(store.patterns(uid))
+            assert patterns_text(got) == patterns_text(want)
+        # the victim-side drop accounting moved through the drain reap
+        drops = obs.REGISTRY.snapshot()["fsm_steal_victim_drops_total"]
+        assert drops >= drops0 + 4
+        # A sheds new submits while drained, pointing at the peers
+        from spark_fsm_tpu.service.actors import AdmissionShed
+
+        with pytest.raises(AdmissionShed, match="draining"):
+            master_a.miner.submit(_req("late"))
+        assert store.status("late") is None
+        # bookkeeping: journals/markers/leases all settled
+        assert store.journal_uids() == []
+        assert store.keys("fsm:admission:") == []
+    finally:
+        gate.set()
+        master_b.shutdown()
+        master_a.shutdown()
+
+
+def test_thief_death_mid_drain_heals_via_periodic_recovery():
+    """A thief that claims a draining replica's marker and dies before
+    resubmitting leaves a journal orphan under its own (now orphaned)
+    lease; the drain times out, leaves the job adoptable, and the
+    survivor's periodic recovery adopts + resumes it exactly once."""
+    t = [0.0]
+    store = ResultStore(clock=lambda: t[0])
+    mk = lambda rid: LeaseManager(store, replica_id=rid,
+                                  lease_ttl_s=30.0, heartbeat_s=0,
+                                  clock=lambda: t[0])
+    mgr_a, mgr_b = mk("rep-a"), mk("rep-b")
+    # A has ZERO workers: its queued job can never start locally, so
+    # the drill is deterministic without gating
+    master_a = Master(store=store, miner_workers=0, lease_mgr=mgr_a)
+    master_b = Master(store=store, miner_workers=1, lease_mgr=mgr_b)
+    db = synthetic_db(seed=62, n_sequences=80, n_items=10,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    want = mine_spade(db, abs_minsup(0.1, len(db)))
+    try:
+        master_a.miner.submit(_req(
+            "orphan", algorithm="SPADE_TPU", sequences=format_spmf(db),
+            support="0.1", checkpoint="1", checkpoint_every_s="0"))
+        # burn B's recovery cadence at t=0 so the NEXT fire needs the
+        # clock advance below (deterministic ordering)
+        mgr_b.tick()
+        # --- the thief's partial claim, verbatim protocol steps, then
+        # death: marker DEL'd, lease overwritten with a larger token,
+        # journal NOT rewritten, no resubmit
+        assert store.delete("fsm:admission:rep-a:orphan") == 1
+        tok = int(store.incr("fsm:lease:token"))
+        store.set_px("fsm:lease:orphan",
+                     json.dumps({"replica": "rep-c", "token": tok}),
+                     30_000)
+        # from A's viewpoint the claim IS a steal (a claimed marker is
+        # indistinguishable from a live thief), so the drain reaps the
+        # entry and reports clean — the heal still happens below, via
+        # recovery, exactly because the journal was never settled
+        report = master_a.miner.drain(timeout_s=0.5, reason="drill")
+        assert report["outcome"] == "clean"
+        assert report["stolen_by_peers"] == 1
+        assert report["left_for_recovery"] == 0
+        assert store.journal_get("orphan") is not None
+        assert store.status("orphan") == "started"  # not settled
+        # dead thief's lease expires; B's periodic recovery adopts
+        t[0] = 40.0
+        mgr_b.tick()
+        assert _await_terminal(store, "orphan") == "finished"
+        got = deserialize_patterns(store.patterns("orphan"))
+        assert patterns_text(got) == patterns_text(want)
+        assert store.journal_uids() == []
+        snap = obs.REGISTRY.snapshot()["fsm_recovery_jobs_total"]
+        assert snap.get("outcome=resumed", 0) >= 1
+    finally:
+        master_b.shutdown()
+        master_a.shutdown()
+
+
+def test_drain_solo_settles_leftovers_durably():
+    """Without a cluster nobody can adopt: a solo drain's leftovers
+    get a durable failure (keep_frontier) instead of a stuck uid."""
+    store = ResultStore()
+    master = Master(store=store, miner_workers=0)
+    try:
+        master.miner.submit(_req("left0"))
+        report = master.miner.drain(timeout_s=0.3, reason="drill")
+        assert report["outcome"] == "timeout"
+        assert store.status("left0") == "failure"
+        assert "draining" in store.get("fsm:error:left0")
+        assert store.journal_get("left0") is None
+    finally:
+        master.shutdown()

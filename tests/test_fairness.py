@@ -1,0 +1,333 @@
+"""Weighted-fair multi-tenant admission (ISSUE 13,
+service/fairness.py): DRR service order, per-tenant occupancy caps
+with bucket-derived Retry-After, the bounded tenant vocabulary, and
+the flood-tenant starvation drill.
+
+The acceptance contract: fairness layers UNDER the strict priority
+classes (a high job from any tenant beats every normal job), a
+flooding tenant sheds 429s with ITS OWN refill-derived Retry-After
+while other tenants' goodput holds at their weight-fair share, and
+the disabled path leaves the queue byte-for-byte FIFO."""
+
+import threading
+import time
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.service import fairness, sources
+from spark_fsm_tpu.service.actors import (AdmissionQueue, AdmissionShed,
+                                          Master, Miner)
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.store import ResultStore
+
+DRILL_TIMEOUT_S = 120.0
+
+
+def _cfg(**fair):
+    fair.setdefault("enabled", True)
+    return cfgmod.parse_config({"fairness": fair})
+
+
+@pytest.fixture
+def fairness_on(request):
+    """Boot config with fairness enabled (+ optional marker-style
+    overrides via indirect param); restored after."""
+    old = cfgmod.get_config()
+    overrides = getattr(request, "param", {})
+    cfgmod.set_config(_cfg(**overrides))
+    yield cfgmod.get_config()
+    cfgmod.set_config(old)
+
+
+def _req(uid, **extra):
+    data = {"algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n", "support": "1.0",
+            "uid": uid}
+    data.update({k: str(v) for k, v in extra.items()})
+    return ServiceRequest("fsm", "train", data)
+
+
+def _wait(store, uid, timeout=DRILL_TIMEOUT_S):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = store.status(uid)
+        if st in ("finished", "failure"):
+            return st
+        time.sleep(0.01)
+    raise TimeoutError(f"job {uid} reached no terminal status")
+
+
+def _queue(weights=None, depth=0, **fair):
+    cfg = _cfg(weights=weights or {}, **fair)
+    return AdmissionQueue(depth,
+                          fair=fairness.TenantScheduler(cfg.fairness))
+
+
+def _fill(q, tenant, n, priority="normal", prefix=None):
+    for i in range(n):
+        ok, *_ = q.try_reserve(priority, tenant)
+        assert ok
+        q.put(_req(f"{prefix or tenant}{i}"), priority, tenant)
+
+
+# ----------------------------------------------------------- DRR mechanics
+
+
+def test_drr_interleaves_equal_weights_round_robin():
+    q = _queue()
+    _fill(q, "a", 4)
+    _fill(q, "b", 4)
+    order = [q.get().uid[0] for _ in range(8)]
+    # one job per tenant per round: strict alternation
+    assert order == list("abababab")
+
+
+def test_drr_serves_proportionally_to_weights():
+    q = _queue(weights={"gold": 2.0, "free": 1.0})
+    _fill(q, "gold", 8)
+    _fill(q, "free", 8)
+    first9 = [q.get().uid for _ in range(9)]
+    n_gold = sum(1 for u in first9 if u.startswith("gold"))
+    # 2:1 service ratio over three rounds of 3
+    assert n_gold == 6, first9
+
+
+def test_drr_idle_tenant_banked_credit_does_not_starve():
+    q = _queue()
+    _fill(q, "a", 6)
+    # serve a few of a's jobs while b is idle
+    for _ in range(3):
+        assert q.get().uid.startswith("a")
+    # b arrives late: it gets its fair share from NOW, not a banked
+    # backlog of quanta for the rounds it sat out
+    _fill(q, "b", 3)
+    order = [q.get().uid[0] for _ in range(6)]
+    assert order.count("b") == 3
+    assert order[:2] != ["b", "b"], order
+
+
+def test_priority_classes_stay_strict_above_fairness():
+    q = _queue()
+    _fill(q, "a", 3, priority="normal")
+    _fill(q, "b", 1, priority="high", prefix="hi-b")
+    # the high-class job wins regardless of tenant round-robin state
+    assert q.get().uid == "hi-b0"
+
+
+def test_remove_uid_and_pop_all_keep_tenant_accounting():
+    q = _queue()
+    _fill(q, "a", 3)
+    _fill(q, "b", 2)
+    assert q.remove("a1") is not None
+    assert q.tenant_depths() == {"a": 2, "b": 2}
+    rest = q.pop_all()
+    assert len(rest) == 4
+    assert q.tenant_depths() == {}
+    assert q.size() == 0
+
+
+# ------------------------------------------------- caps, sheds, Retry-After
+
+
+def test_tenant_cap_sheds_with_tenant_counts():
+    q = _queue(tenant_depth=2, depth=100)
+    _fill(q, "flood", 2)
+    ok, queued, ahead, scope = q.try_reserve("normal", "flood")
+    assert (ok, scope) == (False, "tenant")
+    assert queued == 2  # the TENANT's occupancy, not the global depth
+    # other tenants are untouched by the flood's cap
+    ok, *_ , scope = q.try_reserve("normal", "quiet")
+    assert ok and scope == ""
+
+
+def test_global_bound_still_binds_under_fairness():
+    q = _queue(tenant_depth=0, depth=2)
+    _fill(q, "a", 2)
+    ok, queued, ahead, scope = q.try_reserve("normal", "b")
+    assert (ok, scope) == (False, "queue")
+    assert queued == 2
+
+
+def test_reserve_abort_returns_tenant_token():
+    q = _queue(tenant_depth=1)
+    ok, *_ = q.try_reserve("normal", "a")
+    assert ok
+    ok, *_, scope = q.try_reserve("normal", "a")
+    assert not ok and scope == "tenant"
+    q.abort("a")
+    ok, *_, scope = q.try_reserve("normal", "a")
+    assert ok and scope == ""
+    q.abort("a")
+
+
+def test_retry_after_tracks_tenant_share():
+    sched = fairness.TenantScheduler(
+        _cfg(weights={"gold": 4.0, "free": 1.0}).fairness)
+    # same backlog, same service rate: the low-weight tenant waits
+    # proportionally longer because its bucket refills at its share
+    slow = sched.retry_after_s("free", 10, per_job_s=2.0, workers=2,
+                               active=["gold", "free"])
+    fast = sched.retry_after_s("gold", 10, per_job_s=2.0, workers=2,
+                               active=["gold", "free"])
+    assert slow > fast >= 1
+    assert slow >= 4 * fast / 2  # 4x share, integer ceil slack
+
+
+def test_miner_tenant_shed_is_429_with_own_retry(fairness_on,
+                                                 monkeypatch):
+    cfgmod.set_config(_cfg(tenant_depth=1))
+    gate = threading.Event()
+    entered = threading.Event()
+    real = sources.get_db
+
+    def gated(req, store):
+        entered.set()
+        assert gate.wait(DRILL_TIMEOUT_S)
+        return real(req, store)
+
+    monkeypatch.setattr(sources, "get_db", gated)
+    store = ResultStore()
+    miner = Miner(store, workers=1)
+    try:
+        miner.submit(_req("f0", tenant="flood"))  # runs (gated)
+        # f0 must have LEFT the queue (its token returned) before the
+        # cap=1 arithmetic below is deterministic
+        assert entered.wait(DRILL_TIMEOUT_S)
+        miner.submit(_req("f1", tenant="flood"))  # queued: cap reached
+        with pytest.raises(AdmissionShed) as exc:
+            miner.submit(_req("f2", tenant="flood"))
+        assert "tenant 'flood'" in str(exc.value)
+        assert exc.value.retry_after_s >= 1
+        # the shed left zero trace of the uid
+        assert store.status("f2") is None
+        assert store.journal_get("f2") is None
+        # a different tenant still admits — the cap is per tenant
+        miner.submit(_req("q0", tenant="quiet"))
+    finally:
+        gate.set()
+        for uid in ("f0", "f1", "q0"):
+            _wait(store, uid)
+        miner.shutdown()
+
+
+def test_bounded_tenant_vocabulary(fairness_on):
+    cfgmod.set_config(_cfg(max_tenants=2))  # "default" + one more
+    store = ResultStore()
+    miner = Miner(store, workers=1)
+    try:
+        miner.submit(_req("a0", tenant="alpha"))
+        resp_exc = None
+        try:
+            miner.submit(_req("b0", tenant="beta"))
+        except ValueError as exc:
+            resp_exc = exc
+        assert resp_exc is not None and "vocabulary full" in str(resp_exc)
+        assert store.status("b0") is None  # refused before any write
+        with pytest.raises(ValueError, match="invalid tenant"):
+            miner.submit(_req("c0", tenant="bad tenant!"))
+        # the registered tenant and the default stay usable
+        miner.submit(_req("a1", tenant="alpha"))
+        miner.submit(_req("d0"))
+    finally:
+        for uid in ("a0", "a1", "d0"):
+            _wait(store, uid)
+        miner.shutdown()
+
+
+# ------------------------------------------------------- starvation drill
+
+
+def test_flood_tenant_cannot_starve_background_tenant(fairness_on,
+                                                      monkeypatch):
+    """The ISSUE 13 fairness drill, hermetic: a flooding tenant's
+    backlog is interleaved 1:1 with the background tenant's (equal
+    weights), so the background tenant's k jobs all finish within ~2x
+    its weight-fair share of the service slots — instead of queueing
+    behind the whole flood as FIFO would."""
+    gate = threading.Event()
+    order = []
+    real = sources.get_db
+
+    def tracking(req, store):
+        if req.uid == "hold":
+            assert gate.wait(DRILL_TIMEOUT_S)
+        else:
+            order.append(req.uid)
+        return real(req, store)
+
+    monkeypatch.setattr(sources, "get_db", tracking)
+    store = ResultStore()
+    miner = Miner(store, workers=1)
+    try:
+        # occupy the single worker so the whole mix queues up first
+        miner.submit(_req("hold", tenant="flood"))
+        for i in range(12):
+            miner.submit(_req(f"fl{i}", tenant="flood"))
+        for i in range(4):
+            miner.submit(_req(f"bg{i}", tenant="bg"))
+        gate.set()
+        for i in range(4):
+            _wait(store, f"bg{i}")
+        # fair share with equal weights = every other slot: bg's 4 jobs
+        # must all have STARTED within the first 2*4 = 8 service slots
+        # (+1 slack for the round the flood leads)
+        started_before_last_bg = order.index("bg3") + 1
+        assert started_before_last_bg <= 9, order
+    finally:
+        gate.set()
+        for i in range(12):
+            _wait(store, f"fl{i}")
+        _wait(store, "hold")
+        miner.shutdown()
+
+
+def test_disabled_path_is_fifo_and_ignores_tenant():
+    q = AdmissionQueue(0)  # no scheduler: the pre-ISSUE-13 queue
+    for i in range(4):
+        ok, _, _, scope = q.try_reserve("normal",
+                                        "t%d" % (i % 2))
+        assert ok and scope == ""
+        q.put(_req(f"j{i}"), "normal")
+    assert [q.get().uid for _ in range(4)] == ["j0", "j1", "j2", "j3"]
+    assert q.tenant_depths() == {}
+
+
+def test_heartbeat_piggybacks_tenant_depths_and_drain_state(
+        fairness_on):
+    from spark_fsm_tpu.service.lease import LeaseManager
+
+    store = ResultStore()
+    mgr = LeaseManager(store, replica_id="rep-t", heartbeat_s=0)
+    miner = Miner(store, workers=1, lease_mgr=mgr)
+    try:
+        gate_req = _req("slowhb", tenant="gold")
+        # no gating needed: just check the snapshot fields exist
+        mgr.publish_heartbeat()
+        import json as _json
+
+        rec = _json.loads(store.peek("fsm:replica:rep-t"))
+        assert rec["draining"] is False
+        assert rec["tenants"] == {}
+        assert rec["fps"] == []
+        mgr.set_draining(True)
+        rec = _json.loads(store.peek("fsm:replica:rep-t"))
+        assert rec["draining"] is True and rec["free"] == 0
+        assert gate_req is not None
+    finally:
+        miner.shutdown()
+
+
+def test_fairness_config_validation():
+    with pytest.raises(cfgmod.ConfigError, match="tenant_depth"):
+        cfgmod.parse_config({"fairness": {"tenant_depth": -1}})
+    with pytest.raises(cfgmod.ConfigError, match="max_tenants"):
+        cfgmod.parse_config({"fairness": {"max_tenants": 0}})
+    with pytest.raises(cfgmod.ConfigError, match="default_weight"):
+        cfgmod.parse_config({"fairness": {"default_weight": 0}})
+    with pytest.raises(cfgmod.ConfigError, match="weight"):
+        cfgmod.parse_config(
+            {"fairness": {"weights": {"t": -2.0}}})
+    with pytest.raises(cfgmod.ConfigError, match="weight"):
+        cfgmod.parse_config(
+            {"fairness": {"weights": {"t": "not-a-number"}}})

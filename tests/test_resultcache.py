@@ -465,3 +465,151 @@ def test_cluster_mode_serve_and_coalesce(rescache_on, blocky_source):
             master.shutdown()
     finally:
         cfgmod.set_config(old)
+
+
+# --------------------------------------- FILE fingerprints (ISSUE 13, 2b)
+
+
+def _submit_file(master, uid, path, **params):
+    d = {"algorithm": "TSR_TPU", "source": "FILE", "path": str(path),
+         "k": "8", "minconf": "0.4", "max_side": "2", "uid": uid}
+    d.update({k: str(v) for k, v in params.items()})
+    resp = master.handle(ServiceRequest("fsm", "train", d))
+    assert resp.status != "failure", resp.data
+    return resp
+
+
+def test_file_validator_unlocks_admission_fp_and_dominance(
+        rescache_on, tmp_path):
+    """An immutable FILE artifact fp-resolves at admission after its
+    first load (validator-gated learned mapping), so later FILE
+    requests exact-hit AND dominated-serve — the unlock ROADMAP 2b
+    names (FILE used to coalesce only)."""
+    from spark_fsm_tpu.data.spmf import file_validator
+
+    db = _db(seed=70)
+    path = tmp_path / "data.spmf"
+    path.write_text(format_spmf(db))
+    v1 = file_validator(str(path))
+    assert v1 == file_validator(str(path))  # deterministic witness
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        _submit_file(master, "cold", path)
+        assert _wait(store, "cold") == "finished"
+        assert "served_from_cache" not in _stats(store, "cold")
+        base = rules_text(deserialize_rules(store.rules("cold")))
+        # exact hit: same path, untouched file
+        _submit_file(master, "hit", path)
+        assert _wait(store, "hit") == "finished"
+        assert _stats(store, "hit")["served_from_cache"] == "exact"
+        assert rules_text(deserialize_rules(store.rules("hit"))) == base
+        # dominance serving now works for the FILE spelling too
+        _submit_file(master, "dom", path, k=5)
+        assert _wait(store, "dom") == "finished"
+        assert _stats(store, "dom")["served_from_cache"] == "dominated"
+        want = rules_text(mine_tsr_cpu(db, 5, 0.4, max_side=2))
+        assert rules_text(
+            deserialize_rules(store.rules("dom"))) == want
+    finally:
+        master.shutdown()
+
+
+def test_file_validator_mismatch_falls_back_to_cold_mine(
+        rescache_on, tmp_path):
+    """The pinned fallback: a path whose content changed under the
+    learned mapping must NOT serve the stale entry — the validator
+    mismatch routes it down the mutable (cold) path, and the fresh
+    load re-learns the mapping for the new bytes."""
+    db1, db2 = _db(seed=71), _db(seed=72, n=50)
+    path = tmp_path / "mut.spmf"
+    path.write_text(format_spmf(db1))
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        _submit_file(master, "one", path)
+        assert _wait(store, "one") == "finished"
+        _submit_file(master, "one-hit", path)
+        assert _wait(store, "one-hit") == "finished"
+        assert _stats(store, "one-hit")["served_from_cache"] == "exact"
+        # rewrite the file IN PLACE: same path, different content
+        path.write_text(format_spmf(db2))
+        _submit_file(master, "two", path)
+        assert _wait(store, "two") == "finished"
+        # not served from the stale entry — a cold mine of the NEW data
+        assert "served_from_cache" not in _stats(store, "two")
+        want2 = rules_text(mine_tsr_cpu(db2, 8, 0.4, max_side=2))
+        assert rules_text(
+            deserialize_rules(store.rules("two"))) == want2
+        # the mapping re-learned: the new content now exact-hits
+        _submit_file(master, "two-hit", path)
+        assert _wait(store, "two-hit") == "finished"
+        assert _stats(store, "two-hit")["served_from_cache"] == "exact"
+    finally:
+        master.shutdown()
+
+
+# ------------------------------------ cross-replica coalesce hint (2c)
+
+
+def test_peer_inflight_hint_sheds_with_steal_path_retry(
+        rescache_on, monkeypatch):
+    """A local miss whose dataset fingerprint is in flight on a PEER
+    sheds with 429 + a ~2-heartbeat Retry-After instead of admitting a
+    duplicate cold mine; after the peer publishes its entry the retry
+    exact-hits.  Hint only — nothing attaches across replicas."""
+    import threading
+
+    from spark_fsm_tpu.service.lease import LeaseManager
+    from spark_fsm_tpu.utils import obs as obsmod
+
+    store = ResultStore()
+    mk = lambda rid: LeaseManager(store, replica_id=rid,
+                                  lease_ttl_s=30.0, heartbeat_s=0)
+    mgr_a, mgr_b = mk("rc-a"), mk("rc-b")
+    master_a = Master(store=store, miner_workers=1, lease_mgr=mgr_a)
+    master_b = Master(store=store, miner_workers=1, lease_mgr=mgr_b)
+    gate = threading.Event()
+    entered = threading.Event()
+    real = sources.get_db
+
+    def gated(req, store_):
+        if req.uid == "L":
+            entered.set()
+            assert gate.wait(60)
+        return real(req, store_)
+
+    monkeypatch.setattr(sources, "get_db", gated)
+    text = format_spmf(_db(seed=80, n=40))
+    hints0 = obsmod.REGISTRY.snapshot()["fsm_rescache_peer_hints_total"]
+    try:
+        _submit(master_a, "L", text)
+        assert entered.wait(60)
+        mgr_a.publish_heartbeat()  # advertises L's in-flight fp
+        assert master_a.miner.inflight_fps() != []
+        # refresh B's peer cache past any earlier (pre-heartbeat) scan
+        # a metrics collector may have cached — in production the cache
+        # ages out within one heartbeat; tests don't wait
+        assert [p["replica"] for p in mgr_b.peers()] == ["rc-a"]
+        resp = master_b.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "TSR_TPU", "source": "INLINE",
+            "sequences": text, "k": "8", "minconf": "0.4",
+            "max_side": "2", "uid": "dup"}))
+        assert resp.data.get("http_status") == "429", resp.data
+        assert int(resp.data["retry_after_s"]) >= 1
+        assert "peer replica" in resp.data["error"]
+        # hint only: zero store trace of the shed uid
+        assert store.status("dup") is None
+        assert store.journal_get("dup") is None
+        assert obsmod.REGISTRY.snapshot()[
+            "fsm_rescache_peer_hints_total"] == hints0 + 1
+        gate.set()
+        assert _wait(store, "L") == "finished"
+        # the client's retry hits the entry the peer published
+        _submit(master_b, "dup", text)
+        assert _wait(store, "dup") == "finished"
+        assert _stats(store, "dup")["served_from_cache"] == "exact"
+    finally:
+        gate.set()
+        master_b.shutdown()
+        master_a.shutdown()
