@@ -13,8 +13,15 @@ enough to run an elastic fleet on one box:
 
 - boots ``--initial`` replicas from one boot config (store must be
   ``redis`` — the shared journal/lease namespace IS the fleet bus);
-- polls ``fsm:autoscale:desired`` and spawns replicas while the live
-  count is below the published desired (bounded by ``--max``);
+- polls ``fsm:autoscale:desired`` and spawns replicas while the LIVE
+  count is below the published desired (bounded by ``--max``).  Live =
+  max(own alive children, un-expired ``fsm:replica:*`` heartbeat
+  records): the heartbeat side makes a RESTARTED supervisor converge
+  instead of re-booting a fleet that survived it — replicas orphaned
+  by a supervisor kill keep running and keep heartbeating, so the new
+  supervisor counts them and supplies only the deficit (a transient
+  overshoot from a not-yet-heartbeating boot is reaped by the
+  autoscaler's own scale-down);
 - reaps exited children: a scale-down victim drains and exits on its
   own (the drain directive is between the leader and the victim — the
   supervisor never kills anything), and an exited replica below the
@@ -24,6 +31,10 @@ enough to run an elastic fleet on one box:
 Usage:
     python scripts/fleet.py --config fleet.toml [--initial 2]
                             [--max 8] [--poll 1.0]
+
+``--initial 0`` is the RESTART spelling: boot nothing up front, read
+the live fleet from the heartbeats, supply only what the desired
+record still wants.
 """
 
 from __future__ import annotations
@@ -65,7 +76,8 @@ def main() -> int:
                          "[autoscale] enabled")
     ap.add_argument("--initial", type=int, default=None,
                     help="replicas to boot at start (default: "
-                         "[autoscale] min_replicas)")
+                         "[autoscale] min_replicas; 0 = restart mode — "
+                         "converge from the live heartbeats only)")
     ap.add_argument("--max", type=int, default=None,
                     help="hard replica ceiling (default: [autoscale] "
                          "max_replicas)")
@@ -82,8 +94,20 @@ def main() -> int:
     initial = args.initial if args.initial is not None \
         else max(1, cfg.autoscale.min_replicas)
     ceiling = args.max if args.max is not None \
-        else max(initial, cfg.autoscale.max_replicas)
+        else max(initial or 1, cfg.autoscale.max_replicas)
     client = RespClient(host=cfg.store.host, port=cfg.store.port)
+
+    def live_heartbeats() -> int:
+        """Un-expired fsm:replica:* records — the whole fleet's live
+        count, including replicas a previous (killed) supervisor
+        orphaned.  Cursor SCAN, never KEYS (the fleet bus is shared)."""
+        n, cursor = 0, "0"
+        while True:
+            cursor, batch = client.scan(cursor, match="fsm:replica:*",
+                                        count=64)
+            n += len(batch)
+            if cursor == "0":
+                return n
 
     children: list = []
     seq = 0
@@ -98,7 +122,7 @@ def main() -> int:
     for _ in range(initial):
         seq += 1
         children.append(boot_replica(args.config, seq))
-    desired = initial
+    desired = max(initial, 1)
     log(f"supervising {initial} replicas (ceiling {ceiling}), acting "
         f"on fsm:autoscale:desired")
     try:
@@ -124,8 +148,19 @@ def main() -> int:
                 log(f"desired-record read failed: {exc}")
             # supply up to the published desired count; scale-DOWN is
             # the leader's drain directive + the victim's own exit —
-            # never a supervisor kill
-            while len(children) < min(desired, ceiling):
+            # never a supervisor kill.  Live = max(own children, fleet
+            # heartbeats): a restarted supervisor counts the replicas
+            # its predecessor orphaned instead of duplicating them.
+            try:
+                hb = live_heartbeats()
+            except Exception as exc:
+                log(f"heartbeat scan failed: {exc}")
+                hb = 0
+            # one boot per poll: a freshly spawned replica has no
+            # heartbeat record until it finishes booting, and spawning
+            # the whole deficit at once would double-count it next poll
+            if (max(len(children), hb) < min(desired, ceiling)
+                    and len(children) < ceiling):
                 seq += 1
                 children.append(boot_replica(args.config, seq))
     finally:

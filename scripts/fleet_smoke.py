@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Fleet-supervisor chaos drill (ISSUE 14 satellite, ROADMAP item 4):
+kill scripts/fleet.py mid-scale-up, restart it, and assert the fleet
+CONVERGES to the published desired count with zero lost or duplicated
+jobs.
+
+The drill:
+
+1. one MiniRedis as the fleet bus; ``scripts/fleet.py --initial 2``
+   boots two replicas ([cluster] enabled, [autoscale] enabled so the
+   config validates — the desired record is written by THIS harness,
+   standing in for the leader's decision);
+2. submit jobs to the live replicas (mix of quick + checkpointed);
+3. publish ``fsm:autoscale:desired = 3`` and wait for the supervisor
+   to START supplying the third replica — then SIGKILL the supervisor
+   MID-SCALE-UP (the third replica may be half-booted; the first two
+   keep running as orphans);
+4. restart ``fleet.py --initial 0`` (restart mode): it must read the
+   live fleet from the ``fsm:replica:*`` heartbeats, supply only the
+   DEFICIT, and converge to 3 live heartbeats — never a duplicate
+   fleet next to the orphans;
+5. invariants: every accepted job settled exactly once with oracle
+   parity, zero journal/lease/marker leftovers.
+
+Usage: scripts/fleet_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+BOOT_TIMEOUT_S = 240.0
+DRILL_TIMEOUT_S = 300.0
+
+
+def log(msg):
+    print(f"fleet_smoke: {msg}", flush=True)
+
+
+def post(port, endpoint, timeout=60, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{endpoint}"
+    try:
+        with urllib.request.urlopen(url, data=data,
+                                    timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read().decode())
+
+
+_DRAINED = set()
+
+
+def start_drain(proc):
+    """Background-drain a supervisor's stdout pipe (idempotent): the
+    children inherit it and keep logging, and a full 64KB buffer
+    blocks a child mid-log-write — a wedge that reads as a lost job."""
+    import threading
+
+    if proc is None or proc.stdout is None or id(proc) in _DRAINED:
+        return
+    _DRAINED.add(id(proc))
+
+    def _drain(stream):
+        try:
+            for _ in stream:
+                pass
+        except (OSError, ValueError):
+            pass
+
+    threading.Thread(target=_drain, args=(proc.stdout,),
+                     daemon=True).start()
+
+
+def start_fleet(cfg_path, env, initial):
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "scripts" / "fleet.py"),
+         "--config", cfg_path, "--initial", str(initial),
+         "--max", "4", "--poll", "0.5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, bufsize=1)
+    return proc
+
+
+def drain_lines(proc, pids, ports, deadline):
+    """Non-blockingly-ish read fleet stdout, harvesting child pids and
+    replica HTTP ports (children inherit the supervisor's stdout)."""
+    import select
+
+    while time.time() < deadline:
+        r, _, _ = select.select([proc.stdout], [], [], 0.1)
+        if not r:
+            return
+        line = proc.stdout.readline()
+        if not line:
+            return
+        m = re.search(r"booted replica #\d+ \(pid (\d+)\)", line)
+        if m:
+            pids.add(int(m.group(1)))
+        m = re.search(r"service on http://[^:]+:(\d+)", line)
+        if m:
+            ports.append(int(m.group(1)))
+
+
+def live_heartbeats(client):
+    n, cursor = 0, "0"
+    while True:
+        cursor, batch = client.scan(cursor, match="fsm:replica:*",
+                                    count=64)
+        n += len(batch)
+        if cursor == "0":
+            return n
+
+
+def main():
+    from test_redis_store import MiniRedis
+
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.service.model import deserialize_patterns
+    from spark_fsm_tpu.service.resp import RespClient
+    from spark_fsm_tpu.utils.canonical import patterns_text
+
+    mini = MiniRedis()
+    log(f"MiniRedis (fleet bus) on port {mini.port}")
+    client = RespClient(port=mini.port)
+    tmp = tempfile.mkdtemp(prefix="fleet_smoke_")
+    cfg_path = os.path.join(tmp, "fleet.json")
+    with open(cfg_path, "w") as fh:
+        json.dump({
+            "service": {"port": 0, "miner_workers": 1,
+                        "queue_depth": 16},
+            "store": {"backend": "redis", "host": "127.0.0.1",
+                      "port": mini.port},
+            "cluster": {"enabled": True, "lease_ttl_s": 2.0,
+                        "recover_every_s": 0.5},
+            # the controller is live but parked: this harness writes
+            # the desired record itself (deterministic scale signal)
+            "autoscale": {"enabled": True, "min_replicas": 1,
+                          "max_replicas": 4, "hold_s": 3600.0,
+                          "cooldown_s": 3600.0},
+        }, fh)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    pids, ports = set(), []
+    fleet1 = fleet2 = None
+    try:
+        fleet1 = start_fleet(cfg_path, env, initial=2)
+        deadline = time.time() + BOOT_TIMEOUT_S
+        while time.time() < deadline and (len(ports) < 2
+                                          or live_heartbeats(client) < 2):
+            drain_lines(fleet1, pids, ports, time.time() + 0.5)
+        assert len(ports) >= 2 and live_heartbeats(client) >= 2, \
+            f"initial fleet never came up (ports={ports})"
+        log(f"initial fleet up: 2 replicas on ports {ports[:2]}")
+
+        # live traffic: quick + checkpointed jobs with known oracles
+        db = synthetic_db(seed=77, n_sequences=100, n_items=10,
+                          mean_itemsets=2.5, mean_itemset_size=1.2)
+        want = patterns_text(mine_spade(db, abs_minsup(0.1, len(db))))
+        accepted = []
+        for i, extra in enumerate([{}, {"checkpoint": "1",
+                                        "checkpoint_every_s": "0"}, {}]):
+            uid = f"fleet-job-{i}"
+            code, body = post(ports[i % 2], "/train", uid=uid,
+                              algorithm="SPADE_TPU", source="INLINE",
+                              sequences=format_spmf(db), support="0.1",
+                              **extra)
+            assert code == 200 and body["status"] == "started", body
+            accepted.append(uid)
+
+        # the scale signal: desired = 3 (standing in for the leader)
+        client.set("fsm:autoscale:desired", json.dumps(
+            {"desired": 3, "dir": "up", "reason": "fleet_smoke drill",
+             "leader": "harness", "seq": 1,
+             "ts": round(time.time(), 3)}))
+        log("published fsm:autoscale:desired = 3")
+
+        # wait for the supervisor to START supplying replica #3, then
+        # SIGKILL it mid-scale-up
+        deadline = time.time() + BOOT_TIMEOUT_S
+        while time.time() < deadline and len(pids) < 3:
+            drain_lines(fleet1, pids, ports, time.time() + 0.5)
+        assert len(pids) >= 3, "supervisor never started the 3rd replica"
+        fleet1.send_signal(signal.SIGKILL)
+        fleet1.wait(30)
+        # the orphaned replicas keep logging into fleet1's pipe
+        start_drain(fleet1)
+        killed_at_hb = live_heartbeats(client)
+        log(f"SIGKILLed the supervisor mid-scale-up "
+            f"({killed_at_hb} heartbeats live at the kill; "
+            f"{len(pids)} replicas spawned)")
+
+        # the orphaned replicas keep running: the in-flight jobs keep
+        # settling with nobody supervising
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            sts = [client.get(f"fsm:status:{u}") for u in accepted]
+            if all(s in ("finished", "failure") for s in sts):
+                break
+            time.sleep(0.25)
+        assert all(s == "finished" for s in sts), sts
+        log("all jobs settled on the orphaned replicas")
+
+        # RESTART in converge mode: supply only the heartbeat deficit
+        fleet2 = start_fleet(cfg_path, env, initial=0)
+        deadline = time.time() + BOOT_TIMEOUT_S
+        hb = 0
+        while time.time() < deadline:
+            drain_lines(fleet2, pids, ports, time.time() + 0.5)
+            hb = live_heartbeats(client)
+            if hb >= 3:
+                break
+        assert hb == 3, f"fleet never converged to 3 (heartbeats={hb})"
+        # convergence is STABLE: no duplicate fleet spawns next to the
+        # orphans (one extra poll period of grace, then recount)
+        time.sleep(3.0)
+        drain_lines(fleet2, pids, ports, time.time() + 0.5)
+        hb = live_heartbeats(client)
+        assert hb == 3, f"fleet over-provisioned after restart ({hb})"
+        log(f"restarted supervisor converged the fleet to 3 replicas "
+            f"({len(pids)} total boots across both supervisors)")
+
+        # zero lost/duplicated jobs: one terminal entry each, parity
+        for uid in accepted:
+            entries = [e.partition(":")[2]
+                       for e in client.lrange(f"fsm:status:log:{uid}")]
+            terminals = [e for e in entries
+                         if e in ("finished", "failure")]
+            assert terminals == ["finished"], (uid, entries)
+            got = patterns_text(deserialize_patterns(
+                client.get(f"fsm:pattern:{uid}")))
+            assert got == want, f"{uid}: parity violated"
+        assert client.keys("fsm:journal:*") == []
+        assert client.keys("fsm:admission:*") == []
+        log("invariants ok: every job settled exactly once with "
+            "parity, no journal/marker leftovers")
+    finally:
+        for proc in (fleet1, fleet2):
+            if proc is None:
+                continue
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+            # classic wait-with-full-pipe deadlock guard: the children
+            # keep logging through the shutdown drain
+            start_drain(proc)
+        for proc in (fleet1, fleet2):
+            if proc is not None:
+                try:
+                    proc.wait(90)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        # reap any replica the killed supervisor orphaned
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        mini.close()
+    log("PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Fleet-supervisor chaos smoke (ISSUE 14 satellite / ROADMAP item 4):
+# kill scripts/fleet.py mid-scale-up, restart it with --initial 0, and
+# assert the fleet converges to the published desired count from the
+# fsm:replica:* heartbeats — zero lost or duplicated jobs, no duplicate
+# fleet booted next to the orphaned replicas.  Hard timeout so a wedged
+# fleet fails loudly instead of hanging CI.
+cd "$(dirname "$0")/.."
+exec timeout -k 15 900 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/fleet_smoke.py "$@"

@@ -206,7 +206,21 @@ def main() -> int:
                     "fsm_tenant_admitted_total",
                     "fsm_tenant_sheds_total",
                     "fsm_tenant_dequeued_total",
-                    "fsm_rescache_peer_hints_total"):
+                    "fsm_rescache_peer_hints_total",
+                    # ISSUE 14 families: store-outage survival
+                    # (service/storeguard.py) — present (zero) even on
+                    # a boot with [storeguard] disabled
+                    "fsm_store_health_state",
+                    "fsm_storeguard_transitions_total",
+                    "fsm_storeguard_probes_total",
+                    "fsm_storeguard_spooled_writes_total",
+                    "fsm_storeguard_spool_entries",
+                    "fsm_storeguard_replays_total",
+                    "fsm_storeguard_replayed_writes_total",
+                    "fsm_storeguard_dropped_writes_total",
+                    "fsm_storeguard_stalls_total",
+                    "fsm_storeguard_outage_sheds_total",
+                    "fsm_storeguard_ephemeral_admissions_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -216,16 +230,32 @@ def main() -> int:
         for fam, label, want in (
                 ("fsm_job_e2e_seconds_count", "priority",
                  {"high", "normal", "low"}),
+                # the tenant label (ISSUE 14 satellite): the default
+                # tenant is seeded from boot so per-tenant SLO series
+                # exist before any fairness tenant registers
+                ("fsm_job_e2e_seconds_count", "tenant", {"default"}),
                 ("fsm_job_queue_wait_seconds_count", "priority",
                  {"high", "normal", "low"}),
+                ("fsm_job_queue_wait_seconds_count", "tenant",
+                 {"default"}),
+                ("fsm_job_exec_seconds_count", "tenant", {"default"}),
                 ("fsm_service_sheds_total", "priority",
                  {"high", "normal", "low"}),
                 ("fsm_trace_spine_writes_total", "outcome",
-                 {"ok", "fenced", "error"}),
+                 {"ok", "fenced", "error", "spooled"}),
                 ("fsm_partition_mines_total", "algo",
                  {"tsr", "spade", "cspade"}),
                 ("fsm_rescache_errors_total", "op",
-                 {"lookup", "store", "serve", "coalesce", "fanout"})):
+                 {"lookup", "store", "serve", "coalesce", "fanout"}),
+                # ISSUE 14 vocabularies (service/storeguard.py)
+                ("fsm_storeguard_probes_total", "outcome",
+                 {"ok", "unreachable", "error"}),
+                ("fsm_storeguard_replays_total", "outcome",
+                 {"ok", "refused", "error"}),
+                ("fsm_storeguard_stalls_total", "outcome",
+                 {"entered", "resumed", "fenced"}),
+                ("fsm_storeguard_transitions_total", "state",
+                 {"healthy", "flaky", "down"})):
             got = {m.group(1) for k in families.get(fam, {})
                    for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
             missing = want - got

@@ -1,0 +1,574 @@
+#!/usr/bin/env python
+"""Partition-chaos storm harness (ISSUE 14) — store-outage survival
+over a REAL multi-replica fleet, with a jepsen-lite invariant checker.
+
+Topology: one MiniRedis (the shared store) fronted by ONE
+:class:`~spark_fsm_tpu.utils.netproxy.NetProxy` PER replica, so the
+harness can black-hole, delay, or reset each replica's store link
+independently — asymmetric partitions included.  The MiniRedis is
+subclassed to SNOOP lease-key writes (uid, token, replica) for the
+token-monotonicity invariant.
+
+Phases:
+
+1. **Outage drill** (deterministic, the ISSUE 14 acceptance): submit a
+   checkpointed mine to replica A, black-hole the WHOLE store (every
+   proxy) mid-mine → A's storeguard proves the outage and the job
+   STALLS at a safe point (never a terminal failure); restore A's
+   link first → the SAME replica reacquires through the journal-gated
+   NX path, replays its write-behind spool, resumes and completes
+   with oracle parity, zero duplicated results, spool fully drained.
+
+2. **Randomized storms** (seeded): for each seed, submit a mix of
+   quick and checkpointed jobs across the replicas while a seeded
+   schedule of faults plays out (per-replica black-hole, global
+   black-hole, delay, mid-stream resets).  Then HEAL everything,
+   wait for quiescence, and run the invariant checker:
+
+   - every accepted (HTTP 200) job reached EXACTLY ONE terminal
+     status (the status log carries exactly one terminal entry);
+   - oracle parity on every completed mine (zero duplicated or
+     corrupted results — the no-double-commit invariant observed
+     from the data itself);
+   - lease-token monotonicity per uid: tokens never decrease, and a
+     re-SET of an existing token comes from the SAME replica (the
+     spool replay's same-token reacquire is the only legal reuse);
+   - quiescence: zero journal intents, leases, admission markers, or
+     spooled writes left anywhere (spool gauges at 0 on every
+     replica);
+   - fence-rejection / replay-refusal accounting printed next to the
+     verdict (each refusal is a double-commit that did NOT happen).
+
+Usage: scripts/storm_smoke.sh            (one pinned seed — CI)
+       python scripts/storm_smoke.py --seeds 5   (the acceptance run)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import random
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+BOOT_TIMEOUT_S = 180.0
+DRILL_TIMEOUT_S = 300.0
+QUIESCE_TIMEOUT_S = 240.0
+LEASE_TTL_S = 2.0
+RECOVER_EVERY_S = 0.5
+STORE_TIMEOUT_S = 1.0
+
+
+def log(msg):
+    print(f"storm_smoke: {msg}", flush=True)
+
+
+def post(port, endpoint, timeout=60, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{endpoint}"
+    try:
+        with urllib.request.urlopen(url, data=data,
+                                    timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read().decode())
+
+
+def scrape(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=60) as resp:
+        return resp.read().decode()
+
+
+def series_sum(text, family, label_filter=""):
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        m = re.match(rf"^{re.escape(family)}(\{{[^}}]*\}})?\s+(\S+)$", line)
+        if m and label_filter in (m.group(1) or ""):
+            total += float(m.group(2))
+            seen = True
+    assert seen, f"{family} missing from /metrics"
+    return total
+
+
+def boot_service(cfg_path, env, name):
+    child = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import sys\n"
+        f"sys.argv = ['app', '--config', {str(cfg_path)!r}]\n"
+        "from spark_fsm_tpu.service.app import main\n"
+        "main()\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    port = replica = None
+    deadline = time.time() + BOOT_TIMEOUT_S
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"replica {name} died at boot (rc={proc.poll()})")
+        if line.startswith("cluster replica "):
+            replica = line.split()[2]
+        if "spark_fsm_tpu service on http://" in line:
+            port = int(line.rsplit(":", 1)[1])
+            break
+    assert port is not None, f"no boot line from {name} within the timeout"
+    assert replica is not None, f"no cluster-replica line from {name}"
+    # keep draining the pipe for the life of the drill: the replicas
+    # log every checkpoint/status/storeguard event at INFO, and an
+    # undrained 64KB pipe buffer would eventually block a log write
+    # inside the service — a wedge that reads as a lost job
+    import threading
+
+    def _drain(stream):
+        for _ in stream:
+            pass
+
+    threading.Thread(target=_drain, args=(proc.stdout,),
+                     daemon=True).start()
+    return proc, port, replica
+
+
+def make_snooping_miniredis():
+    """MiniRedis subclass recording every fsm:lease:* SET — the
+    token-monotonicity invariant's evidence stream."""
+    from test_redis_store import MiniRedis
+
+    class SnoopingMiniRedis(MiniRedis):
+        def __init__(self):
+            super().__init__()
+            self.lease_sets = []  # (uid, token, replica)
+
+        def _dispatch(self, args):
+            cmd = args[0].upper()
+            if cmd == "SET" and args[1].startswith("fsm:lease:") \
+                    and args[1] != "fsm:lease:token":
+                try:
+                    rec = json.loads(args[2])
+                    self.lease_sets.append(
+                        (args[1][len("fsm:lease:"):],
+                         int(rec.get("token", -1)),
+                         str(rec.get("replica", "?"))))
+                except (ValueError, TypeError):
+                    pass
+            return super()._dispatch(args)
+
+    return SnoopingMiniRedis()
+
+
+class Fleet:
+    """2 replicas, each behind its own proxy, over one MiniRedis."""
+
+    def __init__(self):
+        from spark_fsm_tpu.utils.netproxy import NetProxy
+
+        self.mini = make_snooping_miniredis()
+        log(f"MiniRedis on port {self.mini.port}")
+        self.proxies = [NetProxy("127.0.0.1", self.mini.port)
+                        for _ in range(2)]
+        self.tmp = tempfile.mkdtemp(prefix="storm_smoke_")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        self.procs, self.ports, self.replicas = [], [], []
+        for i, proxy in enumerate(self.proxies):
+            cfg_path = os.path.join(self.tmp, f"replica{i}.json")
+            with open(cfg_path, "w") as fh:
+                json.dump({
+                    "fault_injection": True,
+                    "service": {"port": 0, "miner_workers": 1,
+                                "queue_depth": 16},
+                    "store": {"backend": "redis", "host": "127.0.0.1",
+                              "port": proxy.port,
+                              "timeout_s": STORE_TIMEOUT_S},
+                    "cluster": {"enabled": True,
+                                "lease_ttl_s": LEASE_TTL_S,
+                                "recover_every_s": RECOVER_EVERY_S},
+                    "storeguard": {"enabled": True,
+                                   "probe_every_s": 0.25,
+                                   "down_after": 1,
+                                   "spool_max_entries": 4096,
+                                   "stall_max_s": 120.0},
+                    "observability": {"trace": True,
+                                      "spine_flush_spans": 8},
+                    "engine": {"fused": "queue"},
+                }, fh)
+            proc, port, rid = boot_service(cfg_path, env, f"R{i}")
+            log(f"replica R{i} {rid} on port {port} (pid {proc.pid}) "
+                f"via proxy :{proxy.port}")
+            self.procs.append(proc)
+            self.ports.append(port)
+            self.replicas.append(rid)
+
+    def direct(self):
+        """A RESP client straight to the MiniRedis (the omniscient
+        observer — never routed through a proxy)."""
+        from spark_fsm_tpu.service.resp import RespClient
+
+        return RespClient(port=self.mini.port)
+
+    def heal_all(self):
+        for p in self.proxies:
+            p.heal()
+
+    def close(self):
+        for proc in self.procs:
+            if proc.poll() is None:
+                proc.kill()
+        for p in self.proxies:
+            p.close()
+        self.mini.close()
+
+
+# --------------------------------------------------------------- invariants
+
+
+def check_invariants(fleet, accepted, oracles, phase):
+    """The jepsen-lite checker; every violation is a hard failure."""
+    from spark_fsm_tpu.service.model import deserialize_patterns
+    from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+    client = fleet.direct()
+    violations = []
+
+    # quiescence: journals/leases/markers settle; spools drain
+    deadline = time.time() + QUIESCE_TIMEOUT_S
+    leftovers = None
+    while time.time() < deadline:
+        leftovers = (client.keys("fsm:journal:*")
+                     + [k for k in client.keys("fsm:lease:*")
+                        if k != "fsm:lease:token"]
+                     + client.keys("fsm:admission:*"))
+        spooled = 0.0
+        try:
+            for port in fleet.ports:
+                spooled += series_sum(scrape(port),
+                                      "fsm_storeguard_spool_entries")
+        except Exception:
+            spooled = -1.0
+        terminal = all(
+            client.get(f"fsm:status:{uid}") in ("finished", "failure")
+            for uid in accepted)
+        if not leftovers and spooled == 0.0 and terminal:
+            break
+        time.sleep(0.25)
+    else:
+        violations.append(f"no quiescence: leftovers={leftovers} "
+                          f"spooled={spooled}")
+        # diagnostics: who owns the stuck uids, and what do the
+        # replicas' guards think is happening?
+        for key in leftovers or ():
+            log(f"  [diag] {key} = {client.get(key)!r}")
+        for port in fleet.ports:
+            try:
+                _, health = post(port, "/admin/health", timeout=45)
+                log(f"  [diag] :{port} storeguard="
+                    f"{health.get('storeguard')} "
+                    f"admission={health.get('admission')}")
+            except Exception as exc:
+                log(f"  [diag] :{port} health unreachable: {exc}")
+
+    # exactly-once settlement: ONE terminal entry in each status log
+    for uid in sorted(accepted):
+        st = client.get(f"fsm:status:{uid}")
+        if st not in ("finished", "failure"):
+            violations.append(f"{uid}: no terminal status ({st!r})")
+            continue
+        entries = [e.partition(":")[2]
+                   for e in client.lrange(f"fsm:status:log:{uid}")]
+        terminals = [e for e in entries if e in ("finished", "failure")]
+        if len(terminals) != 1:
+            violations.append(
+                f"{uid}: settled {len(terminals)} times ({entries})")
+
+    # oracle parity on every completed mine (zero dup/corrupt results)
+    parity_ok = 0
+    for uid, want_text in sorted(oracles.items()):
+        if client.get(f"fsm:status:{uid}") != "finished":
+            continue
+        raw = client.get(f"fsm:pattern:{uid}")
+        if raw is None:
+            violations.append(f"{uid}: finished but no patterns")
+            continue
+        got = deserialize_patterns(raw)
+        if patterns_text(got) != want_text:
+            violations.append(f"{uid}: PARITY VIOLATION")
+        else:
+            parity_ok += 1
+
+    # lease-token monotonicity: per uid, tokens never decrease, and a
+    # token REUSE (the spool replay's same-token reacquire) must come
+    # from the same replica that held it
+    last = {}
+    for uid, token, replica in fleet.mini.lease_sets:
+        prev = last.get(uid)
+        if prev is not None:
+            ptok, prep = prev
+            if token < ptok:
+                violations.append(
+                    f"{uid}: token regressed {ptok} -> {token}")
+            if token == ptok and replica != prep:
+                violations.append(
+                    f"{uid}: token {token} reused across replicas "
+                    f"{prep} -> {replica}")
+        last[uid] = (token, replica)
+
+    # accounting next to the verdict
+    fences = spool_refused = replays = stalls = 0.0
+    for port in fleet.ports:
+        text = scrape(port)
+        fences += series_sum(text, "fsm_lease_fence_rejections_total")
+        spool_refused += series_sum(
+            text, "fsm_storeguard_replays_total", 'outcome="refused"')
+        replays += series_sum(
+            text, "fsm_storeguard_replays_total", 'outcome="ok"')
+        stalls += series_sum(
+            text, "fsm_storeguard_stalls_total", 'outcome="entered"')
+    log(f"[{phase}] checked {len(accepted)} accepted jobs: "
+        f"parity_ok={parity_ok} replays_ok={int(replays)} "
+        f"replays_refused={int(spool_refused)} "
+        f"fence_rejections={int(fences)} stalls={int(stalls)} "
+        f"lease_sets={len(fleet.mini.lease_sets)}")
+    client.close()
+    assert not violations, "INVARIANT VIOLATIONS:\n  " + \
+        "\n  ".join(violations)
+
+
+# -------------------------------------------------------------- the drill
+
+
+def outage_drill(fleet):
+    """Phase 1: the deterministic black-hole-the-store acceptance."""
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.utils.canonical import patterns_text
+
+    port_a, port_b = fleet.ports
+    rep_a = fleet.replicas[0]
+    client = fleet.direct()
+
+    # slow every frontier save on A so the drill spans the outage
+    code, _ = post(port_a, "/admin/faults", action="arm",
+                   site="checkpoint.save", every="1", delay_s="1.0",
+                   exc="none")
+    assert code == 200, "chaos lab refused the arm"
+    db = synthetic_db(seed=41, n_sequences=200, n_items=12,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    want = patterns_text(mine_spade(db, abs_minsup(0.05, len(db))))
+    code, body = post(port_a, "/train", uid="drill",
+                      algorithm="SPADE_TPU", source="INLINE",
+                      sequences=format_spmf(db), support="0.05",
+                      checkpoint="1", checkpoint_every_s="0")
+    assert code == 200 and body["status"] == "started", body
+
+    deadline = time.time() + DRILL_TIMEOUT_S
+    while time.time() < deadline:
+        if client.get("fsm:frontier:drill"):
+            break
+        time.sleep(0.1)
+    assert client.get("fsm:frontier:drill"), "no frontier save seen"
+
+    # BLACK-HOLE the whole store: every replica's link swallowed
+    for p in fleet.proxies:
+        p.blackhole(True)
+    log("store black-holed fleet-wide mid-checkpointed-mine")
+
+    # A must prove the outage and STALL the drill — never fail it.
+    # NOTE the admin endpoints stay up during the outage but are SLOW
+    # (each store-counter read burns a transport timeout): poll with a
+    # generous per-request timeout.
+    stalled, sg = False, {}
+    deadline = time.time() + DRILL_TIMEOUT_S
+    while time.time() < deadline:
+        try:
+            code, health = post(port_a, "/admin/health", timeout=45)
+        except Exception:
+            time.sleep(0.25)
+            continue
+        sg = (health or {}).get("storeguard") or {}
+        if sg.get("state") == "down" and sg.get("stalled_jobs", 0) >= 1:
+            stalled = True
+            break
+        time.sleep(0.25)
+    assert stalled, f"drill never stalled (storeguard: {sg})"
+    assert client.get("fsm:status:drill") not in ("finished", "failure"), \
+        "drill reached a terminal status during the outage"
+    log(f"outage proven on A: state=down, drill stalled "
+        f"(spool {sg.get('spool_entries')} entries)")
+
+    # restore A's link FIRST: the SAME replica must reacquire (journal-
+    # gated NX under its own token) and resume; B heals a beat later
+    fleet.proxies[0].heal()
+    log("healed A's store link (B still black-holed)")
+    deadline = time.time() + DRILL_TIMEOUT_S
+    reacquired = False
+    while time.time() < deadline:
+        raw = client.get("fsm:lease:drill")
+        if raw and json.loads(raw).get("replica") == rep_a:
+            reacquired = True
+            break
+        st = client.get("fsm:status:drill")
+        if st in ("finished", "failure"):
+            reacquired = st == "finished"  # resumed+completed already
+            break
+        time.sleep(0.1)
+    assert reacquired, "A never reacquired the drill after the heal"
+    fleet.proxies[1].heal()
+
+    deadline = time.time() + DRILL_TIMEOUT_S
+    status = None
+    while time.time() < deadline:
+        code, body = post(port_a, "/status/drill")
+        status = body.get("status")
+        if status in ("finished", "failure"):
+            break
+        time.sleep(0.25)
+    assert status == "finished", (status, body)
+    journal = client.get("fsm:journal:drill")
+    assert journal is None or json.loads(journal).get("replica") == rep_a
+    code, body = post(port_a, "/get/patterns", uid="drill")
+    from spark_fsm_tpu.service.model import deserialize_patterns
+    got = patterns_text(deserialize_patterns(body["data"]["patterns"]))
+    assert got == want, "oracle parity violated after outage resume"
+    # spool fully drained; the stall was entered and resumed on A
+    text = scrape(port_a)
+    assert series_sum(text, "fsm_storeguard_spool_entries") == 0.0
+    assert series_sum(text, "fsm_storeguard_replays_total",
+                      'outcome="ok"') >= 1
+    assert series_sum(text, "fsm_storeguard_stalls_total",
+                      'outcome="resumed"') >= 1
+    post(port_a, "/admin/faults", action="disarm", site="checkpoint.save")
+    client.close()
+    log("outage drill ok: stall -> same-replica resume -> parity, "
+        "spool drained")
+    return {"drill": want}
+
+
+# --------------------------------------------------------------- the storm
+
+
+def storm_round(fleet, seed, accepted, oracles):
+    """One seeded randomized fault schedule over live traffic."""
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.utils.canonical import patterns_text
+
+    rng = random.Random(seed)
+    log(f"storm seed={seed}")
+
+    # job templates: a couple of tiny dataset families with precomputed
+    # oracles, mined as quick jobs or checkpointed slow drills
+    dbs = []
+    for fam in range(2):
+        db = synthetic_db(seed=100 + fam, n_sequences=80, n_items=10,
+                          mean_itemsets=2.5, mean_itemset_size=1.2)
+        dbs.append((format_spmf(db),
+                    patterns_text(mine_spade(db,
+                                             abs_minsup(0.1, len(db))))))
+
+    shed = 0
+    for step in range(8):
+        uid = f"storm-{seed}-{step}"
+        port = fleet.ports[rng.randrange(len(fleet.ports))]
+        text, want = dbs[rng.randrange(len(dbs))]
+        params = dict(uid=uid, algorithm="SPADE_TPU", source="INLINE",
+                      sequences=text, support="0.1")
+        if rng.random() < 0.4:
+            params.update(checkpoint="1", checkpoint_every_s="0")
+        try:
+            code, body = post(port, "/train", timeout=30, **params)
+        except Exception as exc:
+            log(f"  submit {uid} failed transport-side ({exc}) — "
+                f"counts as shed")
+            shed += 1
+            code = 0
+        if code == 200 and body.get("status") == "started":
+            accepted.add(uid)
+            oracles[uid] = want
+        else:
+            shed += 1
+
+        # seeded fault event between submits
+        roll = rng.random()
+        if roll < 0.30:
+            victim = rng.randrange(len(fleet.proxies))
+            dur = 0.5 + 2.0 * rng.random()
+            log(f"  event: black-hole R{victim} for {dur:.1f}s")
+            fleet.proxies[victim].blackhole(True)
+            time.sleep(dur)
+            fleet.proxies[victim].heal()
+        elif roll < 0.45:
+            dur = 1.0 + 2.0 * rng.random()
+            log(f"  event: GLOBAL black-hole for {dur:.1f}s")
+            for p in fleet.proxies:
+                p.blackhole(True)
+            time.sleep(dur)
+            fleet.heal_all()
+        elif roll < 0.65:
+            victim = rng.randrange(len(fleet.proxies))
+            d = 0.05 + 0.15 * rng.random()
+            log(f"  event: delay R{victim} by {d * 1000:.0f}ms")
+            fleet.proxies[victim].delay(d)
+            time.sleep(1.0)
+            fleet.proxies[victim].heal()
+        elif roll < 0.80:
+            victim = rng.randrange(len(fleet.proxies))
+            n = fleet.proxies[victim].reset_all()
+            log(f"  event: reset R{victim} ({n} connections)")
+        else:
+            time.sleep(0.3 + 0.5 * rng.random())
+
+    fleet.heal_all()
+    log(f"  seed {seed}: {len(accepted)} accepted so far, "
+        f"{shed} shed this round; healing + quiescing")
+
+
+def main():
+    ap = argparse.ArgumentParser(description="partition-chaos storm "
+                                             "harness")
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("SPARKFSM_STORM_SEED",
+                                               "7001")))
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="number of consecutive seeds to storm "
+                         "(seed, seed+1, ...); the acceptance run "
+                         "uses 5")
+    ap.add_argument("--skip-drill", action="store_true")
+    args = ap.parse_args()
+
+    fleet = Fleet()
+    try:
+        if not args.skip_drill:
+            oracles = outage_drill(fleet)
+            check_invariants(fleet, {"drill"}, oracles, "drill")
+        for i in range(args.seeds):
+            seed = args.seed + i
+            accepted, oracles = set(), {}
+            storm_round(fleet, seed, accepted, oracles)
+            check_invariants(fleet, accepted, oracles, f"seed {seed}")
+    finally:
+        fleet.close()
+    log("PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Partition-chaos storm smoke (ISSUE 14) — CI entry for
+# scripts/storm_smoke.py: the deterministic store-outage drill (black-
+# hole mid-checkpointed-mine -> stall -> same-replica resume with
+# oracle parity and a drained spool) plus ONE pinned-seed randomized
+# fault schedule over a real 2-replica fleet behind per-replica TCP
+# proxies, closed by the jepsen-lite invariant checker (exactly-once
+# settlement, parity, token monotonicity, quiescence).  Override the
+# seed with SPARKFSM_STORM_SEED (or run storm_smoke.py --seeds 5 for
+# the multi-seed acceptance sweep); a failure under a new seed is a
+# real recovery bug, not flake.  Hard timeout so a wedged fleet fails
+# loudly instead of hanging CI.
+cd "$(dirname "$0")/.."
+exec timeout -k 15 900 env JAX_PLATFORMS=cpu \
+    SPARKFSM_STORM_SEED="${SPARKFSM_STORM_SEED:-7001}" \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/storm_smoke.py "$@"

@@ -26,6 +26,14 @@
 #                        MiniRedis — tenant-fair 429s, a leader
 #                        scale-up decision, forced scale-down drain
 #                        with steal + parity and a clean victim exit
+#   storm_smoke.sh       store-outage survival: black-hole-the-store
+#                        drill (stall -> same-replica resume, parity,
+#                        spool drained) + one pinned-seed partition
+#                        storm over a proxied 2-replica fleet with the
+#                        jepsen-lite invariant checker
+#   fleet_smoke.sh       kill scripts/fleet.py mid-scale-up, restart,
+#                        converge to desired from heartbeats — zero
+#                        lost/duplicated jobs
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -37,7 +45,8 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
              throughput_smoke resident_smoke partition_smoke \
-             replica_smoke rescache_smoke autoscale_smoke; do
+             replica_smoke rescache_smoke autoscale_smoke \
+             storm_smoke fleet_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

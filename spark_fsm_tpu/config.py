@@ -28,6 +28,32 @@ File format (TOML shown; JSON with the same nesting also accepted):
     backend = "inproc"              # or "redis"
     host = "127.0.0.1"
     port = 6379
+    timeout_s = 10.0                # redis socket timeout (transport
+                                    # failures past it surface as OSError
+                                    # — what the storeguard probe reads)
+
+    [storeguard]
+    enabled = false                 # store-outage survival (service/
+                                    # storeguard.py): health state machine
+                                    # + write-behind durability spool +
+                                    # outage-aware lease stalls; off = one
+                                    # `is None` read per durable write
+    probe_every_s = 1.0             # active store probe cadence while
+                                    # unhealthy (0 = manual ticks, tests)
+    down_after = 1                  # consecutive transport failures before
+                                    # the probe is consulted for DOWN —
+                                    # 1 (default) probes on the FIRST
+                                    # failure, so an outage never burns a
+                                    # job's retry budget before it is
+                                    # proven; raise to probe lazier
+    spool_max_entries = 512         # per-job write-behind spool bound;
+                                    # overflow fences the job (terminal)
+    stall_max_s = 120.0             # longest a job may stall at a safe
+                                    # point waiting out an outage before
+                                    # it conservatively self-fences
+                                    # (0 = stall as long as the outage)
+    ephemeral_admission = false     # admit loudly-flagged no-journal jobs
+                                    # during an outage instead of 429
 
     [distributed]
     enabled = false                 # true: jax.distributed.initialize at boot
@@ -178,6 +204,45 @@ class StoreConfig:
     backend: str = "inproc"  # "inproc" | "redis"
     host: str = "127.0.0.1"
     port: int = 6379
+    timeout_s: float = 10.0  # redis socket timeout; a blackholed store
+    # surfaces as OSError after at most this long — the storm harness
+    # (scripts/storm_smoke.py) shrinks it so outage detection is prompt
+
+
+@dataclasses.dataclass
+class StoreGuardConfig:
+    """Store-outage survival (service/storeguard.py): a health state
+    machine (healthy/flaky/down) consulted by every durable-write path,
+    a bounded per-job write-behind spool that holds fenced writes while
+    the store is DOWN and replays them IN ORDER under the same fencing
+    token on reconnect, and outage-aware lease semantics — a holder
+    whose renewals fail while the probe proves the store unreachable
+    STALLS at its next jobctl safe point instead of raising terminal
+    LEASE_LOST, and resumes through the journal-gated NX reacquire when
+    the store returns.
+
+    ``enabled = false`` (the default) builds no guard objects: every
+    durable write pays exactly one ``is None`` read
+    (scripts/bench_smoke.sh's dispatch counters stay byte-identical).
+    ``probe_every_s`` is the active-probe cadence while unhealthy (0 =
+    manual ticks — tests drive ``tick()``); ``down_after`` is how many
+    consecutive transport failures arm the probe for the DOWN verdict;
+    ``spool_max_entries`` bounds each job's spool (overflow fences the
+    job — the current terminal-failure posture, never silent loss);
+    ``stall_max_s`` bounds how long a job may wait out an outage at a
+    safe point before conservatively self-fencing (0 = unbounded);
+    ``ephemeral_admission`` admits loudly-flagged NO-JOURNAL jobs
+    during an outage instead of shedding 429 (their results ride the
+    spool; a crash before the store returns loses them — the flag in
+    the submit response says so).
+    """
+
+    enabled: bool = False
+    probe_every_s: float = 1.0
+    down_after: int = 1
+    spool_max_entries: int = 512
+    stall_max_s: float = 120.0
+    ephemeral_admission: bool = False
 
 
 @dataclasses.dataclass
@@ -475,6 +540,8 @@ class Config:
         default_factory=FairnessConfig)
     autoscale: AutoscaleConfig = dataclasses.field(
         default_factory=AutoscaleConfig)
+    storeguard: StoreGuardConfig = dataclasses.field(
+        default_factory=StoreGuardConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -524,6 +591,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "rescache": (RescacheConfig, top.pop("rescache", {})),
         "fairness": (FairnessConfig, top.pop("fairness", {})),
         "autoscale": (AutoscaleConfig, top.pop("autoscale", {})),
+        "storeguard": (StoreGuardConfig, top.pop("storeguard", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -638,6 +706,18 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("autoscale.leader_ttl_s must be > 0")
     if cfg.autoscale.drain_timeout_s <= 0:
         raise ConfigError("autoscale.drain_timeout_s must be > 0")
+    if cfg.store.timeout_s <= 0:
+        raise ConfigError("store.timeout_s must be > 0")
+    if cfg.storeguard.probe_every_s < 0:
+        raise ConfigError(
+            "storeguard.probe_every_s must be >= 0 (0 = manual ticks)")
+    if cfg.storeguard.down_after < 1:
+        raise ConfigError("storeguard.down_after must be >= 1")
+    if cfg.storeguard.spool_max_entries < 1:
+        raise ConfigError("storeguard.spool_max_entries must be >= 1")
+    if cfg.storeguard.stall_max_s < 0:
+        raise ConfigError(
+            "storeguard.stall_max_s must be >= 0 (0 = unbounded)")
     return cfg
 
 

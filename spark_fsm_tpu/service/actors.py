@@ -25,7 +25,7 @@ from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.service import (autoscale, fairness, lease, model,
                                    obsplane, plugins, resultcache,
-                                   sources)
+                                   sources, storeguard)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 from spark_fsm_tpu.utils import faults, jobctl, obs
@@ -33,20 +33,27 @@ from spark_fsm_tpu.utils.obs import log_event, profile_trace
 from spark_fsm_tpu.utils.retry import RetryPolicy
 
 
-def _sink_results(store: ResultStore, uid: str, kind: str, results) -> None:
+def _sink_results(store: ResultStore, uid: str, kind: str, results,
+                  guard=None, gate=None) -> None:
     """Persist a mine's output under ``uid`` — the single result sink used
-    by batch train jobs and stream pushes alike."""
+    by batch train jobs and stream pushes alike.  With a storeguard the
+    write rides the guard (spooled during a store outage, replayed under
+    the fencing gate on reconnect)."""
     if kind == "patterns":
-        store.add_patterns(uid, model.serialize_patterns(results))
+        key, payload = f"fsm:pattern:{uid}", model.serialize_patterns(results)
     else:
-        store.add_rules(uid, model.serialize_rules(results))
+        key, payload = f"fsm:rule:{uid}", model.serialize_rules(results)
+    if guard is None:
+        store.set(key, payload)
+    else:
+        guard.set(uid, key, payload, gate=gate)
 
 
 def _record_failure(store: ResultStore, uid: str, exc: Exception,
                     metric: str = "jobs_failed",
                     keep_frontier: bool = False,
                     lease_mgr: Optional[lease.LeaseManager] = None,
-                    rescache=None) -> None:
+                    rescache=None, guard=None) -> None:
     """The supervision contract: error text + traceback under the error
     key, status -> failure (SURVEY.md sec 5 failure-detection row).
     ``metric`` keeps batch-job and stream-push failure counters distinct
@@ -85,18 +92,39 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
             # followers waiting HERE re-dispatch as cold mines
             rescache.on_leader_terminal(uid)
         return
-    store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
-    store.add_status(uid, Status.FAILURE)
-    store.incr(f"fsm:metric:{metric}")
-    if not keep_frontier:
-        # a job that FAILED mid-mine after its retries leaves a frontier
-        # of unknown quality — drop it rather than leak it
-        store.delete(f"fsm:frontier:{uid}")
-        store.delete(f"fsm:frontier:results:{uid}")
-    # failure is TERMINAL: the journal intent is settled (the restart
-    # recovery pass must not resurrect a job that failed durably) and
-    # the job-control entry released (stream uids have neither — no-ops)
-    store.journal_clear(uid)
+    try:
+        if guard is None:
+            store.set(f"fsm:error:{uid}", f"{exc}\n{traceback.format_exc()}")
+            store.add_status(uid, Status.FAILURE)
+            store.incr(f"fsm:metric:{metric}")
+            if not keep_frontier:
+                # a job that FAILED mid-mine after its retries leaves a
+                # frontier of unknown quality — drop it, don't leak it
+                store.delete(f"fsm:frontier:{uid}")
+                store.delete(f"fsm:frontier:results:{uid}")
+            # failure is TERMINAL: the journal intent is settled (the
+            # restart recovery pass must not resurrect a job that
+            # failed durably)
+            store.journal_clear(uid)
+        else:
+            # storeguard route: spooled during an outage, replayed
+            # under the fencing gate on reconnect — a store blip no
+            # longer turns "record the failure" into a dead worker
+            guard.set(uid, f"fsm:error:{uid}",
+                      f"{exc}\n{traceback.format_exc()}")
+            guard.status(uid, Status.FAILURE)
+            guard.incr(uid, f"fsm:metric:{metric}")
+            if not keep_frontier:
+                guard.delete(uid, f"fsm:frontier:{uid}")
+                guard.delete(uid, f"fsm:frontier:results:{uid}")
+            guard.delete(uid, f"fsm:journal:{uid}")
+    except Exception as wexc:
+        # the store failed while recording the failure: the journal
+        # intent survives, so recovery settles the uid after the store
+        # returns — log loudly instead of killing the worker thread
+        log_event("job_failure_record_failed", uid=uid, error=str(wexc))
+    # the job-control entry is released regardless (stream uids have
+    # neither journal nor entry — no-ops)
     jobctl.release(uid)
     log_event("job_failed", uid=uid, error=str(exc))
     # stamp the terminal failure into the job's flight-recorder ring
@@ -171,7 +199,8 @@ class StoreCheckpoint:
     def __init__(self, store: ResultStore, uid: str,
                  every_s: float = 30.0,
                  retry: Optional[RetryPolicy] = None,
-                 lease_mgr: Optional[lease.LeaseManager] = None) -> None:
+                 lease_mgr: Optional[lease.LeaseManager] = None,
+                 guard=None) -> None:
         self.store, self.uid, self.every_s = store, uid, every_s
         self._meta_key = f"fsm:frontier:{uid}"
         self._results_key = f"fsm:frontier:results:{uid}"
@@ -181,6 +210,10 @@ class StoreCheckpoint:
         # BEFORE writing — a stale holder's snapshot must never land
         # over the adopting replica's (service/lease.py)
         self._lease = lease_mgr
+        # store-outage guard (service/storeguard.py): saves during a
+        # proven outage spool instead of failing the job; None = the
+        # pre-guard posture at one `is None` read per save
+        self._guard = guard
 
     def _io(self, fn, *args):
         return self._retry.run(fn, *args, site="store.checkpoint")
@@ -228,7 +261,13 @@ class StoreCheckpoint:
         obs.flush_trace(self.uid)
 
     def _save(self, state: dict) -> None:
-        if self._lease is not None:
+        g = self._guard
+        outage = g is not None and g.is_down()
+        if self._lease is not None and not outage:
+            # during a PROVEN outage the fence is deferred to the
+            # spool's replay gate (journal-gated NX reacquire under the
+            # same token) — verifying against an unreachable store here
+            # would just fence a job the outage semantics say may stall
             self._lease.fence(self.uid)  # raises JobLeaseLost when stale
         faults.fault_site("checkpoint.save", uid=self.uid)
         # NON-DESTRUCTIVE: pop from a shallow copy, never the caller's
@@ -237,6 +276,22 @@ class StoreCheckpoint:
         state = dict(state)
         delta = state.pop("results")
         done = state.pop("results_done")
+        if outage:
+            self._save_spooled(g, state, delta, done)
+            return
+        try:
+            self._save_direct(state, delta, done)
+        except Exception as exc:
+            # a transport failure the guard's probe confirms as an
+            # outage converts the save into a spool append mid-flight
+            # (an ack-lost rpush that actually landed would make the
+            # chunk list non-reconcilable — load() REFUSES such a list
+            # and the mine restarts fresh: slower, never corrupt)
+            if g is None or not g.note_error(exc):
+                raise
+            self._save_spooled(g, state, delta, done)
+
+    def _save_direct(self, state: dict, delta, done: int) -> None:
         if done == 0:
             # single atomic meta SET; the chunk list (possibly stale from a
             # crashed earlier incarnation) is dropped
@@ -265,7 +320,34 @@ class StoreCheckpoint:
         log_event("frontier_checkpoint", uid=self.uid,
                   stack=len(state["stack"]), results=state["results_total"])
 
+    def _save_spooled(self, g, state: dict, delta, done: int) -> None:
+        """The outage-mode save: the same write sequence (delta first,
+        meta LAST — so any replayed prefix reads as torn and load()
+        heals back to the previous good snapshot, exactly the existing
+        contract) appended to the write-behind spool.  No llen
+        idempotence check: one writer per uid plus strictly in-order
+        replay makes the spooled sequence exact by construction."""
+        uid = self.uid
+        if done == 0:
+            g.delete(uid, self._results_key)
+            self._inline = delta
+            state["results_total"] = len(delta)
+        else:
+            if delta:
+                g.rpush(uid, self._results_key, json.dumps(delta))
+            state["results_total"] = done + len(delta)
+        state["results_inline"] = self._inline
+        g.set(uid, self._meta_key, json.dumps(state))
+        log_event("frontier_checkpoint_spooled", uid=uid,
+                  stack=len(state["stack"]),
+                  results=state["results_total"])
+
     def clear(self) -> None:
+        g = self._guard
+        if g is not None:
+            g.delete(self.uid, self._meta_key)
+            g.delete(self.uid, self._results_key)
+            return
         self.store.delete(self._meta_key)
         self.store.delete(self._results_key)
 
@@ -587,6 +669,15 @@ class Miner:
         # admission.  None (the default) keeps submit at ONE attribute
         # read — bench_smoke's dispatch counters stay byte-identical.
         self._rescache = resultcache.build_for(self)
+        # store-outage survival (ISSUE 14, service/storeguard.py):
+        # health state machine + write-behind spool + outage stalls.
+        # None (the default) keeps every durable-write guard below at
+        # one ``is None`` read — bench_smoke dispatch counters stay
+        # byte-identical.
+        self._guard = None
+        if config.get_config().storeguard.enabled:
+            self._guard = storeguard.install(store, lease_mgr=self._lease)
+            self._guard.start()
         # this Miner's incarnation id: journal entries carrying it are
         # LIVE (409 on resubmit); entries carrying any other id belong
         # to a dead incarnation and are recovery fodder
@@ -752,7 +843,8 @@ class Miner:
                 _record_failure(self.store, req.uid,
                                 RuntimeError("replica draining"),
                                 keep_frontier=True, lease_mgr=None,
-                                rescache=self._rescache)
+                                rescache=self._rescache,
+                                guard=self._guard)
         running_left = self.running_count()
         outcome = ("clean" if not leftovers and running_left == 0
                    else "timeout")
@@ -832,7 +924,8 @@ class Miner:
         except jobctl.JobAborted as caught:
             exc = caught
         _record_failure(self.store, uid, exc, keep_frontier=True,
-                        lease_mgr=self._lease, rescache=self._rescache)
+                        lease_mgr=self._lease, rescache=self._rescache,
+                        guard=self._guard)
         return True
 
     @property
@@ -885,7 +978,9 @@ class Miner:
             / max(1, len(self._threads))
         return max(1, min(3600, math.ceil(est)))
 
-    def submit(self, req: ServiceRequest) -> None:
+    def submit(self, req: ServiceRequest) -> Optional[dict]:
+        """Admit a train request; returns response extras (e.g. the
+        ephemeral-admission flag) or None."""
         faults.fault_site("service.admit", uid=req.uid)
         priority = (req.param("priority") or "normal").lower()
         if priority not in PRIORITIES:
@@ -921,6 +1016,28 @@ class Miner:
                 req.uid, self._q.depth, self._q.size(), retry,
                 why=f"replica is draining for scale-down; peers serve "
                     f"new work — retry in ~{retry}s")
+        g = self._guard
+        if g is not None and g.is_down():
+            # STORE OUTAGE: the submit cannot be journaled, so it
+            # cannot be made durable.  Default: shed 429 (the honest
+            # Retry-After is the probe cadence — how fast the service
+            # can notice the store back).  Opt-in ephemeral admission
+            # runs the job loudly flagged NO-JOURNAL instead: results
+            # ride the spool, a crash before the store returns loses
+            # them, and the response says so.
+            if not g.ephemeral_admission:
+                retry = g.shed_outage_admission()
+                _SHEDS_TOTAL.inc(priority=priority)
+                if self._fair is not None:
+                    fairness.note_shed(tenant)
+                log_event("job_shed_store_outage", uid=req.uid,
+                          priority=priority)
+                raise AdmissionShed(
+                    req.uid, self._q.depth, self._q.size(), retry,
+                    why=f"store outage: durable admission is "
+                        f"unavailable; retry in ~{retry}s")
+            return self._admit_ephemeral(req, priority, deadline_s,
+                                         tenant)
         rc = self._rescache
         if rc is not None:
             # result-reuse tier (service/resultcache.py): a request
@@ -957,7 +1074,7 @@ class Miner:
                 # requests would attach to a uid that never runs
                 rc.admit_aborted(req.uid)
         if enqueued:
-            return
+            return None
         # shutdown() already enqueued the worker sentinels; a request
         # enqueued now would never be dequeued (workers exit on the
         # sentinel) and would sit "started" forever — the exact state
@@ -971,7 +1088,72 @@ class Miner:
         _record_failure(self.store, req.uid,
                         RuntimeError("service shutting down"),
                         keep_frontier=True, lease_mgr=self._lease,
-                        rescache=rc)
+                        rescache=rc, guard=self._guard)
+        return None
+
+    def _admit_ephemeral(self, req: ServiceRequest, priority: str,
+                         deadline_s: Optional[float],
+                         tenant: str) -> Optional[dict]:
+        """Outage-mode admission under ``[storeguard]
+        ephemeral_admission``: NO journal intent, NO lease, NO
+        admission marker — the job exists only in this process, its
+        statuses/results ride the write-behind spool ungated
+        (``gate="none"``: no peer can know the uid, so replay cannot
+        double-commit), and the submit response carries
+        ``ephemeral: "1"`` so the client knows a crash before the
+        store returns loses the job.  Every durable-admission
+        guarantee (409 conflict vs peers, steal, adoption) is
+        explicitly OUT: that is the flag's meaning.  Two duplicate-uid
+        defenses remain even here: a uid live IN THIS PROCESS 409s
+        (below), and the replay gate refuses a gate="none" spool whose
+        uid acquired any durable trace (journal/lease/status) during
+        the outage — a client that reused the uid against a healthy
+        peer keeps that peer's results."""
+        g = self._guard
+        if jobctl.get(req.uid) is not None:
+            raise UidConflict(req.uid)
+        admitted, queued, ahead, scope = self._q.try_reserve(
+            priority, tenant)
+        if not admitted:
+            _SHEDS_TOTAL.inc(priority=priority)
+            if self._fair is not None:
+                fairness.note_shed(tenant)
+            raise AdmissionShed(req.uid, self._q.depth, queued,
+                                self._retry_after_s(ahead))
+        enqueued = False
+        try:
+            ctl = jobctl.register(req.uid, deadline_s, priority=priority)
+            ctl.tenant = tenant
+            ctl.ephemeral = True
+            g.note_ephemeral_admission()
+            g.status(req.uid, Status.STARTED, gate="none")
+            g.incr(req.uid, "fsm:metric:jobs_submitted", gate="none")
+            log_event("job_admitted_ephemeral", uid=req.uid,
+                      priority=priority)
+            obs.trace_begin(req.uid,
+                            algorithm=req.param("algorithm", "SPADE_TPU"),
+                            source=req.param("source", "FILE"))
+            obs.lifecycle(req.uid, "admitted", priority=priority,
+                          ephemeral=True)
+            with self._stop_lock:
+                if not self._stopping:
+                    self._q.put(req, priority, tenant)
+                    if self._fair is not None:
+                        fairness.note_admitted(tenant)
+                    enqueued = True
+        except BaseException:
+            jobctl.release(req.uid)
+            raise
+        finally:
+            if not enqueued:
+                self._q.abort(tenant)
+        if not enqueued:
+            _record_failure(self.store, req.uid,
+                            RuntimeError("service shutting down"),
+                            keep_frontier=True, lease_mgr=None,
+                            rescache=self._rescache, guard=g)
+            return None
+        return {"ephemeral": "1"}
 
     def _admit(self, req: ServiceRequest, priority: str,
                deadline_s: Optional[float],
@@ -1092,6 +1274,8 @@ class Miner:
             # priority rides the control entry so the fusion broker's
             # window rule sees the admission class at dispatch time
             ctl = jobctl.register(req.uid, deadline_s, priority=priority)
+            # tenant too: the fsm_job_*_seconds SLO label at finish
+            ctl.tenant = tenant
             if self._lease is not None:
                 # heartbeat-detected lease loss self-fences the job at
                 # its next safe point via this control entry
@@ -1160,14 +1344,61 @@ class Miner:
             req = self._q.get()
             if req is None:
                 return
-            if self._lease is not None and \
-                    not self._lease.retract_admission(req.uid):
-                # the admission marker is GONE: an idle peer won the
-                # atomic DEL claim and owns the job (lease + journal)
-                # now — drop it silently; running it here would be the
-                # double-execution the two-phase claim exists to prevent
-                # (release OUR control object by identity — the uid may
-                # already map to the thief's live entry in-process)
+            try:
+                self._loop_one(req)
+            except Exception as exc:
+                # the worker thread must NEVER die: a dead worker
+                # strands the whole queue behind it (jobs pinned at
+                # 'started' forever, leases renewed by a heartbeat
+                # that thinks they are fine).  Settle the job as a
+                # durable failure (best effort — the journal intent
+                # survives for recovery if even that fails) and move
+                # on to the next dequeue.
+                log_event("worker_loop_error", uid=req.uid,
+                          error=str(exc))
+                try:
+                    _record_failure(self.store, req.uid, exc,
+                                    keep_frontier=True,
+                                    lease_mgr=self._lease,
+                                    rescache=self._rescache,
+                                    guard=self._guard)
+                except Exception as rexc:
+                    log_event("worker_loop_settle_failed", uid=req.uid,
+                              error=str(rexc))
+
+    def _loop_one(self, req: ServiceRequest) -> None:
+        ctl0 = jobctl.get(req.uid)
+        if self._lease is not None and not (
+                ctl0 is not None and ctl0.ephemeral):
+            try:
+                claimed = self._lease.retract_admission(req.uid)
+            except Exception as exc:
+                g = self._guard
+                if g is not None and g.note_error(exc):
+                    # store outage at dequeue: defer the marker
+                    # retraction into the spool and run the job —
+                    # a post-heal thief racing the replayed DEL
+                    # loses either way: whoever loses the arbiter
+                    # is fenced by token, never double-commits
+                    self._lease.retract_admission_deferred(req.uid, g)
+                    claimed = True
+                else:
+                    # UNPROVEN blip (store answered the probe, or
+                    # no guard): run the job anyway — if a thief
+                    # actually won the marker, the fencing token
+                    # refuses the loser's commits; wasting one
+                    # mine beats stranding the queue
+                    log_event("retract_admission_failed",
+                              uid=req.uid, error=str(exc))
+                    claimed = True
+            if not claimed:
+                # the admission marker is GONE: an idle peer won
+                # the atomic DEL claim and owns the job (lease +
+                # journal) now — drop it silently; running it here
+                # would be the double-execution the two-phase claim
+                # exists to prevent (release OUR control object by
+                # identity — the uid may already map to the thief's
+                # live entry in-process)
                 ctl = self._lease.attached_ctl(req.uid)
                 self._lease.stolen_from_us(req.uid)
                 jobctl.release_entry(ctl)
@@ -1175,50 +1406,61 @@ class Miner:
                     # the thief runs (and fans out) elsewhere: local
                     # followers re-dispatch as cold mines
                     self._rescache.on_leader_terminal(req.uid)
-                continue
-            if self._stopping:
-                # draining: do NOT start queued backlog jobs — give each a
-                # durable failure status (visible through /status) instead
-                # of leaving it "started" forever or dying with the process
-                # (keep_frontier: a drained checkpointed job's persisted
-                # progress stays resumable after the restart)
-                _record_failure(self.store, req.uid,
-                                RuntimeError("service shutting down"),
-                                keep_frontier=True, lease_mgr=self._lease,
-                                rescache=self._rescache)
-                continue
-            ctl = jobctl.get(req.uid)
-            try:
-                # a deadline spent ENTIRELY on queue wait (or a cancel
-                # that landed while queued) aborts before any work
-                jobctl.check_entry(ctl)
-            except jobctl.JobAborted as exc:
-                _record_failure(self.store, req.uid, exc,
-                                keep_frontier=True, lease_mgr=self._lease,
-                                rescache=self._rescache)
-                continue
-            # Clear again at run start: with a reused uid, an EARLIER job
-            # with the same uid may have written its error/results after
-            # submit()'s clear (it was still queued/running then).  The
-            # last job to *start* owns the uid's keys from here on.
+                return
+        if self._stopping:
+            # draining: do NOT start queued backlog jobs — give each a
+            # durable failure status (visible through /status) instead
+            # of leaving it "started" forever or dying with the process
+            # (keep_frontier: a drained checkpointed job's persisted
+            # progress stays resumable after the restart)
+            _record_failure(self.store, req.uid,
+                            RuntimeError("service shutting down"),
+                            keep_frontier=True, lease_mgr=self._lease,
+                            rescache=self._rescache, guard=self._guard)
+            return
+        ctl = jobctl.get(req.uid)
+        try:
+            # a deadline spent ENTIRELY on queue wait (or a cancel
+            # that landed while queued) aborts before any work
+            jobctl.check_entry(ctl)
+        except jobctl.JobAborted as exc:
+            _record_failure(self.store, req.uid, exc,
+                            keep_frontier=True, lease_mgr=self._lease,
+                            rescache=self._rescache, guard=self._guard)
+            return
+        # Clear again at run start: with a reused uid, an EARLIER job
+        # with the same uid may have written its error/results after
+        # submit()'s clear (it was still queued/running then).  The
+        # last job to *start* owns the uid's keys from here on.
+        try:
             self.store.clear_job(req.uid, keep_status_log=True,
                                  keep_frontier=_checkpoint_requested(req))
-            try:
-                retries = int(req.param(
-                    "retries",
-                    str(config.get_config().service.job_retries)))
-            except ValueError as exc:  # malformed param: fail like any
-                _record_failure(self.store, req.uid, exc,  # other bad param
-                                lease_mgr=self._lease,
-                                rescache=self._rescache)
-                continue
+        except Exception as exc:
+            g = self._guard
+            if g is None or not g.note_error(exc):
+                raise
+            # store outage: the clear is cosmetic for a FRESH uid
+            # (this run's writes overwrite the live keys anyway) —
+            # skipping it beats failing the job, and the log line
+            # flags the one visible residue (a reused uid's stale
+            # error key may shadow through /status until then)
+            log_event("job_clear_skipped_outage", uid=req.uid)
+        try:
+            retries = int(req.param(
+                "retries",
+                str(config.get_config().service.job_retries)))
+        except ValueError as exc:  # malformed param: fail like any
+            _record_failure(self.store, req.uid, exc,  # other bad param
+                            lease_mgr=self._lease,
+                            rescache=self._rescache, guard=self._guard)
+            return
+        with self._running_lock:
+            self._running += 1
+        try:
+            self._attempts(req, ctl, retries)
+        finally:
             with self._running_lock:
-                self._running += 1
-            try:
-                self._attempts(req, ctl, retries)
-            finally:
-                with self._running_lock:
-                    self._running -= 1
+                self._running -= 1
 
     def _attempts(self, req: ServiceRequest, ctl, retries: int) -> None:
         attempt = 0
@@ -1241,23 +1483,27 @@ class Miner:
                 # nothing there)
                 _record_failure(self.store, req.uid, exc,
                                 keep_frontier=True, lease_mgr=self._lease,
-                                rescache=self._rescache)
+                                rescache=self._rescache, guard=self._guard)
                 break
             except ValueError as exc:  # bad params / bad source: the
                 # failure is deterministic (SourceError included) — a
                 # re-run would just repeat it, so fail immediately
                 _record_failure(self.store, req.uid, exc,
                                 lease_mgr=self._lease,
-                                rescache=self._rescache)
+                                rescache=self._rescache, guard=self._guard)
                 break
             except Exception as exc:  # supervision: retry, then failure
                 attempt += 1
                 if attempt > max(0, retries):
                     _record_failure(self.store, req.uid, exc,
                                     lease_mgr=self._lease,
-                                    rescache=self._rescache)
+                                    rescache=self._rescache,
+                                    guard=self._guard)
                     break
-                self.store.incr("fsm:metric:jobs_retried")
+                try:
+                    self.store.incr("fsm:metric:jobs_retried")
+                except Exception:
+                    pass  # counter only; a down store must not veto a retry
                 log_event("job_retry", uid=req.uid, attempt=attempt,
                           error=str(exc))
                 with obs.span("job.retry", trace_id=req.uid,
@@ -1296,14 +1542,22 @@ class Miner:
         # the lease fence rides the same boundary — a job whose lease
         # lapsed during a long dataset build self-fences before mining
         jobctl.check()
-        if self._lease is not None:
+        g = self._guard
+        gate = ("none" if ctl is not None and ctl.ephemeral else None)
+        if self._lease is not None and (g is None or not g.is_down()):
+            # the fence is skipped only during a PROVEN outage — the
+            # spool's replay gate re-proves the token before any
+            # deferred write lands (docs/DESIGN.md "Spool replay")
             self._lease.fence(req.uid)
         if self._rescache is not None:
             # content-addressed dataset fingerprint, once per load:
             # stamped on the control entry (the cache-entry key) and
             # learned into the stable-source map (never raises)
             self._rescache.note_dataset(req, db, ctl)
-        self.store.add_status(req.uid, Status.DATASET)
+        if g is None:
+            self.store.add_status(req.uid, Status.DATASET)
+        else:
+            g.status(req.uid, Status.DATASET, gate=gate)
         plugin = plugins.get_plugin(req)
         stats: Dict[str, object] = {
             "algorithm": plugin.name,
@@ -1316,7 +1570,7 @@ class Miner:
             ckpt = StoreCheckpoint(
                 self.store, req.uid,
                 every_s=float(req.param("checkpoint_every_s", "30")),
-                lease_mgr=self._lease)
+                lease_mgr=self._lease, guard=self._guard)
         trace_dir = _profile_dir(req, req.uid)
         t1 = time.perf_counter()
         with profile_trace(trace_dir), obs.span("job.mine"):
@@ -1328,16 +1582,29 @@ class Miner:
         if trace_dir:
             stats["profile_trace"] = trace_dir
         with obs.span("job.sink", results=len(results)):
-            if self._lease is not None:
+            outage = g is not None and g.is_down()
+            if self._lease is not None and not outage:
                 # the split-brain gate: a stale holder that somehow
                 # mined to completion (expired mid-run, adopter already
                 # re-running) must NOT commit its result set over the
-                # adopter's — raises JobLeaseLost, terminal, fenced
+                # adopter's — raises JobLeaseLost, terminal, fenced.
+                # During a PROVEN outage the same gate moves to the
+                # spool replay (journal-gated NX reacquire under the
+                # same token) — refused there, these writes are dropped
+                # and counted, never committed over the adopter's
                 self._lease.fence(req.uid)
-            self.store.set(f"fsm:stats:{req.uid}", json.dumps(stats))
-            _sink_results(self.store, req.uid, plugin.kind, results)
-            self.store.add_status(req.uid, Status.TRAINED)
-            self.store.add_status(req.uid, Status.FINISHED)
+            if g is None:
+                self.store.set(f"fsm:stats:{req.uid}", json.dumps(stats))
+                _sink_results(self.store, req.uid, plugin.kind, results)
+                self.store.add_status(req.uid, Status.TRAINED)
+                self.store.add_status(req.uid, Status.FINISHED)
+            else:
+                g.set(req.uid, f"fsm:stats:{req.uid}", json.dumps(stats),
+                      gate=gate)
+                _sink_results(self.store, req.uid, plugin.kind, results,
+                              guard=g, gate=gate)
+                g.status(req.uid, Status.TRAINED, gate=gate)
+                g.status(req.uid, Status.FINISHED, gate=gate)
         if self._rescache is not None:
             # result-reuse tier: publish the cache entry and fan the
             # durable result out to coalesced followers — while the
@@ -1357,24 +1624,34 @@ class Miner:
         # FINISHED is terminal: settle the journal intent and release
         # the job-control entry (order matters — the terminal status is
         # already durable, so a crash right here leaves an orphan whose
-        # recovery pass sees 'finished' and just clears the journal)
-        self.store.journal_clear(req.uid)
+        # recovery pass sees 'finished' and just clears the journal).
+        # Ephemeral jobs never wrote a journal intent — nothing to clear.
+        if ctl is None or not ctl.ephemeral:
+            if g is None:
+                self.store.journal_clear(req.uid)
+            else:
+                g.delete(req.uid, f"fsm:journal:{req.uid}", gate=gate)
         jobctl.release(req.uid)
-        # SLO accounting (submit -> durable result, per priority) + the
-        # settled lifecycle mark, flushed to the spine while the lease
-        # is STILL HELD so the final chunk rides the fenced write path
+        # SLO accounting (submit -> durable result, per priority and
+        # tenant) + the settled lifecycle mark, flushed to the spine
+        # while the lease is STILL HELD so the final chunk rides the
+        # fenced write path
         if ctl is not None:
             now_m = time.monotonic()
             e2e_s = now_m - ctl.submitted_t
             queue_wait_s = max(0.0, (ctl.started_t or now_m)
                                - ctl.submitted_t)
             obsplane.observe_job(ctl.priority, e2e_s, queue_wait_s,
-                                 max(0.0, e2e_s - queue_wait_s))
+                                 max(0.0, e2e_s - queue_wait_s),
+                                 tenant=ctl.tenant)
         obs.lifecycle(req.uid, "settled", outcome="finished")
         obs.flush_trace(req.uid)
         if self._lease is not None:
             self._lease.release(req.uid)
-        self.store.incr("fsm:metric:jobs_finished")
+        if g is None:
+            self.store.incr("fsm:metric:jobs_finished")
+        else:
+            g.incr(req.uid, "fsm:metric:jobs_finished", gate=gate)
         self._observe_wall(time.perf_counter() - t0)
         log_event("job_finished", uid=req.uid, **stats)
 
@@ -1411,6 +1688,10 @@ class Miner:
             # released its lease); stop the heartbeat and retract the
             # replica record so peers adopt anything left promptly
             self._lease.stop()
+        if self._guard is not None:
+            self._guard.stop()
+            if storeguard.get() is self._guard:
+                storeguard.uninstall()
 
 
 class Questor:
@@ -1858,7 +2139,7 @@ class Master:
                 src = (req.param("source") or "FILE").upper()
                 if src not in sources.SOURCES:
                     raise ValueError(f"unknown source {src!r}")
-                self.miner.submit(req)
+                extras = self.miner.submit(req) or {}
             except AdmissionShed as exc:
                 # overload shed: protocol-mapped to 429 + Retry-After by
                 # the HTTP layer (remote clients read retry_after_s).
@@ -1890,7 +2171,9 @@ class Master:
                 # bad submit params, or a chaos-armed admission/journal
                 # site: a clean synchronous failure envelope either way
                 return model.response(req, Status.FAILURE, error=str(exc))
-            return model.response(req, Status.STARTED)
+            # extras: e.g. ephemeral="1" — the LOUD no-journal flag a
+            # store-outage admission carries ([storeguard])
+            return model.response(req, Status.STARTED, **extras)
         if task == "status":
             status = self.store.status(req.uid)
             if status is None:
@@ -2038,7 +2321,8 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
         # keep_frontier: a recovery resubmit that shed (tiny queue at
         # boot) must not destroy the very progress it failed to resume
         _record_failure(store, uid, failure, keep_frontier=True,
-                        lease_mgr=mgr, rescache=miner._rescache)
+                        lease_mgr=mgr, rescache=miner._rescache,
+                        guard=miner._guard)
         report["failed"].append(uid)
         _RECOVERY_TOTAL.inc(outcome="failed")
     if any(report.values()):

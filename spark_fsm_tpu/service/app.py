@@ -480,6 +480,11 @@ def service_stats(master: Master) -> dict:
         # fsm_autoscale_*); None when [autoscale] is off
         "autoscale": (None if master.autoscaler is None
                       else master.autoscaler.stats()),
+        # store-outage guard (service/storeguard.py): health state +
+        # spool/stall depth (canonical series: fsm_store_health_state /
+        # fsm_storeguard_*); None when [storeguard] is off
+        "storeguard": (None if master.miner._guard is None
+                       else master.miner._guard.stats()),
         # warm-path observability: distinct compiled geometries seen,
         # plus the last prewarm's per-key compile walls (if any ran)
         "shape_keys_recorded": len(shapereg.recorded()),
@@ -532,6 +537,10 @@ def health_report(master: Master) -> dict:
         },
         "cluster": (None if master.miner._lease is None
                     else master.miner._lease.stats()),
+        # store-outage guard (service/storeguard.py): health state,
+        # spool depth, stalled jobs; None when [storeguard] is off
+        "storeguard": (None if master.miner._guard is None
+                       else master.miner._guard.stats()),
         "retry": retry_counters(),
         "watchdog": {**watchdog.stats(),
                      "slack": watchdog.configured_slack()},
@@ -560,7 +569,8 @@ def health_report(master: Master) -> dict:
 def make_store(cfg: Optional[cfgmod.Config] = None) -> ResultStore:
     cfg = cfg if cfg is not None else cfgmod.get_config()
     if cfg.store.backend == "redis":
-        return RedisResultStore(cfg.store.host, cfg.store.port)
+        return RedisResultStore(cfg.store.host, cfg.store.port,
+                                timeout_s=cfg.store.timeout_s)
     return ResultStore()
 
 
@@ -665,6 +675,14 @@ def main() -> None:
         print(f"autoscale controller on (bounds "
               f"[{scaler.min_replicas}, {scaler.max_replicas}], "
               f"cadence {round(scaler.decide_every_s, 3)}s)", flush=True)
+    guard = server.master.miner._guard  # type: ignore[attr-defined]
+    if guard is not None:
+        print(f"storeguard on (probe {guard.probe_every_s}s, "
+              f"spool {guard.spool_max_entries}/job, "
+              f"stall_max {guard.stall_max_s}s, "
+              f"ephemeral_admission "
+              f"{'on' if guard.ephemeral_admission else 'off'})",
+              flush=True)
     mgr = server.master.miner._lease  # type: ignore[attr-defined]
     if mgr is not None:
         # multi-replica mode: peers identify this instance by replica id

@@ -191,6 +191,27 @@ class Autoscaler:
                     else max(worst, e2e["p99"])
         return worst
 
+    @staticmethod
+    def _fleet_p99(rows, local: Optional[float]) -> Optional[float]:
+        """FLEET-WIDE up_p99 signal (ISSUE 14 satellite): the max over
+        the local window and every live replica's heartbeat-piggybacked
+        SLO digest — an idle leader is no longer blind while a peer
+        saturates.  Digest-less rows (old replicas, empty windows)
+        contribute nothing; the merge can only RAISE the signal, never
+        mask a hot local window."""
+        worst = local
+        for r in rows:
+            digest = r.get("slo") or {}
+            p99 = digest.get("p99")
+            if p99 is None or not (digest.get("n") or 0):
+                continue
+            try:
+                p99 = float(p99)
+            except (TypeError, ValueError):
+                continue
+            worst = p99 if worst is None else max(worst, p99)
+        return worst
+
     # ----------------------------------------------------------- decisions
 
     def _publish(self, direction: str, desired: int, replicas: int,
@@ -233,7 +254,7 @@ class Autoscaler:
         workers = sum(int(r.get("workers") or 0) for r in live)
         queued = sum(int(r.get("queued") or 0) for r in live)
         free = sum(int(r.get("free") or 0) for r in live)
-        p99 = self._slo_p99()
+        p99 = self._fleet_p99(live, self._slo_p99())
         load = queued / max(1, workers)
         free_frac = free / max(1, workers)
         up = (load > self.up_queue_per_worker

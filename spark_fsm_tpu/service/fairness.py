@@ -113,6 +113,12 @@ class TenantScheduler:
         _TENANT_ADMITTED.seed(tenant=tenant)
         _TENANT_SHEDS.seed(tenant=tenant)
         _TENANT_SERVED.seed(tenant=tenant)
+        # and the SLO vocabulary (ISSUE 14 satellite): per-tenant
+        # fsm_job_*_seconds series + /admin/slo tenant quantiles exist
+        # from registration, not from the first finished job
+        from spark_fsm_tpu.service import obsplane
+
+        obsplane.seed_tenant(tenant)
 
     def resolve(self, raw: Optional[str]) -> str:
         """Validate + register a request's tenant.  Raises ValueError

@@ -169,6 +169,10 @@ class LeaseManager:
         self.recover_every_s = (float(recover_every_s) if recover_every_s
                                 else self.lease_ttl_s)
         self._clock = clock
+        # store-outage guard (service/storeguard.py): attached by
+        # storeguard.install when [storeguard] is enabled — None keeps
+        # every outage hook below at one `is None` read
+        self._guard = None
         self._lock = threading.Lock()
         # serializes _verify: the heartbeat's renew_all and a worker's
         # stale fence() may race the expired-unclaimed NX reacquire —
@@ -382,6 +386,13 @@ class LeaseManager:
                 if self._verify(h):
                     return
             except Exception as exc:
+                if (self._guard is not None
+                        and self._guard.note_error(exc)):
+                    # PROVEN store outage: the write this fence guards
+                    # is about to ride the spool, whose replay gate
+                    # re-proves the token before anything lands — allow
+                    # it (stall semantics), don't fence
+                    return
                 # unverifiable at a point where the TTL may already have
                 # lapsed: refusing the write is the only safe answer
                 self._mark_lost(h, f"unverifiable: {exc}")
@@ -390,10 +401,22 @@ class LeaseManager:
             uid, "its replica lease expired or was superseded; refusing "
                  "the write to avoid double-commit")
 
+    def attach_guard(self, guard) -> None:
+        """Bind the store-outage guard (service/storeguard.py): renewal
+        failures past the TTL during a PROVEN store outage stall the
+        job at its next safe point instead of fencing it."""
+        self._guard = guard
+
     def renew_all(self) -> None:
         """Heartbeat renewal of every held lease.  A renewal FAILURE is
         survivable until the TTL lapses (the job keeps running); past
-        it the job is fenced at its next safe point."""
+        it the job is fenced at its next safe point — unless the
+        storeguard probe proves the store GLOBALLY unreachable, in
+        which case the job STALLS there instead (frontier kept in
+        memory + spool) and the journal-gated NX reacquire decides its
+        fate when the store returns.  A replica that cannot prove the
+        outage (store answers the probe) fences as before: when in
+        doubt, fence."""
         for h in list(self._held.values()):
             if h.lost:
                 continue
@@ -405,6 +428,9 @@ class LeaseManager:
             except Exception as exc:
                 _RENEW_TOTAL.inc(outcome="error")
                 if self._clock() >= h.expires:
+                    if (self._guard is not None
+                            and self._guard.stall_job(h.ctl, h.uid)):
+                        continue
                     self._mark_lost(h, f"renewal failed past TTL: {exc}")
 
     def settle_for_failure(self, uid: str) -> bool:
@@ -444,6 +470,81 @@ class LeaseManager:
             log_event("lease_settle_unverifiable", uid=uid, error=str(exc))
         _FENCE_REJECTED_TOTAL.inc()
         return False
+
+    def reacquire_for_spool(self, uid: str, token: Optional[int]) -> bool:
+        """The write-behind spool's replay gate (service/storeguard.py):
+        may the spooled writes for ``uid`` — taken under fencing
+        ``token`` before/during the outage — land now?
+
+        True in exactly two cases: the store lease STILL carries our
+        token (the outage was shorter than the TTL), or the lease
+        expired UNCLAIMED and the journal intent still names this
+        replica — then one atomic NX re-take under the SAME token
+        resumes the epoch (nobody else ever held the uid in between,
+        so token monotonicity is preserved: same holder, same token).
+        Any other state means the lease was legitimately taken during
+        the outage — the adopter owns the uid's keys and the replay
+        must be REFUSED (the PR 8 no-double-commit invariant, verbatim).
+        Transport errors propagate (the guard re-enters DOWN and keeps
+        the spool)."""
+        if token is None:
+            return False
+        key = self._lease_key(uid)
+        with self._verify_lock:
+            t0 = self._clock()
+            raw = self._store.peek(key)
+            if raw is not None:
+                if int(self._parse(raw).get("token", -1)) == int(token):
+                    if self._store.pexpire(key, self._ttl_ms):
+                        h = self._held.get(uid)
+                        if h is not None and h.token == token:
+                            h.expires = t0 + self.lease_ttl_s
+                            h.lost = False
+                        return True
+                    raw = None  # expired between the read and the renew
+                else:
+                    _FENCE_REJECTED_TOTAL.inc()
+                    h = self._held.get(uid)
+                    if h is not None and h.token == token:
+                        self._mark_lost(h, "outage_superseded")
+                    return False
+            if not self._journal_ours(uid):
+                # adopted (and possibly finished + settled) elsewhere
+                # during the outage — the uid's keys are the adopter's
+                _FENCE_REJECTED_TOTAL.inc()
+                h = self._held.get(uid)
+                if h is not None and h.token == token:
+                    self._mark_lost(h, "outage_adopted")
+                return False
+            if self._store.set_px(key, self._payload(int(token)),
+                                  self._ttl_ms, nx=True):
+                h = self._held.get(uid)
+                if h is not None:
+                    h.token = int(token)
+                    h.expires = t0 + self.lease_ttl_s
+                    h.lost = False
+                _REACQUIRED_TOTAL.inc()
+                log_event("lease_reacquired_for_replay", uid=uid,
+                          token=token)
+                return True
+            _FENCE_REJECTED_TOTAL.inc()
+            h = self._held.get(uid)
+            if h is not None and h.token == token:
+                self._mark_lost(h, "outage_claimed")
+            return False
+
+    def release_token(self, uid: str, token: int) -> None:
+        """Compare-and-delete by EXPLICIT token — the spool replay's
+        cleanup for a job that settled locally during the outage (its
+        normal release already ran as a store-side no-op, so no
+        ``_held`` record exists to release through)."""
+        key = self._lease_key(uid)
+        try:
+            if int(self._parse(self._store.peek(key)).get("token", -1)) \
+                    == int(token):
+                self._store.delete(key)
+        except Exception as exc:
+            log_event("lease_release_failed", uid=uid, error=str(exc))
 
     def release(self, uid: str) -> None:
         """Terminal-status release: compare-and-delete (best effort —
@@ -529,6 +630,14 @@ class LeaseManager:
         worker's dequeue step).  False = a thief already claimed it."""
         return self._store.delete(self._adm_key(uid)) >= 1
 
+    def retract_admission_deferred(self, uid: str, guard) -> None:
+        """Outage spelling of :meth:`retract_admission`: spool the
+        marker DEL through the storeguard so it lands at replay — the
+        marker-key layout stays this class's private knowledge.  A
+        post-heal thief racing the replayed DEL loses either way:
+        whoever loses the arbiter is fenced by token."""
+        guard.delete(uid, self._adm_key(uid))
+
     def admission_claimed(self, uid: str) -> bool:
         """Has a thief already claimed this queued job's marker?  The
         DRAIN loop's poll: with the queue paused, the worker-side
@@ -593,6 +702,11 @@ class LeaseManager:
             "ewma_s": (round(m.wall_ewma(), 4)
                        if m is not None and m.wall_ewma() is not None
                        else None),
+            # compact per-replica SLO digest (ISSUE 14 satellite): the
+            # worst local e2e p99 + sample count — the autoscale leader
+            # scales on the FLEET max of these instead of its own
+            # (possibly idle, therefore blind) local window
+            "slo": obsplane.slo_digest(),
             "acq": int(_ACQUIRE_TOTAL.total()),
             "lost": int(_LOST_TOTAL.total()),
             "ts": round(time.time(), 3)}), self._ttl_ms)
@@ -642,6 +756,7 @@ class LeaseManager:
             "ewma_s": (round(m.wall_ewma(), 4)
                        if m is not None and m.wall_ewma() is not None
                        else None),
+            "slo": obsplane.slo_digest(),
             "acq": int(_ACQUIRE_TOTAL.total()),
             "lost": int(_LOST_TOTAL.total()),
         }
@@ -835,6 +950,14 @@ class LeaseManager:
         cadence) steal + recover.  Each phase is isolated — a store
         hiccup in one must not starve the others, and the thread must
         never die."""
+        if self._guard is not None:
+            # outage guard first: a healed store replays the spool (and
+            # un-stalls jobs) BEFORE renewals re-prove the leases the
+            # replay just reacquired
+            try:
+                self._guard.tick()
+            except Exception as exc:
+                log_event("storeguard_tick_failed", error=str(exc))
         try:
             self.publish_heartbeat()
         except Exception as exc:

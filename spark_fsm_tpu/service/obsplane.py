@@ -49,6 +49,7 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+from spark_fsm_tpu.service import storeguard
 from spark_fsm_tpu.utils import jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event
 
@@ -61,8 +62,10 @@ _SPINE_WRITES = (obs.REGISTRY.counter(
     "fsm_trace_spine_writes_total",
     "durable trace-spine chunk appends, by outcome (fenced = a stale "
     "holder's spans refused — the observability analog of a prevented "
-    "double-commit)")
-    .seed(outcome="ok").seed(outcome="fenced").seed(outcome="error"))
+    "double-commit; spooled = deferred into the storeguard write-behind "
+    "spool during a store outage)")
+    .seed(outcome="ok").seed(outcome="fenced").seed(outcome="error")
+    .seed(outcome="spooled"))
 # the SAME counter service/lease.py registers — get-or-create returns
 # the shared object, so spine refusals land next to the refused
 # result/checkpoint writes they are the trace-plane analog of
@@ -79,27 +82,61 @@ _STEAL_LATENCY_S = obs.REGISTRY.histogram(
     "work-steal latency: victim's admission (journal ts) to the "
     "thief's successful claim + resubmit").seed()
 
+# the tenant label (ISSUE 14 satellite): bounded vocabulary — "default"
+# from boot, fairness-registered tenants via seed_tenant — so per-tenant
+# SLO quantiles exist and the scrape never shows no-data for a tenant
+# that simply has not finished a job yet
+DEFAULT_TENANT = "default"
+_tenant_lock = threading.Lock()
+_tenants = {DEFAULT_TENANT}
+
 _E2E_S = obs.REGISTRY.histogram(
     "fsm_job_e2e_seconds",
-    "end-to-end job latency, submit to durable result, per priority")
+    "end-to-end job latency, submit to durable result, per priority "
+    "and tenant")
 _QUEUE_WAIT_S = obs.REGISTRY.histogram(
     "fsm_job_queue_wait_seconds",
-    "admission-queue wait, submit to first worker pickup, per priority")
+    "admission-queue wait, submit to first worker pickup, per priority "
+    "and tenant")
 _EXEC_S = obs.REGISTRY.histogram(
     "fsm_job_exec_seconds",
-    "execution component of the end-to-end latency, per priority")
+    "execution component of the end-to-end latency, per priority "
+    "and tenant")
 for _p in PRIORITIES:
-    _E2E_S.seed(priority=_p)
-    _QUEUE_WAIT_S.seed(priority=_p)
-    _EXEC_S.seed(priority=_p)
+    _E2E_S.seed(priority=_p, tenant=DEFAULT_TENANT)
+    _QUEUE_WAIT_S.seed(priority=_p, tenant=DEFAULT_TENANT)
+    _EXEC_S.seed(priority=_p, tenant=DEFAULT_TENANT)
+
+
+def seed_tenant(tenant: str) -> None:
+    """Zero-seed the fsm_job_*_seconds series for a (fairness-
+    registered, bounded) tenant across every priority class — the
+    obs_smoke no-orphan check covers the result."""
+    with _tenant_lock:
+        if tenant in _tenants:
+            return
+        _tenants.add(tenant)
+    for p in PRIORITIES:
+        _E2E_S.seed(priority=p, tenant=tenant)
+        _QUEUE_WAIT_S.seed(priority=p, tenant=tenant)
+        _EXEC_S.seed(priority=p, tenant=tenant)
+
+
+def known_tenants() -> List[str]:
+    with _tenant_lock:
+        return sorted(_tenants)
+
 
 # sliding-window twins of the three histograms — the /admin/slo p50/p95/
-# p99 source ([observability] slo_window_s)
+# p99 source ([observability] slo_window_s); the per-priority windows
+# keep their label shape, the per-tenant e2e window serves the tenant
+# SLO block
 _slo = {
     "e2e": obs.SlidingQuantiles(),
     "queue_wait": obs.SlidingQuantiles(),
     "exec": obs.SlidingQuantiles(),
 }
+_slo_tenant_e2e = obs.SlidingQuantiles()
 
 _lock = threading.Lock()
 _plane: Optional["TraceSpine"] = None
@@ -163,6 +200,8 @@ class TraceSpine:
             return "ok"
         mgr = self._mgr
         token = None
+        guard = storeguard.get()
+        outage = guard is not None and guard.is_down()
         try:
             if mgr is not None:
                 token = mgr.token_of(uid)
@@ -172,7 +211,10 @@ class TraceSpine:
                     _FENCE_REJECTED.inc()
                     _SPINE_WRITES.inc(outcome="fenced")
                     return "fenced"
-                if token is not None:
+                if token is not None and not outage:
+                    # during a proven outage the fence is deferred to
+                    # the spool's replay gate (the journal-gated NX
+                    # reacquire under the same token)
                     mgr.fence(uid)  # raises JobLeaseLost when superseded
                     self._fenced.discard(uid)
         except jobctl.JobLeaseLost:
@@ -190,7 +232,14 @@ class TraceSpine:
         cap = self._max_chunks if self._max_chunks is not None \
             else _max_chunks
         try:
-            self._store.spine_append(uid, chunk)
+            if guard is not None:
+                spooled = guard.spine(
+                    uid, chunk, gate=("none" if token is None else None))
+                if spooled:
+                    _SPINE_WRITES.inc(outcome="spooled")
+                    return "spooled"
+            else:
+                self._store.spine_append(uid, chunk)
             if cap:
                 self._store.spine_trim(uid, cap)
             _SPINE_WRITES.inc(outcome="ok")
@@ -248,6 +297,7 @@ def configure(ocfg) -> None:
     obs.set_spine_flush(int(ocfg.spine_flush_spans))
     for sq in _slo.values():
         sq.set_window(float(ocfg.slo_window_s))
+    _slo_tenant_e2e.set_window(float(ocfg.slo_window_s))
 
 
 # ---------------------------------------------------------------- timeline
@@ -344,18 +394,24 @@ def observe_steal_latency(seconds: float) -> None:
 # ---------------------------------------------------------------- SLO layer
 
 def observe_job(priority: str, e2e_s: float, queue_wait_s: float,
-                exec_s: float) -> None:
+                exec_s: float, tenant: str = DEFAULT_TENANT) -> None:
     """One finished job's latency decomposition (submit → durable
     result = queue wait + execution), into both the fixed-bucket
-    histograms and the sliding SLO window."""
+    histograms (labelled by priority AND tenant) and the sliding SLO
+    windows.  An unregistered tenant folds into "default" — the label
+    vocabulary stays bounded by the fairness registry."""
     if priority not in PRIORITIES:
         priority = "normal"
-    _E2E_S.observe(e2e_s, priority=priority)
-    _QUEUE_WAIT_S.observe(queue_wait_s, priority=priority)
-    _EXEC_S.observe(exec_s, priority=priority)
+    with _tenant_lock:
+        if tenant not in _tenants:
+            tenant = DEFAULT_TENANT
+    _E2E_S.observe(e2e_s, priority=priority, tenant=tenant)
+    _QUEUE_WAIT_S.observe(queue_wait_s, priority=priority, tenant=tenant)
+    _EXEC_S.observe(exec_s, priority=priority, tenant=tenant)
     _slo["e2e"].observe(e2e_s, priority=priority)
     _slo["queue_wait"].observe(queue_wait_s, priority=priority)
     _slo["exec"].observe(exec_s, priority=priority)
+    _slo_tenant_e2e.observe(e2e_s, tenant=tenant)
 
 
 def slo_snapshot() -> dict:
@@ -369,13 +425,36 @@ def slo_snapshot() -> dict:
     for p in PRIORITIES:
         out["priorities"][p] = {
             kind: sq.stats(priority=p) for kind, sq in _slo.items()}
+    # per-tenant e2e quantiles (ISSUE 14 satellite): every registered
+    # tenant gets a row — {"count": 0} until it finishes a job
+    out["tenants"] = {t: _slo_tenant_e2e.stats(tenant=t)
+                      for t in known_tenants()}
     return out
+
+
+def slo_digest() -> dict:
+    """COMPACT per-replica SLO digest piggybacked on the lease
+    heartbeat (the fleet-wide up_p99 merge): the worst per-priority e2e
+    p99 over the local sliding window plus the sample count behind it.
+    The autoscale leader scales on the FLEET max of these, so an idle
+    leader is no longer blind to a saturating peer."""
+    worst, n = None, 0
+    for p in PRIORITIES:
+        st = _slo["e2e"].stats(priority=p)
+        c = int(st.get("count") or 0)
+        n += c
+        p99 = st.get("p99")
+        if c and p99 is not None:
+            worst = p99 if worst is None else max(worst, p99)
+    return {"p99": (None if worst is None else round(float(worst), 4)),
+            "n": n}
 
 
 def clear_slo() -> None:
     """Drop the sliding windows (test isolation)."""
     for sq in _slo.values():
         sq.clear()
+    _slo_tenant_e2e.clear()
 
 
 # ------------------------------------------------------ cluster collector

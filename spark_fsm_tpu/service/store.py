@@ -265,6 +265,14 @@ class ResultStore:
             if cursor == "0":
                 return
 
+    def probe(self) -> bool:
+        """Active health probe (service/storeguard.py): can the store be
+        reached RIGHT NOW?  The in-process store is reachable by
+        construction — outages against it are simulated by wrapping
+        (tests) or by the ``storeguard.probe`` fault site, which the
+        guard weaves around this call."""
+        return True
+
     # -- write-ahead job journal -------------------------------------------
     # One intent record per live train job (``fsm:journal:{uid}``),
     # written at submit and cleared on every terminal status.  A record
@@ -373,12 +381,20 @@ class RedisResultStore(ResultStore):
     tests/test_redis_store.py.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 6379) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout_s: float = 10.0) -> None:
         super().__init__()
         from spark_fsm_tpu.service.resp import RespClient
 
-        self._r = RespClient(host=host, port=port)
+        self._host, self._port = host, port
+        self._timeout_s = float(timeout_s)
+        self._r = RespClient(host=host, port=port, timeout=self._timeout_s)
         self._r.ping()  # fail fast at boot, not on first job
+        # the probe rides a DEDICATED lazily-built connection with a
+        # short timeout: a data connection wedged in a blackhole must
+        # not alias onto the health verdict, and a probe against a
+        # down store must answer in ~a second, not the data timeout
+        self._probe_client = None
 
     def set(self, key: str, value: str) -> None:
         with _timed("set", "redis"):
@@ -441,6 +457,29 @@ class RedisResultStore(ResultStore):
         # MATCH already filters server-side; re-filter defensively so a
         # backend returning unmatched keys cannot leak them upward
         return nxt, [k for k in batch if k.startswith(prefix)]
+
+    def probe(self) -> bool:
+        """One PING on the dedicated probe connection (built fresh after
+        any failure, so a dead socket never caches a stale verdict).
+        Raises the transport error on an unreachable store — the
+        guard's state machine classifies it."""
+        from spark_fsm_tpu.service.resp import RespClient
+
+        try:
+            if self._probe_client is None:
+                self._probe_client = RespClient(
+                    host=self._host, port=self._port,
+                    timeout=min(2.0, self._timeout_s))
+            return self._probe_client.ping()
+        except Exception:
+            # drop the probe connection: the next probe reconnects from
+            # scratch instead of reading a desynced stream
+            try:
+                if self._probe_client is not None:
+                    self._probe_client.close()
+            finally:
+                self._probe_client = None
+            raise
 
     def spine_append(self, uid: str, chunk_json: str) -> None:
         self._r.rpush(f"fsm:trace:{uid}", chunk_json)

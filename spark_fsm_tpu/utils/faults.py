@@ -100,6 +100,16 @@ KNOWN_SITES = (
                          # finished mine — injection must leave the job
                          # green (results already durable); only the
                          # reuse entry is lost
+    "storeguard.probe",  # active store health probe (service/storeguard)
+                         # — an injected raise IS a failed probe (the
+                         # site's whole purpose: drive the health state
+                         # machine to DOWN deterministically); recovery
+                         # on disarm must replay the spool and heal
+    "storeguard.replay", # per-write spool replay after an outage —
+                         # injection must degrade to the current
+                         # terminal-failure path (job fenced, spool
+                         # dropped, store left heal-able), NEVER a
+                         # corrupt/partial state accepted on resume
 )
 
 _EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}

@@ -92,7 +92,7 @@ class JobControl:
 
     __slots__ = ("uid", "deadline", "cancelled", "running", "priority",
                  "lease_lost", "submitted_t", "started_t", "dataset_fp",
-                 "follower_of")
+                 "follower_of", "stalled", "tenant", "ephemeral")
 
     def __init__(self, uid: str, deadline: Optional[float],
                  priority: str = "normal"):
@@ -116,6 +116,18 @@ class JobControl:
         # discipline as ``cancelled``: lock-free at check sites, a stale
         # read costs one extra launch, never a missed fence
         self.lease_lost = False
+        # store-outage stall (service/storeguard.py): while True, the
+        # job PAUSES at its next safe point (frontier kept in memory)
+        # instead of raising — cleared by the guard on store return, or
+        # superseded by ``lease_lost`` when the outage ends badly
+        self.stalled = False
+        # multi-tenant identity (service/fairness.py): the admission
+        # tenant, stamped at submit — the fsm_job_*_seconds tenant label
+        self.tenant = "default"
+        # storeguard ephemeral admission: True marks a loudly-flagged
+        # NO-JOURNAL job admitted during a store outage — its durable
+        # writes ride the spool ungated (no lease, no journal intent)
+        self.ephemeral = False
         # SLO accounting stamps (service/obsplane.py): submit instant
         # and FIRST worker pickup — e2e = terminal - submitted_t,
         # queue wait = started_t - submitted_t (retries re-activate but
@@ -138,7 +150,7 @@ _cur: contextvars.ContextVar[Optional[JobControl]] = contextvars.ContextVar(
 def _recompute_active_locked() -> None:
     global _active
     _active = any(c.deadline is not None or c.cancelled or c.lease_lost
-                  for c in _jobs.values())
+                  or c.stalled for c in _jobs.values())
 
 
 def register(uid: str, deadline_s: Optional[float] = None,
@@ -198,6 +210,37 @@ def cancel(uid: str) -> Optional[str]:
         return "running" if ctl.running else "queued"
 
 
+# stalled job threads wait here; the storeguard notifies on every
+# unstall so a healed outage resumes jobs within one wait quantum
+_stall_cond = threading.Condition()
+
+
+def stall_entry(ctl: Optional[JobControl]) -> None:
+    """Flip a job's outage-stall flag (service/storeguard.py calls this
+    on the control OBJECT captured at lease-attach time): the job
+    PAUSES at its next safe point — frontier kept in memory — until
+    :func:`unstall_entry` or a fence/cancel/deadline supersedes."""
+    global _active
+    if ctl is None:
+        return
+    with _lock:
+        ctl.stalled = True
+        _active = True
+
+
+def unstall_entry(ctl: Optional[JobControl]) -> None:
+    """Release a stalled job (store returned, or the guard fenced it —
+    in the fenced case ``lease_lost`` is already set and the woken
+    check raises terminal LEASE_LOST instead of resuming)."""
+    if ctl is None:
+        return
+    with _lock:
+        ctl.stalled = False
+        _recompute_active_locked()
+    with _stall_cond:
+        _stall_cond.notify_all()
+
+
 def fence_lost(ctl: Optional[JobControl]) -> None:
     """Flip a job's lease-lost flag (lease heartbeat / fence checks call
     this on the CONTROL OBJECT they captured at attach time, never by
@@ -236,10 +279,25 @@ def activate(ctl: Optional[JobControl]):
 
 
 def check_entry(ctl: Optional[JobControl]) -> None:
-    """Raise the abort owed by ``ctl``, if any.  Used directly by the
-    Miner on dequeue (the queued-job path, where no context is bound)."""
+    """Raise the abort owed by ``ctl``, if any — or BLOCK while the
+    job is outage-stalled (service/storeguard.py): the safe point the
+    abort signals land on doubles as the pause point a store outage
+    parks the job at, frontier kept in memory.  Cancel, deadline and
+    fence signals are re-checked every wait quantum, so a stall never
+    shadows an abort the client is owed.  Used directly by the Miner on
+    dequeue (the queued-job path, where no context is bound)."""
     if ctl is None:
         return
+    while ctl.stalled:
+        _check_signals(ctl)
+        with _stall_cond:
+            if ctl.stalled:  # re-check under the condition: an unstall
+                _stall_cond.wait(0.05)  # between the reads must not
+                # strand this thread for a full quantum more than once
+    _check_signals(ctl)
+
+
+def _check_signals(ctl: JobControl) -> None:
     if ctl.cancelled:
         _CANCELLED_TOTAL.inc()
         obs.trace_event("job_cancelled", uid=ctl.uid)

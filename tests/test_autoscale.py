@@ -201,6 +201,38 @@ def test_p99_signal_scales_up():
         obsplane.clear_slo()
 
 
+def test_fleet_p99_merge_scales_up_from_a_peer_digest():
+    """ISSUE 14 satellite: the up_p99 signal is the FLEET max of the
+    heartbeat-piggybacked per-replica digests — an IDLE leader (empty
+    local window) must still scale up when a peer's digest shows a
+    saturated p99."""
+    from spark_fsm_tpu.service import obsplane
+
+    t, store, rigs = _rig(2, up_p99_s=1.0, hold_s=0.0)
+    (sc_a, m_a, mgr_a), (sc_b, m_b, mgr_b) = rigs
+    d0 = _decisions()
+    obsplane.clear_slo()  # the leader's own window is EMPTY (idle)
+    try:
+        # the peer's heartbeat record carries a saturated digest (the
+        # field publish_heartbeat now piggybacks from its local window;
+        # stamped directly here so the leader's merge — not the peer's
+        # in-process obsplane, which the two rigs share — is what's
+        # under test)
+        mgr_b.publish_heartbeat()
+        rec = json.loads(store.peek("fsm:replica:as-1"))
+        assert "slo" in rec  # the digest field rides every heartbeat
+        rec["slo"] = {"p99": 6.5, "n": 40}
+        store.set_px("fsm:replica:as-1", json.dumps(rec), 30000)
+        t[0] = 1.0
+        sc_a.tick()  # as-0 leads, local window empty — peer digest wins
+        out = json.loads(store.peek(AS.DESIRED_KEY))
+        assert out["dir"] == "up" and "p99" in out["reason"]
+        assert sc_a.stats()["last_eval"]["p99_s"] == 6.5
+        assert _decisions()["up"] == d0["up"] + 1
+    finally:
+        obsplane.clear_slo()
+
+
 def test_scale_down_targets_least_loaded_and_respects_min():
     t, store, rigs = _rig(2, hold_s=5.0, min_replicas=1,
                           down_free_frac=0.5)

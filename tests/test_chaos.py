@@ -1140,3 +1140,78 @@ def test_rescache_store_fault_keeps_job_green():
         assert store.keys("fsm:journal:") == []
     finally:
         cfgmod.set_config(old_cfg)
+
+
+@covers("storeguard.probe")
+def test_storeguard_probe_fault_drives_down_then_recovers_clean():
+    """An injected raise at the probe site IS a failed probe: it must
+    drive the health machine to DOWN deterministically (writes spool,
+    nothing lands), and disarming must heal — probe ok, spool replayed
+    IN ORDER, state healthy, store exactly as if no outage happened."""
+    from spark_fsm_tpu.service import storeguard as SG
+
+    SG.uninstall()
+    scfg = cfgmod.parse_config({"storeguard": {
+        "enabled": True, "probe_every_s": 0, "down_after": 1}}).storeguard
+    store = ResultStore()
+    g = SG.StoreGuard(store, scfg=scfg)
+    try:
+        with faults.injected("storeguard.probe", every=1):
+            assert g.probe_once() == "unreachable"
+            assert g.state == SG.DOWN
+            g.rpush("u1", "fsm:frontier:results:u1", "[1]")
+            g.set("u1", "fsm:frontier:u1", '{"meta": 1}')
+            assert store.peek("fsm:frontier:u1") is None
+            assert g.spool_entries() == 2
+            # probes keep failing while armed: still down, still spooled
+            g.tick()
+            assert g.state == SG.DOWN and g.spool_entries() == 2
+        g.tick()  # disarmed: probe succeeds, spool replays in order
+        assert g.state == SG.HEALTHY and g.drained()
+        assert store.lrange("fsm:frontier:results:u1") == ["[1]"]
+        assert store.peek("fsm:frontier:u1") == '{"meta": 1}'
+    finally:
+        SG.uninstall()
+
+
+@covers("storeguard.replay")
+def test_storeguard_replay_fault_degrades_terminal_never_corrupt():
+    """Injection DURING spool replay must degrade to the current
+    terminal-failure path — the job fences, its spool is dropped — and
+    must NEVER leave a state a resume would accept as valid: the spool
+    preserves delta-before-meta ordering, so an interrupted replay
+    leaves either no meta (load refuses: fresh restart) or a healable
+    torn tail, exactly the existing StoreCheckpoint contract."""
+    from spark_fsm_tpu.service import storeguard as SG
+    from spark_fsm_tpu.service.actors import StoreCheckpoint
+    from spark_fsm_tpu.utils import jobctl
+
+    SG.uninstall()
+    scfg = cfgmod.parse_config({"storeguard": {
+        "enabled": True, "probe_every_s": 0, "down_after": 1}}).storeguard
+    store = ResultStore()
+    g = SG.StoreGuard(store, scfg=scfg)
+    ctl = jobctl.register("rpl-1")
+    try:
+        # drive DOWN deterministically via the probe site, then spool a
+        # checkpoint-shaped write sequence (delta rpush, meta set LAST)
+        with faults.injected("storeguard.probe", every=1):
+            assert g.probe_once() == "unreachable"
+        assert g.state == SG.DOWN
+        g.rpush("rpl-1", "fsm:frontier:results:rpl-1", "[1, 2]")
+        g.set("rpl-1", "fsm:frontier:rpl-1",
+              json.dumps({"results_total": 2, "results_inline": [],
+                          "stack": []}))
+        assert g.spool_entries() == 2
+        # the replay's SECOND write faults: the delta landed, the meta
+        # did not — the spool is dropped, the job fenced
+        with faults.injected("storeguard.replay", nth=2):
+            g.tick()
+        assert g.state == SG.HEALTHY and g.drained()
+        assert ctl.lease_lost is True  # terminal at the next safe point
+        assert store.peek("fsm:frontier:rpl-1") is None
+        # never corrupt: a resume attempt REFUSES the metaless residue
+        assert StoreCheckpoint(store, "rpl-1").load() is None
+    finally:
+        jobctl.release("rpl-1")
+        SG.uninstall()

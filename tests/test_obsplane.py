@@ -205,6 +205,7 @@ def test_sliding_quantiles_window_and_exactness():
 def test_observe_job_feeds_histograms_and_slo_snapshot():
     obsplane.clear_slo()
     h0 = obs.REGISTRY.snapshot()["fsm_job_e2e_seconds"]
+    key = "priority=high,tenant=default"
     obsplane.observe_job("high", 2.0, 0.5, 1.5)
     obsplane.observe_job("high", 4.0, 1.0, 3.0)
     snap = obsplane.slo_snapshot()
@@ -214,13 +215,49 @@ def test_observe_job_feeds_histograms_and_slo_snapshot():
     assert row["exec"]["count"] == 2
     assert snap["priorities"]["low"]["e2e"] == {"count": 0}
     h1 = obs.REGISTRY.snapshot()["fsm_job_e2e_seconds"]
-    assert h1["priority=high"]["count"] == h0["priority=high"]["count"] + 2
+    assert h1[key]["count"] == h0[key]["count"] + 2
     # the label vocabulary is zero-seeded: 'low' scrapes as count 0,
-    # not no-data (the no-orphan-series posture)
-    assert "priority=low" in h1
+    # not no-data (the no-orphan-series posture) — with the tenant
+    # label riding along (ISSUE 14 satellite)
+    assert "priority=low,tenant=default" in h1
     text = obs.REGISTRY.render_prometheus()
     assert 'fsm_job_time_to_adoption_seconds_count 0' in text \
         or 'fsm_job_time_to_adoption_seconds_count' in text
+
+
+def test_tenant_label_and_per_tenant_slo_quantiles():
+    """ISSUE 14 satellite: fsm_job_e2e_seconds carries a tenant label
+    with a zero-seeded, BOUNDED vocabulary (fairness-registered
+    tenants), and /admin/slo serves per-tenant e2e quantiles."""
+    obsplane.clear_slo()
+    obsplane.seed_tenant("gold")
+    h = obs.REGISTRY.snapshot()["fsm_job_e2e_seconds"]
+    for p in obsplane.PRIORITIES:
+        assert f"priority={p},tenant=gold" in h  # seeded at 0
+    obsplane.observe_job("normal", 3.0, 1.0, 2.0, tenant="gold")
+    # an UNREGISTERED tenant folds into "default" — the label
+    # cardinality stays bounded no matter what requests claim
+    obsplane.observe_job("normal", 9.0, 1.0, 8.0, tenant="nope")
+    h = obs.REGISTRY.snapshot()["fsm_job_e2e_seconds"]
+    assert h["priority=normal,tenant=gold"]["count"] >= 1
+    assert not any(",tenant=nope" in k for k in h)
+    snap = obsplane.slo_snapshot()
+    assert snap["tenants"]["gold"]["count"] == 1
+    assert snap["tenants"]["gold"]["p99"] == 3.0
+    assert snap["tenants"]["default"]["count"] == 1
+    obsplane.clear_slo()
+
+
+def test_slo_digest_compact_and_heartbeat_merge_shape():
+    """The heartbeat's compact SLO digest: worst per-priority e2e p99
+    + sample count; None/0 on an empty window."""
+    obsplane.clear_slo()
+    assert obsplane.slo_digest() == {"p99": None, "n": 0}
+    obsplane.observe_job("high", 1.0, 0.1, 0.9)
+    obsplane.observe_job("low", 7.0, 0.1, 6.9)
+    d = obsplane.slo_digest()
+    assert d["n"] == 2 and d["p99"] == 7.0  # the WORST priority's p99
+    obsplane.clear_slo()
 
 
 def test_adoption_and_steal_histograms_seeded_and_observable():

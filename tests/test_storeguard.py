@@ -1,0 +1,654 @@
+"""Store-outage survival drills (ISSUE 14, service/storeguard.py).
+
+Three layers:
+
+- HERMETIC state-machine tests: a guard over a cuttable in-process
+  store — transitions need probe confirmation, spools are bounded and
+  replay in order, the replay gate refuses a spool whose lease was
+  legitimately taken during the outage (the no-double-commit
+  invariant, preserved verbatim).
+- The PINNED OUTAGE DRILL (the ISSUE 14 acceptance): cut the store
+  mid-checkpointed-mine → the job STALLS at a safe point (not a
+  terminal failure); heal the store → the SAME replica resumes via the
+  journal-gated NX reacquire and completes with oracle parity, zero
+  duplicated results, spool fully drained.
+- Admission posture: a DOWN store sheds 429 by default; with
+  ``ephemeral_admission`` the submit is admitted loudly flagged
+  no-journal and its results land via the spool replay.
+
+The disabled path (``[storeguard]`` off, the default) builds no guard
+objects — pinned here and byte-identical in scripts/bench_smoke.sh.
+"""
+
+import json
+import time
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.service import storeguard as SG
+from spark_fsm_tpu.service.actors import AdmissionShed, Miner
+from spark_fsm_tpu.service.lease import LeaseManager
+from spark_fsm_tpu.service.model import ServiceRequest, deserialize_patterns
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import faults, jobctl
+from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
+
+DRILL_TIMEOUT_S = 180.0
+
+
+class CuttableStore(ResultStore):
+    """In-process store whose every service-facing verb can be CUT
+    (raises ConnectionError — a transport failure, exactly what a
+    blackholed Redis surfaces).  ``cut_on_set_prefix`` arms an
+    automatic cut that engages right AFTER a key with that prefix
+    lands — the deterministic mid-checkpointed-mine outage trigger."""
+
+    def __init__(self, clock=None):
+        super().__init__(clock=clock)
+        self.cut = False
+        self.cut_on_set_prefix = None
+
+    def _gate(self):
+        if self.cut:
+            raise ConnectionError("injected store outage (cut)")
+
+    def set(self, key, value):
+        self._gate()
+        super().set(key, value)
+        pfx = self.cut_on_set_prefix
+        if pfx and key.startswith(pfx):
+            self.cut = True
+            self.cut_on_set_prefix = None
+
+    def get(self, key):
+        self._gate()
+        return super().get(key)
+
+    def peek(self, key):
+        self._gate()
+        return super().peek(key)
+
+    def rpush(self, key, value):
+        self._gate()
+        super().rpush(key, value)
+
+    def delete(self, key):
+        self._gate()
+        return super().delete(key)
+
+    def incr(self, key):
+        self._gate()
+        return super().incr(key)
+
+    def set_px(self, key, value, px_ms, nx=False):
+        self._gate()
+        return super().set_px(key, value, px_ms, nx=nx)
+
+    def pexpire(self, key, px_ms):
+        self._gate()
+        return super().pexpire(key, px_ms)
+
+    def pttl(self, key):
+        self._gate()
+        return super().pttl(key)
+
+    def llen(self, key):
+        self._gate()
+        return super().llen(key)
+
+    def lrange(self, key):
+        self._gate()
+        return super().lrange(key)
+
+    def scan_keys(self, prefix, cursor="0", count=512):
+        self._gate()
+        return super().scan_keys(prefix, cursor, count)
+
+    def spine_append(self, uid, chunk_json):
+        self._gate()
+        super().spine_append(uid, chunk_json)
+
+    def probe(self):
+        self._gate()
+        return True
+
+    # raw reads for assertions while the store is CUT (the test is the
+    # omniscient observer; the service under test cannot see these)
+    def raw(self, key):
+        return self._kv.get(key)
+
+
+def _scfg(**kw):
+    base = {"enabled": True, "probe_every_s": 0, "down_after": 2,
+            "spool_max_entries": 512, "stall_max_s": 120.0}
+    base.update(kw)
+    return cfgmod.parse_config({"storeguard": base}).storeguard
+
+
+@pytest.fixture(autouse=True)
+def _guard_hygiene():
+    SG.uninstall()
+    yield
+    SG.uninstall()
+
+
+@pytest.fixture()
+def storeguard_config():
+    """Swap the active config to a [storeguard]-enabled one (manual
+    probe ticks) and restore after."""
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"storeguard": {
+        "enabled": True, "probe_every_s": 0, "down_after": 1,
+        "stall_max_s": 120.0}}))
+    yield
+    cfgmod.set_config(old)
+
+
+# ------------------------------------------------------------ state machine
+
+
+def test_down_requires_probe_confirmation_then_replays_in_order():
+    store = CuttableStore()
+    g = SG.StoreGuard(store, scfg=_scfg(down_after=2))
+    # healthy direct write
+    g.set("u1", "k0", "v0")
+    assert store.raw("k0") == "v0" and g.state == SG.HEALTHY
+    store.cut = True
+    # first failure: flaky, still raising (no probe consulted yet)
+    with pytest.raises(ConnectionError):
+        g.set("u1", "k1", "v1")
+    assert g.state == SG.FLAKY
+    # second failure crosses down_after; the probe (also cut) confirms
+    # DOWN — and the write is SPOOLED instead of raising
+    g.set("u1", "k1", "v1")
+    g.rpush("u1", "l1", "a")
+    g.rpush("u1", "l1", "b")
+    g.set("u2", "k2", "v2")
+    assert g.state == SG.DOWN
+    assert store.raw("k1") is None  # nothing landed
+    assert g.spool_entries() == 4
+    # heal: one tick probes OK, replays everything in order
+    store.cut = False
+    g.tick()
+    assert g.state == SG.HEALTHY and g.drained()
+    assert store.raw("k1") == "v1" and store.raw("k2") == "v2"
+    assert store.lrange("l1") == ["a", "b"]
+
+
+def test_store_that_answers_probe_is_sick_not_down():
+    """Writes failing while the probe SUCCEEDS = the store is alive but
+    erroring — the guard must keep the conservative posture (raise,
+    fence), never spool."""
+    store = CuttableStore()
+    g = SG.StoreGuard(store, scfg=_scfg(down_after=1))
+
+    real_set = CuttableStore.set
+    calls = []
+
+    def set_fails(self, key, value):
+        calls.append(key)
+        raise ConnectionError("write path broken")
+
+    CuttableStore.set = set_fails
+    try:
+        with pytest.raises(ConnectionError):
+            g.set("u1", "k1", "v1")  # probe passes -> NOT down
+    finally:
+        CuttableStore.set = real_set
+    assert g.state == SG.FLAKY
+    assert g.drained()
+    # a later clean write heals flaky back to healthy
+    g.set("u1", "k1", "v1")
+    assert g.state == SG.HEALTHY
+
+
+def test_non_transport_errors_never_enter_the_state_machine():
+    store = CuttableStore()
+    g = SG.StoreGuard(store, scfg=_scfg(down_after=1))
+
+    real_set = CuttableStore.set
+
+    def set_value_error(self, key, value):
+        raise ValueError("bad payload")
+
+    CuttableStore.set = set_value_error
+    try:
+        with pytest.raises(ValueError):
+            g.set("u1", "k1", "v1")
+    finally:
+        CuttableStore.set = real_set
+    assert g.state == SG.HEALTHY and g.drained()
+
+
+def test_spool_bound_overflow_fences_the_job():
+    store = CuttableStore()
+    g = SG.StoreGuard(store, scfg=_scfg(down_after=1,
+                                        spool_max_entries=3))
+    ctl = jobctl.register("u-big")
+    try:
+        store.cut = True
+        g.set("u-big", "k", "v")  # confirms DOWN via probe
+        for i in range(3):
+            g.set("u-big", f"k{i}", "v")
+        # 4 entries > bound: the spool poisons, the job fences
+        assert ctl.lease_lost is True
+        assert g.spool_entries() == 0
+        # later writes for the poisoned uid are dropped, not spooled
+        g.set("u-big", "k9", "v")
+        assert g.spool_entries() == 0
+        # heal: the poisoned spool is dropped as refused, nothing lands
+        store.cut = False
+        g.tick()
+        assert g.state == SG.HEALTHY
+        assert store.raw("k0") is None and store.raw("k9") is None
+    finally:
+        jobctl.release("u-big")
+
+
+def test_replay_gate_same_token_reacquire_and_adopted_refusal():
+    """The invariant core: a spool whose lease expired UNCLAIMED with
+    the journal intent still ours replays under the SAME token; a
+    spool whose uid was adopted during the outage is REFUSED."""
+    t = [0.0]
+    store = CuttableStore(clock=lambda: t[0])
+    mgr = LeaseManager(store, replica_id="sg-a", lease_ttl_s=5.0,
+                       heartbeat_s=0, clock=lambda: t[0])
+    g = SG.StoreGuard(store, lease_mgr=mgr, scfg=_scfg(down_after=1),
+                      clock=lambda: t[0])
+    mgr.attach_guard(g)
+    tok = mgr.acquire("u1")
+    store.journal_set("u1", json.dumps({"replica": "sg-a",
+                                        "request": {"x": "1"}}))
+    store.cut = True
+    g.set("u1", "fsm:pattern:u1", "[1]")  # -> DOWN, spooled
+    assert g.state == SG.DOWN
+    # outage outlives the TTL: the store-side lease expires
+    t[0] = 10.0
+    store.cut = False
+    g.tick()
+    # journal still ours -> NX re-take under the SAME token, replayed
+    assert g.drained() and store.raw("fsm:pattern:u1") == "[1]"
+    assert json.loads(store.peek("fsm:lease:u1"))["token"] == tok
+    mgr.release("u1")
+    store.journal_clear("u1")
+
+    # round 2: an adopter takes the uid during the outage
+    tok2 = mgr.acquire("u2")
+    store.journal_set("u2", json.dumps({"replica": "sg-a",
+                                        "request": {"x": "1"}}))
+    ctl = jobctl.register("u2")
+    mgr.attach("u2", ctl)
+    store.cut = True
+    g.set("u2", "fsm:pattern:u2", "[stale]")
+    assert g.state == SG.DOWN
+    t[0] = 20.0  # lease expires store-side
+    store.cut = False
+    # the adopter: fresh (larger) token + journal rewritten
+    adopter = LeaseManager(store, replica_id="sg-b", lease_ttl_s=5.0,
+                           heartbeat_s=0, clock=lambda: t[0])
+    assert adopter.adopt_expired("u2") is True
+    store.journal_set("u2", json.dumps({"replica": "sg-b",
+                                        "request": {"x": "1"}}))
+    store.set("fsm:pattern:u2", "[adopter]")
+    g.tick()
+    # replay REFUSED: the stale spool never lands over the adopter's
+    assert g.drained()
+    assert store.peek("fsm:pattern:u2") == "[adopter]"
+    assert ctl.lease_lost is True  # fenced -> terminal path
+    assert json.loads(store.peek("fsm:lease:u2"))["token"] > tok2
+    jobctl.release("u2")
+
+
+def test_replay_released_job_cleans_its_reacquired_lease():
+    """A job that SETTLED locally during the outage (release ran as a
+    store-side no-op): the replay reacquires to land the writes, then
+    cleans the lease key it re-took."""
+    t = [0.0]
+    store = CuttableStore(clock=lambda: t[0])
+    mgr = LeaseManager(store, replica_id="sg-a", lease_ttl_s=5.0,
+                       heartbeat_s=0, clock=lambda: t[0])
+    g = SG.StoreGuard(store, lease_mgr=mgr, scfg=_scfg(down_after=1),
+                      clock=lambda: t[0])
+    mgr.attach_guard(g)
+    mgr.acquire("u1")
+    store.journal_set("u1", json.dumps({"replica": "sg-a"}))
+    store.cut = True
+    g.set("u1", "fsm:pattern:u1", "[1]")
+    g.delete("u1", "fsm:journal:u1")
+    mgr.release("u1")  # store-side no-op (cut); local record dropped
+    t[0] = 10.0
+    store.cut = False
+    g.tick()
+    assert g.drained()
+    assert store.peek("fsm:pattern:u1") == "[1]"
+    assert store.peek("fsm:journal:u1") is None
+    assert store.peek("fsm:lease:u1") is None  # cleaned after replay
+
+
+# -------------------------------------------------------------- admission
+
+
+def test_outage_sheds_admission_by_default(storeguard_config):
+    store = CuttableStore()
+    miner = Miner(store, workers=1)
+    try:
+        g = miner._guard
+        assert g is not None
+        store.cut = True
+        assert g.probe_once() == "unreachable" and g.is_down()
+        req = ServiceRequest("fsm", "train", {
+            "algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n", "support": "1.0",
+            "uid": "shed-me"})
+        with pytest.raises(AdmissionShed, match="store outage"):
+            miner.submit(req)
+        # zero trace of the uid anywhere (store cut, nothing spooled)
+        assert g.drained()
+    finally:
+        store.cut = False
+        miner.shutdown()
+
+
+def test_ephemeral_admission_runs_no_journal_job_through_the_spool():
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"storeguard": {
+        "enabled": True, "probe_every_s": 0, "down_after": 1,
+        "ephemeral_admission": True}}))
+    store = CuttableStore()
+    miner = Miner(store, workers=1)
+    try:
+        g = miner._guard
+        store.cut = True
+        assert g.probe_once() == "unreachable"
+        req = ServiceRequest("fsm", "train", {
+            "algorithm": "SPADE", "source": "INLINE",
+            "sequences": "1 -1 2 -2\n1 -1 2 -2\n", "support": "1.0",
+            "uid": "eph-1"})
+        extras = miner.submit(req)
+        assert extras == {"ephemeral": "1"}  # the LOUD flag
+        # the job runs to completion locally while the store is cut
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline and jobctl.get("eph-1") is not None:
+            time.sleep(0.02)
+        assert jobctl.get("eph-1") is None, "ephemeral job never settled"
+        assert store.raw("fsm:pattern:eph-1") is None  # not durable yet
+        # no journal intent ever existed (spooled or otherwise)
+        store.cut = False
+        g.tick()
+        assert g.drained()
+        assert store.status("eph-1") == "finished"
+        assert store.patterns("eph-1") is not None
+        assert store.journal_get("eph-1") is None
+    finally:
+        store.cut = False
+        miner.shutdown()
+        cfgmod.set_config(old)
+
+
+def test_disabled_path_builds_no_guard_objects():
+    store = ResultStore()
+    miner = Miner(store, workers=1)
+    try:
+        assert miner._guard is None
+        assert SG.get() is None
+    finally:
+        miner.shutdown()
+
+
+# ------------------------------------------------------- the outage drill
+
+
+def test_outage_drill_stall_resume_parity_spool_drained(
+        storeguard_config):
+    """THE ISSUE 14 acceptance pin: black-hole the store mid-
+    checkpointed-mine → the job pauses at a safe point (stalled, NOT
+    terminally failed); restore the store → the same replica resumes
+    through the journal-gated NX reacquire and completes with oracle
+    parity, zero duplicated results, spool fully drained."""
+    store = CuttableStore()
+    mgr = LeaseManager(store, replica_id="drill-a", lease_ttl_s=0.5,
+                       heartbeat_s=0)
+    miner = Miner(store, workers=1, lease_mgr=mgr)
+    g = miner._guard
+    assert g is not None
+    db = synthetic_db(seed=41, n_sequences=160, n_items=12,
+                      mean_itemsets=3.0, mean_itemset_size=1.3)
+    want = mine_spade(db, abs_minsup(0.05, len(db)))
+    try:
+        # slow every frontier save so the mine reliably spans the cut
+        # (the same trick replica_smoke uses), and cut the store right
+        # after the FIRST frontier snapshot lands
+        with faults.injected("checkpoint.save", every=1, delay_s=0.3,
+                             exc="none"):
+            store.cut_on_set_prefix = "fsm:frontier:drill"
+            miner.submit(ServiceRequest("fsm", "train", {
+                "algorithm": "SPADE_TPU", "source": "INLINE",
+                "sequences": format_spmf(db), "support": "0.05",
+                "checkpoint": "1", "checkpoint_every_s": "0",
+                "uid": "drill"}))
+            ctl = jobctl.get("drill")
+            assert ctl is not None
+            # wait for the auto-cut (first checkpoint landed)
+            deadline = time.time() + DRILL_TIMEOUT_S
+            while time.time() < deadline and not store.cut:
+                assert jobctl.get("drill") is not None, \
+                    f"job settled before the cut: {store.raw('fsm:error:drill')}"
+                time.sleep(0.02)
+            assert store.cut, "the mine never wrote a first checkpoint"
+            # pump lease heartbeats: the TTL lapses, the guard proves
+            # the outage, the job STALLS at its next safe point
+            deadline = time.time() + DRILL_TIMEOUT_S
+            while time.time() < deadline and not ctl.stalled:
+                mgr.tick()
+                g.tick()
+                assert not ctl.lease_lost, \
+                    "outage fenced the job instead of stalling it"
+                time.sleep(0.05)
+            assert ctl.stalled, "job never stalled at a safe point"
+            assert store.raw("fsm:status:drill") not in ("finished",
+                                                         "failure")
+            assert not g.drained() or g.state == SG.DOWN
+            # heal: the probe notices, the spool replays under the SAME
+            # token (journal-gated NX reacquire), the job resumes
+            store.cut = False
+            g.tick()
+            mgr.tick()
+        deadline = time.time() + DRILL_TIMEOUT_S
+        status = None
+        while time.time() < deadline:
+            mgr.tick()
+            try:
+                status = store.status("drill")
+            except ConnectionError:
+                status = None
+            if status in ("finished", "failure"):
+                break
+            time.sleep(0.05)
+        assert status == "finished", (status,
+                                      store.raw("fsm:error:drill"))
+        got = deserialize_patterns(store.patterns("drill"))
+        assert patterns_text(got) == patterns_text(want), \
+            diff_patterns(want, got)
+        # spool fully drained, bookkeeping settled, guard healthy
+        assert g.drained() and g.state == SG.HEALTHY
+        assert store.journal_get("drill") is None
+        deadline = time.time() + 10.0
+        while time.time() < deadline and \
+                store.peek("fsm:lease:drill") is not None:
+            time.sleep(0.05)
+        assert store.peek("fsm:lease:drill") is None
+    finally:
+        store.cut = False
+        miner.shutdown()
+
+
+def test_stall_honors_cancel_and_deadline():
+    """A stalled job is paused, not unkillable: cancel (and deadline)
+    land through the same safe point the stall parks on."""
+    ctl = jobctl.register("stall-1")
+    try:
+        jobctl.stall_entry(ctl)
+        import threading
+        woke = []
+
+        def runner():
+            try:
+                jobctl.check_entry(ctl)
+                woke.append("clean")
+            except jobctl.JobCancelled:
+                woke.append("cancelled")
+
+        th = threading.Thread(target=runner, daemon=True)
+        th.start()
+        time.sleep(0.15)
+        assert not woke, "check_entry returned while stalled"
+        assert jobctl.cancel("stall-1") == "queued"
+        th.join(5.0)
+        assert woke == ["cancelled"]
+    finally:
+        jobctl.unstall_entry(ctl)
+        jobctl.release("stall-1")
+
+
+def test_stall_max_fences_conservatively():
+    t = [0.0]
+    store = CuttableStore(clock=lambda: t[0])
+    g = SG.StoreGuard(store, scfg=_scfg(down_after=1, stall_max_s=30.0),
+                      clock=lambda: t[0])
+    ctl = jobctl.register("stall-2")
+    try:
+        store.cut = True
+        assert g.probe_once() == "unreachable"
+        assert g.stall_job(ctl, "stall-2") is True
+        assert ctl.stalled and not ctl.lease_lost
+        # the optimism budget runs out while the store is still down
+        t[0] = 31.0
+        g.tick()
+        assert ctl.lease_lost and not ctl.stalled
+    finally:
+        store.cut = False
+        jobctl.release("stall-2")
+
+
+def test_storeguard_config_validation():
+    with pytest.raises(cfgmod.ConfigError, match="down_after"):
+        cfgmod.parse_config({"storeguard": {"down_after": 0}})
+    with pytest.raises(cfgmod.ConfigError, match="spool_max_entries"):
+        cfgmod.parse_config({"storeguard": {"spool_max_entries": 0}})
+    with pytest.raises(cfgmod.ConfigError, match="stall_max_s"):
+        cfgmod.parse_config({"storeguard": {"stall_max_s": -1}})
+    with pytest.raises(cfgmod.ConfigError, match="probe_every_s"):
+        cfgmod.parse_config({"storeguard": {"probe_every_s": -1}})
+    with pytest.raises(cfgmod.ConfigError, match="timeout_s"):
+        cfgmod.parse_config({"store": {"timeout_s": 0}})
+    cfg = cfgmod.parse_config({"storeguard": {
+        "enabled": True, "ephemeral_admission": True}})
+    assert cfg.storeguard.enabled and cfg.storeguard.ephemeral_admission
+
+
+def test_down_flaky_drift_still_replays_and_bounds_stalls():
+    """Review findings (ISSUE 14): a DOWN -> flaky drift (store
+    answers the probe but is sick) must neither strand the spool
+    forever once the store truly heals, nor hold a stall past
+    stall_max_s."""
+    t = [0.0]
+    store = CuttableStore(clock=lambda: t[0])
+    g = SG.StoreGuard(store, scfg=_scfg(down_after=1, stall_max_s=30.0),
+                      clock=lambda: t[0])
+    ctl = jobctl.register("drift-1")
+    try:
+        store.cut = True
+        g.set("drift-1", "k1", "v1")  # probe unreachable -> DOWN, spooled
+        assert g.state == SG.DOWN and g.spool_entries() == 1
+        assert g.stall_job(ctl, "drift-1") is True
+        # the store comes back SICK: probe raises a non-transport error
+        # -> DOWN drifts to FLAKY with the stale error streak intact
+        store.cut = False
+        real_probe = CuttableStore.probe
+        CuttableStore.probe = lambda self: (_ for _ in ()).throw(
+            RuntimeError("LOADING"))
+        try:
+            assert g.probe_once() == "error"
+            assert g.state == SG.FLAKY
+            # stall bound applies in FLAKY too: past it the job fences
+            t[0] = 31.0
+            g.tick()
+            assert ctl.lease_lost and not ctl.stalled
+        finally:
+            CuttableStore.probe = real_probe
+        # store now truly healthy: the pending spool must replay even
+        # though the stale streak never saw a successful direct write
+        g.tick()
+        assert g.state == SG.HEALTHY and g.drained()
+        assert store.raw("k1") == "v1"
+    finally:
+        jobctl.release("drift-1")
+
+
+def test_ephemeral_replay_refused_when_uid_has_foreign_trace():
+    """Review finding (ISSUE 14): a gate="none" (ephemeral) spool must
+    NOT clobber a uid that acquired a durable trace elsewhere during
+    the outage — a reused uid's durable run wins, the ephemeral spool
+    is refused."""
+    store = CuttableStore()
+    mgr = LeaseManager(store, replica_id="eph-a", lease_ttl_s=30.0,
+                       heartbeat_s=0)
+    g = SG.StoreGuard(store, lease_mgr=mgr, scfg=_scfg(down_after=1))
+    store.cut = True
+    g.set("eph-x", "fsm:pattern:eph-x", "[ephemeral]", gate="none")
+    assert g.state == SG.DOWN
+    store.cut = False
+    # during the outage a peer ran a DURABLE job under the same uid
+    store.add_status("eph-x", "finished")
+    store.set("fsm:pattern:eph-x", "[durable]")
+    g.tick()
+    assert g.drained()
+    assert store.peek("fsm:pattern:eph-x") == "[durable]"
+    # while a uid with NO trace anywhere replays fine
+    store.cut = True
+    g.set("eph-y", "fsm:pattern:eph-y", "[ephemeral]", gate="none")
+    store.cut = False
+    g.tick()
+    assert store.peek("fsm:pattern:eph-y") == "[ephemeral]"
+
+
+def test_refused_replay_still_sweeps_own_admission_marker():
+    """Review finding (ISSUE 14): a refused spool drop must not leak
+    this replica's TTL-less admission marker — the deferred marker DEL
+    is swept best-effort even when everything else is refused."""
+    t = [0.0]
+    store = CuttableStore(clock=lambda: t[0])
+    mgr = LeaseManager(store, replica_id="mk-a", lease_ttl_s=5.0,
+                       heartbeat_s=0, clock=lambda: t[0])
+    g = SG.StoreGuard(store, lease_mgr=mgr, scfg=_scfg(down_after=1),
+                      clock=lambda: t[0])
+    mgr.attach_guard(g)
+    tok = mgr.acquire("mk-1")
+    store.journal_set("mk-1", json.dumps({"replica": "mk-a",
+                                          "request": {"x": "1"}}))
+    mgr.publish_admission("mk-1")
+    marker = "fsm:admission:mk-a:mk-1"
+    assert store.peek(marker) is not None
+    store.cut = True
+    # the dequeue-during-outage path: marker DEL + result write spool
+    g.delete("mk-1", marker)
+    g.set("mk-1", "fsm:pattern:mk-1", "[stale]")
+    assert g.state == SG.DOWN
+    # outage outlives the TTL; an adopter takes the uid meanwhile
+    t[0] = 10.0
+    store.cut = False
+    adopter = LeaseManager(store, replica_id="mk-b", lease_ttl_s=5.0,
+                           heartbeat_s=0, clock=lambda: t[0])
+    assert adopter.adopt_expired("mk-1") is True
+    store.journal_set("mk-1", json.dumps({"replica": "mk-b",
+                                          "request": {"x": "1"}}))
+    g.tick()
+    assert g.drained()
+    assert store.peek("fsm:pattern:mk-1") is None  # refused, dropped
+    assert store.peek(marker) is None  # ...but the marker was swept
+    assert tok >= 1
