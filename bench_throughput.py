@@ -91,6 +91,18 @@ TENANTS_COMPARED = ("tenants_jobs", "tenants_parity",
 ZIPF_COMPARED = ("zipf_jobs", "zipf_parity", "zipf_hit_ratio_ok",
                  "zipf_speedup_2x", "no_p99_regression_cold")
 
+# --mix engines (ISSUE 15): the SPAM-engine + planner success metric —
+# the same pattern-mine flood run per engine route (SPADE_TPU vs
+# SPAM_TPU) over a DENSE dataset pool, plus an AUTO flood over a mixed
+# dense+sparse pool.  Structural guards: byte parity per dataset across
+# every route, AUTO routes every dense job to SPAM_TPU and every
+# sparse job to SPADE_TPU (never SPAM below the calibrated crossover),
+# zero sheds/failures.  Walls (jobs/s per engine) are reported next to
+# them, never compared — and the existing default/zipf/tenants rows
+# are untouched (this mix only ADDS keys).
+ENGINES_COMPARED = ("engines_jobs", "engines_parity", "engines_auto_ok",
+                    "engines_failures", "engines_sheds")
+
 N_JOBS = int(os.environ.get("SPARKFSM_TP_JOBS", "48"))
 N_WORKERS = int(os.environ.get("SPARKFSM_TP_WORKERS", "8"))
 N_RUNS = int(os.environ.get("SPARKFSM_TP_RUNS", "3"))
@@ -425,6 +437,203 @@ def main_zipf(update: bool, n_jobs: int, workers: int) -> int:
     return 0
 
 
+ENGINES_JOBS = int(os.environ.get("SPARKFSM_TP_ENG_JOBS", "24"))
+
+
+def _engines_datasets():
+    """Dense pool (above the density crossover) + sparse pool (below
+    it — the ONE sub-crossover shape, data/synth.sub_crossover_db).
+    One geometry per pool."""
+    from spark_fsm_tpu.data.synth import sub_crossover_db, synthetic_db
+
+    dense = [synthetic_db(seed=300 + i, n_sequences=90, n_items=9,
+                          mean_itemsets=3.0, mean_itemset_size=1.2)
+             for i in range(4)]
+    sparse = [sub_crossover_db(offset=17 * k) for k in range(2)]
+    return dense, sparse
+
+
+def _engines_flood(plan, workers, label):
+    """Run a [(algorithm, db_key, db, support)] plan through a fresh
+    Master; returns (rows keyed by uid -> (db_key, patterns-json,
+    planner_engine), summary)."""
+    import json as _json
+
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import ServiceRequest
+    from spark_fsm_tpu.service.store import ResultStore
+
+    store = ResultStore()
+    master = Master(store=store, miner_workers=workers)
+    spmf = {}
+    try:
+        t0 = time.monotonic()
+        t_submit, done, meta = {}, {}, {}
+        sheds = failures = 0
+        for i, (algo, db_key, db, support) in enumerate(plan):
+            if db_key not in spmf:
+                spmf[db_key] = format_spmf(db)
+            uid = f"eng-{label}-{i}"
+            resp = master.handle(ServiceRequest("fsm", "train", {
+                "algorithm": algo, "source": "INLINE",
+                "sequences": spmf[db_key], "support": support,
+                "uid": uid}))
+            if resp.status == "failure":
+                sheds += 1
+                continue
+            t_submit[uid] = time.monotonic()
+            meta[uid] = db_key
+        deadline = time.monotonic() + DEADLINE_S
+        while t_submit.keys() - done.keys() and time.monotonic() < deadline:
+            for uid in list(t_submit.keys() - done.keys()):
+                st = store.status(uid)
+                if st in ("finished", "failure"):
+                    done[uid] = (time.monotonic(), st)
+                    if st == "failure":
+                        failures += 1
+            time.sleep(0.002)
+        pending = t_submit.keys() - done.keys()
+        if pending:
+            raise TimeoutError(
+                f"engines-{label}: {len(pending)} jobs never finished")
+        wall = time.monotonic() - t0
+        rows = {}
+        for uid, db_key in meta.items():
+            stats = _json.loads(store.get(f"fsm:stats:{uid}") or "{}")
+            rows[uid] = (db_key, store.patterns(uid),
+                         stats.get("planner_engine"))
+        lats = sorted(done[u][0] - t_submit[u] for u in done)
+        q = lambda p: lats[min(len(lats) - 1, int(p * (len(lats) - 1)))]
+        summary = {"jobs": len(done), "wall_s": round(wall, 3),
+                   "jobs_per_sec": round(len(done) / wall, 2),
+                   "p50_s": round(q(0.50), 4),
+                   "p99_s": round(q(0.99), 4),
+                   "sheds": sheds, "failures": failures}
+        return rows, summary
+    finally:
+        master.shutdown()
+
+
+def main_engines(update: bool, n_jobs: int, workers: int) -> int:
+    """--mix engines: the ISSUE 15 SPAM-engine + planner metric."""
+    from spark_fsm_tpu.ops import ragged_batch as RB
+    from spark_fsm_tpu.utils import jitcache
+
+    RB.set_overhead_calibration(False)
+    jitcache.enable_compile_counter()
+    dense, sparse = _engines_datasets()
+
+    def dense_plan(algo):
+        return [(algo, f"d{i % len(dense)}", dense[i % len(dense)],
+                 "0.08") for i in range(n_jobs)]
+
+    auto_plan = []
+    for i in range(n_jobs):
+        if i % 3 == 2:  # every third AUTO job is a sparse shape
+            k = i % len(sparse)
+            auto_plan.append(("AUTO", f"s{k}", sparse[k], "2"))
+        else:
+            k = i % len(dense)
+            auto_plan.append(("AUTO", f"d{k}", dense[k], "0.08"))
+
+    # compile-warm every route to stability (same arbiter as the other
+    # mixes: a timed phase must not pay fresh XLA compiles)
+    for i in range(6):
+        before = jitcache.compile_counts()["count"]
+        _engines_flood(dense_plan("SPADE_TPU"), workers, f"w-spade-{i}")
+        _engines_flood(dense_plan("SPAM_TPU"), workers, f"w-spam-{i}")
+        _engines_flood(auto_plan, workers, f"w-auto-{i}")
+        if jitcache.compile_counts()["count"] == before:
+            break
+
+    def med(runs):
+        vals = sorted(r["jobs_per_sec"] for r in runs)
+        return vals[len(vals) // 2]
+
+    rows_all = {}
+    per_engine = {}
+    sheds = failures = 0
+    for algo in ("SPADE_TPU", "SPAM_TPU"):
+        runs = []
+        for i in range(N_RUNS):
+            rows, s = _engines_flood(dense_plan(algo), workers,
+                                     f"{algo}-{i}")
+            rows_all.update(rows)
+            runs.append(s)
+            sheds += s["sheds"]; failures += s["failures"]
+        per_engine[algo] = {
+            "jobs_per_sec": med(runs),
+            "p99_s": sorted(r["p99_s"] for r in runs)[len(runs) // 2],
+            "runs_jobs_per_sec": [r["jobs_per_sec"] for r in runs]}
+
+    auto_rows, auto_sum = _engines_flood(auto_plan, workers, "auto")
+    rows_all.update(auto_rows)
+    sheds += auto_sum["sheds"]; failures += auto_sum["failures"]
+
+    # parity: one byte-exact pattern set per dataset key across EVERY
+    # engine route (explicit SPADE, explicit SPAM, AUTO both ways)
+    by_key = {}
+    for db_key, pats, _ in rows_all.values():
+        by_key.setdefault(db_key, set()).add(pats)
+    parity = all(len(v) == 1 for v in by_key.values())
+
+    # AUTO routing: dense keys -> SPAM_TPU, sparse keys -> SPADE_TPU
+    # ("AUTO never picks SPAM below the calibrated density crossover")
+    routed = {"dense": set(), "sparse": set()}
+    for db_key, _, eng in auto_rows.values():
+        routed["dense" if db_key.startswith("d") else "sparse"].add(eng)
+    auto_ok = (routed["dense"] == {"SPAM_TPU"}
+               and routed["sparse"] == {"SPADE_TPU"})
+
+    out = {
+        "engines_jobs": n_jobs, "workers": workers,
+        "engines_parity": parity,
+        "engines_auto_ok": auto_ok,
+        "engines_failures": failures,
+        "engines_sheds": sheds,
+        "engines": {
+            **per_engine,
+            "spam_speedup_dense": round(
+                per_engine["SPAM_TPU"]["jobs_per_sec"]
+                / max(1e-9, per_engine["SPADE_TPU"]["jobs_per_sec"]), 2),
+            "auto": {"jobs_per_sec": auto_sum["jobs_per_sec"],
+                     "p99_s": auto_sum["p99_s"],
+                     "routed": {k: sorted(x for x in v if x)
+                                for k, v in routed.items()}},
+        },
+    }
+    print(json.dumps(out, indent=2))
+
+    try:
+        with open(EXPECT_PATH) as fh:
+            expect = json.load(fh)
+    except OSError:
+        expect = {}
+    if update:
+        expect.update({k: out[k] for k in ENGINES_COMPARED})
+        with open(EXPECT_PATH, "w") as fh:
+            json.dump(expect, fh, indent=2)
+            fh.write("\n")
+        print(f"bench_throughput: engines expectations written -> "
+              f"{EXPECT_PATH}")
+        return 0
+    bad = [k for k in ENGINES_COMPARED if out.get(k) != expect.get(k)]
+    if bad:
+        for k in bad:
+            print(f"bench_throughput[engines]: MISMATCH {k}: got "
+                  f"{out.get(k)!r}, expected {expect.get(k)!r}",
+                  file=sys.stderr)
+        return 1
+    print(f"bench_throughput[engines]: OK (dense flood: SPAM "
+          f"{per_engine['SPAM_TPU']['jobs_per_sec']} jobs/s vs SPADE "
+          f"{per_engine['SPADE_TPU']['jobs_per_sec']} jobs/s "
+          f"({out['engines']['spam_speedup_dense']}x); AUTO routed "
+          f"dense->SPAM_TPU, sparse->SPADE_TPU with byte parity — "
+          f"walls reported, guards structural)")
+    return 0
+
+
 TEN_WORKERS = int(os.environ.get("SPARKFSM_TP_TEN_WORKERS", "2"))
 TEN_FLOOD = int(os.environ.get("SPARKFSM_TP_TEN_FLOOD", "36"))
 TEN_BG = int(os.environ.get("SPARKFSM_TP_TEN_BG", "8"))
@@ -671,8 +880,9 @@ def main() -> int:
     mix = None
     if "--mix" in args:
         mix = args[args.index("--mix") + 1]
-        if mix not in ("zipf", "tenants"):
-            sys.exit(f"unknown --mix {mix!r} (have: zipf, tenants)")
+        if mix not in ("zipf", "tenants", "engines"):
+            sys.exit(f"unknown --mix {mix!r} "
+                     f"(have: zipf, tenants, engines)")
     n_jobs, workers = N_JOBS, N_WORKERS
     if "--jobs" in args:
         n_jobs = int(args[args.index("--jobs") + 1])
@@ -686,6 +896,11 @@ def main() -> int:
         return main_tenants(
             update,
             TEN_WORKERS if "--workers" not in args else workers)
+    if mix == "engines":
+        return main_engines(
+            update,
+            ENGINES_JOBS if "--jobs" not in args else n_jobs,
+            workers)
 
     from spark_fsm_tpu import config as cfgmod
     from spark_fsm_tpu.ops import ragged_batch as RB

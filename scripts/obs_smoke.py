@@ -220,7 +220,11 @@ def main() -> int:
                     "fsm_storeguard_dropped_writes_total",
                     "fsm_storeguard_stalls_total",
                     "fsm_storeguard_outage_sheds_total",
-                    "fsm_storeguard_ephemeral_admissions_total"):
+                    "fsm_storeguard_ephemeral_admissions_total",
+                    # ISSUE 15 family: engine planner
+                    # (service/planner.py) — present even when no AUTO
+                    # request ever arrived
+                    "fsm_engine_selected_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -255,7 +259,12 @@ def main() -> int:
                 ("fsm_storeguard_stalls_total", "outcome",
                  {"entered", "resumed", "fenced"}),
                 ("fsm_storeguard_transitions_total", "state",
-                 {"healthy", "flaky", "down"})):
+                 {"healthy", "flaky", "down"}),
+                # ISSUE 15 vocabulary: every routable engine is seeded
+                # so "this engine never ran" reads as 0, not no-data
+                ("fsm_engine_selected_total", "engine",
+                 {"SPADE", "SPADE_TPU", "SPAM", "SPAM_TPU", "TSR",
+                  "TSR_TPU"})):
             got = {m.group(1) for k in families.get(fam, {})
                    for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
             missing = want - got

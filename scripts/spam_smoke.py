@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""SPAM engine + planner smoke (ISSUE 15).
+
+Seconds-scale CI proof of the new subsystem, on two dataset SHAPES:
+
+- a DENSE miniature (small alphabet, high bitmap fill — above the
+  calibrated density crossover) and a SPARSE one (wide low-support
+  alphabet — below it);
+- SPAM vs oracle parity on BOTH shapes, direct (``algorithm=SPAM_TPU``
+  and the CPU ``SPAM`` plugin) through the real service admission
+  path;
+- the AUTO routing drill: the planner must route the dense shape to
+  ``SPAM_TPU`` and the sparse shape to ``SPADE_TPU`` (never SPAM below
+  the crossover), each with byte parity and the decision recorded in
+  the job's trace;
+- an unknown engine name sheds the structured 400 whose ``supported``
+  list is the live registry;
+- the ``fsm_engine_selected_total{engine=...}`` family is live with
+  its full zero-seeded vocabulary (the obs_smoke no-orphan contract).
+
+Usage: scripts/spam_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import sub_crossover_db, synthetic_db
+    from spark_fsm_tpu.data.vertical import abs_minsup, dataset_stats
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.service import planner, plugins
+    from spark_fsm_tpu.service.actors import Master
+    from spark_fsm_tpu.service.model import (ServiceRequest,
+                                             deserialize_patterns)
+    from spark_fsm_tpu.service.store import ResultStore
+    from spark_fsm_tpu.utils import obs
+    from spark_fsm_tpu.utils.canonical import patterns_text
+
+    cfgmod.set_config(cfgmod.parse_config(
+        {"observability": {"trace": True}}))
+
+    dense = synthetic_db(seed=7, n_sequences=60, n_items=10,
+                         mean_itemsets=3.0, mean_itemset_size=1.3)
+    # 400 items x support 2: density 0.01 < 0.02 (the ONE shared
+    # sub-crossover shape — see its docstring)
+    sparse = sub_crossover_db()
+
+    failures = []
+    store = ResultStore()
+    master = Master(store=store, miner_workers=2)
+
+    def run(uid, algo, db, support):
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": algo, "source": "INLINE",
+            "sequences": format_spmf(db), "support": support,
+            "uid": uid}))
+        if resp.status != "started":
+            failures.append(f"{uid}: submit failed: {resp.data}")
+            return None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = store.status(uid)
+            if st in ("finished", "failure"):
+                break
+            time.sleep(0.02)
+        if store.status(uid) != "finished":
+            failures.append(f"{uid}: did not finish "
+                            f"({store.status(uid)}: "
+                            f"{store.get(f'fsm:error:{uid}')})")
+            return None
+        stats = json.loads(store.get(f"fsm:stats:{uid}") or "{}")
+        return patterns_text(deserialize_patterns(store.patterns(uid))), \
+            stats
+
+    try:
+        # ---- shape sanity: the two miniatures straddle the crossover
+        ms_dense = abs_minsup(0.1, len(dense))
+        d_stats = dataset_stats(dense, min_item_support=ms_dense)
+        s_stats = dataset_stats(sparse, min_item_support=2)
+        x = cfgmod.get_config().planner.density_crossover
+        if not (d_stats.density >= x > s_stats.density):
+            failures.append(
+                f"miniatures do not straddle the crossover {x}: dense "
+                f"{d_stats.density}, sparse {s_stats.density}")
+
+        want_dense = patterns_text(mine_spade(dense, ms_dense))
+        want_sparse = patterns_text(mine_spade(sparse, 2))
+
+        # ---- direct SPAM parity on both shapes, both plugin legs
+        for uid, algo, db, sup, want in (
+                ("spam-dense-tpu", "SPAM_TPU", dense, "0.1", want_dense),
+                ("spam-dense-cpu", "SPAM", dense, "0.1", want_dense),
+                ("spam-sparse-tpu", "SPAM_TPU", sparse, "2",
+                 want_sparse)):
+            out = run(uid, algo, db, sup)
+            if out and out[0] != want:
+                failures.append(f"{uid}: NOT byte-identical to oracle")
+            if out and out[1].get("engine") not in ("spam", "spam-cpu"):
+                failures.append(f"{uid}: wrong engine stats {out[1]}")
+
+        # ---- AUTO routing drill on the two shapes
+        out = run("auto-dense", "AUTO", dense, "0.1")
+        if out:
+            if out[0] != want_dense:
+                failures.append("auto-dense: parity broken")
+            if out[1].get("planner_engine") != "SPAM_TPU":
+                failures.append(f"auto-dense routed "
+                                f"{out[1].get('planner_engine')}, "
+                                f"want SPAM_TPU")
+        out = run("auto-sparse", "AUTO", sparse, "2")
+        if out:
+            if out[0] != want_sparse:
+                failures.append("auto-sparse: parity broken")
+            if out[1].get("planner_engine") != "SPADE_TPU":
+                failures.append(
+                    f"auto-sparse routed "
+                    f"{out[1].get('planner_engine')} — AUTO must never "
+                    f"pick SPAM below the crossover")
+
+        # ---- the decision is on the trace
+        dump = obs.trace_dump("auto-dense")
+        routes = [s for s in (dump or {}).get("spans", ())
+                  if s["site"] == "planner.route"]
+        if not routes or routes[0]["attrs"].get("engine") != "SPAM_TPU":
+            failures.append(f"planner.route span missing/wrong: {routes}")
+
+        # ---- unknown engine: structured 400 from the registry
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "SPQR", "source": "INLINE",
+            "sequences": format_spmf(dense), "support": "0.1"}))
+        if resp.data.get("http_status") != "400":
+            failures.append(f"unknown engine not a 400: {resp.data}")
+        elif json.loads(resp.data.get("supported", "[]")) != \
+                sorted(plugins.ALGORITHMS):
+            failures.append("400 'supported' list drifted from the "
+                            "registry")
+
+        # ---- metric family live with the full vocabulary
+        fam = obs.REGISTRY.snapshot().get("fsm_engine_selected_total", {})
+        for eng in planner.CONCRETE_ENGINES:
+            if f"engine={eng}" not in fam:
+                failures.append(f"fsm_engine_selected_total missing "
+                                f"seeded engine={eng}")
+        if fam.get("engine=SPAM_TPU", 0) < 2:  # direct + auto-dense
+            failures.append(f"engine=SPAM_TPU did not count: {fam}")
+        if fam.get("engine=SPADE_TPU", 0) < 1:  # auto-sparse
+            failures.append(f"engine=SPADE_TPU did not count: {fam}")
+    finally:
+        master.shutdown()
+
+    if failures:
+        print("spam_smoke: FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print(f"spam_smoke: OK (dense density {d_stats.density} -> SPAM_TPU, "
+          f"sparse density {s_stats.density} -> SPADE_TPU, oracle "
+          f"parity on both shapes, structured 400, "
+          f"fsm_engine_selected_total live)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

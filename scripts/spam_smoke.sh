@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# SPAM engine + planner smoke — seconds-scale proof that the SPAM wave
+# engine is byte-identical to the oracle on a dense AND a sparse
+# miniature, that AUTO routes each shape to the right engine (never
+# SPAM below the calibrated crossover), and that the structured-400 /
+# fsm_engine_selected_total surfaces are live.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/spam_smoke.py "$@"

@@ -34,6 +34,10 @@
 #   fleet_smoke.sh       kill scripts/fleet.py mid-scale-up, restart,
 #                        converge to desired from heartbeats — zero
 #                        lost/duplicated jobs
+#   spam_smoke.sh        SPAM wave engine vs oracle parity on a dense
+#                        AND a sparse miniature + AUTO planner routing
+#                        drill (never SPAM below the crossover) +
+#                        structured 400 + fsm_engine_selected_total
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -46,7 +50,7 @@ if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
              throughput_smoke resident_smoke partition_smoke \
              replica_smoke rescache_smoke autoscale_smoke \
-             storm_smoke fleet_smoke; do
+             storm_smoke fleet_smoke spam_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

@@ -160,12 +160,34 @@ File format (TOML shown; JSON with the same nesting also accepted):
     max_replicas = 8
     up_queue_per_worker = 2.0       # scale up past this queued/worker
     up_p99_s = 0.0                  # scale up past this SLO p99 (0 = off)
+    up_rate_derivative = 0.0        # PREDICTIVE scale-up: EWMA of the
+                                    # fleet admission-rate derivative
+                                    # (jobs/s per second) above which
+                                    # load is accelerating (0 = off);
+                                    # rides the same hold_s hysteresis
+    rate_alpha = 0.3                # EWMA smoothing for the admission
+                                    # rate and its derivative, in (0,1]
     down_free_frac = 0.5            # scale down past this idle fraction
     hold_s = 10.0                   # signal must persist (hysteresis)
     cooldown_s = 30.0               # min gap between decisions
     decide_every_s = 0.0            # controller cadence (0 = ttl/3)
     leader_ttl_s = 3.0              # fsm:autoscale:leader lease TTL
     drain_timeout_s = 60.0          # drain wait before exiting anyway
+
+    [planner]
+    mode = "auto"                   # engine planner (service/planner.py)
+                                    # for algorithm=AUTO requests:
+                                    # "auto" = density-crossover routing,
+                                    # "pinned" = always route AUTO to the
+                                    # engine below
+    pinned = "SPADE_TPU"            # the engine AUTO resolves to under
+                                    # pinned mode
+    density_crossover = 0.02        # route patterns-AUTO to SPAM_TPU at
+                                    # dataset density >= this (distinct
+                                    # (item,seq) pairs / (alphabet*seqs);
+                                    # calibrated — docs/DESIGN.md)
+    max_alphabet = 512              # SPAM eligibility ceiling on the
+                                    # frequent-alphabet width
 
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
@@ -470,12 +492,38 @@ class AutoscaleConfig:
     max_replicas: int = 8
     up_queue_per_worker: float = 2.0
     up_p99_s: float = 0.0
+    # predictive scale-up (ROADMAP item 4 remainder): the leader tracks
+    # the fleet's lifetime admission count (heartbeat-piggybacked),
+    # EWMA-smooths its rate and the rate's derivative, and treats a
+    # sustained positive derivative >= this (jobs/s per second) as an
+    # up signal BEFORE the queue has built — guarded by the same hold_s
+    # hysteresis as the reactive signals (0 = off, the default)
+    up_rate_derivative: float = 0.0
+    rate_alpha: float = 0.3
     down_free_frac: float = 0.5
     hold_s: float = 10.0
     cooldown_s: float = 30.0
     decide_every_s: float = 0.0
     leader_ttl_s: float = 3.0
     drain_timeout_s: float = 60.0
+
+
+@dataclasses.dataclass
+class PlannerConfig:
+    """Dataset-shape-aware engine planner (service/planner.py) for
+    ``algorithm=AUTO`` requests.  ``mode = "auto"`` (default) routes by
+    the calibrated density crossover — patterns requests go to the SPAM
+    fixed-shape wave engine when the dataset is dense enough
+    (``density_crossover``) and the frequent alphabet narrow enough
+    (``max_alphabet``), to the SPADE candidate-list engines otherwise;
+    rules requests always route to TSR.  ``mode = "pinned"`` routes
+    every AUTO to ``pinned`` unconditionally (soak/exclusion lever).
+    Explicit ``algorithm=`` names bypass the planner entirely."""
+
+    mode: str = "auto"
+    pinned: str = "SPADE_TPU"
+    density_crossover: float = 0.02
+    max_alphabet: int = 512
 
 
 @dataclasses.dataclass
@@ -542,6 +590,8 @@ class Config:
         default_factory=AutoscaleConfig)
     storeguard: StoreGuardConfig = dataclasses.field(
         default_factory=StoreGuardConfig)
+    planner: PlannerConfig = dataclasses.field(
+        default_factory=PlannerConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -592,6 +642,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "fairness": (FairnessConfig, top.pop("fairness", {})),
         "autoscale": (AutoscaleConfig, top.pop("autoscale", {})),
         "storeguard": (StoreGuardConfig, top.pop("storeguard", {})),
+        "planner": (PlannerConfig, top.pop("planner", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -696,6 +747,11 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("autoscale.up_p99_s must be >= 0 (0 = ignore)")
     if not 0 < cfg.autoscale.down_free_frac <= 1:
         raise ConfigError("autoscale.down_free_frac must be in (0, 1]")
+    if cfg.autoscale.up_rate_derivative < 0:
+        raise ConfigError(
+            "autoscale.up_rate_derivative must be >= 0 (0 = off)")
+    if not 0 < cfg.autoscale.rate_alpha <= 1:
+        raise ConfigError("autoscale.rate_alpha must be in (0, 1]")
     if cfg.autoscale.hold_s < 0 or cfg.autoscale.cooldown_s < 0:
         raise ConfigError(
             "autoscale.hold_s / cooldown_s must be >= 0")
@@ -718,6 +774,24 @@ def parse_config(obj: Dict[str, Any]) -> Config:
     if cfg.storeguard.stall_max_s < 0:
         raise ConfigError(
             "storeguard.stall_max_s must be >= 0 (0 = unbounded)")
+    if cfg.planner.mode not in ("auto", "pinned"):
+        raise ConfigError(
+            f"planner.mode must be 'auto' or 'pinned', "
+            f"got {cfg.planner.mode!r}")
+    # ONE vocabulary: the planner's concrete-engine tuple (lazy import —
+    # planner imports this module at top level, so the edge must stay
+    # function-local here); a future engine added there is pinnable
+    # with no second list to update
+    from spark_fsm_tpu.service.planner import CONCRETE_ENGINES
+
+    if cfg.planner.pinned not in CONCRETE_ENGINES:
+        raise ConfigError(
+            f"planner.pinned must be a concrete engine "
+            f"{list(CONCRETE_ENGINES)}, got {cfg.planner.pinned!r}")
+    if not 0 <= cfg.planner.density_crossover <= 1:
+        raise ConfigError("planner.density_crossover must be in [0, 1]")
+    if cfg.planner.max_alphabet < 1:
+        raise ConfigError("planner.max_alphabet must be >= 1")
     return cfg
 
 

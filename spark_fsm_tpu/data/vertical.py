@@ -179,6 +179,79 @@ def build_vertical(
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class DatasetStats:
+    """Shape/density summary of a SequenceDB — the engine planner's
+    input (service/planner.py).  Computed with the same one-pass token
+    flatten the vertical build uses, so "density" here means exactly
+    what it means to the engines: how full the vertical bitmaps are.
+
+    ``alphabet``/``density`` are computed over the FREQUENT-ITEM
+    PROJECTION at ``min_item_support`` (1 = the raw alphabet) because
+    that is the item axis the engines actually build: ``alphabet`` is
+    the surviving item count and ``density`` is distinct (item,
+    sequence) occurrence pairs over ``alphabet * n_sequences`` — the
+    expected fraction of sequences a surviving item occurs in, i.e.
+    the expected fill of the vertical bitmaps and the expected
+    fraction of the item axis alive per candidate node.  High density
+    means per-node candidate lists approach the full (projected)
+    alphabet, which is the regime where SPAM's fixed-shape all-items
+    wave beats ragged candidate-list packing.
+    """
+
+    n_sequences: int
+    n_itemsets: int
+    n_tokens: int
+    alphabet: int
+    max_len: int
+    avg_len: float
+    n_words: int
+    density: float
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def dataset_stats(db: SequenceDB,
+                  min_item_support: int = 1) -> DatasetStats:
+    """One cheap vectorized pass (data/fasttok) over the horizontal DB;
+    no bitmap is materialized.  ``min_item_support`` applies the same
+    frequent-item projection ``build_vertical`` will — the planner
+    passes the request's absolute minsup so the density it routes on
+    is the density the engine will actually mine."""
+    n_seq = len(db)
+    if n_seq == 0:
+        return DatasetStats(0, 0, 0, 0, 0, 0.0, 1, 0.0)
+    from spark_fsm_tpu.data import fasttok
+
+    ft = fasttok.flatten(db)
+    if ft is None:
+        ft = fasttok.flatten_numpy(db)
+    seq_lengths, counts, raw_items = ft
+    n_itemsets = int(len(counts))
+    n_tokens = int(len(raw_items))
+    max_len = int(seq_lengths.max())
+    n_words = max(1, -(-max_len // WORD_BITS))
+    alphabet = 0
+    density = 0.0
+    if n_tokens:
+        seq_of_itemset = np.repeat(np.arange(n_seq, dtype=np.int64),
+                                   seq_lengths)
+        tok_seq = np.repeat(seq_of_itemset, counts)
+        uniq_pair = np.unique(raw_items.astype(np.int64) * n_seq
+                              + tok_seq)
+        _, sup_all = np.unique(uniq_pair // n_seq, return_counts=True)
+        kept = sup_all >= max(1, int(min_item_support))
+        alphabet = int(kept.sum())
+        if alphabet:
+            density = int(sup_all[kept].sum()) / float(alphabet * n_seq)
+    return DatasetStats(
+        n_sequences=n_seq, n_itemsets=n_itemsets, n_tokens=n_tokens,
+        alphabet=alphabet, max_len=max_len,
+        avg_len=round(n_itemsets / n_seq, 4), n_words=n_words,
+        density=round(density, 6))
+
+
 def abs_minsup(rel_minsup: float, n_sequences: int) -> int:
     """Relative minsup (e.g. 0.001 = 0.1%) -> absolute sequence count.
 

@@ -1,0 +1,620 @@
+"""SPAM vertical-bitmap miner — fixed-shape wave engine (ISSUE 15).
+
+The second mining engine next to the SPADE family: same pattern
+universe, same enumeration (the oracle's S/I equivalence classes), same
+frontier-node shape and checkpoint format — a DIFFERENT evaluation
+strategy.  Where the classic engine builds ragged per-node candidate
+lists on the host and packs them into chunked launches, SPAM evaluates
+every popped node against the WHOLE item axis in one fixed-shape
+device pass (ops/spam_bitops.py): gather + s-extension shift-mask once
+per node, AND against all item bitmaps, support = popcount of packed
+per-sequence alive bits.  The host then reads only the lanes its
+candidate lists name and prunes at the threshold.
+
+Why both engines exist (the planner's crossover, service/planner.py):
+on DENSE data — small alphabet, most items frequent in most sequences
+— the per-node candidate lists approach the full alphabet anyway, so
+the fixed-shape pass does the same work with no ragged packing, fewer
+distinct compiled shapes, and launch counts independent of candidate
+raggedness.  On SPARSE data the full item axis is mostly dead lanes
+and the classic engine's candidate-list packing wins.  The "Data
+Structure Perspective" thread (PAPERS.md) places the representation,
+not the partitioning, as the dominant cost — this engine IS that
+representation choice made routable per dataset.
+
+Composition invariants (pinned by tests/test_spam.py):
+
+- **Enumeration parity**: byte-identical output to the CPU oracle
+  (``models/oracle.mine_spade``) and therefore to every SPADE engine.
+- **Shared frontier format**: nodes are ``models/_common.FrontierNode``
+  and ``frontier_fingerprint()`` matches ``SpadeTPU``'s exactly, so a
+  checkpoint written mid-mine by either engine RESUMES under the other
+  (the service may re-route an orphan through a different engine after
+  a crash without losing progress).
+- **Partition classes unchanged**: a pattern's class is its first item
+  (the DFS root), precisely the classes parallel/partition.py already
+  balances — the partitioned route seeds only owned roots and the
+  slice union is exact, same as SPADE.
+- **Threshold loop**: the wave loop prunes against ``self.threshold``,
+  a monotone non-decreasing bound seeded at minsup — the same
+  rising-threshold contract TSR's top-k loop drives, so the resident-
+  frontier/launch-fusion eligibility reasoning carries over (waves ride
+  ``fusion.dispatch_wave`` for the broker's accounting/fault surface;
+  in minsup mode the threshold simply never rises).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from spark_fsm_tpu.data.spmf import SequenceDB
+from spark_fsm_tpu.data.vertical import VerticalDB, build_vertical
+from spark_fsm_tpu.models._common import (
+    FrontierNode, SlotPool, auto_pool_bytes, decode_frontier, device_axes,
+    encode_frontier, load_checkpoint, next_pow2, scatter_build_store)
+from spark_fsm_tpu.ops import bitops_np as BN
+from spark_fsm_tpu.ops import spam_bitops as SB
+from spark_fsm_tpu.parallel import multihost as MH
+from spark_fsm_tpu.utils import jobctl, shapes
+from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
+
+Step = Tuple[int, bool]
+_Node = FrontierNode
+
+
+def spam_geometry(n_sequences: int, n_items: int, n_words: int, *,
+                  mesh: Optional[Mesh] = None, node_batch: int = 64,
+                  pipeline_depth: int = 2,
+                  pool_bytes: Optional[int] = None,
+                  shape_buckets: bool = False,
+                  tile: int = SB.ITEM_TILE) -> dict:
+    """Derived device geometry — the one sizing routine shared by the
+    constructor and the shape-key record, same contract as
+    ``classic_geometry``.  The extra constraint vs the classic engine:
+    each in-flight wave holds a ``[2*nb, tile, S, W]`` AND intermediate,
+    so the node batch is bounded by the pool budget divided by that
+    live tile footprint, not only by slot arithmetic."""
+    n_shards = 1 if mesh is None else mesh.devices.size
+    n_seq, s_block, _ = device_axes(
+        n_sequences, n_items, n_words, mesh=mesh, use_pallas=False,
+        shape_buckets=shape_buckets)
+    if pool_bytes is None:
+        pool_bytes = auto_pool_bytes(mesh)
+    ni_pad = SB.pad_items(n_items, tile)
+    slot_bytes = n_seq * n_words * 4
+    spd = -(-slot_bytes // n_shards)  # per-device bytes of one store row
+    budget_slots = max(64, min(int(pool_bytes) // max(slot_bytes, 1), 32768))
+    d = max(1, min(int(pipeline_depth), max(1, budget_slots // 8)))
+    # a quarter of the pool budget may live in wave intermediates,
+    # split across the in-flight depth
+    nb_wave = max(1, (int(pool_bytes) // 4) // max(1, 2 * tile * spd * d))
+    nb = max(1, min(int(node_batch), nb_wave, budget_slots // (3 * (d + 2))))
+    pool_slots = max(8, budget_slots - 2 * d * nb)
+    total = ni_pad + pool_slots + 1
+    if shape_buckets:
+        floor_rows = ni_pad + 8 + 1
+        total = next_pow2(total)
+        budget_rows = ni_pad + 1 + budget_slots
+        if total > budget_rows and total // 2 >= floor_rows:
+            total //= 2
+        pool_slots = total - ni_pad - 1
+        nb = max(1, min(nb, pool_slots // (3 * (d + 2))))
+    return {
+        "n_seq": n_seq, "s_block": s_block, "ni_pad": ni_pad, "tile": tile,
+        "node_batch": nb, "pipeline_depth": d, "pool_slots": pool_slots,
+        "total_rows": total, "scratch": ni_pad + pool_slots,
+        "shape_key": shapes.key_spam(n_seq, n_words, total, nb, ni_pad),
+    }
+
+
+class SpamBitmapTPU:
+    """Single- or multi-chip SPAM miner over the shared bitmap store.
+
+    Args mirror :class:`models.spade_tpu.SpadeTPU` where shared;
+    ``node_batch`` is deliberately smaller (default 64) because every
+    node pays the full item axis.  The prep/materialize/recompute
+    kernels are REUSED from the classic engine's jit cache
+    (``spade_tpu._spade_fns``) — the two engines differ only in the
+    support pass, so they must not compile two copies of everything
+    else.
+    """
+
+    def __init__(
+        self,
+        vdb: VerticalDB,
+        minsup_abs: int,
+        *,
+        mesh: Optional[Mesh] = None,
+        node_batch: int = 64,
+        pipeline_depth: int = 2,
+        pool_bytes: Optional[int] = None,
+        max_pattern_itemsets: Optional[int] = None,
+        shape_buckets: bool = False,
+        partition=None,
+    ):
+        from spark_fsm_tpu.models.spade_tpu import _spade_fns
+
+        self.vdb = vdb
+        self.minsup = int(minsup_abs)
+        # the rising-threshold hook (see module docstring): prunes
+        # compare against this, monotone non-decreasing, == minsup in
+        # minsup mode
+        self.threshold = int(minsup_abs)
+        self.mesh = mesh
+        self._partition = partition
+        self._multiproc = MH.is_multihost(mesh)
+        self._put = functools.partial(MH.host_to_device, mesh)
+        self.max_pattern_itemsets = max_pattern_itemsets
+        self._shape_buckets = bool(shape_buckets)
+
+        n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
+        g = spam_geometry(
+            n_seq, n_items, n_words, mesh=mesh, node_batch=node_batch,
+            pipeline_depth=pipeline_depth, pool_bytes=pool_bytes,
+            shape_buckets=self._shape_buckets)
+        n_seq = g["n_seq"]
+        self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
+        self.ni_pad = g["ni_pad"]
+        self.node_batch = g["node_batch"]
+        self.pipeline_depth = g["pipeline_depth"]
+        self.pool_slots = g["pool_slots"]
+        self.scratch = g["scratch"]
+        total = g["total_rows"]
+
+        # pool slots start at ni_pad, NOT n_items: rows n_items..ni_pad-1
+        # are all-zero item pad rows the wave pass ANDs against — a pad
+        # lane's support is exactly 0, never a live pattern bitmap's
+        self.store = scatter_build_store(vdb, total, n_seq, n_words,
+                                         mesh=mesh, put=self._put,
+                                         bucket_tokens=self._shape_buckets,
+                                         flat=True)
+        self._pool = SlotPool(range(self.ni_pad,
+                                    self.ni_pad + self.pool_slots))
+
+        fns = _spade_fns(mesh, n_words)
+        self._prep_fn = fns["prep"]
+        self._materialize_fn = fns["materialize"]
+        self._recompute_fn = fns["recompute"]
+        self._wave_fn = SB.wave_supports_fn(mesh, n_words, self.ni_pad,
+                                            g["tile"])
+        # materialize width: fixed-shape chunks like the classic engine
+        self.chunk = min(2048, max(64, next_pow2(2 * self.node_batch)))
+
+        self.stats = {
+            "engine": "spam",
+            "candidates": 0, "evaluated_lanes": 0, "waves": 0,
+            "kernel_launches": 0, "recomputed_nodes": 0,
+            "reclaimed_slots": 0, "patterns": 0,
+            "shape_key": g["shape_key"],
+        }
+        shapes.record(g["shape_key"])
+
+    # ------------------------------------------------------------ slot mgmt
+
+    def _alloc(self) -> Optional[int]:
+        return self._pool.alloc()
+
+    def _free_slot(self, slot: Optional[int]) -> None:
+        if slot is not None and slot >= self.ni_pad:
+            self._pool.free(slot)
+
+    # ------------------------------------------------------------- kernels
+
+    def _prep(self, batch: List[_Node]):
+        slots = np.zeros(self.node_batch, np.int32)
+        for i, n in enumerate(batch):
+            slots[i] = n.slot
+        pt = self._prep_fn(self.store, self._put(slots))
+        self.stats["kernel_launches"] += 1
+        return pt
+
+    def _materialize(self, prep, ref, item, iss, out_slot) -> None:
+        n = len(ref)
+        c = self.chunk
+        for lo in range(0, n, c):
+            hi = min(lo + c, n)
+            pad = c - (hi - lo)
+            r = self._put(np.pad(ref[lo:hi].astype(np.int32), (0, pad)))
+            it = self._put(np.pad(item[lo:hi].astype(np.int32), (0, pad)))
+            ss = self._put(np.pad(iss[lo:hi].astype(bool), (0, pad)))
+            os_ = self._put(np.pad(out_slot[lo:hi].astype(np.int32),
+                                   (0, pad), constant_values=self.scratch))
+            self.store = self._materialize_fn(prep, self.store, r, it, ss,
+                                              os_)
+            self.stats["kernel_launches"] += 1
+
+    def _ensure_slots(self, batch: List[_Node], stack: List[_Node]) -> None:
+        missing = [n for n in batch if n.slot is None]
+        if not missing:
+            return
+        self.stats["recomputed_nodes"] += len(missing)
+        if len(self._pool) < len(missing):
+            self._pool.reclaim(stack, len(missing),
+                               lambda n: n.slot >= self.ni_pad)
+            self.stats["reclaimed_slots"] = self._pool.reclaimed
+        rc = max(16, self.node_batch)
+        for lo in range(0, len(missing), rc):
+            group = missing[lo: lo + rc]
+            m = rc
+            k = next_pow2(max(len(n.steps) for n in group))
+            items = np.zeros((k, m), np.int32)
+            iss = np.zeros((k, m), bool)
+            valid = np.zeros((k, m), bool)
+            slots = np.full(m, self.scratch, np.int32)
+            for col, node in enumerate(group):
+                slot = self._alloc()
+                assert slot is not None, "slot pool exhausted beyond reclaim"
+                node.slot = slot
+                slots[col] = slot
+                for row, (it, s) in enumerate(node.steps):
+                    items[row, col], iss[row, col] = it, s
+                    valid[row, col] = True
+            self.store = self._recompute_fn(
+                self.store, self._put(items), self._put(iss),
+                self._put(valid), self._put(slots))
+            self.stats["kernel_launches"] += 1
+
+    # ---------------------------------------------------------------- mine
+
+    def _pattern_of(self, steps: Sequence[Step]) -> Pattern:
+        ids = self.vdb.item_ids
+        pat: List[List[int]] = []
+        for it, is_s in steps:
+            if is_s:
+                pat.append([int(ids[it])])
+            else:
+                pat[-1].append(int(ids[it]))
+        return tuple(tuple(s) for s in pat)
+
+    def _dispatch(self, stack: List[_Node]):
+        """Pop a node batch and launch ONE fixed-shape wave pass for the
+        whole (nodes x items x {s,i}) grid; the async host copy starts
+        immediately.  Routed through the fusion broker's wave surface
+        for its accounting/fault posture (an armed ``fusion.dispatch``
+        fault degrades to a direct dispatch, never loses the wave)."""
+        from spark_fsm_tpu.service import fusion
+
+        jobctl.check()  # launch-boundary safe point (cancel/deadline/fence)
+        batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
+        self._ensure_slots(batch, stack)
+        prep = self._prep(batch)
+        sup_dev = fusion.dispatch_wave(
+            "spam", lambda: self._wave_fn(prep, self.store),
+            nodes=len(batch), items=self.ni_pad)
+        self.stats["kernel_launches"] += 1
+        self.stats["waves"] += 1
+        self.stats["evaluated_lanes"] += 2 * self.node_batch * self.ni_pad
+        self.stats["candidates"] += sum(
+            (len(n.s_list) if self._allow_s(n) else 0) + len(n.i_list)
+            for n in batch)
+        try:
+            sup_dev.copy_to_host_async()
+        except (AttributeError, NotImplementedError):
+            pass
+        return batch, prep, sup_dev
+
+    def _allow_s(self, node: _Node) -> bool:
+        if self.max_pattern_itemsets is None:
+            return True
+        return sum(1 for _, s in node.steps
+                   if s) < self.max_pattern_itemsets
+
+    def _resolve(self, inflight, stack: List[_Node],
+                 results: List[PatternResult]) -> None:
+        batch, prep, sup_dev = inflight
+        sups = np.asarray(sup_dev)  # [2*nb, ni_pad]
+        thr = self.threshold
+        children: List[_Node] = []
+        mat_ref: List[int] = []; mat_item: List[int] = []
+        mat_iss: List[bool] = []; mat_child: List[int] = []
+        for b, node in enumerate(batch):
+            allow_s = self._allow_s(node)
+            n_itemsets = sum(1 for _, s in node.steps if s)
+            # host-side lane read: only the lanes the candidate lists
+            # name — pad lanes and non-candidate items are never read
+            s_items = ([i for i in node.s_list if sups[2 * b + 1, i] >= thr]
+                       if allow_s else [])
+            i_items = [i for i in node.i_list if sups[2 * b, i] >= thr]
+            for it, is_s in ([(i, True) for i in s_items]
+                             + [(i, False) for i in i_items]):
+                sup = int(sups[2 * b + 1, it] if is_s else sups[2 * b, it])
+                steps = node.steps + ((it, is_s),)
+                results.append((self._pattern_of(steps), sup))
+                src = s_items if is_s else i_items
+                child_i = [j for j in src if j > it]
+                child_itemsets = n_itemsets + (1 if is_s else 0)
+                child_allow_s = (self.max_pattern_itemsets is None
+                                 or child_itemsets
+                                 < self.max_pattern_itemsets)
+                if not ((s_items and child_allow_s) or child_i):
+                    continue
+                child = _Node(steps, None, s_items, child_i)
+                slot = self._alloc()
+                if slot is not None:
+                    child.slot = slot
+                    mat_ref.append(b); mat_item.append(it)
+                    mat_iss.append(is_s); mat_child.append(slot)
+                children.append(child)
+        if mat_child:
+            self._materialize(prep, np.array(mat_ref, np.int32),
+                              np.array(mat_item, np.int32),
+                              np.array(mat_iss, bool),
+                              np.array(mat_child, np.int32))
+        stack.extend(reversed(children))
+        for node in batch:
+            self._free_slot(node.slot)
+
+    def frontier_fingerprint(self) -> dict:
+        """Identical field-for-field to ``SpadeTPU.frontier_fingerprint``
+        — deliberately: the two engines' checkpoints must resume each
+        other (same projection, same enumeration, same node shape)."""
+        ids = self.vdb.item_ids
+        return {
+            "minsup": self.minsup,
+            "n_items": self.n_items,
+            "n_sequences": self.vdb.n_sequences,
+            "max_itemsets": self.max_pattern_itemsets,
+            "item_ids_head": [int(i) for i in ids[:8]],
+            "item_ids_sum": int(ids.astype(np.int64).sum()),
+        }
+
+    def frontier_state(self, stack: List[_Node],
+                       results: List[PatternResult],
+                       results_from: int = 0) -> dict:
+        return encode_frontier(self.frontier_fingerprint(), stack, results,
+                               results_from)
+
+    def mine(self, *, resume: Optional[dict] = None,
+             checkpoint_cb=None,
+             checkpoint_every_s: float = 30.0) -> List[PatternResult]:
+        stack: List[_Node] = []
+        results: List[PatternResult]
+        if resume is not None:
+            results, stack = decode_frontier(
+                resume, self.frontier_fingerprint(), _Node)
+            self.stats["resumed_nodes"] = len(stack)
+        else:
+            results = []
+            root_items = [i for i in range(self.n_items)
+                          if int(self.vdb.item_supports[i]) >= self.minsup]
+            seed = set(root_items)
+            if self._partition is not None:
+                plan, pidx = self._partition
+                seed = set(plan.owned_slice(root_items,
+                                            self.vdb.item_ids, pidx))
+            for i in reversed(root_items):
+                if i not in seed:
+                    continue
+                results.append((self._pattern_of(((i, True),)),
+                                int(self.vdb.item_supports[i])))
+                stack.append(_Node(((i, True),), i, root_items,
+                                   [j for j in root_items if j > i]))
+
+        ckpt_done = len(results) if resume is not None else 0
+        last_ckpt = time.monotonic()
+        inflight: deque = deque()
+        while stack or inflight:
+            while stack and len(inflight) < self.pipeline_depth:
+                inflight.append(self._dispatch(stack))
+            self._resolve(inflight.popleft(), stack, results)
+            if (checkpoint_cb is not None
+                    and time.monotonic() - last_ckpt >= checkpoint_every_s):
+                while inflight:
+                    self._resolve(inflight.popleft(), stack, results)
+                checkpoint_cb(self.frontier_state(stack, results,
+                                                  results_from=ckpt_done))
+                ckpt_done = len(results)
+                self.stats["checkpoints"] = \
+                    self.stats.get("checkpoints", 0) + 1
+                last_ckpt = time.monotonic()
+
+        self.stats["patterns"] = len(results)
+        return sort_patterns(results)
+
+
+# ---------------------------------------------------------------------------
+# CPU reference (the SPAM plugin's engine; numpy popcount formulation)
+# ---------------------------------------------------------------------------
+
+
+def mine_spam_cpu(db: SequenceDB, minsup_abs: int, *,
+                  max_pattern_itemsets: Optional[int] = None,
+                  stats_out: Optional[dict] = None) -> List[PatternResult]:
+    """Host SPAM miner on the dense bitmaps with the same popcount
+    support formulation (``bitops_np.support_popcount``) — the CPU leg
+    of the SPAM plugin pair, byte-identical to ``oracle.mine_spade`` by
+    the shared enumeration."""
+    vdb = build_vertical(db, min_item_support=minsup_abs)
+    if vdb.n_items == 0:
+        return []
+    bm = vdb.bitmaps  # [n_items, S, W]
+    n_items = vdb.n_items
+    results: List[PatternResult] = []
+    ids = vdb.item_ids
+
+    def pattern_of(steps) -> Pattern:
+        pat: List[List[int]] = []
+        for it, is_s in steps:
+            if is_s:
+                pat.append([int(ids[it])])
+            else:
+                pat[-1].append(int(ids[it]))
+        return tuple(tuple(s) for s in pat)
+
+    root_items = [i for i in range(n_items)
+                  if int(vdb.item_supports[i]) >= minsup_abs]
+    stack: List[tuple] = []  # (steps, bitmap, s_list, i_list)
+    for i in reversed(root_items):
+        results.append((pattern_of(((i, True),)),
+                        int(vdb.item_supports[i])))
+        stack.append(((( i, True),), bm[i], root_items,
+                      [j for j in root_items if j > i]))
+    waves = candidates = 0
+    while stack:
+        steps, b, s_list, i_list = stack.pop()
+        n_itemsets = sum(1 for _, s in steps if s)
+        allow_s = (max_pattern_itemsets is None
+                   or n_itemsets < max_pattern_itemsets)
+        trans = BN.sext_transform(b)
+        waves += 1
+        s_items: List[int] = []
+        s_sups = {}
+        if allow_s and s_list:
+            joined = trans[None] & bm[s_list]           # [n, S, W]
+            sups = BN.support_popcount(joined)
+            candidates += len(s_list)
+            for i, sup in zip(s_list, sups):
+                if sup >= minsup_abs:
+                    s_items.append(i)
+                    s_sups[i] = int(sup)
+        i_items: List[int] = []
+        i_sups = {}
+        if i_list:
+            joined = b[None] & bm[i_list]
+            sups = BN.support_popcount(joined)
+            candidates += len(i_list)
+            for i, sup in zip(i_list, sups):
+                if sup >= minsup_abs:
+                    i_items.append(i)
+                    i_sups[i] = int(sup)
+        children = []
+        for it, is_s in ([(i, True) for i in s_items]
+                         + [(i, False) for i in i_items]):
+            sup = s_sups[it] if is_s else i_sups[it]
+            child_steps = steps + ((it, is_s),)
+            results.append((pattern_of(child_steps), sup))
+            src = s_items if is_s else i_items
+            child_i = [j for j in src if j > it]
+            child_itemsets = n_itemsets + (1 if is_s else 0)
+            child_allow_s = (max_pattern_itemsets is None
+                             or child_itemsets < max_pattern_itemsets)
+            if not ((s_items and child_allow_s) or child_i):
+                continue
+            cb = (BN.s_extend(b, bm[it]) if is_s
+                  else BN.i_extend(b, bm[it]))
+            children.append((child_steps, cb, s_items, child_i))
+        stack.extend(reversed(children))
+    if stats_out is not None:
+        stats_out.update({"engine": "spam-cpu", "waves": waves,
+                          "candidates": candidates,
+                          "patterns": len(results)})
+    return sort_patterns(results)
+
+
+# ---------------------------------------------------------------------------
+# Service entry points
+# ---------------------------------------------------------------------------
+
+
+def mine_spam_tpu(
+    db: SequenceDB,
+    minsup_abs: int,
+    *,
+    mesh: Optional[Mesh] = None,
+    max_pattern_itemsets: Optional[int] = None,
+    stats_out: Optional[dict] = None,
+    checkpoint=None,
+    partition_parts: int = 0,
+    partition_classes: int = 64,
+    **kwargs,
+) -> List[PatternResult]:
+    """DB -> vertical build -> SPAM wave mine; same wrapper contract as
+    ``mine_spade_tpu`` (checkpoint load/save/every_s, optional
+    equivalence-class partitioning)."""
+    vdb = build_vertical(db, min_item_support=minsup_abs)
+    if vdb.n_items == 0:
+        return []
+    if partition_parts and int(partition_parts) > 1:
+        return _mine_spam_partitioned(
+            vdb, minsup_abs, mesh=mesh, parts=int(partition_parts),
+            classes=int(partition_classes),
+            max_pattern_itemsets=max_pattern_itemsets,
+            stats_out=stats_out, checkpoint=checkpoint, **kwargs)
+    eng = SpamBitmapTPU(vdb, minsup_abs, mesh=mesh,
+                        max_pattern_itemsets=max_pattern_itemsets, **kwargs)
+    resume, save_cb, every_s = load_checkpoint(
+        checkpoint, eng.frontier_fingerprint())
+    results = eng.mine(resume=resume, checkpoint_cb=save_cb,
+                       checkpoint_every_s=every_s)
+    if stats_out is not None:
+        stats_out.update(eng.stats)
+    return results
+
+
+def _mine_spam_partitioned(
+    vdb: VerticalDB,
+    minsup_abs: int,
+    *,
+    mesh: Optional[Mesh],
+    parts: int,
+    classes: int,
+    max_pattern_itemsets: Optional[int],
+    stats_out: Optional[dict],
+    checkpoint,
+    **kwargs,
+) -> List[PatternResult]:
+    """Equivalence-class partitioned SPAM: identical structure to the
+    partitioned SPADE route — a pattern's class is its first item, so
+    fixed-minsup slices are fully independent and the union is exact;
+    composite checkpoints nest each slice's frontier in the shared
+    ``frontier_state`` format (parallel/partition.py)."""
+    from spark_fsm_tpu.models.spade_tpu import _SliceCheckpoint
+    from spark_fsm_tpu.parallel import partition as PN
+
+    plan = PN.plan_partitions(vdb.item_ids, vdb.item_supports, parts,
+                              classes)
+    meshes = PN.submeshes(mesh, parts)
+    ids = vdb.item_ids
+    fingerprint = {
+        "minsup": int(minsup_abs),
+        "n_items": int(vdb.n_items),
+        "n_sequences": int(vdb.n_sequences),
+        "max_itemsets": max_pattern_itemsets,
+        "item_ids_head": [int(i) for i in ids[:8]],
+        "item_ids_sum": int(ids.astype(np.int64).sum()),
+        # NO engine marker — field-identical to the partitioned SPADE
+        # fingerprint on purpose: the composite nests slice frontiers in
+        # the shared format, so either engine resumes the other's
+        # partitioned checkpoint too
+        "partition": plan.fingerprint(),
+    }
+    resume, save_cb, every_s = load_checkpoint(checkpoint, fingerprint)
+    stats: dict = {
+        "engine": "spam",
+        "partition_parts": int(parts),
+        "partition_classes": int(classes),
+        "partition_imbalance": round(plan.imbalance_ratio, 4),
+    }
+    PN.count_mine("spam")
+
+    def mine_part(p, inner_mesh, resume_state, part_cb):
+        part_stats: dict = {}
+        ckpt = None
+        if resume_state is not None or part_cb is not None:
+            ckpt = _SliceCheckpoint(resume_state, part_cb, every_s)
+        eng = SpamBitmapTPU(vdb, minsup_abs, mesh=inner_mesh,
+                            max_pattern_itemsets=max_pattern_itemsets,
+                            partition=(plan, p), **kwargs)
+        p_resume, p_save, p_every = load_checkpoint(
+            ckpt, eng.frontier_fingerprint())
+        res = eng.mine(resume=p_resume, checkpoint_cb=p_save,
+                       checkpoint_every_s=p_every)
+        part_stats.update(eng.stats)
+        PN.fold_numeric_stats(stats, part_stats)
+        return PN.encode_patterns(res)
+
+    rows = PN.mine_partitioned_slices(
+        plan=plan, meshes=meshes, fingerprint=fingerprint,
+        mine_part=mine_part, resume=resume, checkpoint_cb=save_cb,
+        stats=stats)
+    results = sort_patterns(PN.decode_patterns(rows))
+    stats["patterns"] = len(results)
+    if stats_out is not None:
+        stats_out.update(stats)
+    return results

@@ -101,6 +101,63 @@ def join(prefix_bitmap: jax.Array, item_bitmap: jax.Array, is_s) -> jax.Array:
     return jnp.where(sel, sext_transform(prefix_bitmap), prefix_bitmap) & item_bitmap
 
 
+def popcount(w: jax.Array) -> jax.Array:
+    """Per-word population count (SWAR), uint32 -> int32 same shape."""
+    w = w.astype(jnp.uint32)
+    w = w - ((w >> jnp.uint32(1)) & jnp.uint32(0x55555555))
+    w = (w & jnp.uint32(0x33333333)) + ((w >> jnp.uint32(2))
+                                        & jnp.uint32(0x33333333))
+    w = (w + (w >> jnp.uint32(4))) & jnp.uint32(0x0F0F0F0F)
+    return ((w * jnp.uint32(0x01010101)) >> jnp.uint32(24)).astype(jnp.int32)
+
+
+def tail_mask(n_valid: int, n_words: int) -> jax.Array:
+    """[n_words] uint32 mask of the valid bits (static shapes; mirrors
+    bitops_np.tail_mask — see there for why popcount reductions must
+    apply it: ``sext_transform`` saturates tail-word padding bits)."""
+    pos = jnp.arange(n_words * 32, dtype=jnp.int32).reshape(n_words, 32)
+    bits = (pos < n_valid).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (bits * weights).sum(axis=-1).astype(jnp.uint32)
+
+
+def masked_popcount(b: jax.Array, n_valid: int) -> jax.Array:
+    """[..., n_words] -> [...] int32 set bits at VALID positions only.
+
+    The mask is load-bearing for any bitmap downstream of the SPAM
+    s-extension shift: ``sext_transform`` fills every bit above the
+    first occurrence, including padding positions past the true
+    capacity in the tail word, so the unmasked popcount overcounts
+    whenever the bit axis is not a multiple of the word width."""
+    return jnp.sum(popcount(b & tail_mask(n_valid, b.shape[-1])),
+                   axis=-1, dtype=jnp.int32)
+
+
+def pack_seq_bits(active: jax.Array) -> jax.Array:
+    """Pack boolean [..., n_seq] into LSB-first uint32 words
+    [..., ceil(n_seq/32)] with an explicit all-zero tail pad — the
+    fixed-shape SPAM support formulation (support = popcount of the
+    packed per-sequence alive bits).  Zero-padding is the tail-word
+    fix when the sequence count is not a multiple of the word width."""
+    n_seq = active.shape[-1]
+    n_w = max(1, -(-n_seq // 32))
+    pad = n_w * 32 - n_seq
+    if pad:
+        active = jnp.concatenate(
+            [active, jnp.zeros(active.shape[:-1] + (pad,), bool)], axis=-1)
+    bits = active.reshape(active.shape[:-1] + (n_w, 32)).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (bits * weights).sum(axis=-1).astype(jnp.uint32)
+
+
+def support_popcount(bitmap: jax.Array) -> jax.Array:
+    """[..., n_seq, n_words] -> [...] int32 support via pack+popcount —
+    bit-identical to :func:`support`, pinned against the bitops_np
+    reference; the spelling the SPAM wave kernel fuses."""
+    packed = pack_seq_bits(contains_bits(bitmap))
+    return jnp.sum(popcount(packed), axis=-1, dtype=jnp.int32)
+
+
 def contains_bits(bitmap: jax.Array) -> jax.Array:
     """[..., n_seq, n_words] -> [..., n_seq] bool: any bit set per sequence."""
     return jnp.any(bitmap != 0, axis=-1)

@@ -120,6 +120,76 @@ def shift_up_one(b: np.ndarray) -> np.ndarray:
     return out
 
 
+def popcount(w: np.ndarray) -> np.ndarray:
+    """Per-word population count (SWAR), uint32 -> int32 same shape."""
+    w = np.asarray(w, dtype=U32).copy()
+    w -= (w >> U32(1)) & U32(0x55555555)
+    w = (w & U32(0x33333333)) + ((w >> U32(2)) & U32(0x33333333))
+    w = (w + (w >> U32(4))) & U32(0x0F0F0F0F)
+    return ((w * U32(0x01010101)) >> U32(24)).astype(np.int32)
+
+
+def tail_mask(n_valid: int, n_words: int) -> np.ndarray:
+    """[n_words] uint32 mask keeping only bits 0..n_valid-1 of the
+    flattened bit axis (bit ``p`` lives in word ``p // 32``).  A bitmap
+    axis padded up to a word multiple carries ``n_words*32 - n_valid``
+    padding bits in its tail word; any POPCOUNT-style reduction must
+    AND this mask in first — supports (any-bit tests) survive padding,
+    counts do not."""
+    out = np.zeros(n_words, dtype=U32)
+    full = min(n_valid // 32, n_words)
+    out[:full] = FULL
+    rem = n_valid - full * 32
+    if 0 < rem and full < n_words:
+        out[full] = (U32(1) << U32(rem)) - U32(1)
+    return out
+
+
+def masked_popcount(b: np.ndarray, n_valid: int) -> np.ndarray:
+    """[..., n_words] -> [...] int64: total set bits at VALID positions.
+
+    The tail-word mask is load-bearing, not defensive: SPAM's
+    s-extension shift (``sext_transform``) deliberately saturates every
+    bit above the first occurrence — including the padding bits beyond
+    the true position capacity in the tail word — so a naive popcount
+    over a transformed bitmap overcounts by up to 31 per sequence
+    whenever the position axis is not a multiple of the word width
+    (the bug this helper fixes; pinned in tests/test_bitops_np.py)."""
+    b = np.asarray(b, dtype=U32)
+    return popcount(b & tail_mask(n_valid, b.shape[-1])).sum(
+        axis=-1, dtype=np.int64)
+
+
+def pack_seq_bits(active: np.ndarray) -> np.ndarray:
+    """Pack a boolean per-sequence indicator [..., n_seq] into LSB-first
+    uint32 words [..., ceil(n_seq/32)], zero-padding the tail word —
+    the SPAM support formulation: support = popcount(packed words).
+    The explicit zero pad is the correct tail handling when the
+    SEQUENCE count is not a multiple of the word width (garbage padding
+    lanes would be counted as support)."""
+    active = np.asarray(active, dtype=bool)
+    n_seq = active.shape[-1]
+    n_w = max(1, -(-n_seq // 32))
+    pad = n_w * 32 - n_seq
+    if pad:
+        active = np.concatenate(
+            [active, np.zeros(active.shape[:-1] + (pad,), bool)], axis=-1)
+    bits = active.reshape(active.shape[:-1] + (n_w, 32)).astype(U32)
+    weights = (U32(1) << np.arange(32, dtype=U32))
+    return (bits * weights).sum(axis=-1).astype(U32)
+
+
+def support_popcount(bitmap: np.ndarray) -> np.ndarray:
+    """Sequence-count support via the SPAM popcount formulation:
+    collapse words -> per-sequence alive bit -> pack over the sequence
+    axis -> popcount.  Bit-identical to :func:`support` (the any/count
+    spelling); exists so the vectorized popcount path has a numpy
+    reference the device engine is pinned against."""
+    alive = (np.asarray(bitmap) != 0).any(axis=-1)
+    packed = pack_seq_bits(alive)
+    return popcount(packed).sum(axis=-1).astype(np.int64)
+
+
 def first_set_positions(b: np.ndarray) -> np.ndarray:
     """Per-sequence index of the first set bit, or n_words*32 if none.
 

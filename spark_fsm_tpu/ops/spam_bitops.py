@@ -1,0 +1,94 @@
+"""SPAM wave kernels — fixed-shape s/i-extension support passes.
+
+The SPAM formulation (Ayres et al. 2002; PAPER.md §0 names it as the
+reference's algorithmic family) evaluates a frontier node against the
+WHOLE item axis instead of a per-node ragged candidate list: one wave of
+``Bn`` nodes costs exactly one device pass of shape
+``[2*Bn, n_items_pad]`` regardless of how ragged the live candidate
+sets are.  That trades wasted lanes on sparse data for zero host-side
+ragged packing and a single fixed compile per geometry — the dense-data
+side of the planner's crossover (service/planner.py).
+
+The pass itself is the accelerator-friendly transformation the
+"Accelerator-Oriented Algorithm Transformation" thread (PAPERS.md)
+argues for: gather + s-extension shift-mask (``sext_transform``) once
+per node, AND against every item bitmap, then support counting as a
+popcount reduction over packed per-sequence alive bits
+(``bitops_jax.pack_seq_bits``/``popcount``) — no gathers keyed by
+candidate identity anywhere in the hot loop.
+
+Layout contracts are the classic engine's verbatim (the ragged packer's
+padding conventions): the store crosses jit boundaries FLAT
+``[rows, S*W]`` word-minor, the ``pt`` tensor interleaves plain and
+transformed parent rows (row ``2b`` = node b, row ``2b+1`` = its
+s-extension transform), and padded sequences are all-zero bitmaps that
+can never count.  Item rows ``n_items..ni_pad-1`` are all-zero pad rows
+owned by the item region (never pool slots), so a pad lane's support is
+exactly 0 rather than garbage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, shard_map
+
+# items per inner tile of the wave pass: bounds the broadcast AND's
+# live intermediate to [2*Bn, ITEM_TILE, S, W] while keeping the lane
+# axis wide enough to fill the VPU (the geometry routine sizes the node
+# batch against this).
+ITEM_TILE = 64
+
+
+def pad_items(n_items: int, tile: int = ITEM_TILE) -> int:
+    """Item-axis pad: the wave pass is a static grid of ``tile``-wide
+    item tiles, so the item row count rounds up to a tile multiple."""
+    return max(tile, -(-max(n_items, 1) // tile) * tile)
+
+
+@functools.lru_cache(maxsize=64)
+def wave_supports_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
+                     tile: int = ITEM_TILE):
+    """Cached jitted wave-support pass for one (mesh, geometry).
+
+    ``fn(pt, store) -> sup[2*Bn, ni_pad] int32``: for every interleaved
+    parent row and every item row, the support of ``pt_row AND item``.
+    Callers read s-extension supports at ``sup[2b+1, i]`` (transformed
+    parent) and i-extension supports at ``sup[2b, i]`` (plain parent).
+
+    Cached per wrapped-function object for the same reason as
+    ``spade_tpu._spade_fns``: a per-engine closure would recompile the
+    whole pass on every /train construction.
+    """
+    W = n_words
+    n_tiles = ni_pad // tile
+
+    def body(pt, store):
+        p3 = pt.reshape(pt.shape[0], -1, W)               # [P, S, W]
+        items = store[:ni_pad].reshape(n_tiles, tile, -1, W)
+
+        def tile_sup(tile_items):                         # [tile, S, W]
+            joined = p3[:, None] & tile_items[None]       # [P, tile, S, W]
+            # SPAM support counting: per-sequence alive bit, packed
+            # LSB-first over the sequence axis, popcount-reduced — the
+            # zero tail pad in pack_seq_bits is the tail-word fix when
+            # the (per-shard) sequence count is not a word multiple
+            return B.support_popcount(joined)             # [P, tile]
+
+        sup = jax.lax.map(tile_sup, items)                # [n_tiles, P, tile]
+        sup = jnp.moveaxis(sup, 0, 1).reshape(p3.shape[0], ni_pad)
+        if mesh is not None:
+            sup = jax.lax.psum(sup, SEQ_AXIS)
+        return sup
+
+    if mesh is None:
+        return jax.jit(body)
+    st = P(None, SEQ_AXIS)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(st, st),
+                             out_specs=P()))

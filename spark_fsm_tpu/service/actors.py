@@ -24,8 +24,8 @@ from typing import Dict, List, Optional
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.service import (autoscale, fairness, lease, model,
-                                   obsplane, plugins, resultcache,
-                                   sources, storeguard)
+                                   obsplane, planner, plugins,
+                                   resultcache, sources, storeguard)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 from spark_fsm_tpu.utils import faults, jobctl, obs
@@ -700,6 +700,11 @@ class Miner:
         # heartbeat advertises and the steal scan's idle check reads
         self._running = 0
         self._running_lock = threading.Lock()
+        # lifetime successful admissions (monotone): heartbeat-
+        # piggybacked as "adm" so the autoscale leader can smooth the
+        # fleet's admission RATE and its derivative (predictive
+        # scale-up, [autoscale] up_rate_derivative)
+        self._admitted = 0
         self._threads = [
             threading.Thread(target=self._loop, daemon=True,
                              name=f"fsm-miner-{i}")
@@ -747,6 +752,12 @@ class Miner:
         the heartbeat snapshot's load-cost hint."""
         with self._wall_lock:
             return self._wall_ewma
+
+    def admitted_total(self) -> int:
+        """Lifetime successful admissions — the heartbeat snapshot's
+        "adm" field (the autoscaler's predictive-rate input)."""
+        with self._running_lock:
+            return self._admitted
 
     @property
     def draining(self) -> bool:
@@ -1153,6 +1164,8 @@ class Miner:
                             keep_frontier=True, lease_mgr=None,
                             rescache=self._rescache, guard=g)
             return None
+        with self._running_lock:
+            self._admitted += 1
         return {"ephemeral": "1"}
 
     def _admit(self, req: ServiceRequest, priority: str,
@@ -1337,6 +1350,14 @@ class Miner:
         finally:
             if not enqueued:
                 self._q.abort(tenant)  # reservation never became queued
+        if enqueued:
+            # lifetime admission counter (heartbeat-piggybacked as
+            # "adm"): the autoscaler's predictive rate-derivative
+            # signal differentiates the fleet SUM of these; locked —
+            # concurrent submit threads racing a bare += lose counts
+            # under exactly the burst load the signal exists to see
+            with self._running_lock:
+                self._admitted += 1
         return enqueued
 
     def _loop(self) -> None:
@@ -1559,6 +1580,10 @@ class Miner:
         else:
             g.status(req.uid, Status.DATASET, gate=gate)
         plugin = plugins.get_plugin(req)
+        if plugin.name != "AUTO":
+            # fsm_engine_selected_total counts the engine that actually
+            # mines; AUTO bumps its RESOLVED engine inside the planner
+            planner.count_selected(plugin.name)
         stats: Dict[str, object] = {
             "algorithm": plugin.name,
             "sequences": len(db),
@@ -2140,6 +2165,16 @@ class Master:
                 if src not in sources.SOURCES:
                     raise ValueError(f"unknown source {src!r}")
                 extras = self.miner.submit(req) or {}
+            except plugins.UnknownAlgorithm as exc:
+                # structured 400 BEFORE anything went async: the body
+                # names the supported registry (derived from the
+                # planner's view of plugins.ALGORITHMS, never a
+                # docstring), so a client typo is one round trip to fix
+                # instead of a failure buried deep in dispatch
+                return model.response(
+                    req, Status.FAILURE, error=str(exc),
+                    http_status="400",
+                    supported=json.dumps(exc.supported))
             except AdmissionShed as exc:
                 # overload shed: protocol-mapped to 429 + Retry-After by
                 # the HTTP layer (remote clients read retry_after_s).

@@ -116,6 +116,15 @@ class Autoscaler:
         self.max_replicas = int(acfg.max_replicas)
         self.up_queue_per_worker = float(acfg.up_queue_per_worker)
         self.up_p99_s = float(acfg.up_p99_s)
+        # predictive scale-up (ISSUE 15 satellite / ROADMAP item 4
+        # remainder): EWMA-smoothed fleet admission rate + its
+        # derivative, from the heartbeat-piggybacked lifetime "adm"
+        # counters; 0 disables the signal entirely
+        self.up_rate_derivative = float(acfg.up_rate_derivative)
+        self.rate_alpha = float(acfg.rate_alpha)
+        self._adm_last: Optional[tuple] = None  # (t, fleet admitted)
+        self._rate_ewma: Optional[float] = None
+        self._deriv_ewma: Optional[float] = None
         self.down_free_frac = float(acfg.down_free_frac)
         self.hold_s = float(acfg.hold_s)
         self.cooldown_s = float(acfg.cooldown_s)
@@ -212,6 +221,45 @@ class Autoscaler:
             worst = p99 if worst is None else max(worst, p99)
         return worst
 
+    def _admission_derivative(self, rows, now: float) -> Optional[float]:
+        """EWMA of the fleet admission-rate DERIVATIVE (jobs/s per
+        second).  Each tick differentiates the fleet's lifetime
+        admitted sum against the previous tick, EWMA-smooths the rate,
+        then EWMA-smooths the rate's slope — two stages of smoothing
+        plus the caller's hold_s window are the hysteresis guard: a
+        single bursty tick cannot fake sustained acceleration.  The
+        fleet sum steps DOWN when a replica leaves (its lifetime
+        counter vanishes with its heartbeat) — a counting artifact,
+        not a demand signal, so a negative raw delta RE-BASELINES the
+        estimator (fresh warm-up from the new fleet sum) instead of
+        feeding a phantom deceleration into the slope, which would
+        cancel a pending scale-up exactly when capacity was lost."""
+        if self.up_rate_derivative <= 0:
+            return None
+        adm = sum(int(r.get("adm") or 0) for r in rows)
+        last = self._adm_last
+        self._adm_last = (now, adm)
+        if last is None:
+            return None
+        dt = now - last[0]
+        if dt <= 0:
+            return self._deriv_ewma
+        if adm < last[1]:
+            self._rate_ewma = None
+            self._deriv_ewma = None
+            return None
+        rate = (adm - last[1]) / dt
+        a = self.rate_alpha
+        prev_rate = self._rate_ewma
+        self._rate_ewma = (rate if prev_rate is None
+                           else a * rate + (1 - a) * prev_rate)
+        if prev_rate is None:
+            return None
+        deriv = (self._rate_ewma - prev_rate) / dt
+        self._deriv_ewma = (deriv if self._deriv_ewma is None
+                            else a * deriv + (1 - a) * self._deriv_ewma)
+        return self._deriv_ewma
+
     # ----------------------------------------------------------- decisions
 
     def _publish(self, direction: str, desired: int, replicas: int,
@@ -257,9 +305,13 @@ class Autoscaler:
         p99 = self._fleet_p99(live, self._slo_p99())
         load = queued / max(1, workers)
         free_frac = free / max(1, workers)
+        deriv = self._admission_derivative(live, self._clock())
+        deriv_up = (self.up_rate_derivative > 0 and deriv is not None
+                    and deriv >= self.up_rate_derivative)
         up = (load > self.up_queue_per_worker
               or (self.up_p99_s > 0 and p99 is not None
-                  and p99 > self.up_p99_s))
+                  and p99 > self.up_p99_s)
+              or deriv_up)
         down = (not up and queued == 0
                 and free_frac >= self.down_free_frac
                 and replicas > self.min_replicas)
@@ -281,6 +333,11 @@ class Autoscaler:
                 "load_per_worker": round(load, 3),
                 "free_frac": round(free_frac, 3),
                 "p99_s": p99, "up": up, "down": down,
+                "adm_rate_ewma": (round(self._rate_ewma, 4)
+                                  if self._rate_ewma is not None
+                                  else None),
+                "adm_deriv_ewma": (round(deriv, 5)
+                                   if deriv is not None else None),
                 # `is not None`: a virtual clock's since-stamp can be
                 # 0.0 (same guard as the decision path above)
                 "held_up_s": (round(now - self._up_since, 3)
@@ -294,10 +351,16 @@ class Autoscaler:
         if up and now - self._up_since >= self.hold_s:
             if replicas >= self.max_replicas:
                 return
-            reason = (f"queued/worker {load:.2f} > "
-                      f"{self.up_queue_per_worker}"
-                      if load > self.up_queue_per_worker else
-                      f"e2e p99 {p99:.2f}s > {self.up_p99_s}s")
+            if load > self.up_queue_per_worker:
+                reason = (f"queued/worker {load:.2f} > "
+                          f"{self.up_queue_per_worker}")
+            elif (self.up_p99_s > 0 and p99 is not None
+                  and p99 > self.up_p99_s):
+                reason = f"e2e p99 {p99:.2f}s > {self.up_p99_s}s"
+            else:
+                reason = (f"admission rate accelerating: d(rate)/dt "
+                          f"EWMA {deriv:.4f} >= "
+                          f"{self.up_rate_derivative} jobs/s^2")
             self._publish("up", replicas + 1, replicas, reason)
             return
         if down and now - self._down_since >= self.hold_s:

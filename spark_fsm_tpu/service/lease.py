@@ -707,6 +707,11 @@ class LeaseManager:
             # scales on the FLEET max of these instead of its own
             # (possibly idle, therefore blind) local window
             "slo": obsplane.slo_digest(),
+            # lifetime successful admissions (ISSUE 15 satellite): the
+            # autoscale leader differentiates the fleet sum of these
+            # for the predictive rate-derivative scale-up signal
+            "adm": (int(getattr(m, "admitted_total", lambda: 0)())
+                    if m is not None else 0),
             "acq": int(_ACQUIRE_TOTAL.total()),
             "lost": int(_LOST_TOTAL.total()),
             "ts": round(time.time(), 3)}), self._ttl_ms)
@@ -757,6 +762,8 @@ class LeaseManager:
                        if m is not None and m.wall_ewma() is not None
                        else None),
             "slo": obsplane.slo_digest(),
+            "adm": (int(getattr(m, "admitted_total", lambda: 0)())
+                    if m is not None else 0),
             "acq": int(_ACQUIRE_TOTAL.total()),
             "lost": int(_LOST_TOTAL.total()),
         }

@@ -34,9 +34,12 @@ class ServiceRequest:
     """``(service, task, data)`` request envelope.
 
     ``data`` carries the per-request knobs as a flat string map exactly
-    like the reference: ``uid``, ``algorithm`` (SPADE | SPADE_TPU | TSR |
-    TSR_TPU), ``source``, ``support``, ``k``, ``minconf``, ``maxgap``,
-    ``maxwindow``, plus source-specific fields.
+    like the reference: ``uid``, ``algorithm`` (any name in
+    ``service/plugins.ALGORITHMS`` — the SPADE/SPAM pattern engines,
+    the TSR rule engines, and ``AUTO`` for planner routing; an unknown
+    name sheds a structured 400 listing the registry), ``source``,
+    ``support``, ``k``, ``minconf``, ``maxgap``, ``maxwindow``, plus
+    source-specific fields.
     """
 
     service: str

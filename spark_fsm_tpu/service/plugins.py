@@ -9,11 +9,19 @@ SPADE_TPU``) over the TPU engines and the CPU oracles:
   SPADE      — CPU oracle miner (numpy bitmap DFS).
   SPADE_TPU  — device engine (models/spade_tpu.py); honors maxgap /
                maxwindow by switching to the constrained engine.
+  SPAM       — CPU SPAM wave miner (models/spam_bitmap.py, popcount
+               support formulation; unconstrained patterns only).
+  SPAM_TPU   — device SPAM fixed-shape wave engine (same module).
   TSR        — CPU top-k rule miner (models/tsr.py TsrCPU: same best-first
                search, NumPy bitmap evaluation on host).
   TSR_TPU    — device TSR engine (models/tsr.py TsrTPU).
+  AUTO       — dataset-shape-aware routing to one of the above by the
+               engine planner (service/planner.py; ISSUE 15).
 
 Each plugin returns (kind, results) where kind is "patterns" or "rules".
+An unknown name raises :class:`UnknownAlgorithm`, whose ``supported``
+listing is derived from ``ALGORITHMS`` itself — the HTTP layer maps it
+to a structured 400.
 """
 
 from __future__ import annotations
@@ -28,6 +36,21 @@ from spark_fsm_tpu.service.model import ServiceRequest
 from spark_fsm_tpu.utils.canonical import PatternResult, RuleResult
 
 Results = Union[List[PatternResult], List[RuleResult]]
+
+
+class UnknownAlgorithm(ValueError):
+    """An ``algorithm`` name outside the registry.  Carries the
+    registry-derived ``supported`` listing so the HTTP layer can shed a
+    structured 400 naming what IS supported (the listing comes from
+    ``ALGORITHMS`` itself, never a docstring — satellite contract of
+    ISSUE 15)."""
+
+    def __init__(self, name: str, supported):
+        self.name = name
+        self.supported = sorted(supported)
+        super().__init__(
+            f"unknown algorithm {name!r} (supported: "
+            f"{', '.join(self.supported)})")
 
 
 @dataclasses.dataclass
@@ -227,6 +250,50 @@ def _spade_tpu(req: ServiceRequest, db: SequenceDB,
                            **kwargs)
 
 
+def _spam_constraints_check(req: ServiceRequest) -> None:
+    maxgap, maxwindow = _constraints(req)
+    if maxgap is not None or maxwindow is not None:
+        raise ValueError(
+            "the SPAM engine serves unconstrained patterns only "
+            "(maxgap/maxwindow unsupported — use SPADE_TPU, or "
+            "algorithm=AUTO to let the planner route)")
+
+
+def _spam_cpu(req: ServiceRequest, db: SequenceDB,
+              stats: Optional[dict] = None, checkpoint=None) -> Results:
+    from spark_fsm_tpu.models.spam_bitmap import mine_spam_cpu
+
+    _spam_constraints_check(req)
+    _checkpoint_unsupported(checkpoint, "SPAM", stats)
+    minsup = _minsup(req, db)
+    return mine_spam_cpu(db, minsup, stats_out=stats)
+
+
+def _spam_tpu(req: ServiceRequest, db: SequenceDB,
+              stats: Optional[dict] = None, checkpoint=None) -> Results:
+    from spark_fsm_tpu.models.spam_bitmap import mine_spam_tpu
+
+    _spam_constraints_check(req)
+    minsup = _minsup(req, db)
+    kwargs = config.engine_kwargs("pool_bytes", "node_batch",
+                                  "pipeline_depth")
+    if req.task == "stream":  # see _spade_tpu: bucket drifting windows
+        kwargs["shape_buckets"] = True
+        part_kw = {}
+    else:
+        part_kw = _partition_kwargs()
+    return mine_spam_tpu(db, minsup, mesh=config.get_mesh(),
+                         stats_out=stats, checkpoint=checkpoint,
+                         **part_kw, **kwargs)
+
+
+def _auto(req: ServiceRequest, db: SequenceDB,
+          stats: Optional[dict] = None, checkpoint=None) -> Results:
+    from spark_fsm_tpu.service import planner
+
+    return planner.extract_auto(req, db, stats, checkpoint=checkpoint)
+
+
 def _tsr_params(req: ServiceRequest):
     k = int(req.param("k", "100"))
     minconf = float(req.param("minconf", "0.5"))
@@ -304,16 +371,37 @@ def _tsr_tpu(req: ServiceRequest, db: SequenceDB,
 ALGORITHMS: Dict[str, AlgorithmPlugin] = {
     "SPADE": AlgorithmPlugin("SPADE", "patterns", _spade_cpu),
     "SPADE_TPU": AlgorithmPlugin("SPADE_TPU", "patterns", _spade_tpu),
+    "SPAM": AlgorithmPlugin("SPAM", "patterns", _spam_cpu),
+    "SPAM_TPU": AlgorithmPlugin("SPAM_TPU", "patterns", _spam_tpu),
     "TSR": AlgorithmPlugin("TSR", "rules", _tsr_cpu),
     "TSR_TPU": AlgorithmPlugin("TSR_TPU", "rules", _tsr_tpu),
+    # AUTO's registry entry exists so listings ("/admin/algorithms",
+    # the 400 body) include it; get_plugin builds the per-request
+    # plugin below because AUTO's result KIND depends on the params
+    "AUTO": AlgorithmPlugin("AUTO", "patterns", _auto),
+}
+
+# the result-identity FAMILY behind each engine name: engines inside a
+# family are byte-identical by the parity contract, so the result-reuse
+# tier keys cache entries/coalescing on the family — a request hits
+# regardless of which engine route produced the entry (ISSUE 15
+# composition invariant).  Family names are the historical device-
+# engine names so pre-existing cache keys stay valid.
+FAMILIES: Dict[str, str] = {
+    "SPADE": "SPADE_TPU", "SPADE_TPU": "SPADE_TPU",
+    "SPAM": "SPADE_TPU", "SPAM_TPU": "SPADE_TPU",
+    "TSR": "TSR_TPU", "TSR_TPU": "TSR_TPU",
 }
 
 
 def get_plugin(req: ServiceRequest) -> AlgorithmPlugin:
     name = (req.param("algorithm") or "SPADE_TPU").upper()
+    if name == "AUTO":
+        from spark_fsm_tpu.service import planner
+
+        return AlgorithmPlugin("AUTO", planner.infer_kind(req), _auto)
     if name not in ALGORITHMS:
-        raise ValueError(f"unknown algorithm {name!r} "
-                         f"(have {sorted(ALGORITHMS)})")
+        raise UnknownAlgorithm(name, ALGORITHMS)
     return ALGORITHMS[name]
 
 
@@ -328,20 +416,31 @@ def effective_params(req: ServiceRequest,
     uid are deliberately EXCLUDED — they change scheduling, never
     output (the engines' parity contract).
 
-    Pattern algorithms (SPADE/SPADE_TPU): ``support`` as given (float),
-    plus ``minsup_abs`` resolved to the absolute count when the value
-    is already absolute (>= 1) or ``n_sequences`` is known — the
-    comparable form dominance needs.  Rule algorithms (TSR/TSR_TPU):
-    ``k``, ``minconf`` (float; compared exactly via Fraction at serve
-    time), ``max_side``.  Raises ValueError on malformed params, same
-    as the plugins themselves would.
+    ``algo`` is the result-identity FAMILY (``FAMILIES``), not the
+    routed engine: SPADE/SPADE_TPU/SPAM/SPAM_TPU (and patterns-AUTO)
+    all normalize to one key because their outputs are byte-identical
+    by the parity contract — a cache entry produced under one engine
+    route serves every other route for the same dataset + params
+    (ISSUE 15).  Engine choice is scheduling, never output, exactly
+    like the fused/resident knobs already excluded below.
+
+    Pattern algorithms: ``support`` as given (float), plus
+    ``minsup_abs`` resolved to the absolute count when the value is
+    already absolute (>= 1) or ``n_sequences`` is known — the
+    comparable form dominance needs.  Rule algorithms: ``k``,
+    ``minconf`` (float; compared exactly via Fraction at serve time),
+    ``max_side``.  Raises ValueError on malformed params, same as the
+    plugins themselves would.
     """
     plugin = get_plugin(req)
+    family = FAMILIES.get(
+        plugin.name,
+        "TSR_TPU" if plugin.kind == "rules" else "SPADE_TPU")
     if plugin.kind == "rules":
         k, minconf, max_side = _tsr_params(req)
         if k < 1:
             raise ValueError(f"k must be >= 1 (got {k})")
-        return {"algo": plugin.name, "kind": plugin.kind, "k": k,
+        return {"algo": family, "kind": plugin.kind, "k": k,
                 "minconf": minconf, "max_side": max_side}
     support = req.param("support")
     if support is None:
@@ -353,6 +452,8 @@ def effective_params(req: ServiceRequest,
     elif n_sequences is not None:
         minsup_abs = abs_minsup(rel, n_sequences)
     maxgap, maxwindow = _constraints(req)
-    return {"algo": plugin.name, "kind": plugin.kind, "support": rel,
+    if plugin.name in ("SPAM", "SPAM_TPU"):
+        _spam_constraints_check(req)  # same error as the plugin would raise
+    return {"algo": family, "kind": plugin.kind, "support": rel,
             "minsup_abs": minsup_abs, "maxgap": maxgap,
             "maxwindow": maxwindow}

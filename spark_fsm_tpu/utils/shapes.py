@@ -142,6 +142,16 @@ def key_tsr_resident(n_seq: int, n_words: int, m: int, km: int, nb: int,
     return f"tsr-resident:s{n_seq}w{n_words}m{m}km{km}nb{nb}r{ring}"
 
 
+def key_spam(n_seq: int, n_words: int, rows: int, node_batch: int,
+             ni_pad: int) -> str:
+    """One SPAM wave-engine geometry (models/spam_bitmap.py): the
+    fixed-shape all-items support pass compiles per (seq axis, words,
+    store rows, node batch, padded item axis) — ONE key per dataset
+    geometry because the wave shape is candidate-raggedness-independent
+    by construction (that independence is the engine's point)."""
+    return f"spam:s{n_seq}w{n_words}r{rows}nb{node_batch}i{ni_pad}"
+
+
 def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
     return f"sweep:s{n_seq}w{n_words}r{n_rows}i{ni_rows}"
 

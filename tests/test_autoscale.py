@@ -58,8 +58,12 @@ class FakeMiner:
         self.q = 0
         self.r = 0
         self.w = workers
+        self.adm = 0  # lifetime admissions (heartbeat "adm")
         self.draining = False
         self.drained_with = None
+
+    def admitted_total(self):
+        return self.adm
 
     def queue_size(self):
         return self.q
@@ -199,6 +203,63 @@ def test_p99_signal_scales_up():
         assert _decisions()["up"] == d0["up"] + 1
     finally:
         obsplane.clear_slo()
+
+
+def test_admission_rate_derivative_scales_up_predictively():
+    """ISSUE 15 satellite (ROADMAP item 4 remainder): an ACCELERATING
+    admission rate scales up before the queue builds — the EWMA'd
+    rate derivative is the signal, guarded by the same hold_s
+    hysteresis; a steady (even high) rate never fires it."""
+    t, store, rigs = _rig(1, up_rate_derivative=0.5, hold_s=3.0,
+                          cooldown_s=100.0)
+    sc, m, mgr = rigs[0]
+    d0 = _decisions()
+
+    # steady rate first: +5 admissions per tick, derivative ~ 0
+    for i in range(8):
+        t[0] = float(i)
+        m.adm += 5
+        sc.tick()
+    assert store.peek(AS.DESIRED_KEY) is None
+    assert _decisions() == d0
+    last = sc.stats()["last_eval"]
+    assert last["adm_rate_ewma"] is not None
+    assert abs(last["adm_deriv_ewma"] or 0.0) < 0.5
+
+    # accelerating: rate grows every tick; queue stays EMPTY (the
+    # whole point — this signal fires before queued/worker can)
+    rate = 5
+    fired_at = None
+    for i in range(8, 20):
+        t[0] = float(i)
+        rate += 4
+        m.adm += rate
+        sc.tick()
+        if store.peek(AS.DESIRED_KEY) is not None:
+            fired_at = i
+            break
+    assert fired_at is not None
+    rec = json.loads(store.peek(AS.DESIRED_KEY))
+    assert rec["dir"] == "up"
+    assert "rate" in rec["reason"] and "d(rate)/dt" in rec["reason"]
+    assert _decisions()["up"] == d0["up"] + 1
+    # hysteresis: the signal needed hold_s of continuous acceleration
+    last = sc.stats()["last_eval"]
+    assert last["queued"] == 0  # predictive, not reactive
+
+
+def test_admission_rate_derivative_off_by_default():
+    t, store, rigs = _rig(1, hold_s=0.0, cooldown_s=0.0)
+    sc, m, mgr = rigs[0]
+    d0 = _decisions()
+    rate = 1
+    for i in range(10):
+        t[0] = float(i)
+        rate *= 2
+        m.adm += rate
+        sc.tick()
+    assert store.peek(AS.DESIRED_KEY) is None
+    assert _decisions() == d0
 
 
 def test_fleet_p99_merge_scales_up_from_a_peer_digest():

@@ -63,3 +63,39 @@ def test_tsr_primitives_match_numpy(jnp_mod):
                          (BN.suffix_or_incl, BJ.suffix_or_incl),
                          (BN.shift_up_one, BJ.shift_up_one)]:
         np.testing.assert_array_equal(np.asarray(jx_fn(jnp_mod.asarray(b))), np_fn(b))
+
+
+def test_popcount_tail_mask_match_numpy(jnp_mod):
+    """ISSUE 15 satellite: the jax popcount/tail-mask/pack primitives
+    are bit-exact mirrors of the numpy reference, including the
+    sext-padding overcount fix."""
+    from spark_fsm_tpu.ops import bitops_jax as BJ
+    rng = np.random.default_rng(21)
+    b = rand_bitmaps(rng, (5, 3))
+    np.testing.assert_array_equal(
+        np.asarray(BJ.popcount(jnp_mod.asarray(b))), BN.popcount(b))
+    for n_valid in (0, 1, 31, 32, 40, 64, 95, 96):
+        np.testing.assert_array_equal(
+            np.asarray(BJ.tail_mask(n_valid, 3)), BN.tail_mask(n_valid, 3))
+        np.testing.assert_array_equal(
+            np.asarray(BJ.masked_popcount(jnp_mod.asarray(b), n_valid)),
+            BN.masked_popcount(b, n_valid))
+    # the observable sext bug, on the jax side
+    t = BJ.sext_transform(jnp_mod.asarray(
+        np.array([[np.uint32(1 << 3), np.uint32(0)]])))
+    assert int(np.asarray(BJ.popcount(t)).sum()) == 60
+    assert int(np.asarray(BJ.masked_popcount(t, 40))) == 36
+
+
+def test_pack_and_support_popcount_match_numpy(jnp_mod):
+    from spark_fsm_tpu.ops import bitops_jax as BJ
+    rng = np.random.default_rng(22)
+    for n_seq in (1, 31, 33, 45, 64):
+        act = rng.random((3, n_seq)) < 0.5
+        np.testing.assert_array_equal(
+            np.asarray(BJ.pack_seq_bits(jnp_mod.asarray(act))),
+            BN.pack_seq_bits(act))
+    bm = rand_bitmaps(rng, (4, 45, 2))
+    np.testing.assert_array_equal(
+        np.asarray(BJ.support_popcount(jnp_mod.asarray(bm))),
+        BN.support(bm))

@@ -122,3 +122,61 @@ def test_shift_up_one():
     # top bit falls off the end
     top = bits(63, n_words=2)
     assert B.shift_up_one(top).tolist() == [0, 0]
+
+
+# ------------------------- popcount / tail-word masking (ISSUE 15 satellite)
+
+
+def test_popcount_matches_bin_count():
+    rng = np.random.default_rng(11)
+    w = rng.integers(0, 2**32, size=(7, 3), dtype=np.uint32)
+    want = np.vectorize(lambda x: bin(int(x)).count("1"))(w)
+    np.testing.assert_array_equal(B.popcount(w), want)
+
+
+def test_tail_mask_edges():
+    assert B.tail_mask(64, 2).tolist() == [0xFFFFFFFF, 0xFFFFFFFF]
+    assert B.tail_mask(40, 2).tolist() == [0xFFFFFFFF, 0xFF]
+    assert B.tail_mask(32, 2).tolist() == [0xFFFFFFFF, 0]
+    assert B.tail_mask(1, 2).tolist() == [1, 0]
+    assert B.tail_mask(0, 2).tolist() == [0, 0]
+    # n_valid past the word span saturates
+    assert B.tail_mask(100, 2).tolist() == [0xFFFFFFFF, 0xFFFFFFFF]
+
+
+def test_masked_popcount_ignores_sext_padding_bits():
+    """THE observable bug: the SPAM s-extension shift saturates every
+    bit above the first occurrence — including tail-word padding
+    positions past the true capacity — so an unmasked popcount
+    overcounts by the padding width."""
+    n_valid = 40  # 2 words, 24 padding bits in the tail word
+    b = bits(3, n_words=2)  # first occurrence at position 3
+    t = B.sext_transform(b)
+    naive = int(B.popcount(t).sum())
+    masked = int(B.masked_popcount(t, n_valid))
+    assert naive == 60          # 64 - 4: every bit after 3, pads included
+    assert masked == 36         # 40 - 4: valid positions only
+    assert naive - masked == 24  # exactly the padding width
+
+
+def test_pack_seq_bits_non_word_multiple_sequence_count():
+    """Packed-sequence-word support: a sequence count that is not a
+    multiple of the word width gets an explicit all-zero tail pad, so
+    popcount(packed) == the true alive count."""
+    rng = np.random.default_rng(12)
+    for n_seq in (1, 31, 32, 33, 45, 64, 95):
+        act = rng.random((4, n_seq)) < 0.5
+        packed = B.pack_seq_bits(act)
+        assert packed.shape == (4, -(-n_seq // 32))
+        np.testing.assert_array_equal(
+            B.popcount(packed).sum(axis=-1), act.sum(axis=-1))
+
+
+def test_support_popcount_matches_support():
+    rng = np.random.default_rng(13)
+    bm = rng.integers(0, 2**32, size=(6, 45, 2), dtype=np.uint32)
+    bm &= rng.integers(0, 2**32, size=(6, 45, 2), dtype=np.uint32)
+    np.testing.assert_array_equal(B.support_popcount(bm), B.support(bm))
+    # all-zero and all-ones extremes
+    assert B.support_popcount(np.zeros((3, 2), np.uint32)) == 0
+    assert B.support_popcount(np.full((1, 33, 1), 7, np.uint32)) == 33

@@ -1,0 +1,385 @@
+"""Engine planner drills (ISSUE 15, service/planner.py).
+
+Pins: the calibrated density-crossover table, AUTO routing on real
+dataset shapes vs explicit overrides, the structured 400 for unknown
+engines, the planner decision on the trace spine, result-cache hits
+across engine routes, and pinned mode."""
+
+import json
+import time
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.data.vertical import (DatasetStats, abs_minsup,
+                                         dataset_stats)
+from spark_fsm_tpu.models.oracle import mine_spade
+from spark_fsm_tpu.service import planner, plugins
+from spark_fsm_tpu.service.actors import Master
+from spark_fsm_tpu.service.model import ServiceRequest, \
+    deserialize_patterns
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import obs
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+
+def _dense_db():
+    # alphabet 10, density ~0.3: well above the 0.02 crossover
+    return synthetic_db(seed=7, n_sequences=60, n_items=10,
+                        mean_itemsets=3.0, mean_itemset_size=1.3)
+
+
+def _sparse_db():
+    # the ONE sub-crossover shape (data/synth.sub_crossover_db): 400
+    # items at support 2 over 200 sequences — density 0.01 < 0.02
+    from spark_fsm_tpu.data.synth import sub_crossover_db
+
+    return sub_crossover_db()
+
+
+def _stats(density, alphabet=32):
+    return DatasetStats(n_sequences=1000, n_itemsets=4000, n_tokens=5000,
+                        alphabet=alphabet, max_len=8, avg_len=4.0,
+                        n_words=1, density=density)
+
+
+def _wait(store, uid, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = store.status(uid)
+        if st in ("finished", "failure"):
+            return st
+        time.sleep(0.01)
+    raise TimeoutError(uid)
+
+
+# -------------------------------------------------------- crossover table
+
+
+def test_density_crossover_table_pinned():
+    """The calibrated routing table (docs/DESIGN.md "Engine planner"):
+    density/alphabet/constraints -> engine, at the committed default
+    crossover (0.02) and alphabet ceiling (512)."""
+    pcfg = cfgmod.PlannerConfig()
+    assert pcfg.density_crossover == 0.02
+    assert pcfg.max_alphabet == 512
+    table = [
+        # (density, alphabet, constrained) -> engine
+        ((0.30, 12, False), "SPAM_TPU"),
+        ((0.076, 62, False), "SPAM_TPU"),   # measured kosarak@0.01 row
+        ((0.023, 230, False), "SPAM_TPU"),  # measured: 1.6x over SPADE
+        ((0.02, 512, False), "SPAM_TPU"),   # boundary: >= is SPAM
+        ((0.019, 64, False), "SPADE_TPU"),  # below crossover: never SPAM
+        ((0.0001, 8, False), "SPADE_TPU"),
+        ((0.30, 513, False), "SPADE_TPU"),  # alphabet ceiling
+        ((0.30, 12, True), "SPADE_TPU"),    # constraints exclude SPAM
+    ]
+    for (density, alphabet, constrained), want in table:
+        d = planner.choose_patterns_engine(
+            _stats(density, alphabet), pcfg, constrained=constrained)
+        assert d.engine == want, (density, alphabet, constrained, d)
+        assert d.kind == "patterns"
+        assert d.reason
+
+
+def test_dataset_stats_projection_density():
+    db = _sparse_db()
+    st = dataset_stats(db, min_item_support=2)
+    assert st.alphabet == 402
+    assert st.density < 0.02
+    dense = dataset_stats(_dense_db(), min_item_support=1)
+    assert dense.density > 0.05
+
+
+# ------------------------------------------------------------ AUTO routing
+
+
+def test_auto_routes_dense_to_spam_with_parity_and_stats():
+    db = _dense_db()
+    req = ServiceRequest("fsm", "train", {
+        "algorithm": "AUTO", "support": "0.1"})
+    plugin = plugins.get_plugin(req)
+    assert plugin.name == "AUTO" and plugin.kind == "patterns"
+    stats = {}
+    got = plugin.extract(req, db, stats)
+    assert stats["planner_engine"] == "SPAM_TPU"
+    assert stats["planner_mode"] == "auto"
+    assert "density" in stats["planner_reason"]
+    assert stats["engine"] == "spam"  # the routed engine actually ran
+    assert patterns_text(got) == patterns_text(
+        mine_spade(db, abs_minsup(0.1, len(db))))
+
+
+def test_auto_routes_sparse_to_spade_never_spam_below_crossover():
+    db = _sparse_db()
+    req = ServiceRequest("fsm", "train", {
+        "algorithm": "AUTO", "support": "2"})
+    stats = {}
+    got = plugins.get_plugin(req).extract(req, db, stats)
+    assert stats["planner_engine"] == "SPADE_TPU"
+    assert stats["planner_density"] < 0.02
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+
+
+def test_auto_routes_constrained_to_spade():
+    db = _dense_db()
+    req = ServiceRequest("fsm", "train", {
+        "algorithm": "AUTO", "support": "0.1", "maxgap": "2"})
+    stats = {}
+    plugins.get_plugin(req).extract(req, db, stats)
+    assert stats["planner_engine"] == "SPADE_TPU"
+    assert "maxgap" in stats["planner_reason"]
+
+
+def test_auto_infers_rules_kind_and_routes_tsr():
+    db = _dense_db()
+    req = ServiceRequest("fsm", "train", {
+        "algorithm": "AUTO", "support": "0.1", "k": "5",
+        "minconf": "0.4"})
+    plugin = plugins.get_plugin(req)
+    assert plugin.kind == "rules"
+    stats = {}
+    rules = plugin.extract(req, db, stats)
+    assert stats["planner_engine"] == "TSR_TPU"
+    assert all(len(r) == 4 for r in rules)
+
+
+def test_explicit_spam_honored_below_crossover():
+    """Explicit algorithm= always wins: SPAM on a sub-crossover dataset
+    runs SPAM (the planner only owns AUTO)."""
+    db = _sparse_db()
+    req = ServiceRequest("fsm", "train", {
+        "algorithm": "SPAM_TPU", "support": "2"})
+    stats = {}
+    got = plugins.get_plugin(req).extract(req, db, stats)
+    assert stats["engine"] == "spam"
+    assert "planner_engine" not in stats
+    assert patterns_text(got) == patterns_text(mine_spade(db, 2))
+
+
+def test_pinned_mode_routes_auto_unconditionally():
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config(
+        {"planner": {"mode": "pinned", "pinned": "SPADE_TPU"}}))
+    try:
+        db = _dense_db()  # dense — auto mode would pick SPAM
+        req = ServiceRequest("fsm", "train", {
+            "algorithm": "AUTO", "support": "0.1"})
+        stats = {}
+        plugins.get_plugin(req).extract(req, db, stats)
+        assert stats["planner_engine"] == "SPADE_TPU"
+        assert stats["planner_mode"] == "pinned"
+        # a rules request cannot be served by a patterns pin: the
+        # kind-default fallback keeps the result kind intact
+        req2 = ServiceRequest("fsm", "train", {
+            "algorithm": "AUTO", "support": "0.1", "k": "3",
+            "minconf": "0.4"})
+        stats2 = {}
+        plugins.get_plugin(req2).extract(req2, db, stats2)
+        assert stats2["planner_engine"] == "TSR_TPU"
+    finally:
+        cfgmod.set_config(old)
+
+
+def test_pinned_spam_constrained_falls_back_to_spade():
+    """A SPAM soak (mode=pinned, pinned=SPAM_TPU) must not fail every
+    constrained AUTO request: constraints fall back to SPADE_TPU, with
+    the reason naming why."""
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config(
+        {"planner": {"mode": "pinned", "pinned": "SPAM_TPU"}}))
+    try:
+        db = _dense_db()
+        req = ServiceRequest("fsm", "train", {
+            "algorithm": "AUTO", "support": "0.1", "maxgap": "2"})
+        stats = {}
+        got = plugins.get_plugin(req).extract(req, db, stats)
+        assert stats["planner_engine"] == "SPADE_TPU"
+        assert "maxgap" in stats["planner_reason"]
+        assert got  # the constrained mine actually ran
+        # unconstrained AUTO under the same pin still soaks SPAM
+        req2 = ServiceRequest("fsm", "train", {
+            "algorithm": "AUTO", "support": "0.1"})
+        stats2 = {}
+        plugins.get_plugin(req2).extract(req2, db, stats2)
+        assert stats2["planner_engine"] == "SPAM_TPU"
+    finally:
+        cfgmod.set_config(old)
+
+
+def test_planner_config_validation():
+    with pytest.raises(cfgmod.ConfigError, match="planner.mode"):
+        cfgmod.parse_config({"planner": {"mode": "sometimes"}})
+    with pytest.raises(cfgmod.ConfigError, match="planner.pinned"):
+        cfgmod.parse_config({"planner": {"pinned": "AUTO"}})
+    with pytest.raises(cfgmod.ConfigError, match="density_crossover"):
+        cfgmod.parse_config({"planner": {"density_crossover": 1.5}})
+    with pytest.raises(cfgmod.ConfigError, match="max_alphabet"):
+        cfgmod.parse_config({"planner": {"max_alphabet": 0}})
+
+
+# ------------------------------------------------- unknown algorithm -> 400
+
+
+def test_unknown_algorithm_sheds_structured_400():
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "SPQR", "source": "INLINE",
+            "sequences": format_spmf(_dense_db()), "support": "0.1"}))
+        assert resp.status == "failure"
+        assert resp.data.get("http_status") == "400"
+        supported = json.loads(resp.data["supported"])
+        # derived from the live registry, not a docstring
+        assert supported == sorted(plugins.ALGORITHMS)
+        assert "SPAM_TPU" in supported and "AUTO" in supported
+        assert "SPQR" in resp.data["error"]
+        # zero store trace of the uid — the shed happened before
+        # anything went async
+        assert store.status(resp.data["uid"]) is None
+    finally:
+        master.shutdown()
+
+
+def test_unknown_algorithm_maps_to_http_400():
+    """Over the real HTTP surface: a bad engine name is a 400 with the
+    structured body, not a 200 failure envelope."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from spark_fsm_tpu.service.app import serve_background
+
+    srv = serve_background()
+    try:
+        data = urllib.parse.urlencode({
+            "algorithm": "NOPE", "source": "INLINE",
+            "sequences": format_spmf(_dense_db()),
+            "support": "0.1"}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_port}/train", data=data,
+                timeout=30)
+        assert ei.value.code == 400
+        body = json.loads(ei.value.read().decode())
+        assert json.loads(body["data"]["supported"]) == \
+            sorted(plugins.ALGORITHMS)
+    finally:
+        srv.master.shutdown()
+        srv.shutdown()
+
+
+# --------------------------------------------------- trace spine + metrics
+
+
+def test_planner_decision_lands_in_trace():
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config(
+        {"observability": {"trace": True}}))
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        resp = master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "AUTO", "source": "INLINE",
+            "sequences": format_spmf(_dense_db()), "support": "0.1",
+            "uid": "planner-trace"}))
+        assert resp.status == "started"
+        assert _wait(store, "planner-trace") == "finished"
+        dump = obs.trace_dump("planner-trace")
+        assert dump is not None
+        routes = [s for s in dump["spans"] if s["site"] == "planner.route"]
+        assert len(routes) == 1
+        attrs = routes[0]["attrs"]
+        assert attrs["engine"] == "SPAM_TPU"
+        assert attrs["mode"] == "auto"
+        assert "reason" in attrs and "density" in attrs
+    finally:
+        master.shutdown()
+        cfgmod.set_config(old)
+
+
+def test_engine_selected_counter_seeded_and_counts():
+    fam = obs.REGISTRY.snapshot().get("fsm_engine_selected_total", {})
+    for eng in planner.CONCRETE_ENGINES:
+        assert f"engine={eng}" in fam, eng
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        before = obs.REGISTRY.snapshot()["fsm_engine_selected_total"]
+        master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "AUTO", "source": "INLINE",
+            "sequences": format_spmf(_dense_db()), "support": "0.1",
+            "uid": "esel-auto"}))
+        master.handle(ServiceRequest("fsm", "train", {
+            "algorithm": "SPADE_TPU", "source": "INLINE",
+            "sequences": format_spmf(_dense_db()), "support": "0.1",
+            "uid": "esel-explicit"}))
+        _wait(store, "esel-auto")
+        _wait(store, "esel-explicit")
+        after = obs.REGISTRY.snapshot()["fsm_engine_selected_total"]
+        assert after["engine=SPAM_TPU"] == before["engine=SPAM_TPU"] + 1
+        assert after["engine=SPADE_TPU"] == \
+            before["engine=SPADE_TPU"] + 1
+        assert "engine=AUTO" not in after  # AUTO counts as its target
+    finally:
+        master.shutdown()
+
+
+# --------------------------------------- result-cache engine invariance
+
+
+def test_effective_params_engine_invariant_families():
+    base = {"support": "0.1"}
+    keys = set()
+    for algo in ("SPADE", "SPADE_TPU", "SPAM", "SPAM_TPU", "AUTO"):
+        req = ServiceRequest("fsm", "train",
+                             {"algorithm": algo, **base})
+        p = plugins.effective_params(req, n_sequences=100)
+        keys.add(json.dumps(p, sort_keys=True))
+        assert p["algo"] == "SPADE_TPU"
+    assert len(keys) == 1
+    rules = {"k": "5", "minconf": "0.4"}
+    for algo in ("TSR", "TSR_TPU", "AUTO"):
+        req = ServiceRequest("fsm", "train",
+                             {"algorithm": algo, **rules})
+        assert plugins.effective_params(req)["algo"] == "TSR_TPU"
+
+
+def test_rescache_hits_across_engine_routes():
+    """ISSUE 15 composition invariant: an entry produced under one
+    engine route serves the identical dataset+params under EVERY other
+    route (exact hit), byte-identically."""
+    old = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"rescache": {"enabled": True}}))
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        db = _dense_db()
+        spmf = format_spmf(db)
+        want = patterns_text(mine_spade(db, abs_minsup(0.1, len(db))))
+
+        def run(uid, algo):
+            resp = master.handle(ServiceRequest("fsm", "train", {
+                "algorithm": algo, "source": "INLINE",
+                "sequences": spmf, "support": "0.1", "uid": uid}))
+            assert resp.status == "started", resp.data
+            assert _wait(store, uid) == "finished"
+            stats = json.loads(store.get(f"fsm:stats:{uid}") or "{}")
+            pats = patterns_text(
+                deserialize_patterns(store.patterns(uid)))
+            assert pats == want, (uid, algo)
+            return stats
+
+        cold = run("rc-cold", "SPADE_TPU")
+        assert not cold.get("served_from_cache")
+        # different engine spelling, same dataset+params: exact hit
+        hit_spam = run("rc-spam", "SPAM")
+        assert hit_spam.get("served_from_cache") == "exact"
+        hit_auto = run("rc-auto", "AUTO")
+        assert hit_auto.get("served_from_cache") == "exact"
+    finally:
+        master.shutdown()
+        cfgmod.set_config(old)

@@ -245,10 +245,22 @@ def test_tsr_rules_and_filtering(server):
 
 
 def test_failure_supervision(server):
-    # unknown algorithm rejected synchronously
-    resp = _post(server, "/train", algorithm="NOPE", source="INLINE",
-                 sequences="1 -2", support="0.5")
-    assert resp["status"] == "failure" and "unknown algorithm" in resp["data"]["error"]
+    # unknown algorithm rejected synchronously — as a STRUCTURED 400
+    # listing the supported registry (ISSUE 15 satellite), not a 200
+    # failure envelope
+    import urllib.error
+
+    from spark_fsm_tpu.service import plugins as _plugins
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server, "/train", algorithm="NOPE", source="INLINE",
+              sequences="1 -2", support="0.5")
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read().decode())
+    assert body["status"] == "failure"
+    assert "unknown algorithm" in body["data"]["error"]
+    assert json.loads(body["data"]["supported"]) == \
+        sorted(_plugins.ALGORITHMS)
 
     # bad source path fails asynchronously with status=failure + error
     resp = _post(server, "/train", algorithm="SPADE", source="FILE",

@@ -1,0 +1,231 @@
+"""SPAM bitmap mining engine (ISSUE 15, models/spam_bitmap.py +
+ops/spam_bitops.py).
+
+The acceptance pins: byte-identical output to the CPU oracle on the
+pinned miniatures (direct and planner-routed, including through the
+partition layer on the 8-virtual-device CPU mesh), checkpoint/resume
+through the EXISTING frontier format — in both directions across
+engines — and the tail-word-masked popcount support counting."""
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.data.synth import kosarak_like, synthetic_db
+from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.models.oracle import brute_force_mine, mine_spade
+from spark_fsm_tpu.models.spam_bitmap import (
+    SpamBitmapTPU, mine_spam_cpu, mine_spam_tpu, spam_geometry)
+from spark_fsm_tpu.utils.canonical import patterns_text
+
+
+def _db_small():
+    return synthetic_db(seed=7, n_sequences=60, n_items=10,
+                        mean_itemsets=3.0, mean_itemset_size=1.3)
+
+
+def _db_mid():
+    return synthetic_db(seed=3, n_sequences=80, n_items=12,
+                        mean_itemsets=4.0, mean_itemset_size=1.4)
+
+
+def _db_kosarak():
+    return kosarak_like(scale=0.0003, fast=True)
+
+
+# ------------------------------------------------------------- oracle parity
+
+
+def test_spam_cpu_matches_brute_force_tiny():
+    db = [((1,), (2,), (1, 3)), ((1, 2), (3,)), ((2,), (1,), (3,)),
+          ((1,), (3,))]
+    want = sorted(brute_force_mine(db, 2))
+    got = sorted(mine_spam_cpu(db, 2))
+    assert got == want
+
+
+@pytest.mark.parametrize("sup", [0.05, 0.1, 0.2])
+def test_spam_cpu_matches_oracle(sup):
+    db = _db_small()
+    ms = abs_minsup(sup, len(db))
+    assert patterns_text(mine_spam_cpu(db, ms)) == \
+        patterns_text(mine_spade(db, ms))
+
+
+@pytest.mark.parametrize("sup", [0.1, 0.2])
+def test_spam_tpu_matches_oracle(sup):
+    db = _db_mid()
+    ms = abs_minsup(sup, len(db))
+    stats = {}
+    got = patterns_text(mine_spam_tpu(db, ms, stats_out=stats))
+    assert got == patterns_text(mine_spade(db, ms))
+    assert stats["engine"] == "spam"
+    assert stats["waves"] >= 1
+    # the wave pass's launch count is raggedness-independent: one
+    # support launch per wave (prep/materialize add their own)
+    assert stats["kernel_launches"] >= stats["waves"]
+    assert stats["shape_key"].startswith("spam:")
+
+
+def test_spam_tpu_kosarak_miniature_parity():
+    db = _db_kosarak()
+    ms = abs_minsup(0.03, len(db))
+    assert patterns_text(mine_spam_tpu(db, ms)) == \
+        patterns_text(mine_spade(db, ms))
+
+
+def test_spam_max_pattern_itemsets_parity():
+    db = _db_mid()
+    ms = abs_minsup(0.1, len(db))
+    from spark_fsm_tpu.models.spade_tpu import mine_spade_tpu
+
+    want = patterns_text(mine_spade_tpu(db, ms, max_pattern_itemsets=2,
+                                        fused="never"))
+    assert patterns_text(mine_spam_tpu(
+        db, ms, max_pattern_itemsets=2)) == want
+    assert patterns_text(mine_spam_cpu(
+        db, ms, max_pattern_itemsets=2)) == want
+
+
+def test_spam_tiny_node_batch_forces_many_waves():
+    """Raggedness-independence under pressure: a 2-node batch produces
+    many waves and recompute-on-miss traffic, same byte output."""
+    db = _db_mid()
+    ms = abs_minsup(0.1, len(db))
+    vdb = build_vertical(db, min_item_support=ms)
+    eng = SpamBitmapTPU(vdb, ms, node_batch=2, pipeline_depth=1)
+    got = patterns_text(eng.mine())
+    assert got == patterns_text(mine_spade(db, ms))
+    assert eng.stats["waves"] > 5
+
+
+def test_spam_empty_projection():
+    db = [((1,),), ((2,),)]
+    assert mine_spam_tpu(db, 2) == []
+    assert mine_spam_cpu(db, 2) == []
+
+
+# ---------------------------------------------------------- mesh + partition
+
+
+def test_spam_mesh_parity():
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    db = _db_kosarak()
+    ms = abs_minsup(0.03, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    assert patterns_text(mine_spam_tpu(db, ms, mesh=make_mesh(8))) == want
+
+
+def test_spam_partitioned_parity_8_device_mesh():
+    """The acceptance's partition pin: the 2 x 4 parts x seq mesh route
+    (class = DFS root item, exactly the SPADE partition classes) is
+    byte-identical to the oracle."""
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    db = _db_kosarak()
+    ms = abs_minsup(0.03, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    stats = {}
+    got = patterns_text(mine_spam_tpu(
+        db, ms, mesh=make_mesh(8), partition_parts=2,
+        partition_classes=16, stats_out=stats))
+    assert got == want
+    assert stats["partition_parts"] == 2
+    assert stats["partition_imbalance"] >= 1.0
+
+
+# ------------------------------------------------------- checkpoint/resume
+
+
+def _mid_snapshot(eng_cls, vdb, ms, **kw):
+    """Mine with per-wave checkpoints; return a MID-mine snapshot with
+    the merged results list (the StoreCheckpoint.load contract)."""
+    eng = eng_cls(vdb, ms, node_batch=2, pipeline_depth=1, **kw)
+    snaps = []
+    eng.mine(checkpoint_cb=snaps.append, checkpoint_every_s=0.0)
+    assert len(snaps) >= 3
+    mid_i = len(snaps) // 2
+    merged = []
+    for s in snaps[:mid_i + 1]:
+        merged.extend(s["results"])
+    mid = dict(snaps[mid_i])
+    mid["results"] = merged
+    return mid
+
+
+def test_spam_checkpoint_resume_parity():
+    db = _db_mid()
+    ms = abs_minsup(0.1, len(db))
+    vdb = build_vertical(db, min_item_support=ms)
+    want = patterns_text(mine_spade(db, ms))
+    mid = _mid_snapshot(SpamBitmapTPU, vdb, ms)
+    eng = SpamBitmapTPU(vdb, ms)
+    assert patterns_text(eng.mine(resume=mid)) == want
+    assert eng.stats["resumed_nodes"] > 0
+
+
+def test_spam_checkpoint_cross_engine_resume_both_ways():
+    """The shared-frontier-format invariant: a SPAM snapshot resumes
+    under the classic SPADE engine and vice versa — identical
+    fingerprints, identical node shape, identical final bytes."""
+    from spark_fsm_tpu.models.spade_tpu import SpadeTPU
+
+    db = _db_mid()
+    ms = abs_minsup(0.1, len(db))
+    vdb = build_vertical(db, min_item_support=ms)
+    want = patterns_text(mine_spade(db, ms))
+
+    spam_mid = _mid_snapshot(SpamBitmapTPU, vdb, ms)
+    assert patterns_text(SpadeTPU(vdb, ms).mine(resume=spam_mid)) == want
+
+    spade_mid = _mid_snapshot(SpadeTPU, vdb, ms)
+    assert patterns_text(
+        SpamBitmapTPU(vdb, ms).mine(resume=spade_mid)) == want
+
+
+def test_spam_stale_fingerprint_refused():
+    db = _db_mid()
+    ms = abs_minsup(0.1, len(db))
+    vdb = build_vertical(db, min_item_support=ms)
+    mid = _mid_snapshot(SpamBitmapTPU, vdb, ms)
+    other = SpamBitmapTPU(vdb, ms + 1)
+    with pytest.raises(ValueError, match="does not match"):
+        other.mine(resume=mid)
+
+
+# ------------------------------------------------------------------ geometry
+
+
+def test_spam_geometry_bounds():
+    g = spam_geometry(1000, 10, 1, node_batch=64,
+                      pool_bytes=32 << 20)
+    assert g["ni_pad"] % 64 == 0 and g["ni_pad"] >= 10
+    assert g["node_batch"] >= 1
+    assert g["total_rows"] == g["ni_pad"] + g["pool_slots"] + 1
+    assert g["scratch"] == g["ni_pad"] + g["pool_slots"]
+    # the wave-intermediate bound: 2*nb*tile rows of per-device slot
+    # bytes fit in a quarter of the budget per in-flight wave
+    spd = g["n_seq"] * 4
+    assert (2 * g["node_batch"] * g["tile"] * spd
+            * g["pipeline_depth"]) <= (32 << 20)
+
+
+def test_spam_service_engine_kwargs_route():
+    """The plugin route honors [engine] pool_bytes/node_batch and sheds
+    constraints with a clear error."""
+    from spark_fsm_tpu.service import plugins
+    from spark_fsm_tpu.service.model import ServiceRequest
+
+    db = _db_small()
+    req = ServiceRequest("fsm", "train", {
+        "algorithm": "SPAM_TPU", "support": "0.1"})
+    stats = {}
+    got = plugins.get_plugin(req).extract(req, db, stats)
+    assert patterns_text(got) == patterns_text(
+        mine_spade(db, abs_minsup(0.1, len(db))))
+    assert stats["engine"] == "spam"
+
+    bad = ServiceRequest("fsm", "train", {
+        "algorithm": "SPAM_TPU", "support": "0.1", "maxgap": "1"})
+    with pytest.raises(ValueError, match="maxgap"):
+        plugins.get_plugin(bad).extract(bad, db, {})
