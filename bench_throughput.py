@@ -103,6 +103,22 @@ ZIPF_COMPARED = ("zipf_jobs", "zipf_parity", "zipf_hit_ratio_ok",
 ENGINES_COMPARED = ("engines_jobs", "engines_parity", "engines_auto_ok",
                     "engines_failures", "engines_sheds")
 
+# --mix hybrid (ISSUE 16): the density-adaptive vertical store's success
+# metric — the SAME mixed-density SPAM flood run three times with the
+# planner's per-item representation routing pinned differently
+# ([planner] representation = auto | bitmap | idlist) at a crossover
+# that actually splits the alphabet.  Structural guards: byte-exact
+# per-dataset parity across ALL THREE representation modes (the dEclat
+# identity sup = parent - |diffset| and the id-list join are exact, not
+# approximate), the auto flood genuinely ran a HYBRID store (rep_dense
+# > 0 AND rep_idlist > 0, with diffset nodes + pair launches observed)
+# while the pins ran uniform stores, zero sheds/failures.  Walls
+# (jobs/s per mode, hybrid-vs-best-fixed ratio) are reported next to
+# the guards, never compared — CPU walls on a shared box say nothing
+# about the TPU writeback the fused prune kernel saves.
+HYBRID_COMPARED = ("hybrid_jobs", "hybrid_parity", "hybrid_store_ok",
+                   "hybrid_failures", "hybrid_sheds")
+
 N_JOBS = int(os.environ.get("SPARKFSM_TP_JOBS", "48"))
 N_WORKERS = int(os.environ.get("SPARKFSM_TP_WORKERS", "8"))
 N_RUNS = int(os.environ.get("SPARKFSM_TP_RUNS", "3"))
@@ -502,7 +518,11 @@ def _engines_flood(plan, workers, label):
         for uid, db_key in meta.items():
             stats = _json.loads(store.get(f"fsm:stats:{uid}") or "{}")
             rows[uid] = (db_key, store.patterns(uid),
-                         stats.get("planner_engine"))
+                         stats.get("planner_engine"),
+                         {k: stats.get(k) for k in
+                          ("representation", "rep_dense", "rep_idlist",
+                           "diffset_nodes", "pair_launches",
+                           "wave_survivors", "waves")})
         lats = sorted(done[u][0] - t_submit[u] for u in done)
         q = lambda p: lats[min(len(lats) - 1, int(p * (len(lats) - 1)))]
         summary = {"jobs": len(done), "wall_s": round(wall, 3),
@@ -574,14 +594,14 @@ def main_engines(update: bool, n_jobs: int, workers: int) -> int:
     # parity: one byte-exact pattern set per dataset key across EVERY
     # engine route (explicit SPADE, explicit SPAM, AUTO both ways)
     by_key = {}
-    for db_key, pats, _ in rows_all.values():
+    for db_key, pats, _, _ in rows_all.values():
         by_key.setdefault(db_key, set()).add(pats)
     parity = all(len(v) == 1 for v in by_key.values())
 
     # AUTO routing: dense keys -> SPAM_TPU, sparse keys -> SPADE_TPU
     # ("AUTO never picks SPAM below the calibrated density crossover")
     routed = {"dense": set(), "sparse": set()}
-    for db_key, _, eng in auto_rows.values():
+    for db_key, _, eng, _ in auto_rows.values():
         routed["dense" if db_key.startswith("d") else "sparse"].add(eng)
     auto_ok = (routed["dense"] == {"SPAM_TPU"}
                and routed["sparse"] == {"SPADE_TPU"})
@@ -630,6 +650,145 @@ def main_engines(update: bool, n_jobs: int, workers: int) -> int:
           f"{per_engine['SPADE_TPU']['jobs_per_sec']} jobs/s "
           f"({out['engines']['spam_speedup_dense']}x); AUTO routed "
           f"dense->SPAM_TPU, sparse->SPADE_TPU with byte parity — "
+          f"walls reported, guards structural)")
+    return 0
+
+
+HYBRID_JOBS = int(os.environ.get("SPARKFSM_TP_HYB_JOBS", "24"))
+# the crossover the whole mix runs at: high enough that the zipf tail
+# of _hybrid_datasets lands below it (id-lists) while the hot head
+# stays above (bitmaps).  All three modes share it so the comparison
+# is representation-only.
+HYBRID_CROSSOVER = 0.5
+
+
+def _hybrid_datasets():
+    """Mixed-density pool: a steep zipf alphabet gives each DB a few
+    ~full-density head items and a long sub-crossover tail — the shape
+    the hybrid store exists for (uniform pins waste pool rows on the
+    tail or wave lanes on the head)."""
+    from spark_fsm_tpu.data.synth import synthetic_db
+
+    return [synthetic_db(seed=400 + i, n_sequences=90, n_items=24,
+                         mean_itemsets=4.0, mean_itemset_size=1.3,
+                         zipf_s=2.2)
+            for i in range(4)]
+
+
+def main_hybrid(update: bool, n_jobs: int, workers: int) -> int:
+    """--mix hybrid: the ISSUE 16 density-adaptive store metric."""
+    from spark_fsm_tpu import config as C
+    from spark_fsm_tpu.ops import ragged_batch as RB
+    from spark_fsm_tpu.utils import jitcache
+
+    RB.set_overhead_calibration(False)
+    jitcache.enable_compile_counter()
+    dbs = _hybrid_datasets()
+    plan = [("SPAM_TPU", f"m{i % len(dbs)}", dbs[i % len(dbs)], "0.08")
+            for i in range(n_jobs)]
+
+    def set_planner(rep):
+        # process-global planner pin, exactly the operator knob
+        # ([planner] representation) docs/OPERATIONS.md describes —
+        # the flood exercises the deployed path, not a bench backdoor
+        C.set_config(C.parse_config({"planner": {
+            "representation": rep,
+            "density_crossover": HYBRID_CROSSOVER}}))
+
+    def med(runs, field="jobs_per_sec"):
+        vals = sorted(r[field] for r in runs)
+        return vals[len(vals) // 2]
+
+    rows_all, per_mode, mode_stats = {}, {}, {}
+    sheds = failures = 0
+    try:
+        for rep in ("auto", "bitmap", "idlist"):
+            set_planner(rep)
+            for i in range(6):  # compile-warm this mode to stability
+                before = jitcache.compile_counts()["count"]
+                _engines_flood(plan, workers, f"w-{rep}-{i}")
+                if jitcache.compile_counts()["count"] == before:
+                    break
+            runs = []
+            for i in range(N_RUNS):
+                rows, s = _engines_flood(plan, workers, f"{rep}-{i}")
+                rows_all.update(rows)
+                if rep not in mode_stats:
+                    mode_stats[rep] = next(iter(rows.values()))[3]
+                runs.append(s)
+                sheds += s["sheds"]; failures += s["failures"]
+            per_mode[rep] = {
+                "jobs_per_sec": med(runs),
+                "p99_s": med(runs, "p99_s"),
+                "runs_jobs_per_sec": [r["jobs_per_sec"] for r in runs]}
+    finally:
+        C.set_config(C.parse_config({}))  # restore process defaults
+
+    # parity: one byte-exact pattern set per dataset across ALL THREE
+    # representation modes — the store is a layout choice, never a
+    # result choice
+    by_key = {}
+    for db_key, pats, _, _ in rows_all.values():
+        by_key.setdefault(db_key, set()).add(pats)
+    parity = all(len(v) == 1 for v in by_key.values())
+
+    # the auto flood must have run a genuinely HYBRID store (both
+    # representations live in one mine, diffsets + pair launches
+    # observed) while each pin ran uniform
+    au, bm, il = (mode_stats.get(k, {}) for k in
+                  ("auto", "bitmap", "idlist"))
+    store_ok = bool(
+        (au.get("rep_dense") or 0) > 0 and (au.get("rep_idlist") or 0) > 0
+        and (au.get("pair_launches") or 0) > 0
+        and (au.get("diffset_nodes") or 0) > 0
+        and (bm.get("rep_idlist") or 0) == 0
+        and (il.get("rep_dense") or 0) == 0)
+
+    best_fixed = max(per_mode["bitmap"]["jobs_per_sec"],
+                     per_mode["idlist"]["jobs_per_sec"])
+    out = {
+        "hybrid_jobs": n_jobs, "workers": workers,
+        "hybrid_parity": parity,
+        "hybrid_store_ok": store_ok,
+        "hybrid_failures": failures,
+        "hybrid_sheds": sheds,
+        "hybrid": {
+            **per_mode,
+            "crossover": HYBRID_CROSSOVER,
+            "auto_stats": au,
+            "speedup_vs_best_fixed": round(
+                per_mode["auto"]["jobs_per_sec"] / max(1e-9, best_fixed),
+                2)},
+    }
+    print(json.dumps(out, indent=2))
+
+    try:
+        with open(EXPECT_PATH) as fh:
+            expect = json.load(fh)
+    except OSError:
+        expect = {}
+    if update:
+        expect.update({k: out[k] for k in HYBRID_COMPARED})
+        with open(EXPECT_PATH, "w") as fh:
+            json.dump(expect, fh, indent=2)
+            fh.write("\n")
+        print(f"bench_throughput: hybrid expectations written -> "
+              f"{EXPECT_PATH}")
+        return 0
+    bad = [k for k in HYBRID_COMPARED if out.get(k) != expect.get(k)]
+    if bad:
+        for k in bad:
+            print(f"bench_throughput[hybrid]: MISMATCH {k}: got "
+                  f"{out.get(k)!r}, expected {expect.get(k)!r}",
+                  file=sys.stderr)
+        return 1
+    print(f"bench_throughput[hybrid]: OK (mixed-density flood: hybrid "
+          f"{per_mode['auto']['jobs_per_sec']} jobs/s vs best fixed "
+          f"{best_fixed} jobs/s "
+          f"({out['hybrid']['speedup_vs_best_fixed']}x); byte parity "
+          f"across auto/bitmap/idlist; auto store split "
+          f"{au.get('rep_dense')} dense / {au.get('rep_idlist')} "
+          f"id-list with {au.get('diffset_nodes')} diffset nodes — "
           f"walls reported, guards structural)")
     return 0
 
@@ -880,9 +1039,9 @@ def main() -> int:
     mix = None
     if "--mix" in args:
         mix = args[args.index("--mix") + 1]
-        if mix not in ("zipf", "tenants", "engines"):
+        if mix not in ("zipf", "tenants", "engines", "hybrid"):
             sys.exit(f"unknown --mix {mix!r} "
-                     f"(have: zipf, tenants, engines)")
+                     f"(have: zipf, tenants, engines, hybrid)")
     n_jobs, workers = N_JOBS, N_WORKERS
     if "--jobs" in args:
         n_jobs = int(args[args.index("--jobs") + 1])
@@ -900,6 +1059,11 @@ def main() -> int:
         return main_engines(
             update,
             ENGINES_JOBS if "--jobs" not in args else n_jobs,
+            workers)
+    if mix == "hybrid":
+        return main_hybrid(
+            update,
+            HYBRID_JOBS if "--jobs" not in args else n_jobs,
             workers)
 
     from spark_fsm_tpu import config as cfgmod

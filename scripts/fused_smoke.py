@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Fused extension-count-prune + hybrid vertical store smoke (ISSUE 16).
+
+Seconds-scale CI proof of the density-adaptive store and the fused
+kernel's CPU (jnp) reference semantics:
+
+- the fused reference (``pallas_extend.extend_count_prune_jnp``)
+  against an independent numpy oracle: supports are EXACT where
+  >= thr and EXACTLY 0 below it (dying candidates never carry a
+  count), the packed survivor mask is bit-for-bit ``sup >= thr``
+  (LSB-first, tail bits zero), and the dEclat diffset spelling is
+  byte-identical to the direct count (exact identity, per row);
+- the production wave wrapper (``spam_bitops.wave_extend_prune_fn``)
+  jnp path vs the Pallas kernel in interpret mode: byte-identical
+  (sup AND mask) on the same inputs with mixed per-row diffset flags;
+- end-to-end hybrid parity: a mixed-density miniature mined with the
+  planner's auto routing, the bitmap pin, the id-list pin, the Pallas
+  wave path and the CPU engine (with and without diffsets) — every
+  variant byte-identical to the SPADE oracle, and the auto mine's
+  stats prove a genuinely HYBRID store ran (dense + id-list items in
+  one mine, diffset nodes and pair launches observed).
+
+Usage: scripts/fused_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.models.spam_bitmap import (mine_spam_cpu,
+                                                  mine_spam_tpu)
+    from spark_fsm_tpu.ops import pallas_extend as PE
+    from spark_fsm_tpu.ops import spam_bitops as SB
+    from spark_fsm_tpu.utils.canonical import patterns_text
+
+    failures = []
+    rng = np.random.default_rng(0)
+
+    # ---- 1. fused jnp reference vs an independent numpy oracle ------
+    P, NI, S, W, thr = 10, 40, 12, 2, 5
+    # sparse item rows spread supports across [0, S] so lanes straddle
+    # the threshold in both directions
+    q = np.linspace(0.05, 0.9, NI)
+    p3 = rng.integers(0, 2**32, (P, S, W), dtype=np.uint32)
+    p3 *= (rng.random((P, S, W)) < 0.6).astype(np.uint32)
+    items3 = rng.integers(0, 2**32, (NI, S, W), dtype=np.uint32)
+    items3 *= (rng.random((NI, S, W)) < q[:, None, None]).astype(np.uint32)
+
+    joined = p3[:, None] & items3[None]                  # [P, NI, S, W]
+    sup_full = (joined != 0).any(-1).sum(-1).astype(np.int32)
+
+    ud = rng.random(P) < 0.5
+    sup, mask = PE.extend_count_prune_jnp(
+        jnp.asarray(p3), jnp.asarray(items3), thr, jnp.asarray(ud))
+    sup, mask = np.asarray(sup), np.asarray(mask)
+    above = sup_full >= thr
+    if not np.array_equal(sup[above], sup_full[above]):
+        failures.append("fused sup not exact above thr")
+    if np.any(sup[~above] != 0):
+        failures.append("sub-threshold lanes carried a nonzero count")
+    bit = (mask[:, np.arange(NI) // 32]
+           >> (np.arange(NI) % 32).astype(np.uint32)) & 1
+    if not np.array_equal(bit.astype(bool), above):
+        failures.append("survivor mask != (sup >= thr) bit-for-bit")
+    tail = mask[:, -1] >> (NI % 32 or 32)
+    if NI % 32 and np.any(tail):
+        failures.append("mask tail bits beyond NI not zero")
+    for flag in (False, True):   # diffset spelling: exact identity
+        s2, m2 = PE.extend_count_prune_jnp(
+            jnp.asarray(p3), jnp.asarray(items3), thr,
+            jnp.full(P, flag))
+        if not (np.array_equal(np.asarray(s2), sup)
+                and np.array_equal(np.asarray(m2), mask)):
+            failures.append(f"diffset identity broken (use_diff={flag})")
+
+    # ---- 2. production wave wrapper: jnp path vs Pallas interpret ---
+    nd_pad, Sw, Bn = 64, 16, 3
+    pt = rng.integers(0, 2**32, (2 * Bn, Sw), dtype=np.uint32)
+    store = rng.integers(0, 2**32, (nd_pad, Sw), dtype=np.uint32)
+    store *= (rng.random((nd_pad, Sw)) < 0.3).astype(np.uint32)
+    ud2 = rng.random(2 * Bn) < 0.5
+    thr2 = jnp.int32(4)
+    f_jnp = SB.wave_extend_prune_fn(None, 1, nd_pad, use_pallas=False)
+    f_pal = SB.wave_extend_prune_fn(None, 1, nd_pad, use_pallas=True,
+                                    s_block=Sw, interpret=True)
+    a = f_jnp(jnp.asarray(pt), jnp.asarray(store), thr2, jnp.asarray(ud2))
+    b = f_pal(jnp.asarray(pt), jnp.asarray(store), thr2, jnp.asarray(ud2))
+    if not (np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+            and np.array_equal(np.asarray(a[1]), np.asarray(b[1]))):
+        failures.append("wave wrapper: jnp vs Pallas-interpret diverged")
+
+    # ---- 3. end-to-end hybrid parity on a mixed-density miniature ---
+    db = synthetic_db(seed=401, n_sequences=90, n_items=24,
+                      mean_itemsets=4.0, mean_itemset_size=1.3,
+                      zipf_s=2.2)
+    minsup = max(1, round(0.08 * len(db)))
+    want = patterns_text(mine_spade(db, minsup))
+    auto_stats = {}
+    variants = [
+        ("tpu-auto", lambda s: mine_spam_tpu(
+            db, minsup, stats_out=s, density_crossover=0.5)),
+        ("tpu-bitmap", lambda s: mine_spam_tpu(
+            db, minsup, stats_out=s, representation="bitmap")),
+        ("tpu-idlist", lambda s: mine_spam_tpu(
+            db, minsup, stats_out=s, representation="idlist")),
+        ("tpu-pallas", lambda s: mine_spam_tpu(
+            db, minsup, stats_out=s, density_crossover=0.5,
+            use_pallas=True)),
+        ("cpu-auto", lambda s: mine_spam_cpu(
+            db, minsup, stats_out=s, density_crossover=0.5)),
+        ("cpu-nodiff", lambda s: mine_spam_cpu(
+            db, minsup, stats_out=s, density_crossover=0.5,
+            diffset_depth=0)),
+    ]
+    for name, run in variants:
+        stats = {}
+        got = patterns_text(run(stats))
+        if got != want:
+            failures.append(f"{name}: NOT byte-identical to oracle")
+        if name == "tpu-auto":
+            auto_stats = stats
+
+    if not (auto_stats.get("rep_dense", 0) > 0
+            and auto_stats.get("rep_idlist", 0) > 0):
+        failures.append(f"auto mine was not hybrid: {auto_stats}")
+    if not auto_stats.get("diffset_nodes", 0) > 0:
+        failures.append(f"no diffset nodes observed: {auto_stats}")
+    if not auto_stats.get("pair_launches", 0) > 0:
+        failures.append(f"no sparse pair launches observed: {auto_stats}")
+
+    if failures:
+        print("fused_smoke: FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print(f"fused_smoke: OK (fused jnp reference exact vs numpy oracle "
+          f"with zeroed sub-threshold lanes + bit-exact survivor mask; "
+          f"Pallas-interpret byte parity; hybrid mine "
+          f"{auto_stats.get('rep_dense')} dense / "
+          f"{auto_stats.get('rep_idlist')} id-list items, "
+          f"{auto_stats.get('diffset_nodes')} diffset nodes, "
+          f"{auto_stats.get('pair_launches')} pair launches, all "
+          f"byte-identical to the SPADE oracle)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

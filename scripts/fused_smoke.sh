@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Fused extension-count-prune + hybrid store smoke — seconds-scale
+# proof that the fused kernel's CPU (jnp) reference is exact vs a numpy
+# oracle (zeroed sub-threshold lanes, bit-exact survivor mask, dEclat
+# diffset identity), that the Pallas kernel matches it byte-for-byte in
+# interpret mode, and that every representation routing of a
+# mixed-density mine is byte-identical to the SPADE oracle.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/fused_smoke.py "$@"

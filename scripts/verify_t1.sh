@@ -38,6 +38,11 @@
 #                        AND a sparse miniature + AUTO planner routing
 #                        drill (never SPAM below the crossover) +
 #                        structured 400 + fsm_engine_selected_total
+#   fused_smoke.sh       fused extension-count-prune reference vs
+#                        numpy oracle (zeroed sub-threshold lanes,
+#                        bit-exact survivor mask, diffset identity) +
+#                        Pallas-interpret byte parity + hybrid-store
+#                        mine parity across every representation pin
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -50,7 +55,7 @@ if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
              throughput_smoke resident_smoke partition_smoke \
              replica_smoke rescache_smoke autoscale_smoke \
-             storm_smoke fleet_smoke spam_smoke; do
+             storm_smoke fleet_smoke spam_smoke fused_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

@@ -188,6 +188,16 @@ File format (TOML shown; JSON with the same nesting also accepted):
                                     # calibrated — docs/DESIGN.md)
     max_alphabet = 512              # SPAM eligibility ceiling on the
                                     # frequent-alphabet width
+    representation = "auto"         # per-ITEM vertical store within a
+                                    # mine: "auto" = density crossover
+                                    # picks bitmap (dense) vs id-list
+                                    # (sparse) per item; "bitmap"/
+                                    # "idlist" pin a uniform store
+                                    # (debugging/bench lever)
+    diffset_depth = 3               # pattern length at which supports
+                                    # switch to the dEclat diffset
+                                    # formulation (parent_support -
+                                    # |diffset|); 0 disables
 
     [prewarm]
     enabled = true                  # AOT-compile the declared envelope at boot
@@ -524,6 +534,14 @@ class PlannerConfig:
     pinned: str = "SPADE_TPU"
     density_crossover: float = 0.02
     max_alphabet: int = 512
+    # per-item representation routing WITHIN a mine (ISSUE 16): the same
+    # crossover that routes the engine routes each item to a dense SPAM
+    # bitmap row or a SPADE id-list; "bitmap"/"idlist" pin a uniform
+    # store (the debugging/bench fixed-representation modes)
+    representation: str = "auto"
+    # pattern length at which the engines switch to the dEclat diffset
+    # support formulation (byte-identical by construction; 0 disables)
+    diffset_depth: int = 3
 
 
 @dataclasses.dataclass
@@ -792,6 +810,13 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("planner.density_crossover must be in [0, 1]")
     if cfg.planner.max_alphabet < 1:
         raise ConfigError("planner.max_alphabet must be >= 1")
+    if cfg.planner.representation not in ("auto", "bitmap", "idlist"):
+        raise ConfigError(
+            f"planner.representation must be 'auto', 'bitmap' or "
+            f"'idlist', got {cfg.planner.representation!r}")
+    if cfg.planner.diffset_depth < 0:
+        raise ConfigError(
+            "planner.diffset_depth must be >= 0 (0 disables diffsets)")
     return cfg
 
 

@@ -85,6 +85,120 @@ class VerticalDB:
     def nbytes(self) -> int:
         return self.n_items * self._n_seq * self._n_words * 4
 
+    # ---------------------------------------------------- id-list view
+    # The token table is item-major (build_vertical sorts by
+    # (item, seq, pos) via the np.unique dedup key), so each item's
+    # SPADE-style id-list is a contiguous slice — the sparse half of
+    # the hybrid vertical store reads these slices instead of ever
+    # materializing the item's dense bitmap row.
+
+    @property
+    def _tok_ptr(self) -> np.ndarray:
+        """[n_items + 1] row pointer into the item-major token table."""
+        ptr = getattr(self, "_tok_ptr_cache", None)
+        if ptr is None:
+            ptr = np.searchsorted(
+                self.tok_item, np.arange(self.n_items + 1, dtype=np.int64))
+            self._tok_ptr_cache = ptr
+        return ptr
+
+    def idlist(self, i: int):
+        """Item ``i``'s id-list: (tok_seq, tok_word, tok_mask) slices,
+        one entry per (sequence, position) occurrence."""
+        ptr = self._tok_ptr
+        lo, hi = int(ptr[i]), int(ptr[i + 1])
+        return self.tok_seq[lo:hi], self.tok_word[lo:hi], self.tok_mask[lo:hi]
+
+    def idlist_lengths(self) -> np.ndarray:
+        """[n_items] int64 token count per item (id-list sizes)."""
+        return np.diff(self._tok_ptr)
+
+
+def idlist_join_support(prefix_bitmap: np.ndarray, tok_seq: np.ndarray,
+                        tok_word: np.ndarray, tok_mask: np.ndarray) -> int:
+    """Support of ``prefix AND item`` evaluated AGAINST THE ID-LIST —
+    the sparse-representation join: a token survives iff the prefix
+    bitmap (pass the plain row for an i-extension, the
+    ``sext_transform``-ed row for an s-extension) has its bit set, and
+    the support is the count of distinct sequences with any survivor.
+    Byte-identical to ``support(prefix & bitmaps[i])`` by construction
+    (pinned in tests/test_vertical.py) without touching the
+    ``n_seq * n_words`` dense row — work scales with the item's token
+    count, which is what makes the id-list side of the density
+    crossover win on sparse items."""
+    hit = (prefix_bitmap[tok_seq, tok_word] & tok_mask) != 0
+    return int(np.unique(tok_seq[hit]).size)
+
+
+# ---------------------------------------------------------------------------
+# Per-item representation plan (the hybrid vertical store's routing table)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RepPlan:
+    """Per-item vertical-representation choice for one mine.
+
+    ``rep[i]`` True holds item ``i`` as a dense SPAM bitmap row (wave
+    lane); False holds it as a SPADE id-list (sparse pair-path lane).
+    Built by :func:`rep_plan` from per-item densities against the
+    planner's calibrated crossover; ``pin`` records whether the split
+    was density-routed ("auto") or operator-pinned ("bitmap"/"idlist"
+    force a uniform store — the debugging/bench fixed-representation
+    modes).  Result bytes are representation-invariant: the plan only
+    picks which evaluation path computes each (identical) support."""
+
+    rep: np.ndarray          # [n_items] bool, True = dense bitmap
+    densities: np.ndarray    # [n_items] float64 item support / n_seq
+    crossover: float
+    pin: str                 # "auto" | "bitmap" | "idlist"
+
+    @property
+    def n_dense(self) -> int:
+        return int(np.count_nonzero(self.rep))
+
+    @property
+    def n_sparse(self) -> int:
+        return int(self.rep.size) - self.n_dense
+
+    @property
+    def hybrid(self) -> bool:
+        return self.n_sparse > 0
+
+    def as_attrs(self) -> dict:
+        """Flat numeric/str summary for the planner trace span."""
+        d = self.densities
+        return {
+            "representation": self.pin,
+            "density_crossover": round(float(self.crossover), 6),
+            "dense_items": self.n_dense,
+            "idlist_items": self.n_sparse,
+            "min_item_density": round(float(d.min()), 6) if d.size else 0.0,
+            "max_item_density": round(float(d.max()), 6) if d.size else 0.0,
+        }
+
+
+def rep_plan(item_supports: np.ndarray, n_sequences: int, *,
+             crossover: float, pin: str = "auto") -> RepPlan:
+    """Pick a vertical representation PER ITEM: density (the item's
+    sequence-support over the sequence axis — exactly the fill fraction
+    of its dense bitmap row and the per-item spelling of
+    ``DatasetStats.density``) at or above the crossover routes to the
+    SPAM bitmap, below it to the SPADE id-list.  ``pin`` forces a
+    uniform store for debugging/benches."""
+    sup = np.asarray(item_supports, dtype=np.int64)
+    d = sup / float(max(1, int(n_sequences)))
+    if pin == "bitmap":
+        rep = np.ones(sup.shape, dtype=bool)
+    elif pin == "idlist":
+        rep = np.zeros(sup.shape, dtype=bool)
+    elif pin == "auto":
+        rep = d >= float(crossover)
+    else:
+        raise ValueError(
+            f"representation must be auto|bitmap|idlist, got {pin!r}")
+    return RepPlan(rep=rep, densities=d, crossover=float(crossover), pin=pin)
+
 
 def build_vertical(
     db: SequenceDB,

@@ -50,6 +50,7 @@ import time
 from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 from jax.sharding import Mesh
 
@@ -73,16 +74,21 @@ def spam_geometry(n_sequences: int, n_items: int, n_words: int, *,
                   pipeline_depth: int = 2,
                   pool_bytes: Optional[int] = None,
                   shape_buckets: bool = False,
+                  use_pallas: bool = False,
                   tile: int = SB.ITEM_TILE) -> dict:
     """Derived device geometry — the one sizing routine shared by the
     constructor and the shape-key record, same contract as
     ``classic_geometry``.  The extra constraint vs the classic engine:
     each in-flight wave holds a ``[2*nb, tile, S, W]`` AND intermediate,
     so the node batch is bounded by the pool budget divided by that
-    live tile footprint, not only by slot arithmetic."""
+    live tile footprint, not only by slot arithmetic.  ``use_pallas``
+    follows the classic engine's precedent: the fused kernel's sequence
+    grid wants the per-shard axis padded to a whole number of s_blocks,
+    so the geometry (and shape key) shift only when the kernel path is
+    actually enabled."""
     n_shards = 1 if mesh is None else mesh.devices.size
     n_seq, s_block, _ = device_axes(
-        n_sequences, n_items, n_words, mesh=mesh, use_pallas=False,
+        n_sequences, n_items, n_words, mesh=mesh, use_pallas=use_pallas,
         shape_buckets=shape_buckets)
     if pool_bytes is None:
         pool_bytes = auto_pool_bytes(mesh)
@@ -109,6 +115,10 @@ def spam_geometry(n_sequences: int, n_items: int, n_words: int, *,
         "n_seq": n_seq, "s_block": s_block, "ni_pad": ni_pad, "tile": tile,
         "node_batch": nb, "pipeline_depth": d, "pool_slots": pool_slots,
         "total_rows": total, "scratch": ni_pad + pool_slots,
+        # sparse-candidate pair-launch chunk width (hybrid store): same
+        # pow2 ladder as the materialize chunk so the shape registry's
+        # spam-pair enumeration can mirror it exactly
+        "chunk": min(2048, max(64, next_pow2(2 * nb))),
         "shape_key": shapes.key_spam(n_seq, n_words, total, nb, ni_pad),
     }
 
@@ -137,8 +147,13 @@ class SpamBitmapTPU:
         max_pattern_itemsets: Optional[int] = None,
         shape_buckets: bool = False,
         partition=None,
+        representation: Optional[str] = None,
+        density_crossover: Optional[float] = None,
+        diffset_depth: Optional[int] = None,
+        use_pallas="auto",
     ):
         from spark_fsm_tpu.models.spade_tpu import _spade_fns
+        from spark_fsm_tpu.service import planner
 
         self.vdb = vdb
         self.minsup = int(minsup_abs)
@@ -154,10 +169,30 @@ class SpamBitmapTPU:
         self._shape_buckets = bool(shape_buckets)
 
         n_items, n_seq, n_words = vdb.n_items, vdb.n_sequences, vdb.n_words
+        # per-item representation plan (ISSUE 16): the planner's density
+        # crossover splits the item axis into dense (wave lanes) and
+        # sparse (pair-launch) halves, and picks the depth at which
+        # supports flip to the dEclat diffset formulation; the call
+        # lands the explaining planner.representation trace record
+        self.rep_plan, self.diffset_depth = planner.choose_representation(
+            vdb.item_supports, n_seq, pin=representation,
+            crossover=density_crossover, diffset_depth=diffset_depth,
+            engine="spam")
+        self._hybrid = self.rep_plan.n_sparse > 0
+        # same resolution idiom as SpadeTPU: "auto" means the kernel is
+        # only worth compiling on real TPU backends; interpret mode makes
+        # explicit use_pallas=True testable on CPU
+        eligible = n_items > 0
+        if use_pallas == "auto":
+            self.use_pallas = eligible and jax.default_backend() == "tpu"
+        else:
+            self.use_pallas = bool(use_pallas) and eligible
+        self._pallas_interpret = jax.default_backend() != "tpu"
+
         g = spam_geometry(
             n_seq, n_items, n_words, mesh=mesh, node_batch=node_batch,
             pipeline_depth=pipeline_depth, pool_bytes=pool_bytes,
-            shape_buckets=self._shape_buckets)
+            shape_buckets=self._shape_buckets, use_pallas=self.use_pallas)
         n_seq = g["n_seq"]
         self.n_items, self.n_seq, self.n_words = n_items, n_seq, n_words
         self.ni_pad = g["ni_pad"]
@@ -181,19 +216,60 @@ class SpamBitmapTPU:
         self._prep_fn = fns["prep"]
         self._materialize_fn = fns["materialize"]
         self._recompute_fn = fns["recompute"]
-        self._wave_fn = SB.wave_supports_fn(mesh, n_words, self.ni_pad,
-                                            g["tile"])
-        # materialize width: fixed-shape chunks like the classic engine
-        self.chunk = min(2048, max(64, next_pow2(2 * self.node_batch)))
 
+        # hybrid item split: dense items buy wave lanes in a compact
+        # gathered block (the wave's item axis shrinks from ni_pad to
+        # nd_pad); sparse items ride explicit pair launches instead.
+        # On the pure-bitmap plan the wave runs over the store itself
+        # and nd_pad == ni_pad — byte- and launch-identical geometry to
+        # the unfused engine.
+        rep = self.rep_plan.rep
+        dense_idx = np.flatnonzero(rep[:n_items])
+        self.n_dense = int(dense_idx.size)
+        self._dense_col = np.full(max(n_items, 1), -1, np.int32)
+        self._dense_col[dense_idx] = np.arange(self.n_dense, dtype=np.int32)
+        if self._hybrid:
+            self.nd_pad = SB.pad_items(self.n_dense) if self.n_dense else 0
+        else:
+            self.nd_pad = self.ni_pad
+        if self._hybrid and self.n_dense:
+            rows = np.full(self.nd_pad, -1, np.int32)
+            rows[: self.n_dense] = dense_idx.astype(np.int32)
+            self._dense_items = SB.gather_rows_fn(mesh)(
+                self.store, self._put(rows))
+        else:
+            self._dense_items = None  # wave (if any) runs over the store
+        self._wave_fn = (
+            SB.wave_extend_prune_fn(
+                mesh, n_words, self.nd_pad, g["tile"],
+                use_pallas=self.use_pallas, s_block=g["s_block"],
+                interpret=self._pallas_interpret)
+            if self.nd_pad else None)
+        self._pair_fn = SB.pair_prune_fn(mesh, n_words) if self._hybrid \
+            else None
+        # materialize + sparse pair-launch width: fixed-shape pow2
+        # chunks like the classic engine
+        self.chunk = g["chunk"]
+
+        if self._hybrid:
+            shape_key = shapes.key_spam_hybrid(
+                n_seq, n_words, total, self.node_batch, self.ni_pad,
+                self.nd_pad)
+        else:
+            shape_key = g["shape_key"]
         self.stats = {
             "engine": "spam",
             "candidates": 0, "evaluated_lanes": 0, "waves": 0,
             "kernel_launches": 0, "recomputed_nodes": 0,
             "reclaimed_slots": 0, "patterns": 0,
-            "shape_key": g["shape_key"],
+            "shape_key": shape_key,
+            "representation": self.rep_plan.pin,
+            "rep_dense": self.n_dense,
+            "rep_idlist": int(self.rep_plan.n_sparse),
+            "diffset_depth": int(self.diffset_depth),
+            "diffset_nodes": 0, "pair_launches": 0, "wave_survivors": 0,
         }
-        shapes.record(g["shape_key"])
+        shapes.record(shape_key)
 
     # ------------------------------------------------------------ slot mgmt
 
@@ -273,31 +349,99 @@ class SpamBitmapTPU:
         return tuple(tuple(s) for s in pat)
 
     def _dispatch(self, stack: List[_Node]):
-        """Pop a node batch and launch ONE fixed-shape wave pass for the
-        whole (nodes x items x {s,i}) grid; the async host copy starts
-        immediately.  Routed through the fusion broker's wave surface
-        for its accounting/fault posture (an armed ``fusion.dispatch``
-        fault degrades to a direct dispatch, never loses the wave)."""
+        """Pop a node batch and launch ONE fused extension-count-prune
+        wave for the whole (nodes x dense items x {s,i}) grid, plus (on
+        a hybrid plan) chunked pair launches for the sparse-item
+        candidates; the async host copies start immediately.  Routed
+        through the fusion broker's wave surface for its
+        accounting/fault posture (an armed ``fusion.dispatch`` fault
+        degrades to a direct dispatch, never loses the wave)."""
         from spark_fsm_tpu.service import fusion
 
         jobctl.check()  # launch-boundary safe point (cancel/deadline/fence)
         batch = [stack.pop() for _ in range(min(self.node_batch, len(stack)))]
         self._ensure_slots(batch, stack)
         prep = self._prep(batch)
-        sup_dev = fusion.dispatch_wave(
-            "spam", lambda: self._wave_fn(prep, self.store),
-            nodes=len(batch), items=self.ni_pad)
-        self.stats["kernel_launches"] += 1
-        self.stats["waves"] += 1
-        self.stats["evaluated_lanes"] += 2 * self.node_batch * self.ni_pad
+        thr_dev = self._put(np.int32(self.threshold))
+        # per-row dEclat flags: a node at or past the diffset depth has
+        # BOTH its interleaved rows (plain 2b, transformed 2b+1) count
+        # via support(parent) - |diffset| — exact identity, but the
+        # accounting matters for drift calibration and the trace
+        dd = self.diffset_depth
+        ud_rows = np.zeros(2 * self.node_batch, bool)
+        for b, node in enumerate(batch):
+            if dd and len(node.steps) >= dd:
+                ud_rows[2 * b] = ud_rows[2 * b + 1] = True
+                self.stats["diffset_nodes"] += 1
+        sup_dev = mask_dev = None
+        if self._wave_fn is not None:
+            items_arg = (self._dense_items if self._dense_items is not None
+                         else self.store)
+            sup_dev, mask_dev = fusion.dispatch_wave(
+                "spam",
+                lambda: self._wave_fn(prep, items_arg, thr_dev,
+                                      self._put(ud_rows)),
+                nodes=len(batch), items=self.nd_pad)
+            self.stats["kernel_launches"] += 1
+            self.stats["waves"] += 1
+            self.stats["evaluated_lanes"] += 2 * self.node_batch * self.nd_pad
+        # sparse half of the hybrid store: candidates whose item the
+        # planner kept as an id-list never bought a wave lane — pack
+        # them into fixed pow2-width pair launches (compiled once per
+        # width, recorded in the shape registry like ragged chunks)
+        pair_devs: List = []
+        pair_pos = {}
+        if self._hybrid:
+            pref_l: List[int] = []
+            item_l: List[int] = []
+            ud_l: List[bool] = []
+            for b, node in enumerate(batch):
+                node_ud = bool(dd and len(node.steps) >= dd)
+                if self._allow_s(node):
+                    for i in node.s_list:
+                        if self._dense_col[i] < 0:
+                            pair_pos[(2 * b + 1, i)] = len(pref_l)
+                            pref_l.append(2 * b + 1)
+                            item_l.append(i)
+                            ud_l.append(node_ud)
+                for i in node.i_list:
+                    if self._dense_col[i] < 0:
+                        pair_pos[(2 * b, i)] = len(pref_l)
+                        pref_l.append(2 * b)
+                        item_l.append(i)
+                        ud_l.append(node_ud)
+            c = self.chunk
+            for lo in range(0, len(pref_l), c):
+                hi = min(lo + c, len(pref_l))
+                w = max(64, next_pow2(hi - lo))
+                pref = np.zeros(w, np.int32)
+                pref[: hi - lo] = pref_l[lo:hi]
+                item = np.full(w, -1, np.int32)
+                item[: hi - lo] = item_l[lo:hi]
+                ud = np.zeros(w, bool)
+                ud[: hi - lo] = ud_l[lo:hi]
+                d = fusion.dispatch_wave(
+                    "spam",
+                    lambda p=pref, it=item, u=ud: self._pair_fn(
+                        prep, self.store, self._put(p), self._put(it),
+                        thr_dev, self._put(u)),
+                    nodes=len(batch), items=w)
+                shapes.record(shapes.key_spam_pair(self.n_seq, self.n_words,
+                                                   w))
+                self.stats["kernel_launches"] += 1
+                self.stats["pair_launches"] += 1
+                self.stats["evaluated_lanes"] += w
+                pair_devs.append(d)
         self.stats["candidates"] += sum(
             (len(n.s_list) if self._allow_s(n) else 0) + len(n.i_list)
             for n in batch)
-        try:
-            sup_dev.copy_to_host_async()
-        except (AttributeError, NotImplementedError):
-            pass
-        return batch, prep, sup_dev
+        for dev in ([sup_dev, mask_dev] if sup_dev is not None
+                    else []) + pair_devs:
+            try:
+                dev.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass
+        return batch, prep, sup_dev, mask_dev, pair_devs, pair_pos
 
     def _allow_s(self, node: _Node) -> bool:
         if self.max_pattern_itemsets is None:
@@ -307,8 +451,30 @@ class SpamBitmapTPU:
 
     def _resolve(self, inflight, stack: List[_Node],
                  results: List[PatternResult]) -> None:
-        batch, prep, sup_dev = inflight
-        sups = np.asarray(sup_dev)  # [2*nb, ni_pad]
+        batch, prep, sup_dev, mask_dev, pair_devs, pair_pos = inflight
+        sups = (np.asarray(sup_dev)  # [2*nb, nd_pad] dense-column lanes
+                if sup_dev is not None else None)
+        pair_sups = [np.asarray(d) for d in pair_devs]
+        if mask_dev is not None:
+            # survivor-mask accounting: the fused prune's packed alive
+            # bits over the LIVE node rows (pad rows carry slot-0
+            # garbage lanes the host never reads)
+            m = np.asarray(mask_dev)[: 2 * len(batch)]
+            self.stats["wave_survivors"] += int(BN.popcount(m).sum())
+        col = self._dense_col
+        c = self.chunk
+
+        def sup_at(r: int, i: int) -> int:
+            # fused-prune read contract: the value is the exact count
+            # where >= threshold and exactly 0 otherwise, so the host's
+            # >= thr comparison below is byte-identical to the unfused
+            # engine's
+            ci = col[i]
+            if ci >= 0:
+                return int(sups[r, ci])
+            gi = pair_pos[(r, i)]
+            return int(pair_sups[gi // c][gi % c])
+
         thr = self.threshold
         children: List[_Node] = []
         mat_ref: List[int] = []; mat_item: List[int] = []
@@ -318,12 +484,12 @@ class SpamBitmapTPU:
             n_itemsets = sum(1 for _, s in node.steps if s)
             # host-side lane read: only the lanes the candidate lists
             # name — pad lanes and non-candidate items are never read
-            s_items = ([i for i in node.s_list if sups[2 * b + 1, i] >= thr]
+            s_items = ([i for i in node.s_list if sup_at(2 * b + 1, i) >= thr]
                        if allow_s else [])
-            i_items = [i for i in node.i_list if sups[2 * b, i] >= thr]
+            i_items = [i for i in node.i_list if sup_at(2 * b, i) >= thr]
             for it, is_s in ([(i, True) for i in s_items]
                              + [(i, False) for i in i_items]):
-                sup = int(sups[2 * b + 1, it] if is_s else sups[2 * b, it])
+                sup = sup_at(2 * b + 1, it) if is_s else sup_at(2 * b, it)
                 steps = node.steps + ((it, is_s),)
                 results.append((self._pattern_of(steps), sup))
                 src = s_items if is_s else i_items
@@ -425,14 +591,30 @@ class SpamBitmapTPU:
 
 def mine_spam_cpu(db: SequenceDB, minsup_abs: int, *,
                   max_pattern_itemsets: Optional[int] = None,
-                  stats_out: Optional[dict] = None) -> List[PatternResult]:
+                  stats_out: Optional[dict] = None,
+                  representation: Optional[str] = None,
+                  density_crossover: Optional[float] = None,
+                  diffset_depth: Optional[int] = None) -> List[PatternResult]:
     """Host SPAM miner on the dense bitmaps with the same popcount
     support formulation (``bitops_np.support_popcount``) — the CPU leg
     of the SPAM plugin pair, byte-identical to ``oracle.mine_spade`` by
-    the shared enumeration."""
+    the shared enumeration.  Carries the same hybrid-representation
+    seams as the device engine (ISSUE 16): planner-routed per-item
+    bitmap/id-list split (sparse candidates count via
+    ``vertical.idlist_join_support``) and depth-selected dEclat diffset
+    supports — all three paths are exact, so results stay byte-identical
+    across any plan."""
+    from spark_fsm_tpu.data.vertical import idlist_join_support
+    from spark_fsm_tpu.service import planner
+
     vdb = build_vertical(db, min_item_support=minsup_abs)
     if vdb.n_items == 0:
         return []
+    plan, dd = planner.choose_representation(
+        vdb.item_supports, vdb.n_sequences, pin=representation,
+        crossover=density_crossover, diffset_depth=diffset_depth,
+        engine="spam-cpu")
+    rep = plan.rep
     bm = vdb.bitmaps  # [n_items, S, W]
     n_items = vdb.n_items
     results: List[PatternResult] = []
@@ -455,7 +637,30 @@ def mine_spam_cpu(db: SequenceDB, minsup_abs: int, *,
                         int(vdb.item_supports[i])))
         stack.append(((( i, True),), bm[i], root_items,
                       [j for j in root_items if j > i]))
-    waves = candidates = 0
+    waves = candidates = diffset_nodes = 0
+
+    def eval_cands(parent, cand, use_diff):
+        """support(parent AND bm[i]) per candidate via the plan's
+        per-item path: dense items as one bitmap block (direct popcount
+        or the dEclat ``support(parent) - |diffset|`` spelling), sparse
+        items via the id-list token join — three exact formulations of
+        the same count."""
+        sups = {}
+        dense = [i for i in cand if rep[i]]
+        if dense:
+            joined = parent[None] & bm[dense]           # [n, S, W]
+            if use_diff:
+                block = BN.support_from_diffset(
+                    BN.support_popcount(parent[None]),
+                    BN.diffset_count(parent[None], joined))
+            else:
+                block = BN.support_popcount(joined)
+            sups.update((i, int(s)) for i, s in zip(dense, block))
+        for i in cand:
+            if not rep[i]:
+                sups[i] = idlist_join_support(parent, *vdb.idlist(i))
+        return sups
+
     while stack:
         steps, b, s_list, i_list = stack.pop()
         n_itemsets = sum(1 for _, s in steps if s)
@@ -463,26 +668,27 @@ def mine_spam_cpu(db: SequenceDB, minsup_abs: int, *,
                    or n_itemsets < max_pattern_itemsets)
         trans = BN.sext_transform(b)
         waves += 1
+        use_diff = bool(dd and len(steps) >= dd)
+        if use_diff:
+            diffset_nodes += 1
         s_items: List[int] = []
         s_sups = {}
         if allow_s and s_list:
-            joined = trans[None] & bm[s_list]           # [n, S, W]
-            sups = BN.support_popcount(joined)
+            all_s = eval_cands(trans, s_list, use_diff)
             candidates += len(s_list)
-            for i, sup in zip(s_list, sups):
-                if sup >= minsup_abs:
+            for i in s_list:
+                if all_s[i] >= minsup_abs:
                     s_items.append(i)
-                    s_sups[i] = int(sup)
+                    s_sups[i] = all_s[i]
         i_items: List[int] = []
         i_sups = {}
         if i_list:
-            joined = b[None] & bm[i_list]
-            sups = BN.support_popcount(joined)
+            all_i = eval_cands(b, i_list, use_diff)
             candidates += len(i_list)
-            for i, sup in zip(i_list, sups):
-                if sup >= minsup_abs:
+            for i in i_list:
+                if all_i[i] >= minsup_abs:
                     i_items.append(i)
-                    i_sups[i] = int(sup)
+                    i_sups[i] = all_i[i]
         children = []
         for it, is_s in ([(i, True) for i in s_items]
                          + [(i, False) for i in i_items]):
@@ -503,7 +709,12 @@ def mine_spam_cpu(db: SequenceDB, minsup_abs: int, *,
     if stats_out is not None:
         stats_out.update({"engine": "spam-cpu", "waves": waves,
                           "candidates": candidates,
-                          "patterns": len(results)})
+                          "patterns": len(results),
+                          "representation": plan.pin,
+                          "rep_dense": plan.n_dense,
+                          "rep_idlist": plan.n_sparse,
+                          "diffset_depth": dd,
+                          "diffset_nodes": diffset_nodes})
     return sort_patterns(results)
 
 

@@ -158,6 +158,29 @@ def support_popcount(bitmap: jax.Array) -> jax.Array:
     return jnp.sum(popcount(packed), axis=-1, dtype=jnp.int32)
 
 
+def alive_popcount(alive: jax.Array) -> jax.Array:
+    """[..., n_seq] bool -> [...] int32: count of alive sequences via the
+    pack+popcount spelling (the SPAM wave's reduction)."""
+    return jnp.sum(popcount(pack_seq_bits(alive)), axis=-1, dtype=jnp.int32)
+
+
+def diffset_count(parent_alive: jax.Array, child_alive: jax.Array) -> jax.Array:
+    """dEclat diffset size from per-sequence alive bits: #sequences alive
+    in the parent row but dead in the child join, [..., n_seq] bool pair
+    -> [...] int32.  Mirrors bitops_np.diffset_count (which takes raw
+    bitmaps); the wave kernels already hold the collapsed alive bits, so
+    this spelling fuses into the same pass."""
+    return alive_popcount(parent_alive & ~child_alive)
+
+
+def support_from_diffset(parent_support: jax.Array,
+                         diffset_size: jax.Array) -> jax.Array:
+    """dEclat support identity ``support(parent_row) - |diffset|`` —
+    exact because every s/i-extension ANDs the joined-against parent
+    row, making the child's alive-set a subset of the parent's."""
+    return parent_support - diffset_size
+
+
 def contains_bits(bitmap: jax.Array) -> jax.Array:
     """[..., n_seq, n_words] -> [..., n_seq] bool: any bit set per sequence."""
     return jnp.any(bitmap != 0, axis=-1)

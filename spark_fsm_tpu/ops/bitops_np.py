@@ -190,6 +190,30 @@ def support_popcount(bitmap: np.ndarray) -> np.ndarray:
     return popcount(packed).sum(axis=-1).astype(np.int64)
 
 
+def diffset_count(parent_bitmap: np.ndarray,
+                  child_bitmap: np.ndarray) -> np.ndarray:
+    """dEclat diffset size: #sequences alive in the parent but dead in
+    the child, [..., n_seq, n_words] -> [...] int64.
+
+    Every temporal join ANDs the (possibly transformed) parent row, so
+    the child's alive-set is a SUBSET of the parent row's alive-set and
+    ``support(child) == support(parent_row) - diffset_count`` holds
+    EXACTLY (integer identity, no approximation) — the deep-extension
+    support formulation of :func:`support_from_diffset`.  The parent
+    here is the JOINED-AGAINST row: the plain prefix bitmap for an
+    i-extension, the ``sext_transform``-ed one for an s-extension."""
+    pa = (np.asarray(parent_bitmap) != 0).any(axis=-1)
+    ca = (np.asarray(child_bitmap) != 0).any(axis=-1)
+    return popcount(pack_seq_bits(pa & ~ca)).sum(axis=-1).astype(np.int64)
+
+
+def support_from_diffset(parent_support, diffset_size):
+    """dEclat support: ``support(parent_row) - |diffset|``.  Exact
+    whenever the child's alive-set is a subset of the parent's — true
+    by construction for every s/i-extension (see diffset_count)."""
+    return parent_support - diffset_size
+
+
 def first_set_positions(b: np.ndarray) -> np.ndarray:
     """Per-sequence index of the first set bit, or n_words*32 if none.
 

@@ -1,0 +1,285 @@
+"""Fused extension-count-prune Pallas kernel (ISSUE 16).
+
+``pair_supports`` (ops/pallas_support.py) computes the full pair-support
+matrix and writes EVERY candidate's count back to HBM; the host (or a
+follow-up device op) then compares against the threshold — but at depth
+most candidates die at that compare, so most of the result write and all
+of the separate threshold pass is wasted motion.  The
+"Accelerator-Oriented Algorithm Transformation" thread (PAPERS.md)
+argues the prune belongs INSIDE the kernel; the PR 7 resident loop
+already moved the compare on-device, this moves it into the kernel
+epilogue itself:
+
+- same matmul-style grid as the pair kernel — (P/P_T, NI/I_T, S/S_B),
+  sequence-block innermost, out tile accumulating in VMEM;
+- on the LAST sequence block the epilogue applies the threshold while
+  the tile is still in VMEM: surviving lanes keep their count, dying
+  lanes are zeroed (``minsup >= 1`` always, so 0 can never read as a
+  survivor), and a PACKED survivor mask (1 bit per lane, LSB-first,
+  same packing as ``bitops_jax.pack_seq_bits``) is emitted alongside;
+- the mask is 1/32 the int32 matrix — a consumer that walks the mask
+  first touches only survivor lanes of the support matrix, so dead
+  candidates cost one mask bit of readback instead of a 4-byte count.
+
+The threshold rides in SMEM (a (1, 1) scalar block) so one compiled
+kernel serves every wave of a mine — the rising-threshold engines
+(resident TSR loop, SPAM's monotone bound) re-launch with a new scalar,
+never a new program.
+
+Semantics note (the diffset tie-in, ops/spam_bitops.py): the dEclat
+formulation ``support(child) = support(parent_row) - |diffset|`` is an
+exact integer identity for every s/i-extension (the child row is the
+parent row AND the item row, so its alive-set is a subset), so the
+kernel's direct count IS the diffset-formulated count — the jnp
+reference (:func:`extend_count_prune_jnp`) computes both and selects
+per parent row to pin that identity byte-for-byte in the parity suites.
+
+Mesh caveat: in-kernel pruning is only correct where the kernel sees the
+WHOLE sequence axis.  Under ``shard_map`` each device holds partial
+counts that must ``psum`` BEFORE the compare, so the sharded wave path
+(ops/spam_bitops.py) runs the raw pair kernel per shard and applies
+threshold+pack post-psum in the same jitted program — still on device,
+one launch, just not inside the kernel epilogue.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spark_fsm_tpu.ops import bitops_jax as B
+from spark_fsm_tpu.ops.pallas_support import (
+    I_TILE, P_TILE, S_BLOCK, effective_tiles, seq_block)
+
+# Inner-loop cost per uint32 word element matches the pair kernel (AND,
+# nonzero, cast, accumulate); the fused epilogue adds O(P*NI) compare/
+# select/pack work once per out tile — amortized over S/s_block grid
+# steps it is noise against the O(P*NI*S*W) stream, which is why fusing
+# the prune is ~free device time and pure readback savings.
+EXTEND_VPU_OPS_PER_WORD = 4
+EPILOGUE_VPU_OPS_PER_LANE = 6  # compare, select, cast, shift-mul, add, pack
+
+
+def grid_model(P: int, n_item_rows: int, W: int, S: int, *,
+               s_block: Optional[int] = None,
+               p_tile: Optional[int] = None,
+               i_tile: Optional[int] = None,
+               items_rows: Optional[int] = None) -> dict:
+    """Grid/traffic/compute model for ONE ``extend_count_prune`` launch —
+    the single definition shared with bench_kernels.py (same contract as
+    ``pallas_support.grid_model``).  Differences from the pair model:
+    the out traffic adds the packed mask (NI/32 uint32 per parent row)
+    and the VPU count adds the per-tile prune epilogue."""
+    sb = s_block if s_block else seq_block(W)
+    ni128 = -(-n_item_rows // 128) * 128
+    if items_rows is None:
+        items_rows = ni128
+    if p_tile is None or i_tile is None:
+        ap, ai = effective_tiles(P, n_item_rows, W, items_rows)
+        p_tile = ap if p_tile is None else p_tile
+        i_tile = ai if i_tile is None else i_tile
+    ni = -(-n_item_rows // i_tile) * i_tile
+    steps = (P // p_tile) * (ni // i_tile) * (S // sb)
+    out_bytes = 4 * P * ni + 4 * P * (ni // 32)
+    model_bytes = P * ni * S * W * 4 * (1 / i_tile + 1 / p_tile) + out_bytes
+    return {
+        "p_tile": int(p_tile), "i_tile": int(i_tile), "s_block": int(sb),
+        "grid_steps": int(steps),
+        "model_bytes": int(model_bytes),
+        "min_useful_bytes": int((P + ni) * S * W * 4 + out_bytes),
+        "vpu_ops": int(EXTEND_VPU_OPS_PER_WORD * P * ni * S * W
+                       + EPILOGUE_VPU_OPS_PER_LANE * P * ni),
+    }
+
+
+def _prune_epilogue(out_ref, mask_ref, thr_ref, p_tile: int, i_tile: int):
+    """Shared last-seq-block epilogue: threshold the accumulated counts
+    in VMEM, zero the dead lanes, pack the survivor bits LSB-first
+    (identical packing to ``bitops_jax.pack_seq_bits`` over the item
+    axis — pinned in tests/test_pallas_extend.py)."""
+    thr = thr_ref[0, 0]
+    raw = out_ref[:]                                   # [P_T, I_T] int32
+    alive = raw >= thr
+    out_ref[:] = jnp.where(alive, raw, 0)
+    bits = alive.reshape(p_tile, i_tile // 32, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jax.lax.broadcasted_iota(
+        jnp.uint32, (p_tile, i_tile // 32, 32), 2))
+    mask_ref[:] = jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def _make_extend_kernel_1w(p_tile: int, i_tile: int, n_sb: int):
+    """Single-word fast path (2-D blocks; see the pair kernel's 1w note:
+    the degenerate [*, 1, S] 3-D block shape compiles ~15x slower in
+    Mosaic for identical throughput)."""
+
+    def kernel(thr_ref, pt_ref, items_ref, out_ref, mask_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+            mask_ref[:] = jnp.zeros_like(mask_ref)
+
+        items = items_ref[:]                           # [I_T, S_B]
+        acc = []
+        for p in range(p_tile):                        # static unroll
+            row = pt_ref[p, :]                         # [S_B]
+            hit = ((row[None, :] & items) != 0).astype(jnp.int32)
+            acc.append(jnp.sum(hit, axis=-1))          # [I_T]
+        out_ref[:] += jnp.stack(acc)                   # [P_T, I_T]
+
+        @pl.when(pl.program_id(2) == n_sb - 1)
+        def _():
+            _prune_epilogue(out_ref, mask_ref, thr_ref, p_tile, i_tile)
+
+    return kernel
+
+
+def _make_extend_kernel(p_tile: int, i_tile: int, n_sb: int):
+    """Multiword variant: OR the per-word hits before counting (any word
+    nonzero -> the sequence contains the join), then the same fused
+    threshold+pack epilogue on the last sequence block."""
+
+    def kernel(thr_ref, pt_ref, items_ref, out_ref, mask_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+            mask_ref[:] = jnp.zeros_like(mask_ref)
+
+        n_words = items_ref.shape[1]
+        acc = []
+        for p in range(p_tile):                        # static unroll
+            hit = None
+            for w in range(n_words):                   # static unroll
+                row = pt_ref[p, w, :]                  # [S_B]
+                h = (row[None, :] & items_ref[:, w, :]) != 0
+                hit = h if hit is None else (hit | h)
+            acc.append(jnp.sum(hit.astype(jnp.int32), axis=-1))
+        out_ref[:] += jnp.stack(acc)
+
+        @pl.when(pl.program_id(2) == n_sb - 1)
+        def _():
+            _prune_epilogue(out_ref, mask_ref, thr_ref, p_tile, i_tile)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_item_rows", "s_block", "p_tile", "i_tile", "interpret"))
+def extend_count_prune(pt: jax.Array, items: jax.Array, thr: jax.Array,
+                       n_item_rows: int, *, s_block: int = S_BLOCK,
+                       p_tile: Optional[int] = None,
+                       i_tile: Optional[int] = None,
+                       interpret: bool = False):
+    """Fused s/i-extension join + support count + threshold prune.
+
+    Args:
+      pt: [P, W, S] uint32 parent rows in kernel layout (plain rows read
+        by i-extensions, ``sext_transform``-ed rows by s-extensions —
+        the caller interleaves them exactly as for ``pair_supports``).
+      items: [T, W, S] uint32 item rows in kernel layout.
+      thr: int32 threshold, any of shape (), (1,) or (1, 1) — becomes
+        the (1, 1) SMEM scalar block.  A TRACED value: one compiled
+        kernel serves every threshold.
+      n_item_rows: leading item rows to evaluate (rounded up to i_tile).
+
+    Returns:
+      (sup [P, NI] int32, mask [P, NI // 32] uint32) with NI =
+      n_item_rows rounded up to i_tile.  ``sup`` holds the exact count
+      where it is >= thr and EXACTLY 0 otherwise (thr >= 1 always —
+      ``abs_minsup`` floors at 1 — so 0 is unambiguous); ``mask`` bit
+      ``i % 32`` of word ``i // 32`` is set iff lane ``i`` survived.
+    """
+    P, W, S = pt.shape
+    if p_tile is None or i_tile is None:
+        ap, ai = effective_tiles(P, n_item_rows, W, items.shape[0])
+        p_tile = ap if p_tile is None else p_tile
+        i_tile = ai if i_tile is None else i_tile
+    assert P % p_tile == 0, (P, p_tile)
+    assert S % s_block == 0, (S, s_block)
+    assert i_tile % 128 == 0, i_tile
+    assert items.shape[1] == W, (items.shape, W)
+    ni = -(-n_item_rows // i_tile) * i_tile
+    assert ni <= items.shape[0], (ni, items.shape)
+    n_sb = S // s_block
+    grid = (P // p_tile, ni // i_tile, n_sb)
+    thr2 = jnp.asarray(thr, jnp.int32).reshape(1, 1)
+    thr_spec = pl.BlockSpec((1, 1), lambda p, i, sb: (0, 0),
+                            memory_space=pltpu.SMEM)
+    out_specs = [
+        pl.BlockSpec((p_tile, i_tile), lambda p, i, sb: (p, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((p_tile, i_tile // 32), lambda p, i, sb: (p, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((P, ni), jnp.int32),
+        jax.ShapeDtypeStruct((P, ni // 32), jnp.uint32),
+    ]
+    if W == 1:  # 2-D fast path
+        return pl.pallas_call(
+            _make_extend_kernel_1w(p_tile, i_tile, n_sb),
+            grid=grid,
+            in_specs=[
+                thr_spec,
+                pl.BlockSpec((p_tile, s_block), lambda p, i, sb: (p, sb),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((i_tile, s_block), lambda p, i, sb: (i, sb),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(thr2, pt[:, 0, :], items[:, 0, :])
+    return pl.pallas_call(
+        _make_extend_kernel(p_tile, i_tile, n_sb),
+        grid=grid,
+        in_specs=[
+            thr_spec,
+            pl.BlockSpec((p_tile, W, s_block), lambda p, i, sb: (p, 0, sb),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((i_tile, W, s_block), lambda p, i, sb: (i, 0, sb),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(thr2, pt, items)
+
+
+def extend_count_prune_jnp(p3: jax.Array, items3: jax.Array, thr,
+                           use_diff) -> tuple:
+    """The jnp reference semantics of the fused kernel — full
+    materialization, so use it at TEST/SMOKE scale; the production CPU
+    path is the TILED spelling in ``spam_bitops.wave_extend_prune_fn``
+    (same math, bounded live intermediate).
+
+    Args:
+      p3: [P, S, W] uint32 parent rows (engine-native layout).
+      items3: [NI, S, W] uint32 item rows.
+      thr: int threshold (>= 1).
+      use_diff: [P] bool — rows evaluated via the dEclat diffset
+        formulation ``support(parent_row) - |diffset|`` instead of the
+        direct count.  The two are an exact integer identity (the child
+        alive-set is a subset of the parent row's), so this selects
+        between provably-equal spellings — which is precisely what the
+        parity suites pin.
+
+    Returns:
+      (sup [P, NI] int32 zeroed below thr, mask [P, ceil(NI/32)] uint32
+      packed survivor bits) — byte-identical to the kernel outputs.
+    """
+    joined = p3[:, None] & items3[None]                 # [P, NI, S, W]
+    child_alive = B.contains_bits(joined)               # [P, NI, S]
+    direct = B.alive_popcount(child_alive)              # [P, NI]
+    parent_alive = B.contains_bits(p3)                  # [P, S]
+    parent_pop = B.alive_popcount(parent_alive)         # [P]
+    diff = B.support_from_diffset(
+        parent_pop[:, None],
+        B.diffset_count(parent_alive[:, None], child_alive))
+    sup = jnp.where(jnp.asarray(use_diff)[:, None], diff, direct)
+    alive = sup >= jnp.asarray(thr, jnp.int32)
+    return jnp.where(alive, sup, 0), B.pack_seq_bits(alive)

@@ -79,6 +79,36 @@ LAUNCH_OVERHEAD_UNITS = 512
 # 85.8e-3 s / 8192 lanes / 990_000 seqs (KERNELS.json rule_supports).
 LANE_SEC_PER_SEQWORD = 85.8e-3 / 8192 / 990_000
 
+# Per-kernel lane-rate anchors (KERNELS.json): each kernel family's
+# measured wall divided by its lane x seq-word work.  The pair/extend
+# "lane" is one (parent, item) output cell — 43.35 ms over 2048x384
+# cells streaming 77824 seq-words (pair_supports headline row).  The
+# fused extend_prune kernel re-uses the pair anchor STRUCTURALLY: its
+# extra epilogue is ~6 VPU ops per output cell ONCE vs 4 ops per
+# seq-word accumulated over every sequence block — a 1.5/(S*W) relative
+# add (~2e-5 at the headline S), below measurement noise, so the
+# committed pair wall is the honest anchor until a TPU session
+# re-measures it (bench_kernels.py writes the entry; the 2026-08-03
+# structural-note precedent).  Like DISPATCH_SEC, these are COMMITTED
+# constants: the live fsm_costmodel_drift_ratio EWMA (PR 6) is what
+# absorbs machine-to-machine drift at plan time — drift_factor()
+# multiplies the overhead regardless of which anchor row priced the
+# lane, so a stale anchor inflates overhead_units uniformly instead of
+# skewing one kernel family against another.
+KERNEL_LANE_SEC = {
+    "rule_supports": LANE_SEC_PER_SEQWORD,
+    "pair_supports": 43.35e-3 / (2048 * 384) / 77_824,
+    "extend_prune": 43.35e-3 / (2048 * 384) / 77_824,
+}
+
+
+def lane_sec_per_seqword(kernel: str = "rule_supports") -> float:
+    """The committed lane-rate anchor for one kernel family (falls back
+    to the rule_supports unit for unknown names — the conservative,
+    largest per-lane figure)."""
+    return KERNEL_LANE_SEC.get(kernel, LANE_SEC_PER_SEQWORD)
+
+
 # Conservative per-dispatch fixed cost (local PCIe; a tunneled backend
 # runs ~10x this, which only makes merging MORE right).
 DISPATCH_SEC = 0.005
@@ -127,16 +157,21 @@ def calibrated_dispatch_s() -> float:
 
 
 def overhead_units(n_seq: int, n_words: int,
-                   dispatch_s: Optional[float] = None) -> int:
+                   dispatch_s: Optional[float] = None,
+                   kernel: str = "rule_supports") -> int:
     """Per-launch overhead in traffic units for a given sequence-axis
     size: how many padded lanes one saved dispatch is worth.  Clamped so
     degenerate geometries cannot zero out either term of the planner's
     cost model.  ``dispatch_s=None`` (the engines' plan-time default)
     resolves to :func:`calibrated_dispatch_s` — the committed constant
-    recalibrated by the live ``fsm_costmodel_drift_ratio`` EWMA."""
+    recalibrated by the live ``fsm_costmodel_drift_ratio`` EWMA.
+    ``kernel`` selects the lane-rate anchor (KERNEL_LANE_SEC): the same
+    saved dispatch is worth more pad lanes of a cheaper-per-lane
+    kernel."""
     if dispatch_s is None:
         dispatch_s = calibrated_dispatch_s()
-    lane_s = max(1e-12, n_seq * max(1, n_words) * LANE_SEC_PER_SEQWORD)
+    lane_s = max(1e-12,
+                 n_seq * max(1, n_words) * lane_sec_per_seqword(kernel))
     return max(64, min(1 << 20, int(dispatch_s / lane_s)))
 
 

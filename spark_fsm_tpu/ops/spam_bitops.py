@@ -92,3 +92,161 @@ def wave_supports_fn(mesh: Optional[Mesh], n_words: int, ni_pad: int,
     st = P(None, SEQ_AXIS)
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(st, st),
                              out_specs=P()))
+
+
+@functools.lru_cache(maxsize=64)
+def wave_extend_prune_fn(mesh: Optional[Mesh], n_words: int, nd_pad: int,
+                         tile: int = ITEM_TILE, use_pallas: bool = False,
+                         s_block: int = 0, interpret: bool = False):
+    """Fused extension-count-PRUNE wave (ISSUE 16): the wave-support
+    pass with the threshold compare pushed on device and, on the Pallas
+    path, into the kernel epilogue itself (ops/pallas_extend.py).
+
+    ``fn(pt, items, thr, use_diff) -> (sup, mask)``:
+
+    - ``pt`` [2*Bn, S*W] interleaved plain/transformed parent rows
+      (flat, the store layout contract);
+    - ``items`` [>= nd_pad, S*W] flat item rows — the engine's whole
+      store on the pure-bitmap path, the gathered DENSE block on the
+      hybrid path (the wave axis is ``nd_pad``, the dense-item pad, not
+      the full item pad: sparse items never buy wave lanes);
+    - ``thr`` int32 scalar (traced — one compile serves the rising
+      threshold), ``use_diff`` [2*Bn] bool per-row dEclat-formulation
+      flags (depth-selected by the engine);
+    - ``sup`` [2*Bn, nd_pad] int32 holds the exact count where it is
+      >= thr and EXACTLY 0 otherwise (thr >= 1 always, so the host's
+      ``sup >= thr`` reads are byte-identical to the unfused pass);
+      ``mask`` [2*Bn, nd_pad/32] uint32 packed survivor bits.
+
+    The diffset spelling ``support(parent_row) - |diffset|`` is an exact
+    identity per row (child alive-set is a subset of the parent row's),
+    and it holds PER SHARD too — each shard's partial counts obey the
+    same subset relation — so psum-then-threshold commutes with the
+    formulation choice.  Under a mesh the threshold+pack runs post-psum
+    inside the same shard_map body (on device, one launch); only the
+    single-device Pallas path prunes inside the kernel epilogue.
+    """
+    W = n_words
+    n_tiles = nd_pad // tile
+
+    def body(pt, items, thr, use_diff):
+        p3 = pt.reshape(pt.shape[0], -1, W)               # [P, S, W]
+        parent_alive = B.contains_bits(p3)                # [P, S]
+        parent_pop = B.alive_popcount(parent_alive)       # [P]
+        if use_pallas:
+            from spark_fsm_tpu.ops import pallas_support as PS
+
+            # kernel layout + tile padding: parent rows up to the
+            # 16-row tile, item rows up to the 128-lane item tile
+            # (nd_pad is a 64-multiple; pad rows are all-zero -> sup 0)
+            p = p3.shape[0]
+            p_pad = -(-p // PS.P_TILE) * PS.P_TILE
+            ptk = jnp.transpose(p3, (0, 2, 1))            # [P, W, S]
+            if p_pad != p:
+                ptk = jnp.pad(ptk, ((0, p_pad - p), (0, 0), (0, 0)))
+            itk = jnp.transpose(
+                items[:nd_pad].reshape(nd_pad, -1, W), (0, 2, 1))
+            ni128 = -(-nd_pad // 128) * 128
+            if ni128 != nd_pad:
+                itk = jnp.pad(itk, ((0, ni128 - nd_pad), (0, 0), (0, 0)))
+            if mesh is None:
+                from spark_fsm_tpu.ops import pallas_extend as PE
+
+                sup, mask = PE.extend_count_prune(
+                    ptk, itk, thr, nd_pad, s_block=s_block,
+                    interpret=interpret)
+                # direct count == diffset count (exact identity):
+                # use_diff changes the accounting, never the bytes
+                return sup[:p, :nd_pad], mask[:p, :nd_pad // 32]
+            sup = PS.pair_supports(ptk, itk, nd_pad, s_block=s_block,
+                                   interpret=interpret)[:p, :nd_pad]
+        else:
+            items4 = items[:nd_pad].reshape(n_tiles, tile, -1, W)
+
+            def tile_sup(tile_items):                     # [tile, S, W]
+                joined = p3[:, None] & tile_items[None]   # [P, tile, S, W]
+                child_alive = B.contains_bits(joined)     # [P, tile, S]
+                direct = B.alive_popcount(child_alive)
+                diff = B.support_from_diffset(
+                    parent_pop[:, None],
+                    B.diffset_count(parent_alive[:, None], child_alive))
+                return jnp.where(use_diff[:, None], diff, direct)
+
+            sup = jax.lax.map(tile_sup, items4)           # [n_tiles, P, tile]
+            sup = jnp.moveaxis(sup, 0, 1).reshape(p3.shape[0], nd_pad)
+        if mesh is not None:
+            sup = jax.lax.psum(sup, SEQ_AXIS)
+        alive = sup >= thr
+        return jnp.where(alive, sup, 0), B.pack_seq_bits(alive)
+
+    if mesh is None:
+        return jax.jit(body)
+    st = P(None, SEQ_AXIS)
+    # check_vma=False for the same reason as spade_tpu's pallas wrap:
+    # pallas_call carries no varying-mesh-axes rule, so the replication
+    # checker cannot see through it on the kernel path
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(st, st, P(), P()),
+                             out_specs=(P(), P()), check_vma=False))
+
+
+@functools.lru_cache(maxsize=8)
+def gather_rows_fn(mesh: Optional[Mesh]):
+    """Cached jitted dense-block gather for the hybrid store: pull the
+    planner's DENSE item rows out of the full store into a compact
+    ``[nd_pad, S*W]`` block the wave pass iterates over.  ``rows`` is a
+    host-built int32 index vector with -1 marking pad rows (gathered as
+    all-zero, so a pad wave lane's support is exactly 0).  Item rows are
+    immutable after the scatter build — materialize/recompute only ever
+    write pool slots — so one gather at construction serves the whole
+    mine."""
+
+    def body(store, rows):
+        safe = jnp.maximum(rows, 0)
+        return jnp.where((rows >= 0)[:, None], store[safe], jnp.uint32(0))
+
+    if mesh is None:
+        return jax.jit(body)
+    st = P(None, SEQ_AXIS)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(st, P()),
+                             out_specs=st))
+
+
+@functools.lru_cache(maxsize=64)
+def pair_prune_fn(mesh: Optional[Mesh], n_words: int):
+    """Fused gather-join-count-prune for the SPARSE (id-list) half of
+    the hybrid store: candidates whose item the planner routed to the
+    id-list representation never buy a full wave lane — they are
+    evaluated as an explicit (parent row, item row) pair list at pow2
+    widths (the engine chunks and pads; compiled once per width).
+
+    ``fn(pt, store, pref, item, thr, use_diff) -> sup [C] int32``:
+    ``pref`` indexes the interleaved pt rows (2b plain / 2b+1
+    transformed), ``item`` the store's item rows with -1 marking pad
+    lanes (masked to 0 on output), ``use_diff`` selects the dEclat
+    formulation per candidate.  Output follows the fused-prune
+    contract: exact count where >= thr, exactly 0 otherwise."""
+    W = n_words
+
+    def body(pt, store, pref, item, thr, use_diff):
+        p3 = pt.reshape(pt.shape[0], -1, W)               # [P, S, W]
+        prows = p3[pref]                                  # [C, S, W]
+        safe = jnp.maximum(item, 0)
+        irows = store[safe].reshape(item.shape[0], -1, W)  # [C, S, W]
+        child_alive = B.contains_bits(prows & irows)      # [C, S]
+        parent_alive = B.contains_bits(prows)
+        direct = B.alive_popcount(child_alive)
+        diff = B.support_from_diffset(
+            B.alive_popcount(parent_alive),
+            B.diffset_count(parent_alive, child_alive))
+        sup = jnp.where(use_diff, diff, direct)
+        if mesh is not None:
+            sup = jax.lax.psum(sup, SEQ_AXIS)
+        return jnp.where((item >= 0) & (sup >= thr), sup, 0)
+
+    if mesh is None:
+        return jax.jit(body)
+    st = P(None, SEQ_AXIS)
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(st, st, P(), P(), P(), P()),
+                             out_specs=P()))

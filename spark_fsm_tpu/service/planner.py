@@ -165,6 +165,39 @@ def choose(req, db) -> PlannerDecision:
     return choose_patterns_engine(stats, pcfg, constrained=constrained)
 
 
+def choose_representation(item_supports, n_sequences: int, *,
+                          pin: Optional[str] = None,
+                          crossover: Optional[float] = None,
+                          diffset_depth: Optional[int] = None,
+                          engine: str = "spam"):
+    """Per-item vertical-representation routing WITHIN a mine (ISSUE 16):
+    the same calibrated density crossover that picks the engine picks,
+    per item, dense SPAM bitmap vs SPADE id-list, and the pattern depth
+    at which supports switch to the dEclat diffset formulation.
+
+    Returns ``(data.vertical.RepPlan, diffset_depth)``.  Explicit
+    arguments (engine kwargs, tests, benches) override the ``[planner]``
+    config; every call lands a zero-length ``planner.representation``
+    span on the trace spine — one record per mine explaining the whole
+    per-item split (counts + density extremes + the crossover used), so
+    ``/admin/trace/{uid}`` answers *why* each representation was chosen
+    the same way ``planner.route`` answers the engine choice."""
+    from spark_fsm_tpu.data import vertical
+
+    pcfg = config.get_config().planner
+    pin = pcfg.representation if pin is None else pin
+    x = pcfg.density_crossover if crossover is None else crossover
+    dd = pcfg.diffset_depth if diffset_depth is None else diffset_depth
+    plan = vertical.rep_plan(item_supports, n_sequences,
+                             crossover=float(x), pin=pin)
+    attrs = plan.as_attrs()
+    attrs.update(engine=engine, diffset_depth=int(dd))
+    with obs.span("planner.representation", **attrs):
+        pass
+    log_event("planner_representation", **attrs)
+    return plan, int(dd)
+
+
 def extract_auto(req, db, stats: Optional[dict] = None,
                  checkpoint=None):
     """The AUTO plugin body: choose, record the decision (trace spine +

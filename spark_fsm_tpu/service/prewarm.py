@@ -216,6 +216,80 @@ def _warm_fused(t: dict, mesh) -> None:
                          t["max_tokens"], eng._put)
 
 
+def _warm_spam(t: dict, mesh, ekw: dict) -> None:
+    """Compile the SPAM engine's pure-bitmap chain: construction (store
+    scatter + dense gather seam) plus a tiny mine through the fused
+    extension-count-prune wave.  ``representation="bitmap"`` pins the
+    pure plan — the prewarm vdb is one-sequence-per-item (density ~0),
+    which the planner would otherwise route entirely to id-lists and
+    the pure wave program a live DENSE mine runs would stay cold."""
+    from spark_fsm_tpu.models.spam_bitmap import SpamBitmapTPU
+
+    vdb = _tiny_vdb(t["n_sequences"], t["n_items"], t["n_words"])
+    skw = {k: v for k, v in ekw.items()
+           if k in ("node_batch", "pipeline_depth", "pool_bytes")}
+    eng = SpamBitmapTPU(vdb, 1, mesh=mesh, representation="bitmap", **skw)
+    eng.mine()
+    _warm_store_builders(eng.store.shape[0], eng.n_seq, eng.n_words, mesh,
+                         True, t["n_items"], t["max_tokens"], eng._put)
+
+
+def _spam_put(mesh):
+    import functools
+
+    from spark_fsm_tpu.parallel import multihost as MH
+
+    return functools.partial(MH.host_to_device, mesh)
+
+
+def _warm_spam_hybrid(t: dict, mesh) -> None:
+    """Compile one hybrid-store wave geometry: the dense-block gather
+    plus the fused prune wave at this ``nd_pad`` — all-zero stores have
+    the right shapes (the only thing a compile keys on).  The d0 entry
+    has no wave program (every item id-list-routed; its launches are
+    the spam-pair widths) — recording the key keeps /admin/shapes
+    completeness exact."""
+    import jax
+
+    from spark_fsm_tpu.ops import spam_bitops as SB
+
+    nd, nw = int(t["nd_pad"]), int(t["n_words"])
+    S, nb = int(t["n_seq_pad"]), int(t["node_batch"])
+    put = _spam_put(mesh)
+    if nd:
+        use_pallas = jax.default_backend() == "tpu"
+        SB.gather_rows_fn(mesh)(
+            put(np.zeros((t["total_rows"], S * nw), np.uint32)),
+            put(np.full(nd, -1, np.int32)))
+        fn = SB.wave_extend_prune_fn(mesh, nw, nd, t["tile"],
+                                     use_pallas=use_pallas,
+                                     s_block=int(t["s_block"]),
+                                     interpret=False)
+        fn(put(np.zeros((2 * nb, S * nw), np.uint32)),
+           put(np.zeros((nd, S * nw), np.uint32)),
+           put(np.int32(1)), put(np.zeros(2 * nb, bool)))
+    shapes.record(shapes.key_spam_hybrid(S, nw, t["total_rows"], nb,
+                                         int(t["ni_pad"]), nd))
+
+
+def _warm_spam_pair(t: dict, mesh) -> None:
+    """Compile one sparse pair-launch width: the gather-join-count-prune
+    program keys on (pt rows, store rows, width) — dispatched on zero
+    stores with all-pad (-1) items, milliseconds on top of the
+    compile."""
+    from spark_fsm_tpu.ops import spam_bitops as SB
+
+    nw, w = int(t["n_words"]), int(t["width"])
+    S, nb = int(t["n_seq_pad"]), int(t["node_batch"])
+    put = _spam_put(mesh)
+    SB.pair_prune_fn(mesh, nw)(
+        put(np.zeros((2 * nb, S * nw), np.uint32)),
+        put(np.zeros((t["total_rows"], S * nw), np.uint32)),
+        put(np.zeros(w, np.int32)), put(np.full(w, -1, np.int32)),
+        put(np.int32(1)), put(np.zeros(w, bool)))
+    shapes.record(shapes.key_spam_pair(S, nw, w))
+
+
 def _warm_cspade(t: dict, mesh, ekw: dict) -> None:
     from spark_fsm_tpu.models.spade_constrained import ConstrainedSpadeTPU
 
@@ -527,6 +601,12 @@ def _run_keys(targets, mesh, eng_sub) -> List[dict]:
                     _warm_fused(t, mesh)
                 elif t["kind"] == "cspade":
                     _warm_cspade(t, mesh, eng_sub)
+                elif t["kind"] == "spam":
+                    _warm_spam(t, mesh, eng_sub)
+                elif t["kind"] == "spam_hybrid":
+                    _warm_spam_hybrid(t, mesh)
+                elif t["kind"] == "spam_pair":
+                    _warm_spam_pair(t, mesh)
                 elif t["kind"] == "tsr":
                     _warm_tsr(t, mesh)
                 elif t["kind"] in ("tsr_eval", "tsr_fused", "tsr_inner"):

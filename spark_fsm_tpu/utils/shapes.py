@@ -152,6 +152,29 @@ def key_spam(n_seq: int, n_words: int, rows: int, node_batch: int,
     return f"spam:s{n_seq}w{n_words}r{rows}nb{node_batch}i{ni_pad}"
 
 
+def key_spam_hybrid(n_seq: int, n_words: int, rows: int, node_batch: int,
+                    ni_pad: int, nd_pad: int) -> str:
+    """One HYBRID-store SPAM geometry (ISSUE 16): the planner's density
+    crossover routed some items to id-lists, so the fused wave runs over
+    a gathered dense block of ``nd_pad`` rows instead of the full item
+    axis — a different compiled wave program per dense pad, hence the
+    extra ``d`` axis.  Keeps the ``spam:`` prefix (the pure-bitmap plan
+    is the ``d``-less spelling, byte-compatible with pre-hybrid keys).
+    ``nd_pad`` walks the item tile ladder 0..ni_pad; 0 = every item
+    id-list-routed, no wave program at all (pair launches only)."""
+    return (f"spam:s{n_seq}w{n_words}r{rows}nb{node_batch}i{ni_pad}"
+            f"d{nd_pad}")
+
+
+def key_spam_pair(n_seq: int, n_words: int, width: int) -> str:
+    """One sparse-candidate pair-launch geometry (hybrid SPAM store):
+    candidates over id-list-routed items dispatch as explicit
+    (parent row, item) pairs at pow2 widths 64..chunk — one compiled
+    prune program per width, recorded at dispatch time like the
+    ``tsr-eval`` ladder."""
+    return f"spam-pair:s{n_seq}w{n_words}c{width}"
+
+
 def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
     return f"sweep:s{n_seq}w{n_words}r{n_rows}i{ni_rows}"
 
@@ -304,6 +327,36 @@ def enumerate_shapes(spec: WorkloadSpec, *, mesh=None,
                                        use_pallas=use_pallas)
         add(f["shape_key"], kind="fused", n_sequences=ns, n_items=ni,
             n_words=nw, max_tokens=max_tokens)
+        # SPAM wave engine + the hybrid-store ladder (ISSUE 16): the
+        # planner routes dense patterns mines here, so a prewarmed boot
+        # must cover the fused wave at the pure geometry AND every
+        # dense-block pad the per-item density split can produce (the
+        # item-tile ladder 0..ni_pad), plus the sparse pair-launch pow2
+        # widths — the same finite-ladder posture as tsr-eval
+        from spark_fsm_tpu.models import spam_bitmap
+
+        skw = {k: v for k, v in ekw.items()
+               if k in ("node_batch", "pipeline_depth", "pool_bytes")}
+        sg = spam_bitmap.spam_geometry(ns, ni, nw, mesh=mesh,
+                                       use_pallas=use_pallas, **skw)
+        add(sg["shape_key"], kind="spam", n_sequences=ns, n_items=ni,
+            n_words=nw, max_tokens=max_tokens)
+        nd = 0
+        while nd <= sg["ni_pad"]:
+            add(key_spam_hybrid(sg["n_seq"], nw, sg["total_rows"],
+                                sg["node_batch"], sg["ni_pad"], nd),
+                kind="spam_hybrid", n_words=nw, nd_pad=nd,
+                tile=sg["tile"], s_block=sg["s_block"],
+                n_seq_pad=sg["n_seq"], node_batch=sg["node_batch"],
+                total_rows=sg["total_rows"], ni_pad=sg["ni_pad"])
+            nd += sg["tile"]
+        w = 64
+        while w <= sg["chunk"]:
+            add(key_spam_pair(sg["n_seq"], nw, w),
+                kind="spam_pair", n_words=nw, width=w,
+                n_seq_pad=sg["n_seq"], node_batch=sg["node_batch"],
+                total_rows=sg["total_rows"])
+            w *= 2
         for maxgap, maxwindow in spec.constraints:
             cg = spade_constrained.cspade_geometry(
                 ns, ni, nw, maxgap=maxgap, maxwindow=maxwindow, mesh=mesh,

@@ -1,0 +1,151 @@
+"""Fused extension-count-prune Pallas kernel (ISSUE 16,
+ops/pallas_extend.py): interpret-mode parity with the numpy ops and the
+jnp reference, the survivor-mask bit contract, and the grid/traffic
+model pinned against the committed KERNELS.json entry.
+
+The kernel itself is TPU-targeted; on the CPU test backend it runs
+through the Pallas interpreter, which exercises identical index/block
+logic (same arrangement as tests/test_pallas_support.py).
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_fsm_tpu.ops import pallas_extend as PE
+from spark_fsm_tpu.ops.pallas_support import (
+    I_TILE, P_TILE, S_BLOCK, seq_block)
+
+
+def _rand_words(rng, *shape):
+    # sparse-ish bitmaps
+    return (rng.integers(0, 2**32, shape, dtype=np.uint32)
+            & rng.integers(0, 2**32, shape, dtype=np.uint32)
+            & rng.integers(0, 2**32, shape, dtype=np.uint32))
+
+
+def _mask_bit(mask, p, i):
+    return (int(mask[p, i // 32]) >> (i % 32)) & 1
+
+
+def test_extend_count_prune_matches_numpy():
+    rng = np.random.default_rng(0)
+    P, NI, S = 2 * P_TILE, 21, S_BLOCK
+    pt = _rand_words(rng, P, S)
+    store = _rand_words(rng, I_TILE, S)
+    store[NI:] = 0  # pad lanes are all-zero rows (the engine contract)
+    want = np.array([[np.count_nonzero(pt[p] & store[i])
+                      for i in range(NI)] for p in range(P)])
+    thr = int(np.median(want))  # both sides of the threshold populated
+    assert (want >= thr).any() and (want < thr).any()
+    sup, mask = PE.extend_count_prune(
+        jnp.asarray(pt)[:, None, :], jnp.asarray(store)[:, None, :],
+        jnp.int32(thr), NI, interpret=True)
+    sup, mask = np.asarray(sup), np.asarray(mask)
+    ni = -(-NI // I_TILE) * I_TILE
+    assert sup.shape == (P, ni) and mask.shape == (P, ni // 32)
+    for p in range(P):
+        for i in range(NI):
+            w = want[p, i]
+            # exact count where it survives, EXACTLY 0 where it dies
+            assert sup[p, i] == (w if w >= thr else 0), (p, i)
+            assert _mask_bit(mask, p, i) == int(w >= thr), (p, i)
+    # pad lanes (all-zero item rows) never survive a thr >= 1
+    assert not sup[:, NI:].any() and not np.asarray(
+        [[_mask_bit(mask, p, i) for i in range(NI, ni)]
+         for p in range(P)]).any()
+
+
+def test_extend_count_prune_multiword():
+    rng = np.random.default_rng(3)
+    W = 3
+    sb = seq_block(W)
+    P, NI, S = P_TILE, 17, 2 * sb
+    pt = _rand_words(rng, P, W, S)
+    items = _rand_words(rng, I_TILE, W, S)
+    want = np.array([[np.count_nonzero((pt[p] & items[i]).any(axis=0))
+                      for i in range(NI)] for p in range(P)])
+    thr = int(np.median(want))
+    sup, mask = PE.extend_count_prune(
+        jnp.asarray(pt), jnp.asarray(items), jnp.int32(thr), NI,
+        s_block=sb, interpret=True)
+    sup, mask = np.asarray(sup), np.asarray(mask)
+    for p in range(P):
+        for i in range(NI):
+            w = want[p, i]
+            assert sup[p, i] == (w if w >= thr else 0), (p, i)
+            assert _mask_bit(mask, p, i) == int(w >= thr), (p, i)
+
+
+def test_kernel_matches_jnp_reference_and_diffset_identity():
+    """The kernel and ``extend_count_prune_jnp`` are byte-identical on
+    the same inputs, and the reference's dEclat flag never changes the
+    bytes (exact identity) — so the kernel needs no diffset leg."""
+    rng = np.random.default_rng(7)
+    W = 2
+    sb = seq_block(W)
+    P, NI, S = P_TILE, 33, sb
+    pt = _rand_words(rng, P, W, S)
+    items = _rand_words(rng, I_TILE, W, S)
+    thr = 3
+    sup_k, mask_k = PE.extend_count_prune(
+        jnp.asarray(pt), jnp.asarray(items), jnp.int32(thr), NI,
+        s_block=sb, interpret=True)
+    for flag in (False, True):
+        sup_r, mask_r = PE.extend_count_prune_jnp(
+            jnp.asarray(pt.transpose(0, 2, 1)),
+            jnp.asarray(items.transpose(0, 2, 1))[:NI],
+            thr, jnp.full(P, flag))
+        assert np.array_equal(np.asarray(sup_k)[:, :NI],
+                              np.asarray(sup_r))
+        ref_bits = np.asarray(mask_r)
+        got_bits = np.asarray(mask_k)[:, :ref_bits.shape[1]]
+        # the reference packs ceil(NI/32) words; the kernel's extra
+        # pad-lane words must be dead
+        tail = 32 - (NI % 32 or 32)
+        keep = np.uint32(0xFFFFFFFF) >> np.uint32(tail)
+        assert np.array_equal(got_bits[:, :-1], ref_bits[:, :-1])
+        assert np.array_equal(got_bits[:, -1] & keep, ref_bits[:, -1])
+
+
+def test_threshold_is_traced():
+    """One compiled kernel serves every threshold: the same callable at
+    thr=1 returns the full counts (every nonzero lane survives) and at
+    a huge thr returns all-zero."""
+    rng = np.random.default_rng(11)
+    P, S = P_TILE, S_BLOCK
+    pt = _rand_words(rng, P, S)
+    store = _rand_words(rng, I_TILE, S)
+    lo, _ = PE.extend_count_prune(
+        jnp.asarray(pt)[:, None, :], jnp.asarray(store)[:, None, :],
+        jnp.int32(1), 8, interpret=True)
+    hi, hi_mask = PE.extend_count_prune(
+        jnp.asarray(pt)[:, None, :], jnp.asarray(store)[:, None, :],
+        jnp.int32(S + 1), 8, interpret=True)
+    want = np.array([[np.count_nonzero(pt[p] & store[i])
+                      for i in range(8)] for p in range(P)])
+    assert np.array_equal(np.asarray(lo)[:, :8], want)
+    assert not np.asarray(hi).any() and not np.asarray(hi_mask).any()
+
+
+def test_grid_model_pins_committed_kernels_entry():
+    """The committed KERNELS.json structural entry for the fused kernel
+    derives from ``grid_model`` at the headline geometry — this is the
+    drift tripwire between the model, the bench and the committed
+    artifact."""
+    gm = PE.grid_model(2048, 384, 1, 77824)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "KERNELS.json")
+    with open(path) as fh:
+        entry = [k for k in json.load(fh)["kernels"]
+                 if k["kernel"].startswith("extend_count_prune")][0]
+    assert entry["structural"] is True
+    assert entry["traffic_model_bytes"] == gm["model_bytes"]
+    assert entry["min_useful_bytes"] == gm["min_useful_bytes"]
+    assert entry["vpu_model"]["total_vpu_ops"] == gm["vpu_ops"]
+    assert entry["vpu_model"]["grid_steps"] == gm["grid_steps"]
+    assert entry["vpu_model"]["ops_per_word"] == PE.EXTEND_VPU_OPS_PER_WORD
+    assert entry["vpu_model"]["epilogue_ops_per_lane"] == \
+        PE.EPILOGUE_VPU_OPS_PER_LANE

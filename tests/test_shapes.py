@@ -55,6 +55,13 @@ def test_key_formats_are_the_engine_spellings():
     assert shapes.key_sweep(128, 1, 256, 128) == "sweep:s128w1r256i128"
     assert shapes.key_tsr_eval(128, 1, 4, 256) == "tsr-eval:s128w1km4c256"
     assert shapes.key_tsr_part(2, 128, 1) == "tsr-part:p2s128w1"
+    assert shapes.key_spam(128, 1, 530, 16, 64) == \
+        "spam:s128w1r530nb16i64"
+    # the hybrid key keeps the "spam:" prefix (same engine, same wave
+    # program family) and appends ONLY the dense-pad axis
+    assert shapes.key_spam_hybrid(128, 1, 530, 16, 64, 64) == \
+        "spam:s128w1r530nb16i64d64"
+    assert shapes.key_spam_pair(128, 1, 256) == "spam-pair:s128w1c256"
 
 
 def test_enumeration_covers_runtime_keys_no_drift():

@@ -210,6 +210,113 @@ def test_spam_geometry_bounds():
             * g["pipeline_depth"]) <= (32 << 20)
 
 
+# --------------------------------------- hybrid store + diffsets (ISSUE 16)
+
+
+def _db_mixed():
+    """Steep-zipf miniature: a couple of ~full-density head items plus
+    a long sparse tail — the shape whose alphabet a 0.5 crossover
+    genuinely splits (pinned inside the hybrid tests below)."""
+    return synthetic_db(seed=401, n_sequences=90, n_items=24,
+                        mean_itemsets=4.0, mean_itemset_size=1.3,
+                        zipf_s=2.2)
+
+
+def test_spam_hybrid_matches_oracle():
+    db = _db_mixed()
+    ms = abs_minsup(0.08, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    stats = {}
+    got = patterns_text(mine_spam_tpu(db, ms, stats_out=stats,
+                                      density_crossover=0.5))
+    assert got == want
+    # the store genuinely split and both evaluation paths ran
+    assert stats["rep_dense"] > 0 and stats["rep_idlist"] > 0
+    assert stats["pair_launches"] > 0
+    assert stats["diffset_nodes"] > 0
+    assert stats["wave_survivors"] > 0
+    # hybrid mines publish the dense-pad-suffixed spelling of the SAME
+    # key family (prefix-compatible with every spam: consumer)
+    assert stats["shape_key"].startswith("spam:")
+    assert f"d{64}" in stats["shape_key"]
+
+
+@pytest.mark.parametrize("rep", ["bitmap", "idlist"])
+def test_spam_representation_pin_parity(rep):
+    """Operator pins force a UNIFORM store; bytes never change."""
+    db = _db_mixed()
+    ms = abs_minsup(0.08, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    stats = {}
+    got = patterns_text(mine_spam_tpu(db, ms, stats_out=stats,
+                                      representation=rep))
+    assert got == want
+    assert stats["representation"] == rep
+    if rep == "bitmap":
+        assert stats["rep_idlist"] == 0 and stats["pair_launches"] == 0
+    else:
+        assert stats["rep_dense"] == 0 and stats["waves"] == 0
+
+
+@pytest.mark.parametrize("dd", [0, 1, None])
+def test_spam_diffset_depth_sweep(dd):
+    """The dEclat formulation is an exact identity: any diffset depth
+    (0 disables it) produces the same bytes, and the accounting stat
+    reflects the depth gate."""
+    db = _db_mixed()
+    ms = abs_minsup(0.08, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    for mine in (mine_spam_tpu, mine_spam_cpu):
+        stats = {}
+        kw = {} if dd is None else {"diffset_depth": dd}
+        assert patterns_text(mine(db, ms, stats_out=stats,
+                                  density_crossover=0.5, **kw)) == want
+        if dd == 0:
+            assert stats["diffset_nodes"] == 0
+        else:
+            assert stats["diffset_nodes"] > 0
+
+
+def test_spam_hybrid_mesh_parity():
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+
+    db = _db_mixed()
+    ms = abs_minsup(0.08, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    stats = {}
+    got = patterns_text(mine_spam_tpu(db, ms, mesh=make_mesh(8),
+                                      density_crossover=0.5,
+                                      stats_out=stats))
+    assert got == want
+    assert stats["rep_idlist"] > 0  # still hybrid under the mesh
+
+
+def test_spam_hybrid_pallas_interpret_parity():
+    """The fused Pallas wave path (interpret mode on CPU) is
+    byte-identical through the full hybrid engine."""
+    db = _db_mixed()
+    ms = abs_minsup(0.08, len(db))
+    want = patterns_text(mine_spade(db, ms))
+    assert patterns_text(mine_spam_tpu(db, ms, density_crossover=0.5,
+                                       use_pallas=True)) == want
+
+
+def test_spam_checkpoint_cross_representation_resume():
+    """Checkpoints are representation-INVARIANT: a snapshot taken under
+    the bitmap pin resumes under the hybrid (auto) store and the
+    id-list pin — same fingerprint, same final bytes.  The frontier
+    format records WHAT to mine, never HOW the store holds it."""
+    db = _db_mixed()
+    ms = abs_minsup(0.08, len(db))
+    vdb = build_vertical(db, min_item_support=ms)
+    want = patterns_text(mine_spade(db, ms))
+    mid = _mid_snapshot(SpamBitmapTPU, vdb, ms, representation="bitmap")
+    for kw in ({"density_crossover": 0.5}, {"representation": "idlist"}):
+        eng = SpamBitmapTPU(vdb, ms, **kw)
+        assert patterns_text(eng.mine(resume=mid)) == want
+        assert eng.stats["resumed_nodes"] > 0
+
+
 def test_spam_service_engine_kwargs_route():
     """The plugin route honors [engine] pool_bytes/node_batch and sheds
     constraints with a clear error."""

@@ -1,7 +1,9 @@
 import numpy as np
+import pytest
 
 from spark_fsm_tpu.data.spmf import parse_spmf
-from spark_fsm_tpu.data.vertical import abs_minsup, build_vertical
+from spark_fsm_tpu.data.vertical import (abs_minsup, build_vertical,
+                                         idlist_join_support, rep_plan)
 
 
 def test_bit_layout():
@@ -61,3 +63,78 @@ def test_abs_minsup():
 def test_nbytes():
     vdb = build_vertical(parse_spmf("1 -2\n"))
     assert vdb.nbytes() == 4
+
+
+# ----------------------------------------- hybrid store (ISSUE 16)
+
+
+def _mixed_vdb():
+    from spark_fsm_tpu.data.synth import synthetic_db
+
+    db = synthetic_db(seed=401, n_sequences=50, n_items=16,
+                      mean_itemsets=4.0, mean_itemset_size=1.3,
+                      zipf_s=2.2)
+    return build_vertical(db, min_item_support=2)
+
+
+def test_idlist_reconstructs_bitmap():
+    """The id-list is the SAME vertical database in sparse form: every
+    (seq, word, mask) token scatters back to exactly the item's dense
+    bitmap row, and the lengths accessor matches the token table."""
+    vdb = _mixed_vdb()
+    lens = vdb.idlist_lengths()
+    assert lens.sum() == vdb.tok_seq.size
+    for i in range(vdb.n_items):
+        ts, tw, tm = vdb.idlist(i)
+        assert ts.size == lens[i]
+        back = np.zeros((vdb.n_sequences, vdb.n_words), np.uint32)
+        np.bitwise_or.at(back, (ts, tw), tm)
+        assert np.array_equal(back, vdb.bitmaps[i])
+
+
+def test_idlist_join_support_matches_dense_join():
+    """The sparse join is byte-identical to the dense one for BOTH
+    extension kinds, for every (prefix item, extension item) pair —
+    the property that makes per-item representation routing a layout
+    choice, never a result choice."""
+    from spark_fsm_tpu.ops import bitops_np as B
+
+    vdb = _mixed_vdb()
+    for p in range(vdb.n_items):
+        plain = vdb.bitmaps[p]
+        sext = B.sext_transform(plain[None])[0]
+        for i in range(vdb.n_items):
+            for pref in (plain, sext):
+                want = int(B.support_popcount((pref & vdb.bitmaps[i])[None])[0])
+                assert idlist_join_support(pref, *vdb.idlist(i)) == want
+
+
+def test_diffset_identity_exact():
+    """sup(child) == sup(parent_row) - |diffset| exactly, for random
+    parent/child pairs where the child is an AND-down of the parent
+    (the only shape joins produce)."""
+    from spark_fsm_tpu.ops import bitops_np as B
+
+    rng = np.random.default_rng(5)
+    parent = rng.integers(0, 2**32, (30, 7, 2), dtype=np.uint32)
+    child = parent & rng.integers(0, 2**32, (30, 7, 2), dtype=np.uint32)
+    direct = B.support_popcount(child)
+    viadiff = B.support_from_diffset(B.support_popcount(parent),
+                                     B.diffset_count(parent, child))
+    assert np.array_equal(direct, viadiff)
+
+
+def test_rep_plan_split_and_pins():
+    sup = np.array([50, 10, 2, 0, 25])
+    plan = rep_plan(sup, 50, crossover=0.3)
+    assert plan.rep.tolist() == [True, False, False, False, True]
+    assert (plan.n_dense, plan.n_sparse, plan.hybrid) == (2, 3, True)
+    attrs = plan.as_attrs()
+    assert attrs["representation"] == "auto"
+    assert attrs["dense_items"] == 2 and attrs["idlist_items"] == 3
+    assert attrs["max_item_density"] == 1.0
+
+    assert rep_plan(sup, 50, crossover=0.3, pin="bitmap").rep.all()
+    assert not rep_plan(sup, 50, crossover=0.3, pin="idlist").rep.any()
+    with pytest.raises(ValueError, match="representation"):
+        rep_plan(sup, 50, crossover=0.3, pin="spam")
