@@ -224,7 +224,26 @@ def main() -> int:
                     # ISSUE 15 family: engine planner
                     # (service/planner.py) — present even when no AUTO
                     # request ever arrived
-                    "fsm_engine_selected_total"):
+                    "fsm_engine_selected_total",
+                    # ISSUE 17 families: prediction serving plane
+                    # (service/predictor.py + ops/rule_trie.py) —
+                    # present (zero) before any /predict ever arrives
+                    "fsm_predict_requests_total",
+                    "fsm_predict_waves_total",
+                    "fsm_predict_wave_jobs_count",
+                    "fsm_predict_artifact_builds_total",
+                    "fsm_predict_artifact_stale_rebuilds_total",
+                    "fsm_predict_artifact_evictions_total",
+                    "fsm_predict_artifact_cache_hits_total",
+                    "fsm_predict_artifact_cache_misses_total",
+                    "fsm_predict_artifact_cache_hit_ratio",
+                    "fsm_predict_fused_ratio",
+                    "fsm_predict_artifact_entries",
+                    "fsm_predict_artifact_bytes",
+                    "fsm_predict_artifact_age_seconds",
+                    "fsm_predict_e2e_seconds_count",
+                    "fsm_predict_window_wait_seconds_count",
+                    "fsm_predict_exec_seconds_count"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -264,7 +283,18 @@ def main() -> int:
                 # so "this engine never ran" reads as 0, not no-data
                 ("fsm_engine_selected_total", "engine",
                  {"SPADE", "SPADE_TPU", "SPAM", "SPAM_TPU", "TSR",
-                  "TSR_TPU"})):
+                  "TSR_TPU"}),
+                # ISSUE 17 vocabularies: read-path SLO priority classes
+                # + wave fusion modes + request outcomes
+                ("fsm_predict_e2e_seconds_count", "priority",
+                 {"high", "normal", "low"}),
+                ("fsm_predict_window_wait_seconds_count", "priority",
+                 {"high", "normal", "low"}),
+                ("fsm_predict_exec_seconds_count", "priority",
+                 {"high", "normal", "low"}),
+                ("fsm_predict_waves_total", "mode", {"fused", "solo"}),
+                ("fsm_predict_requests_total", "outcome",
+                 {"served", "failure", "no_rules"})):
             got = {m.group(1) for k in families.get(fam, {})
                    for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
             missing = want - got

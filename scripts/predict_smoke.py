@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Prediction-serving smoke: boot with ``[predict]`` on, train, prewarm
+the scoring ladder, drive 3 concurrent ``/predict`` requests over HTTP,
+assert ONE fused scoring wave + byte parity + live read-path telemetry.
+
+The CI companion to rescache_smoke/obs_smoke for the serving plane
+(ISSUE 17, service/predictor.py): it boots the real HTTP service with a
+held-open micro-batch window (250 ms — generous so the three
+concurrent posts deterministically land in one group), then
+
+- mines a base TSR job so the store holds a finished rule set;
+- ``POST /admin/prewarm`` with an empty MINING envelope (sequences=0)
+  so only the ``predict:*`` ladder from the boot ``[predict]`` floors
+  compiles — the read path's AOT contract;
+- fires 3 concurrent ``/predict`` posts against the same uid: they
+  must resolve through ONE fused (3-request) scoring wave, each
+  response byte-identical to the brute-force host oracle over the
+  served rules (and to the Questor ``/get/prediction`` slow path);
+- asserts no ``predict:*`` key appears in ``/admin/shapes`` drift
+  (zero live scoring compiles after prewarm), the fsm_predict_*
+  families are live on /metrics with the drill's counts, the
+  ``/admin/slo`` read-path block holds the three observations, and
+  ``/admin/predictor`` + ``/admin/rescache``-style stats surfaces show
+  the resident artifact.
+
+Usage: scripts/predict_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.ops import rule_trie
+    from spark_fsm_tpu.service.app import serve_background
+    from spark_fsm_tpu.service.model import deserialize_rules
+
+    cfgmod.set_config(cfgmod.parse_config({
+        "predict": {"window_ms": 250.0, "max_wave": 4, "topm": 4,
+                    "lanes_floor": 64, "depth_floor": 8}}))
+    srv = serve_background()
+    port = srv.server_port
+
+    def post(ep, **params):
+        data = urllib.parse.urlencode(params).encode()
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{ep}",
+                                    data=data, timeout=120) as r:
+            return r.read().decode()
+
+    failures = []
+    try:
+        db = synthetic_db(seed=81, n_sequences=80, n_items=10,
+                          mean_itemsets=3.0, mean_itemset_size=1.3)
+        resp = json.loads(post("/train", algorithm="TSR_TPU",
+                               source="INLINE", sequences=format_spmf(db),
+                               k="8", minconf="0.4", max_side="2",
+                               uid="pr-base"))
+        assert resp["status"] != "failure", resp
+        deadline = time.time() + 240.0
+        while time.time() < deadline:
+            st = json.loads(post("/status/pr-base"))
+            if st["status"] in ("finished", "failure"):
+                break
+            time.sleep(0.05)
+        if st["status"] != "finished":
+            failures.append(f"base train did not finish: {st}")
+
+        # prewarm ONLY the predict ladder (mining envelope zeroed): the
+        # boot [predict] floors imply predict:f64d8w{1,2,4}m4
+        report = json.loads(post("/admin/prewarm", sequences="0",
+                                 items="0", stream_batch_sequences="0",
+                                 fusion_jobs="0", partition_parts="0",
+                                 tsr="0"))
+        pkeys = [k for k in report.get("enumerated", [])
+                 if k.startswith("predict:")]
+        if not pkeys:
+            failures.append(f"prewarm enumerated no predict keys: "
+                            f"{report.get('enumerated')}")
+
+        # 3 concurrent predicts against the same artifact: the held
+        # window must fuse them into ONE scoring wave
+        queries = [("1,2", "normal"), ("2", "low"), ("3,4", "normal")]
+        out = {}
+
+        def fire(i, items, pr):
+            out[i] = json.loads(post("/predict/pr-base", items=items,
+                                     m="4", priority=pr))
+
+        ts = [threading.Thread(target=fire, args=(i, q, p))
+              for i, (q, p) in enumerate(queries)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60.0)
+        if any(t.is_alive() for t in ts):
+            failures.append("a /predict request wedged")
+
+        rules = deserialize_rules(
+            json.loads(post("/get/rules", uid="pr-base"))["data"]["rules"])
+        fused_seen = 0
+        for i, (items, _) in enumerate(queries):
+            r = out.get(i)
+            if r is None or r["status"] != "finished":
+                failures.append(f"predict {i} failed: {r}")
+                continue
+            stats = json.loads(r["data"]["stats"])
+            if stats.get("fused"):
+                fused_seen += 1
+            got = json.loads(r["data"]["predictions"])
+            prefix = sorted({int(x) for x in items.split(",") if x})
+            want = rule_trie.predict_host(rules, prefix, 4)
+            if (json.dumps(got, sort_keys=True)
+                    != json.dumps(want, sort_keys=True)):
+                failures.append(f"predict {i} not byte-identical to the "
+                                f"host oracle (items={items!r})")
+            # the slow path must agree too: /predict is a drop-in fast
+            # path for the Questor's /get/prediction
+            q = json.loads(post("/get/prediction", uid="pr-base",
+                                items=items, m="4"))
+            slow = json.loads(q["data"]["predictions"])[:4]
+            if (json.dumps(got, sort_keys=True)
+                    != json.dumps(slow, sort_keys=True)):
+                failures.append(f"predict {i} disagrees with "
+                                f"/get/prediction (items={items!r})")
+        if fused_seen < 3:
+            failures.append(f"expected all 3 requests in one fused wave, "
+                            f"only {fused_seen} report fused=true")
+
+        # zero live scoring compiles after prewarm: no predict:* key in
+        # the recorded-vs-enumerated drift (mining keys WILL drift here
+        # — the train above ran against a zeroed mining envelope)
+        shapes_rep = json.loads(post("/admin/shapes"))
+        pdrift = [k for k in (shapes_rep.get("drift") or [])
+                  if k.startswith("predict:")]
+        if pdrift:
+            failures.append(f"live predict compiles after prewarm: "
+                            f"{pdrift}")
+
+        # live metric families with the drill's counts
+        text = post("/metrics")
+
+        def total(fam, **labels):
+            want = set(f'{k}="{v}"' for k, v in labels.items())
+            vals = []
+            for line in text.splitlines():
+                if not line.startswith(fam):
+                    continue
+                rest = line[len(fam):]
+                if rest[:1] not in (" ", "{"):
+                    continue
+                if want and not all(w in rest for w in want):
+                    continue
+                vals.append(float(line.rsplit(" ", 1)[1]))
+            return sum(vals) if vals else None
+
+        for fam, labels, floor in (
+                ("fsm_predict_requests_total", {"outcome": "served"}, 3),
+                ("fsm_predict_waves_total", {"mode": "fused"}, 1),
+                ("fsm_predict_artifact_builds_total", {}, 1),
+                ("fsm_predict_artifact_cache_misses_total", {}, 1),
+                ("fsm_predict_e2e_seconds_count", {"priority": "normal"}, 2),
+                ("fsm_predict_artifact_entries", {}, 1)):
+            got = total(fam, **labels)
+            if got is None:
+                failures.append(f"/metrics missing family {fam} {labels}")
+            elif got < floor:
+                failures.append(f"{fam}{labels} = {got} < {floor}")
+
+        # read-path SLO block live on /admin/slo
+        slo = json.loads(post("/admin/slo"))
+        pblock = slo.get("predict", {})
+        n_obs = sum(pblock.get(p, {}).get("e2e", {}).get("count", 0)
+                    for p in ("high", "normal", "low"))
+        if n_obs < 3:
+            failures.append(f"/admin/slo predict block holds {n_obs} < 3 "
+                            f"observations: {pblock}")
+
+        # resident artifact visible on the admin surface
+        pstats = json.loads(post("/admin/predictor"))
+        if not pstats.get("cache", {}).get("resident"):
+            failures.append(f"/admin/predictor shows no resident "
+                            f"artifact: {pstats}")
+    finally:
+        srv.master.shutdown()
+        srv.shutdown()
+        cfgmod.set_config(cfgmod.parse_config({}))
+    if failures:
+        print("predict_smoke: FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print("predict_smoke: 3 concurrent /predict requests fused into one "
+          "scoring wave with byte parity vs the host oracle AND the "
+          "Questor slow path, zero live predict compiles after prewarm, "
+          "fsm_predict_* families + /admin/slo read-path block live")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

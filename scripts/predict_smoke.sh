@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Prediction-serving smoke — the ISSUE 17 companion to rescache_smoke.sh
+# and obs_smoke.sh.  Boots the service with [predict] on and a held-open
+# micro-batch window, trains a rule set, prewarm-compiles the scoring
+# ladder, then fires 3 concurrent /predict requests: ONE fused scoring
+# wave, byte parity vs the host oracle and the Questor slow path, zero
+# live predict compiles, live fsm_predict_* families + /admin/slo
+# read-path quantiles.
+cd "$(dirname "$0")/.."
+exec timeout -k 30 600 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/predict_smoke.py "$@"

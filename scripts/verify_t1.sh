@@ -43,6 +43,11 @@
 #                        bit-exact survivor mask, diffset identity) +
 #                        Pallas-interpret byte parity + hybrid-store
 #                        mine parity across every representation pin
+#   predict_smoke.sh     prediction serving plane: 3 concurrent
+#                        /predict requests fused into one scoring
+#                        wave, byte parity vs host oracle + Questor
+#                        slow path, zero live compiles after prewarm,
+#                        live fsm_predict_* + /admin/slo read block
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -55,7 +60,8 @@ if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
     for s in bench_smoke chaos_smoke obs_smoke overload_smoke \
              throughput_smoke resident_smoke partition_smoke \
              replica_smoke rescache_smoke autoscale_smoke \
-             storm_smoke fleet_smoke spam_smoke fused_smoke; do
+             storm_smoke fleet_smoke spam_smoke fused_smoke \
+             predict_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

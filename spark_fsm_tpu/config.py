@@ -586,6 +586,34 @@ class ClusterConfig:
 
 
 @dataclasses.dataclass
+class PredictConfig:
+    """Prediction serving plane (`POST /predict`, service/predictor.py):
+    mined rule sets compile into device-resident packed tries and
+    concurrent same-artifact requests fuse into one scoring wave.
+
+    ``window_ms`` is the micro-batch window (0 disables fusion — every
+    request launches solo); ``max_wave`` caps requests per wave (and
+    bounds the enumerated pow2 wave ladder prewarm compiles).  ``topm``
+    is the default consequent count when a request omits ``m``.
+    ``lanes_floor`` / ``depth_floor`` pad every artifact UP to a shared
+    geometry envelope so live predicts land on prewarmed shape keys
+    (the stream_seq_floor idea applied to serving); a longer observed
+    prefix or bigger rule set still works — it just compiles its own
+    geometry on first touch.  ``artifact_entries`` / ``artifact_bytes``
+    bound the compiled-trie LRU exactly like fusion's fused-prep cache.
+    """
+
+    enabled: bool = True
+    window_ms: float = 2.0
+    max_wave: int = 16
+    topm: int = 8
+    lanes_floor: int = 1024
+    depth_floor: int = 16
+    artifact_entries: int = 8
+    artifact_bytes: int = 256 << 20
+
+
+@dataclasses.dataclass
 class Config:
     service: ServiceConfig = dataclasses.field(default_factory=ServiceConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
@@ -610,6 +638,8 @@ class Config:
         default_factory=StoreGuardConfig)
     planner: PlannerConfig = dataclasses.field(
         default_factory=PlannerConfig)
+    predict: PredictConfig = dataclasses.field(
+        default_factory=PredictConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -661,6 +691,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "autoscale": (AutoscaleConfig, top.pop("autoscale", {})),
         "storeguard": (StoreGuardConfig, top.pop("storeguard", {})),
         "planner": (PlannerConfig, top.pop("planner", {})),
+        "predict": (PredictConfig, top.pop("predict", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -817,6 +848,20 @@ def parse_config(obj: Dict[str, Any]) -> Config:
     if cfg.planner.diffset_depth < 0:
         raise ConfigError(
             "planner.diffset_depth must be >= 0 (0 disables diffsets)")
+    if cfg.predict.window_ms < 0:
+        raise ConfigError("predict.window_ms must be >= 0 (0 = no fusion)")
+    if cfg.predict.max_wave < 1:
+        raise ConfigError("predict.max_wave must be >= 1")
+    if cfg.predict.topm < 1:
+        raise ConfigError("predict.topm must be >= 1")
+    if cfg.predict.lanes_floor < 0 or cfg.predict.depth_floor < 0:
+        raise ConfigError(
+            "predict.lanes_floor / depth_floor must be >= 0 "
+            "(0 = size each artifact exactly; no shared prewarm envelope)")
+    if cfg.predict.artifact_entries < 1:
+        raise ConfigError("predict.artifact_entries must be >= 1")
+    if cfg.predict.artifact_bytes < 1:
+        raise ConfigError("predict.artifact_bytes must be >= 1")
     return cfg
 
 
@@ -881,6 +926,11 @@ def set_config(cfg: Config) -> None:
     from spark_fsm_tpu.service import obsplane
 
     obsplane.configure(cfg.observability)
+    # the prediction plane's broker window + artifact cache budgets are
+    # process-global like fusion's (the Master routes into module state)
+    from spark_fsm_tpu.service import predictor
+
+    predictor.configure(cfg.predict)
 
 
 def engine_kwargs(*names: str) -> Dict[str, Any]:

@@ -1,0 +1,450 @@
+"""Device-resident packed rule trie + batched prefix->consequent scoring.
+
+The read half of the reference service (PAPER.md §0: clients POST
+train AND track/get) queries mined rules for next-item prediction.  At
+read QPS the host-side rule walk (service/actors.Questor) is the wrong
+shape: every request re-deserializes and re-scans the whole rule list.
+This module compiles a completed mine's rule set ONCE into a packed
+prefix trie resident in device memory, then scores whole WAVES of
+observed prefixes against it in a single fixed-shape launch — the
+RDD-Eclat observation (PAPERS.md) that a compiled vertical structure
+amortizes best when reused across many queries, applied to serving.
+
+Layout (all planes HBM-resident, pow2-padded so the compile is per
+geometry bucket, never per rule set):
+
+- ``ante_tok [F, D]`` int32 — one row per rule LANE (a lane is one
+  (rule, consequent-item) pair), the rule's antecedent itemset padded
+  with ``-1`` to the D token slots.  Pad lanes carry a ``-2`` sentinel
+  that can never match an observed item, so they are dead without a
+  separate mask plane.
+- CSR trie structure — unique antecedents are deduplicated into a
+  prefix trie (``trie_child_off/trie_child_tok/trie_child_node``,
+  child offsets CSR-style; ``trie_lane_off/trie_lane_ids`` attach
+  lanes to their terminal node).  The flat lane planes above are the
+  trie unrolled for the wave kernel; the CSR planes are the compact
+  spelling (shared-prefix compression is reported in ``stats``).
+- ``lane_item / lane_sup / lane_supx [F]`` int32 — consequent id +
+  confidence/support planes.  Confidence stays the exact integer pair
+  ``(sup, supx)`` end to end (utils/canonical keeps rule text
+  float-free for the same reason); the float division happens on the
+  host at response time, byte-identical to the Questor oracle's.
+- ``sel_rank / score_rank / lane_of_rank [F]`` int32 — the oracle's
+  ENTIRE comparison semantics, precomputed at compile time with the
+  oracle's own arithmetic (Python float confidence, stable payload
+  order).  ``sel_rank`` is the unique per-lane rank by (conf desc,
+  sup desc, payload order) — the per-item winner is the matched lane
+  with the smallest ``sel_rank``.  ``score_rank`` is the DENSE rank by
+  (conf desc, sup desc) — equal pairs share a rank so the cross-item
+  tie-break falls through to item id, exactly the oracle's
+  ``(-conf, -sup, item)`` sort key.  The device kernel therefore does
+  only int32 comparisons: no float op exists that could diverge.
+
+Scoring (``_score_fn``, one jitted program per ``predict:f{F}d{D}w{W}
+m{M}`` geometry — utils/shapes key, prewarmed like every other launch
+ladder): masked AND-fold of each lane's antecedent tokens over the
+wave's observed-prefix token lanes (the engines' evaluator idiom —
+models/tsr._eval_kernel folds candidate item rows the same way),
+scatter-min per consequent slot to pick each item's winning lane, then
+a stable int32 argsort for the top-m emit.  Rows are independent:
+fusing W requests into one wave cannot change any row's bytes (the
+positional-disjointness argument service/fusion.py already relies on,
+made trivial here by the kernel being integer-only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_fsm_tpu.utils import shapes
+from spark_fsm_tpu.utils.canonical import PatternResult, RuleResult, sort_patterns
+
+_PAD = -1          # unused antecedent token slot (matches vacuously)
+_DEAD = -2         # pad-lane sentinel (matches nothing)
+_BIG = np.int32(1 << 30)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Host reference — the Questor prediction semantics, verbatim
+# ---------------------------------------------------------------------------
+
+def predict_host(rules: Sequence[RuleResult], prefix: Sequence[int],
+                 m: int) -> List[dict]:
+    """Brute-force prefix -> top-m consequent scoring over the raw rule
+    list — the byte-parity reference for the device trie (and the exact
+    semantics service/actors.Questor serves on ``/get/prediction``)."""
+    have = set(int(i) for i in prefix)
+    best: Dict[int, tuple] = {}
+    for x, y, sup, supx in rules:
+        if supx <= 0 or not set(x) <= have:
+            continue
+        conf = sup / supx
+        for it in y:
+            if it in have:
+                continue
+            cur = best.get(it)
+            if cur is None or (conf, sup) > (cur[0], cur[1]):
+                best[it] = (conf, sup, supx, x, y)
+    ranked = sorted(best.items(), key=lambda kv: (-kv[1][0], -kv[1][1], kv[0]))
+    return [
+        {"item": it, "confidence": conf, "support": sup,
+         "antecedent_support": supx, "antecedent": list(x),
+         "consequent": list(y)}
+        for it, (conf, sup, supx, x, y) in ranked[:max(0, int(m))]
+    ]
+
+
+def rules_from_patterns(patterns: Sequence[PatternResult]) -> List[RuleResult]:
+    """Derive prediction rules from a frequent-SEQUENCE set (the SPADE/
+    SPAM engines emit patterns, not rules): for every pattern with >= 2
+    itemsets, antecedent = items of the prefix, consequent = the last
+    itemset's new items, supx = the prefix pattern's own support (the
+    set is closed under prefixes, so it is present).  Deterministic
+    (canonical pattern order) so the oracle and the trie consume the
+    same list in the same order."""
+    sup_of = {tuple(p): s for p, s in patterns}
+    rules: List[RuleResult] = []
+    for pat, sup in sort_patterns(patterns):
+        if len(pat) < 2:
+            continue
+        supx = sup_of.get(tuple(pat[:-1]))
+        if supx is None or supx <= 0:
+            continue
+        x = tuple(sorted({i for s in pat[:-1] for i in s}))
+        y = tuple(sorted(set(pat[-1]) - set(x)))
+        if not y:
+            continue
+        rules.append((x, y, int(sup), int(supx)))
+    return rules
+
+
+def rules_digest(payload: str) -> str:
+    """Content address of a serialized rule set — the artifact cache key
+    component that makes re-mine staleness a cache miss, not a bug."""
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Artifact compile
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RuleTrie:
+    """Compiled artifact: device planes + the host rule list they index."""
+
+    rules: List[RuleResult]            # payload order (oracle order)
+    lanes: int                         # real lanes (rule, cons-item) pairs
+    F: int                             # pow2 lane axis
+    D: int                             # pow2 antecedent/prefix token axis
+    digest: str                        # rule-set content digest
+    built_ts: float                    # host wall at build (staleness)
+    # device planes (jax arrays; see module docstring)
+    ante_tok: object = None
+    lane_item: object = None
+    lane_slot: object = None
+    sel_rank: object = None
+    lane_of_rank: object = None
+    score_rank: object = None
+    lane_sup: object = None
+    lane_supx: object = None
+    # CSR trie planes (device-resident compact spelling)
+    trie_child_off: object = None
+    trie_child_tok: object = None
+    trie_child_node: object = None
+    trie_lane_off: object = None
+    trie_lane_ids: object = None
+    # host mirrors for response decode
+    h_lane_rule: Optional[np.ndarray] = None
+    h_lane_item: Optional[np.ndarray] = None
+    stats: Optional[dict] = None
+
+    def nbytes(self) -> int:
+        total = 0
+        for f in ("ante_tok", "lane_item", "lane_slot", "sel_rank",
+                  "lane_of_rank", "score_rank", "lane_sup", "lane_supx",
+                  "trie_child_off", "trie_child_tok", "trie_child_node",
+                  "trie_lane_off", "trie_lane_ids"):
+            arr = getattr(self, f)
+            if arr is not None:
+                total += int(np.asarray(arr).nbytes)
+        return total
+
+
+def _build_csr(antes: List[Tuple[int, ...]],
+               lane_ante: List[int]) -> dict:
+    """Prefix trie over the unique antecedent token sequences; children
+    CSR-packed per node, lanes attached to their terminal node."""
+    children: List[Dict[int, int]] = [{}]
+    node_of_ante: List[int] = []
+    for ante in antes:
+        node = 0
+        for t in ante:
+            nxt = children[node].get(t)
+            if nxt is None:
+                nxt = len(children)
+                children[node][t] = nxt
+                children.append({})
+            node = nxt
+        node_of_ante.append(node)
+    n = len(children)
+    child_off = np.zeros(n + 1, np.int32)
+    toks: List[int] = []
+    kids: List[int] = []
+    for i, ch in enumerate(children):
+        for t in sorted(ch):
+            toks.append(t)
+            kids.append(ch[t])
+        child_off[i + 1] = len(toks)
+    lanes_at: List[List[int]] = [[] for _ in range(n)]
+    for lane, ai in enumerate(lane_ante):
+        lanes_at[node_of_ante[ai]].append(lane)
+    lane_off = np.zeros(n + 1, np.int32)
+    lane_ids: List[int] = []
+    for i, ls in enumerate(lanes_at):
+        lane_ids.extend(ls)
+        lane_off[i + 1] = len(lane_ids)
+    return {
+        "child_off": child_off,
+        "child_tok": np.asarray(toks or [0], np.int32),
+        "child_node": np.asarray(kids or [0], np.int32),
+        "lane_off": lane_off,
+        "lane_ids": np.asarray(lane_ids or [0], np.int32),
+        "n_nodes": n,
+        "token_slots": sum(len(a) for a in antes),
+    }
+
+
+def build_trie(rules: Sequence[RuleResult], *, lanes_floor: int = 0,
+               depth_floor: int = 0, device_put: bool = True) -> RuleTrie:
+    """Compile a rule list into the packed trie artifact.
+
+    ``lanes_floor``/``depth_floor`` pad the geometry UP to the declared
+    prewarm envelope so a live artifact lands on an already-compiled
+    ``predict:*`` key (the stream_seq_floor idea applied to serving).
+    """
+    import time as _time
+
+    rules = [(tuple(int(i) for i in x), tuple(int(i) for i in y),
+              int(sup), int(supx))
+             for x, y, sup, supx in rules if int(supx) > 0]
+    # lanes in payload order: rule r, consequent item y[j]
+    lane_rule: List[int] = []
+    lane_item: List[int] = []
+    antes: List[Tuple[int, ...]] = []
+    ante_ix: Dict[Tuple[int, ...], int] = {}
+    lane_ante: List[int] = []
+    for r, (x, y, sup, supx) in enumerate(rules):
+        ai = ante_ix.get(x)
+        if ai is None:
+            ai = ante_ix[x] = len(antes)
+            antes.append(x)
+        for it in y:
+            lane_rule.append(r)
+            lane_item.append(it)
+            lane_ante.append(ai)
+    L = len(lane_rule)
+    depth = max([len(x) for x, *_ in rules], default=0)
+    F = _next_pow2(max(L, lanes_floor, 1))
+    D = _next_pow2(max(depth, depth_floor, 1))
+
+    # the oracle's comparison semantics, precomputed with the oracle's
+    # own arithmetic: conf is a PYTHON float (sup/supx) so float64
+    # collisions tie exactly where the Questor walk ties
+    conf = [rules[lane_rule[i]][2] / rules[lane_rule[i]][3]
+            for i in range(L)]
+    sups = [rules[lane_rule[i]][2] for i in range(L)]
+    order = sorted(range(L), key=lambda i: (-conf[i], -sups[i], i))
+    sel_rank = np.arange(F, dtype=np.int32)
+    lane_of_rank = np.arange(F, dtype=np.int32)
+    for rank, lane in enumerate(order):
+        sel_rank[lane] = rank
+        lane_of_rank[rank] = lane
+    score_rank = np.full(F, _BIG, np.int32)
+    rank = -1
+    prev = None
+    for r_pos, lane in enumerate(order):
+        key = (conf[lane], sups[lane])
+        if key != prev:
+            rank = r_pos  # dense-enough: equal pairs share, order holds
+            prev = key
+        score_rank[lane] = rank
+
+    # dense consequent slots sorted by item id (slot asc == item asc,
+    # the oracle's final tie-break axis)
+    slot_items = sorted(set(lane_item))
+    slot_of = {it: s for s, it in enumerate(slot_items)}
+
+    ante_tok = np.full((F, D), _PAD, np.int32)
+    ante_tok[L:, 0] = _DEAD
+    l_item = np.full(F, -3, np.int32)
+    l_slot = np.zeros(F, np.int32)
+    l_sup = np.zeros(F, np.int32)
+    l_supx = np.zeros(F, np.int32)
+    for i in range(L):
+        x = rules[lane_rule[i]][0]
+        ante_tok[i, :len(x)] = x
+        l_item[i] = lane_item[i]
+        l_slot[i] = slot_of[lane_item[i]]
+        l_sup[i] = rules[lane_rule[i]][2]
+        l_supx[i] = rules[lane_rule[i]][3]
+
+    csr = _build_csr(antes, lane_ante)
+    digest = hashlib.sha256(repr(rules).encode()).hexdigest()
+    art = RuleTrie(
+        rules=rules, lanes=L, F=F, D=D, digest=digest,
+        built_ts=_time.time(),
+        h_lane_rule=np.asarray(lane_rule or [0], np.int32),
+        h_lane_item=np.asarray(l_item),
+        stats={
+            "rules": len(rules), "lanes": L, "F": F, "D": D,
+            "consequent_slots": len(slot_items),
+            "trie_nodes": csr["n_nodes"],
+            # shared-prefix compression: token slots the trie stores
+            # once vs the flat per-antecedent total
+            "token_slots_flat": csr["token_slots"],
+            "token_slots_trie": max(0, csr["n_nodes"] - 1),
+        })
+    planes = {
+        "ante_tok": ante_tok, "lane_item": l_item, "lane_slot": l_slot,
+        "sel_rank": sel_rank, "lane_of_rank": lane_of_rank,
+        "score_rank": score_rank, "lane_sup": l_sup, "lane_supx": l_supx,
+        "trie_child_off": csr["child_off"],
+        "trie_child_tok": csr["child_tok"],
+        "trie_child_node": csr["child_node"],
+        "trie_lane_off": csr["lane_off"],
+        "trie_lane_ids": csr["lane_ids"],
+    }
+    if device_put:
+        import jax
+
+        planes = {k: jax.device_put(v) for k, v in planes.items()}
+    for k, v in planes.items():
+        setattr(art, k, v)
+    return art
+
+
+# ---------------------------------------------------------------------------
+# Scoring kernel (jnp reference; one compile per geometry bucket)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _score_fn(F: int, D: int, W: int, M: int):
+    import jax
+    import jax.numpy as jnp
+
+    def body(ante_tok, lane_item, lane_slot, sel_rank, lane_of_rank,
+             score_rank, lane_sup, lane_supx, q_tok):
+        # masked AND-fold: every antecedent token slot is either pad or
+        # a member of the row's observed-prefix token lanes
+        member = (ante_tok[None, :, :, None]
+                  == q_tok[:, None, None, :]).any(-1)       # [W, F, D]
+        ok = (ante_tok[None, :, :] == _PAD) | member
+        matched = ok.all(-1)                                 # [W, F]
+        # the oracle never predicts an already-observed item
+        seen = (lane_item[None, :, None] == q_tok[:, None, :]).any(-1)
+        matched = matched & ~seen
+        key = jnp.where(matched, sel_rank[None, :], _BIG)
+        w_ix = jnp.arange(W, dtype=jnp.int32)[:, None]
+        slots = jnp.broadcast_to(lane_slot[None, :], (W, F))
+        best = jnp.full((W, F), _BIG, jnp.int32).at[
+            w_ix, slots].min(key)                            # per-slot winner
+        valid = best < _BIG
+        win = lane_of_rank[jnp.minimum(best, F - 1)]         # [W, F]
+        order_key = jnp.where(valid, score_rank[win], _BIG)
+        # stable argsort == (score_rank asc, slot asc) == the oracle's
+        # (-conf, -sup, item) — slots are item-ascending by construction
+        order = jnp.argsort(order_key, axis=-1)[:, :M]
+        top_valid = jnp.take_along_axis(valid, order, axis=-1)
+        top_lane = jnp.take_along_axis(win, order, axis=-1)
+        top_lane = jnp.where(top_valid, top_lane, -1)
+        safe = jnp.maximum(top_lane, 0)
+        top_sup = jnp.where(top_valid, lane_sup[safe], -1)
+        top_supx = jnp.where(top_valid, lane_supx[safe], -1)
+        return top_lane, top_sup, top_supx
+
+    return jax.jit(body)
+
+
+def warm_geometry(F: int, D: int, W: int, M: int) -> str:
+    """Compile (and record) the scoring program for one geometry bucket
+    with zero planes — the prewarm driver's entry point."""
+    import jax.numpy as jnp
+
+    fn = _score_fn(F, D, W, M)
+    z = jnp.zeros((F, D), jnp.int32) + _DEAD
+    v = jnp.zeros((F,), jnp.int32)
+    q = jnp.full((W, D), _PAD, jnp.int32)
+    out = fn(z, v - 3, v, jnp.arange(F, dtype=jnp.int32),
+             jnp.arange(F, dtype=jnp.int32), v + _BIG, v, v, q)
+    out[0].block_until_ready()
+    key = shapes.key_predict(F, D, W, M)
+    shapes.record(key)
+    return key
+
+
+def score_wave(trie: RuleTrie, prefixes: Sequence[Sequence[int]],
+               m: int, *, wave_pad: int = 0) -> List[List[dict]]:
+    """Score a wave of observed prefixes; returns per-request top-m
+    entry lists in the Questor response spelling (host float division
+    over the winning lanes' exact integer pairs)."""
+    n = len(prefixes)
+    W = _next_pow2(max(n, wave_pad, 1))
+    M = _next_pow2(max(int(m), 1))
+    for p in prefixes:
+        if len(p) > trie.D:
+            raise ValueError(
+                f"observed prefix length {len(p)} exceeds trie depth "
+                f"{trie.D}; rebuild the artifact at a deeper geometry")
+    q = np.full((W, trie.D), _PAD, np.int32)
+    for i, p in enumerate(prefixes):
+        if p:
+            q[i, :len(p)] = np.asarray(list(p), np.int32)
+    fn = _score_fn(trie.F, trie.D, W, M)
+    top_lane, top_sup, top_supx = fn(
+        trie.ante_tok, trie.lane_item, trie.lane_slot, trie.sel_rank,
+        trie.lane_of_rank, trie.score_rank, trie.lane_sup, trie.lane_supx,
+        np.ascontiguousarray(q))
+    shapes.record(shapes.key_predict(trie.F, trie.D, W, M))
+    top_lane = np.asarray(top_lane)
+    top_sup = np.asarray(top_sup)
+    top_supx = np.asarray(top_supx)
+    out: List[List[dict]] = []
+    for i in range(n):
+        entries: List[dict] = []
+        # the kernel's argsort slice yields min(M, F) columns — a top-m
+        # pad wider than the lane axis cannot produce more winners than
+        # there are lanes
+        for j in range(min(int(m), M, top_lane.shape[1])):
+            lane = int(top_lane[i, j])
+            if lane < 0:
+                break
+            x, y, sup, supx = trie.rules[int(trie.h_lane_rule[lane])]
+            # the support planes rode the launch — cross-check the
+            # device's winner against the host rule it indexes
+            if int(top_sup[i, j]) != sup or int(top_supx[i, j]) != supx:
+                raise AssertionError(
+                    f"device support planes disagree with host rules at "
+                    f"lane {lane}: {(int(top_sup[i, j]), int(top_supx[i, j]))}"
+                    f" != {(sup, supx)}")
+            entries.append({
+                "item": int(trie.h_lane_item[lane]),
+                "confidence": sup / supx,
+                "support": sup,
+                "antecedent_support": supx,
+                "antecedent": list(x),
+                "consequent": list(y),
+            })
+        out.append(entries)
+    return out

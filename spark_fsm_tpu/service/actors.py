@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.service import (autoscale, fairness, lease, model,
-                                   obsplane, planner, plugins,
+                                   obsplane, planner, plugins, predictor,
                                    resultcache, sources, storeguard)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
@@ -2131,6 +2131,10 @@ class Master:
             self.miner._lease.start(self.miner,
                                     recover=lambda: recover_orphans(self))
         self.questor = Questor(self.store)
+        # the read plane (ISSUE 17, service/predictor.py): /predict
+        # compiles finished mines into device-resident rule tries and
+        # micro-batches concurrent scoring into fused waves
+        self.predictor = predictor.Predictor(self.store)
         self.tracker = Tracker(self.store)
         self.registrar = Registrar(self.store)
         self.streamer = Streamer(self.store)
@@ -2223,6 +2227,8 @@ class Master:
             return model.response(req, status, **extra)
         if task == "get":
             return self.questor.handle(req, subject or "patterns")
+        if task == "predict":
+            return self.predictor.handle(req)
         if task == "track":
             return self.tracker.handle(req, subject or "item")
         if task == "stream":
@@ -2235,6 +2241,7 @@ class Master:
     def shutdown(self) -> None:
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        self.predictor.shutdown()
         self.miner.shutdown()
 
 

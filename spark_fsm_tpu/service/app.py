@@ -168,13 +168,16 @@ class FsmHandler(BaseHTTPRequestHandler):
             self._admin(tail, data)
             return
         if head not in ("train", "status", "get", "track", "register",
-                        "index", "stream"):
+                        "index", "stream", "predict"):
             self._send(404, json.dumps({"status": "failure",
                                         "error": f"unknown endpoint /{head}"}))
             return
         if head == "status" and tail and "uid" not in data:
             data["uid"] = tail  # /status/{uid}
-        task = head if head in ("train", "status") else f"{head}:{tail}"
+        if head == "predict" and tail and "uid" not in data:
+            data["uid"] = tail  # /predict/{uid}
+        task = head if head in ("train", "status", "predict") \
+            else f"{head}:{tail}"
         req = ServiceRequest(service="fsm", task=task, data=data)
         try:
             resp = self.master.handle(req)
@@ -353,6 +356,12 @@ class FsmHandler(BaseHTTPRequestHandler):
                 a = self.master.autoscaler
                 self._send(200, json.dumps(
                     {"enabled": False} if a is None else a.stats()))
+            elif task == "predictor":
+                # prediction serving plane (service/predictor.py):
+                # request/wave counters, resident artifact inventory
+                # (digest + geometry + bytes per entry — the audit
+                # surface for cache keys), live [predict] config
+                self._send(200, json.dumps(self.master.predictor.stats()))
             elif task == "drain":
                 # forced scale-down (operator lever / autoscale smoke):
                 # run the drain protocol on a background thread and
@@ -480,6 +489,10 @@ def service_stats(master: Master) -> dict:
         # fsm_autoscale_*); None when [autoscale] is off
         "autoscale": (None if master.autoscaler is None
                       else master.autoscaler.stats()),
+        # prediction serving plane (service/predictor.py): request/wave
+        # counters + artifact-cache inventory (canonical series:
+        # fsm_predict_*)
+        "predictor": master.predictor.stats(),
         # store-outage guard (service/storeguard.py): health state +
         # spool/stall depth (canonical series: fsm_store_health_state /
         # fsm_storeguard_*); None when [storeguard] is off

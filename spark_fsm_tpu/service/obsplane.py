@@ -107,6 +107,31 @@ for _p in PRIORITIES:
     _QUEUE_WAIT_S.seed(priority=_p, tenant=DEFAULT_TENANT)
     _EXEC_S.seed(priority=_p, tenant=DEFAULT_TENANT)
 
+# the read-path signal class (ISSUE 17): /predict latencies are ms-scale
+# where mining jobs are seconds-scale, so they get their own histogram
+# families (sub-ms buckets) and their own sliding-quantile block in
+# /admin/slo — a flood of fast predicts must not drown the mining p99,
+# and a mining stall must not hide a read-path regression
+_PREDICT_E2E_S = obs.REGISTRY.histogram(
+    "fsm_predict_e2e_seconds",
+    "end-to-end /predict latency (request in -> predictions out), per "
+    "priority", buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                         0.05, 0.1, 0.25, 1.0, 5.0))
+_PREDICT_WINDOW_S = obs.REGISTRY.histogram(
+    "fsm_predict_window_wait_seconds",
+    "micro-batch window wait component (submit -> wave dispatch), per "
+    "priority", buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                         0.05, 0.1, 0.25, 1.0, 5.0))
+_PREDICT_EXEC_S = obs.REGISTRY.histogram(
+    "fsm_predict_exec_seconds",
+    "scoring-wave execution component (device launch + demux), per "
+    "priority", buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                         0.05, 0.1, 0.25, 1.0, 5.0))
+for _p in PRIORITIES:
+    _PREDICT_E2E_S.seed(priority=_p)
+    _PREDICT_WINDOW_S.seed(priority=_p)
+    _PREDICT_EXEC_S.seed(priority=_p)
+
 
 def seed_tenant(tenant: str) -> None:
     """Zero-seed the fsm_job_*_seconds series for a (fairness-
@@ -137,6 +162,13 @@ _slo = {
     "exec": obs.SlidingQuantiles(),
 }
 _slo_tenant_e2e = obs.SlidingQuantiles()
+# the read path's own sliding windows — same window knob, separate
+# samples (see the fsm_predict_* histogram comment above)
+_slo_predict = {
+    "e2e": obs.SlidingQuantiles(),
+    "window_wait": obs.SlidingQuantiles(),
+    "exec": obs.SlidingQuantiles(),
+}
 
 _lock = threading.Lock()
 _plane: Optional["TraceSpine"] = None
@@ -298,6 +330,8 @@ def configure(ocfg) -> None:
     for sq in _slo.values():
         sq.set_window(float(ocfg.slo_window_s))
     _slo_tenant_e2e.set_window(float(ocfg.slo_window_s))
+    for sq in _slo_predict.values():
+        sq.set_window(float(ocfg.slo_window_s))
 
 
 # ---------------------------------------------------------------- timeline
@@ -414,6 +448,22 @@ def observe_job(priority: str, e2e_s: float, queue_wait_s: float,
     _slo_tenant_e2e.observe(e2e_s, tenant=tenant)
 
 
+def observe_predict(priority: str, e2e_s: float, window_wait_s: float,
+                    exec_s: float) -> None:
+    """One served /predict's latency decomposition (request in ->
+    predictions out = window wait + wave execution) into the read-path
+    histogram families and sliding SLO windows — the second signal
+    class next to observe_job's mining-path one."""
+    if priority not in PRIORITIES:
+        priority = "normal"
+    _PREDICT_E2E_S.observe(e2e_s, priority=priority)
+    _PREDICT_WINDOW_S.observe(window_wait_s, priority=priority)
+    _PREDICT_EXEC_S.observe(exec_s, priority=priority)
+    _slo_predict["e2e"].observe(e2e_s, priority=priority)
+    _slo_predict["window_wait"].observe(window_wait_s, priority=priority)
+    _slo_predict["exec"].observe(exec_s, priority=priority)
+
+
 def slo_snapshot() -> dict:
     """The /admin/slo body: per-priority p50/p95/p99 (+count/max) of
     each latency component over the sliding window."""
@@ -429,6 +479,12 @@ def slo_snapshot() -> dict:
     # tenant gets a row — {"count": 0} until it finishes a job
     out["tenants"] = {t: _slo_tenant_e2e.stats(tenant=t)
                       for t in known_tenants()}
+    # read-path quantiles (ISSUE 17): /predict's own per-priority block
+    # so a dashboard can alert on serving p99 independently of mining
+    out["predict"] = {
+        p: {kind: sq.stats(priority=p)
+            for kind, sq in _slo_predict.items()}
+        for p in PRIORITIES}
     return out
 
 
@@ -455,6 +511,8 @@ def clear_slo() -> None:
     for sq in _slo.values():
         sq.clear()
     _slo_tenant_e2e.clear()
+    for sq in _slo_predict.values():
+        sq.clear()
 
 
 # ------------------------------------------------------ cluster collector

@@ -1,0 +1,591 @@
+"""Prediction serving plane: `/predict` over device-resident rule tries.
+
+The read half of the reference service at read QPS (ROADMAP item 1).
+Three pieces:
+
+- **Artifact cache** (:class:`ArtifactCache`): compiles a completed
+  mine's rule set into the ops/rule_trie.py packed trie, keyed by
+  ``(rule-set digest, geometry)`` — content-addressed, so a re-mine
+  that changes the rules is a MISS by construction (staleness is a
+  cache key, not a coherence protocol) — with LRU byte-bounding
+  exactly like the fusion broker's fused-prep cache (entry cap + byte
+  budget + never cache an entry over half the budget).  Build inputs
+  resolve from a finished job uid (the store's rules payload) or a
+  dataset fingerprint (the rescache entry service/resultcache.py keyed
+  by it); pattern payloads (SPADE/SPAM mines) are lowered to rules by
+  ``rule_trie.rules_from_patterns`` first.
+
+- **Micro-batch broker** (:class:`PredictBroker`): the fusion broker's
+  window machinery at serving latencies.  Concurrent requests against
+  the SAME (digest, geometry, top-m) key park in a bounded window
+  (milliseconds, not the mining broker's tens of ms) and dispatch as
+  ONE scoring wave — request rows are the per-lane job tags, demuxed
+  positionally on readback.  ``high`` priority makes the window due
+  immediately (the `_ready_key` idea), a full window dispatches in the
+  last joiner's thread, and disabling the window degrades every
+  request to a solo launch (the bench's unfused baseline).  Row
+  independence of the scoring kernel makes fusion byte-invariant (see
+  DESIGN.md); the parity smoke pins it.
+
+- **Serving surface** (:class:`Predictor`): the actor Master routes
+  ``predict`` tasks to.  Validates the request, resolves the rule
+  payload, gets-or-builds the artifact at the needed depth, rides the
+  broker, and answers in the Questor prediction spelling (same entry
+  shape, same exact host float division) so ``/predict`` is a drop-in
+  fast path for ``/get/prediction``.  Read-path latency lands in the
+  obsplane's second SLO signal class (``observe_predict`` ->
+  ``/admin/slo``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from spark_fsm_tpu.ops import rule_trie
+from spark_fsm_tpu.service import model, obsplane
+from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
+from spark_fsm_tpu.utils import obs
+from spark_fsm_tpu.utils.obs import log_event
+
+# ---------------------------------------------------------------------------
+# Metrics — every family zero-seeded so a fresh scrape shows 0, not
+# no-data (the obs_smoke no-orphan contract)
+# ---------------------------------------------------------------------------
+
+_REQS = obs.REGISTRY.counter(
+    "fsm_predict_requests_total", "predict requests by outcome")
+for _o in ("served", "failure", "no_rules"):
+    _REQS.seed(outcome=_o)
+_WAVES = obs.REGISTRY.counter(
+    "fsm_predict_waves_total", "scoring waves launched, by fusion mode")
+for _m in ("fused", "solo"):
+    _WAVES.seed(mode=_m)
+_WAVE_JOBS = obs.REGISTRY.histogram(
+    "fsm_predict_wave_jobs", "requests fused per scoring wave",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)).seed()
+_BUILDS = obs.REGISTRY.counter(
+    "fsm_predict_artifact_builds_total", "rule-trie artifact compiles")
+_STALE = obs.REGISTRY.counter(
+    "fsm_predict_artifact_stale_rebuilds_total",
+    "artifact rebuilds because the source's rule set changed (re-mine "
+    "invalidation observed through the content-addressed key)")
+_EVICTS = obs.REGISTRY.counter(
+    "fsm_predict_artifact_evictions_total", "artifact cache LRU evictions")
+_HITS = obs.REGISTRY.counter(
+    "fsm_predict_artifact_cache_hits_total", "artifact cache hits")
+_MISSES = obs.REGISTRY.counter(
+    "fsm_predict_artifact_cache_misses_total", "artifact cache misses")
+
+
+def _collect_metrics():
+    cache = _CACHE
+    hits, misses = _HITS.total(), _MISSES.total()
+    ratio = hits / (hits + misses) if (hits + misses) else 0.0
+    fused = solo = 0.0
+    # fused ratio = share of REQUESTS served by a >=2-job wave; the
+    # broker tallies jobs per mode under its own lock
+    with _stats_lock:
+        fused = float(_stats["fused_jobs"])
+        solo = float(_stats["solo_jobs"])
+    total_jobs = fused + solo
+    now = time.time()
+    age = 0.0
+    entries = bytes_ = 0
+    if cache is not None:
+        with cache._lock:
+            entries = len(cache._entries)
+            bytes_ = cache._bytes
+            if cache._entries:
+                age = max(now - trie.built_ts
+                          for trie, _ in cache._entries.values())
+    return [
+        ("fsm_predict_artifact_cache_hit_ratio", "gauge",
+         "artifact cache hits / lookups (process lifetime)",
+         [({}, round(ratio, 6))]),
+        ("fsm_predict_fused_ratio", "gauge",
+         "share of predict requests served by a fused (>=2 job) wave",
+         [({}, round(fused / total_jobs, 6) if total_jobs else 0.0)]),
+        ("fsm_predict_artifact_entries", "gauge",
+         "resident rule-trie artifacts", [({}, entries)]),
+        ("fsm_predict_artifact_bytes", "gauge",
+         "resident rule-trie artifact bytes", [({}, bytes_)]),
+        ("fsm_predict_artifact_age_seconds", "gauge",
+         "age of the OLDEST resident artifact (staleness horizon: an "
+         "artifact never outlives its digest, so age only measures how "
+         "long a rule set has gone without re-mining)", [({}, round(age, 3))]),
+    ]
+
+
+obs.REGISTRY.register_collector("predictor", _collect_metrics)
+
+_stats_lock = threading.Lock()
+_stats = {"requests": 0, "served": 0, "failures": 0, "waves": 0,
+          "fused_waves": 0, "fused_jobs": 0, "solo_jobs": 0,
+          "stale_rebuilds": 0, "exec_s": 0.0}
+
+
+def _bump(**kw) -> None:
+    with _stats_lock:
+        for k, v in kw.items():
+            _stats[k] = _stats.get(k, 0) + v
+
+
+# ---------------------------------------------------------------------------
+# Config (mirrors fusion.configure: set_config pushes the section here)
+# ---------------------------------------------------------------------------
+
+_cfg_lock = threading.Lock()
+_cfg = {
+    "enabled": True,
+    "window_ms": 2.0,
+    "max_wave": 16,
+    "topm": 8,
+    "lanes_floor": 1024,
+    "depth_floor": 16,
+    "cache_entries": 8,
+    "cache_bytes": 256 << 20,
+}
+
+
+def configure(pcfg) -> None:
+    """Apply a parsed ``[predict]`` config section (config.set_config)."""
+    global _CACHE
+    with _cfg_lock:
+        _cfg.update(
+            enabled=bool(pcfg.enabled),
+            window_ms=float(pcfg.window_ms),
+            max_wave=int(pcfg.max_wave),
+            topm=int(pcfg.topm),
+            lanes_floor=int(pcfg.lanes_floor),
+            depth_floor=int(pcfg.depth_floor),
+            cache_entries=int(pcfg.artifact_entries),
+            cache_bytes=int(pcfg.artifact_bytes),
+        )
+    _CACHE = ArtifactCache(int(pcfg.artifact_entries),
+                           int(pcfg.artifact_bytes))
+
+
+def _cfg_get(key: str):
+    with _cfg_lock:
+        return _cfg[key]
+
+
+# ---------------------------------------------------------------------------
+# Artifact cache
+# ---------------------------------------------------------------------------
+
+class ArtifactCache:
+    """LRU rule-trie cache keyed ``(digest, depth geometry)`` with the
+    fused-prep cache's byte-bounding rules: entry cap, byte budget, and
+    never cache a single artifact over half the budget (one giant rule
+    set must not flush the working set)."""
+
+    def __init__(self, max_entries: int, max_bytes: int) -> None:
+        self.max_entries = max(1, int(max_entries))
+        self.max_bytes = max(1, int(max_bytes))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple[str, int], Tuple[rule_trie.RuleTrie, int]]" = OrderedDict()
+        self._bytes = 0
+
+    def get_or_build(self, digest: str, depth_need: int,
+                     rules_provider: Callable[[], list],
+                     lanes_floor: int) -> rule_trie.RuleTrie:
+        key = (digest, int(depth_need))
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                _HITS.inc()
+                return hit[0]
+        _MISSES.inc()
+        trie = rule_trie.build_trie(rules_provider(),
+                                    lanes_floor=int(lanes_floor),
+                                    depth_floor=int(depth_need))
+        _BUILDS.inc()
+        nbytes = trie.nbytes()
+        if nbytes > self.max_bytes // 2:
+            # oversized artifacts serve this request but are never
+            # cached (the fused-prep half-budget rule)
+            log_event("predict_artifact_uncacheable", bytes=nbytes,
+                      budget=self.max_bytes, digest=digest[:12])
+            return trie
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = (trie, nbytes)
+                self._bytes += nbytes
+            while (len(self._entries) > self.max_entries
+                   or self._bytes > self.max_bytes):
+                old_key, (_, old_bytes) = self._entries.popitem(last=False)
+                self._bytes -= old_bytes
+                _EVICTS.inc()
+        return trie
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "resident": [
+                    {"digest": k[0][:16], "depth": k[1],
+                     "lanes": t.lanes, "F": t.F, "D": t.D,
+                     "bytes": b, "rules": len(t.rules),
+                     "age_s": round(time.time() - t.built_ts, 3)}
+                    for k, (t, b) in self._entries.items()],
+            }
+
+
+_CACHE: Optional[ArtifactCache] = None
+
+
+def _cache() -> ArtifactCache:
+    global _CACHE
+    if _CACHE is None:
+        _CACHE = ArtifactCache(_cfg_get("cache_entries"),
+                               _cfg_get("cache_bytes"))
+    return _CACHE
+
+
+# ---------------------------------------------------------------------------
+# Micro-batch broker
+# ---------------------------------------------------------------------------
+
+class _Ticket:
+    __slots__ = ("prefix", "priority", "event", "entries", "error",
+                 "submit_t", "dispatch_t", "exec_s", "wave_jobs", "tag")
+
+    def __init__(self, prefix: List[int], priority: str, tag: str) -> None:
+        self.prefix = prefix
+        self.priority = priority
+        self.tag = tag
+        self.event = threading.Event()
+        self.entries: Optional[List[dict]] = None
+        self.error: Optional[BaseException] = None
+        self.submit_t = time.monotonic()
+        self.dispatch_t = self.submit_t
+        self.exec_s = 0.0
+        self.wave_jobs = 1
+
+
+class _Group:
+    __slots__ = ("key", "trie", "m", "tickets", "due_t")
+
+    def __init__(self, key, trie, m: int, due_t: float) -> None:
+        self.key = key
+        self.trie = trie
+        self.m = m
+        self.tickets: List[_Ticket] = []
+        self.due_t = due_t
+
+
+class PredictBroker:
+    """Windowed same-geometry wave fusion for predict requests.
+
+    Groups key on ``(digest, F, D, m)`` — rows from different requests
+    against the same artifact concatenate into one launch.  The window
+    is per group from its FIRST joiner; ``high`` priority or a full
+    window makes it due immediately.  Due groups dispatch in the
+    scheduler thread (or, when full, in the last joiner's thread — no
+    context switch on the hot path).  The scoring call itself is
+    rule_trie.score_wave, so every row's bytes are independent of its
+    wave-mates (DESIGN.md: integer-only kernel, per-row reductions).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._groups: Dict[tuple, _Group] = {}
+        self._wake = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        # lazy like fusion's dispatcher pool: a boot that never predicts
+        # never pays a thread
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop,
+                                            name="fsm-predict-window",
+                                            daemon=True)
+            self._stopped = False
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                now = time.monotonic()
+                due = [k for k, g in self._groups.items() if g.due_t <= now]
+                groups = [self._groups.pop(k) for k in due]
+                if not groups:
+                    nxt = min((g.due_t for g in self._groups.values()),
+                              default=now + 0.05)
+                    self._wake.wait(timeout=max(0.0005, nxt - now))
+            for g in groups:
+                self._run_group(g)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+            leftovers = list(self._groups.values())
+            self._groups.clear()
+            self._wake.notify_all()
+        for g in leftovers:
+            self._run_group(g)
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, trie: rule_trie.RuleTrie, prefix: List[int], m: int,
+               priority: str, tag: str) -> _Ticket:
+        """Score one observed prefix; blocks until its wave lands.
+
+        Returns the completed ticket — ``entries`` plus the window-wait
+        and exec timings the read-path SLO wants split out.
+        """
+        window_s = max(0.0, float(_cfg_get("window_ms"))) / 1000.0
+        max_wave = max(1, int(_cfg_get("max_wave")))
+        t = _Ticket(prefix, priority, tag)
+        if (not _cfg_get("enabled")) or window_s <= 0.0 or max_wave <= 1:
+            g = _Group(None, trie, m, 0.0)
+            g.tickets.append(t)
+            self._run_group(g)
+            if t.error is not None:
+                raise t.error
+            return t
+        key = (trie.digest, trie.F, trie.D, int(m))
+        run_now: Optional[_Group] = None
+        with self._lock:
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = _Group(
+                    key, trie, int(m), time.monotonic() + window_s)
+            g.tickets.append(t)
+            if priority == "high":
+                # a high-priority joiner makes the whole group due NOW —
+                # riders already parked get the fast launch too (the
+                # fusion broker's _ready_key posture)
+                g.due_t = 0.0
+            if len(g.tickets) >= max_wave or g.due_t <= time.monotonic():
+                self._groups.pop(key, None)
+                run_now = g
+            else:
+                self._ensure_thread()
+                self._wake.notify_all()
+        if run_now is not None:
+            self._run_group(run_now)
+        t.event.wait(timeout=30.0)
+        if not t.event.is_set():
+            raise TimeoutError("predict wave never dispatched")
+        if t.error is not None:
+            raise t.error
+        return t
+
+    # -- execution ----------------------------------------------------------
+
+    def _run_group(self, g: _Group) -> None:
+        n = len(g.tickets)
+        t0 = time.monotonic()
+        try:
+            waves = rule_trie.score_wave(
+                g.trie, [t.prefix for t in g.tickets], g.m)
+            exec_s = time.monotonic() - t0
+            mode = "fused" if n >= 2 else "solo"
+            _WAVES.inc(mode=mode)
+            _WAVE_JOBS.observe(float(n))
+            _bump(waves=1, fused_waves=1 if n >= 2 else 0, exec_s=exec_s,
+                  **{("fused_jobs" if n >= 2 else "solo_jobs"): n})
+            log_event("predict_wave", jobs=n, mode=mode,
+                      wave_ms=round(exec_s * 1000.0, 3),
+                      tags=[t.tag for t in g.tickets])
+            for i, t in enumerate(g.tickets):
+                t.entries = waves[i]
+                t.dispatch_t = t0
+                t.exec_s = exec_s
+                t.wave_jobs = n
+                t.event.set()
+        except BaseException as exc:
+            for t in g.tickets:
+                t.error = exc
+                t.event.set()
+
+
+_BROKER = PredictBroker()
+
+
+def broker() -> PredictBroker:
+    return _BROKER
+
+
+# ---------------------------------------------------------------------------
+# Serving surface
+# ---------------------------------------------------------------------------
+
+class Predictor:
+    """``predict`` task handler: resolve rules, ride the broker, answer
+    in the Questor prediction spelling."""
+
+    def __init__(self, store) -> None:
+        self.store = store
+        self._src_lock = threading.Lock()
+        self._src_digest: "OrderedDict[str, str]" = OrderedDict()
+
+    # -- rule resolution ----------------------------------------------------
+
+    def _resolve_payload(self, req: ServiceRequest
+                         ) -> Tuple[Optional[str], Optional[str], str]:
+        """-> (payload, kind, source key) or (None, error message, "")."""
+        uid = req.uid
+        fp = req.param("fingerprint")
+        if uid:
+            status = self.store.status(uid)
+            if status is None:
+                return None, "unknown uid", ""
+            if status != Status.FINISHED:
+                return None, "job not finished; results pending", ""
+            payload = self.store.rules(uid)
+            if payload is not None:
+                return payload, "rules", f"uid:{uid}"
+            payload = self.store.patterns(uid)
+            if payload is not None:
+                return payload, "patterns", f"uid:{uid}"
+            return None, "no rules", ""
+        if fp:
+            from spark_fsm_tpu.service import resultcache
+
+            algo = (req.param("algorithm") or "TSR_TPU").upper()
+            raw = self.store.get(resultcache.entry_key(fp, algo))
+            if raw is None:
+                return None, "no rescache entry for fingerprint", ""
+            try:
+                ent = json.loads(raw)
+            except ValueError:
+                return None, "corrupt rescache entry", ""
+            return (ent.get("payload") or "[]",
+                    ent.get("kind") or "rules", f"fp:{fp}:{algo}")
+        return None, "predict needs 'uid' (finished job) or 'fingerprint'", ""
+
+    def _note_staleness(self, src: str, digest: str) -> None:
+        with self._src_lock:
+            prev = self._src_digest.get(src)
+            if prev is not None and prev != digest:
+                _STALE.inc()
+                _bump(stale_rebuilds=1)
+                log_event("predict_artifact_stale", source=src,
+                          prev=prev[:12], now=digest[:12])
+            self._src_digest[src] = digest
+            self._src_digest.move_to_end(src)
+            while len(self._src_digest) > 256:
+                self._src_digest.popitem(last=False)
+
+    # -- request handling ---------------------------------------------------
+
+    def handle(self, req: ServiceRequest) -> ServiceResponse:
+        t_start = time.monotonic()
+        priority = (req.param("priority") or "normal").lower()
+        if priority not in obsplane.PRIORITIES:
+            _REQS.inc(outcome="failure")
+            _bump(requests=1, failures=1)
+            return model.response(
+                req, Status.FAILURE,
+                error=f"unknown priority {priority!r} "
+                      f"(have: {', '.join(obsplane.PRIORITIES)})")
+        items_param = req.param("items")
+        if items_param is None:
+            _REQS.inc(outcome="failure")
+            _bump(requests=1, failures=1)
+            return model.response(
+                req, Status.FAILURE,
+                error="predict needs 'items' (comma-separated item ids "
+                      "observed so far; empty allowed)")
+        try:
+            prefix = sorted({int(i) for i in items_param.split(",") if i})
+        except ValueError:
+            _REQS.inc(outcome="failure")
+            _bump(requests=1, failures=1)
+            return model.response(req, Status.FAILURE,
+                                  error=f"bad 'items' value {items_param!r}")
+        try:
+            m = int(req.param("m") or _cfg_get("topm"))
+        except ValueError:
+            m = int(_cfg_get("topm"))
+        m = max(1, min(m, 256))
+
+        payload, kind, src = self._resolve_payload(req)
+        if payload is None:
+            outcome = "no_rules" if kind in ("no rules",
+                                             "no rescache entry for "
+                                             "fingerprint") else "failure"
+            _REQS.inc(outcome=outcome)
+            _bump(requests=1, failures=1)
+            return model.response(req, Status.FAILURE, error=kind)
+        digest = rule_trie.rules_digest(payload)
+        self._note_staleness(src, digest)
+
+        def rules_provider() -> list:
+            if kind == "patterns":
+                return rule_trie.rules_from_patterns(
+                    model.deserialize_patterns(payload))
+            return model.deserialize_rules(payload)
+
+        depth_floor = int(_cfg_get("depth_floor"))
+        depth_need = max(depth_floor, _next_pow2(max(1, len(prefix))))
+        try:
+            trie = _cache().get_or_build(digest, depth_need, rules_provider,
+                                         _cfg_get("lanes_floor"))
+            ticket = _BROKER.submit(trie, prefix, m, priority,
+                                    tag=req.uid or src)
+        except Exception as exc:
+            _REQS.inc(outcome="failure")
+            _bump(requests=1, failures=1)
+            log_event("predict_failed", source=src, error=str(exc))
+            return model.response(req, Status.FAILURE,
+                                  error=f"predict failed: {exc}")
+        e2e_s = time.monotonic() - t_start
+        window_wait_s = max(0.0, ticket.dispatch_t - ticket.submit_t)
+        # read-path SLO: the obsplane's second signal class
+        obsplane.observe_predict(priority, e2e_s, window_wait_s,
+                                 ticket.exec_s)
+        entries = ticket.entries or []
+        _REQS.inc(outcome="served")
+        _bump(requests=1, served=1)
+        return model.response(
+            req, Status.FINISHED,
+            predictions=json.dumps(entries),
+            stats=json.dumps({
+                "shape_key": f"predict:f{trie.F}d{trie.D}",
+                "artifact_digest": digest[:16],
+                "artifact_lanes": trie.lanes,
+                "source": src,
+                "fused": ticket.wave_jobs >= 2,
+                "wave_jobs": ticket.wave_jobs,
+                "m": m,
+                "priority": priority,
+                "e2e_ms": round(e2e_s * 1000.0, 3),
+                "window_wait_ms": round(window_wait_s * 1000.0, 3),
+                "exec_ms": round(ticket.exec_s * 1000.0, 3),
+            }))
+
+    def stats(self) -> dict:
+        with _stats_lock:
+            s = dict(_stats)
+        s["exec_s"] = round(s["exec_s"], 6)
+        s["cache"] = _cache().snapshot()
+        with _cfg_lock:
+            s["config"] = dict(_cfg)
+        return s
+
+    def shutdown(self) -> None:
+        _BROKER.shutdown()
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p

@@ -620,6 +620,8 @@ def _run_keys(targets, mesh, eng_sub) -> List[dict]:
                     _warm_resident(t, mesh)
                 elif t["kind"] == "sweep":
                     _warm_sweep(t, mesh)
+                elif t["kind"] == "predict":
+                    _warm_predict(t)
             except Exception as exc:  # a failed warm must not take down
                 err = f"{type(exc).__name__}: {exc}"  # boot
                 _COMPILE_ERRORS.inc()
@@ -634,6 +636,17 @@ def _run_keys(targets, mesh, eng_sub) -> List[dict]:
         rows.append(row)
         log_event("prewarm_key", **row)
     return rows
+
+
+def _warm_predict(t) -> None:
+    """Compile one rung of the /predict scoring ladder: the read plane's
+    first post-boot request must land on a cached executable like every
+    other subsystem's (ops/rule_trie.py owns the kernel; it warms with
+    zero planes at the exact (F, D, W, M) a live wave would trace)."""
+    from spark_fsm_tpu.ops import rule_trie
+
+    rule_trie.warm_geometry(int(t["lanes"]), int(t["depth"]),
+                            int(t["wave"]), int(t["topm"]))
 
 
 def last_report() -> Optional[dict]:
@@ -659,7 +672,26 @@ def spec_from_config(pc) -> Optional[shapes.WorkloadSpec]:
         stream_items=int(pc.stream_items),
         stream_seq_floor=int(pc.stream_seq_floor),
         checkpointed=bool(pc.checkpointed),
-        max_tokens=int(pc.max_tokens))
+        max_tokens=int(pc.max_tokens),
+        **_predict_defaults())
+
+
+def _predict_defaults() -> Dict[str, int]:
+    """The /predict scoring-ladder envelope the boot config implies:
+    with the prediction plane enabled, prewarm must cover the artifact
+    floor geometry across the pow2 wave ladder up to ``max_wave`` or
+    the first prewarmed predict pays a live compile.  Floors of 0 mean
+    per-artifact geometry (nothing enumerable) — skip."""
+    from spark_fsm_tpu import config
+
+    pc = config.get_config().predict
+    if not pc.enabled or pc.lanes_floor <= 0 or pc.depth_floor <= 0:
+        return {"predict_lanes": 0, "predict_depth": 0,
+                "predict_wave": 0, "predict_topm": 0}
+    return {"predict_lanes": int(pc.lanes_floor),
+            "predict_depth": int(pc.depth_floor),
+            "predict_wave": max(1, int(pc.max_wave)),
+            "predict_topm": max(1, int(pc.topm))}
 
 
 def _partition_parts_default() -> int:
@@ -716,4 +748,6 @@ def spec_from_params(params: Dict[str, str], pc) -> shapes.WorkloadSpec:
         stream_items=geti("stream_items", pc.stream_items),
         stream_seq_floor=geti("stream_seq_floor", pc.stream_seq_floor),
         checkpointed=truthy(params.get("checkpointed"), pc.checkpointed),
-        max_tokens=geti("max_tokens", pc.max_tokens))
+        max_tokens=geti("max_tokens", pc.max_tokens),
+        **{name: geti(name, default)
+           for name, default in _predict_defaults().items()})

@@ -870,35 +870,45 @@ class ResultCache:
             payload = model.serialize_patterns(sort_patterns(results))
         else:
             payload = model.serialize_rules(sort_rules(results))
+        # the rule-set digest the prediction plane keys its compiled
+        # artifacts on (ops/rule_trie.rules_digest over the SAME payload
+        # string) — stored on the entry AND the LRU sidecar so the
+        # stats/admin surface can audit (fingerprint, digest) pairs
+        # without pulling payloads off the store
+        from spark_fsm_tpu.ops.rule_trie import rules_digest
+
+        digest = rules_digest(payload)
         ent = json.dumps({
             "algo": plugin.name, "kind": plugin.kind, "params": params,
-            "n_sequences": n, "uid": req.uid,
+            "n_sequences": n, "uid": req.uid, "digest": digest,
             "ts": round(time.time(), 3), "payload": payload})
         self.store.set(entry_key(fp, plugin.name), ent)
         self.store.set(_lru_key(fp, plugin.name), json.dumps(
-            {"ts": time.time(), "bytes": len(ent)}))
+            {"ts": time.time(), "bytes": len(ent), "digest": digest}))
         _BYTES_TOTAL.inc(len(ent))
         log_event("rescache_entry_stored", uid=req.uid, fp=fp[:16],
                   algo=plugin.name, bytes=len(ent))
         self._evict()
 
     def _meta_rows(self):
-        """(last_used_ts, entry_key, tail, byte_size) for every resident
-        entry, read from the LRU sidecars — the eviction sweep and the
-        stats endpoint must not pull full payloads off the store (at
-        the default budget that would be up to 64 MiB per pass over a
-        Redis backend).  An entry whose sidecar is missing/corrupt
-        falls back to one payload read."""
+        """(last_used_ts, entry_key, tail, byte_size, digest) for every
+        resident entry, read from the LRU sidecars — the eviction sweep
+        and the stats endpoint must not pull full payloads off the
+        store (at the default budget that would be up to 64 MiB per
+        pass over a Redis backend).  An entry whose sidecar is
+        missing/corrupt falls back to one payload read (digest absent
+        for pre-sidecar-format entries)."""
         rows = []
         for key in self.store.scan_iter("fsm:rescache:"):
             tail = key[len("fsm:rescache:"):]
-            ts, size = 0.0, None
+            ts, size, digest = 0.0, None, None
             side = self.store.peek("fsm:rescache-lru:" + tail)
             if side:
                 try:
                     meta = json.loads(side)
                     ts = float(meta.get("ts") or 0.0)
                     size = int(meta["bytes"])
+                    digest = meta.get("digest")
                 except (ValueError, TypeError, KeyError):
                     pass
             if size is None:
@@ -906,7 +916,7 @@ class ResultCache:
                 if raw is None:
                     continue
                 size = len(raw)
-            rows.append((ts, key, tail, size))
+            rows.append((ts, key, tail, size, digest))
         return rows
 
     def _evict(self) -> None:
@@ -915,9 +925,10 @@ class ResultCache:
         ``max_bytes``.  Eviction is plain DELs — a concurrent serve
         that loses the race simply misses and mines cold."""
         rows = self._meta_rows()
-        total = sum(size for _, _, _, size in rows)
+        total = sum(size for _, _, _, size, _ in rows)
         if self.max_bytes:
-            for ts, key, tail, size in sorted(rows):
+            for ts, key, tail, size, _ in sorted(
+                    rows, key=lambda r: (r[0], r[1])):
                 if total <= self.max_bytes:
                     break
                 self.store.delete(key)
@@ -937,9 +948,22 @@ class ResultCache:
         try:
             rows = self._meta_rows()
             entries = len(rows)
-            bytes_total = sum(size for _, _, _, size in rows)
+            bytes_total = sum(size for _, _, _, size, _ in rows)
+            # auditable per-entry identity (ISSUE 17 satellite): the
+            # dataset fingerprint + algorithm the entry serves under and
+            # the rule-set digest the prediction plane's artifact cache
+            # keys on — an operator can now line /admin/predictor's
+            # resident digests up against the cache that fed them
+            detail = []
+            for ts, _, tail, size, digest in sorted(rows, reverse=True,
+                                                    key=lambda r: r[0]):
+                fp, _, algo = tail.rpartition(":")
+                detail.append({"fingerprint": fp, "algo": algo,
+                               "digest": digest, "bytes": size,
+                               "ts": round(ts, 3)})
         except Exception:
-            entries = bytes_total = None  # store down: stay readable
+            entries = bytes_total = detail = None  # store down: stay
+            # readable
         return {
             "enabled": True,
             "coalesce": self.coalesce_enabled,
@@ -947,6 +971,7 @@ class ResultCache:
             "max_bytes": self.max_bytes,
             "entries": entries,
             "bytes": bytes_total,
+            "entries_detail": detail,
             "inflight_leaders": leaders,
             "inflight_followers": followers,
             "counters": {

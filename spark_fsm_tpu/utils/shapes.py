@@ -62,6 +62,14 @@ Key formats (the geometry axes that decide compiled shapes):
   ``sweep:s{S}w{W}r{R}i{NI}``               streaming/incremental.py
                                             batch-store geometry (the
                                             config-5 mid-stream compile)
+  ``predict:f{F}d{D}w{W}m{M}``              ops/rule_trie.py batched
+                                            prefix->consequent scoring —
+                                            F pow2 rule-lane axis, D pow2
+                                            antecedent/prefix token
+                                            depth, W wave width (fused
+                                            request rows), M top-m pad;
+                                            recorded per launch by
+                                            score_wave
   ``tsr-part:p{P}s{S}w{W}``                 models/tsr.py TsrPartitioned
                                             (parallel/partition.py): the
                                             2-D parts x seq arrangement —
@@ -175,6 +183,16 @@ def key_spam_pair(n_seq: int, n_words: int, width: int) -> str:
     return f"spam-pair:s{n_seq}w{n_words}c{width}"
 
 
+def key_predict(lanes: int, depth: int, wave: int, m_pad: int) -> str:
+    """One batched rule-trie scoring geometry (ops/rule_trie.py): the
+    pow2 rule-lane axis F, the pow2 antecedent/observed-prefix token
+    depth D, the wave width W (concurrent request rows fused into one
+    launch by service/predictor.py), and the pow2 top-m pad M.  The
+    artifact compiler pads live rule sets UP to the declared envelope
+    floors so live predicts land on prewarmed keys."""
+    return f"predict:f{lanes}d{depth}w{wave}m{m_pad}"
+
+
 def key_sweep(n_seq: int, n_words: int, n_rows: int, ni_rows: int) -> str:
     return f"sweep:s{n_seq}w{n_words}r{n_rows}i{ni_rows}"
 
@@ -258,6 +276,15 @@ class WorkloadSpec:
     arrangement plus the per-part INNER ``tsr``/``tsr-eval`` ladder at
     the submesh-row geometry).  The boot spec sets it from
     ``[partition] parts`` when partitioning is enabled.
+    ``predict_lanes``/``predict_depth``/``predict_wave``/
+    ``predict_topm``: the prediction-serving envelope (ops/rule_trie.py
+    + service/predictor.py) — rule-lane floor, antecedent/prefix token
+    depth floor, max fused wave width, and default top-m.  When
+    ``predict_wave > 0`` the enumerator lists one ``predict:*`` key per
+    pow2 wave bucket 1..next_pow2(predict_wave) at the floored
+    lane/depth/top-m geometry (the artifact compiler pads live rule
+    sets up to the same floors, so live predicts land on these keys).
+    The boot spec sets them from ``[predict]``.
     """
 
     n_sequences: int
@@ -274,6 +301,10 @@ class WorkloadSpec:
     # enumeration without the floor would list the WRONG seq bucket
     sweep_row_buckets: int = 4
     checkpointed: bool = False
+    predict_lanes: int = 0
+    predict_depth: int = 0
+    predict_wave: int = 0
+    predict_topm: int = 0
     # token-table size bound for store-build warming: token-array LENGTH
     # is a traced shape of the scatter build (pow2-bucketed by
     # _common.scatter_build_store), so prewarm compiles the builder for
@@ -514,4 +545,24 @@ def enumerate_shapes(spec: WorkloadSpec, *, mesh=None,
                 seq_floor=int(spec.stream_seq_floor),
                 ni_rows=ni_rows, n_rows=rows)
             rows *= 2
+
+    if spec.predict_wave > 0 and spec.predict_lanes > 0:
+        # prediction-serving scoring ladder (ops/rule_trie.py): one
+        # compiled program per (F, D, W, M) bucket.  F/D/M come from the
+        # declared envelope floors (the artifact compiler pads live rule
+        # sets up to the same floors — rule_trie.build_trie), W walks
+        # the pow2 wave ladder 1..max wave because the predict broker
+        # pads each dispatched group to the next bucket.
+        from spark_fsm_tpu.models._common import next_pow2
+
+        f_pad = next_pow2(max(int(spec.predict_lanes), 1))
+        d_pad = next_pow2(max(int(spec.predict_depth), 1))
+        m_pad = next_pow2(max(int(spec.predict_topm), 1))
+        w = 1
+        w_hi = next_pow2(max(int(spec.predict_wave), 1))
+        while w <= w_hi:
+            add(key_predict(f_pad, d_pad, w, m_pad),
+                kind="predict", lanes=f_pad, depth=d_pad, wave=w,
+                topm=m_pad)
+            w *= 2
     return out

@@ -1,0 +1,315 @@
+"""Prediction serving plane (ISSUE 17): ops/rule_trie.py +
+service/predictor.py.
+
+The contract at three altitudes:
+
+- **trie unit** (no service): the device trie's scores are
+  BYTE-IDENTICAL to an independent brute-force oracle written here —
+  over random rule sets with deliberate (confidence, support) ties,
+  empty prefixes, no-match prefixes, and top-m truncation at the
+  tie-break boundary.  "Byte-identical" is literal: the serialized
+  JSON strings compare equal, floats included (docs/DESIGN.md explains
+  why the integer-rank kernel makes that a construction, not a test of
+  float luck).
+- **engine parity**: /predict answers over all three engines' real
+  outputs — TSR rules directly, SPADE/SPAM pattern sets through the
+  prefix-closure rule derivation — match the oracle, and the TSR path
+  additionally matches the live Questor ``get:prediction`` endpoint
+  entry-for-entry (the /predict fast path is a drop-in).
+- **wave fusion**: N prefixes scored as ONE fused wave are
+  byte-identical to the same prefixes scored solo (positional
+  disjointness), and a cached artifact is reused across requests.
+"""
+
+import json
+import random
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import synthetic_db
+from spark_fsm_tpu.ops import rule_trie
+from spark_fsm_tpu.service.actors import Master
+from spark_fsm_tpu.service.model import (ServiceRequest,
+                                         deserialize_patterns,
+                                         deserialize_rules)
+
+DEADLINE_S = 90.0
+
+
+# ------------------------------------------------------ independent oracle
+#
+# Deliberately re-derived from the Questor semantics (actors.py
+# "prediction" subject), not imported from ops/rule_trie — a shared bug
+# cannot hide in a shared implementation.
+
+
+def oracle_predict(rules, prefix, m):
+    have = set(prefix)
+    best = {}
+    for x, y, sup, supx in rules:
+        if supx <= 0 or not set(x) <= have:
+            continue
+        conf = sup / supx
+        for it in y:
+            if it in have:
+                continue
+            cur = best.get(it)
+            if cur is None or (conf, sup) > (cur[0], cur[1]):
+                best[it] = (conf, sup, supx, x, y)
+    ranked = sorted(best.items(),
+                    key=lambda kv: (-kv[1][0], -kv[1][1], kv[0]))[:m]
+    return [{"item": it, "confidence": conf, "support": sup,
+             "antecedent_support": supx,
+             "antecedent": list(x), "consequent": list(y)}
+            for it, (conf, sup, supx, x, y) in ranked]
+
+
+def device_predict(rules, prefix, m, **build_kw):
+    build_kw.setdefault("depth_floor", 8)  # cover test prefixes longer
+    # than the rule set's own antecedent depth (production sizes the
+    # artifact from the prefix — service/predictor.py depth_need)
+    trie = rule_trie.build_trie(rules, **build_kw)
+    return rule_trie.score_wave(trie, [list(prefix)], m)[0]
+
+
+def assert_bytes_equal(got, want, ctx=""):
+    g = json.dumps(got, sort_keys=True)
+    w = json.dumps(want, sort_keys=True)
+    assert g == w, f"{ctx}: device\n{g}\n!= oracle\n{w}"
+
+
+def random_rules(rng, n_rules, n_items, *, with_ties=True):
+    """Random rule list; with_ties plants exact (sup, supx) collisions
+    so the (confidence, support) comparison actually exercises the
+    tie-break order."""
+    rules = []
+    for _ in range(n_rules):
+        xlen = rng.randint(1, 3)
+        x = tuple(sorted(rng.sample(range(n_items), xlen)))
+        rest = [i for i in range(n_items) if i not in x]
+        y = tuple(sorted(rng.sample(rest,
+                                    rng.randint(1, min(2, len(rest))))))
+        supx = rng.randint(1, 12)
+        sup = rng.randint(1, supx)
+        rules.append((x, y, sup, supx))
+    if with_ties and len(rules) >= 4:
+        # clone the support numbers of one rule onto another with a different
+        # consequent: equal conf AND equal sup, the cross-item tie that
+        # must fall through to ascending item id
+        x, y, sup, supx = rules[0]
+        rest = [i for i in range(n_items) if i not in x and i not in y]
+        if rest:
+            rules[1] = (x, (rest[0],), sup, supx)
+        # and an equal-conf different-sup pair (2/4 == 3/6)
+        rules[2] = (rules[2][0], rules[2][1], 2, 4)
+        rules[3] = (rules[3][0], rules[3][1], 3, 6)
+    return rules
+
+
+# ------------------------------------------------------------- unit parity
+
+
+def test_trie_parity_random():
+    rng = random.Random(0xF5A)
+    for trial in range(25):
+        n_items = rng.randint(4, 12)
+        rules = random_rules(rng, rng.randint(1, 30), n_items)
+        trie = rule_trie.build_trie(rules, depth_floor=8)
+        for m in (1, 3, 8):
+            for _ in range(4):
+                prefix = sorted(rng.sample(range(n_items),
+                                           rng.randint(0, min(6, n_items))))
+                got = rule_trie.score_wave(trie, [prefix], m)[0]
+                assert_bytes_equal(got, oracle_predict(rules, prefix, m),
+                                   f"trial={trial} m={m} prefix={prefix}")
+
+
+def test_empty_prefix_matches_empty_antecedent_rules_only():
+    rules = [((1,), (2,), 3, 4), ((), (5,), 2, 8), ((), (6,), 1, 2)]
+    got = device_predict(rules, [], 8)
+    want = oracle_predict(rules, [], 8)
+    assert_bytes_equal(got, want)
+    assert [e["item"] for e in got] == [6, 5]  # 0.5 > 0.25
+
+
+def test_no_match_prefix_returns_empty():
+    rules = [((1, 2), (3,), 3, 4), ((4,), (5,), 2, 8)]
+    assert device_predict(rules, [9], 8) == []
+    assert oracle_predict(rules, [9], 8) == []
+
+
+def test_observed_items_never_predicted():
+    rules = [((1,), (2, 3), 5, 5)]
+    got = device_predict(rules, [1, 2], 8)
+    assert_bytes_equal(got, oracle_predict(rules, [1, 2], 8))
+    assert [e["item"] for e in got] == [3]
+
+
+def test_topm_tiebreak_truncation():
+    # three candidates with IDENTICAL (conf, sup): order is ascending
+    # item id, and m=2 must keep exactly the two smallest
+    rules = [((1,), (7,), 3, 6), ((1,), (5,), 3, 6), ((1,), (9,), 3, 6),
+             # equal conf (1/2), lower sup: sorts after all three
+             ((1,), (4,), 1, 2)]
+    for m in (1, 2, 3, 8):
+        got = device_predict(rules, [1], m)
+        assert_bytes_equal(got, oracle_predict(rules, [1], m), f"m={m}")
+    assert [e["item"] for e in device_predict(rules, [1], 3)] == [5, 7, 9]
+
+
+def test_per_item_best_rule_selection_is_first_wins():
+    # two rules vote for item 5 with identical (conf, sup) — the oracle
+    # keeps the FIRST seen (strict > comparison), and the entry carries
+    # that rule's antecedent, not the later equal-scoring one's
+    rules = [((1,), (5,), 2, 4), ((2,), (5,), 2, 4)]
+    got = device_predict(rules, [1, 2], 4)
+    assert_bytes_equal(got, oracle_predict(rules, [1, 2], 4))
+    assert got[0]["antecedent"] == [1]  # the first rule's
+
+
+def test_wave_fusion_byte_invariant():
+    rng = random.Random(7)
+    rules = random_rules(rng, 40, 10)
+    trie = rule_trie.build_trie(rules, depth_floor=8)
+    prefixes = [sorted(rng.sample(range(10), rng.randint(0, 5)))
+                for _ in range(7)]
+    fused = rule_trie.score_wave(trie, prefixes, 5)
+    for i, p in enumerate(prefixes):
+        solo = rule_trie.score_wave(trie, [p], 5)[0]
+        assert_bytes_equal(fused[i], solo, f"row {i}")
+        assert_bytes_equal(fused[i], oracle_predict(rules, p, 5))
+
+
+def test_floors_do_not_change_bytes():
+    rng = random.Random(11)
+    rules = random_rules(rng, 12, 8)
+    for p in ([], [1], [2, 3]):
+        tight = device_predict(rules, p, 6, depth_floor=8)
+        padded = device_predict(rules, p, 6, lanes_floor=256,
+                                depth_floor=16)
+        assert_bytes_equal(padded, tight, f"prefix={p}")
+
+
+def test_rules_from_patterns_prefix_closure():
+    # pattern set: <(1)> sup 4, <(1)(2)> sup 3 -> rule (1)->(2) with
+    # supx = 4 (the prefix's own support), sup = 3
+    rules = rule_trie.rules_from_patterns(
+        [(((1,),), 4), (((1,), (2,)), 3), (((1,), (1, 2)), 2)])
+    assert ((1,), (2,), 3, 4) in rules
+    # last itemset {1,2} minus antecedent items {1} -> consequent (2,)
+    assert ((1,), (2,), 2, 4) in rules
+
+
+# ---------------------------------------------------------- engine parity
+
+
+@pytest.fixture(scope="module")
+def service():
+    cfg = cfgmod.parse_config({
+        "predict": {"window_ms": 2.0, "lanes_floor": 64,
+                    "depth_floor": 8, "max_wave": 4}})
+    cfgmod.set_config(cfg)
+    m = Master()
+    yield m
+    m.shutdown()
+    cfgmod.set_config(cfgmod.parse_config({}))
+
+
+def _train(master, algorithm, **extra):
+    import time
+
+    db = synthetic_db(seed=21, n_sequences=120, n_items=9,
+                      mean_itemsets=4.0)
+    req = ServiceRequest(service="fsm", task="train", data={
+        "algorithm": algorithm, "source": "INLINE",
+        "sequences": format_spmf(db), **extra})
+    resp = master.handle(req)
+    assert resp.status == "started", resp.data
+    uid = resp.data["uid"]
+    deadline = time.time() + DEADLINE_S
+    while time.time() < deadline:
+        s = master.handle(ServiceRequest(service="fsm", task="status",
+                                         data={"uid": uid}))
+        if s.status == "finished":
+            return uid
+        assert s.status != "failure", s.data
+        time.sleep(0.05)
+    raise AssertionError("train timeout")
+
+
+def _predict(master, uid, items, m="8", **extra):
+    resp = master.handle(ServiceRequest(
+        service="fsm", task="predict",
+        data={"uid": uid, "items": items, "m": m, **extra}))
+    assert resp.status == "finished", resp.data
+    return (json.loads(resp.data["predictions"]),
+            json.loads(resp.data["stats"]))
+
+
+def _engine_rules(master, uid):
+    payload = master.store.rules(uid)
+    if payload is not None:
+        return deserialize_rules(payload)
+    return rule_trie.rules_from_patterns(
+        deserialize_patterns(master.store.patterns(uid)))
+
+
+@pytest.mark.parametrize("algorithm,extra", [
+    ("TSR_TPU", {"support": "0.1", "k": "25", "minconf": "0.2"}),
+    ("SPADE_TPU", {"support": "0.1"}),
+    ("SPAM_TPU", {"support": "0.1"}),
+])
+def test_predict_engine_parity(service, algorithm, extra):
+    uid = _train(service, algorithm, **extra)
+    rules = _engine_rules(service, uid)
+    assert rules, f"{algorithm}: no rules to serve"
+    for items in ("", "1", "1,2", "3,4,5", "99"):
+        prefix = sorted({int(i) for i in items.split(",") if i})
+        got, stats = _predict(service, uid, items)
+        assert_bytes_equal(got, oracle_predict(rules, prefix, 8),
+                           f"{algorithm} items={items!r}")
+    assert stats["shape_key"].startswith("predict:f")
+
+
+def test_predict_matches_questor_endpoint(service):
+    # the rules-backed fast path is a drop-in for get:prediction —
+    # entry-for-entry identical where both serve (Questor has no top-m
+    # and requires a non-empty prefix)
+    uid = _train(service, "TSR_TPU", support="0.1", k="25", minconf="0.2")
+    for items in ("1", "1,2", "2,6"):
+        q = service.handle(ServiceRequest(
+            service="fsm", task="get:prediction",
+            data={"uid": uid, "items": items}))
+        assert q.status == "finished", q.data
+        want = json.loads(q.data["predictions"])
+        got, _ = _predict(service, uid, items, m=str(max(1, len(want))))
+        assert_bytes_equal(got, want, f"items={items!r}")
+
+
+def test_artifact_cache_reuse_and_staleness(service):
+    from spark_fsm_tpu.service import predictor as P
+
+    uid = _train(service, "TSR_TPU", support="0.1", k="25", minconf="0.2")
+    _predict(service, uid, "1,2")
+    hits0 = P._HITS.total()
+    _, stats = _predict(service, uid, "1,2")
+    assert P._HITS.total() > hits0  # same digest+geometry: no rebuild
+    snap = service.predictor.stats()
+    assert snap["cache"]["entries"] >= 1
+    assert any(r["digest"] == stats["artifact_digest"]
+               for r in snap["cache"]["resident"])
+
+
+def test_predict_validation_errors(service):
+    r = service.handle(ServiceRequest(service="fsm", task="predict",
+                                      data={"uid": "nope", "items": "1"}))
+    assert r.status == "failure"
+    r = service.handle(ServiceRequest(service="fsm", task="predict",
+                                      data={"items": "1"}))
+    assert r.status == "failure"  # neither uid nor fingerprint
+    uid = _train(service, "TSR_TPU", support="0.1", k="25", minconf="0.2")
+    r = service.handle(ServiceRequest(service="fsm", task="predict",
+                                      data={"uid": uid, "items": "a,b"}))
+    assert r.status == "failure"

@@ -62,6 +62,8 @@ def test_key_formats_are_the_engine_spellings():
     assert shapes.key_spam_hybrid(128, 1, 530, 16, 64, 64) == \
         "spam:s128w1r530nb16i64d64"
     assert shapes.key_spam_pair(128, 1, 256) == "spam-pair:s128w1c256"
+    # the prediction-serving scoring geometry (ops/rule_trie.py)
+    assert shapes.key_predict(1024, 16, 8, 8) == "predict:f1024d16w8m8"
 
 
 def test_enumeration_covers_runtime_keys_no_drift():
@@ -399,3 +401,44 @@ def test_tsr_partition_keys_through_prewarm():
         assert c1["count"] - c0["count"] == 0, (
             f"partitioned eval dispatch compiled "
             f"{c1['count'] - c0['count']} fresh programs")
+
+
+def test_predict_keys_through_prewarm():
+    """Read-plane warm-path contract (the ISSUE-17 acceptance pin): the
+    enumerator lists one ``predict`` key per pow2 wave bucket at the
+    declared floor geometry, the prewarm driver compiles and records
+    each rung, and a post-prewarm scoring wave at the warmed geometry —
+    a DIFFERENT artifact, same shapes — performs zero fresh compiles."""
+    from spark_fsm_tpu.ops import rule_trie
+    from spark_fsm_tpu.service import prewarm
+
+    assert enable_compile_counter()
+    spec = shapes.WorkloadSpec(n_sequences=0, n_items=0,
+                               predict_lanes=64, predict_depth=8,
+                               predict_wave=4, predict_topm=4)
+    enumerated = sorted(shapes.enumerate_shapes(spec))
+    assert enumerated == [shapes.key_predict(64, 8, w, 4)
+                          for w in (1, 2, 4)]
+
+    shapes.reset_recorded()
+    report = prewarm.run(spec)
+    bad = [r for r in report["keys"] if r.get("error")]
+    assert not bad, bad
+    recorded = shapes.recorded()
+    assert set(enumerated) <= set(recorded), (enumerated, recorded)
+
+    # a live artifact padded to the same floors lands on the warmed
+    # keys: every wave width in the ladder scores with ZERO fresh
+    # compiles (the artifact's planes are data, not shape)
+    rules = [((1,), (2,), 3, 4), ((2, 3), (5,), 2, 6),
+             ((1, 2), (7,), 1, 3)]
+    trie = rule_trie.build_trie(rules, lanes_floor=64, depth_floor=8)
+    for prefixes in ([[1]], [[1], [2, 3]], [[1], [2, 3], [], [1, 2]]):
+        c0 = compile_counts()
+        out = rule_trie.score_wave(trie, prefixes, 4)
+        c1 = compile_counts()
+        assert len(out) == len(prefixes)
+        assert c1["count"] - c0["count"] == 0, (
+            f"post-prewarm predict wave (n={len(prefixes)}) compiled "
+            f"{c1['count'] - c0['count']} fresh programs")
+    assert not shapes.drift(enumerated)
