@@ -1,0 +1,358 @@
+#!/usr/bin/env python
+"""Bitrot drill: the ISSUE 18 integrity plane against the REAL service
+across REAL process boundaries.
+
+The CI companion to overload_smoke for the durable-state integrity
+layer (utils/envelope.py + service/integrity.py).  It boots the HTTP
+service as a subprocess over a MiniRedis store (the in-process RESP
+server from tests/test_redis_store.py — the store must survive the
+service's death), then plants byte damage in every surface the
+envelope protects and asserts the per-surface degradation contract:
+
+1. warms the result-reuse tier with a TSR mine (oracle-checked), then
+   submits a long CHECKPOINTED mine and kill -9s the service once two
+   delta chunks have persisted;
+2. while the service is DEAD, corrupts the durable state the way real
+   bitrot would: byte-flips the LAST checkpoint delta chunk, truncates
+   the rescache entry mid-record, and plants a flipped journal intent
+   under a poison uid;
+3. reboots on the same store: boot recovery must quarantine the poison
+   intent (``1 quarantined`` on the recovery line) and still resume the
+   drill, which must heal to the last GOOD chunk and finish with the
+   EXACT oracle pattern set — zero duplicated, zero missing results;
+4. re-submits the warmed TSR request: the damaged entry must never be
+   served — the service falls through to a cold re-mine (no
+   ``served_from_cache`` stat) that again matches the oracle, and the
+   rotten bytes land in the quarantine keyspace;
+5. BACKGROUND SCRUBBER: plants one more rotten intent at rest and
+   waits for the thread-cadence scrub to quarantine it with no read
+   traffic at all;
+6. asserts ``/admin/integrity`` lists the quarantine records with
+   their surfaces and that the zero-seeded ``fsm_integrity_*`` metric
+   families are live on /metrics.
+
+Usage: scripts/bitrot_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+BOOT_TIMEOUT_S = 180.0
+DRILL_TIMEOUT_S = 300.0
+SCRUB_EVERY_S = 0.5
+
+
+def log(msg):
+    print(f"bitrot_smoke: {msg}", flush=True)
+
+
+def post(port, endpoint, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{endpoint}"
+    try:
+        with urllib.request.urlopen(url, data=data, timeout=60) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read().decode())
+
+
+def scrape(port, family):
+    """Sum every sample of ``family`` in /metrics (labels collapsed)."""
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=60) as resp:
+        text = resp.read().decode()
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        m = re.match(rf"^{re.escape(family)}(\{{[^}}]*\}})?\s+(\S+)$", line)
+        if m:
+            total += float(m.group(2))
+            seen = True
+    assert seen, f"{family} missing from /metrics"
+    return total
+
+
+def flip(value, at):
+    """One bit of bitrot at ``at`` — the minimal real-world damage."""
+    return value[:at] + chr(ord(value[at]) ^ 0x01) + value[at + 1:]
+
+
+def boot_service(cfg_path, env):
+    child = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import sys\n"
+        f"sys.argv = ['app', '--config', {str(cfg_path)!r}]\n"
+        "from spark_fsm_tpu.service.app import main\n"
+        "main()\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    port = None
+    recovery_line = None
+    scrubber_line = None
+    deadline = time.time() + BOOT_TIMEOUT_S
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                f"service died at boot (rc={proc.poll()})")
+        if line.startswith("restart recovery:"):
+            recovery_line = line.strip()
+        if line.startswith("integrity scrubber on"):
+            scrubber_line = line.strip()
+        if "spark_fsm_tpu service on http://" in line:
+            port = int(line.rsplit(":", 1)[1])
+            break
+    assert port is not None, "no boot line within the timeout"
+    # keep draining stdout so a chatty incarnation never blocks on a
+    # full pipe while the drill below is busy elsewhere
+    threading.Thread(target=lambda: proc.stdout.read(),
+                     daemon=True).start()
+    return proc, port, recovery_line, scrubber_line
+
+
+def main():
+    from test_redis_store import MiniRedis  # noqa: E402 (tests/ on path)
+
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.data.vertical import abs_minsup
+    from spark_fsm_tpu.models.oracle import mine_spade
+    from spark_fsm_tpu.models.tsr import mine_tsr_cpu
+    from spark_fsm_tpu.service.model import (deserialize_patterns,
+                                             deserialize_rules)
+    from spark_fsm_tpu.service.resp import RespClient
+    from spark_fsm_tpu.utils import envelope
+    from spark_fsm_tpu.utils.canonical import (diff_patterns,
+                                               patterns_text, rules_text)
+
+    mini = MiniRedis()
+    log(f"MiniRedis on port {mini.port}")
+    client = RespClient(port=mini.port)
+
+    tmp = tempfile.mkdtemp(prefix="bitrot_smoke_")
+    cfg_path = os.path.join(tmp, "config.json")
+    with open(cfg_path, "w") as fh:
+        json.dump({
+            "fault_injection": True,  # the per-save delay arms via HTTP
+            "service": {"port": 0, "miner_workers": 1, "queue_depth": 8},
+            "store": {"backend": "redis", "host": "127.0.0.1",
+                      "port": mini.port},
+            "rescache": {"enabled": True},
+            "integrity": {"scrub_every_s": SCRUB_EVERY_S,
+                          "scrub_batch": 128},
+            # pin the queue engine so the checkpointed drill takes the
+            # segmented path (frontier saves at every segment boundary)
+            "engine": {"fused": "queue"},
+        }, fh)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    proc, port, _, scrubber_line = boot_service(cfg_path, env)
+    log(f"service A on port {port} (pid {proc.pid}); {scrubber_line}")
+    assert scrubber_line is not None, "no scrubber banner at boot"
+    assert "thread cadence" in scrubber_line, scrubber_line
+
+    warm_db = synthetic_db(seed=31, n_sequences=60, n_items=9,
+                           mean_itemsets=3.0, mean_itemset_size=1.2)
+    warm_text = format_spmf(warm_db)
+    warm_params = dict(algorithm="TSR_TPU", source="INLINE",
+                       sequences=warm_text, k="8", minconf="0.4",
+                       max_side="2")
+    oracle_rules = rules_text(mine_tsr_cpu(warm_db, 8, 0.4, max_side=2))
+
+    # deep enough that the queue engine crosses >= 3 segment boundaries
+    # (saves land at waves 1, 5, 21 of ~54): two delta chunks persist
+    # with a couple of segments still to mine after the last one
+    drill_db = synthetic_db(seed=41, n_sequences=300, n_items=10,
+                            mean_itemsets=6.0, mean_itemset_size=1.5)
+    oracle_patterns = mine_spade(drill_db, abs_minsup(0.02, len(drill_db)))
+
+    try:
+        # ---- warm the rescache with an oracle-checked TSR mine
+        code, _, body = post(port, "/train", uid="warm", **warm_params)
+        assert code == 200 and body["status"] == "started", body
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            _, _, body = post(port, "/status/warm")
+            if body["status"] in ("finished", "failure"):
+                break
+            time.sleep(0.1)
+        assert body["status"] == "finished", body
+        _, _, body = post(port, "/get/rules", uid="warm")
+        got = rules_text(deserialize_rules(body["data"]["rules"]))
+        assert got == oracle_rules, "warm mine disagrees with the oracle"
+        ekeys = client.keys("fsm:rescache:*")
+        assert len(ekeys) == 1, f"expected one rescache entry: {ekeys}"
+        ekey = ekeys[0]
+        assert envelope.is_enveloped(client.get(ekey)), \
+            "rescache entry not enveloped on write"
+        log(f"rescache warmed (oracle parity, entry {ekey})")
+
+        # ---- checkpointed drill: slow every frontier save by 1s so at
+        # least two delta chunks persist before the kill
+        code, _, _ = post(port, "/admin/faults", action="arm",
+                          site="checkpoint.save", every="1",
+                          delay_s="1.0", exc="none")
+        assert code == 200, "chaos lab refused the arm"
+        code, _, body = post(port, "/train", uid="drill",
+                             algorithm="SPADE_TPU", source="INLINE",
+                             sequences=format_spmf(drill_db),
+                             support="0.02", checkpoint="1",
+                             checkpoint_every_s="0")
+        assert code == 200 and body["status"] == "started", body
+        chunks_key = "fsm:frontier:results:drill"
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            if client.llen(chunks_key) >= 2:
+                break
+            assert proc.poll() is None, "service A died early"
+            time.sleep(0.1)
+        assert client.llen(chunks_key) >= 2, "never saw 2 delta chunks"
+        assert client.get("fsm:journal:drill"), "drill journal missing"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(30)
+        log("killed service A mid-mine (2+ delta chunks persisted)")
+    except BaseException:
+        proc.kill()
+        raise
+
+    # ---- the service is DEAD: rot the durable state under it
+    chunks = client.lrange(chunks_key)
+    client.ltrim(chunks_key, 0, len(chunks) - 2)
+    client.rpush(chunks_key, flip(chunks[-1], len(chunks[-1]) - 10))
+    log(f"byte-flipped the last of {len(chunks)} checkpoint delta chunks")
+    raw = client.get(ekey)
+    client.set(ekey, raw[: len(raw) // 2])
+    log("truncated the rescache entry mid-record")
+    client.set("fsm:journal:poison-bitrot",
+               flip(envelope.wrap(json.dumps({"incarnation": "ghost"})),
+                    80))
+    log("planted a flipped journal intent under uid poison-bitrot")
+
+    # ---- reboot on the SAME store
+    proc, port, recovery_line, _ = boot_service(cfg_path, env)
+    log(f"service B on port {port} (pid {proc.pid}); {recovery_line}")
+    try:
+        assert recovery_line is not None, "no recovery line at reboot"
+        assert "1 resumed" in recovery_line, recovery_line
+        assert "1 quarantined" in recovery_line, recovery_line
+        assert client.get("fsm:journal:poison-bitrot") is None, \
+            "poison intent not moved out of the journal namespace"
+        assert client.get("fsm:quarantine:poison-bitrot"), \
+            "poison intent missing from the quarantine keyspace"
+
+        # drill: healed to the last GOOD chunk, resumed, oracle parity
+        deadline = time.time() + DRILL_TIMEOUT_S
+        status = None
+        while time.time() < deadline:
+            _, _, body = post(port, "/status/drill")
+            status = body["status"]
+            if status in ("finished", "failure"):
+                break
+            time.sleep(0.25)
+        assert status == "finished", (status, body)
+        _, _, body = post(port, "/get/patterns", uid="drill")
+        got = deserialize_patterns(body["data"]["patterns"])
+        assert patterns_text(got) == patterns_text(oracle_patterns), \
+            diff_patterns(oracle_patterns, got)
+        qkeys = client.keys("fsm:quarantine:*")
+        assert any("frontier:results:drill" in k for k in qkeys), \
+            f"rotten delta chunk not quarantined: {qkeys}"
+        log(f"checkpoint drill ok: resumed from the last good chunk, "
+            f"{len(got)} patterns with oracle parity")
+
+        # rescache: the rotten entry is NEVER served — cold re-mine
+        # with oracle parity (the scrubber may beat the read to the
+        # quarantine; either way the lookup must cleanly miss)
+        code, _, body = post(port, "/train", uid="rehit", **warm_params)
+        assert code == 200 and body["status"] == "started", body
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            _, _, body = post(port, "/status/rehit")
+            if body["status"] in ("finished", "failure"):
+                break
+            time.sleep(0.1)
+        assert body["status"] == "finished", body
+        stats = json.loads(client.get("fsm:stats:rehit") or "{}")
+        assert "served_from_cache" not in stats, \
+            f"rotten entry was served: {stats}"
+        _, _, body = post(port, "/get/rules", uid="rehit")
+        got = rules_text(deserialize_rules(body["data"]["rules"]))
+        assert got == oracle_rules, "cold re-mine disagrees with oracle"
+        qkey = "fsm:quarantine:" + ekey[len("fsm:"):]
+        assert client.get(qkey), f"rotten entry not quarantined at {qkey}"
+        log("rescache drill ok: rotten entry quarantined, cold re-mine "
+            "matches the oracle")
+
+        # background scrubber: damage at REST, zero read traffic
+        client.set("fsm:journal:rot-at-rest",
+                   flip(envelope.wrap(json.dumps({"incarnation": "x"})),
+                        80))
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            if client.get("fsm:journal:rot-at-rest") is None and \
+                    client.get("fsm:quarantine:rot-at-rest"):
+                break
+            time.sleep(0.1)
+        assert client.get("fsm:journal:rot-at-rest") is None, \
+            "scrubber never quarantined the at-rest damage"
+        log("scrubber ok: at-rest damage quarantined with no reads")
+
+        # /admin/integrity: records listed with surfaces + counters
+        code, _, rep = post(port, "/admin/integrity")
+        assert code == 200 and rep["enabled"] is True, rep
+        assert rep["scrub_every_s"] == SCRUB_EVERY_S, rep
+        surfaces = {r.get("surface") for r in rep["quarantine"]}
+        assert {"journal", "rescache", "checkpoint"} <= surfaces, surfaces
+        for name in ("scans", "verified", "legacy", "corrupt",
+                     "quarantined", "repaired"):
+            assert name in rep["counters"], rep["counters"]
+        log(f"/admin/integrity ok: {len(rep['quarantine'])} quarantine "
+            f"records across surfaces {sorted(surfaces)}")
+
+        # metric families live (zero-seeded, so presence is guaranteed;
+        # the drill pushed the interesting ones off zero)
+        for fam in ("fsm_integrity_scans_total",
+                    "fsm_integrity_verified_total",
+                    "fsm_integrity_legacy_total",
+                    "fsm_integrity_corrupt_total",
+                    "fsm_integrity_quarantined_total",
+                    "fsm_integrity_repaired_total"):
+            scrape(port, fam)
+        assert scrape(port, "fsm_integrity_scans_total") >= 1
+        assert scrape(port, "fsm_integrity_verified_total") >= 1
+        assert scrape(port, "fsm_integrity_quarantined_total") >= 2
+        assert scrape(port, "fsm_recovery_jobs_total") >= 2
+        log("metrics ok: fsm_integrity_* families live")
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        mini.close()
+    log("PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Bitrot drill — the durable-state integrity companion to verify_t1.sh,
+# overload_smoke.sh and chaos_smoke.sh.  Boots the real service over a
+# MiniRedis store, kill -9s a checkpointed mine, then rots the durable
+# bytes under the dead service (byte-flipped checkpoint delta, truncated
+# rescache entry, flipped journal intent) and asserts the rebooted
+# service heals to the last good chunk with oracle parity, cold re-mines
+# the poisoned cache hit, quarantines every damaged record, and reports
+# it all via /admin/integrity + fsm_integrity_* metrics.  See
+# scripts/bitrot_smoke.py for the assertions.
+cd "$(dirname "$0")/.."
+# hard wall-clock bound: a service subprocess that wedges during boot
+# blocks the driver in readline(), so the whole drill runs under timeout
+exec timeout -k 30 840 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/bitrot_smoke.py "$@"

@@ -85,6 +85,7 @@ def main() -> int:
     args = ap.parse_args()
 
     from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.utils import envelope
     from spark_fsm_tpu.service.resp import RespClient
 
     cfg = cfgmod.load_config(args.config)
@@ -137,7 +138,9 @@ def main() -> int:
             try:
                 raw = client.get("fsm:autoscale:desired")
                 if raw:
-                    rec = json.loads(raw)
+                    # the record is enveloped on the wire now —
+                    # a corrupt one reads as absent (keep desired)
+                    rec = json.loads(envelope.unwrap(raw)[0] or "{}")
                     want = int(rec.get("desired") or desired)
                     if want != desired:
                         log(f"desired-replica record: {want} "

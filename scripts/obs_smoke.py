@@ -243,7 +243,16 @@ def main() -> int:
                     "fsm_predict_artifact_age_seconds",
                     "fsm_predict_e2e_seconds_count",
                     "fsm_predict_window_wait_seconds_count",
-                    "fsm_predict_exec_seconds_count"):
+                    "fsm_predict_exec_seconds_count",
+                    # ISSUE 18 families: durable-state integrity plane
+                    # (service/integrity.py) — present (zero) before
+                    # any corruption is ever seen
+                    "fsm_integrity_scans_total",
+                    "fsm_integrity_verified_total",
+                    "fsm_integrity_legacy_total",
+                    "fsm_integrity_corrupt_total",
+                    "fsm_integrity_quarantined_total",
+                    "fsm_integrity_repaired_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -294,7 +303,18 @@ def main() -> int:
                  {"high", "normal", "low"}),
                 ("fsm_predict_waves_total", "mode", {"fused", "solo"}),
                 ("fsm_predict_requests_total", "outcome",
-                 {"served", "failure", "no_rules"})):
+                 {"served", "failure", "no_rules"}),
+                # ISSUE 18 vocabularies: every protected surface is
+                # seeded on the verify counters, and boot recovery can
+                # now end an intent in quarantine
+                ("fsm_integrity_verified_total", "surface",
+                 {"checkpoint", "journal", "rescache", "spine",
+                  "lease"}),
+                ("fsm_integrity_corrupt_total", "surface",
+                 {"checkpoint", "journal", "rescache", "spine",
+                  "lease"}),
+                ("fsm_recovery_jobs_total", "outcome",
+                 {"cleared", "resumed", "failed", "quarantined"})):
             got = {m.group(1) for k in families.get(fam, {})
                    for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
             missing = want - got

@@ -131,6 +131,7 @@ def main():
     from spark_fsm_tpu.data.vertical import abs_minsup
     from spark_fsm_tpu.models.oracle import mine_spade
     from spark_fsm_tpu.service.resp import RespClient
+    from spark_fsm_tpu.utils import envelope
 
     mini = MiniRedis()
     log(f"MiniRedis on port {mini.port}")
@@ -245,7 +246,10 @@ def main():
             if raw is None:  # already adopted AND finished
                 t_adopt = t_adopt or time.monotonic()
                 break
-            if json.loads(raw).get("replica") == rep_b:
+            # journal intents are enveloped on the wire now —
+            # unwrap before parsing (legacy bare JSON passes through)
+            if json.loads(envelope.unwrap(raw)[0] or "{}")\
+                    .get("replica") == rep_b:
                 t_adopt = time.monotonic()
                 break
             time.sleep(0.05)

@@ -442,7 +442,10 @@ def outage_drill(fleet):
         time.sleep(0.25)
     assert status == "finished", (status, body)
     journal = client.get("fsm:journal:drill")
-    assert journal is None or json.loads(journal).get("replica") == rep_a
+    # journal intents are enveloped on the wire now — unwrap first
+    from spark_fsm_tpu.utils import envelope
+    assert journal is None or \
+        json.loads(envelope.unwrap(journal)[0] or "{}").get("replica") == rep_a
     code, body = post(port_a, "/get/patterns", uid="drill")
     from spark_fsm_tpu.service.model import deserialize_patterns
     got = patterns_text(deserialize_patterns(body["data"]["patterns"]))

@@ -48,6 +48,11 @@
 #                        wave, byte parity vs host oracle + Questor
 #                        slow path, zero live compiles after prewarm,
 #                        live fsm_predict_* + /admin/slo read block
+#   bitrot_smoke.sh      durable-state integrity: rot the bytes under
+#                        a dead service (checkpoint delta, rescache
+#                        entry, journal intent) — last-good resume +
+#                        oracle parity, cold re-mine, quarantine on
+#                        /admin/integrity, live fsm_integrity_*
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -61,7 +66,7 @@ if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
              throughput_smoke resident_smoke partition_smoke \
              replica_smoke rescache_smoke autoscale_smoke \
              storm_smoke fleet_smoke spam_smoke fused_smoke \
-             predict_smoke; do
+             predict_smoke bitrot_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

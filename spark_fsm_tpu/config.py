@@ -614,6 +614,27 @@ class PredictConfig:
 
 
 @dataclasses.dataclass
+class IntegrityConfig:
+    """Durable-state integrity plane (utils/envelope.py +
+    service/integrity.py): every durable write is checksum-enveloped and
+    verified on read unconditionally; this section tunes only the
+    BACKGROUND SCRUBBER that verifies envelopes at rest.
+
+    ``enabled = false`` removes the scrubber entirely (verify-on-read
+    stays — it is a correctness property, not a feature).
+    ``scrub_every_s`` is the pass cadence (riding the cluster heartbeat
+    when one exists, a private daemon thread on solo boots; 0 = manual
+    passes only, via tests/admin).  ``scrub_batch`` bounds the keys
+    examined per pass — the walk carries its cursor across passes, so
+    a large store is scrubbed incrementally, never in one scan storm.
+    """
+
+    enabled: bool = True
+    scrub_every_s: float = 60.0
+    scrub_batch: int = 256
+
+
+@dataclasses.dataclass
 class Config:
     service: ServiceConfig = dataclasses.field(default_factory=ServiceConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
@@ -640,6 +661,8 @@ class Config:
         default_factory=PlannerConfig)
     predict: PredictConfig = dataclasses.field(
         default_factory=PredictConfig)
+    integrity: IntegrityConfig = dataclasses.field(
+        default_factory=IntegrityConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -692,6 +715,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "storeguard": (StoreGuardConfig, top.pop("storeguard", {})),
         "planner": (PlannerConfig, top.pop("planner", {})),
         "predict": (PredictConfig, top.pop("predict", {})),
+        "integrity": (IntegrityConfig, top.pop("integrity", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -862,6 +886,11 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         raise ConfigError("predict.artifact_entries must be >= 1")
     if cfg.predict.artifact_bytes < 1:
         raise ConfigError("predict.artifact_bytes must be >= 1")
+    if cfg.integrity.scrub_every_s < 0:
+        raise ConfigError(
+            "integrity.scrub_every_s must be >= 0 (0 = manual passes)")
+    if cfg.integrity.scrub_batch < 1:
+        raise ConfigError("integrity.scrub_batch must be >= 1")
     return cfg
 
 
@@ -931,6 +960,12 @@ def set_config(cfg: Config) -> None:
     from spark_fsm_tpu.service import predictor
 
     predictor.configure(cfg.predict)
+    # the integrity plane's scrubber cadence/batch are process-global
+    # like the planes above (read sites count into module counters; the
+    # Miner installs the scrubber over its store)
+    from spark_fsm_tpu.service import integrity
+
+    integrity.configure(cfg.integrity)
 
 
 def engine_kwargs(*names: str) -> Dict[str, Any]:

@@ -23,12 +23,13 @@ from typing import Dict, List, Optional
 
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
-from spark_fsm_tpu.service import (autoscale, fairness, lease, model,
-                                   obsplane, planner, plugins, predictor,
-                                   resultcache, sources, storeguard)
+from spark_fsm_tpu.service import (autoscale, fairness, integrity, lease,
+                                   model, obsplane, planner, plugins,
+                                   predictor, resultcache, sources,
+                                   storeguard)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
-from spark_fsm_tpu.utils import faults, jobctl, obs
+from spark_fsm_tpu.utils import envelope, faults, jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event, profile_trace
 from spark_fsm_tpu.utils.retry import RetryPolicy
 
@@ -222,17 +223,50 @@ class StoreCheckpoint:
         raw = self._io(self.store.get, self._meta_key)
         if not raw:
             return None
-        state = json.loads(raw)
+        meta_payload, verdict = integrity.open_value(raw, "checkpoint")
+        if verdict == "corrupt":
+            # corrupt META: the snapshot's identity itself is
+            # unverifiable — quarantine the bytes for the post-mortem
+            # and restart the mine fresh, LOUDLY (ISSUE 18 posture)
+            integrity.quarantine(self.store, self._meta_key, raw,
+                                 "checkpoint", move=True)
+            self._io(self.store.delete, self._results_key)
+            log_event("frontier_checkpoint_corrupt_meta", uid=self.uid)
+            return None
+        state = json.loads(meta_payload)
         inline = state.pop("results_inline", [])
         total = state.pop("results_total", -1)
         chunks = self._io(self.store.lrange, self._results_key)
         results = list(inline)
         used = 0
+        # (embedded snapshot state, chunks kept, results at that point)
+        # for the corrupt-delta heal: every enveloped chunk embeds the
+        # frontier state as of its OWN save, so a later chunk's
+        # corruption truncates back to here instead of restarting
+        last_good = None
         for chunk in chunks:
             if len(results) == total:
                 break  # later chunks postdate this meta (torn tail)
-            results.extend(json.loads(chunk))
+            payload, cv = integrity.open_value(chunk, "checkpoint")
+            delta, emb = None, None
+            if cv != "corrupt":
+                try:
+                    obj = json.loads(payload)
+                except ValueError:
+                    obj = None
+                if (isinstance(obj, dict)
+                        and isinstance(obj.get("delta"), list)):
+                    delta, emb = obj["delta"], obj.get("state")
+                elif isinstance(obj, list):
+                    delta = obj  # legacy chunk: bare delta, no state
+            if delta is None:
+                return self._heal_corrupt_delta(chunk, inline, results,
+                                                used, last_good)
+            results.extend(delta)
             used += 1
+            if (isinstance(emb, dict)
+                    and emb.get("results_total") == len(results)):
+                last_good = (emb, used, len(results))
         if len(results) != total:
             return None  # torn snapshot (killed mid-save): refuse to resume
         if used < len(chunks):
@@ -248,6 +282,37 @@ class StoreCheckpoint:
         # (their meta overwrites the one that carried it)
         self._inline = inline
         state["results"] = results
+        return state
+
+    def _heal_corrupt_delta(self, bad_chunk, inline, results, used,
+                            last_good) -> Optional[dict]:
+        """A delta chunk INSIDE the used prefix failed verification: the
+        meta's snapshot is unreachable, but every enveloped chunk embeds
+        the frontier state as of its own save — so truncate the list to
+        the last good embedded snapshot, rewrite the meta to it, and
+        RESUME from there: the corruption costs only the work mined
+        after that chunk.  With no embedded predecessor (first chunk
+        corrupt, or a legacy pre-envelope prefix) the snapshot is
+        unreconstructable — quarantine and restart fresh, loudly."""
+        integrity.quarantine(self.store, f"{self._results_key}#{used}",
+                             bad_chunk, "checkpoint")
+        if last_good is None:
+            self._io(self.store.delete, self._meta_key)
+            self._io(self.store.delete, self._results_key)
+            log_event("frontier_checkpoint_corrupt_restart", uid=self.uid)
+            return None
+        emb, keep, n = last_good
+        self._io(self.store.ltrim, self._results_key, keep)
+        meta = dict(emb)  # embedded state carries results_total already
+        meta["results_inline"] = inline
+        self._io(self.store.set, self._meta_key,
+                 envelope.wrap(json.dumps(meta)))
+        log_event("frontier_checkpoint_corrupt_delta_healed",
+                  uid=self.uid, kept_chunks=keep, results=n)
+        self._inline = inline
+        state = dict(emb)
+        state.pop("results_total", None)
+        state["results"] = results[:n]
         return state
 
     def save(self, state: dict) -> None:
@@ -300,7 +365,14 @@ class StoreCheckpoint:
             state["results_total"] = len(delta)
         else:
             if delta:
-                payload = json.dumps(delta)
+                # each chunk embeds the frontier state AS OF THIS SAVE
+                # (sans the inline part, which the meta re-embeds every
+                # save anyway): the corrupt-delta heal resumes from the
+                # newest intact chunk's embedded snapshot (ISSUE 18)
+                emb = dict(state)
+                emb["results_total"] = done + len(delta)
+                payload = envelope.wrap(
+                    json.dumps({"delta": delta, "state": emb}))
                 n0 = self._io(self.store.llen, self._results_key)
 
                 def _push_delta():
@@ -316,7 +388,8 @@ class StoreCheckpoint:
         # meta written LAST: results_total only matches inline+list once
         # the delta is in, so a kill between writes reads as torn (and
         # load() heals back to THIS meta's snapshot), never as valid
-        self._io(self.store.set, self._meta_key, json.dumps(state))
+        self._io(self.store.set, self._meta_key,
+                 envelope.wrap(json.dumps(state)))
         log_event("frontier_checkpoint", uid=self.uid,
                   stack=len(state["stack"]), results=state["results_total"])
 
@@ -334,10 +407,13 @@ class StoreCheckpoint:
             state["results_total"] = len(delta)
         else:
             if delta:
-                g.rpush(uid, self._results_key, json.dumps(delta))
+                emb = dict(state)
+                emb["results_total"] = done + len(delta)
+                g.rpush(uid, self._results_key, envelope.wrap(
+                    json.dumps({"delta": delta, "state": emb})))
             state["results_total"] = done + len(delta)
         state["results_inline"] = self._inline
-        g.set(uid, self._meta_key, json.dumps(state))
+        g.set(uid, self._meta_key, envelope.wrap(json.dumps(state)))
         log_event("frontier_checkpoint_spooled", uid=uid,
                   stack=len(state["stack"]),
                   results=state["results_total"])
@@ -723,6 +799,14 @@ class Miner:
             # solo deployments install nothing and the recorder's
             # spine probe stays one module-global read.
             obsplane.install(self.store, self._lease)
+        # durable-state integrity plane (ISSUE 18, service/integrity.py):
+        # the at-rest scrubber over this store (last Miner wins, like
+        # obsplane).  Cluster mode drives it off the lease heartbeat
+        # (integrity.tick inside LeaseManager.tick); solo service boots
+        # start its cadence thread in app.main.  None when [integrity]
+        # enabled = false — verify-on-READ stays unconditional either
+        # way (it is a correctness property, not a feature flag).
+        self._integrity = integrity.install(self.store)
 
     # ------------------------------------------------------------ admission
 
@@ -1713,6 +1797,11 @@ class Miner:
             # released its lease); stop the heartbeat and retract the
             # replica record so peers adopt anything left promptly
             self._lease.stop()
+        if (self._integrity is not None
+                and integrity.get() is self._integrity):
+            # stop OUR scrubber only — a later Miner's install owns the
+            # module-global slot now (last-wins, same as obsplane)
+            self._integrity.stop()
         if self._guard is not None:
             self._guard.stop()
             if storeguard.get() is self._guard:
@@ -2248,6 +2337,11 @@ class Master:
 _RECOVERY_TOTAL = obs.REGISTRY.counter(
     "fsm_recovery_jobs_total",
     "journal orphans handled by the boot recovery pass, by outcome")
+# zero-seed the outcome vocabulary (obs_smoke's no-orphan contract):
+# "quarantined" is the ISSUE 18 poison-intent outcome
+for _outcome in ("cleared", "resumed", "failed", "quarantined"):
+    _RECOVERY_TOTAL.seed(outcome=_outcome)
+del _outcome
 
 
 def recover_orphans(master: Master) -> Dict[str, List[str]]:
@@ -2277,15 +2371,27 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
     store, miner = master.store, master.miner
     mgr = miner._lease
     report: Dict[str, List[str]] = {"resumed": [], "failed": [],
-                                    "cleared": []}
+                                    "cleared": [], "quarantined": []}
     for uid in store.journal_uids():
         raw = store.journal_get(uid)
         if not raw:
             continue  # settled between the scan and this read
         try:
             entry = json.loads(raw)
+            if not isinstance(entry, dict):
+                raise ValueError("journal intent must be an object")
         except ValueError:
-            entry = {}  # corrupt record: fall through to the durable failure
+            # poison intent (bitrot or a torn write — journal_get hands
+            # back the RAW bytes on a failed envelope so this parse
+            # fails): move it to fsm:quarantine:{uid} and keep
+            # recovering the REMAINING orphans — one bad record must
+            # not wedge boot recovery for every other job (ISSUE 18)
+            integrity.quarantine(store, f"fsm:journal:{uid}", raw,
+                                 "journal", move=True)
+            report["quarantined"].append(uid)
+            _RECOVERY_TOTAL.inc(outcome="quarantined")
+            log_event("restart_recovery_quarantined", uid=uid)
+            continue
         if entry.get("incarnation") == miner.incarnation:
             continue  # live in THIS incarnation (a concurrent submit)
         if mgr is not None and not mgr.adopt_expired(uid):
@@ -2371,5 +2477,6 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
         log_event("restart_recovery",
                   resumed=len(report["resumed"]),
                   failed=len(report["failed"]),
-                  cleared=len(report["cleared"]))
+                  cleared=len(report["cleared"]),
+                  quarantined=len(report["quarantined"]))
     return report

@@ -63,6 +63,11 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         leader, last evaluation signals, the published
                         desired-replica record and decision log;
                         {"enabled": false} when [autoscale] is off;
+  /admin/integrity    — durable-state integrity plane (service/
+                        integrity.py): verify-on-read counters per
+                        surface, background scrubber stats, and the
+                        current quarantine listing (fsm:quarantine:*)
+                        — the bitrot runbook's one-stop read;
   /admin/drain        — drive the scale-down drain protocol NOW (stop
                         admitting → peers steal the queue → leases
                         released); ``exit=1`` also stops the server
@@ -356,6 +361,14 @@ class FsmHandler(BaseHTTPRequestHandler):
                 a = self.master.autoscaler
                 self._send(200, json.dumps(
                     {"enabled": False} if a is None else a.stats()))
+            elif task == "integrity":
+                # durable-state integrity plane (service/integrity.py):
+                # verify-on-read counters, scrubber state, quarantine
+                # listing — the bitrot runbook's one-stop read
+                from spark_fsm_tpu.service import integrity
+
+                self._send(200, json.dumps(
+                    integrity.report(self.master.store)))
             elif task == "predictor":
                 # prediction serving plane (service/predictor.py):
                 # request/wave counters, resident artifact inventory
@@ -513,6 +526,17 @@ def service_stats(master: Master) -> dict:
     }
 
 
+def _integrity_health() -> dict:
+    """Compact /admin/health integrity block: config + counters, no
+    store walk (the quarantine listing lives on /admin/integrity)."""
+    from spark_fsm_tpu.service import integrity
+
+    try:
+        return integrity.report()
+    except Exception as exc:
+        return {"error": str(exc)}
+
+
 def health_report(master: Master) -> dict:
     """Per-subsystem recovery counters for ``/admin/health`` — the
     runbook's one-stop read when a deployment misbehaves: what is armed
@@ -563,6 +587,10 @@ def health_report(master: Master) -> dict:
             "tsr_cache": tsr_engine_cache.breaker.snapshot(),
         },
         "consumers": consumer_health(),
+        # durable-state integrity plane (service/integrity.py): verify-
+        # on-read + scrub counters (no quarantine listing — that walk
+        # belongs to /admin/integrity, health must stay scan-free)
+        "integrity": _integrity_health(),
         "jobs": jobs,
         "tracing": {"enabled": obs.tracing_enabled(),
                     **obs.recorder_stats()},
@@ -676,7 +704,8 @@ def main() -> None:
     if any(report.values()):
         print(f"restart recovery: {len(report['resumed'])} resumed, "
               f"{len(report['failed'])} failed durably, "
-              f"{len(report['cleared'])} journal entries cleared",
+              f"{len(report['cleared'])} journal entries cleared, "
+              f"{len(report.get('quarantined', ()))} quarantined",
               flush=True)
     scaler = server.master.autoscaler  # type: ignore[attr-defined]
     if scaler is not None:
@@ -704,6 +733,18 @@ def main() -> None:
               f"(lease ttl {mgr.lease_ttl_s}s, "
               f"heartbeat {round(mgr.heartbeat_s, 3)}s, "
               f"steal {'on' if mgr.steal_enabled else 'off'})", flush=True)
+    from spark_fsm_tpu.service import integrity
+
+    scr = integrity.get()
+    if scr is not None and cfg.integrity.scrub_every_s > 0:
+        if mgr is None:
+            # solo boot: no heartbeat tick to ride — own daemon thread
+            scr.start()
+        print(f"integrity scrubber on "
+              f"(every {round(cfg.integrity.scrub_every_s, 3)}s, "
+              f"batch {cfg.integrity.scrub_batch}, "
+              f"{'heartbeat' if mgr is not None else 'thread'} cadence)",
+              flush=True)
     print(f"spark_fsm_tpu service on http://{cfg.service.host}:"
           f"{server.server_port}", flush=True)
     remote = None

@@ -56,8 +56,24 @@ from typing import Callable, List, Optional
 
 from spark_fsm_tpu import config
 from spark_fsm_tpu.service import obsplane
-from spark_fsm_tpu.utils import obs
+from spark_fsm_tpu.utils import envelope, obs
 from spark_fsm_tpu.utils.obs import log_event
+
+
+def _open(raw) -> dict:
+    """Tolerant verified decode of one autoscale control record:
+    envelope unwrap (legacy bare JSON accepted) + json.loads, ``{}``
+    for anything rotten.  Control records are re-derived every decide
+    cadence, so the degradation posture for corruption is simply a
+    skipped epoch — never a crashed control loop (ISSUE 18)."""
+    payload, _verdict = envelope.unwrap(raw)
+    if payload is None:
+        return {}
+    try:
+        rec = json.loads(payload)
+    except ValueError:
+        return {}
+    return rec if isinstance(rec, dict) else {}
 
 LEADER_KEY = "fsm:autoscale:leader"
 DESIRED_KEY = "fsm:autoscale:desired"
@@ -166,17 +182,14 @@ class Autoscaler:
         are strictly ordered."""
         raw = self._store.peek(LEADER_KEY)
         if raw is not None:
-            try:
-                rec = json.loads(raw)
-            except ValueError:
-                rec = {}
-            if rec.get("replica") == self.mgr.replica_id:
+            if _open(raw).get("replica") == self.mgr.replica_id:
                 return bool(self._store.pexpire(LEADER_KEY, self._ttl_ms))
             return False
         token = int(self._store.incr(_TOKEN_KEY))
         ok = self._store.set_px(
             LEADER_KEY,
-            json.dumps({"replica": self.mgr.replica_id, "token": token}),
+            envelope.wrap(json.dumps(
+                {"replica": self.mgr.replica_id, "token": token})),
             self._ttl_ms, nx=True)
         if ok:
             log_event("autoscale_leader_acquired",
@@ -270,7 +283,7 @@ class Autoscaler:
                "victim": victim,
                "leader": self.mgr.replica_id, "seq": token,
                "ts": round(time.time(), 3)}
-        payload = json.dumps(rec)
+        payload = envelope.wrap(json.dumps(rec))
         self._store.set(DESIRED_KEY, payload)
         try:
             self._store.rpush(LOG_KEY, payload)
@@ -392,10 +405,7 @@ class Autoscaler:
         except Exception as exc:
             log_event("autoscale_directive_check_failed", error=str(exc))
             return False
-        try:
-            rec = json.loads(raw)
-        except ValueError:
-            rec = {}
+        rec = _open(raw)
         _DIRECTIVES.inc()
         log_event("autoscale_drain_claimed", replica=self.mgr.replica_id,
                   directive=rec)
@@ -409,8 +419,9 @@ class Autoscaler:
             try:
                 self._store.set_px(
                     drained_key(self.mgr.replica_id),
-                    json.dumps({"report": report,
-                                "ts": round(time.time(), 3)}),
+                    envelope.wrap(json.dumps(
+                        {"report": report,
+                         "ts": round(time.time(), 3)})),
                     10 * 60 * 1000)
             except Exception:
                 pass
@@ -476,7 +487,7 @@ class Autoscaler:
         # drop the leader lease so a successor takes over immediately
         try:
             raw = self._store.peek(LEADER_KEY)
-            if raw is not None and json.loads(raw).get(
+            if raw is not None and _open(raw).get(
                     "replica") == self.mgr.replica_id:
                 self._store.delete(LEADER_KEY)
         except Exception:
@@ -488,7 +499,7 @@ class Autoscaler:
     def desired(self) -> Optional[dict]:
         try:
             raw = self._store.peek(DESIRED_KEY)
-            return json.loads(raw) if raw else None
+            return (_open(raw) or None) if raw else None
         except Exception:
             return None
 
@@ -499,10 +510,9 @@ class Autoscaler:
             return []
         out = []
         for raw in rows[-n:]:
-            try:
-                out.append(json.loads(raw))
-            except ValueError:
-                continue
+            rec = _open(raw)
+            if rec:
+                out.append(rec)
         return out
 
     def stats(self) -> dict:
@@ -511,7 +521,7 @@ class Autoscaler:
         leader = None
         try:
             raw = self._store.peek(LEADER_KEY)
-            leader = json.loads(raw).get("replica") if raw else None
+            leader = _open(raw).get("replica") if raw else None
         except Exception:
             pass
         return {"enabled": True,

@@ -68,7 +68,7 @@ import uuid
 from typing import Callable, Dict, List, Optional
 
 from spark_fsm_tpu.service import obsplane
-from spark_fsm_tpu.utils import faults, jobctl, obs
+from spark_fsm_tpu.utils import envelope, faults, jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event
 
 _HELD = obs.REGISTRY.gauge(
@@ -224,10 +224,18 @@ class LeaseManager:
 
     @staticmethod
     def _parse(raw: Optional[str]) -> dict:
+        """Envelope-aware tolerant decode: journal intents and heartbeat
+        records now ride checksum envelopes (utils/envelope.py); legacy
+        bare JSON still parses, corrupt bytes read as absent ({}) — the
+        lease plane's degradation for a rotten record is simply to not
+        trust it."""
         if not raw:
             return {}
+        payload, _verdict = envelope.unwrap(raw)
+        if payload is None:
+            return {}
         try:
-            out = json.loads(raw)
+            out = json.loads(payload)
             return out if isinstance(out, dict) else {}
         except ValueError:
             return {}
@@ -666,7 +674,7 @@ class LeaseManager:
         serve the aggregated cluster view (/admin/cluster,
         fsm_cluster_*) without touching its peers directly."""
         m = self._miner
-        self._store.set_px(self._hb_key, json.dumps({
+        self._store.set_px(self._hb_key, envelope.wrap(json.dumps({
             "replica": self.replica_id,
             "queued": m.queue_size() if m is not None else 0,
             "running": m.running_count() if m is not None else 0,
@@ -714,7 +722,7 @@ class LeaseManager:
                     if m is not None else 0),
             "acq": int(_ACQUIRE_TOTAL.total()),
             "lost": int(_LOST_TOTAL.total()),
-            "ts": round(time.time(), 3)}), self._ttl_ms)
+            "ts": round(time.time(), 3)})), self._ttl_ms)
         _HEARTBEATS_TOTAL.inc()
 
     def peers(self, max_age_s: Optional[float] = None) -> List[dict]:
@@ -986,6 +994,14 @@ class LeaseManager:
                 except Exception as exc:
                     log_event("lease_periodic_recovery_failed",
                               error=str(exc))
+        # background integrity scrub (ISSUE 18) rides the heartbeat
+        # cadence in clustered boots — next-due gating lives inside the
+        # scrubber, this is one cheap global read per tick when idle
+        try:
+            from spark_fsm_tpu.service import integrity
+            integrity.tick()
+        except Exception as exc:
+            log_event("integrity_scrub_failed", error=str(exc))
 
     def quiesce(self) -> None:
         """Stop pulling NEW work (steal scans, periodic adoption) while

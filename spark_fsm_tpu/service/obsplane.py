@@ -47,10 +47,10 @@ import json
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from spark_fsm_tpu.service import storeguard
-from spark_fsm_tpu.utils import jobctl, obs
+from spark_fsm_tpu.utils import envelope, jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event
 
 # THE priority vocabulary: admission classes AND the SLO label seeding.
@@ -258,9 +258,9 @@ class TraceSpine:
             _SPINE_WRITES.inc(outcome="error")
             log_event("trace_spine_fence_error", uid=uid, error=str(exc))
             return "error"
-        chunk = json.dumps({"replica": self.replica_id,
-                            "boot": self.boot_id, "token": token,
-                            "ts": round(time.time(), 3), "spans": spans})
+        chunk = envelope.wrap(json.dumps(
+            {"replica": self.replica_id, "boot": self.boot_id,
+             "token": token, "ts": round(time.time(), 3), "spans": spans}))
         cap = self._max_chunks if self._max_chunks is not None \
             else _max_chunks
         try:
@@ -336,28 +336,53 @@ def configure(ocfg) -> None:
 
 # ---------------------------------------------------------------- timeline
 
-def spine_chunks(store, uid: str) -> List[dict]:
-    """The uid's parsed spine chunks (malformed entries skipped)."""
+def spine_chunks_verified(store, uid: str) -> "Tuple[List[dict], int]":
+    """The uid's verified spine chunks + how many were dropped as
+    corrupt.  Each chunk rides a checksum envelope (legacy bare-JSON
+    chunks still parse); a chunk that fails the envelope OR json.loads
+    OR isn't a dict is skipped and counted — one rotten chunk must
+    never abort a timeline dump (ISSUE 18)."""
+    from spark_fsm_tpu.service import integrity
+
     try:
         raws = store.spine_chunks(uid)
     except Exception:
-        return []
-    out = []
+        return [], 0
+    out: List[dict] = []
+    corrupt = 0
     for raw in raws:
-        try:
-            c = json.loads(raw)
-        except ValueError:
+        payload, verdict = envelope.unwrap(raw)
+        c = None
+        if verdict != "corrupt":
+            try:
+                c = json.loads(payload)
+            except (ValueError, TypeError):
+                c = None
+            if not isinstance(c, dict):
+                c, verdict = None, "corrupt"
+        integrity.note_read("spine", verdict)
+        if c is None:
+            corrupt += 1
             continue
-        if isinstance(c, dict):
-            out.append(c)
-    return out
+        out.append(c)
+    return out, corrupt
+
+
+def spine_chunks(store, uid: str) -> List[dict]:
+    """The uid's parsed spine chunks (malformed entries skipped)."""
+    return spine_chunks_verified(store, uid)[0]
 
 
 def last_activity_ts(store, uid: str) -> Optional[float]:
     """Wall timestamp of the uid's most recent spine chunk — the
     adopter's reference point for time-to-adoption (the dead owner's
     last durable flush is its last provable sign of life)."""
-    ts = [float(c.get("ts") or 0) for c in spine_chunks(store, uid)]
+    ts = []
+    for c in spine_chunks(store, uid):
+        try:
+            ts.append(float(c.get("ts") or 0))
+        except (TypeError, ValueError):
+            pass
     ts = [t for t in ts if t > 0]
     return max(ts) if ts else None
 
@@ -373,7 +398,7 @@ def merged_timeline(store, uid: str, local_dump: Optional[dict] = None,
     by wall ``ts``.  ``boot_id`` is the serving replica's current boot
     nonce (its local ring was flushed under it); None when neither
     source knows the uid."""
-    chunks = spine_chunks(store, uid)
+    chunks, corrupt_chunks = spine_chunks_verified(store, uid)
     spans: List[dict] = []
     seen = set()
     replicas = set()
@@ -404,12 +429,26 @@ def merged_timeline(store, uid: str, local_dump: Optional[dict] = None,
             s["replica"] = rid
             spans.append(s)
             replicas.add(rid)
-    if not spans and local_dump is None:
+    if not spans and local_dump is None and not corrupt_chunks:
         return None
-    spans.sort(key=lambda s: (s.get("ts") or 0.0, s.get("span_id") or 0))
+
+    def _order(s: dict):
+        # damaged chunks can smuggle mixed-type ts/span_id values past
+        # json.loads; the sort must not TypeError on them
+        try:
+            ts = float(s.get("ts") or 0.0)
+        except (TypeError, ValueError):
+            ts = 0.0
+        sid = s.get("span_id")
+        if isinstance(sid, (int, float)):
+            return (ts, 0, sid, "")
+        return (ts, 1, 0, str(sid))
+
+    spans.sort(key=_order)
     return {"trace_id": uid, "merged": True,
             "replicas": sorted(replicas),
             "n_spans": len(spans), "spine_chunks": len(chunks),
+            "corrupt_chunks": corrupt_chunks,
             "attrs": dict((local_dump or {}).get("attrs", {})),
             "dropped_spans": (local_dump or {}).get("dropped_spans", 0),
             "spans": spans}

@@ -459,13 +459,16 @@ class Predictor:
             from spark_fsm_tpu.service import resultcache
 
             algo = (req.param("algorithm") or "TSR_TPU").upper()
-            raw = self.store.get(resultcache.entry_key(fp, algo))
-            if raw is None:
+            # verified read + rules_digest cross-check: the artifact
+            # cache below keys compiled tries on that digest, so never
+            # build from bytes the digest does not vouch for.  Corrupt
+            # entries are quarantined inside open_entry and report as
+            # missing here (degrade, don't crash).
+            opened = resultcache.open_entry(self.store, fp, algo,
+                                            check_digest=True)
+            if opened is None:
                 return None, "no rescache entry for fingerprint", ""
-            try:
-                ent = json.loads(raw)
-            except ValueError:
-                return None, "corrupt rescache entry", ""
+            ent, _size = opened
             return (ent.get("payload") or "[]",
                     ent.get("kind") or "rules", f"fp:{fp}:{algo}")
         return None, "predict needs 'uid' (finished job) or 'fingerprint'", ""

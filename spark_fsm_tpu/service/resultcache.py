@@ -90,9 +90,9 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from spark_fsm_tpu import config
-from spark_fsm_tpu.service import model, obsplane
+from spark_fsm_tpu.service import integrity, model, obsplane
 from spark_fsm_tpu.service.model import ServiceRequest, Status
-from spark_fsm_tpu.utils import faults, jobctl, obs
+from spark_fsm_tpu.utils import envelope, faults, jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event
 
 # ---------------------------------------------------------------- metrics
@@ -172,6 +172,81 @@ def _lru_key(fp: str, algo: str) -> str:
 
 def _src_key(srckey: str) -> str:
     return f"fsm:rescache-src:{srckey}"
+
+
+def sidecar_key_for(ekey: str) -> str:
+    """``fsm:rescache:{fp}:{algo}`` -> its LRU sidecar key."""
+    return "fsm:rescache-lru:" + ekey[len("fsm:rescache:"):]
+
+
+def entry_key_for_sidecar(skey: str) -> str:
+    return "fsm:rescache:" + skey[len("fsm:rescache-lru:"):]
+
+
+def parse_entry(payload: Optional[str],
+                check_digest: bool = True) -> Optional[dict]:
+    """Decode one cache-entry payload; with ``check_digest`` also
+    cross-check the stored ``rules_digest`` against a recompute over the
+    payload string — the PR 17 artifact cache keys compiled tries on
+    that digest, so an artifact must never be built from bytes the
+    digest does not vouch for.  None = undecodable or digest mismatch
+    (the caller treats it as corrupt).  Entries predating the digest
+    field pass undigested."""
+    if payload is None:
+        return None
+    try:
+        ent = json.loads(payload)
+    except ValueError:
+        return None
+    if not isinstance(ent, dict) or not isinstance(ent.get("payload"), str):
+        return None
+    if check_digest and ent.get("digest"):
+        from spark_fsm_tpu.ops.rule_trie import rules_digest
+
+        if rules_digest(ent["payload"]) != ent["digest"]:
+            return None
+    return ent
+
+
+def open_entry(store, fp: str, algo: str, check_digest: bool = False):
+    """Verified read of one cache entry: envelope unwrap + decode
+    (+ digest cross-check when asked — the artifact-build path).
+    Returns ``(ent, payload_size)``, or None — and on CORRUPT bytes
+    first quarantines the entry and drops its sidecar, so the caller's
+    fall-through to a cold mine also heals the keyspace: corrupt bytes
+    are never served, and never crash admission (ISSUE 18)."""
+    key = entry_key(fp, algo)
+    raw = store.get(key)
+    if raw is None:
+        return None
+    payload, verdict = envelope.unwrap(raw)
+    ent = None
+    if verdict != "corrupt":
+        ent = parse_entry(payload, check_digest=check_digest)
+        if ent is None:
+            verdict = "corrupt"
+    integrity.note_read("rescache", verdict)
+    if ent is not None:
+        return ent, len(payload)
+    integrity.quarantine(store, key, raw, "rescache", move=True)
+    store.delete(sidecar_key_for(key))
+    log_event("rescache_entry_quarantined", key=key)
+    return None
+
+
+def write_sidecar(store, ekey: str, ent: dict, size: int,
+                  ts: Optional[float] = None) -> None:
+    """(Re)write an entry's LRU sidecar — shared by the store path, the
+    serve-time LRU touch, and the scrubber's sidecar repair (which
+    passes no ``ts`` so the re-derived sidecar keeps the ENTRY's age
+    instead of artificially refreshing its eviction rank)."""
+    if ts is None:
+        try:
+            ts = float(ent.get("ts") or time.time())
+        except (TypeError, ValueError):
+            ts = time.time()
+    store.set(sidecar_key_for(ekey), envelope.wrap(json.dumps(
+        {"ts": ts, "bytes": size, "digest": ent.get("digest")})))
 
 
 def _conf_frac(minconf: float) -> Tuple[int, int]:
@@ -618,10 +693,12 @@ class ResultCache:
     def _try_serve(self, req: ServiceRequest, fp: str, ident: _Identity,
                    priority: str) -> Optional[str]:
         algo = ident.params["algo"]
-        raw = self.store.get(entry_key(fp, algo))
-        if raw is None:
+        opened = open_entry(self.store, fp, algo)
+        if opened is None:
+            # missing — or corrupt: already quarantined, and the
+            # request falls through to a cold mine (never served)
             return None
-        ent = json.loads(raw)
+        ent, size = opened
         served = _servable(ent, ident.params)
         if served is None:
             return None
@@ -634,8 +711,8 @@ class ResultCache:
         # sidecar also carries the entry's byte size so the eviction
         # sweep never has to read payloads)
         try:
-            self.store.set(_lru_key(fp, algo), json.dumps(
-                {"ts": time.time(), "bytes": len(raw)}))
+            write_sidecar(self.store, entry_key(fp, algo), ent, size,
+                          ts=time.time())
         except Exception:
             pass
         return "served"
@@ -882,9 +959,12 @@ class ResultCache:
             "algo": plugin.name, "kind": plugin.kind, "params": params,
             "n_sequences": n, "uid": req.uid, "digest": digest,
             "ts": round(time.time(), 3), "payload": payload})
-        self.store.set(entry_key(fp, plugin.name), ent)
-        self.store.set(_lru_key(fp, plugin.name), json.dumps(
-            {"ts": time.time(), "bytes": len(ent), "digest": digest}))
+        # enveloped (utils/envelope.py) — entry FIRST, sidecar second:
+        # a kill between the two leaves an intact entry whose sidecar
+        # the scrubber (or the next serve-miss scrub) re-derives
+        self.store.set(entry_key(fp, plugin.name), envelope.wrap(ent))
+        self.store.set(_lru_key(fp, plugin.name), envelope.wrap(json.dumps(
+            {"ts": time.time(), "bytes": len(ent), "digest": digest})))
         _BYTES_TOTAL.inc(len(ent))
         log_event("rescache_entry_stored", uid=req.uid, fp=fp[:16],
                   algo=plugin.name, bytes=len(ent))
@@ -902,7 +982,8 @@ class ResultCache:
         for key in self.store.scan_iter("fsm:rescache:"):
             tail = key[len("fsm:rescache:"):]
             ts, size, digest = 0.0, None, None
-            side = self.store.peek("fsm:rescache-lru:" + tail)
+            side, _sv = envelope.unwrap(
+                self.store.peek("fsm:rescache-lru:" + tail))
             if side:
                 try:
                     meta = json.loads(side)
@@ -915,7 +996,8 @@ class ResultCache:
                 raw = self.store.peek(key)
                 if raw is None:
                     continue
-                size = len(raw)
+                payload, _v = envelope.unwrap(raw)
+                size = len(payload) if payload is not None else len(raw)
             rows.append((ts, key, tail, size, digest))
         return rows
 

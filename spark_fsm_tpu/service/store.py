@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from spark_fsm_tpu.utils import faults, obs
+from spark_fsm_tpu.utils import envelope, faults, obs
 
 # Latency of the three guarded store verbs, labelled by op and backend
 # (inproc latencies are the no-op baseline a Redis deployment's numbers
@@ -101,7 +101,9 @@ class ResultStore:
             faults.fault_site("store.get", key=key)
             with self._lock:
                 self._alive(key)
-                return self._kv.get(key)
+                value = self._kv.get(key)
+            # bitrot chaos seam (ISSUE 18): disarmed = one global read
+            return faults.corrupt_value("store.corrupt", value, key=key)
 
     def peek(self, key: str) -> Optional[str]:
         """Guard-free read for scrape-time metric collectors AND the
@@ -162,7 +164,9 @@ class ResultStore:
 
     def lrange(self, key: str) -> List[str]:
         with self._lock:
-            return list(self._lists.get(key, []))
+            values = list(self._lists.get(key, []))
+        # per-ELEMENT bitrot seam: nth addresses a specific chunk
+        return faults.corrupt_list("store.corrupt", values, key=key)
 
     def lpop(self, key: str) -> Optional[str]:
         with self._lock:
@@ -284,10 +288,26 @@ class ResultStore:
 
     def journal_set(self, uid: str, payload_json: str) -> None:
         faults.fault_site("service.journal", key=f"fsm:journal:{uid}")
-        self.set(f"fsm:journal:{uid}", payload_json)
+        # every journal intent is written enveloped (utils/envelope.py);
+        # journal_get verifies, and legacy pre-envelope intents pass
+        # through untouched until their next write upgrades them
+        self.set(f"fsm:journal:{uid}", envelope.wrap(payload_json))
 
     def journal_get(self, uid: str) -> Optional[str]:
-        return self.get(f"fsm:journal:{uid}")
+        """Verified journal read: the intent payload on an intact or
+        legacy value; on a CORRUPT envelope the raw damaged bytes are
+        returned so the caller's JSON parse fails into its existing
+        degrade path (recover_orphans quarantines, lease._parse treats
+        it as not-ours) instead of this layer guessing a policy."""
+        raw = self.get(f"fsm:journal:{uid}")
+        payload, verdict = envelope.unwrap(raw)
+        if verdict == "missing":
+            return None
+        # lazy import: integrity sits above the store in the service
+        # layering (it holds the counters + quarantine policy)
+        from spark_fsm_tpu.service import integrity
+        integrity.note_read("journal", verdict)
+        return raw if verdict == "corrupt" else payload
 
     def journal_clear(self, uid: str) -> None:
         self.delete(f"fsm:journal:{uid}")
@@ -313,7 +333,11 @@ class ResultStore:
 
     def spine_chunks(self, uid: str) -> List[str]:
         with self._lock:
-            return list(self._lists.get(f"fsm:trace:{uid}", ()))
+            values = list(self._lists.get(f"fsm:trace:{uid}", ()))
+        # raise-free but NOT bitrot-free: the spine is a durable surface
+        # too, and obsplane's verified reader must see planted damage
+        return faults.corrupt_list("store.corrupt", values,
+                                   key=f"fsm:trace:{uid}")
 
     def spine_trim(self, uid: str, keep_last: int) -> None:
         """Retention bound: keep only the NEWEST ``keep_last`` chunks
@@ -404,7 +428,8 @@ class RedisResultStore(ResultStore):
     def get(self, key: str) -> Optional[str]:
         with _timed("get", "redis"):
             faults.fault_site("store.get", key=key)
-            return self._r.get(key)
+            return faults.corrupt_value("store.corrupt", self._r.get(key),
+                                        key=key)
 
     def peek(self, key: str) -> Optional[str]:
         return self._r.get(key)
@@ -425,7 +450,8 @@ class RedisResultStore(ResultStore):
             self._r.rpush(key, value)
 
     def lrange(self, key: str) -> List[str]:
-        return self._r.lrange(key, 0, -1)
+        return faults.corrupt_list("store.corrupt",
+                                   self._r.lrange(key, 0, -1), key=key)
 
     def lpop(self, key: str) -> Optional[str]:
         return self._r.lpop(key)
@@ -485,7 +511,9 @@ class RedisResultStore(ResultStore):
         self._r.rpush(f"fsm:trace:{uid}", chunk_json)
 
     def spine_chunks(self, uid: str) -> List[str]:
-        return self._r.lrange(f"fsm:trace:{uid}", 0, -1)
+        return faults.corrupt_list(
+            "store.corrupt", self._r.lrange(f"fsm:trace:{uid}", 0, -1),
+            key=f"fsm:trace:{uid}")
 
     def spine_trim(self, uid: str, keep_last: int) -> None:
         if keep_last <= 0:

@@ -52,6 +52,11 @@ Disabled (``[storeguard] enabled = false``, the default): no guard
 objects exist, :func:`get` returns None, and every durable-write path
 pays exactly one ``is None`` read — scripts/bench_smoke.sh's dispatch
 counters stay byte-identical.
+
+Integrity envelopes (ISSUE 18) need no handling here: callers compose
+the checksum envelope at value-production time, BEFORE the spool-vs-
+direct dispatch, so a spooled write replays the already-enveloped bytes
+verbatim and verify-on-read sees one format either way.
 """
 
 from __future__ import annotations

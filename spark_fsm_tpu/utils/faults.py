@@ -110,6 +110,18 @@ KNOWN_SITES = (
                          # terminal-failure path (job fenced, spool
                          # dropped, store left heal-able), NEVER a
                          # corrupt/partial state accepted on resume
+    "store.corrupt",     # bitrot simulation on durable READS
+                         # (service/store get/lrange/spine_chunks, via
+                         # :func:`corrupt_value`) — fires by RETURNING
+                         # deterministically damaged bytes (odd
+                         # injections byte-flip the middle character,
+                         # even injections truncate to the first half)
+                         # instead of raising; ``exc``/``delay_s`` are
+                         # ignored.  The envelope layer
+                         # (utils/envelope.py) must detect every hit
+                         # and each surface must degrade per its
+                         # integrity posture (service/integrity.py),
+                         # never parse the damage
 )
 
 _EXC_BY_NAME = {"fault": FaultInjected, "oom": InjectedOom, "none": None}
@@ -285,6 +297,63 @@ def fault_site(site: str, **ctx) -> None:
     if exc is not None:
         raise exc(site) if exc is InjectedOom else exc(
             f"injected fault at site {site!r} (ctx {ctx!r})")
+
+
+def corrupt_value(site: str, value, **ctx):
+    """The value-TRANSFORMING sibling of :func:`fault_site`, woven into
+    durable read verbs for the ``store.corrupt`` bitrot site: when the
+    armed trigger fires, the read returns a deterministically damaged
+    copy of ``value`` instead of raising.
+
+    Damage alternates by injection parity so one arm exercises both
+    envelope failure modes: odd injections BYTE-FLIP (xor 0x01 on the
+    middle character — digest mismatch at intact length), even
+    injections TRUNCATE to the first half (length mismatch).  ``None``
+    and empty values pass through WITHOUT counting a call, so ``nth``
+    deterministically addresses the nth damageable read of a matched
+    key.  ``exc``/``delay_s`` on the spec are ignored.  Disarmed cost:
+    one module-global read.
+    """
+    if not _active:
+        return value
+    if value is None or value == "":
+        return value
+    with _lock:
+        spec = _armed.get(site)
+        if spec is None:
+            return value
+        if spec.match is not None and not any(
+                spec.match in v for v in ctx.values() if isinstance(v, str)):
+            return value
+        spec.calls += 1
+        c = _counters.setdefault(site, {"calls": 0, "injected": 0})
+        c["calls"] += 1
+        fire = ((spec.nth is not None and spec.calls == spec.nth)
+                or (spec.every is not None
+                    and spec.calls % spec.every == 0)
+                or (spec.p is not None and spec.rng.random() < spec.p))
+        if not fire or (spec.times is not None
+                        and spec.injected >= spec.times):
+            return value
+        spec.injected += 1
+        c["injected"] += 1
+        flip = spec.injected % 2 == 1
+    obs.trace_event("fault_injected", site=site,
+                    mode="flip" if flip else "truncate")
+    if flip:
+        i = len(value) // 2
+        return value[:i] + chr(ord(value[i]) ^ 0x01) + value[i + 1:]
+    return value[:max(1, len(value) // 2)]
+
+
+def corrupt_list(site: str, values, **ctx):
+    """`corrupt_value` over a list read (lrange / spine_chunks): each
+    element is one trigger call, so ``nth`` addresses a specific chunk
+    of a matched key (e.g. the 2nd checkpoint delta).  Disarmed cost:
+    one module-global read — the list is returned untouched."""
+    if not _active:
+        return values
+    return [corrupt_value(site, v, **ctx) for v in values]
 
 
 @contextmanager

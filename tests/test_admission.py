@@ -492,7 +492,8 @@ def test_recovery_is_idempotent_and_skips_live_jobs(monkeypatch):
         master.miner.submit(_req("held"))
         assert gate.entered.wait(DRILL_TIMEOUT_S)
         report = recover_orphans(master)
-        assert report == {"resumed": [], "failed": [], "cleared": []}
+        assert report == {"resumed": [], "failed": [], "cleared": [],
+                          "quarantined": []}
         assert store.status("held") == "started"  # untouched
         gate.release.set()
         assert _await_terminal(store, "held") == "finished"

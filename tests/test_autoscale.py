@@ -128,7 +128,7 @@ def test_exactly_one_leader_and_failover_after_ttl():
     (sc_a, _, _), (sc_b, _, _) = rigs
     sc_a.tick()
     sc_b.tick()
-    rec = json.loads(store.peek(AS.LEADER_KEY))
+    rec = AS._open(store.peek(AS.LEADER_KEY))
     assert rec["replica"] == "as-0"
     assert sc_a.stats()["is_leader"] and not sc_b.stats()["is_leader"]
     # the leader dies (stops ticking); its lease expires on the store
@@ -136,7 +136,7 @@ def test_exactly_one_leader_and_failover_after_ttl():
     tok0 = rec["token"]
     t[0] = 10.0  # > leader_ttl_s
     sc_b.tick()
-    rec = json.loads(store.peek(AS.LEADER_KEY))
+    rec = AS._open(store.peek(AS.LEADER_KEY))
     assert rec["replica"] == "as-1"
     assert rec["token"] > tok0
 
@@ -156,7 +156,7 @@ def test_sustained_load_scales_up_once_after_hold():
     assert store.peek(AS.DESIRED_KEY) is None
     t[0] = 10.0
     sc.tick()  # held for hold_s: decision fires
-    rec = json.loads(store.peek(AS.DESIRED_KEY))
+    rec = AS._open(store.peek(AS.DESIRED_KEY))
     assert rec["dir"] == "up" and rec["desired"] == 2 \
         and rec["replicas"] == 1
     assert rec["leader"] == "as-0" and rec["seq"] > 0
@@ -198,7 +198,7 @@ def test_p99_signal_scales_up():
             obsplane.observe_job("normal", 5.0, 1.0, 4.0)
         t[0] = 1.0
         sc.tick()
-        rec = json.loads(store.peek(AS.DESIRED_KEY))
+        rec = AS._open(store.peek(AS.DESIRED_KEY))
         assert rec["dir"] == "up" and "p99" in rec["reason"]
         assert _decisions()["up"] == d0["up"] + 1
     finally:
@@ -239,7 +239,7 @@ def test_admission_rate_derivative_scales_up_predictively():
             fired_at = i
             break
     assert fired_at is not None
-    rec = json.loads(store.peek(AS.DESIRED_KEY))
+    rec = AS._open(store.peek(AS.DESIRED_KEY))
     assert rec["dir"] == "up"
     assert "rate" in rec["reason"] and "d(rate)/dt" in rec["reason"]
     assert _decisions()["up"] == d0["up"] + 1
@@ -280,13 +280,13 @@ def test_fleet_p99_merge_scales_up_from_a_peer_digest():
         # in-process obsplane, which the two rigs share — is what's
         # under test)
         mgr_b.publish_heartbeat()
-        rec = json.loads(store.peek("fsm:replica:as-1"))
+        rec = AS._open(store.peek("fsm:replica:as-1"))
         assert "slo" in rec  # the digest field rides every heartbeat
         rec["slo"] = {"p99": 6.5, "n": 40}
         store.set_px("fsm:replica:as-1", json.dumps(rec), 30000)
         t[0] = 1.0
         sc_a.tick()  # as-0 leads, local window empty — peer digest wins
-        out = json.loads(store.peek(AS.DESIRED_KEY))
+        out = AS._open(store.peek(AS.DESIRED_KEY))
         assert out["dir"] == "up" and "p99" in out["reason"]
         assert sc_a.stats()["last_eval"]["p99_s"] == 6.5
         assert _decisions()["up"] == d0["up"] + 1
@@ -307,7 +307,7 @@ def test_scale_down_targets_least_loaded_and_respects_min():
     t[0] = 5.0
     mgr_b.publish_heartbeat()
     sc_a.tick()
-    rec = json.loads(store.peek(AS.DESIRED_KEY))
+    rec = AS._open(store.peek(AS.DESIRED_KEY))
     assert rec["dir"] == "down" and rec["desired"] == 1
     assert rec["victim"] == "as-1"
     assert _decisions()["down"] == d0["down"] + 1

@@ -1215,3 +1215,77 @@ def test_storeguard_replay_fault_degrades_terminal_never_corrupt():
     finally:
         jobctl.release("rpl-1")
         SG.uninstall()
+
+
+@covers("store.corrupt")
+def test_bitrot_checkpoint_delta_heals_to_last_good_snapshot():
+    """Bitrot on a checkpoint delta chunk (store.corrupt on the nth
+    durable read): load() truncates to the last good snapshot embedded
+    in the preceding chunk and RESUMES — the corruption costs only the
+    work mined after that chunk, never a restart, never a torn resume
+    (ISSUE 18)."""
+    def scenario():
+        store = ResultStore()
+        ckpt = StoreCheckpoint(store, "rot-1", every_s=0.0)
+        a, b, c = [[[[1]], 3]], [[[[1], [2]], 2]], [[[[2]], 2]]
+        ckpt.save({"version": 1, "stack": [{"x": 1}], "results_done": 0,
+                   "results": list(a)})
+        ckpt.save({"version": 1, "stack": [{"x": 2}], "results_done": 1,
+                   "results": list(b)})
+        ckpt.save({"version": 1, "stack": [], "results_done": 2,
+                   "results": list(c)})
+        # nth=2 addresses the SECOND chunk of the lrange (byte-flip:
+        # intact length, dead digest) — the newest delta rots at rest
+        with faults.injected("store.corrupt", nth=2,
+                             match="fsm:frontier:results:"):
+            healed = ckpt.load()
+        assert healed is not None, "corrupt delta must heal, not restart"
+        assert healed["results"] == a + b  # truncated to chunk 1's snapshot
+        assert healed["stack"] == [{"x": 2}]  # chunk 1's embedded frontier
+        assert store.llen("fsm:frontier:results:rot-1") == 1
+        # the damaged bytes are preserved for the post-mortem
+        assert store.peek("fsm:quarantine:frontier:results:rot-1#1")
+        # the heal is durable: a clean (disarmed) reload agrees
+        again = ckpt.load()
+        assert again["results"] == a + b
+        # and the mine RESUMES: the next save extends the healed prefix
+        ckpt.save({"version": 1, "stack": [], "results_done": 2,
+                   "results": list(c)})
+        assert ckpt.load()["results"] == a + b + c
+    _bounded(scenario)
+
+
+@covers("store.corrupt")
+def test_bitrot_rescache_entry_quarantined_never_served():
+    """Bitrot on a rescache entry (truncation this time): the verified
+    read quarantines it and reports a miss — corrupt bytes are never
+    served and never crash admission; the request falls through to a
+    cold mine."""
+    from spark_fsm_tpu.ops.rule_trie import rules_digest
+    from spark_fsm_tpu.service import resultcache
+    from spark_fsm_tpu.utils import envelope
+
+    def scenario():
+        store = ResultStore()
+        payload = json.dumps([[[[1]], 5]])
+        ent = json.dumps({"algo": "SPADE_TPU", "kind": "patterns",
+                          "params": {}, "n_sequences": 10, "uid": "u-rot",
+                          "digest": rules_digest(payload),
+                          "ts": time.time(), "payload": payload})
+        key = resultcache.entry_key("fp-rot", "SPADE_TPU")
+        store.set(key, envelope.wrap(ent))
+        resultcache.write_sidecar(store, key, json.loads(ent), len(ent))
+        # sanity: the intact entry opens
+        assert resultcache.open_entry(store, "fp-rot", "SPADE_TPU")
+        # the next read rots (byte-flip: intact length, dead digest)
+        with faults.injected("store.corrupt", nth=1,
+                             match="fsm:rescache:"):
+            assert resultcache.open_entry(
+                store, "fp-rot", "SPADE_TPU") is None
+        # quarantined + invalidated: entry AND sidecar gone, bytes kept
+        assert store.peek(key) is None
+        assert store.peek(resultcache.sidecar_key_for(key)) is None
+        assert store.peek("fsm:quarantine:rescache:fp-rot:SPADE_TPU")
+        # the miss is sticky-clean: a later (disarmed) lookup just misses
+        assert resultcache.open_entry(store, "fp-rot", "SPADE_TPU") is None
+    _bounded(scenario)

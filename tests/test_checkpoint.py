@@ -20,6 +20,7 @@ from spark_fsm_tpu.service import plugins
 from spark_fsm_tpu.service.actors import Master, StoreCheckpoint
 from spark_fsm_tpu.service.model import ServiceRequest
 from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import envelope
 from spark_fsm_tpu.utils.canonical import diff_patterns, patterns_text
 
 
@@ -155,9 +156,9 @@ def test_store_checkpoint_roundtrip_and_job_clear():
     # beyond repair and refused outright
     store.rpush("fsm:frontier:results:job1",
                 json.dumps([[[[8]], 1], [[[7]], 1]]))
-    meta = json.loads(store.get("fsm:frontier:job1"))
+    meta = json.loads(envelope.unwrap(store.get("fsm:frontier:job1"))[0])
     meta["results_total"] = 3  # mid-chunk divergence: 2 then 4, never 3
-    store.set("fsm:frontier:job1", json.dumps(meta))
+    store.set("fsm:frontier:job1", envelope.wrap(json.dumps(meta)))
     assert ckpt.load() is None
     ckpt.save({"version": 1, "stack": [], "results_done": 0, "results": []})
     assert ckpt.load()["results"] == []

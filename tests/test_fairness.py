@@ -306,12 +306,14 @@ def test_heartbeat_piggybacks_tenant_depths_and_drain_state(
         mgr.publish_heartbeat()
         import json as _json
 
-        rec = _json.loads(store.peek("fsm:replica:rep-t"))
+        from spark_fsm_tpu.utils import envelope as _env
+
+        rec = _json.loads(_env.unwrap(store.peek("fsm:replica:rep-t"))[0])
         assert rec["draining"] is False
         assert rec["tenants"] == {}
         assert rec["fps"] == []
         mgr.set_draining(True)
-        rec = _json.loads(store.peek("fsm:replica:rep-t"))
+        rec = _json.loads(_env.unwrap(store.peek("fsm:replica:rep-t"))[0])
         assert rec["draining"] is True and rec["free"] == 0
         assert gate_req is not None
     finally:

@@ -1,0 +1,262 @@
+"""Durable-state integrity plane (ISSUE 18): envelope wire format,
+per-surface verify-on-read degradation, the quarantine keyspace, and
+the background scrubber.
+
+The crash-TIMING halves of the story live next to their subsystems
+(tests/test_checkpoint.py for torn saves, tests/test_resultcache.py for
+the entry/sidecar write window); the chaos-injection half
+(``store.corrupt``) lives in tests/test_chaos.py.  This file owns the
+*byte-damage* semantics: what each surface does when stored bytes fail
+their checksum.
+"""
+
+import json
+
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.service import integrity, obsplane, resultcache
+from spark_fsm_tpu.service.actors import (Master, StoreCheckpoint,
+                                          recover_orphans)
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import envelope
+
+
+# ---------------------------------------------------------------- envelope
+
+
+def _flip(value: str, at: int) -> str:
+    return value[:at] + chr(ord(value[at]) ^ 0x01) + value[at + 1:]
+
+
+def test_envelope_roundtrip_and_verdicts():
+    payload = json.dumps({"k": [1, 2, 3], "täxt": "ünïcode ✓"})
+    w = envelope.wrap(payload)
+    assert envelope.is_enveloped(w)
+    assert envelope.unwrap(w) == (payload, "ok")
+    # legacy: anything not carrying the magic passes through unverified
+    assert envelope.unwrap(payload) == (payload, "legacy")
+    assert envelope.unwrap("") == ("", "legacy")
+    assert envelope.unwrap(None) == (None, "missing")
+    # byte-flip inside the payload: digest mismatch at intact length
+    assert envelope.unwrap(_flip(w, len(w) - 3)) == (None, "corrupt")
+    # flip inside the stored digest itself
+    assert envelope.unwrap(_flip(w, 8)) == (None, "corrupt")
+    # truncation: length mismatch
+    assert envelope.unwrap(w[: len(w) // 2]) == (None, "corrupt")
+    # an unknown schema version is corrupt, not legacy: the magic says
+    # "enveloped", so failing to verify it must never read as a pass
+    assert envelope.unwrap("FSME9" + w[5:]) == (None, "corrupt")
+    # magic with a mangled header
+    assert envelope.unwrap("FSME1:nonsense") == (None, "corrupt")
+
+
+# ------------------------------------------------- checkpoint degradation
+
+
+def test_corrupt_checkpoint_meta_restarts_fresh_loudly():
+    store = ResultStore()
+    ckpt = StoreCheckpoint(store, "cm-1", every_s=0.0)
+    ckpt.save({"version": 1, "stack": [{"x": 1}], "results_done": 0,
+               "results": [[[[1]], 3]]})
+    ckpt.save({"version": 1, "stack": [], "results_done": 1,
+               "results": [[[[2]], 2]]})
+    meta_key = "fsm:frontier:cm-1"
+    store.set(meta_key, _flip(store.get(meta_key), 80))
+    assert ckpt.load() is None  # identity unverifiable: restart fresh
+    # both keys dropped so the fresh mine starts clean...
+    assert store.peek(meta_key) is None
+    assert store.llen("fsm:frontier:results:cm-1") == 0
+    # ...and the damaged bytes are preserved for the post-mortem
+    assert store.peek("fsm:quarantine:frontier:cm-1") is not None
+
+
+def test_legacy_checkpoint_loads_and_upgrades_on_next_save():
+    """Pre-envelope checkpoints (bare JSON meta + bare delta chunks)
+    still resume — no flag-day migration — and the next save rewrites
+    the surface enveloped."""
+    store = ResultStore()
+    store.set("fsm:frontier:leg-1", json.dumps(
+        {"version": 1, "stack": [], "results_total": 2,
+         "results_inline": [[[[1]], 3]]}))
+    store.rpush("fsm:frontier:results:leg-1", json.dumps([[[[2]], 2]]))
+    ckpt = StoreCheckpoint(store, "leg-1", every_s=0.0)
+    state = ckpt.load()
+    assert state["results"] == [[[[1]], 3], [[[2]], 2]]
+    ckpt.save({**state, "results_done": 2, "results": [[[[3]], 1]]})
+    assert envelope.is_enveloped(store.get("fsm:frontier:leg-1"))
+    assert envelope.is_enveloped(
+        store.lrange("fsm:frontier:results:leg-1")[-1])
+    assert ckpt.load()["results"] == [[[[1]], 3], [[[2]], 2], [[[3]], 1]]
+
+
+# --------------------------------------------------- journal degradation
+
+
+def test_recover_orphans_quarantines_poison_journal_and_continues():
+    store = ResultStore()
+    # a poison intent: bitrot ate the envelope mid-record
+    store.set("fsm:journal:poison-1",
+              _flip(envelope.wrap(json.dumps({"incarnation": "dead"})), 80))
+    # a healthy already-terminal orphan AFTER it in scan order: recovery
+    # must reach it (one bad record never wedges the pass)
+    store.journal_set("zz-done", json.dumps({"incarnation": "dead"}))
+    store.add_status("zz-done", "finished")
+    master = Master(store=store)
+    try:
+        report = recover_orphans(master)
+    finally:
+        master.shutdown()
+    assert report["quarantined"] == ["poison-1"]
+    assert report["cleared"] == ["zz-done"]
+    assert store.peek("fsm:journal:poison-1") is None  # moved
+    qrec = envelope.unwrap(store.peek("fsm:quarantine:poison-1"))[0]
+    assert json.loads(qrec)["surface"] == "journal"
+
+
+def test_journal_get_returns_payload_and_raw_corruption():
+    store = ResultStore()
+    store.journal_set("u1", json.dumps({"replica": "a"}))
+    assert json.loads(store.journal_get("u1")) == {"replica": "a"}
+    store.set("fsm:journal:u1", _flip(store.get("fsm:journal:u1"), 75))
+    raw = store.journal_get("u1")  # corrupt: RAW bytes, callers degrade
+    with pytest.raises(ValueError):
+        json.loads(raw)
+    assert store.journal_get("nope") is None
+
+
+# ----------------------------------------------------- spine degradation
+
+
+def test_merged_timeline_skips_and_counts_corrupt_chunks():
+    store = ResultStore()
+    good = envelope.wrap(json.dumps(
+        {"replica": "r1", "boot": "b1", "token": 1, "ts": 2.0,
+         "spans": [{"span_id": 1, "site": "job", "ts": 2.0}]}))
+    store.spine_append("u-spine", good)
+    store.spine_append("u-spine", _flip(good, len(good) - 5))
+    store.spine_append("u-spine", "not json at all {{")
+    merged = obsplane.merged_timeline(store, "u-spine")
+    assert merged["corrupt_chunks"] == 2
+    assert merged["spine_chunks"] == 1
+    assert [s["span_id"] for s in merged["spans"]] == [1]
+    assert obsplane.last_activity_ts(store, "u-spine") == 2.0
+
+
+# ---------------------------------------------------------------- scrubber
+
+
+def _entry(payload_obj) -> str:
+    from spark_fsm_tpu.ops.rule_trie import rules_digest
+
+    payload = json.dumps(payload_obj)
+    return json.dumps({"algo": "SPADE_TPU", "kind": "patterns",
+                       "params": {}, "n_sequences": 5, "uid": "u-e",
+                       "digest": rules_digest(payload), "ts": 1.0,
+                       "payload": payload})
+
+
+def test_scrubber_quarantines_at_rest_and_repairs_sidecars():
+    store = ResultStore()
+    # corrupt journal intent at rest
+    store.set("fsm:journal:rot-j", _flip(envelope.wrap("{}"), 72))
+    # intact rescache entry whose sidecar a crash window never wrote
+    ekey = resultcache.entry_key("fp-ok", "SPADE_TPU")
+    store.set(ekey, envelope.wrap(_entry([[[[1]], 4]])))
+    # corrupt rescache entry (sidecar present and healthy-looking)
+    bkey = resultcache.entry_key("fp-bad", "SPADE_TPU")
+    wrapped = envelope.wrap(_entry([[[[2]], 4]]))
+    store.set(bkey, wrapped[: len(wrapped) - 10])
+    resultcache.write_sidecar(store, bkey, {"ts": 1.0}, 10)
+    scr = integrity.Scrubber(store, scrub_every_s=0.0, batch=256)
+    tally = scr.scrub()
+    assert tally["corrupt"] >= 2 and tally["quarantined"] >= 2
+    assert tally["repaired"] == 1
+    # journal: quarantine-MOVED
+    assert store.peek("fsm:journal:rot-j") is None
+    assert store.peek("fsm:quarantine:rot-j") is not None
+    # corrupt entry: moved, its sidecar dropped
+    assert store.peek(bkey) is None
+    assert store.peek(resultcache.sidecar_key_for(bkey)) is None
+    # intact entry: sidecar re-derived with the entry's own age
+    side = envelope.unwrap(
+        store.peek(resultcache.sidecar_key_for(ekey)))[0]
+    assert json.loads(side)["ts"] == 1.0
+    # idempotent: a second pass finds the same damage, re-counts nothing
+    q0 = integrity._QUARANTINED.total()
+    scr.scrub()
+    assert integrity._QUARANTINED.total() == q0
+
+
+def test_scrubber_is_batch_bounded_with_cross_pass_cursor():
+    """Ten rotten journal intents, batch 4: NO single pass exceeds its
+    budget, and the cross-pass cursor still reaches every key — the
+    scrub converges without ever becoming a scan storm."""
+    store = ResultStore()
+    for i in range(10):
+        store.set(f"fsm:journal:u{i:02d}", _flip(envelope.wrap("{}"), 72))
+    scr = integrity.Scrubber(store, scrub_every_s=0.0, batch=4)
+    for _ in range(12):
+        assert scr.scrub()["keys"] <= 4  # the batch bound, every pass
+        if not store.scan_keys("fsm:journal:", "0", 64)[1]:
+            break
+    assert store.scan_keys("fsm:journal:", "0", 64)[1] == []
+    assert len(list(store.scan_iter("fsm:quarantine:"))) == 10
+    assert scr.passes >= 3  # 10 keys / batch 4: never one big scan
+
+
+def test_report_lists_quarantine_and_counters():
+    store = ResultStore()
+    cfg = cfgmod.parse_config({"integrity": {"scrub_every_s": 7.5,
+                                             "scrub_batch": 32}})
+    integrity.configure(cfg.integrity)
+    try:
+        scr = integrity.install(store)
+        assert scr is not None
+        assert scr.scrub_every_s == 7.5 and scr.batch == 32
+        integrity.quarantine(store, "fsm:journal:qq", "damaged-bytes",
+                             "journal", move=True)
+        rep = integrity.report(store)
+        assert rep["enabled"] is True and rep["scrub_every_s"] == 7.5
+        rows = {r.get("key"): r for r in rep["quarantine"]}
+        assert rows["fsm:journal:qq"]["surface"] == "journal"
+        assert rows["fsm:journal:qq"]["quarantine_key"] == \
+            "fsm:quarantine:qq"
+        for name in ("scans", "verified", "legacy", "corrupt",
+                     "quarantined", "repaired"):
+            assert name in rep["counters"]
+    finally:
+        integrity.uninstall()
+        integrity.configure(cfgmod.Config().integrity)
+
+
+def test_disabled_plane_installs_nothing_but_still_verifies():
+    store = ResultStore()
+    cfg = cfgmod.parse_config({"integrity": {"enabled": False}})
+    integrity.configure(cfg.integrity)
+    try:
+        assert integrity.install(store) is None
+        integrity.tick()  # no scrubber: a no-op, never a crash
+        assert integrity.report(store)["enabled"] is False
+        # verify-on-read is NOT the flag's to disable
+        store.set("fsm:journal:u9", _flip(envelope.wrap("{}"), 72))
+        raw = store.journal_get("u9")
+        with pytest.raises(ValueError):
+            json.loads(raw)
+    finally:
+        integrity.uninstall()
+        integrity.configure(cfgmod.Config().integrity)
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_integrity_config_parse_and_validation():
+    cfg = cfgmod.parse_config({})
+    assert cfg.integrity.enabled is True
+    assert cfg.integrity.scrub_every_s == 60.0
+    assert cfg.integrity.scrub_batch == 256
+    with pytest.raises(ValueError):
+        cfgmod.parse_config({"integrity": {"scrub_every_s": -1}})
+    with pytest.raises(ValueError):
+        cfgmod.parse_config({"integrity": {"scrub_batch": 0}})

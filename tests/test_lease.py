@@ -477,7 +477,8 @@ def test_recovery_skips_live_sibling_jobs(monkeypatch):
         master_b = _B()
         master_b.store, master_b.miner = store, miner_b
         report = recover_orphans(master_b)
-        assert report == {"resumed": [], "failed": [], "cleared": []}
+        assert report == {"resumed": [], "failed": [], "cleared": [],
+                          "quarantined": []}
         assert store.status("held") == "started"  # untouched
         gate.release.set()
         assert _await_terminal(store, "held") == "finished"

@@ -24,7 +24,7 @@ from spark_fsm_tpu.service import obsplane
 from spark_fsm_tpu.service.lease import LeaseManager
 from spark_fsm_tpu.service.model import ServiceRequest
 from spark_fsm_tpu.service.store import ResultStore
-from spark_fsm_tpu.utils import obs
+from spark_fsm_tpu.utils import envelope, obs
 
 DRILL_TIMEOUT_S = 120.0
 
@@ -131,7 +131,8 @@ def test_spine_unleased_uid_writes_with_null_token():
     plane = obsplane.TraceSpine(store, mk("rep-a"))
     assert plane.flush("stream:topic", [
         {"span_id": 9, "site": "stream.push", "ts": 1.0}]) == "ok"
-    chunk = json.loads(store.spine_chunks("stream:topic")[0])
+    chunk = json.loads(envelope.unwrap(
+        store.spine_chunks("stream:topic")[0])[0])
     assert chunk["token"] is None and chunk["replica"] == "rep-a"
 
 
@@ -386,7 +387,7 @@ def test_miner_writes_lifecycle_spine_and_slo_end_to_end():
                      "lifecycle.started", "lifecycle.settled", "job"):
             assert want in sites, (want, sorted(sites))
         # every non-final chunk was written under the held lease's token
-        tokens = [json.loads(raw)["token"]
+        tokens = [json.loads(envelope.unwrap(raw)[0])["token"]
                   for raw in store.spine_chunks("solo-job")]
         assert tokens[0] is not None
         merged = obsplane.merged_timeline(

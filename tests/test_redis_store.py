@@ -505,9 +505,13 @@ def test_lease_walks_use_scan_not_keys(mini_redis):
         peer.publish_heartbeat()
         # overwrite with a loaded-looking record so the steal scan
         # actually walks scan-b's admission namespace
-        raw = json.loads(store.peek("fsm:replica:scan-b"))
+        from spark_fsm_tpu.utils import envelope
+
+        raw = json.loads(envelope.unwrap(
+            store.peek("fsm:replica:scan-b"))[0])
         raw.update({"queued": 1, "steal": True})
-        store.set_px("fsm:replica:scan-b", json.dumps(raw), 30000)
+        store.set_px("fsm:replica:scan-b",
+                     envelope.wrap(json.dumps(raw)), 30000)
 
     fake_peer_record()
     store.set("fsm:admission:scan-b:job1", "1")

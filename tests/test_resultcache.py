@@ -613,3 +613,44 @@ def test_peer_inflight_hint_sheds_with_steal_path_retry(
         gate.set()
         master_b.shutdown()
         master_a.shutdown()
+
+
+def test_crash_between_entry_and_sidecar_heals_on_next_boot(rescache_on):
+    """kill -9 between the cache-entry write and its LRU-sidecar write
+    (the entry is written FIRST by design): the next boot's scrubber
+    verifies the orphan entry and re-derives its sidecar from the
+    entry's own bytes — the entry then serves normally, zero duplicated
+    results (ISSUE 18 satellite)."""
+    from spark_fsm_tpu.service import integrity
+    from spark_fsm_tpu.utils import envelope
+
+    text = format_spmf(_db(seed=61))
+    store = ResultStore()
+    master = Master(store=store, miner_workers=1)
+    try:
+        _submit(master, "warm", text)
+        assert _wait(store, "warm") == "finished"
+    finally:
+        master.shutdown()
+    [ekey] = store.keys("fsm:rescache:")
+    skey = resultcache.sidecar_key_for(ekey)
+    assert store.peek(skey) is not None
+    store.delete(skey)  # the crash residue: entry landed, sidecar not
+    scr = integrity.Scrubber(store, scrub_every_s=0.0, batch=256)
+    tally = scr.scrub()
+    assert tally["repaired"] == 1 and tally["quarantined"] == 0
+    ent_payload = envelope.unwrap(store.peek(ekey))[0]
+    side = json.loads(envelope.unwrap(store.peek(skey))[0])
+    assert side["digest"] == json.loads(ent_payload)["digest"]
+    assert side["bytes"] == len(ent_payload)
+    # the healed entry SERVES the same request — and serves the SAME
+    # rules the warm mine produced, nothing duplicated or rebuilt
+    master = Master(store=store, miner_workers=1)
+    try:
+        _submit(master, "served", text)
+        assert _wait(store, "served") == "finished"
+        assert _stats(store, "served")["served_from_cache"] == "exact"
+        assert rules_text(deserialize_rules(store.rules("served"))) == \
+            rules_text(deserialize_rules(store.rules("warm")))
+    finally:
+        master.shutdown()

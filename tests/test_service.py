@@ -366,8 +366,13 @@ def test_sigterm_drains_service_cleanly():
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
     try:
-        # wait for the boot line, then exercise one request and stop
-        line = proc.stdout.readline()
+        # wait for the boot line (skipping earlier banner lines, e.g.
+        # the integrity scrubber's), then exercise one request and stop
+        line = ""
+        for _ in range(8):
+            line = proc.stdout.readline()
+            if "spark_fsm_tpu service on http://" in line:
+                break
         assert "spark_fsm_tpu service on http://" in line, line
         port = int(line.rsplit(":", 1)[1])
         # the remote server logs structured lines too — read until its
