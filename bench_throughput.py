@@ -65,8 +65,13 @@ EXPECT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # with this harness's offline client-observed p99 to within an order of
 # magnitude — the structural claim that the observable SLO layer
 # measures the same thing the bench does, not a wall comparison.
+# usage_conserved (ISSUE 19): the fused flood re-runs with [usage] on
+# and the per-tenant attribution counters must move by EXACTLY the
+# broker's own launch/traffic deltas — the conservation invariant over
+# fused waves, the forced cross-job window and degraded re-dispatches.
 COMPARED = ("jobs", "parity", "forced_cross_job", "modeled_2x",
-            "degraded", "sheds", "failures", "slo_consistent")
+            "degraded", "sheds", "failures", "slo_consistent",
+            "usage_conserved")
 
 # --mix tenants (ISSUE 13): the elastic-control-plane success metric —
 # a 2-replica fleet with weighted-fair admission, one flooding tenant
@@ -88,8 +93,13 @@ TENANTS_COMPARED = ("tenants_jobs", "tenants_parity",
 # requests in cached mode stay within a generous 3x envelope of the
 # baseline p99 — walls are noisy on shared boxes, the guard catches
 # order-of-magnitude admission-path regressions, not jitter).
+# zipf_usage_conserved (ISSUE 19): every launch deposited while [usage]
+# is on lands in exactly one finished job's settled usage block (served
+# and coalesced requests bill zero), and the cached phase credits
+# avoided device-seconds off the hot set.
 ZIPF_COMPARED = ("zipf_jobs", "zipf_parity", "zipf_hit_ratio_ok",
-                 "zipf_speedup_2x", "no_p99_regression_cold")
+                 "zipf_speedup_2x", "no_p99_regression_cold",
+                 "zipf_usage_conserved")
 
 # --mix engines (ISSUE 15): the SPAM-engine + planner success metric —
 # the same pattern-mine flood run per engine route (SPADE_TPU vs
@@ -321,6 +331,7 @@ def _zipf_flood(dbs, stream, workers, label):
         rows = {}
         cold_lats, all_lats = [], []
         served = coalesced = 0
+        usage_billed = 0  # launches attributed across THIS flood's jobs
         for uid in done:
             stats = _json.loads(store.get(f"fsm:stats:{uid}") or "{}")
             if stats.get("coalesced_into"):
@@ -336,6 +347,11 @@ def _zipf_flood(dbs, stream, workers, label):
             all_lats.append(lat)
             if how == "cold":
                 cold_lats.append(lat)
+                # only COLD jobs were billed: a served/coalesced row's
+                # stats blob carries the cached leader's usage block
+                # (its historical cost), not a fresh deposit
+                usage_billed += int(
+                    (stats.get("usage") or {}).get("launches", 0))
             rows[uid] = (keys[uid],
                          rules_text(deserialize_rules(store.rules(uid))),
                          how)
@@ -349,7 +365,7 @@ def _zipf_flood(dbs, stream, workers, label):
             "p99_cold_s": (None if not cold_lats
                            else round(q(cold_lats, 0.99), 4)),
             "served": served, "coalesced": coalesced,
-            "failures": failures,
+            "failures": failures, "usage_launches": usage_billed,
         }
         return rows, summary
     finally:
@@ -378,22 +394,47 @@ def main_zipf(update: bool, n_jobs: int, workers: int) -> int:
         vals = sorted(r[key] for r in runs)
         return vals[len(vals) // 2]
 
-    cold_runs, cold_rows = [], {}
-    for i in range(N_RUNS):
-        rows, s = _zipf_flood(dbs, stream, workers, f"cold-{i}")
-        cold_rows.update(rows)
-        cold_runs.append(s)
+    # both timed phases run with [usage] on (ISSUE 19): the reuse tier's
+    # conservation claim is that every deposited launch lands in exactly
+    # one finished job's settled usage block — served/coalesced requests
+    # bill ZERO launches and the cached phase credits avoided-cost
+    # priced from the cached entry's recorded usage instead
+    from spark_fsm_tpu.service import usage as UM
 
     old_cfg = cfgmod.get_config()
-    cfgmod.set_config(cfgmod.parse_config({"rescache": {"enabled": True}}))
+    cfgmod.set_config(cfgmod.parse_config({"usage": {"enabled": True}}))
+    u_launches0 = UM._LAUNCHES.total()
+    u_avoided0 = UM._AVOIDED.total()
     try:
+        cold_runs, cold_rows = [], {}
+        for i in range(N_RUNS):
+            rows, s = _zipf_flood(dbs, stream, workers, f"cold-{i}")
+            cold_rows.update(rows)
+            cold_runs.append(s)
+
+        cfgmod.set_config(cfgmod.parse_config(
+            {"usage": {"enabled": True},
+             "rescache": {"enabled": True}}))
         cached_runs, cached_rows = [], {}
         for i in range(N_RUNS):
             rows, s = _zipf_flood(dbs, stream, workers, f"cached-{i}")
             cached_rows.update(rows)
             cached_runs.append(s)
     finally:
+        UM.uninstall()
         cfgmod.set_config(old_cfg)
+    u_launches1 = UM._LAUNCHES.total()
+    u_avoided1 = UM._AVOIDED.total()
+
+    billed = sum(r["usage_launches"] for r in cold_runs + cached_runs)
+    zipf_usage = {
+        "billed_launches": billed,
+        "counter_launches": int(u_launches1 - u_launches0),
+        "avoided_device_seconds": round(u_avoided1 - u_avoided0, 6),
+    }
+    zipf_usage_conserved = (
+        billed == zipf_usage["counter_launches"]
+        and zipf_usage["avoided_device_seconds"] > 0)
 
     # per-request parity: every cached/coalesced/dominated/cold answer
     # must be byte-identical (canonical text) to the cold baseline's
@@ -428,7 +469,9 @@ def main_zipf(update: bool, n_jobs: int, workers: int) -> int:
         "zipf_hit_ratio_ok": hit_ratio >= 0.5,
         "zipf_speedup_2x": speedup >= 2.0,
         "no_p99_regression_cold": bool(no_regress),
+        "zipf_usage_conserved": bool(zipf_usage_conserved),
         "zipf": {
+            "usage": zipf_usage,
             "cold": {"jobs_per_sec": cold_jps,
                      "p99_s": p99_cold_base,
                      "runs": [r["jobs_per_sec"] for r in cold_runs]},
@@ -1441,11 +1484,22 @@ def main() -> int:
     warm = {"unfused_floods": warm_to_stable("unfused")}
     rows_u, unfused = timed("unfused")
 
+    # the fused phase doubles as the usage-attribution conservation
+    # drill (ISSUE 19): with [usage] on, every broker dispatch over the
+    # span — opportunistic fused waves, the forced cross-job window AND
+    # any degraded solo re-dispatches — must be split across exactly the
+    # jobs that rode it, so the per-tenant fsm_usage_* counters move by
+    # EXACTLY what the broker's own launch/traffic tallies move by
+    from spark_fsm_tpu.service import usage as UM
+
+    old_cfg = cfgmod.get_config()
+    cfgmod.set_config(cfgmod.parse_config({"usage": {"enabled": True}}))
     FZ.configure(cfgmod.FusionConfig(enabled=True))
     try:
         warm["fused_floods"] = warm_to_stable("fused")
         b0 = dict(FZ.broker().stats)  # modeled-ratio baseline: timed
         # fused work only, not the warm floods
+        u0 = (UM._LAUNCHES.total(), UM._TRAFFIC.total())
         rows_f, fused = timed("fused")
         # modeled-ratio snapshot BEFORE the forced window: its held
         # group fuses at the best possible ratio by construction and
@@ -1454,8 +1508,23 @@ def main() -> int:
         b_timed = dict(FZ.broker().stats)
         forced = _forced_window(dbs)
         broker = dict(FZ.broker().stats)
+        u1 = (UM._LAUNCHES.total(), UM._TRAFFIC.total())
     finally:
         FZ.configure(None)
+        UM.uninstall()
+        cfgmod.set_config(old_cfg)
+
+    usage_report = {
+        "billed_launches": u1[0] - u0[0],
+        "broker_launches": broker["launches"] - b0["launches"],
+        "billed_traffic_units": u1[1] - u0[1],
+        "broker_traffic_units": (broker["traffic_units"]
+                                 - b0["traffic_units"]),
+    }
+    usage_conserved = (
+        usage_report["billed_launches"] == usage_report["broker_launches"]
+        and usage_report["billed_traffic_units"]
+        == usage_report["broker_traffic_units"])
 
     # the broker's device-dispatch accounting, priced by the committed
     # cost model: what the timed fused work actually launched vs the
@@ -1522,6 +1591,8 @@ def main() -> int:
         "slo": {"window_s": slo["window_s"],
                 "bounds_s": [round(lo, 4), round(hi, 4)],
                 "e2e": slo_rows},
+        "usage_conserved": bool(usage_conserved),
+        "usage": usage_report,
         "broker": broker,
         "degraded": broker["degraded"],
         "sheds": unfused["sheds"] + fused["sheds"],

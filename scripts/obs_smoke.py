@@ -252,7 +252,16 @@ def main() -> int:
                     "fsm_integrity_legacy_total",
                     "fsm_integrity_corrupt_total",
                     "fsm_integrity_quarantined_total",
-                    "fsm_integrity_repaired_total"):
+                    "fsm_integrity_repaired_total",
+                    # ISSUE 19 families: resource attribution plane
+                    # (service/usage.py) — present (zero) even on a
+                    # boot with [usage] disabled
+                    "fsm_usage_device_seconds_total",
+                    "fsm_usage_launches_total",
+                    "fsm_usage_traffic_units_total",
+                    "fsm_usage_avoided_device_seconds_total",
+                    "fsm_usage_flushes_total",
+                    "fsm_costmodel_family_drift_ratio"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -314,7 +323,24 @@ def main() -> int:
                  {"checkpoint", "journal", "rescache", "spine",
                   "lease"}),
                 ("fsm_recovery_jobs_total", "outcome",
-                 {"cleared", "resumed", "failed", "quarantined"})):
+                 {"cleared", "resumed", "failed", "quarantined"}),
+                # ISSUE 19 vocabularies: the usage bill's tenant label
+                # is seeded with the default tenant from boot, and the
+                # per-family cost-model drift gauge seeds every
+                # dispatch family — "never dispatched" reads as 0
+                ("fsm_usage_device_seconds_total", "tenant",
+                 {"default"}),
+                ("fsm_usage_launches_total", "tenant", {"default"}),
+                ("fsm_usage_traffic_units_total", "tenant",
+                 {"default"}),
+                ("fsm_usage_avoided_device_seconds_total", "tenant",
+                 {"default"}),
+                ("fsm_usage_flushes_total", "tenant", {"default"}),
+                ("fsm_costmodel_family_drift_ratio", "family",
+                 {"tsr-eval", "tsr-fused", "tsr-resident", "spam",
+                  "predict"}),
+                ("fsm_predict_e2e_seconds_count", "tenant",
+                 {"default"})):
             got = {m.group(1) for k in families.get(fam, {})
                    for m in [re.search(rf'{label}="([^"]*)"', k)] if m}
             missing = want - got

@@ -154,6 +154,10 @@ def main():
             # pin the queue engine so the checkpointed drill takes the
             # segmented path (frontier saves at every segment boundary)
             "engine": {"fused": "queue"},
+            # resource attribution (ISSUE 19): the bill must survive
+            # the failover drill — flushed through the lease-heartbeat
+            # fenced write path on this very fleet
+            "usage": {"enabled": True, "flush_every_s": 0.0},
         }, fh)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -352,6 +356,43 @@ def main():
         assert resumed >= 1, "B's recovery counter never saw the adoption"
         log("bookkeeping ok: journals/leases/markers settled, "
             "fsm_lease_*/fsm_steal_* families live")
+
+        # ---- attribution survives the fleet (ISSUE 19): a TSR mine on
+        # the SURVIVOR is billed per launch, settled into its /status
+        # stats, and flushed to the durable fsm:usage:{tenant} ledger
+        # through the lease-fenced write path — billed exactly ONCE
+        code, _, body = post(port_b, "/train", uid="bill-tsr",
+                             algorithm="TSR_TPU", source="INLINE",
+                             sequences="1 -1 2 -2\n2 -1 1 -2\n1 2 -1\n",
+                             k="4", minconf="0.2", max_side="1")
+        assert code == 200 and body["status"] == "started", body
+        deadline = time.time() + DRILL_TIMEOUT_S
+        while time.time() < deadline:
+            _, _, body = post(port_b, "/status/bill-tsr")
+            if body["status"] in ("finished", "failure"):
+                break
+            time.sleep(0.1)
+        assert body["status"] == "finished", body
+        ustats = json.loads(body.get("data", {}).get("stats", "{}"))
+        uvec = ustats.get("usage") or {}
+        assert uvec.get("launches", 0) >= 1, \
+            f"bill-tsr /status stats carries no usage block: {ustats}"
+        code, _, bill = post(port_b, "/admin/usage")
+        assert code == 200 and bill.get("enabled"), bill
+        row = bill.get("tenants", {}).get("default") or {}
+        assert row.get("launches", 0) >= uvec["launches"], \
+            f"/admin/usage default-tenant rollup below the job: {row}"
+        raw = client.get("fsm:usage:default")
+        assert raw is not None, "no durable usage ledger record"
+        rec = json.loads(envelope.unwrap(raw)[0])
+        led = rec.get("jobs", {}).get("bill-tsr")
+        assert led is not None and \
+            led.get("launches") == uvec["launches"], \
+            (f"ledger bills bill-tsr {led} != settled vector {uvec} "
+             f"(double- or under-billed)")
+        log(f"attribution ok: bill-tsr billed {uvec['launches']} "
+            f"launches / {uvec.get('traffic_units')} traffic units "
+            f"once, durable ledger row matches the settled vector")
     finally:
         if proc_a.poll() is None:
             proc_a.kill()

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Usage-metering smoke: 2-tenant flood with a rescache hot set, then
+assert the per-tenant bill on /admin/usage and the conservation
+invariant off /metrics.
+
+The CI companion to obs_smoke/rescache_smoke for the resource
+attribution plane (ISSUE 19, service/usage.py): it boots the real HTTP
+service with [usage] + [fusion] + [fairness] + [rescache] on, then
+
+- floods two fairness tenants (``acme``, ``globex``) with TSR mines —
+  fusion on means every eval dispatch routes through the broker, whose
+  launch counter is the conservation ground truth;
+- re-submits acme's hot dataset after completion: an EXACT cache hit
+  that must credit acme with AVOIDED device-seconds priced from the
+  cached entry's recorded usage block;
+- asserts /admin/usage serves both tenant rows (estimated + measured
+  device-seconds, launches, traffic units, the durable ledger
+  sub-block) with acme's avoided-cost > 0, every finished job carries
+  a ``usage`` block in its /status stats, and the top-jobs table is
+  populated;
+- cross-checks CONSERVATION on /metrics: per-tenant
+  fsm_usage_launches_total sums EXACTLY to fsm_fusion_launches_total,
+  and per-tenant traffic units to the broker's tally — no work
+  invented, none lost;
+- asserts the per-family cost-model drift gauges and the fsm_usage_*
+  families are live (zero-seeded vocabularies, flushes recorded).
+
+Usage: scripts/usage_smoke.sh   (pins JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from spark_fsm_tpu import config as cfgmod
+    from spark_fsm_tpu.data.spmf import format_spmf
+    from spark_fsm_tpu.data.synth import synthetic_db
+    from spark_fsm_tpu.service.app import serve_background
+
+    cfgmod.set_config(cfgmod.parse_config({
+        "usage": {"enabled": True, "flush_every_s": 0.0},
+        "fusion": {"enabled": True, "window_ms": 30.0},
+        "fairness": {"enabled": True,
+                     "weights": {"acme": 2.0, "globex": 1.0}},
+        "rescache": {"enabled": True},
+    }))
+    srv = serve_background()
+    port = srv.server_port
+
+    def post(ep, **params):
+        data = urllib.parse.urlencode(params).encode()
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{ep}",
+                                    data=data, timeout=120) as r:
+            return r.read().decode()
+
+    def train(uid, text, tenant, **params):
+        d = {"algorithm": "TSR_TPU", "source": "INLINE",
+             "sequences": text, "k": "8", "minconf": "0.4",
+             "max_side": "2", "uid": uid, "tenant": tenant}
+        d.update(params)
+        resp = json.loads(post("/train", **d))
+        assert resp["status"] != "failure", resp
+        return resp
+
+    def wait(uid, timeout=240.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = json.loads(post(f"/status/{uid}"))
+            if st["status"] in ("finished", "failure"):
+                return st
+            time.sleep(0.05)
+        raise TimeoutError(f"job {uid} never finished")
+
+    def series(text, fam):
+        """{label-string: value} for one metric family."""
+        out = {}
+        for line in text.splitlines():
+            if line.startswith(fam + " "):
+                out[""] = float(line.rsplit(" ", 1)[1])
+            elif line.startswith(fam + "{"):
+                labels = line[len(fam) + 1:line.index("}")]
+                out[labels] = float(line.rsplit(" ", 1)[1])
+        return out
+
+    failures = []
+    try:
+        dbs = {uid: synthetic_db(seed=seed, n_sequences=70, n_items=9,
+                                 mean_itemsets=3.0, mean_itemset_size=1.2)
+               for uid, seed in (("acme-hot", 81), ("acme-b", 82),
+                                 ("glx-a", 83), ("glx-b", 84))}
+        plan = [("acme-hot", "acme"), ("acme-b", "acme"),
+                ("glx-a", "globex"), ("glx-b", "globex")]
+        for uid, tenant in plan:
+            train(uid, format_spmf(dbs[uid]), tenant)
+        stats_by_uid = {}
+        for uid, _ in plan:
+            st = wait(uid)
+            if st["status"] != "finished":
+                failures.append(f"{uid} did not finish: {st}")
+            stats_by_uid[uid] = json.loads(
+                st.get("data", {}).get("stats", "{}"))
+
+        # every finished job carries its attribution vector
+        for uid, stats in stats_by_uid.items():
+            u = stats.get("usage")
+            if not u or u.get("launches", 0) < 1:
+                failures.append(f"{uid} /status stats missing a usage "
+                                f"block with launches: {u}")
+
+        # the hot re-submit: exact rescache hit -> avoided-cost credit
+        train("acme-hit", format_spmf(dbs["acme-hot"]), "acme")
+        st = wait("acme-hit")
+        hs = json.loads(st.get("data", {}).get("stats", "{}"))
+        if hs.get("served_from_cache") != "exact":
+            failures.append(f"hot re-submit not an exact hit: {hs}")
+
+        admin = json.loads(post("/admin/usage"))
+        if not admin.get("enabled"):
+            failures.append(f"/admin/usage not enabled: {admin}")
+        tenants = admin.get("tenants", {})
+        for t in ("acme", "globex"):
+            row = tenants.get(t)
+            if not row:
+                failures.append(f"/admin/usage missing tenant {t}")
+                continue
+            for f in ("device_seconds_est", "device_seconds_measured",
+                      "launches", "traffic_units"):
+                if not row.get(f, 0) > 0:
+                    failures.append(f"tenant {t} {f} not > 0: {row}")
+            led = row.get("ledger")
+            if not led or not led.get("totals"):
+                failures.append(f"tenant {t} has no durable ledger row")
+            elif led["totals"].get("launches") != row.get("launches"):
+                failures.append(
+                    f"tenant {t} ledger launches "
+                    f"{led['totals'].get('launches')} != live rollup "
+                    f"{row.get('launches')}")
+        if not tenants.get("acme", {}).get("avoided_device_seconds", 0) > 0:
+            failures.append("acme has no avoided-cost credit after the "
+                            "exact hit")
+        if not admin.get("top_jobs"):
+            failures.append("/admin/usage top_jobs empty")
+        if admin.get("totals", {}).get("launches") != \
+                sum(r.get("launches", 0) for r in tenants.values()):
+            failures.append("/admin/usage totals do not sum the tenant "
+                            "rows")
+
+        # ---- conservation: per-tenant attribution == dispatch counters
+        mtext = post("/metrics")
+        usage_launches = series(mtext, "fsm_usage_launches_total")
+        fusion_launches = series(mtext, "fsm_fusion_launches_total")
+        got = sum(usage_launches.values())
+        want = sum(fusion_launches.values())
+        if got != want:
+            failures.append(f"CONSERVATION BROKEN: sum fsm_usage_"
+                            f"launches_total = {got} != fsm_fusion_"
+                            f"launches_total = {want}")
+        fstats = json.loads(post("/admin/stats"))["fusion"]
+        usage_traffic = sum(
+            series(mtext, "fsm_usage_traffic_units_total").values())
+        if usage_traffic != fstats.get("traffic_units"):
+            failures.append(f"CONSERVATION BROKEN: usage traffic "
+                            f"{usage_traffic} != broker traffic "
+                            f"{fstats.get('traffic_units')}")
+
+        # ---- metric families live, vocabularies zero-seeded
+        for fam in ("fsm_usage_device_seconds_total",
+                    "fsm_usage_launches_total",
+                    "fsm_usage_traffic_units_total",
+                    "fsm_usage_avoided_device_seconds_total",
+                    "fsm_usage_flushes_total"):
+            vals = series(mtext, fam)
+            if not vals:
+                failures.append(f"/metrics missing family {fam}")
+                continue
+            for t in ("default", "acme", "globex"):
+                if not any(f'tenant="{t}"' in k for k in vals):
+                    failures.append(f"{fam} missing tenant={t} series")
+        if sum(series(mtext, "fsm_usage_flushes_total").values()) < 1:
+            failures.append("no durable ledger flush recorded")
+        fam_drift = series(mtext, "fsm_costmodel_family_drift_ratio")
+        for f in ("tsr-eval", "tsr-fused", "tsr-resident", "spam",
+                  "predict"):
+            if not any(f'family="{f}"' in k for k in fam_drift):
+                failures.append(f"fsm_costmodel_family_drift_ratio "
+                                f"missing family={f}")
+        if not any(v > 0 for k, v in fam_drift.items()
+                   if 'family="tsr-eval"' in k
+                   or 'family="tsr-fused"' in k):
+            failures.append("no tsr dispatch family recorded a drift "
+                            "sample")
+
+        # zero stuck uids: every journal intent settled
+        leftover = srv.master.store.keys("fsm:journal:")
+        if leftover:
+            failures.append(f"journal intents leaked: {leftover}")
+    finally:
+        srv.master.shutdown()
+        srv.shutdown()
+    if failures:
+        print("usage_smoke: FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print("usage_smoke: 2-tenant flood billed per tenant, conservation "
+          "exact vs dispatch counters, avoided-cost credited on the hot "
+          "set, ledger + families live")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

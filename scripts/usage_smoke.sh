@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Usage-metering smoke — the ISSUE 19 companion to obs_smoke.sh and
+# rescache_smoke.sh.  Boots the service with [usage] + [fusion] +
+# [fairness] + [rescache] on, floods two tenants with TSR mines plus a
+# rescache hot set, then asserts the per-tenant bill on /admin/usage
+# (est + measured device-seconds, launches, durable ledger rows,
+# avoided-cost on the hot tenant) and the conservation invariant:
+# per-tenant fsm_usage_launches_total sums EXACTLY to the broker's
+# dispatch counters on /metrics.
+cd "$(dirname "$0")/.."
+exec timeout -k 30 600 env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/usage_smoke.py "$@"

@@ -53,6 +53,11 @@
 #                        entry, journal intent) — last-good resume +
 #                        oracle parity, cold re-mine, quarantine on
 #                        /admin/integrity, live fsm_integrity_*
+#   usage_smoke.sh       resource attribution plane: 2-tenant flood
+#                        with a rescache hot set — per-tenant bill on
+#                        /admin/usage, conservation invariant exact vs
+#                        the dispatch counters, avoided-cost credited,
+#                        durable ledger + fsm_usage_* families live
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -66,7 +71,7 @@ if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
              throughput_smoke resident_smoke partition_smoke \
              replica_smoke rescache_smoke autoscale_smoke \
              storm_smoke fleet_smoke spam_smoke fused_smoke \
-             predict_smoke bitrot_smoke; do
+             predict_smoke bitrot_smoke usage_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

@@ -635,6 +635,27 @@ class IntegrityConfig:
 
 
 @dataclasses.dataclass
+class UsageConfig:
+    """Resource attribution & usage metering plane (service/usage.py):
+    per-job/per-tenant device-cost ledger with conservation guarantees.
+
+    ``enabled = false`` (the default) removes the meter entirely —
+    every dispatch-surface deposit probe then costs one module-global
+    read, and dispatch behavior is byte-identical to a build without
+    the plane.  ``window_s`` is the per-tenant sliding rollup window
+    (the obs.SlidingQuantiles horizon behind ``/admin/usage`` window
+    stats).  ``flush_every_s`` is the minimum interval between durable
+    ledger flushes (riding the lease heartbeat in cluster mode, a
+    private timer on solo boots).  ``top_jobs`` bounds the top-N
+    settled-jobs table in ``/admin/usage``."""
+
+    enabled: bool = False
+    window_s: float = 300.0
+    flush_every_s: float = 15.0
+    top_jobs: int = 10
+
+
+@dataclasses.dataclass
 class Config:
     service: ServiceConfig = dataclasses.field(default_factory=ServiceConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
@@ -663,6 +684,8 @@ class Config:
         default_factory=PredictConfig)
     integrity: IntegrityConfig = dataclasses.field(
         default_factory=IntegrityConfig)
+    usage: UsageConfig = dataclasses.field(
+        default_factory=UsageConfig)
     profile_dir: str = ""  # root dir for jax.profiler traces ("" disables)
     fault_injection: bool = False  # gate for /admin/faults: arming fault
     # sites over HTTP is a chaos-lab capability, refused unless the boot
@@ -716,6 +739,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "planner": (PlannerConfig, top.pop("planner", {})),
         "predict": (PredictConfig, top.pop("predict", {})),
         "integrity": (IntegrityConfig, top.pop("integrity", {})),
+        "usage": (UsageConfig, top.pop("usage", {})),
     }
     profile_dir = str(top.pop("profile_dir", ""))
     fault_injection = bool(top.pop("fault_injection", False))
@@ -891,6 +915,13 @@ def parse_config(obj: Dict[str, Any]) -> Config:
             "integrity.scrub_every_s must be >= 0 (0 = manual passes)")
     if cfg.integrity.scrub_batch < 1:
         raise ConfigError("integrity.scrub_batch must be >= 1")
+    if cfg.usage.window_s <= 0:
+        raise ConfigError("usage.window_s must be > 0")
+    if cfg.usage.flush_every_s < 0:
+        raise ConfigError(
+            "usage.flush_every_s must be >= 0 (0 = flush every tick)")
+    if cfg.usage.top_jobs < 1:
+        raise ConfigError("usage.top_jobs must be >= 1")
     return cfg
 
 
@@ -966,6 +997,12 @@ def set_config(cfg: Config) -> None:
     from spark_fsm_tpu.service import integrity
 
     integrity.configure(cfg.integrity)
+    # the usage metering plane's meter knobs are process-global like
+    # the integrity scrubber's (dispatch surfaces deposit into module
+    # state; the Miner installs the meter over its store)
+    from spark_fsm_tpu.service import usage
+
+    usage.configure(cfg.usage)
 
 
 def engine_kwargs(*names: str) -> Dict[str, Any]:

@@ -60,9 +60,11 @@ from spark_fsm_tpu.models._common import (
     FrontierNode, SlotPool, auto_pool_bytes, decode_frontier, device_axes,
     encode_frontier, load_checkpoint, next_pow2, scatter_build_store)
 from spark_fsm_tpu.ops import bitops_np as BN
+from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.ops import spam_bitops as SB
 from spark_fsm_tpu.parallel import multihost as MH
-from spark_fsm_tpu.utils import jobctl, shapes
+from spark_fsm_tpu.service import usage
+from spark_fsm_tpu.utils import jobctl, obs, shapes
 from spark_fsm_tpu.utils.canonical import Pattern, PatternResult, sort_patterns
 
 Step = Tuple[int, bool]
@@ -441,7 +443,25 @@ class SpamBitmapTPU:
                 dev.copy_to_host_async()
             except (AttributeError, NotImplementedError):
                 pass
-        return batch, prep, sup_dev, mask_dev, pair_devs, pair_pos
+        # dispatch-cost stamp for attribution at resolve time
+        # (service/usage.py): launches + lane-traffic this inflight
+        # entry bought, the cost model's estimate for them, and the
+        # dispatch instant the resolve measures its wall from
+        launches = (1 if sup_dev is not None else 0) + len(pair_devs)
+        lanes = (2 * self.node_batch * self.nd_pad
+                 if sup_dev is not None else 0)
+        lanes += sum(self._pair_width(d) for d in pair_devs)
+        est_s = RB.estimate_seconds(lanes, max(1, launches), self.n_seq,
+                                    self.n_words) if launches else 0.0
+        return (batch, prep, sup_dev, mask_dev, pair_devs, pair_pos,
+                (launches, lanes, est_s, time.monotonic()))
+
+    @staticmethod
+    def _pair_width(dev) -> int:
+        try:
+            return int(dev.shape[-1])
+        except Exception:
+            return 0
 
     def _allow_s(self, node: _Node) -> bool:
         if self.max_pattern_itemsets is None:
@@ -451,10 +471,29 @@ class SpamBitmapTPU:
 
     def _resolve(self, inflight, stack: List[_Node],
                  results: List[PatternResult]) -> None:
-        batch, prep, sup_dev, mask_dev, pair_devs, pair_pos = inflight
+        (batch, prep, sup_dev, mask_dev, pair_devs, pair_pos,
+         cost) = inflight
         sups = (np.asarray(sup_dev)  # [2*nb, nd_pad] dense-column lanes
                 if sup_dev is not None else None)
         pair_sups = [np.asarray(d) for d in pair_devs]
+        launches, lanes, est_s, t0 = cost
+        if launches:
+            measured_s = time.monotonic() - t0
+            # spam residuals feed the spam FAMILY gauge only — the
+            # global recalibration EWMA stays fed by its two
+            # pre-existing surfaces (bench_smoke pins it byte-identical)
+            obs.observe_costmodel_family("spam", est_s, measured_s)
+            if usage.get() is not None:
+                ctl = jobctl.current()
+                if ctl is not None:
+                    nbytes = (int(sups.nbytes) if sups is not None
+                              else 0) + sum(int(a.nbytes)
+                                            for a in pair_sups)
+                    usage.deposit(ctl.uid, launches=launches,
+                                  traffic_units=lanes,
+                                  seconds_est=est_s,
+                                  seconds_measured=measured_s,
+                                  readback_bytes=nbytes)
         if mask_dev is not None:
             # survivor-mask accounting: the fused prune's packed alive
             # bits over the LIVE node rows (pad rows carry slot-0

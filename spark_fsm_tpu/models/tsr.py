@@ -64,6 +64,7 @@ from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel import partition as PN
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
 from spark_fsm_tpu.service import fusion as FZ
+from spark_fsm_tpu.service import usage
 from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
 
@@ -993,6 +994,16 @@ class TsrTPU:
             shapes.record(shapes.key_tsr_eval(
                 self.n_seq, self.n_words, L.km, L.width))
 
+    @staticmethod
+    def _bill_readback(nbytes: int) -> None:
+        """Attribute a device->host readback's bytes to the current
+        job (service/usage.py); one module-global read when the plane
+        is off."""
+        if usage.get() is not None:
+            ctl = jobctl.current()
+            if ctl is not None:
+                usage.deposit(ctl.uid, readback_bytes=int(nbytes))
+
     def _resolve_eval(self, handle, n: int):
         if isinstance(handle, FZ.EvalWave):
             # fusion-broker ticket: the broker planned, launched, traced
@@ -1036,10 +1047,22 @@ class TsrTPU:
             # runbook; with a deep pipeline the wait includes earlier
             # in-flight dispatches, so the ratio is conservative (an
             # overestimate), which is the safe direction for a deadline.
+            measured_s = 0.0
             if len(handle) > 7:
                 measured_s = time.monotonic() - handle[7]
                 sp.set(measured_s=round(measured_s, 6))
-                obs.observe_costmodel(est_s, measured_s)
+                obs.observe_costmodel(est_s, measured_s,
+                                      family="tsr-eval")
+        if usage.get() is not None:
+            ctl = jobctl.current()
+            if ctl is not None:
+                usage.deposit(
+                    ctl.uid,
+                    launches=int(handle[3] if len(handle) > 3 else 0),
+                    traffic_units=int((handle[4] or {}).get(
+                        "traffic_units", 0) if len(handle) > 4 else 0),
+                    seconds_est=est_s, seconds_measured=measured_s,
+                    readback_bytes=int(arr.nbytes))
         # the blocking readback proves the compute consumed its staged
         # inputs: recycle the dispatch's xy buffers (a FAULTED handle
         # never reaches this line, so its buffers are never reused while
@@ -1269,6 +1292,7 @@ class TsrTPU:
             bound_s = RB.estimate_seconds(
                 budget * nbw * caps.km, 1, self.n_seq, self.n_words)
             deadline = watchdog.deadline_s(bound_s)
+            t_seg = time.monotonic()
             try:
                 with obs.span("tsr.resident", point="segment", nb=nbw,
                               budget=budget, narrow=narrow,
@@ -1320,6 +1344,24 @@ class TsrTPU:
             tr_done += seg_traffic
             self.stats["traffic_units"] = (
                 self.stats.get("traffic_units", 0) + seg_traffic)
+            # whole-segment attribution: a resident segment has exactly
+            # one owning job (the device-carry loop never fuses), and
+            # its residual feeds the tsr-resident family gauge ONLY —
+            # the global recalibration EWMA must stay fed by the two
+            # pre-existing surfaces (bench_smoke pins it byte-identical)
+            seg_wall = time.monotonic() - t_seg
+            seg_est = RB.estimate_seconds(seg_traffic, 1, self.n_seq,
+                                          self.n_words)
+            obs.observe_costmodel_family("tsr-resident", seg_est,
+                                         seg_wall)
+            if usage.get() is not None:
+                ctl = jobctl.current()
+                if ctl is not None:
+                    usage.deposit(ctl.uid, launches=1,
+                                  traffic_units=seg_traffic,
+                                  seconds_est=seg_est,
+                                  seconds_measured=seg_wall,
+                                  readback_bytes=int(counters.nbytes))
             self.stats["evaluated"] += evaluated - ev_done
             self.stats["pruned_conf"] += pruned - pr_done
             waves_done, ev_done, pr_done = waves, evaluated, pruned
@@ -1382,6 +1424,7 @@ class TsrTPU:
         RF.count_readback(nbytes)
         self.stats["resident_readback_bytes"] = (
             self.stats.get("resident_readback_bytes", 0) + nbytes)
+        self._bill_readback(nbytes)
         results = RF.unpack_results(*arrs[:3], n_rec, minsup)
         if n_def:
             # over-ladder children the device deferred: filter against
@@ -1455,6 +1498,7 @@ class TsrTPU:
         RF.count_readback(nbytes)
         self.stats["resident_readback_bytes"] = (
             self.stats.get("resident_readback_bytes", 0) + nbytes)
+        self._bill_readback(nbytes)
         entries = RF.unpack_entries(*arrs[:6], head, tail, minsup)
         if n_def:
             entries += RF.unpack_entries(*darrs, 0, n_def, minsup)

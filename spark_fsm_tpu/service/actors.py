@@ -26,7 +26,7 @@ from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.service import (autoscale, fairness, integrity, lease,
                                    model, obsplane, planner, plugins,
                                    predictor, resultcache, sources,
-                                   storeguard)
+                                   storeguard, usage)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
 from spark_fsm_tpu.utils import envelope, faults, jobctl, obs
@@ -92,6 +92,10 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
             # the adopter finishes the job elsewhere — coalesced
             # followers waiting HERE re-dispatch as cold mines
             rescache.on_leader_terminal(uid)
+        # fenced: the adopter owns the uid's attribution from its
+        # checkpoint-adopted snapshot — dropping (not settling) our
+        # stale accumulator is what keeps the ledger single-billed
+        usage.drop(uid)
         return
     try:
         if guard is None:
@@ -124,6 +128,10 @@ def _record_failure(store: ResultStore, uid: str, exc: Exception,
         # intent survives, so recovery settles the uid after the store
         # returns — log loudly instead of killing the worker thread
         log_event("job_failure_record_failed", uid=uid, error=str(wexc))
+    # failed or not, the device work already happened — settle it into
+    # the tenant rollup so the ledger conserves against the dispatch
+    # counters (a failure is not a refund)
+    usage.settle(uid)
     # the job-control entry is released regardless (stream uids have
     # neither journal nor entry — no-ops)
     jobctl.release(uid)
@@ -282,6 +290,16 @@ class StoreCheckpoint:
         # (their meta overwrites the one that carried it)
         self._inline = inline
         state["results"] = results
+        return self._adopt_usage(state)
+
+    def _adopt_usage(self, state: Optional[dict]) -> Optional[dict]:
+        """Strip the checkpoint's usage snapshot (the engine's resume
+        contract knows nothing of it) and hand it to the meter —
+        REPLACING any live accumulator for the uid."""
+        if state is not None:
+            snap = state.pop("usage", None)
+            if snap:
+                usage.resume(self.uid, snap)
         return state
 
     def _heal_corrupt_delta(self, bad_chunk, inline, results, used,
@@ -313,7 +331,7 @@ class StoreCheckpoint:
         state = dict(emb)
         state.pop("results_total", None)
         state["results"] = results[:n]
-        return state
+        return self._adopt_usage(state)
 
     def save(self, state: dict) -> None:
         with obs.span("checkpoint.save", trace_id=self.uid):
@@ -341,6 +359,13 @@ class StoreCheckpoint:
         state = dict(state)
         delta = state.pop("results")
         done = state.pop("results_done")
+        # usage-attribution snapshot (service/usage.py): rides the meta
+        # AND every delta chunk's embedded state, so an adopter resumes
+        # the job's device-cost accumulator from wherever load() lands —
+        # resume REPLACES, so re-mined work never double-bills
+        snap = usage.checkpoint_snapshot(self.uid)
+        if snap is not None:
+            state["usage"] = snap
         if outage:
             self._save_spooled(g, state, delta, done)
             return
@@ -807,6 +832,14 @@ class Miner:
         # enabled = false — verify-on-READ stays unconditional either
         # way (it is a correctness property, not a feature flag).
         self._integrity = integrity.install(self.store)
+        # usage metering plane (ISSUE 19, service/usage.py): the
+        # per-job/per-tenant device-cost meter over this store (last
+        # Miner wins).  Cluster mode flushes the durable ledger off the
+        # lease heartbeat (usage.tick inside LeaseManager.tick); solo
+        # installs start the meter's private flush timer.  None when
+        # [usage] enabled = false — every dispatch-surface deposit
+        # probe is then one module-global read.
+        self._usage = usage.install(self.store, self._lease)
 
     # ------------------------------------------------------------ admission
 
@@ -1690,6 +1723,13 @@ class Miner:
         stats["results_per_s"] = round(len(results) / mine_s, 2) if mine_s else 0.0
         if trace_dir:
             stats["profile_trace"] = trace_dir
+        # settle the job's device-cost accumulator BEFORE the stats
+        # write: the usage block rides fsm:stats:{uid} AND (via
+        # rescache.on_finished below) the cache entry, which is what
+        # prices a future serve's avoided-cost credit
+        u = usage.settle(req.uid)
+        if u:
+            stats["usage"] = u
         with obs.span("job.sink", results=len(results)):
             outage = g is not None and g.is_down()
             if self._lease is not None and not outage:

@@ -68,6 +68,13 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         surface, background scrubber stats, and the
                         current quarantine listing (fsm:quarantine:*)
                         — the bitrot runbook's one-stop read;
+  /admin/usage        — resource attribution plane (service/usage.py):
+                        per-tenant device-cost rollups (estimated +
+                        measured device-seconds, launches, traffic
+                        units, readback bytes), avoided-cost credits
+                        from result-cache serves, top-N jobs by cost,
+                        and the durable fsm:usage:{tenant} ledger rows;
+                        {"enabled": false} when [usage] is off;
   /admin/drain        — drive the scale-down drain protocol NOW (stop
                         admitting → peers steal the queue → leases
                         released); ``exit=1`` also stops the server
@@ -101,7 +108,7 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qsl, urlsplit
 
 from spark_fsm_tpu import config as cfgmod
-from spark_fsm_tpu.service import plugins
+from spark_fsm_tpu.service import plugins, usage
 from spark_fsm_tpu.utils import obs
 from spark_fsm_tpu.service.actors import Master
 from spark_fsm_tpu.service.model import ServiceRequest
@@ -369,6 +376,17 @@ class FsmHandler(BaseHTTPRequestHandler):
 
                 self._send(200, json.dumps(
                     integrity.report(self.master.store)))
+            elif task == "usage":
+                # resource attribution / usage metering plane
+                # (service/usage.py): per-tenant device-cost rollups
+                # (est + measured seconds, launches, traffic units,
+                # readback bytes), avoided-cost credits, top-N jobs,
+                # durable-ledger rows — flushes pending settlements
+                # first so the response is read-your-writes
+                from spark_fsm_tpu.service import usage
+
+                self._send(200, json.dumps(
+                    usage.report(self.master.store)))
             elif task == "predictor":
                 # prediction serving plane (service/predictor.py):
                 # request/wave counters, resident artifact inventory
@@ -511,6 +529,11 @@ def service_stats(master: Master) -> dict:
         # fsm_storeguard_*); None when [storeguard] is off
         "storeguard": (None if master.miner._guard is None
                        else master.miner._guard.stats()),
+        # resource attribution / usage metering plane (service/
+        # usage.py): live jobs, deposits/settles, flush counters
+        # (canonical series: fsm_usage_*); None when [usage] is off —
+        # the per-tenant rollup tables live on /admin/usage
+        "usage": (usage.stats() if usage.get() is not None else None),
         # warm-path observability: distinct compiled geometries seen,
         # plus the last prewarm's per-key compile walls (if any ran)
         "shape_keys_recorded": len(shapereg.recorded()),

@@ -63,6 +63,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from spark_fsm_tpu.ops import ragged_batch as RB
+from spark_fsm_tpu.service import usage
 from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.obs import log_event
 
@@ -569,11 +570,12 @@ class FusionBroker:
         # dispatcher thread has no current span for it to bind to
         with obs.span("fusion.plan", trace_id=w0.uid, jobs=len(waves)):
             RB.record_plan(plan)
-        arr, cols, est_s = self._execute(
+        arr, cols, est_s, measured_s = self._execute(
             plan, fcands, p1f, s1f, w0, trace_uid=w0.uid,
             fused=True, m_pad=m_pad)
         self._bump(fused_groups=1,
                    traffic_units=sum(L.traffic_units for L in plan))
+        self._attribute_fused(waves, plan, est_s, measured_s)
         cross = sum(1 for L in plan if L.cross_job)
         report_base = {
             "fused_jobs": len(waves), "launches": len(plan),
@@ -595,6 +597,55 @@ class FusionBroker:
                               leader=w0.uid, jobs=len(waves),
                               launches=len(plan)):
                     pass
+
+    @staticmethod
+    def _attribute_fused(waves, plan, est_s: float,
+                         measured_s: float) -> None:
+        """Demux a fused plan's device cost back to the jobs that
+        occupied it, by LANE SHARE (the per-lane ``Launch.jobs`` tags
+        the planner packed with), under the conservation invariant:
+        per-job launches sum to ``len(plan)`` and per-job traffic units
+        sum to the plan's total, EXACTLY (largest-remainder integer
+        apportionment; pad lanes are charged proportionally).  Seconds
+        split proportional to each job's traffic share — floats carry
+        no exactness guarantee and none is claimed."""
+        if usage.get() is None:
+            return
+        # rebuild the jid -> uid map: _fused_plan assigns jids by FIRST
+        # APPEARANCE of each uid in wave order (uid_ix.setdefault)
+        uid_of: Dict[int, str] = {}
+        order: Dict[str, int] = {}
+        for w in waves:
+            jid = order.setdefault(w.uid, len(order))
+            uid_of.setdefault(jid, w.uid)
+        per: Dict[str, List[int]] = {}  # uid -> [launches, traffic]
+        total_traffic = 0
+        for L in plan:
+            total_traffic += L.traffic_units
+            if not L.jobs:
+                tally = per.setdefault(waves[0].uid, [0, 0])
+                tally[0] += 1
+                tally[1] += L.traffic_units
+                continue
+            counts: Dict[int, int] = {}
+            for j in L.jobs:
+                counts[j] = counts.get(j, 0) + 1
+            jids = sorted(counts)
+            weights = [counts[j] for j in jids]
+            one = usage.split_integral(1, weights)
+            traffic = usage.split_integral(L.traffic_units, weights)
+            for i, jid in enumerate(jids):
+                tally = per.setdefault(uid_of.get(jid, waves[0].uid),
+                                       [0, 0])
+                tally[0] += one[i]
+                tally[1] += traffic[i]
+        for uid, (n_launch, n_traffic) in per.items():
+            share = (n_traffic / total_traffic if total_traffic > 0
+                     else 1.0 / max(1, len(per)))
+            usage.deposit(uid, launches=n_launch,
+                          traffic_units=n_traffic,
+                          seconds_est=est_s * share,
+                          seconds_measured=measured_s * share)
 
     def _fused_preps(self, uniq, m_pad: int, total_m: int):
         """LRU-cached :func:`_fuse_preps`: a group of pipelining jobs
@@ -649,8 +700,12 @@ class FusionBroker:
         units = sum(L.traffic_units for L in plan)
         self._bump(traffic_units=units, alt_solo_launches=len(plan),
                    alt_solo_units=units)
-        arr, cols, est_s = self._execute(
+        arr, cols, est_s, measured_s = self._execute(
             plan, w.cands, w.p1, w.s1, w, trace_uid=w.uid, fused=False)
+        # whole-plan attribution: a solo dispatch (window of one, or a
+        # degraded re-dispatch) has exactly one owning job
+        usage.deposit(w.uid, launches=len(plan), traffic_units=units,
+                      seconds_est=est_s, seconds_measured=measured_s)
         w.resolve(arr[0, cols].astype(np.int64),
                   arr[1, cols].astype(np.int64),
                   {"fused_jobs": 1, "launches": len(plan),
@@ -722,9 +777,12 @@ class FusionBroker:
                 site="fusion.readback")
             measured_s = time.monotonic() - t0
             sp.set(measured_s=round(measured_s, 6))
-            obs.observe_costmodel(est_s, measured_s)
+            obs.observe_costmodel(
+                est_s, measured_s,
+                family=("tsr-fused" if fused and m_pad is not None
+                        else "tsr-eval"))
         self._stager().release(bufs)
-        return arr, cols, est_s
+        return arr, cols, est_s, measured_s
 
 
 def _fuse_preps(uniq, m_pad: int, total_m: int):

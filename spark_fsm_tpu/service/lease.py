@@ -1002,6 +1002,16 @@ class LeaseManager:
             integrity.tick()
         except Exception as exc:
             log_event("integrity_scrub_failed", error=str(exc))
+        # usage-ledger flush (ISSUE 19) rides the same cadence: settled
+        # job vectors and avoided-cost credits land in the durable
+        # fsm:usage:{tenant} records through the fenced write path —
+        # min-interval gating lives inside the meter, one global read
+        # per tick when idle or disabled
+        try:
+            from spark_fsm_tpu.service import usage
+            usage.tick()
+        except Exception as exc:
+            log_event("usage_flush_failed", error=str(exc))
 
     def quiesce(self) -> None:
         """Stop pulling NEW work (steal scans, periodic adoption) while

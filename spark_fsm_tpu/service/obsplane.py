@@ -128,15 +128,18 @@ _PREDICT_EXEC_S = obs.REGISTRY.histogram(
     "priority", buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                          0.05, 0.1, 0.25, 1.0, 5.0))
 for _p in PRIORITIES:
-    _PREDICT_E2E_S.seed(priority=_p)
-    _PREDICT_WINDOW_S.seed(priority=_p)
-    _PREDICT_EXEC_S.seed(priority=_p)
+    _PREDICT_E2E_S.seed(priority=_p, tenant=DEFAULT_TENANT)
+    _PREDICT_WINDOW_S.seed(priority=_p, tenant=DEFAULT_TENANT)
+    _PREDICT_EXEC_S.seed(priority=_p, tenant=DEFAULT_TENANT)
 
 
 def seed_tenant(tenant: str) -> None:
-    """Zero-seed the fsm_job_*_seconds series for a (fairness-
-    registered, bounded) tenant across every priority class — the
-    obs_smoke no-orphan check covers the result."""
+    """Zero-seed the fsm_job_*_seconds, fsm_predict_*_seconds and
+    fsm_usage_*_total series for a (fairness-registered, bounded)
+    tenant across every priority class — the obs_smoke no-orphan check
+    covers the result."""
+    from spark_fsm_tpu.service import usage as _usage
+
     with _tenant_lock:
         if tenant in _tenants:
             return
@@ -145,6 +148,10 @@ def seed_tenant(tenant: str) -> None:
         _E2E_S.seed(priority=p, tenant=tenant)
         _QUEUE_WAIT_S.seed(priority=p, tenant=tenant)
         _EXEC_S.seed(priority=p, tenant=tenant)
+        _PREDICT_E2E_S.seed(priority=p, tenant=tenant)
+        _PREDICT_WINDOW_S.seed(priority=p, tenant=tenant)
+        _PREDICT_EXEC_S.seed(priority=p, tenant=tenant)
+    _usage.seed_tenant(tenant)
 
 
 def known_tenants() -> List[str]:
@@ -169,6 +176,9 @@ _slo_predict = {
     "window_wait": obs.SlidingQuantiles(),
     "exec": obs.SlidingQuantiles(),
 }
+# per-tenant read-path e2e window (ISSUE 19 satellite) — the tenant
+# twin of _slo_tenant_e2e for the /admin/slo predict block
+_slo_predict_tenant = obs.SlidingQuantiles()
 
 _lock = threading.Lock()
 _plane: Optional["TraceSpine"] = None
@@ -332,6 +342,7 @@ def configure(ocfg) -> None:
     _slo_tenant_e2e.set_window(float(ocfg.slo_window_s))
     for sq in _slo_predict.values():
         sq.set_window(float(ocfg.slo_window_s))
+    _slo_predict_tenant.set_window(float(ocfg.slo_window_s))
 
 
 # ---------------------------------------------------------------- timeline
@@ -488,19 +499,27 @@ def observe_job(priority: str, e2e_s: float, queue_wait_s: float,
 
 
 def observe_predict(priority: str, e2e_s: float, window_wait_s: float,
-                    exec_s: float) -> None:
+                    exec_s: float,
+                    tenant: str = DEFAULT_TENANT) -> None:
     """One served /predict's latency decomposition (request in ->
     predictions out = window wait + wave execution) into the read-path
     histogram families and sliding SLO windows — the second signal
-    class next to observe_job's mining-path one."""
+    class next to observe_job's mining-path one.  An unregistered
+    tenant folds into "default", same bounded-vocabulary rule as
+    observe_job."""
     if priority not in PRIORITIES:
         priority = "normal"
-    _PREDICT_E2E_S.observe(e2e_s, priority=priority)
-    _PREDICT_WINDOW_S.observe(window_wait_s, priority=priority)
-    _PREDICT_EXEC_S.observe(exec_s, priority=priority)
+    with _tenant_lock:
+        if tenant not in _tenants:
+            tenant = DEFAULT_TENANT
+    _PREDICT_E2E_S.observe(e2e_s, priority=priority, tenant=tenant)
+    _PREDICT_WINDOW_S.observe(window_wait_s, priority=priority,
+                              tenant=tenant)
+    _PREDICT_EXEC_S.observe(exec_s, priority=priority, tenant=tenant)
     _slo_predict["e2e"].observe(e2e_s, priority=priority)
     _slo_predict["window_wait"].observe(window_wait_s, priority=priority)
     _slo_predict["exec"].observe(exec_s, priority=priority)
+    _slo_predict_tenant.observe(e2e_s, tenant=tenant)
 
 
 def slo_snapshot() -> dict:
@@ -524,6 +543,10 @@ def slo_snapshot() -> dict:
         p: {kind: sq.stats(priority=p)
             for kind, sq in _slo_predict.items()}
         for p in PRIORITIES}
+    # per-tenant read-path e2e quantiles (ISSUE 19 satellite): every
+    # registered tenant gets a row — {"count": 0} until it predicts
+    out["predict_tenants"] = {t: _slo_predict_tenant.stats(tenant=t)
+                              for t in known_tenants()}
     return out
 
 
@@ -552,6 +575,7 @@ def clear_slo() -> None:
     _slo_tenant_e2e.clear()
     for sq in _slo_predict.values():
         sq.clear()
+    _slo_predict_tenant.clear()
 
 
 # ------------------------------------------------------ cluster collector

@@ -46,7 +46,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from spark_fsm_tpu.ops import rule_trie
-from spark_fsm_tpu.service import model, obsplane
+from spark_fsm_tpu.service import model, obsplane, usage
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.utils import obs
 from spark_fsm_tpu.utils.obs import log_event
@@ -257,12 +257,15 @@ def _cache() -> ArtifactCache:
 
 class _Ticket:
     __slots__ = ("prefix", "priority", "event", "entries", "error",
-                 "submit_t", "dispatch_t", "exec_s", "wave_jobs", "tag")
+                 "submit_t", "dispatch_t", "exec_s", "wave_jobs", "tag",
+                 "tenant")
 
-    def __init__(self, prefix: List[int], priority: str, tag: str) -> None:
+    def __init__(self, prefix: List[int], priority: str, tag: str,
+                 tenant: str = "default") -> None:
         self.prefix = prefix
         self.priority = priority
         self.tag = tag
+        self.tenant = tenant
         self.event = threading.Event()
         self.entries: Optional[List[dict]] = None
         self.error: Optional[BaseException] = None
@@ -342,7 +345,8 @@ class PredictBroker:
     # -- submission ---------------------------------------------------------
 
     def submit(self, trie: rule_trie.RuleTrie, prefix: List[int], m: int,
-               priority: str, tag: str) -> _Ticket:
+               priority: str, tag: str,
+               tenant: str = "default") -> _Ticket:
         """Score one observed prefix; blocks until its wave lands.
 
         Returns the completed ticket — ``entries`` plus the window-wait
@@ -350,7 +354,7 @@ class PredictBroker:
         """
         window_s = max(0.0, float(_cfg_get("window_ms"))) / 1000.0
         max_wave = max(1, int(_cfg_get("max_wave")))
-        t = _Ticket(prefix, priority, tag)
+        t = _Ticket(prefix, priority, tag, tenant)
         if (not _cfg_get("enabled")) or window_s <= 0.0 or max_wave <= 1:
             g = _Group(None, trie, m, 0.0)
             g.tickets.append(t)
@@ -403,6 +407,20 @@ class PredictBroker:
             log_event("predict_wave", jobs=n, mode=mode,
                       wave_ms=round(exec_s * 1000.0, 3),
                       tags=[t.tag for t in g.tickets])
+            # per-rider attribution (service/usage.py): the wave is ONE
+            # launch streaming the artifact's lanes once — launches and
+            # lanes split across riders by largest-remainder (sums are
+            # exact), wall split equally.  Riders have no JobControl,
+            # so the cost folds straight into each rider's tenant.
+            if usage.get() is not None:
+                one = usage.split_integral(1, [1.0] * n)
+                lanes = usage.split_integral(
+                    int(getattr(g.trie, "lanes", 0) or 0), [1.0] * n)
+                for i, t in enumerate(g.tickets):
+                    usage.deposit_tenant(
+                        t.tenant, launches=one[i],
+                        traffic_units=lanes[i],
+                        seconds_measured=exec_s / n)
             for i, t in enumerate(g.tickets):
                 t.entries = waves[i]
                 t.dispatch_t = t0
@@ -498,6 +516,14 @@ class Predictor:
                 req, Status.FAILURE,
                 error=f"unknown priority {priority!r} "
                       f"(have: {', '.join(obsplane.PRIORITIES)})")
+        # tenant threading (ISSUE 19): validated against the fairness
+        # bounded vocabulary the same way obsplane.observe_job folds —
+        # an unknown tenant reads as "default", never a failure (the
+        # label space must stay bounded; a typo'd tenant still gets its
+        # prediction)
+        tenant = (req.param("tenant") or obsplane.DEFAULT_TENANT)
+        if tenant not in obsplane.known_tenants():
+            tenant = obsplane.DEFAULT_TENANT
         items_param = req.param("items")
         if items_param is None:
             _REQS.inc(outcome="failure")
@@ -542,7 +568,7 @@ class Predictor:
             trie = _cache().get_or_build(digest, depth_need, rules_provider,
                                          _cfg_get("lanes_floor"))
             ticket = _BROKER.submit(trie, prefix, m, priority,
-                                    tag=req.uid or src)
+                                    tag=req.uid or src, tenant=tenant)
         except Exception as exc:
             _REQS.inc(outcome="failure")
             _bump(requests=1, failures=1)
@@ -553,7 +579,7 @@ class Predictor:
         window_wait_s = max(0.0, ticket.dispatch_t - ticket.submit_t)
         # read-path SLO: the obsplane's second signal class
         obsplane.observe_predict(priority, e2e_s, window_wait_s,
-                                 ticket.exec_s)
+                                 ticket.exec_s, tenant=tenant)
         entries = ticket.entries or []
         _REQS.inc(outcome="served")
         _bump(requests=1, served=1)
@@ -569,6 +595,7 @@ class Predictor:
                 "wave_jobs": ticket.wave_jobs,
                 "m": m,
                 "priority": priority,
+                "tenant": tenant,
                 "e2e_ms": round(e2e_s * 1000.0, 3),
                 "window_wait_ms": round(window_wait_s * 1000.0, 3),
                 "exec_ms": round(ticket.exec_s * 1000.0, 3),

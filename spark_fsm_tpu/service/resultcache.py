@@ -90,7 +90,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from spark_fsm_tpu import config
-from spark_fsm_tpu.service import integrity, model, obsplane
+from spark_fsm_tpu.service import integrity, model, obsplane, usage
 from spark_fsm_tpu.service.model import ServiceRequest, Status
 from spark_fsm_tpu.utils import envelope, faults, jobctl, obs
 from spark_fsm_tpu.utils.obs import log_event
@@ -775,7 +775,17 @@ class ResultCache:
             self.store.journal_clear(uid)
             self.store.incr("fsm:metric:jobs_finished")
             e2e = time.monotonic() - t0
-            obsplane.observe_job(priority, e2e, 0.0, e2e)
+            obsplane.observe_job(priority, e2e, 0.0, e2e,
+                                 tenant=(req.param("tenant")
+                                         or obsplane.DEFAULT_TENANT))
+            # avoided-cost credit (service/usage.py): this serve spent
+            # ~zero device seconds where a cold mine would have spent
+            # what the cached entry's recorded usage says it cost
+            u = ent.get("usage") or {}
+            usage.credit_avoided(
+                req.param("tenant"),
+                u.get("device_seconds_measured")
+                or u.get("device_seconds_est") or 0.0, mode)
             obs.lifecycle(uid, "settled", outcome="finished",
                           served_from_cache=mode)
             obs.flush_trace(uid)
@@ -864,7 +874,15 @@ class ResultCache:
         self.store.journal_clear(rec.uid)
         jobctl.release_entry(rec.ctl)
         e2e = now - rec.ctl.submitted_t
-        obsplane.observe_job(rec.priority, e2e, max(0.0, e2e), 0.0)
+        obsplane.observe_job(rec.priority, e2e, max(0.0, e2e), 0.0,
+                             tenant=rec.ctl.tenant)
+        # coalesced serve: the follower avoided the leader's measured
+        # device cost (rode the same mine for free)
+        u = stats.get("usage") or {}
+        usage.credit_avoided(
+            rec.ctl.tenant,
+            u.get("device_seconds_measured")
+            or u.get("device_seconds_est") or 0.0, "coalesced")
         obs.lifecycle(rec.uid, "settled", outcome="finished",
                       coalesced_into=leader)
         obs.flush_trace(rec.uid)
@@ -958,7 +976,12 @@ class ResultCache:
         ent = json.dumps({
             "algo": plugin.name, "kind": plugin.kind, "params": params,
             "n_sequences": n, "uid": req.uid, "digest": digest,
-            "ts": round(time.time(), 3), "payload": payload})
+            "ts": round(time.time(), 3),
+            # the mining job's recorded device cost (service/usage.py):
+            # what a future serve from this entry AVOIDS — the usage
+            # plane prices exact/dominated/coalesced credits from it
+            "usage": stats.get("usage"),
+            "payload": payload})
         # enveloped (utils/envelope.py) — entry FIRST, sidecar second:
         # a kill between the two leaves an intact entry whose sidecar
         # the scrubber (or the next serve-miss scrub) re-derives

@@ -92,7 +92,7 @@ class JobControl:
 
     __slots__ = ("uid", "deadline", "cancelled", "running", "priority",
                  "lease_lost", "submitted_t", "started_t", "dataset_fp",
-                 "follower_of", "stalled", "tenant", "ephemeral")
+                 "follower_of", "stalled", "tenant", "ephemeral", "usage")
 
     def __init__(self, uid: str, deadline: Optional[float],
                  priority: str = "normal"):
@@ -128,6 +128,10 @@ class JobControl:
         # NO-JOURNAL job admitted during a store outage — its durable
         # writes ride the spool ungated (no lease, no journal intent)
         self.ephemeral = False
+        # usage metering (service/usage.py): the live per-job device-
+        # cost accumulator, attached by the meter's first deposit —
+        # None when the plane is off or nothing was dispatched yet
+        self.usage = None
         # SLO accounting stamps (service/obsplane.py): submit instant
         # and FIRST worker pickup — e2e = terminal - submitted_t,
         # queue wait = started_t - submitted_t (retries re-activate but

@@ -423,14 +423,50 @@ _DRIFT_ALPHA = 0.2  # EWMA weight for the newest residual
 _drift_lock = threading.Lock()
 _drift_ewma: Optional[float] = None
 
+#: per-shape-family drift (ISSUE 19): the single global EWMA above
+#: stays the ``drift_factor`` recalibration input, unchanged; these
+#: labeled gauges break the same residuals out per dispatch family so
+#: the hardware-recalibration session can see WHICH shape family the
+#: planner misprices.  The vocabulary is closed (shapes.py families) —
+#: unknown families are dropped, keeping the label space bounded.
+COSTMODEL_FAMILIES = ("tsr-eval", "tsr-fused", "tsr-resident", "spam",
+                      "predict")
+_COSTMODEL_FAMILY_DRIFT = REGISTRY.gauge(
+    "fsm_costmodel_family_drift_ratio",
+    "EWMA of measured/predicted dispatch wall per shape family")
+for _f in COSTMODEL_FAMILIES:
+    _COSTMODEL_FAMILY_DRIFT.set(0.0, family=_f)
+del _f
+_family_ewma: Dict[str, float] = {}
 
-def observe_costmodel(predicted_s: float, measured_s: float) -> None:
+
+def observe_costmodel_family(family: str, predicted_s: float,
+                             measured_s: float) -> None:
+    """Feed one (predicted, measured) pair into a FAMILY drift gauge
+    only — for dispatch surfaces (resident segments, SPAM waves) whose
+    residuals must NOT perturb the global recalibration EWMA that
+    ``drift_factor`` consumes (pinned byte-identical by bench_smoke)."""
+    if predicted_s <= 0 or family not in COSTMODEL_FAMILIES:
+        return
+    ratio = measured_s / predicted_s
+    with _drift_lock:
+        prev = _family_ewma.get(family)
+        cur = (ratio if prev is None
+               else _DRIFT_ALPHA * ratio + (1 - _DRIFT_ALPHA) * prev)
+        _family_ewma[family] = cur
+        _COSTMODEL_FAMILY_DRIFT.set(cur, family=family)
+
+
+def observe_costmodel(predicted_s: float, measured_s: float,
+                      family: Optional[str] = None) -> None:
     """Feed one (predicted, measured) dispatch-wall pair into the
     cost-model calibration gauge.  Ratios are measured/predicted, so a
     drifting gauge reads directly as "the planner underestimates by
     Nx" — the number ``[engine] watchdog_slack`` must stay above.
     Pairs with a degenerate prediction are dropped (a zero-traffic
-    dispatch says nothing about the model)."""
+    dispatch says nothing about the model).  ``family`` additionally
+    routes the pair into that family's labeled drift gauge; the global
+    EWMA path is byte-identical with or without it."""
     global _drift_ewma
     if predicted_s <= 0:
         return
@@ -442,12 +478,20 @@ def observe_costmodel(predicted_s: float, measured_s: float) -> None:
                        else _DRIFT_ALPHA * ratio
                        + (1 - _DRIFT_ALPHA) * _drift_ewma)
         _COSTMODEL_DRIFT.set(_drift_ewma)
+    if family is not None:
+        observe_costmodel_family(family, predicted_s, measured_s)
 
 
 def costmodel_drift() -> Optional[float]:
     """Current measured/predicted EWMA (None until the first sample)."""
     with _drift_lock:
         return _drift_ewma
+
+
+def costmodel_family_drift() -> Dict[str, float]:
+    """Per-family measured/predicted EWMAs (families with samples)."""
+    with _drift_lock:
+        return dict(_family_ewma)
 
 
 # ===========================================================================

@@ -313,3 +313,22 @@ def test_predict_validation_errors(service):
     r = service.handle(ServiceRequest(service="fsm", task="predict",
                                       data={"uid": uid, "items": "a,b"}))
     assert r.status == "failure"
+
+
+def test_predict_tenant_labeling(service):
+    """ISSUE 19 satellite: the read path carries the fairness tenant —
+    a KNOWN tenant labels the response stats, the histograms, and the
+    per-tenant SLO split; an unregistered one folds to 'default' (the
+    label vocabulary stays bounded by the fairness config)."""
+    from spark_fsm_tpu.service import obsplane
+
+    obsplane.seed_tenant("predict-acme")
+    uid = _train(service, "TSR_TPU", support="0.1", k="25", minconf="0.2")
+    _, stats = _predict(service, uid, "1,2", tenant="predict-acme")
+    assert stats["tenant"] == "predict-acme"
+    # unknown tenants fold to the default label, never mint a new one
+    _, stats = _predict(service, uid, "1,2", tenant="nobody-configured")
+    assert stats["tenant"] == "default"
+    snap = obsplane.slo_snapshot()
+    t = snap.get("predict_tenants", {}).get("predict-acme")
+    assert t is not None and t.get("count", 0) >= 1

@@ -1,0 +1,393 @@
+"""Resource attribution & usage metering plane (service/usage.py, ISSUE 19).
+
+Pins the tentpole's contracts at three altitudes:
+
+- **apportionment unit**: split_integral is exact (sums to total),
+  deterministic (largest remainder, lowest-index tie-break), and safe
+  on degenerate weights;
+- **conservation invariant**: under a forced cross-job fused window AND
+  under a cost-model-rejected (degraded solo re-dispatch) window, the
+  per-job attribution sums EXACTLY to the broker's own dispatch
+  counters (launches and traffic units) — no work invented, none lost;
+- **durability**: the accumulator rides the frontier checkpoint across
+  kill -9/adoption (resume REPLACES, so the final ledger row bills the
+  job ONCE), avoided-cost credits land per mode, and the DISABLED path
+  is one module-global read (same pin as fusion.dispatch_wave).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu import config as cfgmod
+from spark_fsm_tpu.service import fusion as FZ
+from spark_fsm_tpu.service import obsplane
+from spark_fsm_tpu.service import usage
+from spark_fsm_tpu.service.actors import StoreCheckpoint
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import jobctl, obs
+
+DEADLINE_S = 60.0
+
+
+@pytest.fixture(autouse=True)
+def _usage_hygiene():
+    """No meter or broker leaks across tests: the engines probe module
+    globals, so a leaked install would silently bill every later
+    dispatch in the session."""
+    usage.uninstall()
+    FZ.configure(None)
+    yield
+    b = FZ.broker()
+    if b is not None:
+        b.release()
+        assert b.drain(10.0), "fusion broker still busy at test exit"
+    FZ.configure(None)
+    usage.uninstall()
+    cfgmod.set_config(cfgmod.parse_config({}))
+
+
+def _install(store=None):
+    cfg = cfgmod.parse_config({"usage": {"enabled": True,
+                                         "flush_every_s": 0.0}})
+    cfgmod.set_config(cfg)
+    m = usage.install(store if store is not None else ResultStore(), None)
+    m.stop()  # deterministic flushes only (flush_now / tick)
+    return m
+
+
+def _job(uid, tenant="default"):
+    ctl = jobctl.register(uid)
+    ctl.tenant = tenant
+    return ctl
+
+
+# ----------------------------------------------------- apportionment unit
+
+
+def test_split_integral_is_exact_and_deterministic():
+    assert usage.split_integral(7, [3, 2, 2]) == [3, 2, 2]
+    # one unit, plurality weight wins (lowest index breaks ties)
+    assert usage.split_integral(1, [2, 1, 1]) == [1, 0, 0]
+    assert usage.split_integral(1, [1, 1]) == [1, 0]
+    # degenerate weights fall back to equal shares
+    assert usage.split_integral(10, [0, 0]) == [5, 5]
+    assert usage.split_integral(0, [5, 3]) == [0, 0]
+    assert usage.split_integral(3, []) == []
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        n = int(rng.integers(1, 9))
+        total = int(rng.integers(0, 10_000))
+        weights = [float(w) for w in rng.random(n)]
+        out = usage.split_integral(total, weights)
+        assert sum(out) == total, (total, weights, out)
+        assert all(v >= 0 for v in out)
+
+
+# -------------------------------------------------- conservation invariant
+#
+# Broker-level waves reuse test_fusion.py's table-lookup eval idiom: no
+# device, no compile cost, but the broker runs its REAL planner, cost
+# model, and (here) its real attribution demux.
+
+
+def _table_eval(km):
+    def fn(p1, s1, xy):
+        t = np.asarray(p1)[:, 0].astype(np.int64)
+        s = np.asarray(s1)[:, 0].astype(np.int64)
+        xyn = np.asarray(xy)
+        xs = np.where(xyn[:, 0] >= 0, t[np.maximum(xyn[:, 0], 0)], 0)
+        ys = np.where(xyn[:, 1] >= 0, s[np.maximum(xyn[:, 1], 0)], 0)
+        return np.stack([xs.sum(axis=1), ys.sum(axis=1)])
+    return fn
+
+
+def _wave(uid, *, base, m=8, cands=None, priority="normal", n_seq=64):
+    p1 = (np.arange(m, dtype=np.uint32)[:, None] + np.uint32(base))
+    s1 = p1 + np.uint32(100_000)
+    cands = cands if cands is not None else [((0,), (1,)), ((2, 3), (4,))]
+    pools = {}
+    for r, (x, y) in enumerate(cands):
+        side = max(len(x), len(y))
+        km = 1
+        while km < side:
+            km *= 2
+        pools.setdefault(km, []).append(r)
+    return FZ.EvalWave(uid=uid, priority=priority, cands=cands,
+                       pools=pools, p1=p1, s1=s1, eval_fn=_table_eval,
+                       put=lambda x: x, cap=lambda km: 8192, lane=32,
+                       n_seq=n_seq, n_words=1)
+
+
+def _settled_sum(uids):
+    total = {"launches": 0, "traffic_units": 0, "seconds": 0.0}
+    for uid in uids:
+        vec = usage.settle(uid)
+        assert vec is not None, f"no attribution deposited for {uid}"
+        total["launches"] += vec["launches"]
+        total["traffic_units"] += vec["traffic_units"]
+        total["seconds"] += vec["device_seconds_measured"]
+    return total
+
+
+def test_conservation_exact_under_cross_job_fusion():
+    """THE invariant: a fused cross-job group's per-job attribution sums
+    EXACTLY to the broker's own launch/traffic counters."""
+    _install()
+    b = FZ.FusionBroker(window_s=0.25, max_jobs=8, max_width=16384)
+    b.hold()
+    _job("cons-a", "acme")
+    _job("cons-b", "globex")
+    try:
+        w1 = _wave("cons-a", base=1)
+        w2 = _wave("cons-b", base=1000,
+                   cands=[((1,), (0,)), ((4,), (2, 5)), ((6, 7), (3,))])
+        b.submit(w1)
+        b.submit(w2)
+        b.release()
+        w1.result()
+        w2.result()
+        assert b.stats["fused_groups"] == 1
+        assert b.stats["cross_job_launches"] >= 1
+        got = _settled_sum(["cons-a", "cons-b"])
+        assert got["launches"] == b.stats["launches"]
+        assert got["traffic_units"] == b.stats["traffic_units"]
+        assert got["seconds"] > 0.0
+    finally:
+        jobctl.release("cons-a")
+        jobctl.release("cons-b")
+
+
+def test_conservation_exact_under_degraded_solo_dispatch():
+    """A cost-model-REJECTED group dispatches per-job (the degraded
+    path): each solo re-dispatch bills its own job, and the sum still
+    equals the broker's counters exactly."""
+    _install()
+    b = FZ.FusionBroker(window_s=0.25, max_jobs=8, max_width=16384)
+    b.hold()
+    _job("deg-a", "acme")
+    _job("deg-b", "globex")
+    try:
+        w1 = _wave("deg-a", base=1, m=8192, n_seq=990_000)
+        w2 = _wave("deg-b", base=7, m=8192, n_seq=990_000)
+        b.submit(w1)
+        b.submit(w2)
+        b.release()
+        w1.result()
+        w2.result()
+        assert b.stats["rejected_groups"] == 1
+        assert b.stats["solo_waves"] == 2
+        va = usage.settle("deg-a")
+        vb = usage.settle("deg-b")
+        assert va["launches"] + vb["launches"] == b.stats["launches"]
+        assert (va["traffic_units"] + vb["traffic_units"]
+                == b.stats["traffic_units"])
+        # each job billed for ITS OWN plan, not a half of the pair
+        assert va["launches"] >= 1 and vb["launches"] >= 1
+    finally:
+        jobctl.release("deg-a")
+        jobctl.release("deg-b")
+
+
+def test_conservation_counters_match_tenant_rollup():
+    """The zero-seeded fsm_usage_* counters move by exactly what the
+    tenant rollups record — the cross-check usage_smoke reads off
+    /metrics."""
+    m = _install()
+    obsplane.seed_tenant("acme")
+    before = usage._LAUNCHES.total()
+    _job("ctr-1", "acme")
+    try:
+        usage.deposit("ctr-1", launches=5, traffic_units=640,
+                      seconds_measured=0.25)
+        vec = usage.settle("ctr-1")
+        assert usage._LAUNCHES.total() - before == vec["launches"] == 5
+        rep = m.report()
+        assert rep["tenants"]["acme"]["launches"] == 5
+        assert rep["tenants"]["acme"]["traffic_units"] == 640
+    finally:
+        jobctl.release("ctr-1")
+
+
+# ------------------------------------------------ kill -9 / adoption drill
+
+
+def test_attribution_survives_checkpoint_adoption_no_double_billing():
+    """The dead holder's deposits ride the frontier checkpoint; the
+    adopter resumes them (REPLACE, not add), re-deposits its own work,
+    and the final ledger row bills the job ONCE."""
+    store = ResultStore()
+    _install(store)
+    uid = "adopt-1"
+    _job(uid, "acme")
+    obsplane.seed_tenant("acme")
+    try:
+        usage.deposit(uid, launches=4, traffic_units=400,
+                      seconds_est=0.4, seconds_measured=0.5)
+        ckpt = StoreCheckpoint(store, uid, every_s=0.0)
+        ckpt.save({"stack": [1, 2], "fingerprint": "fp",
+                   "results": [], "results_done": 0})
+        # kill -9: the holder's live accumulator dies with the process.
+        # The fenced-failure path would usage.drop() — same end state.
+        usage.drop(uid)
+        jobctl.release(uid)
+
+        # adopter: fresh control entry, loads the frontier
+        _job(uid, "acme")
+        state = StoreCheckpoint(store, uid).load()
+        assert state is not None
+        assert "usage" not in state  # stripped before the engine sees it
+        adopted = usage.job_view(uid)
+        assert adopted is not None and adopted["launches"] == 4
+        # the adopter re-mines PAST the checkpoint and deposits on top
+        usage.deposit(uid, launches=2, traffic_units=100,
+                      seconds_measured=0.1)
+        vec = usage.settle(uid)
+        assert vec["launches"] == 6 and vec["traffic_units"] == 500
+        usage.flush_now()
+        rows = usage.get().ledger_rows(store)
+        row = rows["acme"]
+        assert row["jobs"][uid]["launches"] == 6
+        assert row["totals"]["launches"] == 6  # once, not 4 + 6
+
+        # a LATER settle of the same uid (resubmit/adopt chain) REPLACES
+        # the ledger entry — totals follow the newest vector
+        _job(uid, "acme")
+        usage.deposit(uid, launches=3, traffic_units=50)
+        usage.settle(uid)
+        usage.flush_now()
+        row = usage.get().ledger_rows(store)["acme"]
+        assert row["jobs"][uid]["launches"] == 3
+        assert row["totals"]["launches"] == 3
+    finally:
+        jobctl.release(uid)
+
+
+def test_fenced_holder_drops_without_settling():
+    m = _install()
+    _job("fence-1", "acme")
+    try:
+        usage.deposit("fence-1", launches=7, traffic_units=10)
+        usage.drop("fence-1")
+        assert usage.settle("fence-1") is None
+        rep = m.report()
+        assert rep["tenants"].get("acme", {}).get("launches", 0) == 0
+    finally:
+        jobctl.release("fence-1")
+
+
+# ------------------------------------------------------------ avoided cost
+
+
+def test_avoided_cost_credits_per_mode():
+    m = _install()
+    obsplane.seed_tenant("acme")
+    before = usage._AVOIDED.total()
+    for mode, secs in (("exact", 0.5), ("dominated", 0.25),
+                       ("coalesced", 0.125)):
+        usage.credit_avoided("acme", secs, mode)
+    rep = m.report()
+    assert rep["tenants"]["acme"]["avoided_device_seconds"] == \
+        pytest.approx(0.875)
+    assert usage._AVOIDED.total() - before == pytest.approx(0.875)
+    # unknown tenants fold to default; negative credits clamp to zero
+    usage.credit_avoided("nobody-registered-this", 0.5, "exact")
+    usage.credit_avoided("acme", -1.0, "exact")
+    rep = m.report()
+    assert rep["tenants"]["default"]["avoided_device_seconds"] == \
+        pytest.approx(0.5)
+    assert rep["tenants"]["acme"]["avoided_device_seconds"] == \
+        pytest.approx(0.875)
+
+
+# ---------------------------------------------------------- disabled path
+
+
+def test_disabled_path_is_one_global_read():
+    """[usage] off (the default): every probe returns after one
+    module-global read — no meter, no counter, no rollup touched."""
+    assert usage.get() is None
+    before = usage._LAUNCHES.total()
+    usage.deposit("ghost", launches=5, traffic_units=100,
+                  seconds_measured=1.0)
+    usage.deposit_tenant("acme", launches=3)
+    usage.credit_avoided("acme", 1.0, "exact")
+    assert usage.settle("ghost") is None
+    assert usage.job_view("ghost") is None
+    assert usage.checkpoint_snapshot("ghost") is None
+    usage.resume("ghost", {"launches": 9})
+    usage.drop("ghost")
+    usage.tick()
+    assert usage.flush_now() == 0
+    assert usage.report() == {"enabled": False}
+    assert usage.stats() is None
+    assert usage._LAUNCHES.total() == before
+    # the fused-attribution demux early-returns before touching a wave
+    FZ.FusionBroker._attribute_fused([], [], 0.0, 0.0)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        cfgmod.parse_config({"usage": {"window_s": 0}})
+    with pytest.raises(ValueError):
+        cfgmod.parse_config({"usage": {"flush_every_s": -1}})
+    with pytest.raises(ValueError):
+        cfgmod.parse_config({"usage": {"top_jobs": 0}})
+    cfg = cfgmod.parse_config({"usage": {"enabled": True}})
+    assert cfg.usage.enabled and cfg.usage.window_s == 300.0
+
+
+# ------------------------------------------- per-family cost-model drift
+
+
+def test_family_drift_isolated_from_global_ewma():
+    """observe_costmodel_family moves ONLY the per-family EWMA — the
+    global drift ratio and sample counter stay byte-identical (the
+    bench_smoke pin); observe_costmodel(family=...) moves both."""
+    # earlier suite tests mine real jobs and pre-seed these EWMAs —
+    # clear the two families this test asserts exact first-sample
+    # values for (the module dict is process-global, like the gauge)
+    obs._family_ewma.pop("tsr-resident", None)
+    obs._family_ewma.pop("tsr-eval", None)
+    samples = obs._COSTMODEL_SAMPLES.total()
+    global_drift = obs.costmodel_drift()
+    obs.observe_costmodel_family("tsr-resident", 0.1, 0.3)
+    assert obs._COSTMODEL_SAMPLES.total() == samples
+    assert obs.costmodel_drift() == global_drift
+    fam = obs.costmodel_family_drift()
+    assert fam["tsr-resident"] == pytest.approx(3.0)
+    # unknown families and non-positive predictions are dropped
+    obs.observe_costmodel_family("not-a-family", 0.1, 0.2)
+    obs.observe_costmodel_family("spam", 0.0, 0.2)
+    assert "not-a-family" not in obs.costmodel_family_drift()
+    # the combined entry point moves the global EWMA AND the family's
+    obs.observe_costmodel(0.2, 0.2, family="tsr-eval")
+    assert obs._COSTMODEL_SAMPLES.total() == samples + 1
+    assert obs.costmodel_family_drift()["tsr-eval"] > 0.0
+    for f in obs.COSTMODEL_FAMILIES:
+        assert isinstance(f, str) and f
+
+
+# ---------------------------------------------------------- read path
+
+
+def test_jobless_deposit_folds_to_tenant_and_flushes():
+    """Predict waves have no JobControl: deposit_tenant folds the cost
+    straight into the tenant rollup, and the durable flush merges it
+    append-only into the ledger totals + read_path sub-vector."""
+    store = ResultStore()
+    m = _install(store)
+    obsplane.seed_tenant("acme")
+    usage.deposit_tenant("acme", launches=1, traffic_units=256,
+                         seconds_measured=0.01)
+    usage.deposit_tenant("unregistered", launches=1)  # folds to default
+    rep = m.report(store)
+    assert rep["tenants"]["acme"]["launches"] == 1
+    assert rep["tenants"]["default"]["launches"] == 1
+    row = usage.get().ledger_rows(store)["acme"]
+    assert row["totals"]["launches"] == 1
+    assert row["read_path"]["traffic_units"] == 256
+    # a second flush with no new work writes nothing
+    assert usage.flush_now() == 0
