@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Degraded-topology smoke: kill a partition row mid-mine, keep parity.
+
+The CI companion to verify_t1.sh for the mesh-loss survival plane
+(service/meshguard.py + parallel/partition.replan_surviving +
+models/tsr.TsrPartitioned adoption): on the forced-host 8-device CPU
+mesh it runs the config-3 kosarak miniature through the PARTITIONED
+route (2 partition rows x 4-device inner seq rows) while a
+device-shaped injected fault kills row 0 mid-round, and asserts
+
+- BYTE PARITY with the single-device route after the surviving row
+  adopts the dead row's class slice (the degraded exact-merge
+  contract);
+- the guard fenced exactly row 0 (dead_after=1) and bumped the
+  topology epoch — stale launches are refused, not silently degraded;
+- a poison-filler crash-loop quarantine roundtrip: a synthetic
+  exhausted-adoption-budget job settles a durable
+  ``fsm:quarantine:{uid}`` record, blocks re-admission, counts a
+  refusal, and releases clean via the /admin/quarantine verbs;
+- the fsm_mesh_* / fsm_quarantine_* metric families are LIVE on a
+  registry scrape with their label vocabularies seeded.
+
+Usage: scripts/meshguard_smoke.sh   (pins JAX_PLATFORMS=cpu + 8 devs)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def main() -> int:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from spark_fsm_tpu.config import MeshguardConfig
+    from spark_fsm_tpu.data.synth import kosarak_like
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+    from spark_fsm_tpu.parallel.mesh import make_mesh
+    from spark_fsm_tpu.service import meshguard
+    from spark_fsm_tpu.service.store import ResultStore
+    from spark_fsm_tpu.utils import faults, obs
+    from spark_fsm_tpu.utils.canonical import rules_text
+
+    failures = []
+    db = kosarak_like(scale=0.002, fast=True)
+
+    t0 = time.monotonic()
+    want = rules_text(mine_tsr_tpu(db, 100, 0.5, max_side=2))
+    solo_s = time.monotonic() - t0
+
+    # ---- chaos drill: kill partition row 0 mid-mine, adopt, merge
+    guard = meshguard.install(MeshguardConfig(enabled=True, dead_after=1))
+    t0 = time.monotonic()
+    try:
+        faults.arm("device.dispatch", every=1, times=1, match="part0")
+        got = rules_text(mine_tsr_tpu(db, 100, 0.5, max_side=2,
+                                      mesh=make_mesh(8),
+                                      partition_parts=2))
+    finally:
+        faults.disarm()
+    drill_s = time.monotonic() - t0
+    if got != want:
+        failures.append("degraded mine differs from the single-device "
+                        "route (adoption exact-merge contract broken)")
+    if guard.dead_rows() != frozenset({0}):
+        failures.append(f"guard fenced {set(guard.dead_rows())}, "
+                        "expected exactly row 0 dead")
+    epoch = guard.current_epoch()
+    if epoch < 1:
+        failures.append(f"topology epoch never bumped (epoch={epoch})")
+    try:
+        guard.check_epoch(epoch - 1)
+        failures.append("stale pre-death epoch was NOT refused")
+    except meshguard.StaleTopology:
+        pass
+    meshguard.reset()
+
+    # ---- poison-filler quarantine roundtrip (no real crash loop: the
+    # tier-1 drill in tests/test_meshguard.py owns that; this pins the
+    # durable-record verbs an operator actually drives)
+    store = ResultStore()
+    uid = "meshguard-smoke-poison"
+    meshguard.poison_record(store, uid, reason="adoption budget "
+                            "exhausted: smoke filler", adoptions=3)
+    if meshguard.poisoned(store, uid) is None:
+        failures.append("poison record did not block re-admission")
+    meshguard.note_refused(uid)
+    listed = [r for r in meshguard.quarantine_list(store)
+              if r.get("uid") == uid]
+    if not listed:
+        failures.append("poison record missing from /admin/quarantine "
+                        "list surface")
+    if not meshguard.quarantine_release(store, uid):
+        failures.append("quarantine_release returned False for a live "
+                        "record")
+    if meshguard.poisoned(store, uid) is not None:
+        failures.append("released uid still blocks re-admission")
+
+    # ---- scrape: families live, vocabularies seeded
+    text = obs.REGISTRY.render_prometheus()
+    for fam in ("fsm_mesh_epoch", "fsm_mesh_rows_dead",
+                "fsm_mesh_row_transitions_total", "fsm_mesh_probes_total",
+                "fsm_mesh_replans_total",
+                "fsm_mesh_stale_epoch_refused_total",
+                "fsm_quarantine_jobs_total"):
+        if fam not in text:
+            failures.append(f"metric family missing from scrape: {fam}")
+    for series in ('fsm_mesh_row_transitions_total{to="dead"}',
+                   'fsm_mesh_probes_total{outcome="failed"}',
+                   'fsm_quarantine_jobs_total{outcome="poisoned"}',
+                   'fsm_quarantine_jobs_total{outcome="refused"}',
+                   'fsm_quarantine_jobs_total{outcome="released"}'):
+        if series not in text:
+            failures.append(f"label vocabulary not seeded: {series}")
+
+    if failures:
+        print("meshguard_smoke: FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print(f"meshguard_smoke: row 0 killed mid-round and adopted — "
+          f"degraded 2x4 mine byte-identical to the single-device route "
+          f"(epoch {epoch}, stale launch refused; poison quarantine "
+          f"roundtrip clean; walls solo {solo_s:.1f}s / degraded "
+          f"{drill_s:.1f}s on timeshared virtual devices)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Degraded-topology smoke — the mesh-loss survival companion to
+# verify_t1.sh (service/meshguard.py).  Pinned 8-virtual-device
+# partitioned kosarak miniature with partition row 0 killed mid-round:
+# adoption byte parity, epoch fence, poison-quarantine roundtrip, live
+# fsm_mesh_* / fsm_quarantine_* metric families.
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/meshguard_smoke.py "$@"

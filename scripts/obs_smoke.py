@@ -261,7 +261,17 @@ def main() -> int:
                     "fsm_usage_traffic_units_total",
                     "fsm_usage_avoided_device_seconds_total",
                     "fsm_usage_flushes_total",
-                    "fsm_costmodel_family_drift_ratio"):
+                    "fsm_costmodel_family_drift_ratio",
+                    # ISSUE 20 families: degraded-topology survival
+                    # (service/meshguard.py) — present (zero) even on
+                    # a boot with [meshguard] disabled
+                    "fsm_mesh_epoch",
+                    "fsm_mesh_rows_dead",
+                    "fsm_mesh_row_transitions_total",
+                    "fsm_mesh_probes_total",
+                    "fsm_mesh_replans_total",
+                    "fsm_mesh_stale_epoch_refused_total",
+                    "fsm_quarantine_jobs_total"):
             if fam not in families:
                 failures.append(f"expected family missing: {fam}")
 
@@ -322,8 +332,17 @@ def main() -> int:
                 ("fsm_integrity_corrupt_total", "surface",
                  {"checkpoint", "journal", "rescache", "spine",
                   "lease"}),
+                # ISSUE 20 grows the recovery vocabulary: an intent can
+                # settle as bitrot ("corrupt") now, and the mesh /
+                # crash-loop quarantine families seed their transitions
                 ("fsm_recovery_jobs_total", "outcome",
-                 {"cleared", "resumed", "failed", "quarantined"}),
+                 {"cleared", "resumed", "failed", "quarantined",
+                  "corrupt"}),
+                ("fsm_mesh_row_transitions_total", "to",
+                 {"healthy", "suspect", "dead"}),
+                ("fsm_mesh_probes_total", "outcome", {"ok", "failed"}),
+                ("fsm_quarantine_jobs_total", "outcome",
+                 {"poisoned", "refused", "released"}),
                 # ISSUE 19 vocabularies: the usage bill's tenant label
                 # is seeded with the default tenant from boot, and the
                 # per-family cost-model drift gauge seeds every

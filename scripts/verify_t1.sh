@@ -58,6 +58,11 @@
 #                        /admin/usage, conservation invariant exact vs
 #                        the dispatch counters, avoided-cost credited,
 #                        durable ledger + fsm_usage_* families live
+#   meshguard_smoke.sh   degraded-topology survival: partition row 0
+#                        killed mid-round on the 8-virtual-device 2-D
+#                        mesh — adoption byte parity, stale-epoch
+#                        launch refused, poison-quarantine roundtrip,
+#                        live fsm_mesh_* + fsm_quarantine_* families
 cd "$(dirname "$0")/.."
 set -o pipefail
 SMOKES=0
@@ -71,7 +76,8 @@ if [ $rc -eq 0 ] && [ $SMOKES -eq 1 ]; then
              throughput_smoke resident_smoke partition_smoke \
              replica_smoke rescache_smoke autoscale_smoke \
              storm_smoke fleet_smoke spam_smoke fused_smoke \
-             predict_smoke bitrot_smoke usage_smoke; do
+             predict_smoke bitrot_smoke usage_smoke \
+             meshguard_smoke; do
         echo "== scripts/$s.sh"
         "scripts/$s.sh" || { echo "SMOKE_FAILED=$s"; exit 1; }
     done

@@ -415,6 +415,35 @@ class PartitionConfig:
 
 
 @dataclasses.dataclass
+class MeshguardConfig:
+    """Topology-survival plane (service/meshguard.py): per-partition-row
+    health state machine (healthy -> suspect -> dead) fed by watchdog
+    timeouts and ``device.dispatch``/``device.resident`` fault trips,
+    plus an active zero-width probe per row.  Row deaths bump a
+    monotonic ``topology_epoch`` published on the lease heartbeat; the
+    partitioned orchestrator re-plans the dead row's equivalence
+    classes LPT onto survivors and resumes from the composite frontier
+    (parallel/partition.py ``replan_surviving``), byte-identical to the
+    healthy mine (docs/DESIGN.md).
+
+    ``enabled = false`` (default) keeps every dispatch probe at one
+    module-global read and the pre-meshguard behavior byte-identical.
+    ``dead_after`` is how many device-shaped trips move a row from
+    suspect to dead (the first trip is always only suspect — one flaky
+    launch must not kill a row).  ``probe_every_s`` is the active-probe
+    cadence riding the lease heartbeat (0 = passive trips only).
+    ``max_retries`` bounds per-round adoption attempts in the
+    orchestrator before the mine fails for real (a mesh losing rows
+    faster than re-planning converges is dead, not degraded).
+    """
+
+    enabled: bool = False
+    dead_after: int = 2
+    probe_every_s: float = 0.0
+    max_retries: int = 4
+
+
+@dataclasses.dataclass
 class RescacheConfig:
     """Result-reuse tier above admission (service/resultcache.py):
     content-addressed dataset fingerprints, in-flight request
@@ -574,7 +603,11 @@ class ClusterConfig:
     failed renewals still leave one attempt before the TTL lapses.
     ``steal`` lets idle replicas claim queued jobs from loaded peers.
     ``recover_every_s`` (0 = ttl) is the periodic orphan-adoption scan
-    cadence.
+    cadence.  ``max_adoptions`` is the crash-loop quarantine bound
+    (service/meshguard.py + recover_orphans): a job whose journal
+    intent records this many adoption resubmits settles as a durable
+    ``POISON:`` failure instead of burning another replica — released
+    only via ``/admin/quarantine``.
     """
 
     enabled: bool = False
@@ -583,6 +616,7 @@ class ClusterConfig:
     heartbeat_s: float = 0.0
     steal: bool = True
     recover_every_s: float = 0.0
+    max_adoptions: int = 3
 
 
 @dataclasses.dataclass
@@ -670,6 +704,8 @@ class Config:
         default_factory=PartitionConfig)
     cluster: ClusterConfig = dataclasses.field(
         default_factory=ClusterConfig)
+    meshguard: MeshguardConfig = dataclasses.field(
+        default_factory=MeshguardConfig)
     rescache: RescacheConfig = dataclasses.field(
         default_factory=RescacheConfig)
     fairness: FairnessConfig = dataclasses.field(
@@ -732,6 +768,7 @@ def parse_config(obj: Dict[str, Any]) -> Config:
         "fusion": (FusionConfig, top.pop("fusion", {})),
         "partition": (PartitionConfig, top.pop("partition", {})),
         "cluster": (ClusterConfig, top.pop("cluster", {})),
+        "meshguard": (MeshguardConfig, top.pop("meshguard", {})),
         "rescache": (RescacheConfig, top.pop("rescache", {})),
         "fairness": (FairnessConfig, top.pop("fairness", {})),
         "autoscale": (AutoscaleConfig, top.pop("autoscale", {})),
@@ -803,6 +840,17 @@ def parse_config(obj: Dict[str, Any]) -> Config:
             "renewed slower than it expires is permanently flapping)")
     if cfg.cluster.recover_every_s < 0:
         raise ConfigError("cluster.recover_every_s must be >= 0 (0 = ttl)")
+    if cfg.cluster.max_adoptions < 1:
+        raise ConfigError(
+            "cluster.max_adoptions must be >= 1 (every orphan deserves "
+            "at least one adoption before quarantine)")
+    if cfg.meshguard.dead_after < 1:
+        raise ConfigError("meshguard.dead_after must be >= 1")
+    if cfg.meshguard.probe_every_s < 0:
+        raise ConfigError(
+            "meshguard.probe_every_s must be >= 0 (0 = passive only)")
+    if cfg.meshguard.max_retries < 1:
+        raise ConfigError("meshguard.max_retries must be >= 1")
     if cfg.rescache.max_bytes < 0:
         raise ConfigError("rescache.max_bytes must be >= 0 (0 = unbounded)")
     if cfg.fairness.tenant_depth < 0:

@@ -64,6 +64,7 @@ from spark_fsm_tpu.parallel import multihost as MH
 from spark_fsm_tpu.parallel import partition as PN
 from spark_fsm_tpu.parallel.mesh import SEQ_AXIS, pad_to_multiple, shard_map, store_sharding
 from spark_fsm_tpu.service import fusion as FZ
+from spark_fsm_tpu.service import meshguard as MGD
 from spark_fsm_tpu.service import usage
 from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.canonical import RuleResult, sort_rules
@@ -483,9 +484,23 @@ class TsrTPU:
         self._sup_sorted = vdb.item_supports[order]
         if self._partition is not None:
             self.stats["partition"] = self._partition[1]
+        # topology epoch at construction (service/meshguard.py; None
+        # when the plane is off): every dispatch re-checks it, so a
+        # partition-row death between planning and launch refuses the
+        # launch instead of executing on dead silicon — the partitioned
+        # orchestrator then rebuilds this engine against the survivors
+        self._topo_epoch = MGD.current_epoch()
 
     def _part_idx(self) -> Optional[int]:
         return None if self._partition is None else self._partition[1]
+
+    def _fault_ctx(self) -> dict:
+        """Extra chaos-site context naming this engine's partition row
+        (``part{p}``) so a drill can kill ONE row's dispatches with
+        ``match="part0"`` (scripts/meshguard_smoke.py); empty when
+        unpartitioned — the committed chaos-seed ctx must not shift."""
+        p = self._part_idx()
+        return {} if p is None else {"part": f"part{p}"}
 
     def _owned_mask(self, m: int) -> Optional[np.ndarray]:
         """Boolean mask over the round's local root indices 0..m-1: True
@@ -667,6 +682,10 @@ class TsrTPU:
         clock to the handle so :meth:`_resolve_eval` can put the
         measured wall next to the planner's prediction.  One global
         read when tracing is off (utils/obs.span)."""
+        # meshguard fence: refuse a dispatch planned against a topology
+        # a row death has invalidated (one global read when the plane
+        # is off; StaleTopology sends the orchestrator to re-plan)
+        MGD.check_epoch(self._topo_epoch)
         t0 = time.monotonic()
         with obs.span("tsr.dispatch", candidates=len(cands)) as sp:
             handle = self._dispatch_eval_inner(p1, s1, cands)
@@ -812,7 +831,8 @@ class TsrTPU:
                                       L.traffic_units, 1, self.n_seq,
                                       self.n_words), 6)):
                     faults.fault_site("device.dispatch", point="jnp",
-                                      km=str(L.km), width=str(L.width))
+                                      km=str(L.km), width=str(L.width),
+                                      **self._fault_ctx())
                     fn = self._eval_fn(L.km)
                     xy = self._stager.take(L, cands)
                     xy_bufs.append(xy)
@@ -925,7 +945,8 @@ class TsrTPU:
                           6)) as sp:
             try:
                 faults.fault_site("device.dispatch", point="kernel",
-                                  km=str(L.km), width=str(L.width))
+                                  km=str(L.km), width=str(L.width),
+                                  **self._fault_ctx())
                 faults.fault_site("device.oom", point="kernel",
                                   km=str(L.km), width=str(L.width))
                 fn = _kernel_eval_fn(self.mesh, L.km,
@@ -1026,7 +1047,8 @@ class TsrTPU:
         out, cols = handle[0], handle[1]
 
         def read():
-            faults.fault_site("device.dispatch", point="readback")
+            faults.fault_site("device.dispatch", point="readback",
+                              **self._fault_ctx())
             return np.asarray(out)
 
         # the blocking readback runs under the dispatch watchdog: the
@@ -2020,10 +2042,23 @@ class TsrPartitioned:
         self.owned = PN.owned_parts(self.plan)
         self.item_cap = int(engine_kwargs.get("item_cap",
                                               ITEM_CAP_DEFAULT))
+        # kept for degraded-topology rebuilds (service/meshguard.py): an
+        # adopted part re-instantiates its engine on the survivor's mesh
+        # row with the SAME construction arguments
+        self._engine_kwargs = dict(engine_kwargs)
         self.engines: Dict[int, TsrTPU] = {
             p: TsrTPU(vdb, k, minconf, mesh=self.meshes[p],
                       partition=(self.plan, p), **engine_kwargs)
             for p in self.owned}
+        # register each partition row's devices with the meshguard so
+        # its active probe exercises the same silicon the rows dispatch
+        # on (no-op when the plane is off)
+        g = MGD.get()
+        if g is not None:
+            g.register_rows({
+                p: (tuple(self.meshes[p].devices.flat)
+                    if self.meshes[p] is not None else ())
+                for p in self.owned})
         first = self.engines[self.owned[0]]
         self.stats: dict = {
             "partition_parts": int(parts),
@@ -2068,18 +2103,63 @@ class TsrPartitioned:
             resume, self.frontier_fingerprint())
         for rows_p in done.values():
             board.merge(int(r[2]) for r in rows_p)
+        guard = MGD.get()
         for p in self.owned:
             if p in done:
                 continue  # completed before the resumed snapshot
             eng = self.engines[p]
             cb = None
-            if checkpoint_cb is not None:
-                def cb(fs, p=p):
-                    checkpoint_cb(self._composite(
-                        m, board.floor(), done, p, fs))
-            res_p, _s_k_p = eng._mine_restricted(
-                m, resume=active_resume.get(p), checkpoint_cb=cb,
-                every_s=every_s, floor=board.floor())
+            # the part's latest frontier snapshot, kept host-side even
+            # with no durable checkpoint sink: a mid-slice row death
+            # resumes the ADOPTER from here with the conservative floor
+            # carried over, instead of re-mining the slice from scratch
+            last = {"fs": active_resume.get(p)}
+            if checkpoint_cb is not None or guard is not None:
+                def cb(fs, p=p, last=last):
+                    last["fs"] = fs
+                    if checkpoint_cb is not None:
+                        checkpoint_cb(self._composite(
+                            m, board.floor(), done, p, fs))
+            row, attempts = p, 0
+            while True:
+                try:
+                    res_p, _s_k_p = eng._mine_restricted(
+                        m, resume=last["fs"], checkpoint_cb=cb,
+                        every_s=every_s, floor=board.floor())
+                    if guard is not None:
+                        guard.note_row_ok(row)
+                    break
+                except Exception as exc:
+                    if guard is None:
+                        raise
+                    attempts += 1
+                    if attempts >= guard.max_retries:
+                        raise  # the mesh is melting, not degrading
+                    if isinstance(exc, MGD.StaleTopology):
+                        # refused launch, not a device failure: the row
+                        # keeps its health — rebuild at the new epoch
+                        # (adopting below if OUR row is the dead one)
+                        state = guard.state_of(row)
+                    else:
+                        state = guard.note_row_fault(row, exc)
+                        if state is None:
+                            raise  # not device-shaped: supervision owns it
+                    if state == MGD.DEAD:
+                        adopter = PN.adopters_for(
+                            self.plan, guard.dead_rows()).get(row)
+                        if adopter is None or adopter == row:
+                            raise
+                        MGD.note_replan(guard.dead_rows())
+                        row = adopter
+                    # rebuild: fresh topology epoch, and (after an
+                    # adoption) the survivor's mesh row — the class
+                    # restriction (plan, p) is unchanged, so the
+                    # resumed frontier and the final merge are too
+                    eng = TsrTPU(self.vdb, self.k, self.minconf,
+                                 mesh=self.meshes[row],
+                                 partition=(self.plan, p),
+                                 **self._engine_kwargs)
+                    self.engines[p] = eng
             done[p] = [[list(x), list(y), int(sup), int(supx)]
                        for x, y, sup, supx in res_p]
             board.merge(r[2] for r in done[p])

@@ -120,6 +120,11 @@ class PartitionPlan:
     n_classes: int
     owner: np.ndarray  # [n_classes] int32
     part_costs: np.ndarray  # [n_parts] float64
+    # per-class modeled costs, kept so a degraded re-plan
+    # (:func:`replan_surviving`) can LPT-rebalance a dead row's classes
+    # without the vertical DB in hand; None on plans built before the
+    # topology-survival plane (re-plans then assume uniform class cost)
+    class_costs: Optional[np.ndarray] = None
 
     @property
     def imbalance_ratio(self) -> float:
@@ -188,11 +193,87 @@ def plan_partitions(item_ids, item_supports, n_parts: int,
         p = int(np.argmin(load))
         owner[int(c)] = p
         load[p] += costs[int(c)]
-    plan = PartitionPlan(n_parts, n_classes, owner, load)
+    plan = PartitionPlan(n_parts, n_classes, owner, load, costs)
     if record:
         _PLANS.inc()
         _IMBALANCE.set(plan.imbalance_ratio)
     return plan
+
+
+def replan_surviving(plan: PartitionPlan,
+                     dead_rows: Sequence[int]) -> PartitionPlan:
+    """Re-balance a dead row's equivalence classes onto the survivors.
+
+    Class hashes (:func:`class_of`) are TOPOLOGY-INDEPENDENT — a class
+    is a pure function of global item ids, not of which silicon owns it
+    — so ownership recomputes with zero coordination: surviving rows
+    KEEP their classes (their in-flight frontiers and checkpoints stay
+    valid), and only the dead rows' classes re-assign, LPT (largest
+    class first to the least-loaded survivor) over the per-class costs
+    the original plan recorded.  Dead partitions end with zero cost and
+    an empty class set; the layout geometry (``n_parts``/``n_classes``)
+    is unchanged, but the owner map is not — so
+    :meth:`PartitionPlan.fingerprint` CHANGES, and a composite
+    checkpoint taken under the old layout restarts fresh rather than
+    resuming per-part slices that no longer mean the same classes.
+    (In-flight adoption therefore keeps the ORIGINAL plan and re-homes
+    whole slices via :func:`adopters_for` instead.)  Byte parity of the
+    merged result follows either way (docs/DESIGN.md): every class is
+    still mined exactly once, under the same minsup / conservative
+    floor, and the merge sorts.
+    """
+    dead = {int(r) for r in dead_rows}
+    survivors = [p for p in range(plan.n_parts) if p not in dead]
+    if not survivors:
+        raise ValueError(
+            f"no surviving partitions (dead={sorted(dead)} of "
+            f"{plan.n_parts}): the mesh is gone, not degraded")
+    if not dead:
+        return plan
+    costs = (plan.class_costs if plan.class_costs is not None
+             else np.ones(plan.n_classes, np.float64))
+    owner = plan.owner.copy()
+    load = np.zeros(plan.n_parts, np.float64)
+    for c in range(plan.n_classes):
+        if int(owner[c]) not in dead:
+            load[int(owner[c])] += costs[c]
+    orphan_classes = [c for c in range(plan.n_classes)
+                     if int(owner[c]) in dead]
+    # LPT over the orphaned classes only — stable sort, so every
+    # process (and every retry) derives the identical adoption map
+    orphan_classes.sort(key=lambda c: (-costs[c], c))
+    for c in orphan_classes:
+        p = survivors[int(np.argmin(load[survivors]))]
+        owner[c] = p
+        load[p] += costs[c]
+    return PartitionPlan(plan.n_parts, plan.n_classes, owner, load,
+                         plan.class_costs)
+
+
+def adopters_for(plan: PartitionPlan,
+                 dead_rows: Sequence[int]) -> dict:
+    """Deterministic ``dead part -> surviving adopter`` map for
+    in-flight slice adoption: each dead part's WHOLE remaining slice
+    re-homes onto the least-loaded survivor (largest dead part first —
+    the same LPT discipline as :func:`replan_surviving`, applied at
+    part granularity because a mid-mine slice must keep its original
+    class restriction for checkpoint compatibility; only the silicon
+    underneath it changes)."""
+    dead = sorted({int(r) for r in dead_rows},
+                  key=lambda r: (-float(plan.part_costs[r]), r))
+    survivors = [p for p in range(plan.n_parts)
+                 if p not in set(dead)]
+    if not survivors:
+        raise ValueError(
+            f"no surviving partitions (dead={sorted(dead)} of "
+            f"{plan.n_parts}): the mesh is gone, not degraded")
+    load = plan.part_costs.astype(np.float64).copy()
+    out = {}
+    for r in dead:
+        p = survivors[int(np.argmin(load[survivors]))]
+        out[r] = p
+        load[p] += float(plan.part_costs[r])
+    return out
 
 
 # ------------------------------------------------------------- 2-D mesh
@@ -442,8 +523,22 @@ def mine_partitioned_slices(*, plan: PartitionPlan, meshes: list,
     plus the active part's frontier UNCHANGED in the engine's own
     ``frontier_state`` format, fingerprint-bound to the partition
     layout.  Returns the union of every partition's rows (across
-    processes too — one exchange round)."""
+    processes too — one exchange round).
+
+    Topology survival (service/meshguard.py, when installed): a part
+    whose dispatch dies device-shaped marks its mesh row suspect/dead;
+    a dead row's slice RE-HOMES onto the :func:`adopters_for` survivor
+    — same part index, same class restriction, same resumed frontier
+    (the last snapshot the part forwarded), different silicon — so the
+    merged union stays byte-identical to the healthy run."""
     done, active_resume = decode_composite(resume, fingerprint)
+    guard = None
+    MG = None
+    try:  # lazy, like the jax import in exchange_objects: the parallel
+        from spark_fsm_tpu.service import meshguard as MG  # layer must
+        guard = MG.get()  # not hard-depend on the service layer
+    except Exception:
+        guard = None
 
     def composite(active_part, active_state):
         return composite_state(fingerprint, done, active_part,
@@ -452,12 +547,36 @@ def mine_partitioned_slices(*, plan: PartitionPlan, meshes: list,
     for p in owned_parts(plan):
         if p in done:
             continue
+        last = {"fs": active_resume.get(p)}
         part_cb = None
-        if checkpoint_cb is not None:
-            def part_cb(fs, p=p):
-                checkpoint_cb(composite(p, fs))
-        done[p] = list(mine_part(p, meshes[p], active_resume.get(p),
-                                 part_cb))
+        if checkpoint_cb is not None or guard is not None:
+            def part_cb(fs, p=p, last=last):
+                last["fs"] = fs  # adoption resume point, even with no
+                if checkpoint_cb is not None:  # durable checkpoint sink
+                    checkpoint_cb(composite(p, fs))
+        row, attempts = p, 0
+        while True:
+            try:
+                done[p] = list(mine_part(p, meshes[row], last["fs"],
+                                         part_cb))
+                if guard is not None:
+                    guard.note_row_ok(row)
+                break
+            except Exception as exc:
+                if guard is None:
+                    raise
+                state = guard.note_row_fault(row, exc)
+                attempts += 1
+                if state is None or attempts >= guard.max_retries:
+                    raise  # not device-shaped, or the mesh is melting
+                if state == MG.DEAD:
+                    adopter = adopters_for(
+                        plan, guard.dead_rows()).get(row)
+                    if adopter is None or adopter == row:
+                        raise
+                    MG.note_replan(guard.dead_rows())
+                    row = adopter
+                # suspect: one more try on the same row
         if checkpoint_cb is not None:
             checkpoint_cb(composite(None, None))
     # contribute ONLY owned parts to the exchange: a resumed composite

@@ -24,8 +24,8 @@ from typing import Dict, List, Optional
 from spark_fsm_tpu import config
 from spark_fsm_tpu.ops import ragged_batch as RB
 from spark_fsm_tpu.service import (autoscale, fairness, integrity, lease,
-                                   model, obsplane, planner, plugins,
-                                   predictor, resultcache, sources,
+                                   meshguard, model, obsplane, planner,
+                                   plugins, predictor, resultcache, sources,
                                    storeguard, usage)
 from spark_fsm_tpu.service.model import ServiceRequest, ServiceResponse, Status
 from spark_fsm_tpu.service.store import ResultStore
@@ -478,6 +478,21 @@ class UidConflict(RuntimeError):
             "wipe its state — wait for a terminal status or use a new uid")
 
 
+class QuarantinedUid(UidConflict):
+    """A submit naming a crash-loop-quarantined uid ([cluster]
+    max_adoptions exhausted).  Subclasses :class:`UidConflict` so every
+    handler maps it to the same 409 — but the message points the
+    operator at the release path instead of at a live job."""
+
+    def __init__(self, uid: str, adoptions: Optional[int] = None):
+        tag = "" if adoptions is None else f" after {adoptions} adoptions"
+        RuntimeError.__init__(
+            self,
+            f"uid {uid!r} is quarantined as a poison job{tag}; inspect "
+            f"fsm:quarantine:{uid} and release via "
+            "/admin/quarantine?action=release before resubmitting")
+
+
 # the ONE priority vocabulary (admission classes, SLO label seeding)
 # lives in obsplane — actors imports it so the two can never drift
 PRIORITIES = obsplane.PRIORITIES
@@ -797,6 +812,11 @@ class Miner:
         # both pass the 409 check and both admit — the state-wipe race
         # the conflict check exists to close
         self._admit_lock = threading.Lock()
+        # adoption counters staged by note_adoption() for the NEXT admit
+        # of a uid (recovery resubmit / steal): the journal intent the
+        # admit writes carries the count, so the crash-loop quarantine
+        # budget ([cluster] max_adoptions) survives further crashes
+        self._adoptions_pending: Dict[str, int] = {}
         # running-job count (distinct from queue depth): what the lease
         # heartbeat advertises and the steal scan's idle check reads
         self._running = 0
@@ -840,6 +860,16 @@ class Miner:
         # [usage] enabled = false — every dispatch-surface deposit
         # probe is then one module-global read.
         self._usage = usage.install(self.store, self._lease)
+        # degraded-topology survival plane (ISSUE 20, service/
+        # meshguard.py): per-partition-row health state machine +
+        # topology epochs + crash-loop quarantine.  Cluster mode
+        # gossips/probes off the lease heartbeat (meshguard tick phase
+        # inside LeaseManager.tick).  [meshguard] enabled = false is a
+        # strict no-op (a test-installed guard survives a Miner boot);
+        # uninstalled, every epoch check and row-fault probe costs one
+        # module-global read.
+        if config.get_config().meshguard.enabled:
+            meshguard.install(config.get_config().meshguard)
 
     # ------------------------------------------------------------ admission
 
@@ -1285,6 +1315,60 @@ class Miner:
             self._admitted += 1
         return {"ephemeral": "1"}
 
+    def note_adoption(self, uid: str, count: int) -> None:
+        """Stage adoption number ``count`` for the NEXT admit of
+        ``uid``: the journal intent the admit writes carries the
+        counter, so the crash-loop budget is durable across the very
+        crashes it is counting."""
+        self._adoptions_pending[str(uid)] = int(count)
+
+    def adopt_or_poison(self, uid: str, entry: Dict, raw=None) -> bool:
+        """Crash-loop quarantine gate, shared by boot/periodic recovery
+        and the steal path.  Returns True when ``uid`` may be adopted
+        once more (and pre-stamps the bumped counter for the resubmit);
+        False when the budget ([cluster] max_adoptions) is exhausted —
+        the job is settled instead as a durable ``POISON:`` terminal
+        plus an fsm:quarantine:{uid} record, and every resubmit 409s
+        until ``/admin/quarantine`` releases it."""
+        try:
+            n = int(entry.get("adoptions") or 0)
+        except (TypeError, ValueError):
+            n = 0
+        limit = config.get_config().cluster.max_adoptions
+        if n < limit:
+            self.note_adoption(uid, n + 1)
+            return True
+        self._settle_poison(uid, n, limit, raw=raw)
+        return False
+
+    def _settle_poison(self, uid: str, adoptions: int, limit: int,
+                       raw=None) -> None:
+        """Durable poison settle: quarantine record first (evidence =
+        the dead holders' trace-spine tail, so the operator sees WHERE
+        the crash loop bit without replaying it), then the normal
+        fenced failure path — no client ever polls a forever-pending
+        poison uid."""
+        evidence = None
+        try:
+            evidence = obsplane.spine_chunks(self.store, uid)[-3:]
+        except Exception:
+            evidence = None
+        meshguard.poison_record(
+            self.store, uid,
+            reason=(f"adoption budget exhausted: {adoptions} adoptions "
+                    f">= [cluster] max_adoptions={limit}"),
+            adoptions=adoptions, evidence=evidence, raw_intent=raw)
+        # keep_frontier: the preserved checkpoint is evidence too, and
+        # an operator release + resubmit resumes instead of re-mining
+        _record_failure(
+            self.store, uid,
+            RuntimeError(
+                f"POISON: job crashed its holder {adoptions} times "
+                f"([cluster] max_adoptions={limit}); quarantined — "
+                "release via /admin/quarantine to resubmit"),
+            keep_frontier=True, lease_mgr=self._lease,
+            rescache=self._rescache, guard=self._guard)
+
     def _admit(self, req: ServiceRequest, priority: str,
                deadline_s: Optional[float],
                tenant: str = fairness.DEFAULT_TENANT) -> bool:
@@ -1295,6 +1379,15 @@ class Miner:
         down)."""
         enqueued = False
         with self._admit_lock:
+            # crash-loop quarantine gate (meshguard): a poison record
+            # refuses the uid outright — 409 until an operator releases
+            # it via /admin/quarantine.  Integrity quarantines (other
+            # surfaces under the same prefix) do NOT block.
+            poison = meshguard.poisoned(self.store, req.uid)
+            if poison is not None:
+                meshguard.note_refused(req.uid)
+                raise QuarantinedUid(req.uid,
+                                     adoptions=poison.get("adoptions"))
             # the conflict check and the journal intent that makes the
             # uid LIVE must be one atomic step: two racing submits of
             # the same uid must serialize here so exactly one admits
@@ -1372,6 +1465,7 @@ class Miner:
                     "ts": round(time.time(), 3),
                     "checkpoint": _checkpoint_requested(req),
                     "priority": priority,
+                    "adoptions": self._adoptions_pending.pop(req.uid, 0),
                     "request": dict(req.data),
                 }))
                 if self._lease is not None:
@@ -2378,8 +2472,9 @@ _RECOVERY_TOTAL = obs.REGISTRY.counter(
     "fsm_recovery_jobs_total",
     "journal orphans handled by the boot recovery pass, by outcome")
 # zero-seed the outcome vocabulary (obs_smoke's no-orphan contract):
-# "quarantined" is the ISSUE 18 poison-intent outcome
-for _outcome in ("cleared", "resumed", "failed", "quarantined"):
+# "quarantined" is the ISSUE 18 poison-intent report bucket; "corrupt"
+# counts the same records once they ALSO settle as durable failures
+for _outcome in ("cleared", "resumed", "failed", "quarantined", "corrupt"):
     _RECOVERY_TOTAL.seed(outcome=_outcome)
 del _outcome
 
@@ -2425,11 +2520,25 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
             # back the RAW bytes on a failed envelope so this parse
             # fails): move it to fsm:quarantine:{uid} and keep
             # recovering the REMAINING orphans — one bad record must
-            # not wedge boot recovery for every other job (ISSUE 18)
+            # not wedge boot recovery for every other job (ISSUE 18).
+            # An undecodable intent can never be resumed, so the uid
+            # ALSO settles as a durable failure (lease-fenced: a live
+            # holder elsewhere keeps settling rights) — no client polls
+            # a forever-pending uid whose intent rotted.
             integrity.quarantine(store, f"fsm:journal:{uid}", raw,
                                  "journal", move=True)
+            if ((mgr is None or mgr.adopt_expired(uid))
+                    and store.status(uid) not in (Status.FINISHED,
+                                                  Status.FAILURE)):
+                _record_failure(
+                    store, uid,
+                    RuntimeError("journal intent corrupt (quarantined "
+                                 f"at fsm:quarantine:{uid}); re-submit "
+                                 "to re-mine"),
+                    keep_frontier=True, lease_mgr=mgr,
+                    rescache=miner._rescache, guard=miner._guard)
             report["quarantined"].append(uid)
-            _RECOVERY_TOTAL.inc(outcome="quarantined")
+            _RECOVERY_TOTAL.inc(outcome="corrupt")
             log_event("restart_recovery_quarantined", uid=uid)
             continue
         if entry.get("incarnation") == miner.incarnation:
@@ -2476,6 +2585,16 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
             if ref_ts is not None:
                 adoption_s = max(0.0, time.time() - ref_ts)
         if entry.get("checkpoint"):
+            # crash-loop quarantine gate ([cluster] max_adoptions): a
+            # job whose every holder dies would otherwise ping-pong
+            # through adoption forever.  Past the budget it settles as
+            # a durable POISON: terminal + fsm:quarantine:{uid} record
+            # (409 on resubmit until /admin/quarantine releases it).
+            if not miner.adopt_or_poison(uid, entry, raw=raw):
+                report["failed"].append(uid)
+                _RECOVERY_TOTAL.inc(outcome="failed")
+                log_event("restart_recovery_poisoned", uid=uid)
+                continue
             req = ServiceRequest("fsm", "train", {
                 str(k): str(v) for k, v in entry.get("request", {}).items()})
             try:
@@ -2498,7 +2617,10 @@ def recover_orphans(master: Master) -> Dict[str, List[str]]:
                 continue
             except Exception as exc:  # shed (tiny queue at boot) or a
                 # store hiccup: fall through to the durable failure —
-                # recovery must never leave the orphan pending
+                # recovery must never leave the orphan pending (and the
+                # staged adoption counter must not leak onto a future
+                # fresh submit of the same uid)
+                miner._adoptions_pending.pop(uid, None)
                 failure = RuntimeError(
                     f"interrupted by restart; recovery resubmit failed: "
                     f"{exc}")

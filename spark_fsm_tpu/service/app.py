@@ -75,6 +75,13 @@ Endpoints (POST, form- or JSON-encoded parameters):
                         from result-cache serves, top-N jobs by cost,
                         and the durable fsm:usage:{tenant} ledger rows;
                         {"enabled": false} when [usage] is off;
+  /admin/quarantine   — crash-loop quarantine ledger (service/
+                        meshguard.py): lists every fsm:quarantine:*
+                        record (poison AND integrity surfaces);
+                        ``action=release&uid=...`` deletes a poison
+                        record so the uid may be resubmitted (404 when
+                        no record exists) — the operator end of the
+                        [cluster] max_adoptions POISON: terminal;
   /admin/drain        — drive the scale-down drain protocol NOW (stop
                         admitting → peers steal the queue → leases
                         released); ``exit=1`` also stops the server
@@ -387,6 +394,42 @@ class FsmHandler(BaseHTTPRequestHandler):
 
                 self._send(200, json.dumps(
                     usage.report(self.master.store)))
+            elif task == "quarantine":
+                # crash-loop quarantine ledger (service/meshguard.py):
+                # list every preserved fsm:quarantine:* record, or
+                # release one (action=release&uid=...) so a poisoned
+                # uid may be resubmitted — the operator end of the
+                # [cluster] max_adoptions POISON: terminal
+                from spark_fsm_tpu.service import meshguard
+
+                d = data or {}
+                action = d.get("action", "list")
+                if action == "release":
+                    uid = d.get("uid", "")
+                    if not uid:
+                        self._send(400, json.dumps({
+                            "status": "failure",
+                            "error": "release needs a uid: /admin/"
+                                     "quarantine?action=release&uid=..."}))
+                        return
+                    if not meshguard.quarantine_release(
+                            self.master.store, uid):
+                        self._send(404, json.dumps({
+                            "status": "failure",
+                            "error": f"no quarantine record for uid "
+                                     f"{uid!r}"}))
+                        return
+                    self._send(200, json.dumps(
+                        {"status": "released", "uid": uid}))
+                    return
+                if action != "list":
+                    raise ValueError(f"unknown quarantine action "
+                                     f"{action!r} (list/release)")
+                g = meshguard.get()
+                self._send(200, json.dumps({
+                    "records": meshguard.quarantine_list(
+                        self.master.store),
+                    "mesh": None if g is None else g.stats()}))
             elif task == "predictor":
                 # prediction serving plane (service/predictor.py):
                 # request/wave counters, resident artifact inventory

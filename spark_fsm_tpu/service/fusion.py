@@ -63,7 +63,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from spark_fsm_tpu.ops import ragged_batch as RB
-from spark_fsm_tpu.service import usage
+from spark_fsm_tpu.service import meshguard, usage
 from spark_fsm_tpu.utils import faults, jobctl, obs, shapes, watchdog
 from spark_fsm_tpu.utils.obs import log_event
 
@@ -139,8 +139,8 @@ class EvalWave:
 
     __slots__ = ("uid", "priority", "cands", "pools", "p1", "s1",
                  "eval_fn", "put", "cap", "lane", "n_seq", "n_words",
-                 "t_submit", "_event", "_sups", "_supxs", "_report",
-                 "_error")
+                 "t_submit", "topology_epoch", "_event", "_sups",
+                 "_supxs", "_report", "_error")
 
     def __init__(self, *, uid: str, priority: str, cands, pools,
                  p1, s1, eval_fn, put, cap, lane: int, n_seq: int,
@@ -158,6 +158,11 @@ class EvalWave:
         self.n_seq = int(n_seq)
         self.n_words = int(n_words)
         self.t_submit = time.monotonic()
+        # topology epoch at submit (service/meshguard.py, None when the
+        # plane is off): the broker re-checks at launch time — a row
+        # death between submit and dispatch refuses the wave instead of
+        # executing it on dead silicon
+        self.topology_epoch = meshguard.current_epoch()
         self._event = threading.Event()
         self._sups = self._supxs = None
         self._report: dict = {}
@@ -400,6 +405,22 @@ class FusionBroker:
         waves = group.waves
         wait_s = time.monotonic() - group.t0
         _WINDOW_WAIT.observe(wait_s)
+        # topology-epoch fence (service/meshguard.py): a wave planned
+        # against a mesh a row death has since invalidated is REFUSED
+        # here — failed upward so the orchestrator re-plans onto the
+        # survivors, never degraded to a solo launch on dead silicon
+        live = []
+        for w in waves:
+            try:
+                meshguard.check_epoch(w.topology_epoch)
+            except meshguard.StaleTopology as exc:
+                _mark(w.uid, "fusion_stale_epoch", error=str(exc))
+                w.fail(exc)
+                continue
+            live.append(w)
+        waves = live
+        if not waves:
+            return
         try:
             faults.fault_site("fusion.dispatch", point="window",
                               jobs=str(len(waves)))
@@ -851,7 +872,11 @@ def dispatch_wave(engine: str, fn: Callable, **ctx):
     segment dispatch) through the broker's accounting/fault surface.
     One global read when the broker is off.  An armed
     ``fusion.dispatch`` fault DEGRADES to a direct dispatch — broker
-    failure must never lose a wave."""
+    failure must never lose a wave.  A ``topology_epoch`` in ``ctx``
+    is the meshguard fence: a wave planned against a stale mesh is
+    REFUSED (StaleTopology) — that one failure mode must never degrade
+    to a direct dispatch on dead silicon."""
+    meshguard.check_epoch(ctx.pop("topology_epoch", None))
     if not _on:
         return fn()
     _WAVES_TOTAL.inc(engine=engine, fused="false")

@@ -722,8 +722,22 @@ class LeaseManager:
                     if m is not None else 0),
             "acq": int(_ACQUIRE_TOTAL.total()),
             "lost": int(_LOST_TOTAL.total()),
+            # degraded-topology gossip (ISSUE 20, service/meshguard.py):
+            # {"epoch", "dead"} so peers converge on the fleet-max
+            # topology epoch and the union dead-row set; None when the
+            # guard is off
+            "mesh": self._mesh_payload(),
             "ts": round(time.time(), 3)})), self._ttl_ms)
         _HEARTBEATS_TOTAL.inc()
+
+    @staticmethod
+    def _mesh_payload() -> Optional[dict]:
+        try:
+            from spark_fsm_tpu.service import meshguard
+            g = meshguard.get()
+            return None if g is None else g.heartbeat_payload()
+        except Exception:
+            return None
 
     def peers(self, max_age_s: Optional[float] = None) -> List[dict]:
         """Live peer heartbeat records.  ``max_age_s`` serves a cached
@@ -897,6 +911,17 @@ class LeaseManager:
         self._store.set_px(self._lease_key(uid), self._payload(token),
                            self._ttl_ms)
         self._set_held(uid, token, t0 + self.lease_ttl_s)
+        # a steal IS an adoption: stage the bumped counter so the
+        # resubmit's journal intent carries it — the crash-loop
+        # quarantine budget ([cluster] max_adoptions) counts holders
+        # lost to steals and crashes alike
+        bump = getattr(self._miner, "note_adoption", None)
+        if bump is not None:
+            try:
+                n = int(entry.get("adoptions") or 0)
+            except (TypeError, ValueError):
+                n = 0
+            bump(uid, n + 1)
         req = ServiceRequest("fsm", "train", {
             str(k): str(v) for k, v in entry["request"].items()})
         try:
@@ -918,6 +943,9 @@ class LeaseManager:
             except Exception as restore_exc:
                 log_event("job_steal_restore_failed", uid=uid,
                           error=str(restore_exc))
+            # the staged adoption counter must not leak onto an
+            # unrelated future admit of the same uid
+            getattr(self._miner, "_adoptions_pending", {}).pop(uid, None)
             self.release(uid)
             _STEAL_TOTAL.inc(outcome="error")
             log_event("job_steal_resubmit_failed", uid=uid, victim=victim,
@@ -1012,6 +1040,20 @@ class LeaseManager:
             usage.tick()
         except Exception as exc:
             log_event("usage_flush_failed", error=str(exc))
+        # degraded-topology gossip + probe (ISSUE 20) rides the same
+        # cadence: adopt peers' advertised mesh views (monotone merge —
+        # max epoch, union dead rows) and run the cadenced zero-width
+        # row probe.  One module-global read per tick when the guard is
+        # off; probe cadence gating lives inside the guard.
+        try:
+            from spark_fsm_tpu.service import meshguard
+            g = meshguard.get()
+            if g is not None:
+                for p in self.peers(max_age_s=self.heartbeat_s or None):
+                    g.merge_peer(p.get("mesh"))
+                g.maybe_probe()
+        except Exception as exc:
+            log_event("meshguard_tick_failed", error=str(exc))
 
     def quiesce(self) -> None:
         """Stop pulling NEW work (steal scans, periodic adoption) while

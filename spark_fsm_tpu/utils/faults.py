@@ -259,12 +259,31 @@ def reset_counters() -> None:
         _counters.clear()
 
 
+def _ctx_matches(match: str, ctx: dict) -> bool:
+    """Spec-``match`` predicate: substring over the call's string ctx
+    values, PLUS the ambient job identity — a ``match`` of the exact
+    form ``uid=<job uid>`` matches any guarded call made on that job's
+    worker thread (utils/jobctl contextvar), so a chaos drill can arm a
+    poison DATASET (every holder of the job crashes at dispatch, on
+    every replica that adopts it) without the engines threading uids
+    into every site's ctx."""
+    if any(match in v for v in ctx.values() if isinstance(v, str)):
+        return True
+    if match.startswith("uid="):
+        from spark_fsm_tpu.utils import jobctl  # lazy: no import cycle
+        ctl = jobctl.current()
+        return ctl is not None and match == f"uid={ctl.uid}"
+    return False
+
+
 def fault_site(site: str, **ctx) -> None:
     """The guard woven into real call sites; raises/delays when armed.
 
     Context values are matched as substrings against the spec's
-    ``match`` (all calls match when unset).  Counting happens only while
-    the site is armed — the disarmed path is one global read.
+    ``match`` (all calls match when unset; a ``uid=...`` match also
+    consults the ambient job identity — see :func:`_ctx_matches`).
+    Counting happens only while the site is armed — the disarmed path
+    is one global read.
     """
     if not _active:
         return
@@ -272,8 +291,7 @@ def fault_site(site: str, **ctx) -> None:
         spec = _armed.get(site)
         if spec is None:
             return
-        if spec.match is not None and not any(
-                spec.match in v for v in ctx.values() if isinstance(v, str)):
+        if spec.match is not None and not _ctx_matches(spec.match, ctx):
             return
         spec.calls += 1
         c = _counters.setdefault(site, {"calls": 0, "injected": 0})
@@ -322,8 +340,7 @@ def corrupt_value(site: str, value, **ctx):
         spec = _armed.get(site)
         if spec is None:
             return value
-        if spec.match is not None and not any(
-                spec.match in v for v in ctx.values() if isinstance(v, str)):
+        if spec.match is not None and not _ctx_matches(spec.match, ctx):
             return value
         spec.calls += 1
         c = _counters.setdefault(site, {"calls": 0, "injected": 0})

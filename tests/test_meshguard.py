@@ -1,0 +1,363 @@
+"""Degraded-topology survival drills (ISSUE 20, service/meshguard.py).
+
+The contracts under test:
+
+- HEALTH STATE MACHINE: device-shaped failures walk a partition row
+  healthy -> suspect -> dead (``dead_after`` trips); non-device
+  exceptions never move health; suspect rows heal on success; dead rows
+  never heal passively; every death bumps the topology epoch; the
+  heartbeat gossip merge is monotone (max epoch, union dead) so order
+  cannot matter.
+- DEGRADED RE-PLAN: ``replan_surviving`` re-homes ONLY the dead rows'
+  classes (LPT over recorded class costs) — survivors keep theirs —
+  and ``adopters_for`` maps each dead part to a deterministic surviving
+  adopter.
+- IN-FLIGHT ADOPTION PARITY: killing a partition row mid-mine on the
+  8-virtual-device 2x4 mesh re-homes its slice onto the survivor and
+  the merged result stays byte-identical to the healthy run.
+- STALE-EPOCH FENCE: launches planned against a pre-death epoch are
+  REFUSED (StaleTopology) at the engine dispatch and the fusion broker
+  entry — never silently run on dead silicon.
+- CRASH-LOOP QUARANTINE: a poison job that kills every holder is
+  adopted exactly ``[cluster] max_adoptions`` times across a 2-miner
+  fleet, then settles as a durable ``POISON:`` terminal with an
+  ``fsm:quarantine:{uid}`` record; resubmits 409 until the record is
+  released, after which the job completes clean.
+- CORRUPT-INTENT SETTLE: an undecodable journal intent quarantines AND
+  settles as a durable failure (outcome="corrupt") — no forever-pending
+  uid.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from spark_fsm_tpu.config import MeshguardConfig
+from spark_fsm_tpu.data.spmf import format_spmf
+from spark_fsm_tpu.data.synth import kosarak_like, synthetic_db
+from spark_fsm_tpu.parallel import partition as PN
+from spark_fsm_tpu.parallel.mesh import make_mesh
+from spark_fsm_tpu.service import integrity, meshguard as MG
+from spark_fsm_tpu.service.actors import Master, recover_orphans
+from spark_fsm_tpu.service.lease import LeaseManager
+from spark_fsm_tpu.service.model import ServiceRequest
+from spark_fsm_tpu.service.store import ResultStore
+from spark_fsm_tpu.utils import faults, obs
+from spark_fsm_tpu.utils.canonical import rules_text
+
+DRILL_TIMEOUT_S = 120.0
+
+
+def _req(uid, **extra):
+    # SPADE_TPU: the plain-CPU plugin ignores the checkpoint object, and
+    # the poison drill's crash fires INSIDE checkpoint.save
+    data = {"algorithm": "SPADE_TPU", "source": "INLINE",
+            "sequences": format_spmf(synthetic_db(
+                seed=17, n_sequences=120, n_items=10, mean_itemsets=3.0,
+                mean_itemset_size=1.3)),
+            "support": "0.1", "uid": uid}
+    data.update(extra)
+    return ServiceRequest("fsm", "train", data)
+
+
+def _await(cond, what, timeout=DRILL_TIMEOUT_S):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"never happened: {what}")
+
+
+# ------------------------------------------------- health state machine
+
+
+def test_meshguard_health_state_machine_and_gossip():
+    g = MG.MeshGuard(dead_after=2)
+    assert g.state_of(0) == MG.HEALTHY
+    # non-device exceptions never move health: None = caller re-raises
+    assert g.note_row_fault(0, ValueError("store blip")) is None
+    assert g.state_of(0) == MG.HEALTHY
+    assert g.note_row_fault(
+        0, faults.FaultInjected("injected fault")) == MG.SUSPECT
+    g.note_row_ok(0)  # a suspect row heals on success
+    assert g.state_of(0) == MG.HEALTHY
+    assert g.current_epoch() == 0
+    assert g.note_row_fault(0, None) == MG.SUSPECT
+    assert g.note_row_fault(0, None) == MG.DEAD  # dead_after=2 trips
+    assert g.current_epoch() == 1  # every death is an epoch
+    g.note_row_ok(0)  # dead rows never heal passively
+    assert g.state_of(0) == MG.DEAD
+    assert g.dead_rows() == frozenset({0})
+    # gossip merge is monotone (max epoch, union dead): order-free
+    h = MG.MeshGuard(dead_after=2)
+    h.merge_peer(g.heartbeat_payload())
+    assert h.state_of(0) == MG.DEAD and h.current_epoch() == 1
+    h.merge_peer({"epoch": 0, "dead": []})  # a stale peer view: no-op
+    assert h.state_of(0) == MG.DEAD and h.current_epoch() == 1
+    h.merge_peer(None)  # solo replicas advertise None
+    h.merge_peer({"epoch": "garbage"})  # bitrot tolerated
+    assert h.current_epoch() == 1
+
+
+def test_probe_trips_and_fences_row():
+    g = MG.MeshGuard(dead_after=1)
+    g.register_rows({0: (), 1: ()})
+    assert g.probe() == {0: MG.HEALTHY, 1: MG.HEALTHY}
+    faults.arm("device.dispatch", every=1, match="part1")
+    try:
+        out = g.probe()
+    finally:
+        faults.disarm()
+    assert out == {0: MG.HEALTHY, 1: MG.DEAD}  # dead_after=1 fences
+    assert g.current_epoch() == 1
+    assert g.probe()[1] == MG.DEAD  # dead rows are not re-probed
+
+
+# ------------------------------------------------------ degraded re-plan
+
+
+def test_replan_surviving_keeps_survivors_and_lpt_rebalances():
+    rng = np.random.default_rng(11)
+    ids = rng.choice(100000, size=400, replace=False)
+    sups = rng.integers(1, 1000, size=400)
+    plan = PN.plan_partitions(ids, sups, 4, 64, record=False)
+    new = PN.replan_surviving(plan, [1, 3])
+    assert (new.n_parts, new.n_classes) == (plan.n_parts, plan.n_classes)
+    for c in range(plan.n_classes):
+        if int(plan.owner[c]) in (0, 2):  # survivors keep their classes
+            assert int(new.owner[c]) == int(plan.owner[c])
+        else:  # orphaned classes land on SOME survivor
+            assert int(new.owner[c]) in (0, 2)
+    # dead partitions end empty; total cost is conserved
+    assert float(new.part_costs[1]) == 0.0
+    assert float(new.part_costs[3]) == 0.0
+    assert np.isclose(new.part_costs.sum(), plan.part_costs.sum())
+    # LPT keeps the 2-survivor split bounded
+    assert new.part_costs[[0, 2]].max() < 0.8 * new.part_costs.sum()
+    # deterministic: every process derives the identical re-plan
+    again = PN.replan_surviving(plan, [3, 1])
+    assert (again.owner == new.owner).all()
+    assert PN.replan_surviving(plan, []) is plan
+    with pytest.raises(ValueError):
+        PN.replan_surviving(plan, [0, 1, 2, 3])
+
+
+def test_adopters_for_is_deterministic_lpt():
+    rng = np.random.default_rng(3)
+    ids = rng.choice(100000, size=300, replace=False)
+    sups = rng.integers(1, 1000, size=300)
+    plan = PN.plan_partitions(ids, sups, 4, 64, record=False)
+    ad = PN.adopters_for(plan, [1, 2])
+    assert set(ad) == {1, 2}
+    assert set(ad.values()) <= {0, 3}
+    # both survivors share the orphaned slices (LPT: the two dead
+    # parts' loads spread, they do not both pile onto one survivor)
+    assert len(set(ad.values())) == 2
+    assert PN.adopters_for(plan, [2, 1]) == ad
+    with pytest.raises(ValueError):
+        PN.adopters_for(plan, [0, 1, 2, 3])
+
+
+# ------------------------------------------------- stale-topology fence
+
+
+def test_stale_epoch_refused_at_engine_and_broker():
+    g = MG.install(MeshguardConfig(enabled=True, dead_after=1))
+    try:
+        assert MG.current_epoch() == 0
+        MG.check_epoch(0)  # planned == current: passes
+        MG.check_epoch(None)  # pre-plane launches always pass
+        g.mark_dead(0)
+        with pytest.raises(MG.StaleTopology) as ei:
+            MG.check_epoch(0)
+        assert ei.value.planned == 0 and ei.value.current == 1
+        MG.check_epoch(1)  # re-planned launches pass again
+        # broker entry: a stale unfusable wave is REFUSED (StaleTopology
+        # propagates), never degraded onto dead silicon
+        from spark_fsm_tpu.service import fusion
+        with pytest.raises(MG.StaleTopology):
+            fusion.dispatch_wave(object(), lambda: None, topology_epoch=0)
+    finally:
+        MG.reset()
+
+
+# ------------------------------------- in-flight adoption (kill a row)
+
+
+def test_tsr_partitioned_row_death_adoption_parity():
+    """Chaos drill: on the 8-virtual-device 2x4 mesh, a device-shaped
+    fault kills partition row 0 mid-mine (dead_after=1); its slice is
+    adopted by the surviving row and the merged rules stay
+    byte-identical to the healthy single-device run."""
+    from spark_fsm_tpu.models.tsr import mine_tsr_tpu
+
+    db = kosarak_like(scale=0.002, fast=True)
+    want = rules_text(mine_tsr_tpu(db, 100, 0.5, max_side=2))
+    g = MG.install(MeshguardConfig(enabled=True, dead_after=1))
+    try:
+        faults.arm("device.dispatch", every=1, times=1, match="part0")
+        got = mine_tsr_tpu(db, 100, 0.5, max_side=2, mesh=make_mesh(8),
+                           partition_parts=2)
+        assert rules_text(got) == want
+        assert g.dead_rows() == frozenset({0})
+        assert g.current_epoch() >= 1
+        # unlabelled counters snapshot to a bare float
+        assert obs.REGISTRY.snapshot()["fsm_mesh_replans_total"] >= 1
+    finally:
+        faults.disarm()
+        MG.reset()
+
+
+# ---------------------------------------------- corrupt-intent recovery
+
+
+def test_recover_orphans_corrupt_intent_settles_durably():
+    store = ResultStore()
+    store.set("fsm:journal:rot-1", "definitely { not json")
+    master = Master(store=store, miner_workers=0)
+    try:
+        report = recover_orphans(master)
+    finally:
+        master.shutdown()
+    assert report["quarantined"] == ["rot-1"]
+    # quarantined AND settled: the client polling rot-1 sees a terminal
+    assert store.status("rot-1") == "failure"
+    assert "corrupt" in (store.get("fsm:error:rot-1") or "")
+    assert store.peek("fsm:journal:rot-1") is None  # moved
+    assert store.peek("fsm:quarantine:rot-1") is not None
+    snap = obs.REGISTRY.snapshot()["fsm_recovery_jobs_total"]
+    assert snap.get("outcome=corrupt", 0) >= 1
+
+
+# -------------------------------------------- quarantine ledger (unit)
+
+
+def test_quarantine_ledger_only_poison_blocks():
+    store = ResultStore()
+    MG.poison_record(store, "u-poison", reason="budget", adoptions=3)
+    rec = MG.poisoned(store, "u-poison")
+    assert rec["adoptions"] == 3 and rec["surface"] == "poison"
+    # idempotent: re-settling neither rewrites nor recounts
+    MG.poison_record(store, "u-poison", reason="other", adoptions=9)
+    assert MG.poisoned(store, "u-poison")["reason"] == "budget"
+    # an ISSUE 18 integrity quarantine (surface "journal") must NOT
+    # block re-admission — only crash-loop poison does
+    integrity.quarantine(store, "fsm:journal:u-bitrot", "raw??", "journal")
+    assert MG.poisoned(store, "u-bitrot") is None
+    rows = MG.quarantine_list(store)
+    assert {r.get("surface") for r in rows} == {"poison", "journal"}
+    assert MG.quarantine_release(store, "nope") is False  # the 404 case
+    assert MG.quarantine_release(store, "u-poison") is True
+    assert MG.poisoned(store, "u-poison") is None
+
+
+# ------------------------------------------------- steal bumps adoptions
+
+
+def test_steal_bumps_adoption_counter():
+    t = [0.0]
+    store = ResultStore(clock=lambda: t[0])
+    mgr = LeaseManager(store, replica_id="thief", lease_ttl_s=10.0,
+                       heartbeat_s=0, clock=lambda: t[0])
+    calls = {}
+
+    class FakeMiner:
+        def note_adoption(self, uid, count):
+            calls["adoption"] = (uid, count)
+
+        def submit(self, req):
+            calls["submitted"] = req.uid
+
+    mgr.start(FakeMiner())
+    store.journal_set("s1", json.dumps(
+        {"uid": "s1", "adoptions": 1, "ts": 1.0,
+         "request": {"uid": "s1"}}))
+    store.set("fsm:admission:victim:s1", "1")
+    assert mgr._steal_one("fsm:admission:victim:s1", "s1", "victim")
+    assert calls["submitted"] == "s1"
+    assert calls["adoption"] == ("s1", 2)  # parsed 1, staged 2
+
+
+# --------------------------------- crash-loop quarantine (2-miner fleet)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_poison_job_quarantined_after_max_adoptions():
+    """The acceptance drill: a poison job (every holder crashes at its
+    first checkpoint save) is adopted exactly ``max_adoptions`` (3)
+    times across a 2-miner fleet, then settles as a durable ``POISON:``
+    terminal; resubmission 409s until ``/admin/quarantine`` releases
+    the record, after which the job completes clean."""
+
+    class _Crash(KeyboardInterrupt):
+        """BaseException: kills the worker thread like a process crash
+        — Miner._loop's supervision catches only Exception, so the
+        journal intent and lease survive untouched."""
+
+    uid = "poison-drill"
+    t = [0.0]
+    store = ResultStore(clock=lambda: t[0])
+    mk = lambda rid: LeaseManager(store, replica_id=rid, lease_ttl_s=5.0,
+                                  heartbeat_s=0, clock=lambda: t[0])
+    # each crash permanently consumes one worker THREAD (the point of
+    # the drill: real crashed processes); 3 per miner leaves a survivor
+    # on rep-b for the post-release clean run
+    master_a = Master(store=store, miner_workers=3, lease_mgr=mk("rep-a"))
+    master_b = Master(store=store, miner_workers=3, lease_mgr=mk("rep-b"))
+
+    def crashes():
+        # injection counters are CUMULATIVE across disarm (they survive
+        # for post-mortems), so measure relative to the suite's baseline
+        return (faults.counters().get("checkpoint.save",
+                                      {}).get("injected", 0) - base)
+
+    base = faults.counters().get("checkpoint.save", {}).get("injected", 0)
+    try:
+        faults.arm("checkpoint.save", every=1, match=uid, exc=_Crash)
+        master_a.miner.submit(_req(uid, checkpoint="1",
+                                   checkpoint_every_s="0"))
+        _await(lambda: crashes() >= 1, "first holder crash")
+        # each recovery must run on the NON-holding replica (the
+        # holder's own incarnation tag reads as live to itself)
+        for n, master in enumerate((master_b, master_a, master_b),
+                                   start=1):
+            t[0] += 10.0  # the dead holder's lease expires
+            report = recover_orphans(master)
+            assert report["resumed"] == [uid], f"adoption {n}: {report}"
+            assert json.loads(
+                store.journal_get(uid))["adoptions"] == n
+            _await(lambda n=n: crashes() >= n + 1,
+                   f"holder crash after adoption {n}")
+        # adoption budget (default max_adoptions=3) exhausted: the next
+        # recovery settles POISON instead of adopting a 4th time
+        t[0] += 10.0
+        report = recover_orphans(master_a)
+        assert report["failed"] == [uid] and report["resumed"] == []
+        assert store.status(uid) == "failure"
+        assert (store.get(f"fsm:error:{uid}") or "").startswith("POISON:")
+        assert store.journal_get(uid) is None  # settled, not pending
+        rec = MG.poisoned(store, uid)
+        assert rec is not None and rec["adoptions"] == 3
+        # resubmission is REFUSED with the 409 conflict mapping
+        resp = master_b.handle(_req(uid, checkpoint="1"))
+        assert resp.data.get("http_status") == "409"
+        assert "quarantine" in resp.data.get("error", "")
+        snap = obs.REGISTRY.snapshot()["fsm_quarantine_jobs_total"]
+        assert snap.get("outcome=poisoned", 0) >= 1
+        assert snap.get("outcome=refused", 0) >= 1
+        # operator release: the record clears, the fault is gone (the
+        # poison dataset was "fixed"), and the resubmit completes clean
+        assert MG.quarantine_release(store, uid) is True
+        faults.disarm()
+        master_b.miner.submit(_req(uid, checkpoint="1"))
+        _await(lambda: store.status(uid) in ("finished", "failure"),
+               "released job terminal")
+        assert store.status(uid) == "finished"
+    finally:
+        faults.disarm()
+        master_b.shutdown()
+        master_a.shutdown()
